@@ -1,0 +1,26 @@
+"""ouroboros_consensus_tpu — a TPU-native consensus & storage framework.
+
+A brand-new implementation of the capabilities of the Cardano consensus and
+storage layer (reference: karknu/ouroboros-consensus, Haskell), designed
+TPU-first: the block-validation hot path (Ed25519 / KES / ECVRF signature
+verification, Blake2b / SHA-512 hashing) runs as batched JAX/XLA kernels on
+columnar header batches, while the control plane (chain selection, storage,
+mempool, mini-protocols) is host-side Python with a deterministic simulation
+harness for multi-node tests.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  ops/          batched crypto kernels + pure-Python host reference impls
+  protocol/     ConsensusProtocol interface; Praos / BFT / PBFT instances
+  ledger/       ledger interface, extended ledger state, mock ledger
+  block/        block/header model, CBOR codecs, SoA batch staging
+  storage/      ImmutableDB / VolatileDB / LedgerDB / ChainDB + ChainSel
+  mempool/      transaction pool consistent with the ledger
+  miniprotocol/ ChainSync / BlockFetch client+server logic over channels
+  node/         node kernel: forging loop, clocks, assembly
+  hardfork/     era composition (hard-fork combinator) + time conversions
+  parallel/     device mesh sharding, nonce scan, multi-chip fan-out
+  utils/        CBOR, tracers, registry, deterministic sim runtime
+  tools/        db_synthesizer / db_analyser / db_truncater / immdb_server
+"""
+
+__version__ = "0.1.0"

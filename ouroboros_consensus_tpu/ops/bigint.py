@@ -1,0 +1,141 @@
+"""Generic multi-precision helpers on 13-bit int32 limbs (batched, jnp).
+
+Unlike ops/field.py (which is specialized to GF(2^255-19) with wrap-around
+reduction), these helpers operate on plain non-negative integers spread over
+an arbitrary number of 13-bit limbs. Used by the mod-L scalar reduction
+(ops/scalar.py) and anywhere byte strings become integers on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax import numpy as jnp
+
+BITS = 13
+MASK = (1 << BITS) - 1
+
+
+def nlimbs_for_bits(bits: int) -> int:
+    return -(-bits // BITS)
+
+
+def int_to_limbs_np(x: int, n: int) -> np.ndarray:
+    assert x >= 0 and x < 1 << (BITS * n)
+    return np.array([(x >> (BITS * i)) & MASK for i in range(n)], dtype=np.int32)
+
+
+def limbs_to_int_np(limbs) -> int:
+    return sum(int(v) << (BITS * i) for i, v in enumerate(np.asarray(limbs)))
+
+
+def bytes_to_limbs(b, n: int):
+    """[..., nbytes] little-endian bytes -> [..., n] normalized limbs."""
+    b = b.astype(jnp.int32)
+    nbytes = b.shape[-1]
+    bits = (b[..., :, None] >> jnp.arange(8, dtype=jnp.int32)) & 1
+    bits = bits.reshape(*b.shape[:-1], nbytes * 8)
+    want = n * BITS
+    if want > nbytes * 8:
+        pad = jnp.zeros((*b.shape[:-1], want - nbytes * 8), jnp.int32)
+        bits = jnp.concatenate([bits, pad], axis=-1)
+    else:
+        bits = bits[..., :want]
+    groups = bits.reshape(*b.shape[:-1], n, BITS)
+    return jnp.sum(groups * (1 << jnp.arange(BITS, dtype=jnp.int32)), axis=-1)
+
+
+def limbs_to_bits(x, nbits: int):
+    """[..., n] normalized limbs -> [..., nbits] bits (little-endian)."""
+    bits = (x[..., :, None] >> jnp.arange(BITS, dtype=jnp.int32)) & 1
+    bits = bits.reshape(*x.shape[:-1], x.shape[-1] * BITS)
+    return bits[..., :nbits]
+
+
+def carry(z, passes: int = 2, keep: int | None = None):
+    """Vectorized carry passes; pads one limb to catch the top carry.
+    `keep` truncates/zero-pads the result to a fixed limb count."""
+    z = jnp.concatenate([z, jnp.zeros_like(z[..., :1])], axis=-1)
+    for _ in range(passes):
+        c = z >> BITS
+        z = (z & MASK) + jnp.concatenate(
+            [jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1
+        )
+    if keep is not None:
+        cur = z.shape[-1]
+        if cur > keep:
+            z = z[..., :keep]
+        elif cur < keep:
+            z = jnp.concatenate(
+                [z, jnp.zeros((*z.shape[:-1], keep - cur), jnp.int32)], axis=-1
+            )
+    return z
+
+
+def seq_carry(z):
+    """Full sequential carry; returns (normalized limbs, final carry-out)."""
+    c = jnp.zeros_like(z[..., 0])
+    out = []
+    for i in range(z.shape[-1]):
+        v = z[..., i] + c
+        out.append(v & MASK)
+        c = v >> BITS
+    return jnp.stack(out, axis=-1), c
+
+
+def mul(a, b):
+    """Product of normalized limb vectors: [..., n] x [..., m] -> [..., n+m].
+
+    Accumulation bound: min(n, m) * 2^26 must stay below 2^31, i.e.
+    min(n, m) <= 32 limbs (416 bits) — ample for scalar reduction.
+    """
+    n, m = a.shape[-1], b.shape[-1]
+    assert min(n, m) <= 32
+    ap = jnp.concatenate(
+        [a, jnp.zeros((*a.shape[:-1], m), jnp.int32)], axis=-1
+    )  # [..., n+m]
+    z = jnp.zeros_like(ap)
+    for i in range(m):
+        z = z + b[..., i : i + 1] * jnp.roll(ap, i, axis=-1)
+    return carry(z, passes=2, keep=n + m)
+
+
+def mul_const_np(a, k_limbs: np.ndarray):
+    """Multiply by a host constant (numpy limb vector)."""
+    return mul(a, jnp.broadcast_to(jnp.asarray(k_limbs), (*a.shape[:-1], len(k_limbs))))
+
+
+def shift_right_limbs(a, k: int):
+    return a[..., k:]
+
+
+def sub_mod_2k(a, b, n: int):
+    """(a - b) mod 2^(13n), exact when the true difference is in [0, 2^(13n)).
+    Sequential borrow over n limbs. Both inputs must be NORMALIZED
+    (limbs <= MASK): the borrow logic only covers borrow in {0, 1}.
+    Note bi.mul output is only nearly normalized — seq_carry it first."""
+    borrow = jnp.zeros_like(a[..., 0])
+    out = []
+    for i in range(n):
+        av = a[..., i] if i < a.shape[-1] else jnp.zeros_like(a[..., 0])
+        bv = b[..., i] if i < b.shape[-1] else jnp.zeros_like(b[..., 0])
+        v = av - bv - borrow
+        out.append(v & MASK)
+        borrow = jnp.where(v < 0, 1, 0)
+    return jnp.stack(out, axis=-1)
+
+
+def geq(a, b):
+    """a >= b for normalized limb vectors of equal length -> bool[...]."""
+    assert a.shape[-1] == b.shape[-1]
+    borrow = jnp.zeros_like(a[..., 0])
+    for i in range(a.shape[-1]):
+        v = a[..., i] - b[..., i] - borrow
+        borrow = jnp.where(v < 0, 1, 0)
+    return borrow == 0
+
+
+def cond_sub(a, b):
+    """a - b when a >= b else a (same length, normalized)."""
+    n = a.shape[-1]
+    d = sub_mod_2k(a, b, n)
+    return jnp.where(geq(a, b)[..., None], d, a)

@@ -1,0 +1,209 @@
+"""Batched edwards25519 point arithmetic on limb-vector coordinates.
+
+Points are extended homogeneous coordinates (X, Y, Z, T) with T = XY/Z,
+each coordinate a nearly-normalized field element [..., 20] (ops/field.py).
+All control flow is batch-uniform: failures (bad encodings) are carried as
+mask lanes, never branches — the TPU-native discipline for the Praos hot
+path (SURVEY.md section 7.3).
+
+The unified addition law (complete for twisted Edwards a=-1) is used for
+both generic adds and table lookups, so the identity and doublings need no
+special-casing inside ladders.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+from jax import lax
+from jax import numpy as jnp
+
+from . import bigint as bi
+from . import field as fe
+from .host import ed25519 as he
+
+
+class Point(NamedTuple):
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+def identity(batch_shape=()) -> Point:
+    return Point(
+        fe.zeros(batch_shape),
+        fe.ones(batch_shape),
+        fe.ones(batch_shape),
+        fe.zeros(batch_shape),
+    )
+
+
+def add(p: Point, q: Point) -> Point:
+    a = fe.mul(fe.sub(p.y, p.x), fe.sub(q.y, q.x))
+    b = fe.mul(fe.add(p.y, p.x), fe.add(q.y, q.x))
+    c = fe.mul(fe.mul_small(fe.mul(p.t, q.t), 2), fe.constant(fe.D_INT))
+    d = fe.mul_small(fe.mul(p.z, q.z), 2)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def double(p: Point) -> Point:
+    a = fe.sqr(p.x)
+    b = fe.sqr(p.y)
+    c = fe.mul_small(fe.sqr(p.z), 2)
+    h = fe.add(a, b)
+    e = fe.sub(h, fe.sqr(fe.add(p.x, p.y)))
+    g = fe.sub(a, b)
+    f = fe.add(c, g)
+    return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def neg(p: Point) -> Point:
+    return Point(fe.neg(p.x), p.y, p.z, fe.neg(p.t))
+
+
+def select(cond, p: Point, q: Point) -> Point:
+    """cond ? p : q, cond shaped like the batch."""
+    return Point(*(fe.select(cond, a, b) for a, b in zip(p, q)))
+
+
+def eq(p: Point, q: Point):
+    """Projective equality -> bool[...]. (Cross-multiplied, no inversion.)"""
+    ex = fe.eq(fe.mul(p.x, q.z), fe.mul(q.x, p.z))
+    ey = fe.eq(fe.mul(p.y, q.z), fe.mul(q.y, p.z))
+    return ex & ey
+
+
+def is_identity(p: Point):
+    return fe.is_zero(p.x) & fe.eq(p.y, p.z)
+
+
+def mul_cofactor(p: Point) -> Point:
+    return double(double(double(p)))
+
+
+# ---------------------------------------------------------------------------
+# Scalar multiplication
+# ---------------------------------------------------------------------------
+
+
+def scalar_mul(bits, p: Point) -> Point:
+    """Variable-base double-and-add. bits: [..., nb] int32 little-endian.
+
+    Batch-uniform: every lane does nb doublings and nb selected adds.
+    """
+    nb = bits.shape[-1]
+    rev = jnp.flip(bits, axis=-1)  # msb first
+
+    def body(i, q):
+        q = double(q)
+        bit = lax.dynamic_index_in_dim(rev, i, axis=-1, keepdims=False)
+        return select(bit == 1, add(q, p), q)
+
+    return lax.fori_loop(0, nb, body, identity(bits.shape[:-1]))
+
+
+def double_scalar_mul(bits_a, pa: Point, bits_b, pb: Point) -> Point:
+    """a*PA + b*PB with a shared doubling chain (Strauss-Shamir)."""
+    nb = max(bits_a.shape[-1], bits_b.shape[-1])
+
+    def pad(bits):
+        d = nb - bits.shape[-1]
+        if d:
+            bits = jnp.concatenate(
+                [bits, jnp.zeros((*bits.shape[:-1], d), jnp.int32)], axis=-1
+            )
+        return jnp.flip(bits, axis=-1)
+
+    ra, rb = pad(bits_a), pad(bits_b)
+    pab = add(pa, pb)
+
+    def body(i, q):
+        q = double(q)
+        ba = lax.dynamic_index_in_dim(ra, i, axis=-1, keepdims=False)
+        bb = lax.dynamic_index_in_dim(rb, i, axis=-1, keepdims=False)
+        qa = select(ba == 1, add(q, pa), q)
+        qboth = select(ba == 1, add(q, pab), add(q, pb))
+        return select(bb == 1, qboth, qa)
+
+    return lax.fori_loop(0, nb, body, identity(ra.shape[:-1]))
+
+
+# Fixed-base table for B: 64 windows of 4 bits; TABLE[w][d] = d * 16^w * B.
+def _build_base_table() -> np.ndarray:
+    tbl = np.zeros((64, 16, 4, fe.NLIMBS), dtype=np.int32)
+    wbase = he.B
+    for w in range(64):
+        acc = he.IDENT
+        for d in range(16):
+            x, y, z, t = acc
+            zi = pow(z, fe.P_INT - 2, fe.P_INT)
+            ax, ay = x * zi % fe.P_INT, y * zi % fe.P_INT
+            tbl[w, d, 0] = fe.int_to_limbs_np(ax)
+            tbl[w, d, 1] = fe.int_to_limbs_np(ay)
+            tbl[w, d, 2] = fe.int_to_limbs_np(1)
+            tbl[w, d, 3] = fe.int_to_limbs_np(ax * ay % fe.P_INT)
+            acc = he.point_add(acc, wbase)
+        for _ in range(4):
+            wbase = he.point_double(wbase)
+    return tbl
+
+
+_BASE_TABLE = _build_base_table()
+
+
+def base_mul(digits) -> Point:
+    """s*B from base-16 digits [..., 64] (s < 2^256, canonical digits)."""
+    table = jnp.asarray(_BASE_TABLE)  # [64, 16, 4, 20]
+
+    def body(w, q):
+        tw = lax.dynamic_index_in_dim(table, w, axis=0, keepdims=False)  # [16,4,20]
+        dw = lax.dynamic_index_in_dim(digits, w, axis=-1, keepdims=False)  # [...]
+        entry = jnp.take(tw, dw, axis=0)  # [..., 4, 20]
+        pt = Point(
+            entry[..., 0, :], entry[..., 1, :], entry[..., 2, :], entry[..., 3, :]
+        )
+        return add(q, pt)
+
+    return lax.fori_loop(0, 64, body, identity(digits.shape[:-1]))
+
+
+# ---------------------------------------------------------------------------
+# Encoding
+# ---------------------------------------------------------------------------
+
+
+def decompress(b32):
+    """[..., 32] bytes -> (ok[...], Point). Rejects non-canonical y (>= p),
+    non-residue x^2, and x=0 with sign bit set — matching the host
+    reference point_decompress (ops/host/ed25519.py)."""
+    b32 = b32.astype(jnp.int32)
+    sign = (b32[..., 31] >> 7) & 1
+    y = fe.from_bytes(b32.at[..., 31].set(b32[..., 31] & 0x7F))
+    y_ok = ~bi.geq(y, jnp.broadcast_to(jnp.asarray(fe.P_LIMBS), y.shape))
+    one = fe.ones(y.shape[:-1])
+    y2 = fe.sqr(y)
+    num = fe.sub(y2, one)
+    den = fe.add(fe.mul(y2, fe.constant(fe.D_INT)), one)
+    ok_sqrt, x = fe.sqrt_ratio(num, den)
+    x_zero = fe.is_zero(x)
+    flip = (fe.parity(x) != sign) & ~x_zero
+    x = fe.select(flip, fe.neg(x), x)
+    ok = y_ok & ok_sqrt & ~(x_zero & (sign == 1))
+    return ok, Point(x, y, one, fe.mul(x, y))
+
+
+def compress(p: Point):
+    """Point -> [..., 32] int32 bytes. One inv chain per batch lane; stack
+    multiple points on a new axis to amortize (vectorized chain)."""
+    zi = fe.inv(p.z)
+    x = fe.canonical(fe.mul(p.x, zi))
+    y = fe.mul(p.y, zi)
+    b = fe.to_bytes(y)
+    sign = (x[..., 0] & 1) << 7
+    return b.at[..., 31].add(sign)

@@ -1,0 +1,325 @@
+"""Batched GF(2^255 - 19) arithmetic for TPU: 13-bit limbs on int32.
+
+TPU has no 64-bit integer multiplier, so field elements are represented as
+20 limbs of 13 bits held in int32 (shape [..., 20], little-endian limb
+order). Schoolbook products of 13-bit limbs fit comfortably in int32:
+a limb-convolution coefficient is bounded by 20 * (2^13.22)^2 < 2^31.
+
+Representation invariants:
+  * "nearly normalized": every limb in [0, B_MAX] with B_MAX = 9500 < 2^13.3.
+    All public ops accept and return nearly-normalized elements; values are
+    only unique mod p after `canonical`.
+  * reduction: 2^260 = 2^5 * 2^255 == 19 * 2^5 = 608 (mod p), so carry out
+    of limb 19 wraps to limb 0 multiplied by FOLD = 608.
+
+This module is pure jnp (XLA fuses the elementwise limb ops); a Pallas
+variant can slot in underneath without changing callers. Everything is
+shape-polymorphic over leading batch dimensions.
+
+Reference equivalent: the C libsodium field arithmetic (fe25519, radix
+2^25.5/2^51) used by `cardano-crypto-class`/`cardano-crypto-praos`; call
+sites in the reference hot path are cited in ops/host/ed25519.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax import lax
+from jax import numpy as jnp
+
+BITS = 13
+NLIMBS = 20
+MASK = (1 << BITS) - 1
+FOLD = 608  # 19 * 2^5 : weight of carry out of limb 19
+B_MAX = 9500  # nearly-normalized limb bound (see module docstring)
+
+P_INT = 2**255 - 19
+SQRT_M1_INT = pow(2, (P_INT - 1) // 4, P_INT)
+D_INT = (-121665 * pow(121666, P_INT - 2, P_INT)) % P_INT
+
+
+from . import bigint as _bi
+
+
+def int_to_limbs_np(x: int, n: int = NLIMBS) -> np.ndarray:
+    """Host-side: python int -> canonical limb vector (numpy int32)."""
+    return _bi.int_to_limbs_np(x, n)
+
+
+def limbs_to_int_np(limbs) -> int:
+    """Host-side: limb vector (any bounds) -> python int."""
+    return _bi.limbs_to_int_np(limbs)
+
+
+P_LIMBS = int_to_limbs_np(P_INT)
+
+# Subtraction constant: 48p in "spread" limb form, every limb > B_MAX, so
+# (a + SUBC - b) is limb-wise non-negative for nearly-normalized a, b. The
+# top limb is oversized (48p >> 247 = 12287 > B_MAX) by construction; the
+# others are boosted by borrowing two units from the limb above.
+_v48p = 48 * P_INT
+_subc = np.array(
+    [(_v48p >> (BITS * i)) & MASK for i in range(NLIMBS - 1)]
+    + [_v48p >> (BITS * (NLIMBS - 1))],
+    dtype=np.int64,
+)
+for _i in range(NLIMBS - 1):
+    _subc[_i] += 2 << BITS
+    _subc[_i + 1] -= 2
+assert (_subc > B_MAX).all() and (_subc < 2**15.5).all()
+assert limbs_to_int_np(_subc) == _v48p
+SUBC = _subc.astype(np.int32)
+
+
+def constant(x: int):
+    """Field constant as a (20,) device array (broadcasts over batch)."""
+    return jnp.asarray(int_to_limbs_np(x % P_INT))
+
+
+ZERO = int_to_limbs_np(0)
+ONE = int_to_limbs_np(1)
+
+
+def zeros(batch_shape):
+    return jnp.zeros((*batch_shape, NLIMBS), jnp.int32)
+
+
+def ones(batch_shape):
+    return jnp.broadcast_to(jnp.asarray(ONE), (*batch_shape, NLIMBS))
+
+
+# ---------------------------------------------------------------------------
+# Carry propagation
+# ---------------------------------------------------------------------------
+
+
+def _carry_pass(z):
+    """One vectorized carry pass over the last axis; carry out of the top
+    limb wraps to limb 0 with weight FOLD. Limbs must be non-negative."""
+    c = z >> BITS
+    r = z & MASK
+    wrapped = jnp.concatenate([c[..., -1:] * FOLD, c[..., :-1]], axis=-1)
+    return r + wrapped
+
+
+def weak_reduce(z, passes: int = 2):
+    """Bring non-negative limbs (< 2^31) down to nearly-normalized form."""
+    for _ in range(passes):
+        z = _carry_pass(z)
+    return z
+
+
+# ---------------------------------------------------------------------------
+# Ring ops
+# ---------------------------------------------------------------------------
+
+
+def add(a, b):
+    return _carry_pass(a + b)
+
+
+def sub(a, b):
+    # a - b + 48p (SUBC), limb-wise non-negative by construction of SUBC
+    return _carry_pass(a - b + jnp.asarray(SUBC))
+
+
+def neg(a):
+    return sub(jnp.asarray(ZERO), a)
+
+
+def mul_small(a, k: int):
+    """Multiply by a small non-negative int constant (k * B_MAX * 20 < 2^31)."""
+    return weak_reduce(a * k, passes=3)
+
+
+def mul(a, b):
+    """Field multiplication. Inputs nearly normalized; output likewise.
+
+    Bound check: coefficients are sums of <= 20 products of limbs
+    <= B_MAX, so z_k <= 20 * 9500^2 < 2^31. Carries can propagate up to
+    limb 40 (product limbs reach 38, two carry passes extend two more),
+    so the accumulator is 41 limbs wide and the fold covers limb 40 with
+    weight 2^(13*40) == FOLD^2 (mod p).
+    """
+    ap = jnp.concatenate(
+        [a, jnp.zeros((*a.shape[:-1], NLIMBS + 1), jnp.int32)], axis=-1
+    )  # [..., 41]
+    z = jnp.zeros_like(ap)
+    for i in range(NLIMBS):
+        # b_i * (a shifted up by i limbs); the tail of ap is zero so the
+        # wrap-around of roll only moves zeros
+        z = z + b[..., i : i + 1] * jnp.roll(ap, i, axis=-1)
+    # two carry passes over 41 limbs (carry cannot leave limb 40: after
+    # pass one limb 39 <= 2^17.4, after pass two limb 40 <= 2^4.4)
+    for _ in range(2):
+        c = z >> BITS
+        z = (z & MASK) + jnp.concatenate(
+            [jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1
+        )
+    # fold limbs [20..39] onto [0..19] with weight FOLD = 2^260 mod p and
+    # limb 40 onto limb 0 with weight FOLD^2 = 2^520 mod p, then normalize
+    lo, hi, top = z[..., :NLIMBS], z[..., NLIMBS : 2 * NLIMBS], z[..., 2 * NLIMBS :]
+    lo = lo + hi * FOLD
+    lo = lo.at[..., 0].add(top[..., 0] * (FOLD * FOLD))
+    return weak_reduce(lo, passes=2)
+
+
+def sqr(a):
+    return mul(a, a)
+
+
+def pow2k(a, k: int):
+    """a^(2^k) by repeated squaring (k static)."""
+    if k <= 4:
+        for _ in range(k):
+            a = sqr(a)
+        return a
+    return lax.fori_loop(0, k, lambda _, v: sqr(v), a)
+
+
+def _chain_2_250m1(x):
+    """x^(2^250 - 1) plus helpers (x^11)."""
+    t0 = sqr(x)  # x^2
+    t1 = mul(x, pow2k(t0, 2))  # x^9
+    x11 = mul(t0, t1)  # x^11
+    t31 = mul(t1, sqr(x11))  # x^31 = 2^5-1
+    a = mul(pow2k(t31, 5), t31)  # 2^10-1
+    b = mul(pow2k(a, 10), a)  # 2^20-1
+    c = mul(pow2k(b, 20), b)  # 2^40-1
+    d = mul(pow2k(c, 10), a)  # 2^50-1
+    e = mul(pow2k(d, 50), d)  # 2^100-1
+    f = mul(pow2k(e, 100), e)  # 2^200-1
+    g = mul(pow2k(f, 50), d)  # 2^250-1
+    return g, x11
+
+
+def inv(x):
+    """x^(p-2) = x^(2^255 - 21). inv(0) = 0."""
+    g, x11 = _chain_2_250m1(x)
+    return mul(pow2k(g, 5), x11)
+
+
+def pow22523(x):
+    """x^((p-5)/8) = x^(2^252 - 3)."""
+    g, _ = _chain_2_250m1(x)
+    return mul(pow2k(g, 2), x)
+
+
+def legendre(x):
+    """x^((p-1)/2) = x^(2^254 - 10); canonical 1 / p-1 / 0 as field elem."""
+    g, _ = _chain_2_250m1(x)  # 2^250-1
+    x4 = pow2k(x, 2)
+    x6 = mul(x4, sqr(x))
+    return mul(pow2k(g, 4), x6)  # (2^250-1)<<4 = 2^254-16 ; +6 -> 2^254-10
+
+
+# ---------------------------------------------------------------------------
+# Canonicalization, comparison, selection
+# ---------------------------------------------------------------------------
+
+
+def canonical(x):
+    """Unique representative: limbs exactly 13-bit (top limb 8-bit, so the
+    value is < 2^255 + eps), then reduced into [0, p)."""
+    # two sequential carry passes, folding bits >= 2^255 back with weight 19
+    for _ in range(2):
+        c = jnp.zeros_like(x[..., 0])
+        out = []
+        for i in range(NLIMBS):
+            v = x[..., i] + c
+            out.append(v & MASK)
+            c = v >> BITS
+        # carry beyond limb 19 has weight 2^260 == FOLD; the top 5 bits of
+        # limb 19 (bits 255..259 of the value) have weight 2^255 == 19
+        hi = out[-1] >> 8
+        out[-1] = out[-1] & 0xFF
+        out[0] = out[0] + c * FOLD + hi * 19
+        x = jnp.stack(out, axis=-1)
+    # value < 2^255 + 2^13 < 2p: conditional subtract p (twice for safety)
+    p = jnp.asarray(P_LIMBS)
+    for _ in range(2):
+        borrow = jnp.zeros_like(x[..., 0])
+        diff = []
+        for i in range(NLIMBS):
+            v = x[..., i] - p[i] - borrow
+            diff.append(v & MASK)
+            borrow = jnp.where(v < 0, 1, 0)
+        d = jnp.stack(diff, axis=-1)
+        x = jnp.where((borrow == 0)[..., None], d, x)
+    return x
+
+
+def eq(a, b):
+    """Field equality -> bool[...]."""
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_zero(a):
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def select(cond, a, b):
+    """cond ? a : b with cond shaped [...] (broadcast over limbs)."""
+    return jnp.where(cond[..., None], a, b)
+
+
+# ---------------------------------------------------------------------------
+# Byte <-> limb conversion (on device; little-endian 32-byte strings)
+# ---------------------------------------------------------------------------
+
+def from_bytes(b):
+    """[..., 32] uint8/int32 little-endian -> nearly-normalized limbs.
+
+    Does NOT reduce mod p or reject >= p; callers handling encodings must
+    canonicalize / validate separately (cf. point decompress).
+    """
+    return _bi.bytes_to_limbs(b, NLIMBS)
+
+
+def to_bytes(x):
+    """Canonical field element -> [..., 32] int32 bytes (values 0..255)."""
+    x = canonical(x)
+    bits = (x[..., :, None] >> jnp.arange(BITS, dtype=jnp.int32)) & 1
+    bits = bits.reshape(*x.shape[:-1], NLIMBS * BITS)[..., :256]
+    groups = bits.reshape(*x.shape[:-1], 32, 8)
+    return jnp.sum(groups * (1 << jnp.arange(8, dtype=jnp.int32)), axis=-1)
+
+
+def parity(x):
+    """Low bit of the canonical value (the RFC 8032 sign bit source)."""
+    return canonical(x)[..., 0] & 1
+
+
+# ---------------------------------------------------------------------------
+# Square roots
+# ---------------------------------------------------------------------------
+
+
+def sqrt_ratio(n, d):
+    """(ok, r) with r = sqrt(n/d) when n/d is square (even-parity root).
+
+    One exponentiation: r0 = n d^3 (n d^7)^((p-5)/8); then correct by
+    sqrt(-1) if needed. ok is False when n/d is not a QR (and n != 0).
+    For n == 0 returns (True, 0).
+    """
+    d2 = sqr(d)
+    d3 = mul(d, d2)
+    d7 = mul(d3, sqr(d2))
+    r = mul(mul(n, d3), pow22523(mul(n, d7)))
+    check = mul(d, sqr(r))  # should be +-n
+    r_alt = mul(r, constant(SQRT_M1_INT))
+    good = eq(check, n)
+    good_alt = eq(check, neg(n))
+    r = select(good, r, r_alt)
+    ok = good | good_alt
+    # normalize to even parity
+    r = select(parity(r) == 1, neg(r), r)
+    return ok, r
+
+
+def sqrt(x):
+    """(ok, even root) of a plain field element."""
+    return sqrt_ratio(x, ones(x.shape[:-1]))

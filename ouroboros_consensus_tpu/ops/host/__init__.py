@@ -1,0 +1,5 @@
+"""Pure-Python host reference crypto: Ed25519, ECVRF (draft-03), CompactSum
+KES, hashes. The ground truth for differential testing of the batched JAX
+kernels, and the sign-side primitives for the chain synthesizer."""
+
+from . import ecvrf, ed25519, hashes, kes  # noqa: F401

@@ -1,0 +1,179 @@
+"""ECVRF-ED25519-SHA512-Elligator2 (IETF draft-03) host reference.
+
+Pure-Python reference implementation of the VRF used by Praos leader
+election. Reference equivalents: the C libsodium fork vendored by
+`cardano-crypto-praos` ("ietfdraft03" suite), reached from the hot path at
+ouroboros-consensus-protocol/.../Protocol/Praos.hs:543 (verifyCertified)
+and Praos.hs:397 (evalCertified, forging side).
+
+Proof format (80 bytes): Gamma (32) || c (16) || s (32).
+Output (beta) is 64 bytes.
+
+NOTE on conformance: no libsodium test vectors are available in this
+offline environment; this implementation follows draft-03 semantics
+(suite 0x04) and is the single source of truth for the framework — the
+batched JAX verifier (ops/ecvrf_batch.py), the synthesizer's prover, and
+these host functions are differentially tested against each other.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .ed25519 import (
+    B,
+    IDENT,
+    L,
+    MONT_A,
+    P,
+    SQRT_M1,
+    SQRT_M486664,
+    _clamp,
+    fe_inv,
+    fe_sqrt,
+    is_square,
+    point_add,
+    point_compress,
+    point_decompress,
+    point_mul,
+    point_neg,
+)
+
+SUITE = b"\x04"
+PROOF_BYTES = 80
+OUTPUT_BYTES = 64
+
+
+def _sha512(data: bytes) -> bytes:
+    return hashlib.sha512(data).digest()
+
+
+# ---------------------------------------------------------------------------
+# Elligator2 hash-to-curve (draft-03 section 5.4.1.2 semantics)
+# ---------------------------------------------------------------------------
+
+
+def elligator2(r: int):
+    """Map a field element r to a point on the Edwards curve.
+
+    Deterministic Elligator2 on curve25519 followed by the birational map
+    to edwards25519. Returns an extended-coordinate point (not yet
+    cofactor-cleared). Sign convention: the Edwards x-coordinate is negated
+    when the Montgomery v coordinate is "negative" (odd), giving a fixed
+    deterministic choice mirrored exactly by the batched JAX kernel.
+    """
+    # u = -A / (1 + 2 r^2); if 1 + 2 r^2 == 0 use u = -A (r excluded anyway)
+    t = (2 * r * r) % P
+    denom = (t + 1) % P
+    if denom == 0:
+        denom = 1
+    u = (-MONT_A * fe_inv(denom)) % P
+    # w = u (u^2 + A u + 1): the Montgomery curve RHS at u
+    w = u * ((u * u + MONT_A * u + 1) % P) % P
+    if not is_square(w):
+        # switch to the other candidate u' = -u - A; RHS becomes square
+        u = (-u - MONT_A) % P
+        w = u * ((u * u + MONT_A * u + 1) % P) % P
+    v = fe_sqrt(w)
+    assert v is not None
+    # Birational map curve25519 -> edwards25519:
+    #   x = sqrt(-486664) * u / v ;  y = (u - 1) / (u + 1)
+    if v == 0:
+        x = 0
+    else:
+        x = SQRT_M486664 * u % P * fe_inv(v) % P
+    up1 = (u + 1) % P
+    y = ((u - 1) * fe_inv(up1)) % P if up1 != 0 else 0
+    # Fix sign deterministically: force x even
+    if x % 2 == 1:
+        x = P - x
+    return (x, y, 1, x * y % P)
+
+
+def hash_to_curve(pk: bytes, alpha: bytes):
+    """H = cofactor * Elligator2(SHA512(suite || 0x01 || pk || alpha))."""
+    h = _sha512(SUITE + b"\x01" + pk + alpha)
+    r_bytes = bytearray(h[:32])
+    r_bytes[31] &= 0x7F  # clear sign bit => r < 2^255
+    r = int.from_bytes(bytes(r_bytes), "little") % P
+    e = elligator2(r)
+    # clear cofactor (multiply by 8)
+    h8 = point_mul(8, e)
+    return h8
+
+
+def _hash_points(h, gamma, u, v) -> bytes:
+    """c = first 16 bytes of SHA512(suite || 0x02 || H || Gamma || U || V)."""
+    data = (
+        SUITE
+        + b"\x02"
+        + point_compress(h)
+        + point_compress(gamma)
+        + point_compress(u)
+        + point_compress(v)
+    )
+    return _sha512(data)[:16]
+
+
+# ---------------------------------------------------------------------------
+# Prove / verify / proof-to-hash
+# ---------------------------------------------------------------------------
+
+
+def prove(seed: bytes, alpha: bytes) -> bytes:
+    """Produce an 80-byte proof pi for message alpha under sk seed."""
+    h = _sha512(seed[:32])
+    x = _clamp(h[:32])
+    prefix = h[32:]
+    pk = point_compress(point_mul(x, B))
+    H = hash_to_curve(pk, alpha)
+    H_enc = point_compress(H)
+    gamma = point_mul(x, H)
+    # nonce k = SHA512(prefix || H) mod L   (draft-03 section 5.4.2.2)
+    k = int.from_bytes(_sha512(prefix + H_enc), "little") % L
+    c_bytes = _hash_points(H, gamma, point_mul(k, B), point_mul(k, H))
+    c = int.from_bytes(c_bytes, "little")
+    s = (k + c * x) % L
+    return point_compress(gamma) + c_bytes + int.to_bytes(s, 32, "little")
+
+
+def decode_proof(pi: bytes):
+    """Split pi into (Gamma point, c int, s int); None on malformed."""
+    if len(pi) != PROOF_BYTES:
+        return None
+    gamma = point_decompress(pi[:32])
+    if gamma is None:
+        return None
+    c = int.from_bytes(pi[32:48], "little")
+    s = int.from_bytes(pi[48:80], "little")
+    if s >= L:  # non-canonical scalar
+        return None
+    return gamma, c, s
+
+
+def verify(pk: bytes, pi: bytes, alpha: bytes) -> bytes | None:
+    """Verify proof; return beta (64-byte VRF output) or None."""
+    y = point_decompress(pk)
+    if y is None:
+        return None
+    dec = decode_proof(pi)
+    if dec is None:
+        return None
+    gamma, c, s = dec
+    H = hash_to_curve(pk, alpha)
+    # U = s*B - c*Y ;  V = s*H - c*Gamma
+    U = point_add(point_mul(s, B), point_neg(point_mul(c, y)))
+    V = point_add(point_mul(s, H), point_neg(point_mul(c, gamma)))
+    c_prime = _hash_points(H, gamma, U, V)
+    if int.from_bytes(c_prime, "little") != c:
+        return None
+    return proof_to_hash(pi)
+
+
+def proof_to_hash(pi: bytes) -> bytes:
+    """beta = SHA512(suite || 0x03 || encode(cofactor * Gamma))."""
+    gamma = point_decompress(pi[:32])
+    if gamma is None:
+        raise ValueError("malformed proof")
+    g8 = point_mul(8, gamma)
+    return _sha512(SUITE + b"\x03" + point_compress(g8))

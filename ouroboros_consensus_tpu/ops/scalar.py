@@ -1,0 +1,81 @@
+"""Batched arithmetic mod the Ed25519 group order L (on-device).
+
+L = 2^252 + 27742317777372353535851937790883648493.
+
+The verify hot path needs exactly two things here:
+  * reduce a 512-bit SHA-512 digest mod L (Barrett reduction in 13-bit
+    limbs) to obtain the challenge scalar h — ops/ed25519_batch.py;
+  * canonicality checks s < L on 32-byte signature scalars.
+
+Reference equivalent: libsodium's sc25519_reduce / sc25519_is_canonical as
+used by crypto_sign_verify_detached and the vendored VRF (call sites cited
+in ops/host/ed25519.py and ops/host/ecvrf.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax import numpy as jnp
+
+from . import bigint as bi
+
+BITS = bi.BITS
+
+L_INT = 2**252 + 27742317777372353535851937790883648493
+NL = 20  # limbs for values < 2^260
+
+L_LIMBS = bi.int_to_limbs_np(L_INT, NL)
+L21 = bi.int_to_limbs_np(L_INT, 21)
+
+# Barrett parameters: a = 19 limbs (247 bits), b = 21 limbs (273 bits)
+_A_LIMBS = 19
+_B_LIMBS = 21
+MU = bi.int_to_limbs_np((1 << (BITS * (_A_LIMBS + _B_LIMBS))) // L_INT, 21)
+
+
+def reduce512(digest_bytes):
+    """[..., 64] little-endian bytes (SHA-512 output) -> [..., 20] limbs < L.
+
+    Barrett: q = ((V >> 247) * mu) >> 273, r = V - q*L, then up to three
+    conditional subtractions (error bound q - q_hat <= 2).
+    """
+    v = bi.bytes_to_limbs(digest_bytes, 40)
+    v1 = bi.shift_right_limbs(v, _A_LIMBS)  # 21 limbs
+    t = bi.mul(v1, jnp.broadcast_to(jnp.asarray(MU), (*v1.shape[:-1], 21)))
+    q = bi.shift_right_limbs(t, _B_LIMBS)[..., :21]  # <= 2^260: 21 limbs
+    ql = bi.mul(q, jnp.broadcast_to(jnp.asarray(L21), (*q.shape[:-1], 21)))
+    # bi.mul output limbs can slightly exceed MASK (vectorized carry
+    # passes only); sub_mod_2k's borrow logic needs a normalized
+    # subtrahend, so run a full sequential carry first.
+    ql, _ = bi.seq_carry(ql)
+    # r = V - q*L fits in [0, 3L) < 2^254 => compute mod 2^(13*21) exactly
+    r = bi.sub_mod_2k(v, ql, 21)
+    lc = jnp.broadcast_to(jnp.asarray(L21), r.shape)
+    for _ in range(3):
+        r = bi.cond_sub(r, lc)
+    return r[..., :NL]
+
+
+def is_canonical32(s_bytes):
+    """s < L for [..., 32]-byte little-endian scalars -> bool[...]."""
+    s = bi.bytes_to_limbs(s_bytes, NL)
+    lim = jnp.broadcast_to(jnp.asarray(L_LIMBS), s.shape)
+    return ~bi.geq(s, lim)
+
+
+def bits_from_limbs(x, nbits: int = 253):
+    return bi.limbs_to_bits(x, nbits)
+
+
+def bits_from_bytes(b, nbits: int):
+    """[..., n] LE bytes -> [..., nbits] bits, little-endian."""
+    bits = (b.astype(jnp.int32)[..., :, None] >> jnp.arange(8, dtype=jnp.int32)) & 1
+    return bits.reshape(*b.shape[:-1], b.shape[-1] * 8)[..., :nbits]
+
+
+def windows4_from_bits(bits):
+    """[..., 4k] bits -> [..., k] base-16 digits (for fixed-base tables)."""
+    nb = bits.shape[-1]
+    assert nb % 4 == 0
+    g = bits.reshape(*bits.shape[:-1], nb // 4, 4)
+    return jnp.sum(g * jnp.asarray([1, 2, 4, 8], jnp.int32), axis=-1)

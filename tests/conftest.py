@@ -1,0 +1,28 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (multi-chip hardware is not
+available in CI): the env vars must be set before jax is first imported,
+hence this conftest sets them at collection time. The real-TPU benchmark
+path is exercised separately by bench.py.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Some environments install a sitecustomize that force-registers a TPU
+# plugin and overrides jax_platforms after interpreter start; the config
+# update below (post-import, pre-backend-init) wins either way.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# the crypto kernels are large HLO graphs: cache compilations across runs
+# (must go through jax.config — env vars are ignored after `import jax`)
+jax.config.update("jax_compilation_cache_dir", "/tmp/ouroboros-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,139 @@
+"""Differential tests: batched GF(2^255-19) limb arithmetic vs python ints."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ouroboros_consensus_tpu.ops import field as fe
+
+P = fe.P_INT
+rng = random.Random(1234)
+
+# jit everything heavy once — eager dispatch of the exponentiation chains
+# (hundreds of field muls) is orders of magnitude slower than compiled
+_addc = jax.jit(lambda a, b: fe.canonical(fe.add(a, b)))
+_subc_ = jax.jit(lambda a, b: fe.canonical(fe.sub(a, b)))
+_mulc = jax.jit(lambda a, b: fe.canonical(fe.mul(a, b)))
+_invc = jax.jit(lambda x: fe.canonical(fe.inv(x)))
+_legc = jax.jit(lambda x: fe.canonical(fe.legendre(x)))
+_sqrtc = jax.jit(lambda x: (lambda ok_r: (ok_r[0], fe.canonical(ok_r[1])))(fe.sqrt(x)))
+_sqrt_ratio_c = jax.jit(
+    lambda n, d: (lambda ok_r: (ok_r[0], fe.canonical(ok_r[1])))(fe.sqrt_ratio(n, d))
+)
+
+
+def _rand_ints(n):
+    vals = [0, 1, 2, P - 1, P - 2, P, P + 1, 2**255 - 1, 19, 608]
+    vals += [rng.randrange(P) for _ in range(n - len(vals))]
+    return vals
+
+
+def _stage(vals):
+    return jnp.asarray(np.stack([fe.int_to_limbs_np(v) for v in vals]))
+
+
+def _unstage(x):
+    return [fe.limbs_to_int_np(row) for row in np.asarray(x)]
+
+
+def test_add_sub_mul_vs_ints():
+    a_int = _rand_ints(32)
+    b_int = list(reversed(_rand_ints(32)))
+    a, b = _stage(a_int), _stage(b_int)
+    for got, want in zip(_unstage(_addc(a, b)),
+                         [(x + y) % P for x, y in zip(a_int, b_int)]):
+        assert got == want
+    for got, want in zip(_unstage(_subc_(a, b)),
+                         [(x - y) % P for x, y in zip(a_int, b_int)]):
+        assert got == want
+    for got, want in zip(_unstage(_mulc(a, b)),
+                         [(x * y) % P for x, y in zip(a_int, b_int)]):
+        assert got == want
+
+
+def test_limb_bounds_preserved():
+    a_int, b_int = _rand_ints(16), list(reversed(_rand_ints(16)))
+    a, b = _stage(a_int), _stage(b_int)
+    x = a
+    for _ in range(4):  # chain ops without canonicalizing
+        x = fe.mul(fe.add(x, b), fe.sub(x, a))
+        arr = np.asarray(x)
+        assert (arr >= 0).all() and (arr <= fe.B_MAX).all()
+
+
+def test_inv_sqrt_legendre():
+    vals = [v for v in _rand_ints(20) if v % P != 0]
+    x = _stage(vals)
+    inv_got = _unstage(_invc(x))
+    for got, v in zip(inv_got, vals):
+        assert got == pow(v, P - 2, P)
+    leg = _unstage(_legc(x))
+    for got, v in zip(leg, vals):
+        assert got == pow(v, (P - 1) // 2, P)
+    ok, r = _sqrtc(x)
+    ok = np.asarray(ok)
+    roots = _unstage(r)
+    for o, root, v in zip(ok, roots, vals):
+        v %= P
+        issq = pow(v, (P - 1) // 2, P) == 1
+        assert bool(o) == issq
+        if issq:
+            assert (root * root) % P == v
+            assert root % 2 == 0  # even-parity convention
+
+
+def test_sqrt_ratio():
+    ns = _rand_ints(12)
+    ds = [v if v % P else 3 for v in reversed(_rand_ints(12))]
+    n, d = _stage(ns), _stage(ds)
+    ok, r = _sqrt_ratio_c(n, d)
+    for o, root, nv, dv in zip(np.asarray(ok), _unstage(r), ns, ds):
+        ratio = nv * pow(dv, P - 2, P) % P
+        issq = ratio == 0 or pow(ratio, (P - 1) // 2, P) == 1
+        assert bool(o) == issq
+        if issq:
+            assert (root * root) % P == ratio
+
+
+def test_bytes_roundtrip():
+    vals = _rand_ints(16)
+    vals = [v % P for v in vals]
+    x = _stage(vals)
+    b = fe.to_bytes(x)
+    assert np.asarray(b).shape[-1] == 32
+    back = fe.from_bytes(b)
+    for got, want in zip(_unstage(fe.canonical(back)), vals):
+        assert got == want
+    for row, v in zip(np.asarray(b), vals):
+        assert bytes(row.astype(np.uint8)) == v.to_bytes(32, "little")
+
+
+def test_eq_iszero_parity_select():
+    vals = [5, P - 5, 0, P, 12345]
+    x = _stage(vals)
+    y = _stage([5, P - 5, P, 0, 54321])
+    got = np.asarray(fe.eq(x, y))
+    assert got.tolist() == [True, True, True, True, False]
+    assert np.asarray(fe.is_zero(_stage([0, P, 1, 2 * P]))).tolist() == [
+        True, True, False, True]
+    assert np.asarray(fe.parity(_stage([2, 3, P - 1]))).tolist() == [0, 1, 0]
+    sel = fe.select(jnp.asarray([True, False]), _stage([1, 1]), _stage([2, 2]))
+    assert _unstage(sel) == [1, 2]
+
+
+def test_mul_large_top_limbs_regression():
+    """mul must not drop the carry out of limb 39 (weight 2^520 mod p)."""
+    rows = np.full((3, fe.NLIMBS), 0, dtype=np.int32)
+    rows[0, :] = 9000  # all limbs near B_MAX
+    rows[1, 19] = 8192  # oversized top limb (reachable nearly-normalized)
+    rows[1, 0] = 7777
+    rows[2, :] = fe.B_MAX
+    x = jnp.asarray(rows)
+    got = _mulc(x, x)
+    for row_in, row_out in zip(rows, np.asarray(got)):
+        v = fe.limbs_to_int_np(row_in)
+        assert fe.limbs_to_int_np(row_out) == (v * v) % P

@@ -1,0 +1,218 @@
+"""Host reference crypto: Ed25519 (RFC 8032 vectors), ECVRF, KES, CBOR."""
+
+import hashlib
+import os
+
+import pytest
+
+from ouroboros_consensus_tpu.ops.host import ecvrf, ed25519, hashes, kes
+from ouroboros_consensus_tpu.utils import cbor
+
+# --- Ed25519 RFC 8032 test vectors (section 7.1) ---------------------------
+
+RFC8032_VECTORS = [
+    # (secret, public, message, signature)
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+@pytest.mark.parametrize("sk,pk,msg,sig", RFC8032_VECTORS)
+def test_ed25519_rfc8032(sk, pk, msg, sig):
+    seed = bytes.fromhex(sk)
+    public = bytes.fromhex(pk)
+    message = bytes.fromhex(msg)
+    signature = bytes.fromhex(sig)
+    assert ed25519.secret_to_public(seed) == public
+    assert ed25519.sign(seed, message) == signature
+    assert ed25519.verify(public, message, signature)
+
+
+def test_ed25519_reject_tampered():
+    seed = os.urandom(32)
+    pk = ed25519.secret_to_public(seed)
+    msg = b"ouroboros"
+    sig = ed25519.sign(seed, msg)
+    assert ed25519.verify(pk, msg, sig)
+    bad = bytearray(sig)
+    bad[0] ^= 1
+    assert not ed25519.verify(pk, msg, bytes(bad))
+    assert not ed25519.verify(pk, msg + b"x", sig)
+    # non-canonical s >= L rejected
+    s = int.from_bytes(sig[32:], "little") + ed25519.L
+    if s < 1 << 256:
+        bad2 = sig[:32] + s.to_bytes(32, "little")
+        assert not ed25519.verify(pk, msg, bad2)
+
+
+def test_point_roundtrip_and_curve_membership():
+    for i in [1, 2, 7, 12345, ed25519.L - 1]:
+        pt = ed25519.point_mul(i, ed25519.B)
+        assert ed25519.point_is_on_curve(pt)
+        enc = ed25519.point_compress(pt)
+        dec = ed25519.point_decompress(enc)
+        assert dec is not None
+        assert ed25519.point_equal(pt, dec)
+
+
+# --- ECVRF ------------------------------------------------------------------
+
+
+def test_ecvrf_prove_verify_roundtrip():
+    seed = bytes(range(32))
+    pk = ed25519.secret_to_public(seed)
+    for alpha in [b"", b"slot-42", os.urandom(100)]:
+        pi = ecvrf.prove(seed, alpha)
+        assert len(pi) == ecvrf.PROOF_BYTES
+        beta = ecvrf.verify(pk, pi, alpha)
+        assert beta is not None and len(beta) == ecvrf.OUTPUT_BYTES
+        assert beta == ecvrf.proof_to_hash(pi)
+
+
+def test_ecvrf_deterministic():
+    seed = b"\x07" * 32
+    assert ecvrf.prove(seed, b"a") == ecvrf.prove(seed, b"a")
+    assert ecvrf.prove(seed, b"a") != ecvrf.prove(seed, b"b")
+
+
+def test_ecvrf_reject_bad():
+    seed = os.urandom(32)
+    pk = ed25519.secret_to_public(seed)
+    alpha = b"input"
+    pi = ecvrf.prove(seed, alpha)
+    assert ecvrf.verify(pk, pi, alpha + b"!") is None
+    bad = bytearray(pi)
+    bad[40] ^= 1  # corrupt c
+    assert ecvrf.verify(pk, bytes(bad), alpha) is None
+    other_pk = ed25519.secret_to_public(os.urandom(32))
+    assert ecvrf.verify(other_pk, pi, alpha) is None
+
+
+def test_elligator_output_on_curve():
+    for i in range(8):
+        h = ecvrf.hash_to_curve(b"\x01" * 32, bytes([i]))
+        assert ed25519.point_is_on_curve(h)
+        # cofactor-cleared => in prime-order subgroup: L*H == identity
+        assert ed25519.point_equal(
+            ed25519.point_mul(ed25519.L, h), ed25519.IDENT
+        )
+
+
+# --- KES --------------------------------------------------------------------
+
+
+def test_kes_sign_verify_all_periods_depth3():
+    seed = b"\x42" * 32
+    depth = 3
+    vk = kes.derive_vk(seed, depth)
+    for t in range(1 << depth):
+        sig = kes.sign(seed, depth, t, b"header-body")
+        assert len(sig) == kes.sig_bytes(depth)
+        assert kes.verify(vk, depth, t, b"header-body", sig)
+        assert not kes.verify(vk, depth, t, b"tampered", sig)
+        # wrong period fails (different leaf key)
+        assert not kes.verify(vk, depth, (t + 1) % (1 << depth), b"header-body", sig)
+
+
+def test_kes_depth7_spot():
+    seed = os.urandom(32)
+    depth = 7
+    vk = kes.derive_vk(seed, depth)
+    for t in [0, 1, 63, 64, 127]:
+        sig = kes.sign(seed, depth, t, b"m")
+        assert kes.verify(vk, depth, t, b"m", sig)
+    bad_vk = hashlib.blake2b(b"x", digest_size=32).digest()
+    assert not kes.verify(bad_vk, depth, 0, b"m", kes.sign(seed, depth, 0, b"m"))
+
+
+# --- hashes / nonce helpers -------------------------------------------------
+
+
+def test_hash_helpers():
+    assert len(hashes.blake2b_256(b"")) == 32
+    assert len(hashes.blake2b_224(b"")) == 28
+    assert hashes.input_vrf(5, b"\x00" * 32) != hashes.input_vrf(6, b"\x00" * 32)
+    beta = b"\xaa" * 64
+    assert 0 <= hashes.vrf_leader_value(beta) < 1 << 256
+    assert len(hashes.vrf_nonce_value(beta)) == 32
+    n1 = hashes.nonce_combine(b"\x01" * 32, b"\x02" * 32)
+    assert len(n1) == 32
+
+
+# --- CBOR -------------------------------------------------------------------
+
+
+def test_cbor_roundtrip():
+    cases = [
+        0,
+        23,
+        24,
+        255,
+        256,
+        2**32,
+        2**63,
+        -1,
+        -25,
+        -(2**40),
+        b"",
+        b"\x00\x01\x02",
+        "hello",
+        [],
+        [1, [2, 3], b"x"],
+        {1: b"a", b"k": [True, False, None]},
+        cbor.Tag(24, b"\x82\x01\x02"),
+        True,
+        False,
+        None,
+    ]
+    for c in cases:
+        assert cbor.decode(cbor.encode(c)) == c
+
+
+def test_cbor_canonical_known_bytes():
+    assert cbor.encode(0) == b"\x00"
+    assert cbor.encode(23) == b"\x17"
+    assert cbor.encode(24) == b"\x18\x18"
+    assert cbor.encode([1, 2, 3]) == b"\x83\x01\x02\x03"
+    assert cbor.encode(b"\x01\x02") == b"\x42\x01\x02"
+    assert cbor.encode("a") == b"\x61\x61"
+    assert cbor.encode(-1) == b"\x20"
+
+
+def test_cbor_decode_prefix():
+    data = cbor.encode([1, 2]) + cbor.encode(b"tail")
+    v, off = cbor.decode_prefix(data, 0)
+    assert v == [1, 2]
+    v2, off2 = cbor.decode_prefix(data, off)
+    assert v2 == b"tail" and off2 == len(data)
+
+
+def test_cbor_float_and_simple_decode():
+    # floats whose bit patterns collide with simple-value codes
+    import struct
+    for v in [0.0, 1.5, -2.25, struct.unpack(">d", (20).to_bytes(8, "big"))[0]]:
+        assert cbor.decode(cbor.encode(v)) == v
+    # half/single width floats decode too
+    assert cbor.decode(b"\xf9\x3c\x00") == 1.0
+    assert cbor.decode(b"\xfa\x3f\x80\x00\x00") == 1.0
+    with pytest.raises(cbor.DecodeError):
+        cbor.decode(b"\xf8\x20")  # unsupported simple value

@@ -1,0 +1,209 @@
+"""Differential tests: mod-L scalar reduction and batched curve ops vs host."""
+
+import hashlib
+import os
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ouroboros_consensus_tpu.ops import bigint as bi
+from ouroboros_consensus_tpu.ops import curve as cv
+from ouroboros_consensus_tpu.ops import field as fe
+from ouroboros_consensus_tpu.ops import scalar as sc
+from ouroboros_consensus_tpu.ops.host import ed25519 as he
+
+rng = random.Random(99)
+
+
+def _bytes_arr(rows):
+    return jnp.asarray(np.stack([np.frombuffer(r, dtype=np.uint8) for r in rows]))
+
+
+# --- bigint / scalar --------------------------------------------------------
+
+
+def test_bigint_mul_and_bits():
+    a_int = [rng.randrange(2**250) for _ in range(8)]
+    b_int = [rng.randrange(2**250) for _ in range(8)]
+    a = jnp.asarray(np.stack([bi.int_to_limbs_np(v, 20) for v in a_int]))
+    b = jnp.asarray(np.stack([bi.int_to_limbs_np(v, 20) for v in b_int]))
+    z = jax.jit(bi.mul)(a, b)
+    for row, x, y in zip(np.asarray(z), a_int, b_int):
+        assert bi.limbs_to_int_np(row) == x * y
+    bits = bi.limbs_to_bits(a, 253)
+    for row, x in zip(np.asarray(bits), a_int):
+        assert sum(int(v) << i for i, v in enumerate(row)) == x % (1 << 253)
+
+
+@jax.jit
+def _reduce512(b):
+    return sc.reduce512(b)
+
+
+def test_reduce512_vs_int():
+    digests = [os.urandom(64) for _ in range(16)]
+    digests += [b"\xff" * 64, b"\x00" * 64]
+    out = _reduce512(_bytes_arr(digests))
+    for row, d in zip(np.asarray(out), digests):
+        assert bi.limbs_to_int_np(row) == int.from_bytes(d, "little") % sc.L_INT
+
+
+def test_is_canonical32():
+    vals = [0, 1, sc.L_INT - 1, sc.L_INT, sc.L_INT + 5, 2**256 - 1]
+    arr = _bytes_arr([v.to_bytes(32, "little") for v in vals])
+    got = np.asarray(jax.jit(sc.is_canonical32)(arr))
+    assert got.tolist() == [True, True, True, False, False, False]
+
+
+# --- curve ------------------------------------------------------------------
+
+
+def _stage_points(pts):
+    """host extended points -> batched Point (canonicalized limbs)."""
+    cols = [[], [], [], []]
+    for p in pts:
+        for i, c in enumerate(p):
+            cols[i].append(fe.int_to_limbs_np(c % fe.P_INT))
+    return cv.Point(*(jnp.asarray(np.stack(c)) for c in cols))
+
+
+def _host_point(p: cv.Point, i):
+    arr = [fe.limbs_to_int_np(np.asarray(c)[i]) % fe.P_INT for c in p]
+    return tuple(arr)
+
+
+@jax.jit
+def _add(p, q):
+    return cv.add(p, q)
+
+
+@jax.jit
+def _dbl(p):
+    return cv.double(p)
+
+
+def test_add_double_vs_host():
+    hosts = [he.point_mul(rng.randrange(he.L), he.B) for _ in range(8)]
+    others = [he.point_mul(rng.randrange(he.L), he.B) for _ in range(8)]
+    p, q = _stage_points(hosts), _stage_points(others)
+    s = _add(p, q)
+    d = _dbl(p)
+    for i in range(8):
+        assert he.point_equal(_host_point(s, i), he.point_add(hosts[i], others[i]))
+        assert he.point_equal(_host_point(d, i), he.point_double(hosts[i]))
+
+
+@jax.jit
+def _smul(bits, p):
+    return cv.scalar_mul(bits, p)
+
+
+@jax.jit
+def _bmul(digits):
+    return cv.base_mul(digits)
+
+
+@jax.jit
+def _dsmul(ba, pa, bb, pb):
+    return cv.double_scalar_mul(ba, pa, bb, pb)
+
+
+def test_scalar_mul_vs_host():
+    scalars = [0, 1, 2, he.L - 1] + [rng.randrange(he.L) for _ in range(4)]
+    base_pts = [he.point_mul(rng.randrange(he.L), he.B) for _ in range(8)]
+    p = _stage_points(base_pts)
+    bits_np = np.zeros((8, 253), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        for j in range(253):
+            bits_np[i, j] = (s >> j) & 1
+    got = _smul(jnp.asarray(bits_np), p)
+    for i, s in enumerate(scalars):
+        assert he.point_equal(_host_point(got, i), he.point_mul(s, base_pts[i]))
+
+
+def test_base_mul_vs_host():
+    scalars = [rng.randrange(2**256) for _ in range(6)] + [0, 1]
+    digits_np = np.zeros((8, 64), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        for w in range(64):
+            digits_np[i, w] = (s >> (4 * w)) & 0xF
+    got = _bmul(jnp.asarray(digits_np))
+    for i, s in enumerate(scalars):
+        assert he.point_equal(_host_point(got, i), he.point_mul(s, he.B))
+
+
+def test_double_scalar_mul():
+    pa_h = [he.point_mul(rng.randrange(he.L), he.B) for _ in range(4)]
+    pb_h = [he.point_mul(rng.randrange(he.L), he.B) for _ in range(4)]
+    a_s = [rng.randrange(2**253) for _ in range(4)]
+    b_s = [rng.randrange(2**128) for _ in range(4)]
+    ba = np.zeros((4, 253), np.int32)
+    bb = np.zeros((4, 128), np.int32)
+    for i in range(4):
+        for j in range(253):
+            ba[i, j] = (a_s[i] >> j) & 1
+        for j in range(128):
+            bb[i, j] = (b_s[i] >> j) & 1
+    got = _dsmul(jnp.asarray(ba), _stage_points(pa_h), jnp.asarray(bb), _stage_points(pb_h))
+    for i in range(4):
+        want = he.point_add(he.point_mul(a_s[i], pa_h[i]), he.point_mul(b_s[i], pb_h[i]))
+        assert he.point_equal(_host_point(got, i), want)
+
+
+@jax.jit
+def _decompress(b):
+    return cv.decompress(b)
+
+
+@jax.jit
+def _compress(p):
+    return cv.compress(p)
+
+
+def test_compress_decompress_vs_host():
+    pts = [he.point_mul(rng.randrange(he.L), he.B) for _ in range(8)]
+    encs = [he.point_compress(p) for p in pts]
+    ok, got = _decompress(_bytes_arr(encs))
+    assert np.asarray(ok).all()
+    for i in range(8):
+        assert he.point_equal(_host_point(got, i), pts[i])
+    back = _compress(got)
+    for row, enc in zip(np.asarray(back), encs):
+        assert bytes(row.astype(np.uint8)) == enc
+
+
+def test_decompress_rejects_bad():
+    bad_y = (fe.P_INT + 1).to_bytes(32, "little")  # non-canonical
+    nonres = None
+    for y in range(2, 100):
+        x2 = (y * y - 1) * pow(he.D * y * y + 1, fe.P_INT - 2, fe.P_INT) % fe.P_INT
+        if pow(x2, (fe.P_INT - 1) // 2, fe.P_INT) not in (0, 1):
+            nonres = y.to_bytes(32, "little")
+            break
+    ok, _ = _decompress(_bytes_arr([bad_y, nonres]))
+    assert not np.asarray(ok).any()
+
+
+def test_identity_eq_cofactor():
+    ident = cv.identity((2,))
+    assert np.asarray(jax.jit(cv.is_identity)(ident)).all()
+    pts = _stage_points([he.B, he.point_double(he.B)])
+    assert not np.asarray(jax.jit(cv.is_identity)(pts)).any()
+    e8 = jax.jit(cv.mul_cofactor)(pts)
+    for i, hp in enumerate([he.B, he.point_double(he.B)]):
+        assert he.point_equal(_host_point(e8, i), he.point_mul(8, hp))
+
+
+def test_reduce512_borrow_regression():
+    """sub_mod_2k needs a normalized subtrahend: crafted digest whose q*L
+    has limbs > MASK used to produce a wrong challenge scalar."""
+    d = bytes.fromhex(
+        "dc6cf55033dd30030807739cfa77160fd9b05d7b851378cf555486a683d8705a"
+        "1180000000000000000000000000000000000000000000000000000000000000"
+    )
+    out = _reduce512(_bytes_arr([d]))
+    assert bi.limbs_to_int_np(np.asarray(out)[0]) == int.from_bytes(d, "little") % sc.L_INT
