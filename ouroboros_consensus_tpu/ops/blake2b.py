@@ -1,0 +1,185 @@
+"""Batched Blake2b device kernel (RFC 7693; unkeyed; digest size 1..64).
+
+Host staging pads messages into zero-filled 128-byte blocks
+(`pad_messages_np`); the device kernel runs each lane through the batch-max
+block count with masked updates, threading the byte counter and final-block
+flag per lane.
+
+Reference equivalents: `cardano-crypto-class` Blake2b_256/Blake2b_224 hash
+classes (C libsodium), used for KES Merkle nodes (CompactSum), header
+hashes (Praos/Header.hs:158), the VRF input `Blake2b-256(slot ‖ nonce)`
+(Praos/VRF.hs:47), leader/nonce range extension (VRF.hs:103,116), and pool
+key hashes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+from jax import lax
+from jax import numpy as jnp
+
+from . import u64
+from .sha512 import _H0_INTS  # Blake2b IV == SHA-512 IV
+
+BLOCK = 128
+
+IV = u64.split_np(_H0_INTS)  # [8, 2]
+
+_SIGMA = [
+    [0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15],
+    [14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3],
+    [11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4],
+    [7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8],
+    [9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13],
+    [2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9],
+    [12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11],
+    [13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10],
+    [6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5],
+    [10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0],
+]
+
+
+def nblocks_for_len(n: int) -> int:
+    return max(1, (n + BLOCK - 1) // BLOCK)
+
+
+def pad_messages_np(msgs: Sequence[bytes], nb: int | None = None):
+    """Messages -> (blocks [B, NB, 16, 2] uint32 LE words, nblocks [B],
+    total_len [B]). Zero-padding only (Blake2b has no padding bits)."""
+    need = max((nblocks_for_len(len(m)) for m in msgs), default=1)
+    if nb is None:
+        nb = need
+    assert nb >= need
+    buf = np.zeros((len(msgs), nb * BLOCK), dtype=np.uint8)
+    nblocks = np.zeros((len(msgs),), dtype=np.int32)
+    total = np.zeros((len(msgs),), dtype=np.int32)
+    for i, m in enumerate(msgs):
+        buf[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        nblocks[i] = nblocks_for_len(len(m))
+        total[i] = len(m)
+    return (
+        bytes_to_blocks_np(buf.reshape(len(msgs), nb, BLOCK)),
+        nblocks,
+        total,
+    )
+
+
+def bytes_to_blocks_np(b: np.ndarray) -> np.ndarray:
+    """[..., 128] uint8 -> [..., 16, 2] uint32 little-endian words."""
+    w = b.reshape(*b.shape[:-1], 16, 8).astype(np.uint32)
+    shifts = np.array([0, 8, 16, 24], dtype=np.uint32)
+    lo = (w[..., :4] << shifts).sum(axis=-1, dtype=np.uint32)
+    hi = (w[..., 4:] << shifts).sum(axis=-1, dtype=np.uint32)
+    return np.stack([hi, lo], axis=-1)
+
+
+def bytes_to_blocks(b):
+    """Device variant: [..., 128] int32 bytes -> [..., 16, 2] uint32 LE words."""
+    w = b.astype(jnp.uint32).reshape(*b.shape[:-1], 16, 8)
+    shifts = jnp.asarray([0, 8, 16, 24], jnp.uint32)
+    lo = (w[..., :4] << shifts).sum(axis=-1).astype(jnp.uint32)
+    hi = (w[..., 4:] << shifts).sum(axis=-1).astype(jnp.uint32)
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def _g(v, a, b, c, d, x, y):
+    v[a] = u64.add_many(v[a], v[b], x)
+    v[d] = u64.rotr(u64.xor(v[d], v[a]), 32)
+    v[c] = u64.add(v[c], v[d])
+    v[b] = u64.rotr(u64.xor(v[b], v[c]), 24)
+    v[a] = u64.add_many(v[a], v[b], y)
+    v[d] = u64.rotr(u64.xor(v[d], v[a]), 16)
+    v[c] = u64.add(v[c], v[d])
+    v[b] = u64.rotr(u64.xor(v[b], v[c]), 63)
+
+
+def compress(state, block, t_bytes, is_final):
+    """One Blake2b compression.
+
+    state [..., 8, 2]; block [..., 16, 2] LE words; t_bytes [...] int32
+    (bytes hashed including this block, < 2^31); is_final [...] bool.
+    """
+    iv = jnp.asarray(IV)
+    m = [(block[..., i, 0], block[..., i, 1]) for i in range(16)]
+    v = [(state[..., i, 0], state[..., i, 1]) for i in range(8)]
+    zero = jnp.zeros_like(state[..., 0, 0])
+    for i in range(8):
+        v.append((jnp.broadcast_to(iv[i, 0], zero.shape), jnp.broadcast_to(iv[i, 1], zero.shape)))
+    # v12 ^= t (counter fits 31 bits: t_hi = 0); v14 inverted on final block
+    v[12] = (v[12][0], v[12][1] ^ t_bytes.astype(jnp.uint32))
+    fmask = jnp.where(is_final, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    v[14] = (v[14][0] ^ fmask, v[14][1] ^ fmask)
+    for r in range(12):
+        s = _SIGMA[r % 10]
+        _g(v, 0, 4, 8, 12, m[s[0]], m[s[1]])
+        _g(v, 1, 5, 9, 13, m[s[2]], m[s[3]])
+        _g(v, 2, 6, 10, 14, m[s[4]], m[s[5]])
+        _g(v, 3, 7, 11, 15, m[s[6]], m[s[7]])
+        _g(v, 0, 5, 10, 15, m[s[8]], m[s[9]])
+        _g(v, 1, 6, 11, 12, m[s[10]], m[s[11]])
+        _g(v, 2, 7, 8, 13, m[s[12]], m[s[13]])
+        _g(v, 3, 4, 9, 14, m[s[14]], m[s[15]])
+    outs = []
+    for i in range(8):
+        w = u64.xor(u64.xor((state[..., i, 0], state[..., i, 1]), v[i]), v[i + 8])
+        outs.append(jnp.stack([w[0], w[1]], axis=-1))
+    return jnp.stack(outs, axis=-2)
+
+
+def init_state(batch_shape, digest_size: int):
+    h = np.array(IV, dtype=np.uint32).copy()
+    h[0, 1] ^= np.uint32(0x01010000 ^ digest_size)
+    return jnp.broadcast_to(jnp.asarray(h), (*batch_shape, 8, 2))
+
+
+def blake2b_blocks(blocks, nblocks, total_len, digest_size: int = 32):
+    """Batched Blake2b over zero-padded blocks -> [..., digest_size] bytes.
+
+    blocks [..., NB, 16, 2]; nblocks, total_len [...] int32.
+    """
+    nb = blocks.shape[-3]
+    batch = blocks.shape[:-3]
+    nblocks = jnp.asarray(nblocks)
+    total_len = jnp.asarray(total_len)
+    state = init_state(batch, digest_size)
+
+    def step(st, i, blk):
+        is_final = i == nblocks - 1
+        t = jnp.where(is_final, total_len, (i + 1) * BLOCK)
+        nxt = compress(st, blk, t, is_final)
+        return jnp.where((i < nblocks)[..., None, None], nxt, st)
+
+    if nb == 1:
+        state = step(state, jnp.int32(0), blocks[..., 0, :, :])
+    else:
+        def body(i, st):
+            blk = lax.dynamic_index_in_dim(blocks, i, axis=len(batch), keepdims=False)
+            return step(st, i, blk)
+
+        state = lax.fori_loop(0, nb, body, state)
+    nwords = (digest_size + 7) // 8
+    outs = [u64.to_bytes_le((state[..., i, 0], state[..., i, 1])) for i in range(nwords)]
+    return jnp.concatenate(outs, axis=-1)[..., :digest_size]
+
+
+def blake2b_fixed(data_bytes, data_len: int, digest_size: int = 32):
+    """Single-block fast path: [..., n] int32 bytes with a STATIC common
+    length data_len <= 128 (the KES Merkle-node / nonce-evolution shape).
+    """
+    assert 0 < data_len <= BLOCK
+    batch = data_bytes.shape[:-1]
+    pad = BLOCK - data_bytes.shape[-1]
+    if pad:
+        data_bytes = jnp.concatenate(
+            [data_bytes, jnp.zeros((*batch, pad), jnp.int32)], axis=-1
+        )
+    blk = bytes_to_blocks(data_bytes)
+    state = init_state(batch, digest_size)
+    t = jnp.broadcast_to(jnp.int32(data_len), batch)
+    fin = jnp.broadcast_to(jnp.bool_(True), batch)
+    state = compress(state, blk, t, fin)
+    nwords = (digest_size + 7) // 8
+    outs = [u64.to_bytes_le((state[..., i, 0], state[..., i, 1])) for i in range(nwords)]
+    return jnp.concatenate(outs, axis=-1)[..., :digest_size]

@@ -134,6 +134,35 @@ def double_scalar_mul(bits_a, pa: Point, bits_b, pb: Point) -> Point:
     return lax.fori_loop(0, nb, body, identity(ra.shape[:-1]))
 
 
+def scalar_mul_w4(digits, p: Point) -> Point:
+    """Variable-base windowed mul: digits [..., k] base-16, little-endian.
+
+    Builds a per-lane table [0..15]*P (15 adds), then k iterations of
+    4 doublings + one gathered table add. ~70% fewer adds than the bit
+    ladder for 253-bit scalars (64 windows: 256 doubles + 79 adds).
+    """
+    k = digits.shape[-1]
+    batch = digits.shape[:-1]
+    # table[d] = d*P, extended coords stacked [..., 16, 4, 20]
+    entries = [identity(batch), p]
+    for _ in range(14):
+        entries.append(add(entries[-1], p))
+    tbl = jnp.stack([jnp.stack(list(e), axis=-2) for e in entries], axis=-3)
+
+    rev = jnp.flip(digits, axis=-1)  # msb window first
+
+    def body(i, q):
+        for _ in range(4):
+            q = double(q)
+        dw = lax.dynamic_index_in_dim(rev, i, axis=-1, keepdims=False)  # [...]
+        e = jnp.take_along_axis(tbl, dw[..., None, None, None], axis=-3)
+        e = e[..., 0, :, :]
+        pt = Point(e[..., 0, :], e[..., 1, :], e[..., 2, :], e[..., 3, :])
+        return add(q, pt)
+
+    return lax.fori_loop(0, k, body, identity(batch))
+
+
 # Fixed-base table for B: 64 windows of 4 bits; TABLE[w][d] = d * 16^w * B.
 def _build_base_table() -> np.ndarray:
     tbl = np.zeros((64, 16, 4, fe.NLIMBS), dtype=np.int32)
@@ -207,3 +236,25 @@ def compress(p: Point):
     b = fe.to_bytes(y)
     sign = (x[..., 0] & 1) << 7
     return b.at[..., 31].add(sign)
+
+
+def compress_many(points):
+    """Compress k points sharing ONE inversion chain (Montgomery's trick:
+    k-1 prefix muls + 1 inv + 2(k-1) muls instead of k inversions).
+    Used by the ECVRF challenge hash (compresses H, Gamma, U, V)."""
+    zs = [p.z for p in points]
+    prefix = [zs[0]]
+    for z in zs[1:]:
+        prefix.append(fe.mul(prefix[-1], z))
+    acc = fe.inv(prefix[-1])
+    invs: list = [None] * len(zs)
+    for i in range(len(zs) - 1, 0, -1):
+        invs[i] = fe.mul(acc, prefix[i - 1])
+        acc = fe.mul(acc, zs[i])
+    invs[0] = acc
+    outs = []
+    for p, zi in zip(points, invs):
+        x = fe.canonical(fe.mul(p.x, zi))
+        b = fe.to_bytes(fe.mul(p.y, zi))
+        outs.append(b.at[..., 31].add((x[..., 0] & 1) << 7))
+    return outs

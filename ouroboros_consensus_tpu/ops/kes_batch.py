@@ -1,0 +1,116 @@
+"""Batched CompactSum KES verification on device.
+
+Per lane: one Ed25519 leaf verification (the KES-signed message) plus
+`depth` Blake2b-256 Merkle-node recomputations walking bottom-up; at level
+i the period's bit i selects H(vk ‖ sib) vs H(sib ‖ vk) — realized as a
+masked select, batch-uniform. The reconstructed root must equal the
+declared KES verification key.
+
+Reference equivalent: `cardano-crypto-class` `Cardano.Crypto.KES.CompactSum`
+verifySignedKES, the header-signature check in the Praos hot path
+(ouroboros-consensus-protocol/.../Protocol/Praos.hs:582) and the storage
+integrity check (ouroboros-consensus-cardano shelley Ledger/Integrity.hs:14).
+Differentially tested against ops/host/kes.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+from jax import numpy as jnp
+
+from . import blake2b, curve, scalar, sha512
+from .host import kes as hk
+
+
+class KesBatch(NamedTuple):
+    vk: np.ndarray  # [B, 32] uint8 — declared root vk
+    period: np.ndarray  # [B] int32
+    r: np.ndarray  # [B, 32] uint8 — leaf Ed25519 sig R
+    s: np.ndarray  # [B, 32] uint8 — leaf Ed25519 sig s
+    vk_leaf: np.ndarray  # [B, 32] uint8
+    siblings: np.ndarray  # [B, depth, 32] uint8, bottom-up
+    hblocks: np.ndarray  # [B, NB, 16, 2] — padded SHA-512(R ‖ vk_leaf ‖ msg)
+    hnblocks: np.ndarray  # [B] int32
+
+
+def stage_np(
+    vks: Sequence[bytes],
+    periods: Sequence[int],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    depth: int = hk.DEFAULT_DEPTH,
+    nb: int | None = None,
+) -> KesBatch:
+    b = len(vks)
+    assert len(periods) == len(msgs) == len(sigs) == b
+    vk = np.zeros((b, 32), np.uint8)
+    period = np.zeros((b,), np.int32)
+    r = np.zeros((b, 32), np.uint8)
+    s = np.zeros((b, 32), np.uint8)
+    vk_leaf = np.zeros((b, 32), np.uint8)
+    siblings = np.zeros((b, depth, 32), np.uint8)
+    hmsgs = []
+    for i, (v, p, m, sig) in enumerate(zip(vks, periods, msgs, sigs)):
+        assert len(v) == 32 and len(sig) == hk.sig_bytes(depth)
+        ed_sig, leaf, sibs = hk.decompose_sig(sig, depth)
+        vk[i] = np.frombuffer(v, np.uint8)
+        period[i] = p
+        r[i] = np.frombuffer(ed_sig[:32], np.uint8)
+        s[i] = np.frombuffer(ed_sig[32:], np.uint8)
+        vk_leaf[i] = np.frombuffer(leaf, np.uint8)
+        for j, sb in enumerate(sibs):
+            siblings[i, j] = np.frombuffer(sb, np.uint8)
+        hmsgs.append(ed_sig[:32] + leaf + m)
+    hblocks, hnblocks = sha512.pad_messages_np(hmsgs, nb)
+    return KesBatch(vk, period, r, s, vk_leaf, siblings, hblocks, hnblocks)
+
+
+def verify(vk, period, r, s, vk_leaf, siblings, hblocks, hnblocks, *, depth: int | None = None):
+    """Device kernel -> ok bool[B]. depth defaults to siblings.shape[-2]."""
+    vk = jnp.asarray(vk).astype(jnp.int32)
+    period = jnp.asarray(period)
+    vk_leaf = jnp.asarray(vk_leaf).astype(jnp.int32)
+    siblings = jnp.asarray(siblings).astype(jnp.int32)
+    if depth is None:
+        depth = siblings.shape[-2]
+
+    # leaf Ed25519: pk = vk_leaf, challenge hash pre-staged in hblocks
+    ok_a, a_pt = curve.decompress(vk_leaf)
+    ok_r, r_pt = curve.decompress(jnp.asarray(r).astype(jnp.int32))
+    s_arr = jnp.asarray(s).astype(jnp.int32)
+    s_ok = scalar.is_canonical32(s_arr)
+    h = scalar.reduce512(sha512.sha512(jnp.asarray(hblocks), jnp.asarray(hnblocks)))
+    sb = curve.base_mul(scalar.windows4_from_bits(scalar.bits_from_bytes(s_arr, 256)))
+    ha = curve.scalar_mul_w4(
+        scalar.windows4_from_bits(scalar.bits_from_limbs(h, 256)), a_pt
+    )
+    ed_ok = ok_a & ok_r & s_ok & curve.eq(sb, curve.add(r_pt, ha))
+
+    # Merkle root reconstruction, bottom-up; bit i of period selects side
+    cur = vk_leaf
+    for i in range(depth):
+        sib = siblings[..., i, :]
+        bit = (period >> i) & 1
+        left = jnp.concatenate([cur, sib], axis=-1)
+        right = jnp.concatenate([sib, cur], axis=-1)
+        data = jnp.where((bit == 1)[..., None], right, left)
+        cur = blake2b.blake2b_fixed(data, 64, 32)
+
+    root_ok = jnp.all(cur == vk, axis=-1)
+    period_ok = (period >= 0) & (period < (1 << depth))
+    return ed_ok & root_ok & period_ok
+
+
+_JIT: dict = {}
+
+
+def verify_batch(vks, periods, msgs, sigs, depth: int = hk.DEFAULT_DEPTH) -> np.ndarray:
+    global _JIT
+    if depth not in _JIT:
+        import jax
+
+        _JIT[depth] = jax.jit(verify)
+    batch = stage_np(vks, periods, msgs, sigs, depth)
+    return np.asarray(_JIT[depth](*(jnp.asarray(x) for x in batch)))

@@ -1,0 +1,206 @@
+"""Batched SHA-512 device kernel (pure jnp, uint32 word pairs).
+
+Variable-length messages are staged host-side into standard padded 128-byte
+blocks (`pad_messages_np`); the device kernel runs every lane through the
+batch-max number of blocks with masked state updates — batch-uniform
+control flow, no data-dependent branches (the TPU discipline from
+SURVEY.md §7.3).
+
+Reference equivalent: SHA-512 inside libsodium's Ed25519 (challenge hash
+`H(R||A||M)`) and the vendored ECVRF proof/challenge hashes — reached from
+the reference hot path at ouroboros-consensus-protocol/.../Protocol/
+Praos.hs:543,580,582 via `cardano-crypto-{class,praos}`.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+from jax import lax
+from jax import numpy as jnp
+
+from . import u64
+
+BLOCK = 128
+
+_H0_INTS = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+
+_K_INTS = [
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F, 0xE9B5DBA58189DBBC,
+    0x3956C25BF348B538, 0x59F111F1B605D019, 0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118,
+    0xD807AA98A3030242, 0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235, 0xC19BF174CF692694,
+    0xE49B69C19EF14AD2, 0xEFBE4786384F25E3, 0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65,
+    0x2DE92C6F592B0275, 0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F, 0xBF597FC7BEEF0EE4,
+    0xC6E00BF33DA88FC2, 0xD5A79147930AA725, 0x06CA6351E003826F, 0x142929670A0E6E70,
+    0x27B70A8546D22FFC, 0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6, 0x92722C851482353B,
+    0xA2BFE8A14CF10364, 0xA81A664BBC423001, 0xC24B8B70D0F89791, 0xC76C51A30654BE30,
+    0xD192E819D6EF5218, 0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99, 0x34B0BCB5E19B48A8,
+    0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB, 0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3,
+    0x748F82EE5DEFB2FC, 0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915, 0xC67178F2E372532B,
+    0xCA273ECEEA26619C, 0xD186B8C721C0C207, 0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178,
+    0x06F067AA72176FBA, 0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC, 0x431D67C49C100D4C,
+    0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A, 0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+]
+
+H0 = u64.split_np(_H0_INTS)  # [8, 2] uint32
+K = u64.split_np(_K_INTS)  # [80, 2] uint32
+
+
+def nblocks_for_len(n: int) -> int:
+    """Number of SHA-512 blocks for an n-byte message (incl. padding)."""
+    return (n + 1 + 16 + BLOCK - 1) // BLOCK
+
+
+def pad_messages_np(msgs: Sequence[bytes], nb: int | None = None):
+    """Host staging: messages -> (blocks [B, NB, 16, 2] uint32, nblocks [B] int32).
+
+    Standard SHA-512 padding (0x80, zeros, 128-bit big-endian bit length);
+    trailing blocks beyond a lane's nblocks are zero and masked out on
+    device.
+    """
+    need = max((nblocks_for_len(len(m)) for m in msgs), default=1)
+    if nb is None:
+        nb = need
+    assert nb >= need, f"nb={nb} < required {need}"
+    buf = np.zeros((len(msgs), nb * BLOCK), dtype=np.uint8)
+    nblocks = np.zeros((len(msgs),), dtype=np.int32)
+    for i, m in enumerate(msgs):
+        k = nblocks_for_len(len(m))
+        padded = bytearray(k * BLOCK)
+        padded[: len(m)] = m
+        padded[len(m)] = 0x80
+        padded[-16:] = (8 * len(m)).to_bytes(16, "big")
+        buf[i, : k * BLOCK] = np.frombuffer(bytes(padded), dtype=np.uint8)
+        nblocks[i] = k
+    return bytes_to_blocks_np(buf.reshape(len(msgs), nb, BLOCK)), nblocks
+
+
+def bytes_to_blocks_np(b: np.ndarray) -> np.ndarray:
+    """[..., 128] uint8 -> [..., 16, 2] uint32 big-endian words."""
+    w = b.reshape(*b.shape[:-1], 16, 8).astype(np.uint32)
+    shifts = np.array([24, 16, 8, 0], dtype=np.uint32)
+    hi = (w[..., :4] << shifts).sum(axis=-1, dtype=np.uint32)
+    lo = (w[..., 4:] << shifts).sum(axis=-1, dtype=np.uint32)
+    return np.stack([hi, lo], axis=-1)
+
+
+def bytes_to_blocks(b):
+    """Device variant: [..., 128] int32 bytes -> [..., 16, 2] uint32 words."""
+    w = b.astype(jnp.uint32).reshape(*b.shape[:-1], 16, 8)
+    shifts = jnp.asarray([24, 16, 8, 0], jnp.uint32)
+    hi = (w[..., :4] << shifts).sum(axis=-1).astype(jnp.uint32)
+    lo = (w[..., 4:] << shifts).sum(axis=-1).astype(jnp.uint32)
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def _bsig0(x):
+    return u64.xor(u64.xor(u64.rotr(x, 28), u64.rotr(x, 34)), u64.rotr(x, 39))
+
+
+def _bsig1(x):
+    return u64.xor(u64.xor(u64.rotr(x, 14), u64.rotr(x, 18)), u64.rotr(x, 41))
+
+
+def _ssig0(x):
+    return u64.xor(u64.xor(u64.rotr(x, 1), u64.rotr(x, 8)), u64.shr(x, 7))
+
+
+def _ssig1(x):
+    return u64.xor(u64.xor(u64.rotr(x, 19), u64.rotr(x, 61)), u64.shr(x, 6))
+
+
+def compress(state, block):
+    """One SHA-512 compression. state [..., 8, 2]; block [..., 16, 2]."""
+    kc = jnp.asarray(K)
+    w = [(block[..., i, 0], block[..., i, 1]) for i in range(16)]
+    for t in range(16, 80):
+        w.append(
+            u64.add_many(_ssig1(w[t - 2]), w[t - 7], _ssig0(w[t - 15]), w[t - 16])
+        )
+    regs = [(state[..., i, 0], state[..., i, 1]) for i in range(8)]
+    a, b, c, d, e, f, g, h = regs
+    for t in range(80):
+        ch = u64.xor(u64.and_(e, f), u64.and_(u64.not_(e), g))
+        maj = u64.xor(u64.xor(u64.and_(a, b), u64.and_(a, c)), u64.and_(b, c))
+        kt = (kc[t, 0], kc[t, 1])
+        t1 = u64.add_many(h, _bsig1(e), ch, kt, w[t])
+        t2 = u64.add(_bsig0(a), maj)
+        h, g, f = g, f, e
+        e = u64.add(d, t1)
+        d, c, b = c, b, a
+        a = u64.add(t1, t2)
+    out = [a, b, c, d, e, f, g, h]
+    new = jnp.stack(
+        [jnp.stack([out[i][0], out[i][1]], axis=-1) for i in range(8)], axis=-2
+    )
+    hi = state[..., 0] + new[..., 0]
+    lo = state[..., 1] + new[..., 1]
+    carry = (lo < state[..., 1]).astype(jnp.uint32)
+    return jnp.stack([hi + carry, lo], axis=-1)
+
+
+def sha512_blocks(blocks, nblocks):
+    """Batched SHA-512 over pre-padded blocks.
+
+    blocks: [..., NB, 16, 2] uint32; nblocks: [...] int32 (1 <= n <= NB).
+    Returns digest words [..., 8, 2] uint32.
+    """
+    nb = blocks.shape[-3]
+    batch = blocks.shape[:-3]
+    init = jnp.broadcast_to(jnp.asarray(H0), (*batch, 8, 2))
+
+    if nb == 1:
+        return compress(init, blocks[..., 0, :, :])
+
+    def body(i, st):
+        blk = lax.dynamic_index_in_dim(blocks, i, axis=len(batch), keepdims=False)
+        nxt = compress(st, blk)
+        active = (i < nblocks)[..., None, None]
+        return jnp.where(active, nxt, st)
+
+    return lax.fori_loop(0, nb, body, init)
+
+
+def digest_bytes(words):
+    """[..., 8, 2] words -> [..., 64] int32 bytes in digest order."""
+    outs = [u64.to_bytes_be((words[..., i, 0], words[..., i, 1])) for i in range(8)]
+    return jnp.concatenate(outs, axis=-1)
+
+
+def sha512(blocks, nblocks):
+    """Convenience: padded blocks -> [..., 64] digest bytes."""
+    return digest_bytes(sha512_blocks(blocks, nblocks))
+
+
+def sha512_fixed(data):
+    """SHA-512 of [..., n] int32 byte arrays with a STATIC common length n.
+
+    Padding is a compile-time constant; every block is processed (no
+    masking). This is the shape of the ECVRF hash-to-curve / challenge /
+    proof-to-hash inputs.
+    """
+    n = data.shape[-1]
+    batch = data.shape[:-1]
+    nb = nblocks_for_len(n)
+    tail = np.zeros(nb * BLOCK - n, dtype=np.int32)
+    tail[0] = 0x80
+    tail[-16:] = np.frombuffer((8 * n).to_bytes(16, "big"), np.uint8)
+    padded = jnp.concatenate(
+        [data.astype(jnp.int32), jnp.broadcast_to(jnp.asarray(tail), (*batch, tail.size))],
+        axis=-1,
+    )
+    blocks = bytes_to_blocks(padded.reshape(*batch, nb, BLOCK))
+    state = jnp.broadcast_to(jnp.asarray(H0), (*batch, 8, 2))
+    for i in range(nb):
+        state = compress(state, blocks[..., i, :, :])
+    return digest_bytes(state)

@@ -1,0 +1,84 @@
+"""64-bit word ops as (hi, lo) uint32 pairs — TPU has no native u64.
+
+Words are plain tuples of uint32 arrays so XLA sees flat elementwise ops it
+can fuse freely. Shared by the SHA-512 (ops/sha512.py) and Blake2b
+(ops/blake2b.py) device kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax import numpy as jnp
+
+U32 = jnp.uint32
+
+
+def const(x: int):
+    """Python int -> ((), ()) uint32 scalar pair."""
+    return (jnp.uint32((x >> 32) & 0xFFFFFFFF), jnp.uint32(x & 0xFFFFFFFF))
+
+
+def split_np(words) -> np.ndarray:
+    """[N] python ints / uint64 -> [N, 2] uint32 (hi, lo)."""
+    w = [int(x) for x in words]
+    return np.array([[(x >> 32) & 0xFFFFFFFF, x & 0xFFFFFFFF] for x in w], dtype=np.uint32)
+
+
+def add(a, b):
+    ah, al = a
+    bh, bl = b
+    lo = al + bl
+    carry = (lo < al).astype(U32)
+    return ah + bh + carry, lo
+
+
+def add_many(*xs):
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = add(acc, x)
+    return acc
+
+
+def xor(a, b):
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def and_(a, b):
+    return a[0] & b[0], a[1] & b[1]
+
+
+def not_(a):
+    return ~a[0], ~a[1]
+
+
+def rotr(x, n: int):
+    h, l = x
+    n %= 64
+    if n == 0:
+        return h, l
+    if n == 32:
+        return l, h
+    if n < 32:
+        return (h >> n) | (l << (32 - n)), (l >> n) | (h << (32 - n))
+    m = n - 32
+    return (l >> m) | (h << (32 - m)), (h >> m) | (l << (32 - m))
+
+
+def shr(x, n: int):
+    """Logical right shift, 0 < n < 32."""
+    h, l = x
+    return h >> n, (l >> n) | (h << (32 - n))
+
+
+def to_bytes_be(x):
+    """(hi, lo)[...] -> [..., 8] int32 bytes, big-endian (SHA-512 digest order)."""
+    h, l = x
+    parts = [h >> 24, h >> 16, h >> 8, h, l >> 24, l >> 16, l >> 8, l]
+    return jnp.stack([(p & jnp.uint32(0xFF)).astype(jnp.int32) for p in parts], axis=-1)
+
+
+def to_bytes_le(x):
+    """(hi, lo)[...] -> [..., 8] int32 bytes, little-endian (Blake2b digest order)."""
+    h, l = x
+    parts = [l, l >> 8, l >> 16, l >> 24, h, h >> 8, h >> 16, h >> 24]
+    return jnp.stack([(p & jnp.uint32(0xFF)).astype(jnp.int32) for p in parts], axis=-1)

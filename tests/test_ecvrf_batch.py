@@ -1,0 +1,64 @@
+"""Differential test: batched device ECVRF verify vs host reference."""
+
+import random
+
+import numpy as np
+
+from ouroboros_consensus_tpu.ops import ecvrf_batch as vb
+from ouroboros_consensus_tpu.ops.host import ecvrf as hv
+from ouroboros_consensus_tpu.ops.host import ed25519 as he
+from ouroboros_consensus_tpu.ops.host import hashes
+
+
+def test_ecvrf_batch_mixed():
+    rng = random.Random(11)
+    pks, proofs, alphas, want = [], [], [], []
+
+    # valid proofs over Praos-shaped alphas (InputVRF)
+    for slot in (1, 77, 4096):
+        seed = bytes(rng.randrange(256) for _ in range(32))
+        pk = he.secret_to_public(seed)
+        alpha = hashes.input_vrf(slot, b"\x42" * 32)
+        pi = hv.prove(seed, alpha)
+        assert hv.verify(pk, pi, alpha) is not None
+        pks.append(pk); proofs.append(pi); alphas.append(alpha); want.append(True)
+
+    # corrupted gamma
+    seed = bytes(rng.randrange(256) for _ in range(32))
+    pk = he.secret_to_public(seed)
+    alpha = hashes.input_vrf(5, b"\x01" * 32)
+    pi = bytearray(hv.prove(seed, alpha))
+    pi[2] ^= 0x10
+    pks.append(pk); proofs.append(bytes(pi)); alphas.append(alpha); want.append(False)
+
+    # corrupted c
+    pi = bytearray(hv.prove(seed, alpha))
+    pi[33] ^= 0x01
+    pks.append(pk); proofs.append(bytes(pi)); alphas.append(alpha); want.append(False)
+
+    # corrupted s
+    pi = bytearray(hv.prove(seed, alpha))
+    pi[50] ^= 0x80
+    pks.append(pk); proofs.append(bytes(pi)); alphas.append(alpha); want.append(False)
+
+    # wrong alpha
+    pi = hv.prove(seed, alpha)
+    wrong = hashes.input_vrf(6, b"\x01" * 32)
+    pks.append(pk); proofs.append(pi); alphas.append(wrong); want.append(False)
+
+    # non-canonical s (s + L)
+    pi = hv.prove(seed, alpha)
+    s = int.from_bytes(pi[48:], "little")
+    pi_nc = pi[:48] + int.to_bytes(s + he.L, 32, "little")
+    pks.append(pk); proofs.append(pi_nc); alphas.append(alpha); want.append(False)
+
+    # host agrees with expectations
+    for pk_, pi_, al_, w_ in zip(pks, proofs, alphas, want):
+        assert (hv.verify(pk_, pi_, al_) is not None) == w_
+
+    ok, beta = vb.verify_batch(pks, proofs, alphas)
+    assert list(ok) == want
+    # beta matches host proof_to_hash on the valid lanes
+    for i, w_ in enumerate(want):
+        if w_:
+            assert bytes(beta[i]) == hv.proof_to_hash(proofs[i])

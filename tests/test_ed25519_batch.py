@@ -1,0 +1,79 @@
+"""Differential test: batched device Ed25519 verify vs host reference."""
+
+import random
+
+import numpy as np
+
+from ouroboros_consensus_tpu.ops import ed25519_batch as eb
+from ouroboros_consensus_tpu.ops.host import ed25519 as he
+
+
+def _keypair(rng):
+    seed = bytes(rng.randrange(256) for _ in range(32))
+    return seed, he.secret_to_public(seed)
+
+
+def test_ed25519_batch_mixed_valid_invalid():
+    rng = random.Random(7)
+    pks, sigs, msgs, want = [], [], [], []
+
+    # 6 valid signatures, varied message lengths
+    for n in (0, 1, 31, 64, 100, 200):
+        seed, pk = _keypair(rng)
+        msg = bytes(rng.randrange(256) for _ in range(n))
+        sig = he.sign(seed, msg)
+        assert he.verify(pk, msg, sig)
+        pks.append(pk)
+        sigs.append(sig)
+        msgs.append(msg)
+        want.append(True)
+
+    # corrupted signature R
+    seed, pk = _keypair(rng)
+    msg = b"corrupt-R"
+    sig = bytearray(he.sign(seed, msg))
+    sig[1] ^= 0x40
+    pks.append(pk); sigs.append(bytes(sig)); msgs.append(msg); want.append(False)
+
+    # corrupted s
+    seed, pk = _keypair(rng)
+    msg = b"corrupt-s"
+    sig = bytearray(he.sign(seed, msg))
+    sig[40] ^= 0x01
+    pks.append(pk); sigs.append(bytes(sig)); msgs.append(msg); want.append(False)
+
+    # corrupted message
+    seed, pk = _keypair(rng)
+    msg = b"the real message"
+    sig = he.sign(seed, msg)
+    pks.append(pk); sigs.append(sig); msgs.append(b"a fake message!!"); want.append(False)
+
+    # wrong public key
+    seed, pk = _keypair(rng)
+    _, pk2 = _keypair(rng)
+    msg = b"wrong pk"
+    sig = he.sign(seed, msg)
+    pks.append(pk2); sigs.append(sig); msgs.append(msg); want.append(False)
+
+    # non-canonical s (s + L)
+    seed, pk = _keypair(rng)
+    msg = b"non-canonical s"
+    sig = he.sign(seed, msg)
+    s = int.from_bytes(sig[32:], "little")
+    sig_nc = sig[:32] + int.to_bytes(s + he.L, 32, "little")
+    pks.append(pk); sigs.append(sig_nc); msgs.append(msg); want.append(False)
+
+    # undecodable public key (y >= p, canonicality)
+    seed, pk = _keypair(rng)
+    msg = b"bad point"
+    sig = he.sign(seed, msg)
+    bad_pk = int.to_bytes(he.P + 1, 32, "little")
+    pks.append(bad_pk); sigs.append(sig); msgs.append(msg); want.append(False)
+
+    # cross-check host reference agrees with expectations
+    for pk, sig, msg, w in zip(pks, sigs, msgs, want):
+        assert he.verify(pk, msg, sig) == w
+
+    got = eb.verify_batch(pks, sigs, msgs)
+    assert got.dtype == np.bool_
+    assert list(got) == want

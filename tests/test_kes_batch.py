@@ -1,0 +1,56 @@
+"""Differential test: batched device KES verify vs host reference."""
+
+import random
+
+from ouroboros_consensus_tpu.ops import kes_batch as kb
+from ouroboros_consensus_tpu.ops.host import kes as hk
+
+DEPTH = 6
+
+
+def test_kes_batch_mixed():
+    rng = random.Random(13)
+    seeds = [bytes(rng.randrange(256) for _ in range(32)) for _ in range(4)]
+    vks_all = [hk.derive_vk(sd, DEPTH) for sd in seeds]
+
+    vks, periods, msgs, sigs, want = [], [], [], [], []
+
+    # valid signatures across the period range (different tree paths)
+    for sd, vk, p in zip(seeds, vks_all, (0, 1, 31, 63)):
+        msg = bytes(rng.randrange(256) for _ in range(120))
+        sig = hk.sign(sd, DEPTH, p, msg)
+        assert hk.verify(vk, DEPTH, p, msg, sig)
+        vks.append(vk); periods.append(p); msgs.append(msg); sigs.append(sig)
+        want.append(True)
+
+    sd, vk = seeds[0], vks_all[0]
+    msg = b"kes message under test"
+    sig = hk.sign(sd, DEPTH, 17, msg)
+
+    # wrong period (tree path mismatch)
+    vks.append(vk); periods.append(18); msgs.append(msg); sigs.append(sig)
+    want.append(False)
+
+    # corrupted sibling vk
+    bad = bytearray(sig); bad[100] ^= 0x01
+    vks.append(vk); periods.append(17); msgs.append(msg); sigs.append(bytes(bad))
+    want.append(False)
+
+    # corrupted leaf signature
+    bad = bytearray(sig); bad[3] ^= 0x80
+    vks.append(vk); periods.append(17); msgs.append(msg); sigs.append(bytes(bad))
+    want.append(False)
+
+    # wrong message
+    vks.append(vk); periods.append(17); msgs.append(b"a different message!!!"); sigs.append(sig)
+    want.append(False)
+
+    # wrong root vk
+    vks.append(vks_all[1]); periods.append(17); msgs.append(msg); sigs.append(sig)
+    want.append(False)
+
+    for v, p, m, s, w in zip(vks, periods, msgs, sigs, want):
+        assert hk.verify(v, DEPTH, p, m, s) == w
+
+    got = kb.verify_batch(vks, periods, msgs, sigs, DEPTH)
+    assert list(got) == want

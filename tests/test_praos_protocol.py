@@ -1,0 +1,197 @@
+"""Praos protocol state machine: happy path, error taxonomy, epoch nonces."""
+
+from dataclasses import replace
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.protocol import nonces, praos
+from ouroboros_consensus_tpu.protocol.praos import (
+    CounterOverIncrementedOCERT,
+    CounterTooSmallOCERT,
+    InvalidKesSignatureOCERT,
+    InvalidSignatureOCERT,
+    KESAfterEndOCERT,
+    KESBeforeStartOCERT,
+    NoCounterForKeyHashOCERT,
+    PraosParams,
+    PraosState,
+    VRFKeyBadProof,
+    VRFKeyUnknown,
+    VRFKeyWrongVRFKey,
+    VRFLeaderValueTooBig,
+    tick,
+    update,
+)
+from ouroboros_consensus_tpu.protocol.views import hash_key
+from ouroboros_consensus_tpu.testing import fixtures as fx
+
+# small test params: short epochs, generous f so leadership is common
+PARAMS = PraosParams(
+    slots_per_kes_period=100,
+    max_kes_evolutions=62,
+    security_param=4,
+    active_slot_coeff=Fraction(1, 2),
+    epoch_length=50,
+    kes_depth=6,
+)
+
+POOLS = [fx.make_pool(i) for i in range(3)]
+LV = fx.make_ledger_view(POOLS)
+
+
+def _update_at(hv, state=PraosState(), params=PARAMS, lv=LV):
+    ticked = tick(params, lv, hv.slot, state)
+    return update(params, hv, hv.slot, ticked)
+
+
+def test_update_happy_path_and_bookkeeping():
+    pool = POOLS[0]
+    st = PraosState(epoch_nonce=b"\x07" * 32)
+    hv = fx.forge_header_view(PARAMS, pool, 3, st.epoch_nonce, None, b"body-0")
+    st2 = _update_at(hv, st)
+    assert st2.last_slot == 3
+    assert st2.ocert_counters[pool.pool_id] == 0
+    # evolving nonce combined with this header's nonce value
+    eta = nonces.vrf_nonce_value(hv.vrf_output)
+    assert st2.evolving_nonce == eta  # neutral ⭒ eta = eta
+    # slot 3 + stability(24) >= 50? 27 < 50: within window -> candidate follows
+    assert st2.candidate_nonce == st2.evolving_nonce
+    assert st2.lab_nonce is None  # genesis prev-hash -> neutral
+
+
+def test_candidate_nonce_freezes_near_epoch_end():
+    pool = POOLS[0]
+    st = PraosState(epoch_nonce=b"\x07" * 32, last_slot=30)
+    # stability window = ceil(3*4 / (1/2)) = 24; slot 30: 30+24 >= 50 -> frozen
+    hv = fx.forge_header_view(PARAMS, pool, 32, st.epoch_nonce, b"\xaa" * 32)
+    st2 = _update_at(hv, st)
+    assert st2.candidate_nonce is None  # unchanged (was neutral)
+    assert st2.evolving_nonce is not None
+    assert st2.lab_nonce == b"\xaa" * 32
+
+
+def test_tick_rotates_nonces_on_epoch_boundary():
+    st = PraosState(
+        last_slot=49,
+        candidate_nonce=b"\x01" * 32,
+        last_epoch_block_nonce=b"\x02" * 32,
+        lab_nonce=b"\x03" * 32,
+        epoch_nonce=b"\x09" * 32,
+    )
+    ticked = tick(PARAMS, LV, 55, st)  # slot 55 is epoch 1
+    assert ticked.state.epoch_nonce == nonces.combine(b"\x01" * 32, b"\x02" * 32)
+    assert ticked.state.last_epoch_block_nonce == b"\x03" * 32
+    # same epoch: no rotation
+    ticked2 = tick(PARAMS, LV, 49, replace(st, last_slot=48))
+    assert ticked2.state.epoch_nonce == b"\x09" * 32
+
+
+def test_error_taxonomy():
+    pool = POOLS[0]
+    nonce = b"\x07" * 32
+    st = PraosState(epoch_nonce=nonce)
+    hv = fx.forge_header_view(PARAMS, pool, 3, nonce, None, b"body")
+
+    # KES period before ocert start
+    bad = replace(hv, ocert=pool.make_ocert(0, 5))  # slot 3 -> period 0 < 5
+    with pytest.raises(KESBeforeStartOCERT):
+        _update_at(bad, st)
+
+    # KES period beyond max evolutions
+    far = fx.forge_header_view(PARAMS, pool, 100 * 63, nonce, None, b"body")
+    bad = replace(far, ocert=pool.make_ocert(0, 0))
+    with pytest.raises(KESAfterEndOCERT):
+        _update_at(bad, st)
+
+    # corrupt ocert cold-key signature
+    oc = hv.ocert
+    bad = replace(hv, ocert=replace(oc, sigma=bytes(64)))
+    with pytest.raises(InvalidSignatureOCERT):
+        _update_at(bad, st)
+
+    # corrupt KES signature
+    ks = bytearray(hv.kes_sig)
+    ks[0] ^= 1
+    with pytest.raises(InvalidKesSignatureOCERT):
+        _update_at(replace(hv, kes_sig=bytes(ks)), st)
+
+    # issuer not in pool distribution
+    rogue = fx.make_pool(99)
+    bad = fx.forge_header_view(PARAMS, rogue, 3, nonce, None, b"body")
+    with pytest.raises(NoCounterForKeyHashOCERT):
+        _update_at(bad, st)
+    # ...unless it has a counter already (then it fails later, at the VRF)
+    st_known = replace(st, ocert_counters={rogue.pool_id: 0})
+    with pytest.raises(VRFKeyUnknown):
+        _update_at(bad, st_known)
+
+    # registered VRF key hash mismatch (header carries another pool's VRF vk)
+    bad = replace(hv, vrf_vk=POOLS[1].vrf_vk)
+    with pytest.raises(VRFKeyWrongVRFKey):
+        _update_at(bad, st)
+
+    # bad VRF proof
+    pf = bytearray(hv.vrf_proof)
+    pf[3] ^= 4
+    with pytest.raises(VRFKeyBadProof):
+        _update_at(replace(hv, vrf_proof=bytes(pf)), st)
+
+    # wrong epoch nonce in state => proof doesn't match
+    with pytest.raises(VRFKeyBadProof):
+        _update_at(hv, replace(st, epoch_nonce=b"\x08" * 32))
+
+    # counter rules
+    st_high = replace(st, ocert_counters={pool.pool_id: 5})
+    with pytest.raises(CounterTooSmallOCERT):
+        _update_at(hv, st_high)  # header counter 0 < last 5
+    bad = fx.forge_header_view(PARAMS, pool, 3, nonce, None, b"body", ocert_counter=7)
+    with pytest.raises(CounterOverIncrementedOCERT):
+        _update_at(bad, st_high)  # 7 > 5+1
+
+    # leader value too big: tiny stake + tiny f
+    lv_tiny = fx.make_ledger_view(POOLS, [Fraction(1, 10**12)] * 3)
+    params_tiny = replace(PARAMS, active_slot_coeff=Fraction(1, 10**6))
+    with pytest.raises(VRFLeaderValueTooBig):
+        ticked = tick(params_tiny, lv_tiny, hv.slot, st)
+        update(params_tiny, hv, hv.slot, ticked)
+
+
+def test_check_is_leader_agrees_with_validation():
+    pool = POOLS[0]
+    nonce = b"\x05" * 32
+    st = PraosState(epoch_nonce=nonce)
+    cbl = fx.can_be_leader(pool)
+    hits = 0
+    for slot in range(40):
+        ticked = tick(PARAMS, LV, slot, st)
+        res = praos.check_is_leader(PARAMS, cbl, slot, ticked)
+        if res is None:
+            continue
+        hits += 1
+        hv = fx.forge_header_view(PARAMS, pool, slot, nonce, None, b"b")
+        assert hv.vrf_output == res.vrf_output
+        _update_at(hv, st)  # must validate
+    # f = 1/2, sigma = 1/3: expect ~1-(1/2)^(1/3) ≈ 20% of 40 slots
+    assert hits >= 2
+
+
+def test_sequential_chain_multi_epoch():
+    """Batch-of-1 spec run: a 3-epoch chain with per-epoch nonce evolution."""
+    pool = POOLS[0]
+    st = PraosState()
+    prev_hash = None
+    counters = {}
+    for slot in range(0, 140, 7):  # crosses epochs at 50 and 100
+        ticked = tick(PARAMS, LV, slot, st)
+        n = counters.get(pool.pool_id, 0)
+        hv = fx.forge_header_view(
+            PARAMS, pool, slot, ticked.state.epoch_nonce, prev_hash,
+            b"body-%d" % slot, ocert_counter=n,
+        )
+        st = update(PARAMS, hv, slot, ticked)
+        counters[pool.pool_id] = n
+        prev_hash = bytes(32)  # placeholder header hash
+    assert st.last_slot == 133
+    assert st.epoch_nonce is not None
+    assert st.ocert_counters[pool.pool_id] == 0
