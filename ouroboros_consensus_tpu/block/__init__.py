@@ -1,0 +1,5 @@
+"""Block/header model: abstraction, Praos block, CBOR codecs, forging."""
+
+from .abstract import GENESIS_HASH, ORIGIN, HeaderFields, Point, block_point
+from .praos_block import Block, Header, HeaderBody, body_hash
+from .forge import forge_block, evaluate_vrf

@@ -1,0 +1,48 @@
+"""Block abstraction: points, header fields, chain hashes.
+
+Reference equivalents: `Ouroboros.Consensus.Block.Abstract` /
+`Block/RealPoint.hs` (HeaderFields, Point, RealPoint, ChainHash). The
+Haskell type-class tower (`GetHeader`, `HasHeader`, …) collapses to plain
+structural duck-typing on the host control plane: any object with
+`.slot`, `.block_no`, `.hash_`, `.prev_hash` participates in chain logic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+GENESIS_HASH = None  # ChainHash: None = GenesisHash, bytes = BlockHash
+
+
+@dataclass(frozen=True, order=True)
+class Point:
+    """A point on the chain: (slot, hash); None means genesis/origin.
+
+    Reference: `Ouroboros.Network.Block.Point` as re-exported by
+    Block/Abstract.hs; RealPoint (Block/RealPoint.hs:30) is a Point
+    guaranteed non-genesis.
+    """
+
+    slot: int
+    hash_: bytes
+
+    def __repr__(self):
+        return f"Point({self.slot}, {self.hash_[:6].hex()})"
+
+
+ORIGIN: Optional[Point] = None
+
+
+@dataclass(frozen=True)
+class HeaderFields:
+    """The fields every header exposes (Block/Abstract.hs HeaderFields)."""
+
+    slot: int
+    block_no: int
+    hash_: bytes
+
+
+def block_point(b) -> Point:
+    return Point(b.slot, b.hash_)

@@ -1,0 +1,71 @@
+"""Block forging: assemble and KES-sign a Praos block.
+
+Reference: `forgeBlock`/`mkHeader` — Block/Forging.hs:143 and the Praos
+`mkHeader` instance (ouroboros-consensus-cardano shelley
+Protocol/Praos.hs:102): build the header body, KES-sign its serialisation
+with the hot key at the current evolution, attach the signature.
+
+Used by the forging loop (node/), db_synthesizer (tools/) and tests.
+"""
+
+from __future__ import annotations
+
+from ..ops.host import ecvrf as host_ecvrf
+from ..ops.host import kes as host_kes
+from ..protocol import nonces
+from ..protocol.praos import PraosIsLeader, PraosParams
+from ..testing.fixtures import PoolCredentials
+from .praos_block import Block, Header, HeaderBody, body_hash
+
+
+def evaluate_vrf(pool: PoolCredentials, slot: int, epoch_nonce: nonces.Nonce):
+    """VRF.evalCertified at InputVRF(slot, eta0) (Praos.hs:397)."""
+    alpha = nonces.mk_input_vrf(slot, epoch_nonce)
+    proof = host_ecvrf.prove(pool.vrf_seed, alpha)
+    return PraosIsLeader(host_ecvrf.proof_to_hash(proof), proof)
+
+
+def forge_block(
+    params: PraosParams,
+    pool: PoolCredentials,
+    *,
+    slot: int,
+    block_no: int,
+    prev_hash: bytes | None,
+    epoch_nonce: nonces.Nonce,
+    txs: tuple[bytes, ...] = (),
+    ocert_counter: int = 0,
+    is_leader: PraosIsLeader | None = None,
+    protocol_version: tuple[int, int] = (9, 0),
+) -> Block:
+    """Forge a protocol-valid block for `slot` (the caller is responsible
+    for having won the slot; db_synthesizer checks check_is_leader first).
+
+    The OCert is issued for the KES period containing `slot` rounded down
+    to the evolution window start, and the KES signature is produced at
+    evolution t = period(slot) - c0, mirroring HotKey evolution
+    (Ledger/HotKey.hs:142).
+    """
+    if is_leader is None:
+        is_leader = evaluate_vrf(pool, slot, epoch_nonce)
+    kp = params.kes_period_of(slot)
+    # issue the ocert at the containing evolution-window start so that
+    # 0 <= t < max_kes_evolutions always holds
+    c0 = max(0, kp - (kp % params.max_kes_evolutions))
+    ocert = pool.make_ocert(ocert_counter, c0)
+    t = kp - c0
+    body = HeaderBody(
+        block_no=block_no,
+        slot=slot,
+        prev_hash=prev_hash,
+        issuer_vk=pool.vk_cold,
+        vrf_vk=pool.vrf_vk,
+        vrf_output=is_leader.vrf_output,
+        vrf_proof=is_leader.vrf_proof,
+        body_size=sum(len(t_) for t_ in txs),
+        body_hash=body_hash(txs),
+        ocert=ocert,
+        protocol_version=protocol_version,
+    )
+    kes_sig = host_kes.sign(pool.kes_seed, pool.kes_depth, t, body.signed_bytes)
+    return Block(Header(body, kes_sig), tuple(txs))

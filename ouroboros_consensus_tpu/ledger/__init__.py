@@ -1,0 +1,14 @@
+"""Ledger layer: abstract interface, header validation, extended state, mock."""
+
+from .abstract import Forecast, Ledger, LedgerError, OutsideForecastRange
+from .extended import ExtLedger, ExtLedgerState, TickedExtLedgerState
+from .header_validation import (
+    AnnTip,
+    HeaderEnvelopeError,
+    HeaderState,
+    TickedHeaderState,
+    revalidate_header,
+    tick_header_state,
+    validate_envelope,
+    validate_header,
+)

@@ -1,0 +1,109 @@
+"""Header validation: envelope checks + protocol state update.
+
+Reference: `Ouroboros.Consensus.HeaderValidation` — `HeaderState`
+(HeaderValidation.hs:151) pairs the protocol ChainDepState with the tip
+(`AnnTip`); `tickHeaderState` (:186); `validateHeader` (:413-432) runs the
+protocol-independent envelope checks (`BasicEnvelopeValidation` :251 —
+block number and slot monotonic, prev-hash matches) and then the
+protocol's `update`; `revalidateHeader` (:441) is the assert-only +
+`reupdate` fast path for previously-validated headers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Generic, TypeVar
+
+from ..block.abstract import Point
+
+S = TypeVar("S")
+
+
+class HeaderEnvelopeError(Exception):
+    pass
+
+
+@dataclass
+class UnexpectedBlockNo(HeaderEnvelopeError):
+    expected: int
+    actual: int
+
+
+@dataclass
+class UnexpectedSlotNo(HeaderEnvelopeError):
+    expected_at_least: int
+    actual: int
+
+
+@dataclass
+class UnexpectedPrevHash(HeaderEnvelopeError):
+    expected: bytes | None
+    actual: bytes | None
+
+
+@dataclass(frozen=True)
+class AnnTip:
+    """Annotated tip (HeaderValidation.hs:96): slot, block no, hash."""
+
+    slot: int
+    block_no: int
+    hash_: bytes
+
+    @property
+    def point(self) -> Point:
+        return Point(self.slot, self.hash_)
+
+
+@dataclass(frozen=True)
+class HeaderState:
+    """HeaderValidation.hs:151 — tip + protocol chain-dep state."""
+
+    tip: AnnTip | None  # None = genesis
+    chain_dep_state: Any
+
+
+@dataclass(frozen=True)
+class TickedHeaderState:
+    tip: AnnTip | None
+    ticked_chain_dep_state: Any
+
+
+def tick_header_state(protocol, ledger_view, slot: int, hs: HeaderState) -> TickedHeaderState:
+    """tickHeaderState (HeaderValidation.hs:186)."""
+    return TickedHeaderState(hs.tip, protocol.tick(ledger_view, slot, hs.chain_dep_state))
+
+
+def validate_envelope(tip: AnnTip | None, header) -> None:
+    """BasicEnvelopeValidation (HeaderValidation.hs:251): first block no /
+    slot are minimal, successors increment block no, advance the slot, and
+    link prev-hash to the tip hash."""
+    if tip is None:
+        expected_bno = 0
+        min_slot = 0
+        expected_prev = None
+    else:
+        expected_bno = tip.block_no + 1
+        min_slot = tip.slot + 1
+        expected_prev = tip.hash_
+    if header.block_no != expected_bno:
+        raise UnexpectedBlockNo(expected_bno, header.block_no)
+    if header.slot < min_slot:
+        raise UnexpectedSlotNo(min_slot, header.slot)
+    if header.prev_hash != expected_prev:
+        raise UnexpectedPrevHash(expected_prev, header.prev_hash)
+
+
+def validate_header(protocol, ticked: TickedHeaderState, header) -> HeaderState:
+    """validateHeader (HeaderValidation.hs:413-432): envelope then
+    protocol `update` (the crypto); returns the new HeaderState."""
+    validate_envelope(ticked.tip, header)
+    st = protocol.update(header.to_view(), header.slot, ticked.ticked_chain_dep_state)
+    return HeaderState(AnnTip(header.slot, header.block_no, header.hash_), st)
+
+
+def revalidate_header(protocol, ticked: TickedHeaderState, header) -> HeaderState:
+    """revalidateHeader (HeaderValidation.hs:441): envelope as assertion,
+    `reupdate` (no crypto) — the replay/reapply fast path."""
+    validate_envelope(ticked.tip, header)
+    st = protocol.reupdate(header.to_view(), header.slot, ticked.ticked_chain_dep_state)
+    return HeaderState(AnnTip(header.slot, header.block_no, header.hash_), st)

@@ -1,0 +1,151 @@
+"""Mock UTxO ledger — the test/benchmark ledger of the framework.
+
+Reference: the `mock-block` library's `SimpleBlock` ledger
+(ouroboros-consensus/src/mock-block/.../Mock/Ledger/*): a minimal UTxO
+ledger sufficient to drive ThreadNet tests, the mempool, and the
+db-synthesizer/db-analyser benchmark pipeline, while keeping tx-level
+Shelley fidelity out of the hot path (SURVEY.md §7.2 step 11).
+
+Tx wire format (deterministic CBOR):
+    [[ [txid, ix], ... ],  [ [addr, amount], ... ]]
+txid = Blake2b-256 of the tx bytes. Genesis UTxO enters as outputs of the
+zero txid. The pool stake distribution is static per-epoch configuration
+(the Praos LedgerView), as the reference's mock ledger fixes its stake
+distribution at genesis (Mock/Ledger/Stake.hs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Mapping
+
+from ..ops.host.hashes import blake2b_256
+from ..protocol.views import LedgerView
+from ..utils import cbor
+from .abstract import Forecast, LedgerError
+
+
+class InvalidTx(LedgerError):
+    pass
+
+
+@dataclass
+class MissingInput(InvalidTx):
+    txin: tuple[bytes, int]
+
+
+@dataclass
+class ValueNotConserved(InvalidTx):
+    consumed: int
+    produced: int
+
+
+def tx_id(tx_bytes: bytes) -> bytes:
+    return blake2b_256(tx_bytes)
+
+
+def decode_tx(tx_bytes: bytes):
+    ins, outs = cbor.decode(tx_bytes)
+    return (
+        [(bytes(i[0]), i[1]) for i in ins],
+        [(bytes(o[0]), o[1]) for o in outs],
+    )
+
+
+def encode_tx(ins, outs) -> bytes:
+    return cbor.encode([[list(i) for i in ins], [list(o) for o in outs]])
+
+
+@dataclass(frozen=True)
+class MockConfig:
+    ledger_view: LedgerView  # static pool distribution (mock stake)
+    stability_window: int  # forecast horizon (3k/f for Praos)
+    check_value_conservation: bool = True
+
+
+@dataclass(frozen=True)
+class MockState:
+    """UTxO map + tip slot. Immutable; apply returns a new state."""
+
+    utxo: Mapping[tuple[bytes, int], tuple[bytes, int]]
+    tip_slot_: int | None = None
+
+
+@dataclass(frozen=True)
+class TickedMockState:
+    state: MockState
+    slot: int
+
+
+class MockLedger:
+    """Ledger instance (ledger/abstract.py) for the mock UTxO rules."""
+
+    def __init__(self, config: MockConfig):
+        self.config = config
+
+    def genesis_state(self, initial_outputs) -> MockState:
+        """initial_outputs: list of (addr, amount) spendable as
+        (zero-txid, index)."""
+        utxo = {
+            (bytes(32), ix): (addr, amt)
+            for ix, (addr, amt) in enumerate(initial_outputs)
+        }
+        return MockState(utxo)
+
+    def tick(self, state: MockState, slot: int) -> TickedMockState:
+        return TickedMockState(state, slot)
+
+    def apply_tx(self, utxo: dict, tx_bytes: bytes) -> dict:
+        ins, outs = decode_tx(tx_bytes)
+        consumed = 0
+        for txin in ins:
+            if txin not in utxo:
+                raise MissingInput(txin)
+            consumed += utxo[txin][1]
+        produced = sum(a for _, a in outs)
+        if self.config.check_value_conservation and consumed != produced:
+            raise ValueNotConserved(consumed, produced)
+        tid = tx_id(tx_bytes)
+        for txin in ins:
+            del utxo[txin]
+        for ix, (addr, amt) in enumerate(outs):
+            utxo[(tid, ix)] = (addr, amt)
+        return utxo
+
+    def apply_block(self, ticked: TickedMockState, block) -> MockState:
+        utxo = dict(ticked.state.utxo)
+        for tx in block.txs:
+            utxo = self.apply_tx(utxo, tx)
+        return MockState(utxo, ticked.slot)
+
+    def reapply_block(self, ticked: TickedMockState, block) -> MockState:
+        """Previously validated: inputs are known-present; skip checks."""
+        utxo = dict(ticked.state.utxo)
+        for tx in block.txs:
+            ins, outs = decode_tx(tx)
+            tid = tx_id(tx)
+            for txin in ins:
+                utxo.pop(txin, None)
+            for ix, (addr, amt) in enumerate(outs):
+                utxo[(tid, ix)] = (addr, amt)
+        return MockState(utxo, ticked.slot)
+
+    def tip_slot(self, state: MockState) -> int | None:
+        return state.tip_slot_
+
+    def protocol_ledger_view(self, ticked: TickedMockState) -> LedgerView:
+        return self.config.ledger_view
+
+    def ledger_view_forecast_at(self, state: MockState) -> Forecast:
+        at = -1 if state.tip_slot_ is None else state.tip_slot_
+        return Forecast(
+            at=at,
+            max_for=at + 1 + self.config.stability_window,
+            view_fn=lambda s: self.config.ledger_view,
+        )
+
+    def tick_then_apply(self, state, block):
+        return self.apply_block(self.tick(state, block.slot), block)
+
+    def tick_then_reapply(self, state, block):
+        return self.reapply_block(self.tick(state, block.slot), block)

@@ -1,0 +1,1 @@
+"""Consensus protocols: abstract interface + Praos / BFT instances."""

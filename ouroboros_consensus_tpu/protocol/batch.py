@@ -1,0 +1,470 @@
+"""Batched Praos header validation — the TPU hot path.
+
+This is the architectural inversion at the heart of the framework: where
+the reference validates header-by-header inside a sequential fold
+(`ledgerDbPushMany` = repeatedlyM, LedgerDB/Update.hs:302; crypto at
+Praos.hs:441-606), we stage a columnar batch of header views (SoA) and run
+ALL the expensive work as one fused device program:
+
+  * Ed25519 verify of the OCert cold-key signature   (Praos.hs:580)
+  * CompactSum KES verify of the header body          (Praos.hs:582)
+  * ECVRF verify of the leader-election proof         (Praos.hs:543)
+  * beta == declared certified output                 (verifyCertified)
+  * leader-value range extension Blake2b("L" ‖ beta)  (Praos/VRF.hs:103)
+  * leader threshold compare                          (Praos.hs:551)
+  * nonce range extension Blake2b²("N" ‖ beta)        (Praos/VRF.hs:116)
+
+Only the cheap state-threading (ocert counter monotonicity, nonce fold —
+a NON-associative hash fold, so inherently sequential but ~1µs/header on
+host) remains outside the kernel. Verdicts come back as per-check bitmaps;
+the host locates the first failing chain position and reports the exact
+`PraosValidationError` the sequential reference implementation would have
+raised (re-deriving it with the host verifier for the error payload).
+
+Leader threshold on device: the rule p < 1 − (1−f)^σ compares a 256-bit
+hash against an irrational bound. Per (σ, f) — one per pool per epoch —
+the host brackets T = 2²⁵⁶·(1 − (1−f)^σ) by rationals [T_lo, T_hu] tight
+to ~2⁻⁴⁰ relative width (protocol/leader.py series bounds). The device
+does the big-endian compare against both brackets; the measure-zero band
+in between falls back to the exact host check (`leader_ambiguous` mask).
+
+Epoch segmentation (SURVEY.md §5.7): the epoch nonce and pool distribution
+are constant within an epoch, so a batch spans at most one epoch; the
+chain driver (storage/ledgerdb, tools/db_analyser) cuts batches at epoch
+boundaries and threads the tiny PraosState between them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from fractions import Fraction
+from functools import lru_cache
+from typing import Mapping, NamedTuple, Sequence
+
+import numpy as np
+from jax import numpy as jnp
+
+from ..ops import blake2b, ecvrf_batch, ed25519_batch, kes_batch
+from ..ops.host import kes as host_kes
+from . import leader, nonces, praos
+from .praos import PraosParams, PraosState, TickedPraosState
+from .views import HeaderView, LedgerView, hash_key, hash_vrf_vk
+
+# ---------------------------------------------------------------------------
+# Leader-threshold bracketing (host, cached per (sigma, f))
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=4096)
+def leader_threshold_bracket(sigma: Fraction, f: Fraction) -> tuple[int, int]:
+    """[T_lo, T_hi] integers bracketing 2^256 * (1 - (1-f)^sigma).
+
+    leader_value < T_lo  => certainly a leader;
+    leader_value >= T_hi => certainly not;
+    otherwise undecided (exact host check).  With 64 series terms the
+    bracket width is far below 1 for every realistic (sigma, f), so the
+    ambiguous band is empty in practice.
+    """
+    if f == 1:
+        return (leader.LEADER_VALUE_MAX, leader.LEADER_VALUE_MAX)
+    if sigma == 0:
+        return (0, 0)
+    llo, lhi = leader._neg_log1m_interval(f, 64)
+    elo, ehi = leader._exp_interval(sigma * llo, sigma * lhi, 64)
+    # lhs = 2^256/(2^256 - lv) < exp(x)  <=>  lv < 2^256 (1 - 1/exp(x))
+    t_lo = leader.LEADER_VALUE_MAX * (1 - Fraction(1) / elo)
+    t_hi = leader.LEADER_VALUE_MAX * (1 - Fraction(1) / ehi)
+    lo = int(t_lo)  # floor: lv < floor(T_lo) <= T_lo  => leader
+    hi = -int(-t_hi)  # ceil: lv >= ceil(T_hi) >= T_hi => not leader
+    return (lo, hi)
+
+
+# ---------------------------------------------------------------------------
+# SoA staging
+# ---------------------------------------------------------------------------
+
+
+class PraosBatch(NamedTuple):
+    """Device-ready columnar batch of Praos header-validation inputs."""
+
+    ed: ed25519_batch.Ed25519Batch  # OCert cold-key signature check
+    kes: kes_batch.KesBatch  # header-body KES signature check
+    vrf: ecvrf_batch.EcvrfBatch  # leader VRF proof check
+    beta: np.ndarray  # [B, 64] uint8 — declared certified VRF output
+    thr_lo: np.ndarray  # [B, 32] uint8 big-endian leader bound (certain win)
+    thr_hi: np.ndarray  # [B, 32] uint8 big-endian leader bound (certain loss)
+
+
+@dataclass(frozen=True)
+class HostChecks:
+    """Results of the cheap non-crypto checks.
+
+    Split into KES-side and VRF-side error arrays because the reference
+    interleaves them with the crypto verdicts in a strict order
+    (validateKESSignature COMPLETELY before validateVRFSignature,
+    Praos.hs:441-466) that `_lane_error` must reproduce.
+    """
+
+    # per-lane: None = pass, else the error the reference would raise
+    kes_window_errors: list  # KESBeforeStart / KESAfterEnd (Praos.hs:560-574)
+    vrf_lookup_errors: list  # VRFKeyUnknown / WrongVRFKey (Praos.hs:530-540)
+    kes_evolution: np.ndarray  # [B] int32 — t = kes_period - c0 (clamped 0)
+
+
+def host_prechecks(
+    params: PraosParams,
+    ledger_view: LedgerView,
+    hvs: Sequence[HeaderView],
+) -> HostChecks:
+    """The non-crypto parts of validateKESSignature/validateVRFSignature
+    (Praos.hs:558-574 window checks, :528-540 pool lookups), batch-wide.
+
+    OCert counter monotonicity (Praos.hs:585-590) is NOT here: it depends
+    on the evolving counter map and is checked in the sequential epilogue.
+    """
+    kes_errors: list = [None] * len(hvs)
+    vrf_errors: list = [None] * len(hvs)
+    evol = np.zeros((len(hvs),), np.int32)
+    for i, hv in enumerate(hvs):
+        c0 = hv.ocert.kes_period
+        kp = params.kes_period_of(hv.slot)
+        if not c0 <= kp:
+            kes_errors[i] = praos.KESBeforeStartOCERT(c0, kp)
+        elif not kp < c0 + params.max_kes_evolutions:
+            kes_errors[i] = praos.KESAfterEndOCERT(kp, c0, params.max_kes_evolutions)
+        else:
+            evol[i] = kp - c0
+        hk = hash_key(hv.vk_cold)
+        entry = ledger_view.pool_distr.get(hk)
+        if entry is None:
+            vrf_errors[i] = praos.VRFKeyUnknown(hk)
+        else:
+            header_vrf_hash = hash_vrf_vk(hv.vrf_vk)
+            if entry.vrf_key_hash != header_vrf_hash:
+                vrf_errors[i] = praos.VRFKeyWrongVRFKey(
+                    hk, entry.vrf_key_hash, header_vrf_hash
+                )
+    return HostChecks(kes_errors, vrf_errors, evol)
+
+
+def stage(
+    params: PraosParams,
+    ledger_view: LedgerView,
+    epoch_nonce: nonces.Nonce,
+    hvs: Sequence[HeaderView],
+    evolution: np.ndarray,
+) -> PraosBatch:
+    """Columnarize header views for the fused device kernel."""
+    b = len(hvs)
+    ed = ed25519_batch.stage_np(
+        [hv.vk_cold for hv in hvs],
+        [hv.ocert.sigma for hv in hvs],
+        [hv.ocert.signable() for hv in hvs],
+    )
+    kes = kes_batch.stage_np(
+        [hv.ocert.vk_hot for hv in hvs],
+        [int(t) for t in evolution],
+        [hv.signed_bytes for hv in hvs],
+        [hv.kes_sig for hv in hvs],
+        depth=params.kes_depth,
+    )
+    vrf = ecvrf_batch.stage_np(
+        [hv.vrf_vk for hv in hvs],
+        [hv.vrf_proof for hv in hvs],
+        [nonces.mk_input_vrf(hv.slot, epoch_nonce) for hv in hvs],
+    )
+    beta = np.zeros((b, 64), np.uint8)
+    thr_lo = np.zeros((b, 32), np.uint8)
+    thr_hi = np.zeros((b, 32), np.uint8)
+    f = params.active_slot_coeff
+    for i, hv in enumerate(hvs):
+        beta[i] = np.frombuffer(hv.vrf_output, np.uint8)
+        entry = ledger_view.pool_distr.get(hash_key(hv.vk_cold))
+        sigma = entry.stake if entry is not None else Fraction(0)
+        lo, hi = leader_threshold_bracket(Fraction(sigma), Fraction(f))
+        # clamp to the 256-bit compare domain: a threshold of 2^256 means
+        # "every value wins", encoded as all-0xFF + the hi-inclusive trick
+        thr_lo[i] = np.frombuffer(
+            min(lo, (1 << 256) - 1).to_bytes(32, "big"), np.uint8
+        )
+        thr_hi[i] = np.frombuffer(
+            min(hi, (1 << 256) - 1).to_bytes(32, "big"), np.uint8
+        )
+    return PraosBatch(ed, kes, vrf, beta, thr_lo, thr_hi)
+
+
+# ---------------------------------------------------------------------------
+# Fused device kernel
+# ---------------------------------------------------------------------------
+
+
+def _lt_be(a, b):
+    """Big-endian lexicographic a < b for [..., 32] int32 byte arrays."""
+    eq = a == b
+    # all_eq_before[i] = all(eq[:i])
+    all_eq_before = jnp.cumprod(
+        jnp.concatenate([jnp.ones_like(eq[..., :1]), eq[..., :-1]], axis=-1),
+        axis=-1,
+    ).astype(bool)
+    return jnp.any(all_eq_before & (a < b), axis=-1)
+
+
+class Verdicts(NamedTuple):
+    """Per-lane verdict bitmaps + derived values (device arrays)."""
+
+    ok_ocert_sig: jnp.ndarray  # [B] InvalidSignatureOCERT if False
+    ok_kes_sig: jnp.ndarray  # [B] InvalidKesSignatureOCERT if False
+    ok_vrf: jnp.ndarray  # [B] VRFKeyBadProof if False (proof or beta mismatch)
+    ok_leader: jnp.ndarray  # [B] VRFLeaderValueTooBig if False
+    leader_ambiguous: jnp.ndarray  # [B] host must decide exactly
+    eta: jnp.ndarray  # [B, 32] vrfNonceValue(beta) for the nonce fold
+    leader_value: jnp.ndarray  # [B, 32] big-endian Blake2b("L" ‖ beta)
+
+
+def verify_praos(
+    ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
+    kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+    kes_hblocks, kes_hnblocks,
+    vrf_pk, vrf_gamma, vrf_c, vrf_s, vrf_alpha,
+    beta_decl, thr_lo, thr_hi,
+) -> Verdicts:
+    """The fused Praos hot-path kernel. One jit, one device program.
+
+    XLA fuses the three verifier subgraphs and the Blake2b range
+    extensions; everything is batch-uniform control flow (mask lanes).
+    """
+    ok_ed = ed25519_batch.verify(ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks)
+    ok_kes = kes_batch.verify(
+        kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+        kes_hblocks, kes_hnblocks,
+    )
+    ok_proof, beta = ecvrf_batch.verify(vrf_pk, vrf_gamma, vrf_c, vrf_s, vrf_alpha)
+    beta_decl = jnp.asarray(beta_decl).astype(jnp.int32)
+    ok_vrf = ok_proof & jnp.all(beta == beta_decl, axis=-1)
+
+    # range extensions (Praos/VRF.hs:103,116) on the DECLARED beta: the
+    # reference computes them from the certified output, which ok_vrf
+    # guarantees equals the proof's beta
+    tag_l = jnp.broadcast_to(
+        jnp.asarray([ord("L")], jnp.int32), (*beta_decl.shape[:-1], 1)
+    )
+    lv = blake2b.blake2b_fixed(
+        jnp.concatenate([tag_l, beta_decl], axis=-1), 65, 32
+    )  # 32 bytes, big-endian natural (hash bytes ARE the BE encoding)
+    tag_n = jnp.broadcast_to(
+        jnp.asarray([ord("N")], jnp.int32), (*beta_decl.shape[:-1], 1)
+    )
+    eta1 = blake2b.blake2b_fixed(
+        jnp.concatenate([tag_n, beta_decl], axis=-1), 65, 32
+    )
+    eta = blake2b.blake2b_fixed(eta1, 32, 32)
+
+    thr_lo = jnp.asarray(thr_lo).astype(jnp.int32)
+    thr_hi = jnp.asarray(thr_hi).astype(jnp.int32)
+    certain_win = _lt_be(lv, thr_lo)
+    certain_loss = ~_lt_be(lv, thr_hi)
+    ok_leader = certain_win
+    ambiguous = ~certain_win & ~certain_loss
+    return Verdicts(ok_ed, ok_kes, ok_vrf, ok_leader, ambiguous, eta, lv)
+
+
+_JIT: dict = {}
+
+
+def run_batch(batch: PraosBatch) -> Verdicts:
+    """Stage -> device -> host verdict arrays (numpy)."""
+    import jax
+
+    key = (batch.kes.siblings.shape[-2],)
+    if key not in _JIT:
+        _JIT[key] = jax.jit(verify_praos)
+    out = _JIT[key](
+        *(jnp.asarray(x) for x in batch.ed),
+        *(jnp.asarray(x) for x in batch.kes),
+        *(jnp.asarray(x) for x in batch.vrf),
+        jnp.asarray(batch.beta),
+        jnp.asarray(batch.thr_lo),
+        jnp.asarray(batch.thr_hi),
+    )
+    return Verdicts(*(np.asarray(x) for x in out))
+
+
+# ---------------------------------------------------------------------------
+# Batched chain-position semantics (first failure + state fold)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BatchResult:
+    """Outcome of validating a within-epoch run of headers."""
+
+    state: PraosState  # state after the last VALID prefix header
+    n_valid: int  # length of the valid prefix
+    error: praos.PraosValidationError | None  # error at position n_valid
+    states: list | None = None  # per-position states (collect_states=True)
+
+
+def _lane_error(
+    params: PraosParams,
+    ledger_view: LedgerView,
+    epoch_nonce: nonces.Nonce,
+    hv: HeaderView,
+    pre: HostChecks,
+    v: Verdicts,
+    i: int,
+    counters: Mapping[bytes, int],
+) -> praos.PraosValidationError | None:
+    """Map verdict bitmaps back to the EXACT error the sequential
+    reference fold would raise, in its order: the whole of
+    validateKESSignature (window, OCert sig, KES sig, counters —
+    Praos.hs:558-606) before any of validateVRFSignature (pool lookup,
+    proof, leader threshold — Praos.hs:528-556)."""
+    if pre.kes_window_errors[i] is not None:
+        return pre.kes_window_errors[i]
+    if not v.ok_ocert_sig[i]:
+        return praos.InvalidSignatureOCERT(hv.ocert.counter, hv.ocert.kes_period)
+    if not v.ok_kes_sig[i]:
+        kp = params.kes_period_of(hv.slot)
+        c0 = hv.ocert.kes_period
+        return praos.InvalidKesSignatureOCERT(kp, c0, kp - c0)
+    # ocert counter monotonicity (Praos.hs:585-590), stateful
+    hk = hash_key(hv.vk_cold)
+    if hk in counters:
+        m = counters[hk]
+    elif hk in ledger_view.pool_distr:
+        m = 0
+    else:
+        return praos.NoCounterForKeyHashOCERT(hk)
+    n = hv.ocert.counter
+    if not m <= n:
+        return praos.CounterTooSmallOCERT(m, n)
+    if not n <= m + 1:
+        return praos.CounterOverIncrementedOCERT(m, n)
+    if pre.vrf_lookup_errors[i] is not None:
+        return pre.vrf_lookup_errors[i]
+    if not v.ok_vrf[i]:
+        return praos.VRFKeyBadProof(hv.slot, epoch_nonce)
+    lv_val = int.from_bytes(bytes(v.leader_value[i].astype(np.uint8)), "big")
+    entry = ledger_view.pool_distr.get(hk)
+    sigma = entry.stake if entry is not None else Fraction(0)
+    if v.leader_ambiguous[i]:
+        if not leader.check_leader_value(lv_val, sigma, params.active_slot_coeff):
+            return praos.VRFLeaderValueTooBig(
+                lv_val, sigma, params.active_slot_coeff
+            )
+        return None
+    if not v.ok_leader[i]:
+        return praos.VRFLeaderValueTooBig(lv_val, sigma, params.active_slot_coeff)
+    return None
+
+
+def validate_batch(
+    params: PraosParams,
+    ticked: TickedPraosState,
+    hvs: Sequence[HeaderView],
+    collect_states: bool = False,
+) -> BatchResult:
+    """Validate a within-epoch run of headers as one device batch.
+
+    Equivalent to folding `praos.update` over `hvs` from `ticked` — same
+    resulting state, same first error — but with all crypto executed as a
+    single fused device program. The epoch nonce must be constant across
+    the run (the caller segments at epoch boundaries; `tick` between
+    segments).
+    """
+    if not hvs:
+        return BatchResult(ticked.state, 0, None, [] if collect_states else None)
+    lview = ticked.ledger_view
+    eta0 = ticked.state.epoch_nonce
+
+    pre = host_prechecks(params, lview, hvs)
+    batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
+    v = run_batch(batch)
+
+    # sequential epilogue: counters + nonce fold, stop at first failure
+    st = ticked.state
+    counters = dict(st.ocert_counters)
+    evolving = st.evolving_nonce
+    candidate = st.candidate_nonce
+    lab = st.lab_nonce
+    last_slot = st.last_slot
+    states_out: list | None = [] if collect_states else None
+    for i, hv in enumerate(hvs):
+        err = _lane_error(params, lview, eta0, hv, pre, v, i, counters)
+        if err is not None:
+            state = PraosState(
+                last_slot=last_slot,
+                ocert_counters=counters,
+                evolving_nonce=evolving,
+                candidate_nonce=candidate,
+                epoch_nonce=st.epoch_nonce,
+                lab_nonce=lab,
+                last_epoch_block_nonce=st.last_epoch_block_nonce,
+            )
+            return BatchResult(state, i, err, states_out)
+        # reupdate bookkeeping (Praos.hs:468-502) with the device-computed
+        # eta (Blake2b² range extension)
+        eta = bytes(v.eta[i].astype(np.uint8))
+        evolving = nonces.combine(evolving, eta)
+        slot = hv.slot
+        first_next = params.first_slot_of(params.epoch_of(slot) + 1)
+        if slot + params.stability_window < first_next:
+            candidate = evolving
+        lab = nonces.prev_hash_to_nonce(hv.prev_hash)
+        counters[hash_key(hv.vk_cold)] = hv.ocert.counter
+        last_slot = slot
+        if states_out is not None:
+            states_out.append(
+                PraosState(
+                    last_slot=last_slot,
+                    ocert_counters=dict(counters),
+                    evolving_nonce=evolving,
+                    candidate_nonce=candidate,
+                    epoch_nonce=st.epoch_nonce,
+                    lab_nonce=lab,
+                    last_epoch_block_nonce=st.last_epoch_block_nonce,
+                )
+            )
+
+    state = PraosState(
+        last_slot=last_slot,
+        ocert_counters=counters,
+        evolving_nonce=evolving,
+        candidate_nonce=candidate,
+        epoch_nonce=st.epoch_nonce,
+        lab_nonce=lab,
+        last_epoch_block_nonce=st.last_epoch_block_nonce,
+    )
+    return BatchResult(state, len(hvs), None, states_out)
+
+
+def validate_chain(
+    params: PraosParams,
+    ledger_view_for_epoch,
+    state: PraosState,
+    hvs: Sequence[HeaderView],
+    max_batch: int = 8192,
+) -> BatchResult:
+    """Validate an arbitrary run of headers, segmenting at epoch
+    boundaries (and at `max_batch` within an epoch) per SURVEY.md §5.7.
+
+    `ledger_view_for_epoch(epoch) -> LedgerView` supplies the forecastable
+    per-epoch pool distribution (constant within an epoch).
+    """
+    total_valid = 0
+    i = 0
+    n = len(hvs)
+    while i < n:
+        epoch = params.epoch_of(hvs[i].slot)
+        j = i
+        while j < n and params.epoch_of(hvs[j].slot) == epoch and j - i < max_batch:
+            j += 1
+        lview = ledger_view_for_epoch(epoch)
+        ticked = praos.tick(params, lview, hvs[i].slot, state)
+        res = validate_batch(params, ticked, hvs[i:j])
+        state = res.state
+        total_valid += res.n_valid
+        if res.error is not None:
+            return BatchResult(state, total_valid, res.error)
+        i = j
+    return BatchResult(state, total_valid, None)

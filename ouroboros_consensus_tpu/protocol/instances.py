@@ -1,0 +1,137 @@
+"""Concrete ConsensusProtocol instances.
+
+* `PraosProtocol` — the flagship: host semantics from protocol/praos.py,
+  batched device crypto from protocol/batch.py (reference instance:
+  Praos.hs:364).
+* `BftProtocol` — trivial round-robin BFT for tests (Protocol/BFT.hs):
+  slot s must be signed by node (s mod n); one Ed25519 verify, no state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+from ..ops.host import ed25519 as host_ed25519
+from . import batch as pbatch
+from . import praos, select
+from .abstract import ConsensusError
+from .praos import PraosParams, PraosState, TickedPraosState
+
+
+class PraosProtocol:
+    """ConsensusProtocol (Praos c) — instance-as-object over praos.py."""
+
+    def __init__(
+        self,
+        params: PraosParams,
+        crypto: praos.CryptoVerifier = praos.HOST_VERIFIER,
+        use_device_batch: bool = True,
+    ):
+        self.params = params
+        self.crypto = crypto
+        self.security_param = params.security_param
+        # False routes LedgerDB/ChainSel through the sequential host fold
+        # (useful for tests that should not pay kernel compilation)
+        self.use_device_batch = use_device_batch
+
+    def initial_state(self) -> PraosState:
+        return PraosState()
+
+    def tick(self, ledger_view, slot, state) -> TickedPraosState:
+        return praos.tick(self.params, ledger_view, slot, state)
+
+    def update(self, view, slot, ticked) -> PraosState:
+        return praos.update(self.params, view, slot, ticked, self.crypto)
+
+    def reupdate(self, view, slot, ticked) -> PraosState:
+        return praos.reupdate(self.params, view, slot, ticked)
+
+    def check_is_leader(self, can_be_leader, slot, ticked):
+        return praos.check_is_leader(self.params, can_be_leader, slot, ticked)
+
+    def select_view(self, header) -> select.PraosSelectView:
+        return select.PraosSelectView.from_header(header)
+
+    def compare_candidates(self, ours, theirs) -> int:
+        return select.compare_select_views(ours, theirs)
+
+    def validate_batch(
+        self, ticked, views: Sequence, collect_states: bool = False
+    ) -> pbatch.BatchResult:
+        """Batched fold of `update` with fused device crypto."""
+        return pbatch.validate_batch(self.params, ticked, views, collect_states)
+
+
+# ---------------------------------------------------------------------------
+# BFT (Protocol/BFT.hs): round-robin signing for tests
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BftInvalidSignature(ConsensusError):
+    slot: int
+
+
+@dataclass
+class BftWrongLeader(ConsensusError):
+    slot: int
+    expected_node: int
+
+
+@dataclass(frozen=True)
+class BftState:
+    """BFT has no interesting chain-dep state (reference: ())."""
+
+    last_slot: int | None = None
+
+
+@dataclass(frozen=True)
+class TickedBftState:
+    state: BftState
+
+
+@dataclass(frozen=True)
+class BftView:
+    """ValidateView: the signed bytes + signature + claimed node id."""
+
+    node_id: int
+    signed_bytes: bytes
+    signature: bytes
+
+
+class BftProtocol:
+    """Round-robin: slot s is led by node (s mod num_nodes)."""
+
+    def __init__(self, num_nodes: int, verification_keys: Sequence[bytes], security_param: int = 2160):
+        self.num_nodes = num_nodes
+        self.vks = list(verification_keys)
+        self.security_param = security_param
+
+    def initial_state(self) -> BftState:
+        return BftState()
+
+    def tick(self, ledger_view, slot, state) -> TickedBftState:
+        return TickedBftState(state)
+
+    def update(self, view: BftView, slot, ticked) -> BftState:
+        expected = slot % self.num_nodes
+        if view.node_id != expected:
+            raise BftWrongLeader(slot, expected)
+        if not host_ed25519.verify(self.vks[expected], view.signed_bytes, view.signature):
+            raise BftInvalidSignature(slot)
+        return BftState(slot)
+
+    def reupdate(self, view, slot, ticked) -> BftState:
+        return BftState(slot)
+
+    def check_is_leader(self, node_id: int, slot, ticked):
+        return node_id if slot % self.num_nodes == node_id else None
+
+    def select_view(self, header):
+        return header.block_no
+
+    def compare_candidates(self, ours, theirs) -> int:
+        o = -1 if ours is None else ours
+        t = -1 if theirs is None else theirs
+        return (t > o) - (t < o)

@@ -1,0 +1,6 @@
+"""Storage engine: ImmutableDB / VolatileDB / LedgerDB / ChainDB + ChainSel."""
+
+from .chaindb import AddBlockResult, ChainDB, Follower
+from .immutable import ImmutableDB
+from .ledgerdb import InvalidBlock, LedgerDB
+from .volatile import VolatileDB

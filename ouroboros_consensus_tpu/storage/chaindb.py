@@ -1,0 +1,413 @@
+"""ChainDB: the unified chain store + chain selection.
+
+Reference: `Ouroboros.Consensus.Storage.ChainDB` — the `ChainDB` record
+(API.hs:117) facading ImmutableDB + VolatileDB + LedgerDB, and ChainSel
+(Impl/ChainSel.hs, 1,305 LoC), the consensus decision engine:
+
+  * `add_block` (addBlockSync, ChainSel.hs:256): store in VolatileDB,
+    then `chainSelectionForBlock` (:440) — construct maximal candidate
+    fragments through the volatile successor graph (Paths.hs:65
+    maximalCandidates / isReachable :372), order them by SelectView
+    (chainSelection :874), validate the best (ledgerValidateCandidate
+    :1053 → LedgerDB switch), and install the winner.
+  * followers (Impl/Follower.hs) — push-style chain-update consumers
+    feeding the ChainSync server.
+  * background copy: blocks > k deep migrate VolatileDB → ImmutableDB
+    with a LedgerDB snapshot (Impl/Background.hs copyAndSnapshotRunner);
+    VolatileDB GC after copy.
+  * invalid-block set (getIsInvalidBlock, API.hs:331) so peers serving
+    known-bad blocks are punished once, not revalidated.
+
+The batched inversion: candidate suffix validation goes through
+`LedgerDB.push_many`, which ships the headers' crypto to the device as one
+fused batch instead of per-block calls.
+
+Concurrency: the reference serializes chain selection through an STM
+queue + single background thread (cdbBlocksToAdd, ChainSel.hs:217-246);
+here `add_block` IS the serialization point (called from the node's
+single-threaded event loop; utils/sim for deterministic tests).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Sequence
+
+from ..block.abstract import Point
+from ..block.praos_block import Block
+from ..ledger.extended import ExtLedger, ExtLedgerState
+from .immutable import ImmutableDB
+from .ledgerdb import InvalidBlock, LedgerDB
+from .volatile import VolatileDB
+
+
+@dataclass
+class AddBlockResult:
+    added: bool
+    new_tip: Point | None  # tip after (possibly unchanged)
+    selected: bool  # did the chain change?
+
+
+class Follower:
+    """A push-style consumer of chain updates (Impl/Follower.hs): the
+    ChainSync server reads (rollback, new_blocks) instructions."""
+
+    def __init__(self, db: "ChainDB"):
+        self.db = db
+        self.updates: list = []  # ("rollback", Point|None) | ("addblock", Block)
+
+    def _notify_switch(self, rollback_to: Point | None, new_blocks: Sequence[Block]):
+        if rollback_to is not None or new_blocks:
+            if rollback_to is not None:
+                self.updates.append(("rollback", rollback_to))
+            for b in new_blocks:
+                self.updates.append(("addblock", b))
+
+    def take_updates(self) -> list:
+        out, self.updates = self.updates, []
+        return out
+
+
+class ChainDB:
+    """The facade. `current_chain` is the volatile fragment (≤ k blocks,
+    newest last); older blocks live in the ImmutableDB."""
+
+    def __init__(
+        self,
+        ext: ExtLedger,
+        immutable: ImmutableDB,
+        volatile: VolatileDB,
+        ledgerdb: LedgerDB,
+        k: int,
+        snap_dir: str | None = None,
+        snapshot_interval: int = 100,
+        trace: Callable[[str], None] = lambda s: None,
+    ):
+        self.ext = ext
+        self.immutable = immutable
+        self.volatile = volatile
+        self.ledgerdb = ledgerdb
+        self.k = k
+        self.snap_dir = snap_dir
+        # DiskPolicy analog (DiskPolicy.hs:87): snapshot every N blocks
+        # copied to the immutable tier, not on every adoption
+        self.snapshot_interval = snapshot_interval
+        self._copied_since_snapshot = 0
+        self.trace = trace
+        self.current_chain: list[Block] = []  # volatile fragment, ≤ k
+        self.invalid: dict[bytes, Exception] = {}  # hash -> reason
+        self.followers: list[Follower] = []
+        self._init_chain_selection()
+
+    # -- initial chain selection (ChainSel.hs:96) ----------------------------
+
+    def _init_chain_selection(self) -> None:
+        """Find the best chain through the volatile graph extending the
+        immutable tip; validates via LedgerDB."""
+        self.current_chain = []
+        best = self._best_candidate_from(self._anchor_point(), [])
+        if best:
+            self._try_adopt(0, best)
+
+    def _anchor_point(self) -> Point | None:
+        return self.immutable.tip_point()
+
+    # -- queries (API.hs) ----------------------------------------------------
+
+    def tip_point(self) -> Point | None:
+        if self.current_chain:
+            return self.current_chain[-1].point
+        return self._anchor_point()
+
+    def tip_header(self):
+        return self.current_chain[-1].header if self.current_chain else None
+
+    def tip_block_no(self) -> int | None:
+        if self.current_chain:
+            return self.current_chain[-1].block_no
+        t = self.immutable.tip()
+        return None if t is None else t.block_no
+
+    def current_ledger(self) -> ExtLedgerState:
+        return self.ledgerdb.current()
+
+    def get_past_ledger(self, point: Point | None) -> ExtLedgerState | None:
+        return self.ledgerdb.past_state(point)
+
+    def get_is_invalid_block(self, hash_: bytes) -> Exception | None:
+        return self.invalid.get(hash_)
+
+    def get_block(self, point: Point) -> Block | None:
+        raw = self.volatile.get_block_bytes(point.hash_)
+        if raw is None:
+            try:
+                raw = self.immutable.get_block_bytes(point)
+            except Exception:
+                return None
+        return Block.from_bytes(raw)
+
+    def new_follower(self) -> Follower:
+        f = Follower(self)
+        self.followers.append(f)
+        return f
+
+    def stream_all(self) -> Iterable[Block]:
+        """Iterator over the whole current chain, immutable part first."""
+        for entry, raw in self.immutable.stream_all():
+            yield Block.from_bytes(raw)
+        yield from self.current_chain
+
+    # -- candidates (Impl/Paths.hs) ------------------------------------------
+
+    def _candidates_through(
+        self, anchor: Point | None, via: bytes | None = None
+    ) -> list[list[bytes]]:
+        """maximalCandidates (Paths.hs:65): maximal hash-paths in the
+        volatile successor graph rooted at `anchor`. With `via`, only the
+        paths passing through that block (isReachable, Paths.hs:372):
+        walk prev-hashes backwards from `via` to the anchor, then extend
+        forward — O(depth + subtree) instead of the whole graph.
+
+        Iterative DFS: volatile paths reach k blocks (2160 mainnet),
+        beyond Python's recursion limit.
+        """
+        root = None if anchor is None else anchor.hash_
+
+        if via is not None:
+            back: list[bytes] = []
+            h = via
+            while True:
+                info = self.volatile.get_block_info(h)
+                if info is None or h in self.invalid:
+                    return []  # not connected (yet) or known bad
+                back.append(h)
+                if info.prev_hash == root:
+                    break
+                h = info.prev_hash
+                if h is None:
+                    return []  # hit genesis without meeting the anchor
+            prefix = list(reversed(back))
+            return [prefix[:-1] + tail for tail in self._forward_paths(via)]
+
+        out: list[list[bytes]] = []
+        # stack of (hash, path-so-far); paths share list copies only on fork
+        stack: list[tuple[bytes | None, list[bytes]]] = [(root, [])]
+        while stack:
+            h, acc = stack.pop()
+            succs = [
+                s
+                for s in self.volatile.filter_by_predecessor(h)
+                if s not in self.invalid
+            ]
+            if not succs:
+                if acc:
+                    out.append(acc)
+                continue
+            for s in succs:
+                stack.append((s, acc + [s]))
+        return out
+
+    def _forward_paths(self, start: bytes) -> list[list[bytes]]:
+        """All maximal paths beginning AT `start` (inclusive)."""
+        out: list[list[bytes]] = []
+        stack: list[tuple[bytes, list[bytes]]] = [(start, [start])]
+        while stack:
+            h, acc = stack.pop()
+            succs = [
+                s
+                for s in self.volatile.filter_by_predecessor(h)
+                if s not in self.invalid
+            ]
+            if not succs:
+                out.append(acc)
+                continue
+            for s in succs:
+                stack.append((s, acc + [s]))
+        return out
+
+    def _load_fragment(self, hashes: list[bytes]) -> list[Block] | None:
+        blocks = []
+        for h in hashes:
+            raw = self.volatile.get_block_bytes(h)
+            if raw is None:
+                return None
+            blocks.append(Block.from_bytes(raw))
+        return blocks
+
+    def _best_candidate_from(
+        self,
+        anchor: Point | None,
+        exclude: Sequence[Sequence[bytes]],
+        via: bytes | None = None,
+    ) -> list[Block] | None:
+        """Best UNVALIDATED candidate by SelectView ordering; `exclude`
+        lists hash-fragments already rejected this round."""
+        cands = [
+            c for c in self._candidates_through(anchor, via)
+            if not any(list(c) == list(e) for e in exclude)
+        ]
+        if not cands:
+            return None
+        proto = self.ext.protocol
+
+        def view_of(c):
+            blocks = self._load_fragment(c)
+            if blocks is None:
+                return None
+            return (blocks, proto.select_view(blocks[-1].header))
+
+        best = None
+        for c in cands:
+            bv = view_of(c)
+            if bv is None:
+                continue
+            if best is None or proto.compare_candidates(best[1], bv[1]) > 0:
+                best = bv
+        return best[0] if best else None
+
+    # -- chain selection for a new block (ChainSel.hs:440) -------------------
+
+    def add_block(self, block: Block) -> AddBlockResult:
+        """addBlockSync: store, then run chain selection."""
+        if block.hash_ in self.invalid:
+            return AddBlockResult(False, self.tip_point(), False)
+        # olderThanK (ChainSel.hs:359): blocks at or before the immutable
+        # tip slot can never be adopted
+        imm = self.immutable.tip()
+        if imm is not None and block.slot <= imm.slot:
+            return AddBlockResult(False, self.tip_point(), False)
+        self.volatile.put_block(block)
+        selected = self._chain_selection_for_block(block)
+        return AddBlockResult(True, self.tip_point(), selected)
+
+    def _current_select_view(self):
+        proto = self.ext.protocol
+        if self.current_chain:
+            return proto.select_view(self.current_chain[-1].header)
+        return None
+
+    def _chain_selection_for_block(self, block: Block) -> bool:
+        """chainSelectionForBlock: consider candidates containing `block`;
+        loop validate-best / truncate-rejected (chainSelection :874)."""
+        proto = self.ext.protocol
+        anchor = self._anchor_point()
+        rejected: list[list[bytes]] = []
+        while True:
+            cur_view = self._current_select_view()
+            cand = self._best_candidate_from(anchor, rejected, via=block.hash_)
+            if cand is None:
+                return False
+            cand_view = proto.select_view(cand[-1].header)
+            # preferCandidate: only strictly better chains are adopted
+            if proto.compare_candidates(cur_view, cand_view) <= 0:
+                return False
+            n_rollback, suffix = self._diff_against_current(cand)
+            ok = self._try_adopt(n_rollback, suffix, full_candidate=cand)
+            if ok:
+                return True
+            rejected.append([b.hash_ for b in cand])
+
+    def _diff_against_current(self, cand: list[Block]):
+        """ChainDiff (Fragment/Diff.hs): longest common prefix with the
+        current chain -> (rollback count, new suffix)."""
+        i = 0
+        while (
+            i < len(cand)
+            and i < len(self.current_chain)
+            and cand[i].hash_ == self.current_chain[i].hash_
+        ):
+            i += 1
+        return len(self.current_chain) - i, cand[i:]
+
+    def _try_adopt(
+        self, n_rollback: int, suffix: list[Block], full_candidate: list[Block] | None = None
+    ) -> bool:
+        """ledgerValidateCandidate (:1053): LedgerDB switch validates the
+        suffix (batched header crypto). On invalid blocks, mark + truncate
+        and adopt the valid prefix if it still beats the current chain
+        (the truncate-rejected loop)."""
+        if not suffix and n_rollback == 0:
+            return False
+        n_before = self.ledgerdb.volatile_length()
+        try:
+            if not self.ledgerdb.switch(n_rollback, suffix):
+                # rollback deeper than the LedgerDB holds (> k): the
+                # candidate forks before our immutability window — reject
+                self.trace(f"rollback {n_rollback} beyond LedgerDB window")
+                return False
+        except InvalidBlock as e:
+            self.invalid[e.point.hash_] = e.reason
+            self.trace(f"invalid block at {e.point}: {type(e.reason).__name__}")
+            # LedgerDB has adopted the valid prefix's states already;
+            # roll its extra states back to match a prefix decision
+            n_valid = next(
+                (i for i, b in enumerate(suffix) if b.point == e.point),
+                len(suffix),
+            )
+            prefix = suffix[:n_valid]
+            if prefix:
+                proto = self.ext.protocol
+                cur_view = self._current_select_view()
+                pref_view = proto.select_view(prefix[-1].header)
+                if proto.compare_candidates(cur_view, pref_view) > 0:
+                    self._install(n_rollback, prefix)
+                    return True
+            # restore: rollback the states LedgerDB pushed for the prefix
+            pushed = self.ledgerdb.volatile_length() - (n_before - n_rollback)
+            if pushed > 0:
+                self.ledgerdb.rollback(pushed)
+            # and re-push the states for the blocks we rolled back earlier
+            if n_rollback > 0:
+                restore = self.current_chain[len(self.current_chain) - n_rollback :]
+                self.ledgerdb.push_many(restore, apply=False)
+            return False
+        self._install(n_rollback, suffix)
+        return True
+
+    def _install(self, n_rollback: int, suffix: list[Block]) -> None:
+        """switchTo (ChainSel.hs:703): swap the fragment, notify
+        followers, run the copy/GC/snapshot background step."""
+        if n_rollback:
+            rollback_point = (
+                self.current_chain[len(self.current_chain) - n_rollback - 1].point
+                if n_rollback < len(self.current_chain)
+                else self._anchor_point()
+            )
+            self.current_chain = self.current_chain[: len(self.current_chain) - n_rollback]
+        else:
+            rollback_point = None
+        self.current_chain.extend(suffix)
+        for f in self.followers:
+            f._notify_switch(rollback_point, suffix)
+        self._copy_and_gc()
+
+    def close(self) -> None:
+        """Clean shutdown: final ledger snapshot + index flush, so the
+        next open resumes from the tip without a long replay."""
+        if self.snap_dir is not None:
+            self.ledgerdb.take_snapshot(self.snap_dir)
+        self.immutable.flush()
+
+    # -- background (Impl/Background.hs) -------------------------------------
+
+    def _copy_and_gc(self) -> None:
+        """copyAndSnapshotRunner: move blocks > k deep to the ImmutableDB,
+        snapshot the ledger anchor, GC the VolatileDB."""
+        excess = len(self.current_chain) - self.k
+        if excess <= 0:
+            return
+        to_copy, self.current_chain = (
+            self.current_chain[:excess],
+            self.current_chain[excess:],
+        )
+        for b in to_copy:
+            self.immutable.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+        self._copied_since_snapshot += len(to_copy)
+        if (
+            self.snap_dir is not None
+            and self._copied_since_snapshot >= self.snapshot_interval
+        ):
+            self.ledgerdb.take_snapshot(self.snap_dir)
+            self._copied_since_snapshot = 0
+        gc_slot = to_copy[-1].slot + 1
+        self.volatile.garbage_collect(gc_slot)

@@ -1,0 +1,333 @@
+"""ImmutableDB: append-only chunked store of the immutable chain.
+
+Reference: `Ouroboros.Consensus.Storage.ImmutableDB` (15 files, ~4.9k LoC)
+— `NNNNN.chunk` files of concatenated block bytes plus two indices: a
+primary index of fixed-width offsets per relative slot
+(Impl/Index/Primary.hs:96) and a secondary index of per-block entries with
+CRCs (Impl/Index/Secondary.hs). This implementation keeps the same
+on-disk shape with one combined index file per chunk:
+
+    NNNNN.chunk      block bytes, concatenated
+    NNNNN.index      CBOR [[slot, block_no, hash, offset, size, crc32], …]
+
+Startup validation (Impl/Validation.hs:67) reparses the last chunk (or all
+chunks under `validate_all`), checks CRCs and hashes, optionally runs the
+`check_integrity` hook (body hash + KES — batched on device by the
+caller), and TRUNCATES the corrupted tail rather than failing.
+
+Iterators stream blocks in slot order across chunk boundaries
+(Impl/Iterator.hs). Appends go through an in-memory tail buffer flushed
+per block — the OS page cache does the batching; `fsync` on chunk close.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Iterator
+
+from ..block.abstract import Point
+from ..utils import cbor
+
+
+class ImmutableDBError(Exception):
+    pass
+
+
+class MissingBlock(ImmutableDBError):
+    pass
+
+
+@dataclass(frozen=True)
+class IndexEntry:
+    slot: int
+    block_no: int
+    hash_: bytes
+    offset: int
+    size: int
+    crc32: int
+
+    def to_cbor_obj(self):
+        return [self.slot, self.block_no, self.hash_, self.offset, self.size, self.crc32]
+
+    @classmethod
+    def from_cbor_obj(cls, o):
+        return cls(o[0], o[1], bytes(o[2]), o[3], o[4], o[5])
+
+
+def _chunk_name(n: int) -> str:
+    return f"{n:05d}.chunk"
+
+
+def _index_name(n: int) -> str:
+    return f"{n:05d}.index"
+
+
+class ImmutableDB:
+    """Append-only block store; blocks arrive in strictly increasing slot
+    order (the chain ≥ k deep is immutable — ChainDB background copy).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        chunk_size: int = 21600,  # slots per chunk (reference: epoch-ish)
+        check_integrity: Callable[[bytes], bool] | None = None,
+        validate_all: bool = False,
+    ):
+        self.path = path
+        self.chunk_size = chunk_size
+        os.makedirs(path, exist_ok=True)
+        self._entries: dict[int, list[IndexEntry]] = {}  # chunk -> entries
+        self._chunks: list[int] = []
+        self._truncated: dict[int, bool] = {}
+        self._validate(check_integrity, validate_all)
+
+    # -- startup validation --------------------------------------------------
+
+    def _chunk_numbers(self) -> list[int]:
+        ns = []
+        for f in os.listdir(self.path):
+            if f.endswith(".chunk"):
+                ns.append(int(f.split(".")[0]))
+        return sorted(ns)
+
+    def _validate(self, check_integrity, validate_all: bool) -> None:
+        """Load indices; reparse + CRC-check the last chunk (or all); on
+        mismatch truncate the tail from the first bad block onward."""
+        chunks = self._chunk_numbers()
+        for i, n in enumerate(chunks):
+            deep = validate_all or i == len(chunks) - 1
+            entries = self._load_chunk(n, deep, check_integrity)
+            if entries is None:  # wholly corrupt chunk: drop it and the rest
+                for m in chunks[i:]:
+                    self._remove_chunk(m)
+                break
+            self._entries[n] = entries
+            self._chunks.append(n)
+            if deep and self._truncated.get(n):
+                # tail truncated inside this chunk: later chunks are invalid
+                for m in chunks[i + 1 :]:
+                    self._remove_chunk(m)
+                break
+
+    def _load_chunk(self, n: int, deep: bool, check_integrity):
+        ipath = os.path.join(self.path, _index_name(n))
+        cpath = os.path.join(self.path, _chunk_name(n))
+        entries = self._load_index(ipath)
+        if entries is None:
+            # index missing/corrupt (e.g. crash before flush): rebuild it
+            # from the chunk data — blocks are self-delimiting CBOR
+            entries = self._reparse_chunk(n, check_integrity)
+            return entries
+        # deferred index writes mean the on-disk index can LAG the chunk
+        # data after a crash: reparse any bytes past the indexed end
+        end = entries[-1].offset + entries[-1].size if entries else 0
+        try:
+            fsize = os.path.getsize(cpath)
+        except OSError:
+            return None
+        if fsize > end:
+            entries = self._reparse_chunk(n, check_integrity)
+            return entries
+        if deep:
+            # reparse against the index, truncating at the first corruption
+            try:
+                with open(cpath, "rb") as f:
+                    data = f.read()
+            except OSError:
+                return None
+            good = []
+            for e in entries:
+                blob = data[e.offset : e.offset + e.size]
+                if len(blob) != e.size or zlib.crc32(blob) != e.crc32:
+                    self._truncated[n] = True
+                    break
+                if check_integrity is not None and not check_integrity(blob):
+                    self._truncated[n] = True
+                    break
+                good.append(e)
+            entries = good
+            if self._truncated.get(n):
+                self._rewrite_chunk(n, data, entries)
+        return entries
+
+    def _reparse_chunk(self, n: int, check_integrity):
+        """Walk self-delimiting CBOR blocks in the chunk file, rebuilding
+        index entries; truncate at the first unparseable/bad block."""
+        from ..block.praos_block import Block
+
+        cpath = os.path.join(self.path, _chunk_name(n))
+        try:
+            with open(cpath, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        entries: list[IndexEntry] = []
+        off = 0
+        while off < len(data):
+            try:
+                _, end = cbor.decode_prefix(data, off)
+                blob = data[off:end]
+                blk = Block.from_bytes(blob)
+            except Exception:
+                self._truncated[n] = True
+                break
+            if check_integrity is not None and not check_integrity(blob):
+                self._truncated[n] = True
+                break
+            entries.append(
+                IndexEntry(
+                    blk.slot, blk.block_no, blk.hash_, off, len(blob), zlib.crc32(blob)
+                )
+            )
+            off = end
+        if self._truncated.get(n):
+            self._rewrite_chunk(n, data, entries)
+        else:
+            self._write_index(n, entries)
+        return entries
+
+    def _rewrite_chunk(self, n: int, data: bytes, entries: list[IndexEntry]):
+        end = entries[-1].offset + entries[-1].size if entries else 0
+        with open(os.path.join(self.path, _chunk_name(n)), "wb") as f:
+            f.write(data[:end])
+        self._write_index(n, entries)
+
+    def _remove_chunk(self, n: int):
+        for name in (_chunk_name(n), _index_name(n)):
+            p = os.path.join(self.path, name)
+            if os.path.exists(p):
+                os.remove(p)
+
+    @staticmethod
+    def _load_index(ipath: str) -> list[IndexEntry] | None:
+        """Index file = concatenated CBOR entry arrays (append-only, like
+        the reference's secondary index). A torn final entry (crash
+        mid-append) just ends the list — the fsize-lag check reparses."""
+        try:
+            with open(ipath, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        entries: list[IndexEntry] = []
+        off = 0
+        while off < len(data):
+            try:
+                obj, off = cbor.decode_prefix(data, off)
+                entries.append(IndexEntry.from_cbor_obj(obj))
+            except Exception:
+                break
+        return entries
+
+    def _write_index(self, n: int, entries: list[IndexEntry]):
+        tmp = os.path.join(self.path, _index_name(n) + ".tmp")
+        with open(tmp, "wb") as f:
+            for e in entries:
+                f.write(cbor.encode(e.to_cbor_obj()))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.path, _index_name(n)))
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def is_empty(self) -> bool:
+        return not any(self._entries.values())
+
+    def tip(self) -> IndexEntry | None:
+        for n in reversed(self._chunks):
+            if self._entries[n]:
+                return self._entries[n][-1]
+        return None
+
+    def tip_point(self) -> Point | None:
+        t = self.tip()
+        return None if t is None else Point(t.slot, t.hash_)
+
+    def n_blocks(self) -> int:
+        return sum(len(v) for v in self._entries.values())
+
+    # -- appending -----------------------------------------------------------
+
+    def append_block(self, slot: int, block_no: int, hash_: bytes, raw: bytes) -> None:
+        t = self.tip()
+        if t is not None and slot <= t.slot:
+            raise ImmutableDBError(f"append out of order: {slot} <= {t.slot}")
+        n = slot // self.chunk_size
+        if n not in self._entries:
+            self._entries[n] = []
+            self._chunks.append(n)
+            self._chunks.sort()
+        cpath = os.path.join(self.path, _chunk_name(n))
+        offset = os.path.getsize(cpath) if os.path.exists(cpath) else 0
+        with open(cpath, "ab") as f:
+            f.write(raw)
+        e = IndexEntry(slot, block_no, hash_, offset, len(raw), zlib.crc32(raw))
+        self._entries[n].append(e)
+        # O(1) append-only index write (no fsync: startup validation
+        # recovers from torn tails); CRC lives in the entry
+        with open(os.path.join(self.path, _index_name(n)), "ab") as f:
+            f.write(cbor.encode(e.to_cbor_obj()))
+
+    def flush(self) -> None:
+        """fsync chunk + index data of the newest chunk (clean shutdown)."""
+        if not self._chunks:
+            return
+        n = self._chunks[-1]
+        for name in (_chunk_name(n), _index_name(n)):
+            p = os.path.join(self.path, name)
+            if os.path.exists(p):
+                fd = os.open(p, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+
+    # -- reading -------------------------------------------------------------
+
+    def _read(self, n: int, e: IndexEntry) -> bytes:
+        with open(os.path.join(self.path, _chunk_name(n)), "rb") as f:
+            f.seek(e.offset)
+            return f.read(e.size)
+
+    def get_block_bytes(self, point: Point) -> bytes:
+        n = point.slot // self.chunk_size
+        for e in self._entries.get(n, ()):
+            if e.slot == point.slot and e.hash_ == point.hash_:
+                return self._read(n, e)
+        raise MissingBlock(point)
+
+    def stream_all(self) -> Iterator[tuple[IndexEntry, bytes]]:
+        """Stream every block in slot order (db-analyser processAll)."""
+        for n in self._chunks:
+            entries = self._entries[n]
+            if not entries:
+                continue
+            with open(os.path.join(self.path, _chunk_name(n)), "rb") as f:
+                data = f.read()
+            for e in entries:
+                yield e, data[e.offset : e.offset + e.size]
+
+    def stream_from(self, after_slot: int) -> Iterator[tuple[IndexEntry, bytes]]:
+        for e, raw in self.stream_all():
+            if e.slot > after_slot:
+                yield e, raw
+
+    def truncate_after(self, point: Point | None) -> None:
+        """db-truncater (Tools/DBTruncater/Run.hs): drop everything after
+        `point` (None = wipe)."""
+        keep_through = -1 if point is None else point.slot
+        for n in list(self._chunks):
+            entries = [e for e in self._entries[n] if e.slot <= keep_through]
+            if len(entries) != len(self._entries[n]):
+                if entries:
+                    with open(os.path.join(self.path, _chunk_name(n)), "rb") as f:
+                        data = f.read()
+                    self._entries[n] = entries
+                    self._rewrite_chunk(n, data, entries)
+                else:
+                    self._remove_chunk(n)
+                    self._entries.pop(n, None)
+                    self._chunks.remove(n)

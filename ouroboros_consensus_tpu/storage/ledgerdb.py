@@ -1,0 +1,245 @@
+"""LedgerDB: last-k ledger-state checkpoints + on-disk snapshots.
+
+Reference: `Ouroboros.Consensus.Storage.LedgerDB` (~1.6k LoC) — an
+in-memory `AnchoredSeq` of `Checkpoint ExtLedgerState` (LedgerDB.hs:78,102)
+anchored at the immutable tip's state, supporting `ledgerDbPush` (:294),
+`ledgerDbSwitch` (:315 — rollback + pushMany), pruning to k; plus CBOR
+snapshots on disk (Snapshots.hs:108), a keep-2 disk policy
+(DiskPolicy.hs:87), and replay-on-init from the newest usable snapshot
+with fallback to older/genesis (Init.hs:89-145) using `tickThenReapply`
+(NO crypto).
+
+The batched inversion: `push_many` with `apply=True` routes header crypto
+through the protocol's device batch (BatchingProtocol.validate_batch)
+while ledger-body application stays a cheap host fold — the `Ap` GADT's
+Apply/Reapply distinction (Update.hs:89) becomes a flag.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+from ..block.abstract import Point
+from ..ledger.extended import ExtLedger, ExtLedgerState
+from ..ledger.header_validation import AnnTip, HeaderState, validate_envelope
+from . import serialize
+
+
+@dataclass
+class InvalidBlock(Exception):
+    point: Point
+    reason: Exception
+
+
+class LedgerDB:
+    """AnchoredSeq of (point, state): index 0 is the anchor (immutable
+    tip); at most k volatile checkpoints follow."""
+
+    def __init__(self, ext: ExtLedger, k: int, anchor: ExtLedgerState):
+        self.ext = ext
+        self.k = k
+        self._seq: list[tuple[Point | None, ExtLedgerState]] = [
+            (ext.tip_point(anchor), anchor)
+        ]
+
+    # -- queries -------------------------------------------------------------
+
+    def current(self) -> ExtLedgerState:
+        return self._seq[-1][1]
+
+    def anchor(self) -> ExtLedgerState:
+        return self._seq[0][1]
+
+    def tip_point(self) -> Point | None:
+        return self._seq[-1][0]
+
+    def volatile_length(self) -> int:
+        return len(self._seq) - 1
+
+    def past_state(self, point: Point | None) -> ExtLedgerState | None:
+        """getPastLedger: state at `point` if within the last k blocks."""
+        for p, st in self._seq:
+            if p == point:
+                return st
+        return None
+
+    # -- updates -------------------------------------------------------------
+
+    def push(self, block, apply: bool = True) -> ExtLedgerState:
+        """ledgerDbPush + prune-to-k."""
+        st = self.current()
+        new = (
+            self.ext.tick_then_apply(st, block)
+            if apply
+            else self.ext.tick_then_reapply(st, block)
+        )
+        self._seq.append((block.point, new))
+        if len(self._seq) > self.k + 1:
+            self._seq = self._seq[len(self._seq) - (self.k + 1) :]
+        return new
+
+    def rollback(self, n: int) -> bool:
+        """ledgerDbRollback: drop the last n states; fails beyond k."""
+        if n > self.volatile_length():
+            return False
+        if n:
+            self._seq = self._seq[:-n]
+        return True
+
+    def push_many(self, blocks: Sequence, apply: bool = True) -> None:
+        """ledgerDbPushMany; with `apply` and a batching protocol, header
+        crypto runs as fused device batches (epoch-segmented)."""
+        proto = self.ext.protocol
+        if apply and getattr(proto, "use_device_batch", False) and len(blocks) > 1:
+            self._push_many_batched(blocks)
+        else:
+            for b in blocks:
+                try:
+                    self.push(b, apply)
+                except Exception as e:
+                    raise InvalidBlock(b.point, e) from e
+
+    def _push_many_batched(self, blocks: Sequence) -> None:
+        """Bodies: sequential host fold. Headers: device batch per epoch
+        segment (protocol/batch.py), envelope checks on host."""
+        proto = self.ext.protocol
+        params = proto.params
+        i = 0
+        n = len(blocks)
+        while i < n:
+            epoch = params.epoch_of(blocks[i].slot)
+            j = i
+            while j < n and params.epoch_of(blocks[j].slot) == epoch:
+                j += 1
+            segment = blocks[i:j]
+            st = self.current()
+            # envelope + ledger bodies first (reference order applies the
+            # ledger before validateHeader, Extended.hs:142-156); a body/
+            # envelope failure truncates the segment so header states for
+            # the valid prefix are STILL pushed before raising (callers —
+            # ChainSel's truncate-rejected loop — rely on that)
+            ext_states = []
+            tip = st.header_state.tip
+            ledger_state = st.ledger_state
+            pending: InvalidBlock | None = None
+            for b in segment:
+                try:
+                    validate_envelope(tip, b.header)
+                    ledger_state = self.ext.ledger.tick_then_apply(ledger_state, b)
+                except Exception as e:
+                    pending = InvalidBlock(b.point, e)
+                    break
+                tip = AnnTip(b.slot, b.block_no, b.hash_)
+                ext_states.append(ledger_state)
+            segment = segment[: len(ext_states)]
+            if segment:
+                # ticked ledger view for the segment's epoch from the
+                # current state (mock: static; HFC: per-era summary)
+                lt = self.ext.ledger.tick(st.ledger_state, segment[0].slot)
+                view = self.ext.ledger.protocol_ledger_view(lt)
+                ticked = proto.tick(
+                    view, segment[0].slot, st.header_state.chain_dep_state
+                )
+                res = proto.validate_batch(
+                    ticked, [b.header.to_view() for b in segment], collect_states=True
+                )
+                for idx in range(res.n_valid):
+                    b = segment[idx]
+                    hs = HeaderState(
+                        AnnTip(b.slot, b.block_no, b.hash_), res.states[idx]
+                    )
+                    self._seq.append((b.point, ExtLedgerState(ext_states[idx], hs)))
+                if len(self._seq) > self.k + 1:
+                    self._seq = self._seq[len(self._seq) - (self.k + 1) :]
+                if res.error is not None:
+                    raise InvalidBlock(segment[res.n_valid].point, res.error)
+            if pending is not None:
+                raise pending
+            i = j
+
+    def switch(self, n_rollback: int, blocks: Sequence, apply: bool = True) -> bool:
+        """ledgerDbSwitch (Update.hs:315): rollback then pushMany."""
+        if not self.rollback(n_rollback):
+            return False
+        self.push_many(blocks, apply)
+        return True
+
+    # -- snapshots (Snapshots.hs, DiskPolicy.hs) -----------------------------
+
+    SNAP_RE = re.compile(r"^snapshot-(\d+)$")
+
+    def take_snapshot(self, snap_dir: str, keep: int = 2) -> str | None:
+        """Write the ANCHOR state (immutable tip, Snapshots.hs:108) named
+        by its slot; prune to `keep` newest (DiskPolicy: default 2)."""
+        os.makedirs(snap_dir, exist_ok=True)
+        anchor_point, anchor = self._seq[0]
+        slot = 0 if anchor_point is None else anchor_point.slot
+        name = f"snapshot-{slot}"
+        path = os.path.join(snap_dir, name)
+        if os.path.exists(path):
+            return None
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(serialize.encode_ext_state(anchor))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        snaps = sorted(self.list_snapshots(snap_dir))
+        for s in snaps[:-keep]:
+            os.remove(os.path.join(snap_dir, f"snapshot-{s}"))
+        return name
+
+    @classmethod
+    def list_snapshots(cls, snap_dir: str) -> list[int]:
+        if not os.path.isdir(snap_dir):
+            return []
+        out = []
+        for f in os.listdir(snap_dir):
+            m = cls.SNAP_RE.match(f)
+            if m:
+                out.append(int(m.group(1)))
+        return out
+
+    @classmethod
+    def init_from_snapshots(
+        cls,
+        ext: ExtLedger,
+        k: int,
+        snap_dir: str,
+        genesis: ExtLedgerState,
+        immutable_db,
+        trace: Callable[[str], None] = lambda s: None,
+    ) -> "LedgerDB":
+        """initLedgerDB (Init.hs:89-145): newest snapshot first, fall back
+        to older ones then genesis; replay immutable blocks after the
+        snapshot with tickThenReapply (no crypto)."""
+        from ..block.praos_block import Block
+
+        for slot in sorted(cls.list_snapshots(snap_dir), reverse=True):
+            path = os.path.join(snap_dir, f"snapshot-{slot}")
+            try:
+                with open(path, "rb") as f:
+                    state = serialize.decode_ext_state(f.read())
+            except Exception:
+                trace(f"snapshot-{slot} unreadable; falling back")
+                os.remove(path)
+                continue
+            db = cls(ext, k, state)
+            tip_slot = ext.tip_slot(state)
+            start = -1 if tip_slot is None else tip_slot  # None = genesis
+            for entry, raw in immutable_db.stream_from(start):
+                db.push(Block.from_bytes(raw), apply=False)
+                db._seq = db._seq[-1:]  # replay keeps only the tip state
+            trace(f"replayed from snapshot-{slot}")
+            return db
+        db = cls(ext, k, genesis)
+        n = 0
+        for entry, raw in immutable_db.stream_all():
+            db.push(Block.from_bytes(raw), apply=False)
+            db._seq = db._seq[-1:]
+            n += 1
+        trace(f"replayed {n} blocks from genesis")
+        return db

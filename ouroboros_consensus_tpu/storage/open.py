@@ -1,0 +1,52 @@
+"""ChainDB assembly: the openDB path of node startup.
+
+Reference: `ChainDB.openDB` via `openChainDB` (diffusion Node.hs:568-580)
+— open ImmutableDB (with validation policy), VolatileDB (reparse),
+initialize LedgerDB from newest snapshot + replay, then initial chain
+selection. The `validate_all` flag is the clean-shutdown-marker policy
+(Node/Recovery.hs:24-59): absent marker ⇒ last run crashed ⇒ full
+revalidation of all chunks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+from ..block.praos_block import Block
+from ..ledger.extended import ExtLedger, ExtLedgerState
+from .chaindb import ChainDB
+from .immutable import ImmutableDB
+from .ledgerdb import LedgerDB
+from .volatile import VolatileDB
+
+
+def default_check_integrity(raw: bytes) -> bool:
+    """nodeCheckIntegrity (Node/InitStorage.hs:25 → shelley
+    Ledger/Integrity.hs): parseable + body hash matches. (The KES check
+    runs batched when the analyser revalidates headers.)"""
+    try:
+        return Block.from_bytes(raw).check_integrity()
+    except Exception:
+        return False
+
+
+def open_chaindb(
+    path: str,
+    ext: ExtLedger,
+    genesis: ExtLedgerState,
+    k: int,
+    validate_all: bool = False,
+    chunk_size: int = 21600,
+    trace: Callable[[str], None] = lambda s: None,
+) -> ChainDB:
+    imm = ImmutableDB(
+        os.path.join(path, "immutable"),
+        chunk_size=chunk_size,
+        check_integrity=default_check_integrity if validate_all else None,
+        validate_all=validate_all,
+    )
+    vol = VolatileDB(os.path.join(path, "volatile"))
+    snap_dir = os.path.join(path, "ledger")
+    ldb = LedgerDB.init_from_snapshots(ext, k, snap_dir, genesis, imm, trace)
+    return ChainDB(ext, imm, vol, ldb, k, snap_dir=snap_dir, trace=trace)

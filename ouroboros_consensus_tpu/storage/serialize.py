@@ -1,0 +1,79 @@
+"""Disk codecs for ledger/protocol state — the snapshot payloads.
+
+Reference: `Storage/Serialisation.hs` + the EncodeDisk/DecodeDisk
+instances for `ExtLedgerState` (Ledger/Extended.hs:178-199): snapshots
+serialize (ledger state, header state) where the header state embeds the
+protocol's ChainDepState — the chain itself is the checkpoint for
+consensus state (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+from ..ledger.extended import ExtLedgerState
+from ..ledger.header_validation import AnnTip, HeaderState
+from ..ledger.mock import MockState
+from ..protocol.praos import PraosState
+from ..utils import cbor
+
+
+def encode_praos_state(st: PraosState):
+    return [
+        st.last_slot,
+        sorted((k, v) for k, v in st.ocert_counters.items()),
+        st.evolving_nonce,
+        st.candidate_nonce,
+        st.epoch_nonce,
+        st.lab_nonce,
+        st.last_epoch_block_nonce,
+    ]
+
+
+def decode_praos_state(o) -> PraosState:
+    def nb(x):
+        return bytes(x) if x is not None else None
+
+    return PraosState(
+        last_slot=o[0],
+        ocert_counters={bytes(k): v for k, v in o[1]},
+        evolving_nonce=nb(o[2]),
+        candidate_nonce=nb(o[3]),
+        epoch_nonce=nb(o[4]),
+        lab_nonce=nb(o[5]),
+        last_epoch_block_nonce=nb(o[6]),
+    )
+
+
+def encode_header_state(hs: HeaderState):
+    tip = None if hs.tip is None else [hs.tip.slot, hs.tip.block_no, hs.tip.hash_]
+    return [tip, encode_praos_state(hs.chain_dep_state)]
+
+
+def decode_header_state(o) -> HeaderState:
+    tip = None if o[0] is None else AnnTip(o[0][0], o[0][1], bytes(o[0][2]))
+    return HeaderState(tip, decode_praos_state(o[1]))
+
+
+def encode_mock_state(st: MockState):
+    utxo = sorted(
+        ([txid, ix, addr, amt] for (txid, ix), (addr, amt) in st.utxo.items()),
+        key=lambda e: (e[0], e[1]),
+    )
+    return [utxo, st.tip_slot_]
+
+
+def decode_mock_state(o) -> MockState:
+    utxo = {(bytes(e[0]), e[1]): (bytes(e[2]), e[3]) for e in o[0]}
+    return MockState(utxo, o[1])
+
+
+def encode_ext_state(st: ExtLedgerState) -> bytes:
+    return cbor.encode(
+        [encode_mock_state(st.ledger_state), encode_header_state(st.header_state)]
+    )
+
+
+def decode_ext_state(data: bytes) -> ExtLedgerState:
+    o = cbor.decode(data)
+    return ExtLedgerState(decode_mock_state(o[0]), decode_header_state(o[1]))

@@ -1,0 +1,152 @@
+"""VolatileDB: unordered store of recent blocks, GC'd by slot.
+
+Reference: `Ouroboros.Consensus.Storage.VolatileDB` (7 files, ~1.7k LoC) —
+blocks append to `blocks-N.dat` files (Impl.hs:83-96) capped at
+`maxBlocksPerFile` (Impl.hs:208); all lookup state (block info by hash,
+successor map by prev-hash) is IN MEMORY and rebuilt by reparsing the
+files on open; garbage collection removes whole files whose blocks are all
+older than the GC slot.
+
+On-disk record framing (per block):  u32 length ‖ u32 crc32 ‖ bytes.
+A torn/corrupt record truncates its file at that point on open (the
+reference's ParseError truncation).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+from ..block.abstract import Point
+
+
+@dataclass(frozen=True)
+class BlockInfo:
+    """What the in-memory index holds per block (VolatileDB API's
+    BlockInfo): enough for ChainSel's path finding without reads."""
+
+    hash_: bytes
+    prev_hash: bytes | None
+    slot: int
+    block_no: int
+    file_no: int
+    offset: int  # of the payload inside the file
+    size: int
+
+
+class VolatileDB:
+    def __init__(self, path: str, max_blocks_per_file: int = 1000):
+        self.path = path
+        self.max_blocks_per_file = max_blocks_per_file
+        os.makedirs(path, exist_ok=True)
+        self._info: dict[bytes, BlockInfo] = {}
+        self._successors: dict[bytes | None, set[bytes]] = {}
+        self._file_counts: dict[int, int] = {}
+        self._reopen()
+
+    # -- open / reparse ------------------------------------------------------
+
+    def _files(self) -> list[int]:
+        ns = []
+        for f in os.listdir(self.path):
+            if f.startswith("blocks-") and f.endswith(".dat"):
+                ns.append(int(f[len("blocks-") : -len(".dat")]))
+        return sorted(ns)
+
+    def _reopen(self) -> None:
+        from ..block.praos_block import Block
+
+        for n in self._files():
+            p = self._file_path(n)
+            with open(p, "rb") as f:
+                data = f.read()
+            off = 0
+            good_end = 0
+            while off + 8 <= len(data):
+                size, crc = struct.unpack_from("<II", data, off)
+                payload = data[off + 8 : off + 8 + size]
+                if len(payload) != size or zlib.crc32(payload) != crc:
+                    break
+                try:
+                    blk = Block.from_bytes(payload)
+                except Exception:
+                    break
+                self._index(blk, n, off + 8, size)
+                off += 8 + size
+                good_end = off
+            if good_end != len(data):  # truncate torn tail
+                with open(p, "r+b") as f:
+                    f.truncate(good_end)
+        ns = self._files()
+        self._write_file_no = ns[-1] if ns else 0
+
+    def _file_path(self, n: int) -> str:
+        return os.path.join(self.path, f"blocks-{n:04d}.dat")
+
+    def _index(self, blk, file_no: int, offset: int, size: int) -> None:
+        info = BlockInfo(
+            blk.hash_, blk.prev_hash, blk.slot, blk.block_no, file_no, offset, size
+        )
+        self._info[blk.hash_] = info
+        self._successors.setdefault(blk.prev_hash, set()).add(blk.hash_)
+        self._file_counts[file_no] = self._file_counts.get(file_no, 0) + 1
+
+    # -- API (Storage/VolatileDB/API.hs) -------------------------------------
+
+    def put_block(self, blk) -> None:
+        if blk.hash_ in self._info:
+            return  # duplicates are no-ops (putBlock idempotence)
+        n = self._write_file_no
+        if self._file_counts.get(n, 0) >= self.max_blocks_per_file:
+            n = self._write_file_no = n + 1
+        raw = blk.bytes_
+        p = self._file_path(n)
+        offset = (os.path.getsize(p) if os.path.exists(p) else 0) + 8
+        with open(p, "ab") as f:
+            f.write(struct.pack("<II", len(raw), zlib.crc32(raw)))
+            f.write(raw)
+        self._index(blk, n, offset, len(raw))
+
+    def get_block_info(self, hash_: bytes) -> BlockInfo | None:
+        return self._info.get(hash_)
+
+    def member(self, hash_: bytes) -> bool:
+        return hash_ in self._info
+
+    def get_block_bytes(self, hash_: bytes) -> bytes | None:
+        info = self._info.get(hash_)
+        if info is None:
+            return None
+        with open(self._file_path(info.file_no), "rb") as f:
+            f.seek(info.offset)
+            return f.read(info.size)
+
+    def filter_by_predecessor(self, prev_hash: bytes | None) -> set[bytes]:
+        """The successor map ChainSel's path finding walks (Paths.hs)."""
+        return set(self._successors.get(prev_hash, ()))
+
+    def garbage_collect(self, slot: int) -> None:
+        """Remove whole files whose blocks all have slot < `slot`
+        (VolatileDB GC granularity is the file, Impl.hs garbageCollect)."""
+        by_file: dict[int, list[BlockInfo]] = {}
+        for info in self._info.values():
+            by_file.setdefault(info.file_no, []).append(info)
+        for n, infos in by_file.items():
+            if n == self._write_file_no:
+                continue  # never GC the write file
+            if all(i.slot < slot for i in infos):
+                os.remove(self._file_path(n))
+                for i in infos:
+                    del self._info[i.hash_]
+                    succ = self._successors.get(i.prev_hash)
+                    if succ is not None:
+                        succ.discard(i.hash_)
+                        if not succ:
+                            del self._successors[i.prev_hash]
+                self._file_counts.pop(n, None)
+
+    def all_hashes(self) -> Iterable[bytes]:
+        return self._info.keys()

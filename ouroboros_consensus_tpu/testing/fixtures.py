@@ -88,6 +88,29 @@ def can_be_leader(pool: PoolCredentials, counter: int = 0, kes_period: int = 0) 
     )
 
 
+def find_leader(
+    params: PraosParams,
+    pools: list[PoolCredentials],
+    lview: LedgerView,
+    slot: int,
+    epoch_nonce: nonces.Nonce,
+) -> PoolCredentials | None:
+    """First pool (by list order) winning the leader check for `slot`,
+    decided by the protocol's own check_is_leader (no re-implementation)."""
+    from ..protocol import praos as praos_mod
+
+    ticked = praos_mod.TickedPraosState(
+        praos_mod.PraosState(epoch_nonce=epoch_nonce), lview
+    )
+    for pool in pools:
+        if (
+            praos_mod.check_is_leader(params, can_be_leader(pool), slot, ticked)
+            is not None
+        ):
+            return pool
+    return None
+
+
 def forge_header_view(
     params: PraosParams,
     pool: PoolCredentials,

@@ -1,0 +1,155 @@
+"""Block model, CBOR codecs, mock ledger, extended validation (host-only)."""
+
+from dataclasses import replace
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.block import Block, Header, forge_block
+from ouroboros_consensus_tpu.ledger import (
+    ExtLedger,
+    HeaderEnvelopeError,
+    validate_envelope,
+)
+from ouroboros_consensus_tpu.ledger import mock as mock_ledger
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.protocol.instances import PraosProtocol
+from ouroboros_consensus_tpu.testing import fixtures
+
+# f = 1: every slot is active for every pool (reference short-circuit,
+# activeSlotVal == maxBound), so chains can be forged deterministically
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=100,
+    max_kes_evolutions=62,
+    security_param=4,
+    active_slot_coeff=Fraction(1),
+    epoch_length=500,
+    kes_depth=3,
+)
+
+POOLS = [fixtures.make_pool(i, kes_depth=PARAMS.kes_depth) for i in range(2)]
+LVIEW = fixtures.make_ledger_view(POOLS)
+
+
+def mk_ext():
+    ledger = mock_ledger.MockLedger(
+        mock_ledger.MockConfig(LVIEW, PARAMS.stability_window)
+    )
+    protocol = PraosProtocol(PARAMS)
+    return ExtLedger(ledger, protocol), ledger
+
+
+def forge_chain(n, eta0=b"\x11" * 32, txs_for=lambda i: ()):
+    blocks = []
+    prev = None
+    for i in range(n):
+        b = forge_block(
+            PARAMS, POOLS[i % len(POOLS)], slot=i + 1, block_no=i,
+            prev_hash=prev, epoch_nonce=eta0, txs=tuple(txs_for(i)),
+        )
+        blocks.append(b)
+        prev = b.hash_
+    return blocks
+
+
+def test_header_roundtrip():
+    blk = forge_chain(1)[0]
+    h2 = Header.from_bytes(blk.header.bytes_)
+    assert h2 == blk.header
+    assert h2.hash_ == blk.header.hash_
+    b2 = Block.from_bytes(blk.bytes_)
+    assert b2 == blk
+    assert b2.check_integrity()
+
+
+def test_signed_bytes_cover_body():
+    blk = forge_chain(1)[0]
+    view = blk.header.to_view()
+    assert view.signed_bytes == blk.header.body.signed_bytes
+    # KES sig verifies over the signed bytes
+    from ouroboros_consensus_tpu.ops.host import kes as hk
+
+    t = PARAMS.kes_period_of(blk.slot) - blk.header.body.ocert.kes_period
+    assert hk.verify(
+        blk.header.body.ocert.vk_hot, PARAMS.kes_depth, t, view.signed_bytes, view.kes_sig
+    )
+
+
+def test_envelope_checks():
+    blocks = forge_chain(3)
+    ext, _ = mk_ext()
+    st = ext.genesis(ext.ledger.genesis_state([]))
+    # genesis expects block_no 0
+    validate_envelope(None, blocks[0].header)
+    with pytest.raises(HeaderEnvelopeError):
+        validate_envelope(None, blocks[1].header)
+
+
+def test_ext_ledger_chain_apply():
+    eta0 = b"\x11" * 32
+    ext, ledger = mk_ext()
+    st = ext.genesis(ledger.genesis_state([]))
+    # chain must be forged against the evolving protocol state's epoch
+    # nonce; with one epoch (epoch_length=500) eta0 stays the initial one
+    st = replace(
+        st,
+        header_state=replace(
+            st.header_state,
+            chain_dep_state=replace(st.header_state.chain_dep_state, epoch_nonce=eta0),
+        ),
+    )
+    for blk in forge_chain(5):
+        st = ext.tick_then_apply(st, blk)
+    assert st.header_state.tip.block_no == 4
+    assert ext.tip_slot(st) == 5
+
+    # reapply reproduces the same state without crypto
+    st2 = ext.genesis(ledger.genesis_state([]))
+    st2 = replace(
+        st2,
+        header_state=replace(
+            st2.header_state,
+            chain_dep_state=replace(st2.header_state.chain_dep_state, epoch_nonce=eta0),
+        ),
+    )
+    for blk in forge_chain(5):
+        st2 = ext.tick_then_reapply(st2, blk)
+    assert st2.header_state.tip == st.header_state.tip
+    assert st2.header_state.chain_dep_state == st.header_state.chain_dep_state
+
+
+def test_mock_ledger_utxo():
+    ledger = mock_ledger.MockLedger(
+        mock_ledger.MockConfig(LVIEW, PARAMS.stability_window)
+    )
+    st = ledger.genesis_state([(b"alice", 100)])
+    gtx = (bytes(32), 0)
+    tx1 = mock_ledger.encode_tx([gtx], [(b"bob", 60), (b"alice", 40)])
+    blocks = forge_chain(1, txs_for=lambda i: [tx1])
+    st2 = ledger.tick_then_apply(st, blocks[0])
+    tid = mock_ledger.tx_id(tx1)
+    assert st2.utxo[(tid, 0)] == (b"bob", 60)
+    assert gtx not in st2.utxo
+
+    # double spend rejected
+    tx_bad = mock_ledger.encode_tx([gtx], [(b"eve", 100)])
+    blocks_bad = forge_chain(1, txs_for=lambda i: [tx1, tx_bad])
+    with pytest.raises(mock_ledger.MissingInput):
+        ledger.tick_then_apply(st, blocks_bad[0])
+
+    # value conservation
+    tx_inflate = mock_ledger.encode_tx([gtx], [(b"eve", 101)])
+    blocks_inf = forge_chain(1, txs_for=lambda i: [tx_inflate])
+    with pytest.raises(mock_ledger.ValueNotConserved):
+        ledger.tick_then_apply(st, blocks_inf[0])
+
+
+def test_forecast_horizon():
+    ext, ledger = mk_ext()
+    st = ledger.genesis_state([])
+    fc = ledger.ledger_view_forecast_at(st)
+    assert fc.forecast_for(0) is LVIEW
+    from ouroboros_consensus_tpu.ledger.abstract import OutsideForecastRange
+
+    with pytest.raises(OutsideForecastRange):
+        fc.forecast_for(fc.max_for)

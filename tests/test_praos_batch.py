@@ -1,0 +1,205 @@
+"""Batched Praos validation == sequential reference fold.
+
+The contract (SURVEY.md §7.3 item 2): `validate_batch` must produce the
+same resulting PraosState, the same valid-prefix length, and the same
+first-error class as folding `praos.update` header by header.
+"""
+
+from dataclasses import replace
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.ops.host import kes as host_kes
+from ouroboros_consensus_tpu.protocol import batch as pbatch
+from ouroboros_consensus_tpu.protocol import nonces, praos
+from ouroboros_consensus_tpu.testing import fixtures
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=100,
+    max_kes_evolutions=62,
+    security_param=4,
+    active_slot_coeff=Fraction(1, 2),
+    epoch_length=50,
+    kes_depth=3,
+)
+
+
+def make_chain(n, pools, params=PARAMS, epoch_nonce=b"\x07" * 32, lview=None):
+    """Leader-aware forging: only slots some pool actually wins."""
+    if lview is None:
+        lview = fixtures.make_ledger_view(pools)
+    hvs = []
+    prev = None
+    slot = 1
+    while len(hvs) < n:
+        pool = fixtures.find_leader(params, pools, lview, slot, epoch_nonce)
+        if pool is not None:
+            hv = fixtures.forge_header_view(
+                params, pool, slot=slot, epoch_nonce=epoch_nonce,
+                prev_hash=prev, body_bytes=b"body-%d" % len(hvs),
+            )
+            hvs.append(hv)
+            prev = (b"%032d" % len(hvs))[:32]
+        slot += 1
+    return hvs
+
+
+def sequential_fold(params, ticked, hvs):
+    """Reference semantics: fold praos.update, stop at first error."""
+    st = ticked.state
+    for i, hv in enumerate(hvs):
+        try:
+            st = praos.update(params, hv, hv.slot, praos.TickedPraosState(st, ticked.ledger_view))
+        except praos.PraosValidationError as e:
+            return st, i, e
+    return st, len(hvs), None
+
+
+@pytest.fixture(scope="module")
+def pools():
+    return [fixtures.make_pool(i, kes_depth=PARAMS.kes_depth) for i in range(3)]
+
+
+@pytest.fixture(scope="module")
+def lview(pools):
+    return fixtures.make_ledger_view(pools)
+
+
+def ticked_state(lview, epoch_nonce=b"\x07" * 32):
+    st = praos.PraosState(epoch_nonce=epoch_nonce)
+    return praos.TickedPraosState(st, lview)
+
+
+def assert_same(params, ticked, hvs):
+    st_seq, n_seq, err_seq = sequential_fold(params, ticked, hvs)
+    res = pbatch.validate_batch(params, ticked, hvs)
+    assert res.n_valid == n_seq
+    if err_seq is None:
+        assert res.error is None
+    else:
+        assert type(res.error) is type(err_seq)
+    assert res.state == replace(
+        st_seq, ocert_counters=dict(st_seq.ocert_counters)
+    ) or (
+        res.state.evolving_nonce == st_seq.evolving_nonce
+        and res.state.candidate_nonce == st_seq.candidate_nonce
+        and res.state.lab_nonce == st_seq.lab_nonce
+        and res.state.last_slot == st_seq.last_slot
+        and dict(res.state.ocert_counters) == dict(st_seq.ocert_counters)
+    )
+
+
+def test_all_valid(pools, lview):
+    hvs = make_chain(8, pools)
+    t = ticked_state(lview)
+    assert_same(PARAMS, t, hvs)
+    res = pbatch.validate_batch(PARAMS, t, hvs)
+    assert res.n_valid == 8 and res.error is None
+
+
+def test_bad_kes_sig_midway(pools, lview):
+    hvs = make_chain(6, pools)
+    bad = hvs[3]
+    hvs[3] = replace(bad, kes_sig=b"\x01" + bad.kes_sig[1:])
+    assert_same(PARAMS, ticked_state(lview), hvs)
+
+
+def test_bad_vrf_proof(pools, lview):
+    hvs = make_chain(5, pools)
+    bad = hvs[2]
+    hvs[2] = replace(bad, vrf_proof=bad.vrf_proof[:-1] + bytes([bad.vrf_proof[-1] ^ 1]))
+    assert_same(PARAMS, ticked_state(lview), hvs)
+
+
+def test_bad_ocert_sigma(pools, lview):
+    hvs = make_chain(4, pools)
+    bad = hvs[1]
+    hvs[1] = replace(bad, ocert=replace(bad.ocert, sigma=bytes(64)))
+    assert_same(PARAMS, ticked_state(lview), hvs)
+
+
+def test_unknown_pool(pools, lview):
+    stranger = fixtures.make_pool(99, kes_depth=PARAMS.kes_depth)
+    hvs = make_chain(3, pools)
+    hvs[1] = fixtures.forge_header_view(
+        PARAMS, stranger, slot=hvs[1].slot, epoch_nonce=b"\x07" * 32,
+        prev_hash=hvs[1].prev_hash,
+    )
+    assert_same(PARAMS, ticked_state(lview), hvs)
+
+
+def test_counter_regression(pools, lview):
+    # same pool twice: second header reuses a LOWER ocert counter; pick
+    # slots the pool actually wins so the counter check is what fires
+    p = pools[0]
+    eta = b"\x07" * 32
+    slots = [
+        s for s in range(1, 2000)
+        if fixtures.find_leader(PARAMS, [p], lview, s, eta) is not None
+    ][:2]
+    assert len(slots) == 2
+    hv1 = fixtures.forge_header_view(
+        PARAMS, p, slot=slots[0], epoch_nonce=eta, prev_hash=None,
+        ocert_counter=5,
+    )
+    hv2 = fixtures.forge_header_view(
+        PARAMS, p, slot=slots[1], epoch_nonce=eta, prev_hash=b"x" * 32,
+        ocert_counter=3,
+    )
+    assert_same(PARAMS, ticked_state(lview), [hv1, hv2])
+
+
+def test_leader_threshold_losers(pools):
+    # tiny stake for pool 0 => its VRF values should mostly lose the slot
+    lv = fixtures.make_ledger_view(
+        pools, stakes=[Fraction(1, 10**12)] + [Fraction(1, 2)] * (len(pools) - 1)
+    )
+    hvs = make_chain(6, pools)
+    t = ticked_state(lv)
+    assert_same(PARAMS, t, hvs)
+
+
+def test_validate_chain_epoch_segmentation(pools, lview):
+    # headers crossing an epoch boundary (epoch_length=50): nonce rotation
+    # between segments must match the sequential tick-per-header fold
+    params = PARAMS
+    hvs = []
+    prev = None
+    st0 = praos.PraosState(epoch_nonce=b"\x07" * 32)
+
+    # build chain with correct per-epoch nonces by running the fold as forge
+    st = st0
+    slot = 44  # will cross slot 50 (epoch 0 -> 1)
+    while len(hvs) < 8:
+        ticked = praos.tick(params, lview, slot, st)
+        pool = fixtures.find_leader(
+            params, pools, lview, slot, ticked.state.epoch_nonce
+        )
+        if pool is None:
+            slot += 1
+            continue
+        hv = fixtures.forge_header_view(
+            params, pool, slot=slot,
+            epoch_nonce=ticked.state.epoch_nonce, prev_hash=prev,
+            body_bytes=b"b%d" % len(hvs),
+        )
+        st = praos.update(params, hv, slot, ticked)
+        hvs.append(hv)
+        prev = (b"%032d" % len(hvs))[:32]
+        slot += 1
+
+    res = pbatch.validate_chain(
+        params, lambda epoch: lview, st0, hvs
+    )
+    assert res.error is None and res.n_valid == len(hvs)
+    assert res.state.evolving_nonce == st.evolving_nonce
+    assert res.state.epoch_nonce == st.epoch_nonce
+    assert res.state.candidate_nonce == st.candidate_nonce
+
+
+def test_leader_threshold_bracket_sane():
+    lo, hi = pbatch.leader_threshold_bracket(Fraction(1, 3), Fraction(1, 20))
+    assert 0 < lo <= hi < pbatch.leader.LEADER_VALUE_MAX
+    assert hi - lo <= 1 << 200  # tight bracket (width << 2^256)
+    assert pbatch.leader_threshold_bracket(Fraction(0), Fraction(1, 20)) == (0, 0)

@@ -1,0 +1,289 @@
+"""Storage engine tests: ImmutableDB, VolatileDB, LedgerDB, ChainDB.
+
+Mirrors the reference's model-based storage tests (SURVEY.md §4 tier 2) in
+spirit: every property is phrased against expected chain/store contents,
+including corruption-and-truncate recovery.
+"""
+
+import os
+from dataclasses import replace
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.block import Block, Point, forge_block
+from ouroboros_consensus_tpu.block.abstract import block_point
+from ouroboros_consensus_tpu.ledger import ExtLedger
+from ouroboros_consensus_tpu.ledger import mock as mock_ledger
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.protocol.instances import PraosProtocol
+from ouroboros_consensus_tpu.storage import (
+    ChainDB,
+    ImmutableDB,
+    LedgerDB,
+    VolatileDB,
+)
+from ouroboros_consensus_tpu.storage.open import open_chaindb
+from ouroboros_consensus_tpu.testing import fixtures
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=100,
+    max_kes_evolutions=62,
+    security_param=3,  # tiny k: exercises copy-to-immutable quickly
+    active_slot_coeff=Fraction(1),
+    epoch_length=10_000,
+    kes_depth=3,
+)
+POOLS = [fixtures.make_pool(i, kes_depth=PARAMS.kes_depth) for i in range(2)]
+LVIEW = fixtures.make_ledger_view(POOLS)
+ETA0 = b"\x22" * 32
+
+
+def mk_ext(use_device_batch=False):
+    ledger = mock_ledger.MockLedger(
+        mock_ledger.MockConfig(LVIEW, PARAMS.stability_window)
+    )
+    protocol = PraosProtocol(PARAMS, use_device_batch=use_device_batch)
+    return ExtLedger(ledger, protocol)
+
+
+def genesis_state(ext):
+    st = ext.genesis(ext.ledger.genesis_state([]))
+    return replace(
+        st,
+        header_state=replace(
+            st.header_state,
+            chain_dep_state=replace(st.header_state.chain_dep_state, epoch_nonce=ETA0),
+        ),
+    )
+
+
+def forge_chain(n, start_slot=1, start_bno=0, prev=None, pool_ix=0, slot_step=1):
+    blocks = []
+    for i in range(n):
+        b = forge_block(
+            PARAMS, POOLS[(pool_ix + i) % len(POOLS)],
+            slot=start_slot + i * slot_step, block_no=start_bno + i,
+            prev_hash=prev, epoch_nonce=ETA0,
+        )
+        blocks.append(b)
+        prev = b.hash_
+    return blocks
+
+
+# -- ImmutableDB -------------------------------------------------------------
+
+
+def test_immutable_roundtrip(tmp_path):
+    db = ImmutableDB(str(tmp_path / "imm"), chunk_size=4)
+    blocks = forge_chain(10)
+    for b in blocks:
+        db.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+    assert db.n_blocks() == 10
+    assert db.tip().slot == blocks[-1].slot
+
+    # reopen: indices reload, tail chunk revalidated
+    db2 = ImmutableDB(str(tmp_path / "imm"), chunk_size=4)
+    assert db2.n_blocks() == 10
+    streamed = [Block.from_bytes(raw) for _, raw in db2.stream_all()]
+    assert streamed == blocks
+    assert db2.get_block_bytes(blocks[3].point) == blocks[3].bytes_
+
+
+def test_immutable_corrupt_tail_truncates(tmp_path):
+    db = ImmutableDB(str(tmp_path / "imm"), chunk_size=100)
+    blocks = forge_chain(6)
+    for b in blocks:
+        db.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+    # corrupt the last block's bytes in the chunk file
+    chunk = tmp_path / "imm" / "00000.chunk"
+    data = bytearray(chunk.read_bytes())
+    data[-3] ^= 0xFF
+    chunk.write_bytes(bytes(data))
+
+    db2 = ImmutableDB(str(tmp_path / "imm"), chunk_size=100)
+    assert db2.n_blocks() == 5  # corrupted tail dropped
+    assert db2.tip().slot == blocks[4].slot
+
+
+def test_immutable_truncate_after(tmp_path):
+    db = ImmutableDB(str(tmp_path / "imm"), chunk_size=4)
+    blocks = forge_chain(10)
+    for b in blocks:
+        db.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+    db.truncate_after(blocks[6].point)
+    assert db.n_blocks() == 7
+    db2 = ImmutableDB(str(tmp_path / "imm"), chunk_size=4)
+    assert db2.n_blocks() == 7
+
+
+# -- VolatileDB --------------------------------------------------------------
+
+
+def test_volatile_roundtrip_and_gc(tmp_path):
+    db = VolatileDB(str(tmp_path / "vol"), max_blocks_per_file=3)
+    blocks = forge_chain(8)
+    for b in blocks:
+        db.put_block(b)
+        db.put_block(b)  # idempotent
+    assert db.get_block_bytes(blocks[2].hash_) == blocks[2].bytes_
+    assert db.filter_by_predecessor(None) == {blocks[0].hash_}
+    assert db.filter_by_predecessor(blocks[0].hash_) == {blocks[1].hash_}
+
+    # reopen rebuilds the in-memory maps
+    db2 = VolatileDB(str(tmp_path / "vol"), max_blocks_per_file=3)
+    assert set(db2.all_hashes()) == {b.hash_ for b in blocks}
+
+    # GC removes whole files of old blocks (3 per file)
+    db2.garbage_collect(blocks[5].slot + 1)
+    remaining = set(db2.all_hashes())
+    assert {b.hash_ for b in blocks[6:]} <= remaining
+    assert blocks[0].hash_ not in remaining
+
+
+def test_volatile_torn_write_truncates(tmp_path):
+    db = VolatileDB(str(tmp_path / "vol"), max_blocks_per_file=100)
+    blocks = forge_chain(3)
+    for b in blocks:
+        db.put_block(b)
+    f = tmp_path / "vol" / "blocks-0000.dat"
+    data = f.read_bytes()
+    f.write_bytes(data[:-5])  # torn tail
+    db2 = VolatileDB(str(tmp_path / "vol"), max_blocks_per_file=100)
+    assert set(db2.all_hashes()) == {b.hash_ for b in blocks[:2]}
+
+
+# -- LedgerDB ----------------------------------------------------------------
+
+
+def test_ledgerdb_push_rollback_snapshots(tmp_path):
+    ext = mk_ext()
+    gen = genesis_state(ext)
+    db = LedgerDB(ext, k=PARAMS.security_param, anchor=gen)
+    blocks = forge_chain(5)
+    for b in blocks:
+        db.push(b)
+    assert db.volatile_length() == 3  # pruned to k
+    assert db.tip_point() == blocks[-1].point
+
+    assert db.rollback(2)
+    assert db.tip_point() == blocks[2].point
+    assert not db.rollback(5)  # beyond k
+
+    # switch to a fork from block 2
+    fork = forge_chain(3, start_slot=20, start_bno=3, prev=blocks[2].hash_, pool_ix=1)
+    assert db.switch(0, fork)
+    assert db.tip_point() == fork[-1].point
+
+    # snapshots
+    snap = tmp_path / "snaps"
+    name = db.take_snapshot(str(snap))
+    assert name is not None
+    assert LedgerDB.list_snapshots(str(snap))
+
+
+def test_ledgerdb_init_replay(tmp_path):
+    ext = mk_ext()
+    gen = genesis_state(ext)
+    imm = ImmutableDB(str(tmp_path / "imm"), chunk_size=100)
+    blocks = forge_chain(6)
+    for b in blocks:
+        imm.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+    db = LedgerDB.init_from_snapshots(
+        ext, PARAMS.security_param, str(tmp_path / "snaps"), gen, imm
+    )
+    assert ext.tip_slot(db.current()) == blocks[-1].slot
+    # header states replayed without crypto: tip matches
+    assert db.current().header_state.tip.block_no == 5
+
+
+# -- ChainDB + ChainSel ------------------------------------------------------
+
+
+def open_db(tmp_path, name="db"):
+    ext = mk_ext()
+    gen = genesis_state(ext)
+    return open_chaindb(
+        str(tmp_path / name), ext, gen, k=PARAMS.security_param, chunk_size=100
+    ), ext
+
+
+def test_chaindb_linear_growth(tmp_path):
+    db, _ = open_db(tmp_path)
+    blocks = forge_chain(7)
+    for b in blocks:
+        r = db.add_block(b)
+        assert r.selected
+    assert db.tip_point() == blocks[-1].point
+    # k=3: 4 blocks copied to immutable
+    assert db.immutable.n_blocks() == 4
+    assert len(db.current_chain) == 3
+    # full chain streams in order
+    assert [b.hash_ for b in db.stream_all()] == [b.hash_ for b in blocks]
+
+
+def test_chaindb_prefers_longer_fork(tmp_path):
+    db, _ = open_db(tmp_path)
+    main = forge_chain(4)
+    for b in main:
+        db.add_block(b)
+    # fork from block 1 with more blocks (longer chain wins)
+    fork = forge_chain(
+        5, start_slot=main[1].slot + 1, start_bno=2, prev=main[1].hash_, pool_ix=1,
+        slot_step=2,
+    )
+    for b in fork:
+        db.add_block(b)
+    assert db.tip_point() == fork[-1].point
+
+
+def test_chaindb_out_of_order_arrival(tmp_path):
+    db, _ = open_db(tmp_path)
+    blocks = forge_chain(5)
+    # arrive newest-first: nothing selectable until the chain connects
+    for b in reversed(blocks[1:]):
+        r = db.add_block(b)
+        assert not r.selected
+    r = db.add_block(blocks[0])
+    assert r.selected
+    assert db.tip_point() == blocks[-1].point
+
+
+def test_chaindb_invalid_block_marked(tmp_path):
+    db, _ = open_db(tmp_path)
+    blocks = forge_chain(4)
+    bad_body = Block(blocks[2].header, (b"not-a-valid-tx-cbor",))
+    for b in [blocks[0], blocks[1], bad_body]:
+        db.add_block(b)
+    # invalid block rejected, prefix adopted
+    assert db.tip_point() == blocks[1].point
+    assert db.get_is_invalid_block(bad_body.hash_) is not None
+    # adding the valid block with the same header hash is now impossible
+    # (same hash marked invalid) — extension continues on valid prefix
+    more = forge_chain(2, start_slot=10, start_bno=2, prev=blocks[1].hash_, pool_ix=1)
+    for b in more:
+        db.add_block(b)
+    assert db.tip_point() == more[-1].point
+
+
+def test_chaindb_restart_recovers(tmp_path):
+    db, _ = open_db(tmp_path)
+    blocks = forge_chain(7)
+    for b in blocks:
+        db.add_block(b)
+    tip = db.tip_point()
+    # reopen from disk (snapshot + immutable + volatile reparse)
+    db2, _ = open_db(tmp_path)
+    assert db2.tip_point() == tip
+    assert [b.hash_ for b in db2.stream_all()] == [b.hash_ for b in blocks]
+
+
+def test_chaindb_follower_updates(tmp_path):
+    db, _ = open_db(tmp_path)
+    f = db.new_follower()
+    blocks = forge_chain(3)
+    for b in blocks:
+        db.add_block(b)
+    ups = f.take_updates()
+    added = [u[1].hash_ for u in ups if u[0] == "addblock"]
+    assert added == [b.hash_ for b in blocks]
