@@ -1,0 +1,168 @@
+"""North-star benchmark: Praos headers fully validated per second.
+
+Measures the fused batched hot path (protocol/batch.py: Ed25519 OCert
+verify + CompactSum KES verify + ECVRF verify + leader threshold + nonce
+range extension — the per-header crypto of Praos.hs:441-606) on the
+available accelerator, and compares against a libsodium-class single-core
+CPU baseline measured live with the `cryptography` package (OpenSSL
+Ed25519).
+
+Baseline model (BASELINE.md config 1): one header costs ≈ 2 Ed25519
+verifies (OCert DSIGN + KES leaf) + 1 ECVRF verify (≈ 4 Ed25519-equivalent
+scalar mults: 2 fixed-base + 2 variable-base in ietfdraft03 verify) +
+~8 Blake2b hashes (negligible) ⇒ 6 Ed25519-equivalents/header. The CPU
+baseline is therefore measured_openssl_ed25519_rate / 6 — matching what a
+sequential libsodium fold (the reference's db-analyser --only-validation
+loop) achieves per core.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "headers/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BENCH_BATCH = int(os.environ.get("BENCH_BATCH", "4096"))
+BENCH_ITERS = int(os.environ.get("BENCH_ITERS", "5"))
+KES_DEPTH = int(os.environ.get("BENCH_KES_DEPTH", "7"))
+CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+
+
+def build_or_load_batch():
+    """Forge BENCH_BATCH protocol-valid headers (cached across runs —
+    host-side signing is ~35ms/header) and stage them columnar."""
+    import numpy as np
+
+    from ouroboros_consensus_tpu.protocol import batch as pbatch
+    from ouroboros_consensus_tpu.protocol import praos
+    from ouroboros_consensus_tpu.testing import fixtures
+
+    from fractions import Fraction
+
+    params = praos.PraosParams(
+        slots_per_kes_period=3600,
+        max_kes_evolutions=62,
+        security_param=2160,
+        active_slot_coeff=Fraction(1, 20),  # mainnet f
+        epoch_length=432_000,
+        kes_depth=KES_DEPTH,
+    )
+    npz = os.path.join(CACHE, f"praos_batch_b{BENCH_BATCH}_d{KES_DEPTH}.npz")
+    names = [
+        "ed_pk", "ed_r", "ed_s", "ed_hblocks", "ed_hnblocks",
+        "kes_vk", "kes_period", "kes_r", "kes_s", "kes_vk_leaf",
+        "kes_siblings", "kes_hblocks", "kes_hnblocks",
+        "vrf_pk", "vrf_gamma", "vrf_c", "vrf_s", "vrf_alpha",
+        "beta", "thr_lo", "thr_hi",
+    ]
+    if os.path.exists(npz):
+        z = np.load(npz)
+        cols = [z[n] for n in names]
+        from ouroboros_consensus_tpu.ops.ed25519_batch import Ed25519Batch
+        from ouroboros_consensus_tpu.ops.ecvrf_batch import EcvrfBatch
+        from ouroboros_consensus_tpu.ops.kes_batch import KesBatch
+
+        return pbatch.PraosBatch(
+            Ed25519Batch(*cols[0:5]), KesBatch(*cols[5:13]),
+            EcvrfBatch(*cols[13:18]), cols[18], cols[19], cols[20],
+        ), params
+
+    # forge a fresh epoch-uniform batch: distinct slots, one pool
+    # (validation cost is identical across issuers — crypto dominates)
+    pool = fixtures.make_pool(0, kes_depth=KES_DEPTH)
+    lview = fixtures.make_ledger_view([pool], stakes=None)
+    nonce = b"\x07" * 32
+    hvs = []
+    t0 = time.monotonic()
+    prev = None
+    for i in range(BENCH_BATCH):
+        hv = fixtures.forge_header_view(
+            params, pool, slot=i + 1, epoch_nonce=nonce,
+            prev_hash=prev, body_bytes=b"body-%d" % i,
+        )
+        hvs.append(hv)
+        prev = b"%032d" % i
+        if i and i % 512 == 0:
+            print(
+                f"# forged {i}/{BENCH_BATCH} ({(time.monotonic()-t0):.0f}s)",
+                file=sys.stderr,
+            )
+    pre = pbatch.host_prechecks(params, lview, hvs)
+    batch = pbatch.stage(params, lview, nonce, hvs, pre.kes_evolution)
+    os.makedirs(CACHE, exist_ok=True)
+    flat = pbatch.flatten_batch(batch)
+    np.savez_compressed(npz, **{n: np.asarray(c) for n, c in zip(names, flat)})
+    return batch, params
+
+
+def measure_cpu_baseline() -> float:
+    """Single-core libsodium-class headers/s (see module docstring)."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+    except Exception:
+        return 4200.0 / 6.0  # recorded OpenSSL rate on this image's CPU
+    sk = Ed25519PrivateKey.generate()
+    pk = sk.public_key()
+    msg = b"x" * 256
+    sig = sk.sign(msg)
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 1.0:
+        for _ in range(200):
+            pk.verify(sig, msg)
+        n += 200
+    rate = n / (time.perf_counter() - t0)
+    return rate / 6.0
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from ouroboros_consensus_tpu.protocol import batch as pbatch
+
+    batch, params = build_or_load_batch()
+    b = batch.beta.shape[0]
+    platform = jax.devices()[0].platform
+
+    # warmup: compile + first run
+    t0 = time.monotonic()
+    v = pbatch.run_batch(batch)
+    warm_s = time.monotonic() - t0
+    n_ok = int(np.sum(v.ok_ocert_sig & v.ok_kes_sig & v.ok_vrf))
+    assert n_ok == b, f"benchmark batch must verify clean: {n_ok}/{b}"
+
+    times = []
+    for _ in range(BENCH_ITERS):
+        t0 = time.perf_counter()
+        pbatch.run_batch(batch)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    rate = b / best
+
+    baseline = measure_cpu_baseline()
+    print(
+        f"# platform={platform} batch={b} warmup={warm_s:.1f}s "
+        f"best={best*1e3:.1f}ms cpu_baseline={baseline:.0f}/s",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "praos headers fully validated (Ed25519+KES+VRF+leader) per second",
+                "value": round(rate, 1),
+                "unit": "headers/s",
+                "vs_baseline": round(rate / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Transaction pool (reference: Ouroboros.Consensus.Mempool)."""
+
+from .mempool import Mempool, MempoolFull, MempoolSnapshot, TxTicket
+
+__all__ = ["Mempool", "MempoolFull", "MempoolSnapshot", "TxTicket"]

@@ -1,0 +1,183 @@
+"""Mempool: a transaction pool kept consistent with the ledger.
+
+Reference: `Ouroboros.Consensus.Mempool` (Mempool/API.hs:102 — `addTx`,
+`removeTxs`, `syncWithLedger`, `getSnapshot`, `getSnapshotFor`;
+capacity = 2 × max block size by default; FIFO order with monotonically
+increasing ticket numbers, TxSeq.hs:83).
+
+Design notes vs the reference:
+  * The reference's `TxSeq` is a strict FingerTree for O(log n) splits
+    at a ticket number; here a plain list + dict index gives the same
+    API (snapshot_after) with O(n) worst case — fine for the pool sizes
+    the capacity bound admits. The batch path that matters for TPU is
+    `get_snapshot_for`, which revalidates the whole pool against a new
+    ledger state in one pass.
+  * Validation state is maintained incrementally: the mempool caches
+    the UTxO view after applying the pool's txs, so `add_tx` validates
+    against the cached view in O(tx) (Mempool/Impl/Common.hs
+    `InternalState` analog: `isLedgerState` + `isTxs`).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+from ..ledger.mock import InvalidTx, LedgerError
+
+
+@dataclass(frozen=True)
+class TxTicket:
+    """TxSeq.hs:55 — a tx with its ticket number and byte size."""
+
+    tx: bytes
+    number: int
+    size: int
+
+
+@dataclass(frozen=True)
+class MempoolSnapshot:
+    """MempoolSnapshot (Mempool/API.hs:338): an immutable view."""
+
+    txs: tuple[TxTicket, ...]
+    ledger_slot: int | None
+    last_ticket: int
+
+    def tx_bytes(self) -> tuple[bytes, ...]:
+        return tuple(t.tx for t in self.txs)
+
+    def after(self, ticket: int) -> tuple[TxTicket, ...]:
+        """snapshotTxsAfter: txs with ticket number > `ticket` (the
+        TxSubmission server's incremental read)."""
+        return tuple(t for t in self.txs if t.number > ticket)
+
+
+class MempoolFull(Exception):
+    """Mempool capacity (in bytes) would be exceeded (TxLimits)."""
+
+
+class Mempool:
+    """The pool. Thread-safe; all mutation under one lock (the
+    reference gets atomicity from STM — Mempool/Impl/Common.hs)."""
+
+    def __init__(
+        self,
+        ledger,
+        get_ledger_state: Callable[[], object],
+        capacity_bytes: int | None = None,
+        max_block_bytes: int = 65536,
+        trace: Callable[[str], None] = lambda s: None,
+    ):
+        """`get_ledger_state` returns the current (state, slot) anchor —
+        the ChainDB's current ledger in the full node assembly."""
+        self._ledger = ledger
+        self._get_ledger_state = get_ledger_state
+        self.capacity = (
+            capacity_bytes if capacity_bytes is not None else 2 * max_block_bytes
+        )
+        self._trace = trace
+        self._lock = threading.Lock()
+        self._txs: list[TxTicket] = []
+        self._next_ticket = 1
+        self._anchor_state = None
+        self._anchor_slot: int | None = None
+        self._cached_utxo: dict | None = None
+        self._sync_locked()
+
+    # -- internal ----------------------------------------------------------
+
+    def _sync_locked(self) -> list[TxTicket]:
+        """Revalidate the pool against the current ledger anchor
+        (syncWithLedger, Mempool/API.hs:191). Returns dropped tickets."""
+        state, slot = self._get_ledger_state()
+        self._anchor_state = state
+        self._anchor_slot = slot
+        utxo = dict(state.utxo)
+        kept: list[TxTicket] = []
+        dropped: list[TxTicket] = []
+        for t in self._txs:
+            try:
+                utxo = self._ledger.apply_tx(utxo, t.tx)
+                kept.append(t)
+            except LedgerError:
+                dropped.append(t)
+        self._txs = kept
+        self._cached_utxo = utxo
+        if dropped:
+            self._trace(f"mempool: dropped {len(dropped)} txs on sync")
+        return dropped
+
+    def _size_locked(self) -> int:
+        return sum(t.size for t in self._txs)
+
+    # -- API (Mempool/API.hs:102) -----------------------------------------
+
+    def add_tx(self, tx: bytes) -> TxTicket:
+        """addTx: validate against the pool-extended ledger view, FIFO.
+
+        Raises InvalidTx (ledger rejection) or MempoolFull (capacity).
+        """
+        with self._lock:
+            if self._size_locked() + len(tx) > self.capacity:
+                raise MempoolFull(len(tx), self.capacity)
+            # validates and, on success, extends the cached view
+            self._cached_utxo = self._ledger.apply_tx(
+                dict(self._cached_utxo), tx
+            )
+            t = TxTicket(tx, self._next_ticket, len(tx))
+            self._next_ticket += 1
+            self._txs.append(t)
+            return t
+
+    def try_add_txs(self, txs: Sequence[bytes]) -> tuple[list[TxTicket], list[bytes]]:
+        """Bulk add; returns (accepted, rejected)."""
+        ok, bad = [], []
+        for tx in txs:
+            try:
+                ok.append(self.add_tx(tx))
+            except (InvalidTx, MempoolFull):
+                bad.append(tx)
+        return ok, bad
+
+    def remove_txs(self, tx_ids: Sequence[bytes]) -> None:
+        """removeTxs (Mempool/API.hs:174): drop by tx id, then
+        revalidate the remainder (a removed tx may have fed a later one)."""
+        from ..ledger.mock import tx_id as mk_id
+
+        ids = set(tx_ids)
+        with self._lock:
+            self._txs = [t for t in self._txs if mk_id(t.tx) not in ids]
+            self._sync_locked()
+
+    def sync_with_ledger(self) -> list[TxTicket]:
+        """syncWithLedger: called by the node when the chain advances."""
+        with self._lock:
+            return self._sync_locked()
+
+    def get_snapshot(self) -> MempoolSnapshot:
+        """getSnapshot: view at the current anchor."""
+        with self._lock:
+            return MempoolSnapshot(
+                tuple(self._txs), self._anchor_slot, self._next_ticket - 1
+            )
+
+    def get_snapshot_for(self, state, slot: int, max_bytes: int | None = None) -> MempoolSnapshot:
+        """getSnapshotFor (Mempool/API.hs:203): revalidate against a
+        GIVEN ticked ledger state (the forge path: NodeKernel.hs:348-375)
+        without mutating the pool; optionally cap to a block's budget."""
+        with self._lock:
+            txs = list(self._txs)
+        utxo = dict(state.utxo)
+        kept: list[TxTicket] = []
+        used = 0
+        for t in txs:
+            if max_bytes is not None and used + t.size > max_bytes:
+                break
+            try:
+                utxo = self._ledger.apply_tx(utxo, t.tx)
+            except LedgerError:
+                continue
+            kept.append(t)
+            used += t.size
+        return MempoolSnapshot(tuple(kept), slot, self._next_ticket - 1)

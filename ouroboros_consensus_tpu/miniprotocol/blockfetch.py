@@ -1,0 +1,116 @@
+"""BlockFetch mini-protocol: download bodies for preferred candidates.
+
+Reference: `MiniProtocol/BlockFetch/{ClientInterface,Server}.hs` plus the
+fetch-decision logic the consensus layer feeds (preferAnchoredCandidate:
+only fetch candidates strictly better than our chain by the protocol's
+SelectView order). The full network-layer fetch governor (multi-peer
+de-duplication, in-flight limits) is out of scope for the sim harness —
+one fetch client per peer requests the candidate suffix it is missing
+and pushes completed blocks into the ChainDB (addBlockAsync sink,
+ClientInterface.hs mkBlockFetchConsensusInterface).
+
+Wire messages:
+  client → server: ("request_range", Point_from_exclusive|None, Point_to)
+                   ("done",)
+  server → client: ("start_batch",) ("block", block_bytes) ("batch_done",)
+                   ("no_blocks",)
+"""
+
+from __future__ import annotations
+
+from ..block.abstract import Point
+from ..block.praos_block import Block
+from ..utils.sim import Recv, Send, Sleep
+
+
+def server(chain_db, rx, tx):
+    """Serve block bodies from the ChainDB (Server.hs)."""
+    while True:
+        msg = yield Recv(rx)
+        if msg[0] == "done":
+            return
+        if msg[0] != "request_range":
+            raise RuntimeError(f"blockfetch server: bad message {msg[0]!r}")
+        _from, to = msg[1], msg[2]
+        # collect the requested window from our chain (volatile part —
+        # candidates only ever reference recent blocks)
+        chain = list(chain_db.current_chain)
+        out = []
+        seen_from = _from is None
+        for b in chain:
+            if not seen_from:
+                if b.point == _from:
+                    seen_from = True
+                continue
+            out.append(b)
+            if b.point == to:
+                break
+        else:
+            if out and out[-1].point != to:
+                out = []
+        if not out:
+            # the chain may have switched away from the candidate
+            yield Send(tx, ("no_blocks",))
+            continue
+        yield Send(tx, ("start_batch",))
+        for b in out:
+            yield Send(tx, ("block", b.bytes_))
+        yield Send(tx, ("batch_done",))
+
+
+def client(node, peer_name: str, rx, tx, candidate, *, poll_interval: float = 0.05, rounds: int | None = None):
+    """Fetch-decision + download loop for one peer.
+
+    Watches the peer's ChainSync candidate; when the candidate is
+    preferred over our current chain (longer per PraosChainSelectView —
+    via node.protocol.compare_candidates on select views), requests the
+    missing suffix and feeds blocks to the ChainDB.
+    """
+    done = 0
+    while rounds is None or done < rounds:
+        headers = list(candidate.headers)
+        if not headers:
+            yield Sleep(poll_interval)
+            done += 1
+            continue
+        # fetch only headers we don't already have on our chain
+        have = {b.hash_ for b in node.chain_db.current_chain}
+        missing = [h for h in headers if h.hash_ not in have]
+        if not missing:
+            yield Sleep(poll_interval)
+            done += 1
+            continue
+        if not node.prefer_candidate(headers):
+            yield Sleep(poll_interval)
+            done += 1
+            continue
+        frm = missing[0].prev_hash
+        frm_point = None
+        if frm is not None:
+            # the fetch range anchor: the predecessor's point
+            for h in headers:
+                if h.hash_ == frm:
+                    frm_point = h.point
+                    break
+            if frm_point is None:
+                for b in node.chain_db.current_chain:
+                    if b.hash_ == frm:
+                        frm_point = b.point
+                        break
+        yield Send(tx, ("request_range", frm_point, missing[-1].point))
+        msg = yield Recv(rx)
+        if msg[0] == "no_blocks":
+            yield Sleep(poll_interval)
+            done += 1
+            continue
+        assert msg[0] == "start_batch", msg
+        while True:
+            msg = yield Recv(rx)
+            if msg[0] == "batch_done":
+                break
+            assert msg[0] == "block", msg
+            block = Block.from_bytes(msg[1])
+            res = node.chain_db.add_block(block)
+            if res.selected:
+                node.on_chain_changed()
+        done += 1

@@ -1,0 +1,205 @@
+"""ChainSync mini-protocol: header diffusion with per-peer validation.
+
+Reference: `MiniProtocol/ChainSync/{Client,Server}.hs`. The server feeds
+headers of its current chain to the client from a ChainDB follower; the
+client validates EVERY header (full crypto via the protocol instance —
+Client.hs:55-57 → validateHeader) before extending its candidate
+fragment, and disconnects the peer on the first invalid header
+(ChainSyncClientException, Client.hs:1142).
+
+Wire messages (typed-protocols codec analog — plain tuples over a
+sim/asyncio Channel):
+  client → server:  ("find_intersect", [Point])
+                    ("request_next",)
+  server → client:  ("intersect_found", Point|None, tip)
+                    ("intersect_not_found", tip)
+                    ("roll_forward", header_bytes, tip)
+                    ("roll_backward", Point|None, tip)
+
+The client tracks the candidate as (headers, header_states) so a
+roll_backward is a O(1) truncation with the protocol state restored from
+the kept prefix — the reference's `theirHeaderStateHistory`
+(Client.hs:291, HeaderStateHistory.hs).
+
+Both ends are written as generator tasks for the deterministic sim
+runtime (utils/sim.py); the same logic drives the asyncio TCP transport.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..block.abstract import Point
+from ..block.praos_block import Header
+from ..protocol import praos as praos_mod
+from ..utils.sim import Recv, Send, Sleep
+
+K_DEFAULT = 2160
+
+
+class ChainSyncClientException(Exception):
+    """Peer sent an invalid header / violated the protocol: disconnect
+    (the rethrow-policy 'disconnect peer' class, Node/RethrowPolicy.hs)."""
+
+
+@dataclass
+class Candidate:
+    """Per-peer candidate fragment + protocol states per position.
+
+    Invariant: len(states) == len(headers) + 1 — states[0] is the
+    protocol state at the intersection (anchor), states[i+1] the state
+    after validating headers[i]. This is theirHeaderStateHistory
+    (Client.hs:291) with O(1) rollback.
+    """
+
+    headers: list = field(default_factory=list)
+    states: list = field(default_factory=list)
+
+    def tip_point(self) -> Point | None:
+        return self.headers[-1].point if self.headers else None
+
+    def reset(self, base_state) -> None:
+        self.headers = []
+        self.states = [base_state]
+
+    def extend(self, header, state) -> None:
+        self.headers.append(header)
+        self.states.append(state)
+
+    def truncate_to(self, point: Point | None) -> bool:
+        """Roll back the suffix to `point` (None = back to the anchor).
+        False if the point is not on the candidate."""
+        if point is None:
+            del self.headers[:]
+            del self.states[1:]
+            return True
+        for i in range(len(self.headers) - 1, -1, -1):
+            if self.headers[i].point == point:
+                del self.headers[i + 1 :]
+                del self.states[i + 2 :]
+                return True
+        return False
+
+
+def server(chain_db, rx, tx, *, poll_interval: float = 0.05):
+    """ChainSync server task (Server.hs): answer find_intersect from the
+    current chain, then stream follower updates as roll_forward /
+    roll_backward."""
+    follower = chain_db.new_follower()
+    # pending instructions not yet sent (beyond the intersection)
+    pending: list = []
+    intersect_done = False
+
+    def tip():
+        return chain_db.tip_point()
+
+    while True:
+        msg = yield Recv(rx)
+        kind = msg[0]
+        if kind == "find_intersect":
+            # drain stale follower updates: everything up to NOW is
+            # covered by the chain snapshot taken below
+            follower.take_updates()
+            points = msg[1]
+            ours = {b.point: i for i, b in enumerate(chain_db.current_chain)}
+            found = None
+            for p in points:
+                if p in ours or p == chain_db._anchor_point():
+                    found = p
+                    break
+                if p is None:
+                    found = None
+                    break
+            if found is not None or (points and points[-1] is None):
+                # serve everything after the intersection
+                pending.clear()
+                start = ours[found] + 1 if found in ours else 0
+                for b in chain_db.current_chain[start:]:
+                    pending.append(("addblock", b))
+                intersect_done = True
+                yield Send(tx, ("intersect_found", found, tip()))
+            else:
+                yield Send(tx, ("intersect_not_found", tip()))
+        elif kind == "request_next":
+            if not intersect_done:
+                raise RuntimeError("request_next before find_intersect")
+            while True:
+                pending.extend(follower.take_updates())
+                if pending:
+                    break
+                yield Sleep(poll_interval)  # MustReply/await analog
+            op = pending.pop(0)
+            if op[0] == "rollback":
+                yield Send(tx, ("roll_backward", op[1], tip()))
+            else:
+                yield Send(tx, ("roll_forward", op[1].header.bytes_, tip()))
+        elif kind == "done":
+            return
+        else:
+            raise RuntimeError(f"chainsync server: bad message {kind!r}")
+
+
+def client(
+    node,
+    peer_name: str,
+    rx,
+    tx,
+    candidate: Candidate,
+    *,
+    max_headers: int | None = None,
+):
+    """ChainSync client task (Client.hs:422).
+
+    `node` provides: .protocol (instances.PraosProtocol-shaped),
+    .chain_db, .ledger_view_at(slot) — the forecast (bounded-horizon
+    ledger view, Forecast.hs; static for the mock ledger).
+
+    Validates each roll_forward header against the candidate's protocol
+    state (full crypto) and extends the candidate; blockfetch drains it.
+    """
+    # findIntersect with points of our current chain (newest first —
+    # Client.hs:464 uses the standard exponentially-spaced offsets; the
+    # dense recent prefix suffices for test chains)
+    our_points = [b.point for b in reversed(node.chain_db.current_chain)]
+    our_points.append(None)  # genesis fallback
+    yield Send(tx, ("find_intersect", our_points))
+    msg = yield Recv(rx)
+    if msg[0] == "intersect_not_found":
+        raise ChainSyncClientException(f"{peer_name}: no intersection")
+    intersection = msg[1]
+
+    # seed candidate protocol state from OUR state at the intersection
+    # (the candidate implicitly shares our chain up to it)
+    candidate.reset(node.chain_dep_state_at(intersection))
+
+    n = 0
+    while max_headers is None or n < max_headers:
+        yield Send(tx, ("request_next",))
+        msg = yield Recv(rx)
+        kind = msg[0]
+        if kind == "roll_forward":
+            header = Header.from_bytes(msg[1])
+            base = candidate.states[-1]
+            lview = node.ledger_view_at(header.slot)
+            ticked = node.protocol.tick(lview, header.slot, base)
+            try:
+                new_st = node.protocol.update(
+                    header.to_view(), header.slot, ticked
+                )
+            except praos_mod.PraosValidationError as e:
+                raise ChainSyncClientException(
+                    f"{peer_name}: invalid header at slot {header.slot}: {e!r}"
+                ) from e
+            candidate.extend(header, new_st)
+            n += 1
+        elif kind == "roll_backward":
+            point = msg[1]
+            target = None if point == intersection else point
+            if not candidate.truncate_to(target):
+                raise ChainSyncClientException(
+                    f"{peer_name}: rollback to unknown point {point}"
+                )
+            n += 1
+        else:
+            raise ChainSyncClientException(f"{peer_name}: bad message {kind!r}")

@@ -1,0 +1,162 @@
+"""NodeKernel: the node's organs wired together.
+
+Reference: `ouroboros-consensus-diffusion` `NodeKernel.hs:88-114` — the
+kernel owns the ChainDB, mempool, per-peer candidate map and the forging
+loop (`forkBlockForging`, NodeKernel.hs:237-436). Here the kernel is a
+plain object whose loops are sim-runtime generator tasks (utils/sim.py),
+so an N-node network runs deterministically in one process
+(testing/threadnet.py) — the ThreadNet architecture.
+
+Forging loop per slot (NodeKernel.hs:253-425 condensed to the mock-era
+shape): current tip → past ledger → forecast ledger view → tick chain-dep
+state → check_is_leader (VRF eval) → tick ledger → mempool snapshot →
+forge_block (KES sign) → add to own ChainDB → mempool sync on adoption.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..block.abstract import Point
+from ..block.forge import forge_block
+from ..mempool import Mempool
+from ..miniprotocol.chainsync import Candidate
+from ..protocol import praos as praos_mod
+from ..utils.sim import Sleep
+
+
+@dataclass
+class SlotClock:
+    """BlockchainTime analog (BlockchainTime/API.hs:30): virtual-time
+    slot clock — slot s starts at t0 + s*slot_length."""
+
+    slot_length: float = 1.0
+    t0: float = 0.0
+
+    def slot_of(self, now: float) -> int:
+        return max(0, int((now - self.t0) / self.slot_length))
+
+    def start_of(self, slot: int) -> float:
+        return self.t0 + slot * self.slot_length
+
+
+class NodeKernel:
+    """One node: ChainDB + mempool + protocol + credentials."""
+
+    def __init__(
+        self,
+        name: str,
+        chain_db,
+        protocol,
+        ledger,
+        pool=None,  # PoolCredentials when this node forges
+        clock: SlotClock | None = None,
+        trace: Callable[[str], None] = lambda s: None,
+    ):
+        self.name = name
+        self.chain_db = chain_db
+        self.protocol = protocol
+        self.ledger = ledger
+        self.pool = pool
+        self.clock = clock or SlotClock()
+        self.trace = trace
+        self.candidates: dict[str, Candidate] = {}  # per-peer
+        self.mempool = Mempool(
+            ledger,
+            lambda: (
+                chain_db.current_ledger().ledger_state,
+                chain_db.current_ledger().header_state.tip.slot
+                if chain_db.current_ledger().header_state.tip
+                else None,
+            ),
+        )
+        self._ocert_counter = 0
+
+    # -- hooks used by the miniprotocol clients ---------------------------
+
+    def ledger_view_at(self, slot: int):
+        """Forecast of the ledger view for `slot` (Forecast.hs) — the
+        mock ledger's view is slot-independent within the horizon."""
+        fc = self.ledger.ledger_view_forecast_at(
+            self.chain_db.current_ledger().ledger_state
+        )
+        return fc.forecast_for(slot)
+
+    def chain_dep_state_at(self, point: Point | None):
+        """Protocol state after `point` on OUR chain (for seeding a
+        peer candidate at the intersection)."""
+        ext = self.chain_db.get_past_ledger(point)
+        if ext is None:
+            raise ValueError(f"{self.name}: no ledger state at {point}")
+        return ext.header_state.chain_dep_state
+
+    def prefer_candidate(self, cand_headers: list) -> bool:
+        """preferAnchoredCandidate (BlockFetch/ClientInterface.hs): is
+        the candidate strictly better than our current selection?"""
+        if not cand_headers:
+            return False
+        ours = self.chain_db.tip_header()
+        if ours is None:
+            return True
+        our_sv = self.protocol.select_view(ours)
+        their_sv = self.protocol.select_view(cand_headers[-1])
+        # compare_candidates > 0 iff `theirs` strictly preferred
+        return self.protocol.compare_candidates(our_sv, their_sv) > 0
+
+    # -- forging (NodeKernel.hs:237-436) ----------------------------------
+
+    def try_forge(self, slot: int):
+        """One forging opportunity: returns the forged Block or None."""
+        if self.pool is None:
+            return None
+        ext = self.chain_db.current_ledger()
+        lview = self.ledger_view_at(slot)
+        ticked = self.protocol.tick(lview, slot, ext.header_state.chain_dep_state)
+        is_leader = self.protocol.check_is_leader(
+            self._can_be_leader(), slot, ticked
+        )
+        if is_leader is None:
+            return None
+        tip = self.chain_db.tip_point()
+        block_no = (self.chain_db.tip_block_no() or 0) + 1 if tip else 0
+        snap = self.mempool.get_snapshot_for(
+            self.ledger.tick(ext.ledger_state, slot).state, slot
+        )
+        block = forge_block(
+            self.protocol.params,
+            self.pool,
+            slot=slot,
+            block_no=block_no,
+            prev_hash=tip.hash_ if tip else None,
+            epoch_nonce=ticked.state.epoch_nonce,
+            txs=snap.tx_bytes(),
+            ocert_counter=self._ocert_counter,
+            is_leader=is_leader,
+        )
+        res = self.chain_db.add_block(block)
+        if res.selected:
+            self.trace(f"{self.name}: forged+adopted block {block_no}@{slot}")
+            self.mempool.sync_with_ledger()
+        else:
+            # self-forged block not adopted — the adoption check would
+            # purge its txs (NodeKernel.hs:402-425); sync covers it
+            self.trace(f"{self.name}: forged block not adopted @{slot}")
+        return block
+
+    def _can_be_leader(self):
+        from ..testing.fixtures import can_be_leader
+
+        return can_be_leader(self.pool, counter=self._ocert_counter)
+
+    def forging_loop(self, n_slots: int):
+        """Sim task: wake at every slot start (knownSlotWatcher,
+        BlockchainTime/API.hs:59) and attempt to forge."""
+        for slot in range(n_slots):
+            # sleep until the slot starts (virtual time)
+            yield Sleep(self.clock.slot_length)
+            self.try_forge(slot)
+
+    def on_chain_changed(self):
+        """Post-adoption bookkeeping shared by fetch/forge paths."""
+        self.mempool.sync_with_ledger()

@@ -1,0 +1,121 @@
+"""Multi-chip SPMD fan-out of the Praos validation hot path.
+
+The reference's hot loop is one OS thread validating one header at a time
+(SURVEY.md §2.6 "Sequential hot loop"; ledgerDbPushMany fold,
+LedgerDB/Update.hs:302-312). The TPU-native design replaces it with
+batch × device data parallelism over a `jax.sharding.Mesh`:
+
+  * every column of the staged `PraosBatch` has leading batch dim B and
+    per-lane-independent compute, so the natural sharding is P('batch')
+    on axis 0 across all chips (ICI all the way — no host hops);
+  * the only cross-device communication is the verdict reduction: a
+    `psum` of the per-shard valid counts and a `pmin` of the global
+    index of the first failing lane (SURVEY.md §5.8: "collectives only
+    appear ... as psum/all_gather over verification verdict bitmaps");
+  * the per-header nonce values (eta) stay device-resident sharded and
+    are gathered once per batch for the tiny sequential host fold.
+
+This module is exercised on a virtual 8-device CPU mesh in tests and by
+the driver's `dryrun_multichip`; on real hardware the same code spans a
+TPU pod slice (mesh axis over all chips of the slice).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax import numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..protocol import batch as pbatch
+
+BATCH_AXIS = "batch"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """1-D device mesh over the batch axis.
+
+    The validation workload has a single parallel dimension (chain
+    position), so the mesh is 1-D; on a multi-host pod slice the same
+    axis simply spans all global devices (jax.devices() is global under
+    multi-host jax.distributed initialization).
+    """
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (BATCH_AXIS,))
+
+
+def pad_batch(batch: pbatch.PraosBatch, multiple: int):
+    """Pad every column of `batch` to a batch size divisible by
+    `multiple`, returning (padded_batch, original_size).
+
+    Pad lanes replicate lane 0 (guaranteed decodable inputs) — their
+    verdicts are sliced off before the host epilogue, and the
+    first-failure reduction masks them out by position.
+    """
+    b = batch.beta.shape[0]
+    target = b + ((-b) % multiple)
+    return pbatch.pad_batch_to(batch, target), b
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _sharded_verify(mesh, *cols):
+    """jit-of-shard_map: local fused verify + global verdict collectives."""
+
+    def local_step(*local_cols):
+        v = pbatch.verify_praos(*local_cols)
+        ok = v.ok_ocert_sig & v.ok_kes_sig & v.ok_vrf & (
+            v.ok_leader | v.leader_ambiguous
+        )
+        # global chain positions of this shard's lanes
+        shard = jax.lax.axis_index(BATCH_AXIS)
+        n_local = ok.shape[0]
+        pos = shard * n_local + jnp.arange(n_local, dtype=jnp.int32)
+        big = jnp.iinfo(jnp.int32).max
+        local_first_bad = jnp.min(jnp.where(ok, big, pos))
+        first_bad = jax.lax.pmin(local_first_bad, BATCH_AXIS)
+        n_ok = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
+        return v, ok, first_bad, n_ok
+
+    spec = P(BATCH_AXIS)
+    out = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=tuple(spec for _ in cols),
+        out_specs=(
+            pbatch.Verdicts(*(spec,) * 7),
+            spec,
+            P(),  # first_bad: replicated scalar
+            P(),  # n_ok: replicated scalar
+        ),
+        check_vma=False,
+    )(*cols)
+    return out
+
+
+def sharded_run_batch(batch: pbatch.PraosBatch, mesh: Mesh | None = None):
+    """Device-parallel `protocol.batch.run_batch`: shard the staged batch
+    over the mesh, verify, reduce verdicts with collectives.
+
+    Returns (Verdicts as host numpy sliced to the true batch size,
+    first_bad_index or None, n_ok) — drop-in for the sequential epilogue
+    in `validate_batch`.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    n_dev = mesh.devices.size
+    padded, b = pad_batch(batch, n_dev)
+    cols = [
+        jax.device_put(
+            np.asarray(c), NamedSharding(mesh, P(BATCH_AXIS))
+        )
+        for c in pbatch.flatten_batch(padded)
+    ]
+    v, ok, first_bad, n_ok = _sharded_verify(mesh, *cols)
+    v = pbatch.Verdicts(*(np.asarray(x)[:b] for x in v))
+    ok = np.asarray(ok)[:b]
+    fb = int(first_bad)
+    n_pad_ok = int(np.sum(np.asarray(ok))) if b else 0
+    return v, (fb if fb < b else None), n_pad_ok

@@ -271,22 +271,64 @@ def verify_praos(
 _JIT: dict = {}
 
 
-def run_batch(batch: PraosBatch) -> Verdicts:
-    """Stage -> device -> host verdict arrays (numpy)."""
+def flatten_batch(batch: PraosBatch) -> list:
+    """PraosBatch -> flat array list in verify_praos argument order."""
+    return [*batch.ed, *batch.kes, *batch.vrf, batch.beta, batch.thr_lo, batch.thr_hi]
+
+
+def pad_batch_to(batch: PraosBatch, size: int) -> PraosBatch:
+    """Pad every column's batch dim up to `size` by replicating lane 0
+    (guaranteed-decodable inputs; callers slice verdicts back to the true
+    size). Keeps the jit cache bounded: one compilation per bucket shape
+    instead of one per epoch-segment length."""
+    b = batch.beta.shape[0]
+    if b == size:
+        return batch
+
+    def _pad(x):
+        x = np.asarray(x)
+        return np.concatenate([x, np.repeat(x[:1], size - b, axis=0)], axis=0)
+
+    def _pad_tuple(t):
+        return type(t)(*(_pad(c) for c in t))
+
+    return PraosBatch(
+        ed=_pad_tuple(batch.ed),
+        kes=_pad_tuple(batch.kes),
+        vrf=_pad_tuple(batch.vrf),
+        beta=_pad(batch.beta),
+        thr_lo=_pad(batch.thr_lo),
+        thr_hi=_pad(batch.thr_hi),
+    )
+
+
+def bucket_size(b: int, minimum: int = 8) -> int:
+    """Next power-of-two bucket for a batch of b lanes."""
+    n = minimum
+    while n < b:
+        n *= 2
+    return n
+
+
+def _jitted_verify():
     import jax
 
-    key = (batch.kes.siblings.shape[-2],)
-    if key not in _JIT:
-        _JIT[key] = jax.jit(verify_praos)
-    out = _JIT[key](
-        *(jnp.asarray(x) for x in batch.ed),
-        *(jnp.asarray(x) for x in batch.kes),
-        *(jnp.asarray(x) for x in batch.vrf),
-        jnp.asarray(batch.beta),
-        jnp.asarray(batch.thr_lo),
-        jnp.asarray(batch.thr_hi),
-    )
-    return Verdicts(*(np.asarray(x) for x in out))
+    if "fn" not in _JIT:
+        _JIT["fn"] = jax.jit(verify_praos)
+    return _JIT["fn"]
+
+
+def run_batch(batch: PraosBatch) -> Verdicts:
+    """Stage -> device -> host verdict arrays (numpy).
+
+    Batches are padded to power-of-two buckets so jax's per-shape trace
+    cache compiles once per (bucket, kes_depth) — the crypto graph is
+    large and arbitrary-length recompiles would dominate wall-clock.
+    """
+    b = batch.beta.shape[0]
+    padded = pad_batch_to(batch, bucket_size(b))
+    out = _jitted_verify()(*(jnp.asarray(x) for x in flatten_batch(padded)))
+    return Verdicts(*(np.asarray(x)[:b] for x in out))
 
 
 # ---------------------------------------------------------------------------

@@ -56,12 +56,18 @@ class Follower:
         self.db = db
         self.updates: list = []  # ("rollback", Point|None) | ("addblock", Block)
 
-    def _notify_switch(self, rollback_to: Point | None, new_blocks: Sequence[Block]):
-        if rollback_to is not None or new_blocks:
-            if rollback_to is not None:
-                self.updates.append(("rollback", rollback_to))
-            for b in new_blocks:
-                self.updates.append(("addblock", b))
+    def _notify_switch(
+        self,
+        rolled_back: bool,
+        rollback_to: Point | None,
+        new_blocks: Sequence[Block],
+    ):
+        # `rolled_back` distinguishes "no rollback" from "rollback to
+        # genesis" — rollback_to is None in BOTH cases
+        if rolled_back:
+            self.updates.append(("rollback", rollback_to))
+        for b in new_blocks:
+            self.updates.append(("addblock", b))
 
     def take_updates(self) -> list:
         out, self.updates = self.updates, []
@@ -250,20 +256,28 @@ class ChainDB:
             return None
         proto = self.ext.protocol
 
-        def view_of(c):
-            blocks = self._load_fragment(c)
-            if blocks is None:
+        # compare by TIP select-view only (sortCandidates, ChainSel.hs:874
+        # orders on the tip's SelectView) — parsing whole fragments here
+        # would cost O(k) block reads per incoming block on the hot path
+        def tip_view(c):
+            raw = self.volatile.get_block_bytes(c[-1])
+            if raw is None:
                 return None
-            return (blocks, proto.select_view(blocks[-1].header))
+            return proto.select_view(Block.from_bytes(raw).header)
 
-        best = None
-        for c in cands:
-            bv = view_of(c)
-            if bv is None:
-                continue
-            if best is None or proto.compare_candidates(best[1], bv[1]) > 0:
-                best = bv
-        return best[0] if best else None
+        ranked = [(c, v) for c in cands if (v := tip_view(c)) is not None]
+        # best-first: load the full fragment only for the winner; fall
+        # back to the next candidate if a body went missing (GC race)
+        while ranked:
+            best_i = 0
+            for i in range(1, len(ranked)):
+                if proto.compare_candidates(ranked[best_i][1], ranked[i][1]) > 0:
+                    best_i = i
+            c, _ = ranked.pop(best_i)
+            blocks = self._load_fragment(c)
+            if blocks is not None:
+                return blocks
+        return None
 
     # -- chain selection for a new block (ChainSel.hs:440) -------------------
 
@@ -378,7 +392,7 @@ class ChainDB:
             rollback_point = None
         self.current_chain.extend(suffix)
         for f in self.followers:
-            f._notify_switch(rollback_point, suffix)
+            f._notify_switch(n_rollback > 0, rollback_point, suffix)
         self._copy_and_gc()
 
     def close(self) -> None:

@@ -106,8 +106,10 @@ class ImmutableDB:
                 break
             self._entries[n] = entries
             self._chunks.append(n)
-            if deep and self._truncated.get(n):
-                # tail truncated inside this chunk: later chunks are invalid
+            if self._truncated.get(n):
+                # truncated inside this chunk (deep check OR a reparse of
+                # a stale/missing index): later chunks would leave a gap
+                # in the chain — drop them (truncate-corrupted-tail)
                 for m in chunks[i + 1 :]:
                     self._remove_chunk(m)
                 break
@@ -311,9 +313,18 @@ class ImmutableDB:
                 yield e, data[e.offset : e.offset + e.size]
 
     def stream_from(self, after_slot: int) -> Iterator[tuple[IndexEntry, bytes]]:
-        for e, raw in self.stream_all():
-            if e.slot > after_slot:
-                yield e, raw
+        """Stream blocks with slot > after_slot, seeking to the first
+        relevant chunk instead of scanning from genesis (snapshot-resume
+        replay, LedgerDB/Init.hs:116 — must not reread the whole DB)."""
+        for n in self._chunks:
+            entries = self._entries[n]
+            if not entries or entries[-1].slot <= after_slot:
+                continue  # chunk entirely at or before the snapshot point
+            with open(os.path.join(self.path, _chunk_name(n)), "rb") as f:
+                data = f.read()
+            for e in entries:
+                if e.slot > after_slot:
+                    yield e, data[e.offset : e.offset + e.size]
 
     def truncate_after(self, point: Point | None) -> None:
         """db-truncater (Tools/DBTruncater/Run.hs): drop everything after

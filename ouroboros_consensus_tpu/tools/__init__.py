@@ -1,0 +1,3 @@
+"""CLI tools (reference: ouroboros-consensus-cardano src/tools):
+db_synthesizer (chain forging), db_analyser (validation + benchmarks),
+db_truncater, immdb_server."""

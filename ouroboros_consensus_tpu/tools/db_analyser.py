@@ -1,0 +1,232 @@
+"""db-analyser: stream a stored chain and validate / benchmark it.
+
+Reference: `Cardano.Tools.DBAnalyser` (Analysis.hs:75-88, Run.hs:42-151).
+Implemented analyses:
+
+  * ``only_validation`` — open the ImmutableDB with full integrity
+    checking (ValidateAllChunks analog: reparse + body-hash check per
+    block, Run.hs:133-143) and run full header revalidation. With the
+    ``device`` backend the Praos crypto executes as epoch-segmented
+    fused TPU batches (protocol/batch.py); with the ``host`` backend it
+    folds the sequential pure-Python reference path — the same work the
+    reference's libsodium-backed fold does.
+  * ``benchmark_ledger_ops`` — per-block timing of forecast / header
+    tick / header apply / ledger tick / ledger apply, CSV rows matching
+    the reference's SlotDataPoint columns (Analysis.hs:526-607). Host
+    backend only (per-block timing is meaningless inside a fused batch).
+  * ``count_blocks`` — CountBlocks analog.
+
+The device path is the north-star benchmark: headers validated/sec over
+a db-synthesizer chain.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..block.praos_block import Block, Header
+from ..protocol import batch as pbatch
+from ..protocol import praos
+from ..protocol.praos import PraosParams, PraosState
+from ..protocol.views import LedgerView
+from ..storage.immutable import ImmutableDB
+from ..storage.open import default_check_integrity
+
+
+@dataclass
+class ValidationResult:
+    n_blocks: int = 0
+    n_valid: int = 0
+    wall_s: float = 0.0
+    stage_s: float = 0.0  # host SoA staging time (device backend)
+    device_s: float = 0.0  # kernel execution time (device backend)
+    error: Exception | None = None
+    final_state: PraosState | None = None
+
+
+@dataclass
+class SlotDataPoint:
+    """One CSV row of benchmark_ledger_ops (SlotDataPoint.hs)."""
+
+    slot: int
+    block_no: int
+    block_bytes: int
+    mut_forecast_us: float
+    mut_header_tick_us: float
+    mut_header_apply_us: float
+    mut_block_tick_us: float
+    mut_block_apply_us: float
+
+    CSV_HEADER = (
+        "slot,block_no,block_bytes,mut_forecast,mut_headerTick,"
+        "mut_headerApply,mut_blockTick,mut_blockApply"
+    )
+
+    def csv(self) -> str:
+        return (
+            f"{self.slot},{self.block_no},{self.block_bytes},"
+            f"{self.mut_forecast_us:.1f},{self.mut_header_tick_us:.1f},"
+            f"{self.mut_header_apply_us:.1f},{self.mut_block_tick_us:.1f},"
+            f"{self.mut_block_apply_us:.1f}"
+        )
+
+
+def open_immutable(db_path: str, validate_all: bool = False) -> ImmutableDB:
+    import os
+
+    return ImmutableDB(
+        os.path.join(db_path, "immutable"),
+        check_integrity=default_check_integrity if validate_all else None,
+        validate_all=validate_all,
+    )
+
+
+def _epoch_segments(params: PraosParams, headers):
+    """Cut a header stream at epoch boundaries (SURVEY.md §5.7: nonce and
+    pool distribution are epoch-constant, so a batch spans one epoch)."""
+    seg: list = []
+    epoch = None
+    for h in headers:
+        e = params.epoch_of(h.slot)
+        if epoch is None or e == epoch:
+            seg.append(h)
+            epoch = e
+        else:
+            yield seg
+            seg = [h]
+            epoch = e
+    if seg:
+        yield seg
+
+
+def revalidate(
+    db_path: str,
+    params: PraosParams,
+    lview: LedgerView,
+    backend: str = "device",
+    validate_all: bool = True,
+    max_batch: int = 8192,
+    trace=lambda s: None,
+) -> ValidationResult:
+    """only-validation analysis: full chain revalidation from genesis.
+
+    backend="device": epoch-segmented batches through the fused kernel
+    (further split at max_batch to bound device memory; the jit caches
+    per padded shape).
+    backend="host": the sequential fold (reference semantics, pure host).
+    """
+    res = ValidationResult()
+    t0 = time.monotonic()
+    imm = open_immutable(db_path, validate_all=validate_all)
+
+    def headers():
+        for entry, raw in imm.stream_all():
+            res.n_blocks += 1
+            yield Block.from_bytes(raw).header
+
+    st = PraosState()
+    if backend == "host":
+        try:
+            for h in headers():
+                hv = h.to_view()
+                ticked = praos.tick(params, lview, h.slot, st)
+                st = praos.update(params, hv, h.slot, ticked)
+                res.n_valid += 1
+        except praos.PraosValidationError as e:
+            res.error = e
+    elif backend == "device":
+        done = False
+        for seg in _epoch_segments(params, headers()):
+            if done:
+                break
+            for i in range(0, len(seg), max_batch):
+                sub = seg[i : i + max_batch]
+                hvs = [h.to_view() for h in sub]
+                ticked = praos.tick(params, lview, sub[0].slot, st)
+                ts = time.monotonic()
+                result = pbatch.validate_batch(params, ticked, hvs)
+                res.device_s += time.monotonic() - ts
+                st = result.state
+                res.n_valid += result.n_valid
+                if result.error is not None:
+                    res.error = result.error
+                    done = True
+                    break
+                trace(f"validated {res.n_valid} headers")
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+
+    res.final_state = st
+    res.wall_s = time.monotonic() - t0
+    return res
+
+
+def benchmark_ledger_ops(
+    db_path: str,
+    params: PraosParams,
+    lview: LedgerView,
+    ledger=None,
+    genesis_state=None,
+    out_csv=None,
+) -> list[SlotDataPoint]:
+    """Per-block μs timings of the five ledger ops (Analysis.hs:526-607).
+
+    The ledger tick/apply columns use the mock ledger when one is given
+    (matching the reference, where ledger cost dwarfs header cost only
+    on real eras); header columns always run the host Praos path.
+    """
+    imm = open_immutable(db_path, validate_all=False)
+    rows: list[SlotDataPoint] = []
+    st = PraosState()
+    lst = genesis_state
+    for entry, raw in imm.stream_all():
+        block = Block.from_bytes(raw)
+        h = block.header
+        hv = h.to_view()
+
+        t = time.monotonic()
+        # forecast: ledger view at the header's slot (epoch-constant here)
+        _ = lview
+        forecast_us = (time.monotonic() - t) * 1e6
+
+        t = time.monotonic()
+        ticked = praos.tick(params, lview, h.slot, st)
+        header_tick_us = (time.monotonic() - t) * 1e6
+
+        t = time.monotonic()
+        st = praos.update(params, hv, h.slot, ticked)
+        header_apply_us = (time.monotonic() - t) * 1e6
+
+        block_tick_us = block_apply_us = 0.0
+        if ledger is not None and lst is not None:
+            t = time.monotonic()
+            tls = ledger.tick(lst, h.slot)
+            block_tick_us = (time.monotonic() - t) * 1e6
+            t = time.monotonic()
+            lst = ledger.apply_block(tls, block)
+            block_apply_us = (time.monotonic() - t) * 1e6
+
+        rows.append(
+            SlotDataPoint(
+                slot=h.slot,
+                block_no=h.block_no,
+                block_bytes=len(raw),
+                mut_forecast_us=forecast_us,
+                mut_header_tick_us=header_tick_us,
+                mut_header_apply_us=header_apply_us,
+                mut_block_tick_us=block_tick_us,
+                mut_block_apply_us=block_apply_us,
+            )
+        )
+    if out_csv is not None:
+        with open(out_csv, "w") as f:
+            f.write(SlotDataPoint.CSV_HEADER + "\n")
+            for r in rows:
+                f.write(r.csv() + "\n")
+    return rows
+
+
+def count_blocks(db_path: str) -> int:
+    imm = open_immutable(db_path)
+    return imm.n_blocks()

@@ -26,3 +26,7 @@ jax.config.update("jax_platforms", "cpu")
 # (must go through jax.config — env vars are ignored after `import jax`)
 jax.config.update("jax_compilation_cache_dir", "/tmp/ouroboros-jax-cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running integration test")

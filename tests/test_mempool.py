@@ -1,0 +1,114 @@
+"""Mempool semantics (reference: Test/Consensus/Mempool.hs — validity
+consistent with ledger, FIFO ticket order, capacity, sync-on-reorg)."""
+
+import pytest
+
+from ouroboros_consensus_tpu.ledger.mock import (
+    InvalidTx,
+    MockConfig,
+    MockLedger,
+    MockState,
+    encode_tx,
+    tx_id,
+)
+from ouroboros_consensus_tpu.mempool import Mempool, MempoolFull
+
+
+from ouroboros_consensus_tpu.protocol.views import LedgerView
+
+
+@pytest.fixture
+def ledger():
+    # the mempool never consults the protocol view: empty distr is fine
+    return MockLedger(MockConfig(LedgerView(pool_distr={}), 24))
+
+
+@pytest.fixture
+def genesis(ledger):
+    return ledger.genesis_state([(b"alice", 100), (b"bob", 50)])
+
+
+def make_pool(ledger, state, slot=0, **kw):
+    return Mempool(ledger, lambda: (state, slot), **kw)
+
+
+def _genesis_txin(state, addr):
+    for txin, (a, amt) in state.utxo.items():
+        if a == addr:
+            return txin, amt
+    raise AssertionError
+
+
+def test_add_valid_tx_fifo_tickets(ledger, genesis):
+    pool = make_pool(ledger, genesis)
+    txin, amt = _genesis_txin(genesis, b"alice")
+    tx1 = encode_tx([txin], [(b"carol", amt)])
+    t1 = pool.add_tx(tx1)
+    # chained tx spending tx1's output is valid against the POOL view
+    tx2 = encode_tx([(tx_id(tx1), 0)], [(b"dave", amt)])
+    t2 = pool.add_tx(tx2)
+    assert (t1.number, t2.number) == (1, 2)
+    snap = pool.get_snapshot()
+    assert snap.tx_bytes() == (tx1, tx2)
+    assert snap.after(1) == (snap.txs[1],)
+
+
+def test_invalid_tx_rejected(ledger, genesis):
+    pool = make_pool(ledger, genesis)
+    bad = encode_tx([(b"\x00" * 32, 0)], [(b"x", 1)])
+    with pytest.raises(InvalidTx):
+        pool.add_tx(bad)
+    # double spend within the pool
+    txin, amt = _genesis_txin(genesis, b"alice")
+    pool.add_tx(encode_tx([txin], [(b"c", amt)]))
+    with pytest.raises(InvalidTx):
+        pool.add_tx(encode_tx([txin], [(b"d", amt)]))
+
+
+def test_capacity(ledger, genesis):
+    txin, amt = _genesis_txin(genesis, b"alice")
+    tx = encode_tx([txin], [(b"carol", amt)])
+    pool = make_pool(ledger, genesis, capacity_bytes=len(tx) - 1)
+    with pytest.raises(MempoolFull):
+        pool.add_tx(tx)
+
+
+def test_sync_with_ledger_drops_spent(ledger, genesis):
+    state = {"cur": genesis}
+    pool = Mempool(ledger, lambda: (state["cur"], 0))
+    txin, amt = _genesis_txin(genesis, b"alice")
+    tx = encode_tx([txin], [(b"carol", amt)])
+    pool.add_tx(tx)
+    # the chain adopts a block spending the same input differently
+    other = encode_tx([txin], [(b"eve", amt)])
+    new_utxo = ledger.apply_tx(dict(genesis.utxo), other)
+    state["cur"] = MockState(new_utxo, 1)
+    dropped = pool.sync_with_ledger()
+    assert [t.tx for t in dropped] == [tx]
+    assert pool.get_snapshot().txs == ()
+
+
+def test_remove_txs_revalidates_dependents(ledger, genesis):
+    pool = make_pool(ledger, genesis)
+    txin, amt = _genesis_txin(genesis, b"alice")
+    tx1 = encode_tx([txin], [(b"carol", amt)])
+    pool.add_tx(tx1)
+    tx2 = encode_tx([(tx_id(tx1), 0)], [(b"dave", amt)])
+    pool.add_tx(tx2)
+    pool.remove_txs([tx_id(tx1)])
+    # tx2 depended on tx1's output: dropped by the revalidation pass
+    assert pool.get_snapshot().txs == ()
+
+
+def test_get_snapshot_for_respects_budget(ledger, genesis):
+    pool = make_pool(ledger, genesis)
+    ta, amta = _genesis_txin(genesis, b"alice")
+    tb, amtb = _genesis_txin(genesis, b"bob")
+    tx1 = encode_tx([ta], [(b"c", amta)])
+    tx2 = encode_tx([tb], [(b"d", amtb)])
+    pool.add_tx(tx1)
+    pool.add_tx(tx2)
+    snap = pool.get_snapshot_for(genesis, 5, max_bytes=len(tx1))
+    assert snap.tx_bytes() == (tx1,)
+    full = pool.get_snapshot_for(genesis, 5)
+    assert full.tx_bytes() == (tx1, tx2)

@@ -1,0 +1,102 @@
+"""Multi-chip SPMD validation on the virtual 8-device CPU mesh.
+
+Checks that sharding the staged batch over a Mesh produces the same
+verdicts and first-failure index as the single-device fused kernel.
+"""
+
+from dataclasses import replace
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+import jax
+
+from ouroboros_consensus_tpu.parallel import spmd
+from ouroboros_consensus_tpu.protocol import batch as pbatch
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.testing import fixtures
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=100,
+    max_kes_evolutions=62,
+    security_param=4,
+    active_slot_coeff=Fraction(1, 2),
+    epoch_length=10_000,  # one epoch: batch spans a single nonce
+    kes_depth=3,
+)
+
+NONCE = b"\x07" * 32
+
+
+@pytest.fixture(scope="module")
+def pools():
+    return [fixtures.make_pool(i, kes_depth=PARAMS.kes_depth) for i in range(2)]
+
+
+@pytest.fixture(scope="module")
+def lview(pools):
+    return fixtures.make_ledger_view(pools)
+
+
+@pytest.fixture(scope="module")
+def chain(pools, lview):
+    hvs = []
+    prev = None
+    slot = 1
+    while len(hvs) < 11:  # deliberately NOT divisible by 8: exercises padding
+        pool = fixtures.find_leader(PARAMS, pools, lview, slot, NONCE)
+        if pool is not None:
+            hvs.append(
+                fixtures.forge_header_view(
+                    PARAMS, pool, slot=slot, epoch_nonce=NONCE,
+                    prev_hash=prev, body_bytes=b"body-%d" % len(hvs),
+                )
+            )
+            prev = (b"%032d" % len(hvs))[:32]
+        slot += 1
+    return hvs
+
+
+def _stage(lview, hvs):
+    pre = pbatch.host_prechecks(PARAMS, lview, hvs)
+    return pbatch.stage(PARAMS, lview, NONCE, hvs, pre.kes_evolution)
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_matches_single_device(lview, chain):
+    batch = _stage(lview, chain)
+    ref = pbatch.run_batch(batch)
+    mesh = spmd.make_mesh()
+    v, first_bad, n_ok = spmd.sharded_run_batch(batch, mesh)
+    for a, b in zip(v, ref):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert first_bad is None
+    assert n_ok >= len(chain)  # pad lanes replicate a valid lane
+
+
+def test_sharded_detects_first_failure(lview, chain):
+    bad = list(chain)
+    # corrupt the KES signature of the header at position 5
+    ks = bytearray(bad[5].kes_sig)
+    ks[0] ^= 0xFF
+    bad[5] = replace(bad[5], kes_sig=bytes(ks))
+    batch = _stage(lview, bad)
+    mesh = spmd.make_mesh()
+    v, first_bad, _ = spmd.sharded_run_batch(batch, mesh)
+    assert first_bad == 5
+    assert not v.ok_kes_sig[5]
+    assert v.ok_kes_sig[4] and v.ok_kes_sig[6]
+
+
+def test_pad_batch_roundtrip(lview, chain):
+    batch = _stage(lview, chain)
+    padded, b = spmd.pad_batch(batch, 8)
+    assert b == len(chain)
+    assert padded.beta.shape[0] % 8 == 0
+    np.testing.assert_array_equal(padded.beta[:b], batch.beta)
+    # pad lanes replicate lane 0
+    np.testing.assert_array_equal(padded.beta[b:], np.repeat(batch.beta[:1], padded.beta.shape[0] - b, axis=0))

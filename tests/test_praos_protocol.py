@@ -45,26 +45,37 @@ def _update_at(hv, state=PraosState(), params=PARAMS, lv=LV):
     return update(params, hv, hv.slot, ticked)
 
 
+def _leader_in(slots, epoch_nonce):
+    """First (pool, slot) actually winning the VRF lottery — leadership is
+    probabilistic, so tests must search rather than assume."""
+    for slot in slots:
+        pool = fx.find_leader(PARAMS, POOLS, LV, slot, epoch_nonce)
+        if pool is not None:
+            return pool, slot
+    raise AssertionError("no leader found in slot range")
+
+
 def test_update_happy_path_and_bookkeeping():
-    pool = POOLS[0]
     st = PraosState(epoch_nonce=b"\x07" * 32)
-    hv = fx.forge_header_view(PARAMS, pool, 3, st.epoch_nonce, None, b"body-0")
+    # need slot + stability(24) < epoch_end(50) so candidate still follows
+    pool, slot = _leader_in(range(1, 26), st.epoch_nonce)
+    hv = fx.forge_header_view(PARAMS, pool, slot, st.epoch_nonce, None, b"body-0")
     st2 = _update_at(hv, st)
-    assert st2.last_slot == 3
+    assert st2.last_slot == slot
     assert st2.ocert_counters[pool.pool_id] == 0
     # evolving nonce combined with this header's nonce value
     eta = nonces.vrf_nonce_value(hv.vrf_output)
     assert st2.evolving_nonce == eta  # neutral ⭒ eta = eta
-    # slot 3 + stability(24) >= 50? 27 < 50: within window -> candidate follows
+    # slot + stability(24) < 50: within window -> candidate follows
     assert st2.candidate_nonce == st2.evolving_nonce
     assert st2.lab_nonce is None  # genesis prev-hash -> neutral
 
 
 def test_candidate_nonce_freezes_near_epoch_end():
-    pool = POOLS[0]
     st = PraosState(epoch_nonce=b"\x07" * 32, last_slot=30)
-    # stability window = ceil(3*4 / (1/2)) = 24; slot 30: 30+24 >= 50 -> frozen
-    hv = fx.forge_header_view(PARAMS, pool, 32, st.epoch_nonce, b"\xaa" * 32)
+    # stability window = ceil(3*4 / (1/2)) = 24; slot >= 31: slot+24 >= 50 -> frozen
+    pool, slot = _leader_in(range(31, 50), st.epoch_nonce)
+    hv = fx.forge_header_view(PARAMS, pool, slot, st.epoch_nonce, b"\xaa" * 32)
     st2 = _update_at(hv, st)
     assert st2.candidate_nonce is None  # unchanged (was neutral)
     assert st2.evolving_nonce is not None
@@ -178,12 +189,17 @@ def test_check_is_leader_agrees_with_validation():
 
 def test_sequential_chain_multi_epoch():
     """Batch-of-1 spec run: a 3-epoch chain with per-epoch nonce evolution."""
-    pool = POOLS[0]
     st = PraosState()
     prev_hash = None
     counters = {}
-    for slot in range(0, 140, 7):  # crosses epochs at 50 and 100
+    forged = 0
+    for slot in range(0, 140):  # crosses epochs at 50 and 100
         ticked = tick(PARAMS, LV, slot, st)
+        pool = fx.find_leader(
+            PARAMS, POOLS, LV, slot, ticked.state.epoch_nonce
+        )
+        if pool is None:
+            continue
         n = counters.get(pool.pool_id, 0)
         hv = fx.forge_header_view(
             PARAMS, pool, slot, ticked.state.epoch_nonce, prev_hash,
@@ -192,6 +208,8 @@ def test_sequential_chain_multi_epoch():
         st = update(PARAMS, hv, slot, ticked)
         counters[pool.pool_id] = n
         prev_hash = bytes(32)  # placeholder header hash
-    assert st.last_slot == 133
+        forged += 1
+    assert forged > 100 * PARAMS.active_slot_coeff  # sanity: chain is dense
+    assert st.last_slot > 100  # reached the third epoch
     assert st.epoch_nonce is not None
-    assert st.ocert_counters[pool.pool_id] == 0
+    assert all(c == 0 for c in st.ocert_counters.values())
