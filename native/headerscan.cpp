@@ -1,0 +1,215 @@
+// Native chunk scanner / columnar header extractor.
+//
+// The host-side data loader of the framework: parses ImmutableDB chunk
+// files (concatenated CBOR blocks, layout defined by
+// ouroboros_consensus_tpu/block/praos_block.py) directly into the
+// struct-of-arrays columns the device staging layer consumes, without
+// materializing Python objects. This is the C++ runtime component the
+// reference keeps in external C packages (CBOR decode via cborg,
+// libsodium hashing) — CBOR decode throughput is the host bottleneck at
+// batch rates (SURVEY.md §7.3 items 5-6).
+//
+// Block layout (praos_block.py):
+//   block  = [header, [tx, ...]]
+//   header = [body, kes_sig]
+//   body   = [block_no, slot, prev_hash|null, issuer_vk, vrf_vk,
+//             [vrf_output, vrf_proof], body_size, body_hash,
+//             [ocert_vk, counter, kes_period, sigma], [pv_maj, pv_min]]
+//
+// The KES-signed message is the body's exact CBOR span, which we return
+// as (offset, len) into the chunk buffer — zero copies.
+//
+// Build: g++ -O2 -shared -fPIC -o libheaderscan.so headerscan.cpp
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+struct Cursor {
+    const uint8_t* p;
+    size_t len;
+    size_t off;
+    bool ok;
+
+    bool need(size_t n) {
+        if (off + n > len) { ok = false; return false; }
+        return true;
+    }
+    uint8_t peek() { return p[off]; }
+    uint8_t take() { return p[off++]; }
+};
+
+// Read a CBOR head; returns major in *major and argument in *arg.
+bool read_head(Cursor& c, int* major, uint64_t* arg) {
+    if (!c.need(1)) return false;
+    uint8_t b = c.take();
+    *major = b >> 5;
+    uint8_t info = b & 0x1f;
+    if (info < 24) { *arg = info; return true; }
+    int n;
+    switch (info) {
+        case 24: n = 1; break;
+        case 25: n = 2; break;
+        case 26: n = 4; break;
+        case 27: n = 8; break;
+        default: c.ok = false; return false;  // indefinite not emitted
+    }
+    if (!c.need((size_t)n)) return false;
+    uint64_t v = 0;
+    for (int i = 0; i < n; i++) v = (v << 8) | c.take();
+    *arg = v;
+    return true;
+}
+
+// Skip one complete CBOR item.
+bool skip_item(Cursor& c) {
+    int major; uint64_t arg;
+    if (!read_head(c, &major, &arg)) return false;
+    switch (major) {
+        case 0: case 1: return true;                    // ints
+        case 2: case 3:                                  // bytes/text
+            if (!c.need(arg)) return false;
+            c.off += arg; return true;
+        case 4:                                          // array
+            for (uint64_t i = 0; i < arg; i++)
+                if (!skip_item(c)) return false;
+            return true;
+        case 5:                                          // map
+            for (uint64_t i = 0; i < 2 * arg; i++)
+                if (!skip_item(c)) return false;
+            return true;
+        case 6: return skip_item(c);                     // tag
+        case 7:                                          // simple/float
+            if (arg >= 24 && c.peek()) {}
+            return true;
+        default: return false;
+    }
+}
+
+bool expect_array(Cursor& c, uint64_t* n) {
+    int major; uint64_t arg;
+    if (!read_head(c, &major, &arg) || major != 4) { c.ok = false; return false; }
+    *n = arg;
+    return true;
+}
+
+bool read_uint(Cursor& c, int64_t* out) {
+    int major; uint64_t arg;
+    if (!read_head(c, &major, &arg) || major != 0) { c.ok = false; return false; }
+    *out = (int64_t)arg;
+    return true;
+}
+
+// bytes of exactly `want` length copied to dst; or null (-> zero, *present=0)
+bool read_bytes_fixed(Cursor& c, uint8_t* dst, size_t want, uint8_t* present) {
+    int major; uint64_t arg;
+    size_t save = c.off;
+    if (!read_head(c, &major, &arg)) return false;
+    if (major == 7 && arg == 22) {  // null
+        memset(dst, 0, want);
+        if (present) *present = 0;
+        return true;
+    }
+    if (major != 2 || arg != want) { c.off = save; c.ok = false; return false; }
+    if (!c.need(arg)) return false;
+    memcpy(dst, c.p + c.off, want);
+    c.off += arg;
+    if (present) *present = 1;
+    return true;
+}
+
+// variable-length bytes: record (offset, len), no copy
+bool read_bytes_span(Cursor& c, int64_t* off_out, int64_t* len_out) {
+    int major; uint64_t arg;
+    if (!read_head(c, &major, &arg) || major != 2) { c.ok = false; return false; }
+    if (!c.need(arg)) return false;
+    *off_out = (int64_t)c.off;
+    *len_out = (int64_t)arg;
+    c.off += arg;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan concatenated top-level CBOR items; fill offsets/sizes.
+// Returns the count of COMPLETE items (stopping at max_items). A torn
+// or malformed tail ends the scan: *bad_off is the offset where the
+// good prefix ends (== len iff the whole buffer is well-formed) — the
+// truncate-corrupted-tail recovery point (ImmutableDB/Impl/Validation).
+int ocx_scan_items(const uint8_t* buf, size_t len,
+                   int64_t* offsets, int64_t* sizes, int max_items,
+                   int64_t* bad_off) {
+    Cursor c{buf, len, 0, true};
+    int n = 0;
+    while (c.off < c.len && n < max_items) {
+        size_t start = c.off;
+        if (!skip_item(c) || !c.ok) {
+            if (bad_off) *bad_off = (int64_t)start;
+            return n;
+        }
+        offsets[n] = (int64_t)start;
+        sizes[n] = (int64_t)(c.off - start);
+        n++;
+    }
+    if (bad_off) *bad_off = (int64_t)c.off;
+    return n;
+}
+
+// Extract header columns from n blocks located at offsets[] in buf.
+// Fixed-width outputs are caller-allocated numpy arrays; variable-width
+// fields (kes_sig, signed body span) come back as (offset, len) pairs
+// into buf. Returns 0 on success, or 1-based index of first bad block.
+int ocx_extract_headers(
+    const uint8_t* buf, size_t len,
+    const int64_t* offsets, int n,
+    int64_t* block_no, int64_t* slot,
+    uint8_t* prev_hash /* n*32 */, uint8_t* has_prev,
+    uint8_t* issuer_vk /* n*32 */, uint8_t* vrf_vk /* n*32 */,
+    uint8_t* vrf_output /* n*64 */, uint8_t* vrf_proof /* n*80 */,
+    int64_t* body_size, uint8_t* body_hash /* n*32 */,
+    uint8_t* ocert_vk /* n*32 */, int64_t* ocert_counter,
+    int64_t* ocert_kes_period, int64_t* ocert_sigma_off,
+    int64_t* ocert_sigma_len, int64_t* pv_major, int64_t* pv_minor,
+    int64_t* kes_sig_off, int64_t* kes_sig_len,
+    int64_t* signed_off, int64_t* signed_len) {
+    for (int i = 0; i < n; i++) {
+        Cursor c{buf, len, (size_t)offsets[i], true};
+        uint64_t na;
+        // block = [header, txs]
+        if (!expect_array(c, &na) || na != 2) return i + 1;
+        // header = [body, kes_sig]
+        if (!expect_array(c, &na) || na != 2) return i + 1;
+        size_t body_start = c.off;
+        // body = [...10 fields...]
+        if (!expect_array(c, &na) || na != 10) return i + 1;
+        if (!read_uint(c, &block_no[i])) return i + 1;
+        if (!read_uint(c, &slot[i])) return i + 1;
+        if (!read_bytes_fixed(c, prev_hash + 32 * i, 32, &has_prev[i])) return i + 1;
+        if (!read_bytes_fixed(c, issuer_vk + 32 * i, 32, nullptr)) return i + 1;
+        if (!read_bytes_fixed(c, vrf_vk + 32 * i, 32, nullptr)) return i + 1;
+        if (!expect_array(c, &na) || na != 2) return i + 1;
+        if (!read_bytes_fixed(c, vrf_output + 64 * i, 64, nullptr)) return i + 1;
+        if (!read_bytes_fixed(c, vrf_proof + 80 * i, 80, nullptr)) return i + 1;
+        if (!read_uint(c, &body_size[i])) return i + 1;
+        if (!read_bytes_fixed(c, body_hash + 32 * i, 32, nullptr)) return i + 1;
+        if (!expect_array(c, &na) || na != 4) return i + 1;
+        if (!read_bytes_fixed(c, ocert_vk + 32 * i, 32, nullptr)) return i + 1;
+        if (!read_uint(c, &ocert_counter[i])) return i + 1;
+        if (!read_uint(c, &ocert_kes_period[i])) return i + 1;
+        if (!read_bytes_span(c, &ocert_sigma_off[i], &ocert_sigma_len[i])) return i + 1;
+        if (!expect_array(c, &na) || na != 2) return i + 1;
+        if (!read_uint(c, &pv_major[i])) return i + 1;
+        if (!read_uint(c, &pv_minor[i])) return i + 1;
+        signed_off[i] = (int64_t)body_start;
+        signed_len[i] = (int64_t)(c.off - body_start);
+        if (!read_bytes_span(c, &kes_sig_off[i], &kes_sig_len[i])) return i + 1;
+        if (!c.ok) return i + 1;
+    }
+    return 0;
+}
+
+}  // extern "C"

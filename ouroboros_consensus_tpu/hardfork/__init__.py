@@ -1,0 +1,26 @@
+"""Hard-fork combinator: era composition + era-aware time conversions
+(reference: Ouroboros.Consensus.HardFork)."""
+
+from .combinator import (
+    Era,
+    HardForkBlock,
+    HardForkLedger,
+    HardForkProtocol,
+    HFState,
+    TickedHFState,
+    decode_block,
+)
+from .history import (
+    Bound,
+    EraParams,
+    EraSummary,
+    PastHorizon,
+    Summary,
+    summarize,
+)
+
+__all__ = [
+    "Era", "HardForkBlock", "HardForkLedger", "HardForkProtocol",
+    "HFState", "TickedHFState", "decode_block", "Bound", "EraParams",
+    "EraSummary", "PastHorizon", "Summary", "summarize",
+]

@@ -1,0 +1,247 @@
+"""Hard-fork combinator: compose N eras into one protocol/ledger/block.
+
+Reference: `Ouroboros.Consensus.HardFork.Combinator` — `HardForkBlock xs`
+(Basics.hs:65), the per-era `Telescope` state (State/Types.hs:38), the
+cross-era `ConsensusProtocol` instance (Combinator/Protocol.hs), ledger
+(Combinator/Ledger.hs) and state translations (Translation.hs:20-22).
+
+TPU-first inversion: the reference's type-level n-ary sums (SOP) become a
+plain era index + dispatch tables. Batched validation is unaffected —
+an era boundary is simply another batch cut, like an epoch boundary
+(tools/db_analyser segments at min(epoch, era) granularity), so the fused
+kernels never see mixed-era control flow.
+
+Era transitions are config-driven (`TriggerHardForkAtEpoch` analog,
+Cardano/Node.hs) via each era's `end_epoch`; ledger-decided transitions
+(singleEraTransition) plug in by overriding `HardForkLedger.transition`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Sequence
+
+from ..utils import cbor
+from .history import Summary
+
+
+@dataclass(frozen=True)
+class Era:
+    """One era of the composite (SingleEraBlock analog)."""
+
+    name: str
+    protocol: Any  # ConsensusProtocol instance-as-object
+    ledger: Any  # Ledger instance-as-object
+    # translations INTO this era from the previous one (identity default)
+    translate_chain_dep: Callable[[Any], Any] = lambda s: s
+    translate_ledger_state: Callable[[Any], Any] = lambda s: s
+
+
+@dataclass(frozen=True)
+class HFState:
+    """The Telescope collapsed to (current era index, its state) — past
+    eras' states are dead after translation (State/Types.hs Past)."""
+
+    era: int
+    inner: Any
+
+
+@dataclass(frozen=True)
+class TickedHFState:
+    era: int
+    inner: Any  # the era protocol's ticked state
+
+
+class HardForkProtocol:
+    """ConsensusProtocol (HardForkBlock xs) (Combinator/Protocol.hs)."""
+
+    def __init__(self, eras: Sequence[Era], summary: Summary):
+        assert len(eras) == len(summary.eras)
+        self.eras = list(eras)
+        self.summary = summary
+        self.security_param = max(
+            getattr(e.protocol, "security_param", 0) for e in eras
+        )
+
+    def era_of_slot(self, slot: int) -> int:
+        return self.summary.era_index_of_slot(slot)
+
+    def initial_state(self) -> HFState:
+        return HFState(0, self.eras[0].protocol.initial_state())
+
+    def _cross_eras(self, state: HFState, target: int) -> HFState:
+        """Walk the telescope forward, translating at each boundary
+        (Translation.hs translateChainDepState)."""
+        era, inner = state.era, state.inner
+        while era < target:
+            era += 1
+            inner = self.eras[era].translate_chain_dep(inner)
+        return HFState(era, inner)
+
+    def tick(self, ledger_view, slot: int, state: HFState) -> TickedHFState:
+        target = self.era_of_slot(slot)
+        if target < state.era:
+            raise ValueError(f"slot {slot} is in past era {target} < {state.era}")
+        state = self._cross_eras(state, target)
+        ticked = self.eras[target].protocol.tick(ledger_view, slot, state.inner)
+        return TickedHFState(target, ticked)
+
+    def update(self, view, slot: int, ticked: TickedHFState) -> HFState:
+        inner = self.eras[ticked.era].protocol.update(view, slot, ticked.inner)
+        return HFState(ticked.era, inner)
+
+    def reupdate(self, view, slot: int, ticked: TickedHFState) -> HFState:
+        inner = self.eras[ticked.era].protocol.reupdate(view, slot, ticked.inner)
+        return HFState(ticked.era, inner)
+
+    def check_is_leader(self, can_be_leader, slot: int, ticked: TickedHFState):
+        return self.eras[ticked.era].protocol.check_is_leader(
+            can_be_leader, slot, ticked.inner
+        )
+
+    # -- chain order across eras (Combinator/Protocol/ChainSel.hs) --------
+
+    def select_view(self, header):
+        era = self.era_of_slot(header.slot)
+        return (era, self.eras[era].protocol.select_view(header))
+
+    @staticmethod
+    def _block_no_of(view):
+        """Every inner SelectView exposes a block number: richer views
+        (Praos) as .block_no, simple protocols (BFT/PBFT/LeaderSchedule)
+        return the block number itself."""
+        return view.block_no if hasattr(view, "block_no") else view
+
+    def compare_candidates(self, ours, theirs) -> int:
+        """AcrossEraSelection: same era → era rules; different eras →
+        block number only (the universally comparable component).
+        None = empty chain, loses to any candidate (ConsensusProtocol
+        contract relied on by ChainDB's initial selection)."""
+        if theirs is None:
+            return 0 if ours is None else -1
+        if ours is None:
+            return 1
+        (ea, va), (eb, vb) = ours, theirs
+        if ea == eb:
+            return self.eras[ea].protocol.compare_candidates(va, vb)
+        a_no, b_no = self._block_no_of(va), self._block_no_of(vb)
+        return (b_no > a_no) - (b_no < a_no)
+
+    # -- batched validation (era-segmented) --------------------------------
+
+    def validate_batch(self, ticked: TickedHFState, views, collect_states=False):
+        inner_proto = self.eras[ticked.era].protocol
+        res = inner_proto.validate_batch(ticked.inner, views, collect_states)
+        return replace(res, state=HFState(ticked.era, res.state)) if hasattr(
+            res, "state"
+        ) else res
+
+
+class HardForkLedger:
+    """LedgerState (HardForkBlock xs) (Combinator/Ledger.hs) — same
+    telescope walk for ledger states."""
+
+    def __init__(self, eras: Sequence[Era], summary: Summary):
+        self.eras = list(eras)
+        self.summary = summary
+
+    def _cross_eras(self, state: HFState, target: int) -> HFState:
+        era, inner = state.era, state.inner
+        while era < target:
+            era += 1
+            inner = self.eras[era].translate_ledger_state(inner)
+        return HFState(era, inner)
+
+    def genesis_state(self, inner) -> HFState:
+        return HFState(0, inner)
+
+    def tick(self, state: HFState, slot: int):
+        target = self.summary.era_index_of_slot(slot)
+        if target < state.era:
+            raise ValueError(f"slot {slot} is in past era {target} < {state.era}")
+        state = self._cross_eras(state, target)
+        return TickedHFState(target, self.eras[target].ledger.tick(state.inner, slot))
+
+    def apply_block(self, ticked: TickedHFState, block) -> HFState:
+        inner = self.eras[ticked.era].ledger.apply_block(
+            ticked.inner, unwrap(block)
+        )
+        return HFState(ticked.era, inner)
+
+    def reapply_block(self, ticked: TickedHFState, block) -> HFState:
+        inner = self.eras[ticked.era].ledger.reapply_block(
+            ticked.inner, unwrap(block)
+        )
+        return HFState(ticked.era, inner)
+
+    def tip_slot(self, state: HFState):
+        return self.eras[state.era].ledger.tip_slot(state.inner)
+
+    def protocol_ledger_view(self, ticked: TickedHFState):
+        return self.eras[ticked.era].ledger.protocol_ledger_view(ticked.inner)
+
+    def ledger_view_forecast_at(self, state: HFState):
+        return self.eras[state.era].ledger.ledger_view_forecast_at(state.inner)
+
+    def tick_then_apply(self, state, block):
+        return self.apply_block(self.tick(state, block.slot), block)
+
+    def tick_then_reapply(self, state, block):
+        return self.reapply_block(self.tick(state, block.slot), block)
+
+
+# -- era-tagged block wrapper (NestedContent / Serialisation analog) ---------
+
+
+@dataclass(frozen=True)
+class HardForkBlock:
+    """A block tagged with its era (HardForkBlock's one-constructor-per-
+    era sum collapsed to an index + payload)."""
+
+    era: int
+    block: Any
+
+    @property
+    def slot(self) -> int:
+        return self.block.slot
+
+    @property
+    def block_no(self) -> int:
+        return self.block.block_no
+
+    @property
+    def hash_(self) -> bytes:
+        return self.block.hash_
+
+    @property
+    def prev_hash(self):
+        return self.block.prev_hash
+
+    @property
+    def header(self):
+        return self.block.header
+
+    @property
+    def txs(self):
+        return self.block.txs
+
+    @property
+    def point(self):
+        return self.block.point
+
+    @property
+    def bytes_(self) -> bytes:
+        # era tag + inner bytes (Combinator/Serialisation era tags)
+        return cbor.encode([self.era, self.block.bytes_])
+
+    def check_integrity(self) -> bool:
+        return self.block.check_integrity()
+
+
+def unwrap(block):
+    return block.block if isinstance(block, HardForkBlock) else block
+
+
+def decode_block(data: bytes, era_decoders: Sequence[Callable[[bytes], Any]]):
+    era, inner = cbor.decode(data)
+    return HardForkBlock(era, era_decoders[era](inner))

@@ -1,0 +1,130 @@
+"""Local (node-to-client) mini-protocols: state query, tx submission,
+tx monitor.
+
+Reference: `MiniProtocol/LocalStateQuery/Server.hs` (acquire a ledger
+state at a point, answer queries against it — Ledger/Query.hs:78-83
+`GetSystemStart`/`GetChainBlockNo` plus ledger-specific queries),
+`MiniProtocol/LocalTxSubmission/Server.hs` (submit txs to the mempool),
+`MiniProtocol/LocalTxMonitor/Server.hs` (observe mempool contents).
+
+Wire messages (tuples over sim/asyncio channels):
+  state query:   ("acquire", Point|None) → ("acquired",) | ("failed", why)
+                 ("query", name, args) → ("result", value)
+                 ("release",) / ("done",)
+  tx submission: ("submit", tx_bytes) → ("accepted",) | ("rejected", why)
+  tx monitor:    ("acquire",) → ("acquired", slot)
+                 ("next_tx",) → ("tx", bytes) | ("no_more",)
+                 ("has_tx", txid) → ("bool", b)
+                 ("get_sizes",) → ("sizes", capacity, used, n)
+"""
+
+from __future__ import annotations
+
+from ..ledger.mock import InvalidTx, tx_id
+from ..mempool import MempoolFull
+from ..utils.sim import Recv, Send
+
+
+class QueryError(Exception):
+    pass
+
+
+def run_query(node, ext_state, name: str, args):
+    """The query vocabulary (Ledger/Query.hs + mock ledger queries)."""
+    ledger_state = ext_state.ledger_state
+    hs = ext_state.header_state
+    if name == "get_chain_block_no":
+        return hs.tip.block_no if hs.tip else None
+    if name == "get_chain_point":
+        return hs.tip.point if hs.tip else None
+    if name == "get_tip_slot":
+        return hs.tip.slot if hs.tip else None
+    if name == "get_utxo":
+        return dict(ledger_state.utxo)
+    if name == "get_balance":
+        addr = args[0]
+        return sum(amt for (a, amt) in ledger_state.utxo.values() if a == addr)
+    if name == "get_pool_distr":
+        return node.ledger_view_at(hs.tip.slot if hs.tip else 0).pool_distr
+    raise QueryError(f"unknown query {name!r}")
+
+
+def state_query_server(node, rx, tx):
+    """LocalStateQuery server: acquire/query/release session."""
+    acquired = None
+    while True:
+        msg = yield Recv(rx)
+        kind = msg[0]
+        if kind == "acquire":
+            point = msg[1]
+            st = (
+                node.chain_db.current_ledger()
+                if point is None
+                else node.chain_db.get_past_ledger(point)
+            )
+            if st is None:
+                yield Send(tx, ("failed", "point not on chain"))
+            else:
+                acquired = st
+                yield Send(tx, ("acquired",))
+        elif kind == "query":
+            if acquired is None:
+                yield Send(tx, ("failed", "no state acquired"))
+                continue
+            try:
+                val = run_query(node, acquired, msg[1], msg[2])
+                yield Send(tx, ("result", val))
+            except QueryError as e:
+                yield Send(tx, ("failed", str(e)))
+        elif kind == "release":
+            acquired = None
+        elif kind == "done":
+            return
+        else:
+            yield Send(tx, ("failed", f"bad message {kind!r}"))
+
+
+def tx_submission_server(node, rx, tx):
+    """LocalTxSubmission server: mempool add with typed verdicts."""
+    while True:
+        msg = yield Recv(rx)
+        if msg[0] == "done":
+            return
+        assert msg[0] == "submit", msg
+        try:
+            node.mempool.add_tx(msg[1])
+            yield Send(tx, ("accepted",))
+        except (InvalidTx, MempoolFull) as e:
+            yield Send(tx, ("rejected", repr(e)))
+
+
+def tx_monitor_server(node, rx, tx):
+    """LocalTxMonitor server: iterate a mempool snapshot."""
+    snap = None
+    cursor = 0
+    while True:
+        msg = yield Recv(rx)
+        kind = msg[0]
+        if kind == "acquire":
+            snap = node.mempool.get_snapshot()
+            cursor = 0
+            yield Send(tx, ("acquired", snap.ledger_slot))
+        elif snap is None:
+            yield Send(tx, ("failed", "no snapshot acquired"))
+        elif kind == "next_tx":
+            if cursor < len(snap.txs):
+                yield Send(tx, ("tx", snap.txs[cursor].tx))
+                cursor += 1
+            else:
+                yield Send(tx, ("no_more",))
+        elif kind == "has_tx":
+            yield Send(tx, ("bool", any(tx_id(t.tx) == msg[1] for t in snap.txs)))
+        elif kind == "get_sizes":
+            used = sum(t.size for t in snap.txs)
+            yield Send(tx, ("sizes", node.mempool.capacity, used, len(snap.txs)))
+        elif kind == "release":
+            snap = None
+        elif kind == "done":
+            return
+        else:
+            yield Send(tx, ("failed", f"bad message {kind!r}"))

@@ -1,0 +1,152 @@
+"""ctypes bindings for the native chunk scanner (native/headerscan.cpp).
+
+Builds the shared library on first use with g++ (cached next to the
+source; rebuilt when the source is newer). Falls back gracefully — every
+caller treats `load() is None` as "use the pure-Python path", so the
+framework works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from dataclasses import dataclass
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native", "headerscan.cpp")
+_SO = os.path.join(os.path.dirname(_SRC), "libheaderscan.so")
+
+_lib = None
+_tried = False
+
+
+def load():
+    """The loaded library, building if needed; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_SO)
+    except Exception:
+        return None
+    lib.ocx_scan_items.restype = ctypes.c_int
+    lib.ocx_scan_items.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.ocx_extract_headers.restype = ctypes.c_int
+    lib.ocx_extract_headers.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,  # buf, len
+        ctypes.c_void_p, ctypes.c_int,  # offsets, n
+        *([ctypes.c_void_p] * 21),
+    ]
+    _lib = lib
+    return _lib
+
+
+def scan_items(buf: bytes, max_items: int = 1 << 20):
+    """(offsets, sizes, end) of the complete top-level CBOR items in
+    `buf`. `end` is where the well-formed prefix stops — == len(buf)
+    iff the whole buffer parses; anything past `end` is a torn tail to
+    truncate. None if the native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    offsets = np.zeros(max_items, np.int64)
+    sizes = np.zeros(max_items, np.int64)
+    bad = ctypes.c_int64(0)
+    n = lib.ocx_scan_items(
+        buf, len(buf),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        max_items, ctypes.byref(bad),
+    )
+    return offsets[:n].copy(), sizes[:n].copy(), int(bad.value)
+
+
+@dataclass
+class HeaderColumns:
+    """SoA header columns straight from chunk bytes — the zero-object
+    fast path feeding protocol/batch.stage."""
+
+    n: int
+    block_no: np.ndarray  # [n] int64
+    slot: np.ndarray  # [n] int64
+    prev_hash: np.ndarray  # [n, 32] uint8
+    has_prev: np.ndarray  # [n] uint8
+    issuer_vk: np.ndarray  # [n, 32]
+    vrf_vk: np.ndarray  # [n, 32]
+    vrf_output: np.ndarray  # [n, 64]
+    vrf_proof: np.ndarray  # [n, 80]
+    body_size: np.ndarray  # [n] int64
+    body_hash: np.ndarray  # [n, 32]
+    ocert_vk: np.ndarray  # [n, 32]
+    ocert_counter: np.ndarray  # [n] int64
+    ocert_kes_period: np.ndarray  # [n] int64
+    ocert_sigma: list  # [n] bytes
+    pv_major: np.ndarray
+    pv_minor: np.ndarray
+    kes_sig: list  # [n] bytes
+    signed_bytes: list  # [n] bytes — the KES-signed body span
+    header_end: np.ndarray  # [n] int64 — buf offset just past the header item
+
+
+def extract_headers(buf: bytes, offsets: np.ndarray) -> HeaderColumns | None:
+    """Parse the blocks at `offsets` into columns. None if the native
+    library is unavailable. Raises ValueError on malformed blocks."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(offsets)
+    offs = np.ascontiguousarray(offsets, np.int64)
+    i64 = lambda: np.zeros(n, np.int64)
+    u8 = lambda w: np.zeros((n, w), np.uint8)
+    cols = dict(
+        block_no=i64(), slot=i64(), prev_hash=u8(32),
+        has_prev=np.zeros(n, np.uint8), issuer_vk=u8(32), vrf_vk=u8(32),
+        vrf_output=u8(64), vrf_proof=u8(80), body_size=i64(),
+        body_hash=u8(32), ocert_vk=u8(32), ocert_counter=i64(),
+        ocert_kes_period=i64(),
+    )
+    sig_off, sig_len = i64(), i64()
+    pv_major, pv_minor = i64(), i64()
+    kes_off, kes_len = i64(), i64()
+    sgn_off, sgn_len = i64(), i64()
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    rc = lib.ocx_extract_headers(
+        buf, len(buf), ptr(offs), n,
+        ptr(cols["block_no"]), ptr(cols["slot"]),
+        ptr(cols["prev_hash"]), ptr(cols["has_prev"]),
+        ptr(cols["issuer_vk"]), ptr(cols["vrf_vk"]),
+        ptr(cols["vrf_output"]), ptr(cols["vrf_proof"]),
+        ptr(cols["body_size"]), ptr(cols["body_hash"]),
+        ptr(cols["ocert_vk"]), ptr(cols["ocert_counter"]),
+        ptr(cols["ocert_kes_period"]), ptr(sig_off), ptr(sig_len),
+        ptr(pv_major), ptr(pv_minor),
+        ptr(kes_off), ptr(kes_len), ptr(sgn_off), ptr(sgn_len),
+    )
+    if rc != 0:
+        raise ValueError(f"malformed block at index {rc - 1}")
+    return HeaderColumns(
+        n=n,
+        ocert_sigma=[buf[sig_off[i] : sig_off[i] + sig_len[i]] for i in range(n)],
+        pv_major=pv_major,
+        pv_minor=pv_minor,
+        kes_sig=[buf[kes_off[i] : kes_off[i] + kes_len[i]] for i in range(n)],
+        signed_bytes=[buf[sgn_off[i] : sgn_off[i] + sgn_len[i]] for i in range(n)],
+        header_end=kes_off + kes_len,
+        **cols,
+    )

@@ -143,11 +143,25 @@ def scalar_mul_w4(digits, p: Point) -> Point:
     """
     k = digits.shape[-1]
     batch = digits.shape[:-1]
-    # table[d] = d*P, extended coords stacked [..., 16, 4, 20]
-    entries = [identity(batch), p]
-    for _ in range(14):
-        entries.append(add(entries[-1], p))
-    tbl = jnp.stack([jnp.stack(list(e), axis=-2) for e in entries], axis=-3)
+
+    # table[d] = d*P, extended coords stacked [..., 16, 4, NL]. Built
+    # with a fori_loop + indexed store: the Python-unrolled build (14
+    # point adds at trace time) multiplied out to ~15k HLO ops per call
+    # site and dominated XLA compile time of the fused verifier.
+    def _stack_pt(q: Point):
+        return jnp.stack([q.x, q.y, q.z, q.t], axis=-2)  # [..., 4, NL]
+
+    ident = identity(batch)
+    tbl0 = jnp.zeros((*batch, 16, 4, ident.x.shape[-1]), ident.x.dtype)
+    tbl0 = tbl0.at[..., 0, :, :].set(_stack_pt(ident))
+    tbl0 = tbl0.at[..., 1, :, :].set(_stack_pt(p))
+
+    def tbuild(i, carry):
+        tbl, last = carry
+        nxt = add(last, p)
+        return tbl.at[..., i, :, :].set(_stack_pt(nxt)), nxt
+
+    tbl, _ = lax.fori_loop(2, 16, tbuild, (tbl0, p))
 
     rev = jnp.flip(digits, axis=-1)  # msb window first
 

@@ -10,7 +10,8 @@
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Sequence
+from fractions import Fraction
+from typing import Mapping, Sequence
 
 from ..ops.host import ed25519 as host_ed25519
 from . import batch as pbatch
@@ -127,6 +128,172 @@ class BftProtocol:
 
     def check_is_leader(self, node_id: int, slot, ticked):
         return node_id if slot % self.num_nodes == node_id else None
+
+    def select_view(self, header):
+        return header.block_no
+
+    def compare_candidates(self, ours, theirs) -> int:
+        o = -1 if ours is None else ours
+        t = -1 if theirs is None else theirs
+        return (t > o) - (t < o)
+
+
+# ---------------------------------------------------------------------------
+# PBFT (Protocol/PBFT.hs): permissive BFT — any genesis delegate may sign,
+# but no delegate may have signed more than threshold·window of the last
+# `window` blocks (PBftState tracks the signer window, PBFT/State.hs:82)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PBftNotGenesisDelegate(ConsensusError):
+    slot: int
+    issuer_vk: bytes
+
+
+@dataclass
+class PBftInvalidSignature(ConsensusError):
+    slot: int
+
+
+@dataclass
+class PBftExceededSignThreshold(ConsensusError):
+    slot: int
+    signer: int
+    signed: int
+    allowed: int
+
+
+@dataclass(frozen=True)
+class PBftParams:
+    """PBftParams (Protocol/PBFT.hs): threshold is the max fraction of
+    the window one delegate may sign; window = k signed blocks."""
+
+    num_genesis_keys: int
+    threshold: Fraction
+    window: int  # number of recent signers retained (k)
+    security_param: int = 2160
+
+
+@dataclass(frozen=True)
+class PBftState:
+    """Last `window` signer indices, oldest first (PBftState)."""
+
+    signers: tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class TickedPBftState:
+    state: PBftState
+
+
+@dataclass(frozen=True)
+class PBftView:
+    """ValidateView: issuer key + signature over the header body."""
+
+    issuer_vk: bytes
+    signed_bytes: bytes
+    signature: bytes
+
+
+class PBftProtocol:
+    """ConsensusProtocol (PBft c) (Protocol/PBFT.hs:284)."""
+
+    def __init__(self, params: PBftParams, genesis_keys: Sequence[bytes]):
+        assert len(genesis_keys) == params.num_genesis_keys
+        self.params = params
+        self.genesis_keys = list(genesis_keys)
+        self._index = {vk: i for i, vk in enumerate(genesis_keys)}
+        self.security_param = params.security_param
+
+    def initial_state(self) -> PBftState:
+        return PBftState()
+
+    def tick(self, ledger_view, slot, state) -> TickedPBftState:
+        return TickedPBftState(state)
+
+    def _append_signer(self, st: PBftState, signer: int) -> PBftState:
+        signers = (st.signers + (signer,))[-self.params.window :]
+        return PBftState(signers)
+
+    def update(self, view: PBftView, slot, ticked) -> PBftState:
+        st = ticked.state
+        signer = self._index.get(view.issuer_vk)
+        if signer is None:
+            raise PBftNotGenesisDelegate(slot, view.issuer_vk)
+        if not host_ed25519.verify(
+            view.issuer_vk, view.signed_bytes, view.signature
+        ):
+            raise PBftInvalidSignature(slot)
+        # threshold check over the window INCLUDING this block
+        window = st.signers[-(self.params.window - 1) :] if self.params.window > 1 else ()
+        signed = sum(1 for s in window if s == signer) + 1
+        allowed = int(self.params.threshold * self.params.window)
+        if signed > allowed:
+            raise PBftExceededSignThreshold(slot, signer, signed, allowed)
+        return self._append_signer(st, signer)
+
+    def reupdate(self, view: PBftView, slot, ticked) -> PBftState:
+        return self._append_signer(ticked.state, self._index[view.issuer_vk])
+
+    def check_is_leader(self, node_id: int, slot, ticked):
+        """PBFT leadership is round-robin among delegates (Byron)."""
+        return node_id if slot % self.params.num_genesis_keys == node_id else None
+
+    def select_view(self, header):
+        return header.block_no
+
+    def compare_candidates(self, ours, theirs) -> int:
+        o = -1 if ours is None else ours
+        t = -1 if theirs is None else theirs
+        return (t > o) - (t < o)
+
+
+# ---------------------------------------------------------------------------
+# LeaderSchedule (Protocol/LeaderSchedule.hs): scripted leadership for
+# ThreadNet tests — no crypto, the schedule IS the protocol
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NotScheduledLeader(ConsensusError):
+    slot: int
+    node_id: int
+
+
+@dataclass(frozen=True)
+class LeaderScheduleState:
+    last_slot: int | None = None
+
+
+@dataclass(frozen=True)
+class TickedLeaderScheduleState:
+    state: LeaderScheduleState
+
+
+class LeaderScheduleProtocol:
+    """WithLeaderSchedule: slot -> set of leader node ids."""
+
+    def __init__(self, schedule: Mapping[int, Sequence[int]], security_param: int = 2160):
+        self.schedule = {s: tuple(ns) for s, ns in schedule.items()}
+        self.security_param = security_param
+
+    def initial_state(self) -> LeaderScheduleState:
+        return LeaderScheduleState()
+
+    def tick(self, ledger_view, slot, state) -> TickedLeaderScheduleState:
+        return TickedLeaderScheduleState(state)
+
+    def update(self, node_id: int, slot, ticked) -> LeaderScheduleState:
+        if node_id not in self.schedule.get(slot, ()):
+            raise NotScheduledLeader(slot, node_id)
+        return LeaderScheduleState(slot)
+
+    def reupdate(self, node_id, slot, ticked) -> LeaderScheduleState:
+        return LeaderScheduleState(slot)
+
+    def check_is_leader(self, node_id: int, slot, ticked):
+        return node_id if node_id in self.schedule.get(slot, ()) else None
 
     def select_view(self, header):
         return header.block_no

@@ -157,7 +157,11 @@ class ImmutableDB:
 
     def _reparse_chunk(self, n: int, check_integrity):
         """Walk self-delimiting CBOR blocks in the chunk file, rebuilding
-        index entries; truncate at the first unparseable/bad block."""
+        index entries; truncate at the first unparseable/bad block.
+
+        Uses the native scanner (native/headerscan.cpp) when available
+        and no integrity predicate is requested — the pure-Python CBOR
+        walk is the startup-validation bottleneck on large DBs."""
         from ..block.praos_block import Block
 
         cpath = os.path.join(self.path, _chunk_name(n))
@@ -166,6 +170,12 @@ class ImmutableDB:
                 data = f.read()
         except OSError:
             return None
+
+        if check_integrity is None:
+            fast = self._reparse_chunk_native(n, data)
+            if fast is not None:
+                return fast
+
         entries: list[IndexEntry] = []
         off = 0
         while off < len(data):
@@ -186,6 +196,47 @@ class ImmutableDB:
             )
             off = end
         if self._truncated.get(n):
+            self._rewrite_chunk(n, data, entries)
+        else:
+            self._write_index(n, entries)
+        return entries
+
+    def _reparse_chunk_native(self, n: int, data: bytes) -> list[IndexEntry] | None:
+        """Native-scanner reparse (no integrity predicate): columnar
+        header extraction + hashlib blake2b for the header hashes.
+        Returns None when the native library is unavailable or the
+        chunk's shape defeats the fast path (falls back to Python)."""
+        import hashlib
+
+        from .. import native_loader
+
+        scan = native_loader.scan_items(data)
+        if scan is None:
+            return None
+        offsets, sizes, end = scan
+        try:
+            cols = (
+                native_loader.extract_headers(data, offsets)
+                if len(offsets)
+                else None
+            )
+        except ValueError:
+            return None  # parseable CBOR but not our block layout
+        entries: list[IndexEntry] = []
+        for i in range(len(offsets)):
+            off, sz = int(offsets[i]), int(sizes[i])
+            # header bytes span: after the block's array(2) head (1 byte),
+            # through the end of the kes_sig item
+            hdr = data[off + 1 : int(cols.header_end[i])]
+            h = hashlib.blake2b(hdr, digest_size=32).digest()
+            entries.append(
+                IndexEntry(
+                    int(cols.slot[i]), int(cols.block_no[i]), h, off, sz,
+                    zlib.crc32(data[off : off + sz]),
+                )
+            )
+        if end < len(data):
+            self._truncated[n] = True
             self._rewrite_chunk(n, data, entries)
         else:
             self._write_index(n, entries)

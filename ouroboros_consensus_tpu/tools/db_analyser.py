@@ -100,6 +100,65 @@ def _epoch_segments(params: PraosParams, headers):
         yield seg
 
 
+def _views_from_columns(cols):
+    """native_loader.HeaderColumns -> HeaderViews (no Python CBOR)."""
+    from ..protocol.views import HeaderView, OCert
+
+    out = []
+    for i in range(cols.n):
+        out.append(
+            HeaderView(
+                prev_hash=bytes(cols.prev_hash[i]) if cols.has_prev[i] else None,
+                vk_cold=bytes(cols.issuer_vk[i]),
+                vrf_vk=bytes(cols.vrf_vk[i]),
+                vrf_output=bytes(cols.vrf_output[i]),
+                vrf_proof=bytes(cols.vrf_proof[i]),
+                ocert=OCert(
+                    bytes(cols.ocert_vk[i]),
+                    int(cols.ocert_counter[i]),
+                    int(cols.ocert_kes_period[i]),
+                    cols.ocert_sigma[i],
+                ),
+                slot=int(cols.slot[i]),
+                signed_bytes=cols.signed_bytes[i],
+                kes_sig=cols.kes_sig[i],
+            )
+        )
+    return out
+
+
+def _stream_views(imm: ImmutableDB, res: "ValidationResult"):
+    """HeaderView stream for revalidation: the native columnar extractor
+    per chunk when available (the C++ data-loader path — SURVEY.md §7.3
+    item 5: CBOR decode is the host bottleneck), else per-block Python
+    parsing."""
+    import os
+
+    from .. import native_loader
+    from ..storage.immutable import _chunk_name
+
+    native_ok = native_loader.load() is not None
+    for n in imm._chunks:
+        entries = imm._entries[n]
+        if not entries:
+            continue
+        with open(os.path.join(imm.path, _chunk_name(n)), "rb") as f:
+            data = f.read()
+        if native_ok:
+            import numpy as np
+
+            offsets = np.asarray([e.offset for e in entries], np.int64)
+            cols = native_loader.extract_headers(data, offsets)
+            res.n_blocks += cols.n
+            yield from _views_from_columns(cols)
+        else:
+            for e in entries:
+                res.n_blocks += 1
+                yield Block.from_bytes(
+                    data[e.offset : e.offset + e.size]
+                ).header.to_view()
+
+
 def revalidate(
     db_path: str,
     params: PraosParams,
@@ -120,30 +179,23 @@ def revalidate(
     t0 = time.monotonic()
     imm = open_immutable(db_path, validate_all=validate_all)
 
-    def headers():
-        for entry, raw in imm.stream_all():
-            res.n_blocks += 1
-            yield Block.from_bytes(raw).header
-
     st = PraosState()
     if backend == "host":
         try:
-            for h in headers():
-                hv = h.to_view()
-                ticked = praos.tick(params, lview, h.slot, st)
-                st = praos.update(params, hv, h.slot, ticked)
+            for hv in _stream_views(imm, res):
+                ticked = praos.tick(params, lview, hv.slot, st)
+                st = praos.update(params, hv, hv.slot, ticked)
                 res.n_valid += 1
         except praos.PraosValidationError as e:
             res.error = e
     elif backend == "device":
         done = False
-        for seg in _epoch_segments(params, headers()):
+        for seg in _epoch_segments(params, _stream_views(imm, res)):
             if done:
                 break
             for i in range(0, len(seg), max_batch):
-                sub = seg[i : i + max_batch]
-                hvs = [h.to_view() for h in sub]
-                ticked = praos.tick(params, lview, sub[0].slot, st)
+                hvs = seg[i : i + max_batch]
+                ticked = praos.tick(params, lview, hvs[0].slot, st)
                 ts = time.monotonic()
                 result = pbatch.validate_batch(params, ticked, hvs)
                 res.device_s += time.monotonic() - ts
@@ -230,3 +282,45 @@ def benchmark_ledger_ops(
 def count_blocks(db_path: str) -> int:
     imm = open_immutable(db_path)
     return imm.n_blocks()
+
+
+def main(argv=None) -> None:
+    """CLI (app/db-analyser.hs + DBAnalyser/Parsers.hs analog)."""
+    import argparse
+
+    from .db_synthesizer import default_params, make_credentials
+
+    p = argparse.ArgumentParser(prog="db_analyser", description=__doc__)
+    p.add_argument("--db", required=True)
+    p.add_argument("--pools", type=int, default=2,
+                   help="credential count the chain was synthesized with")
+    p.add_argument("--kes-depth", type=int, default=7)
+    p.add_argument(
+        "--analysis",
+        choices=["only-validation", "benchmark-ledger-ops", "count-blocks"],
+        default="only-validation",
+    )
+    p.add_argument("--backend", choices=["device", "host"], default="device")
+    p.add_argument("--out-csv", default=None)
+    a = p.parse_args(argv)
+    if a.analysis == "count-blocks":
+        print(count_blocks(a.db))
+        return
+    params = default_params(kes_depth=a.kes_depth)
+    _, lview = make_credentials(a.pools, kes_depth=a.kes_depth)
+    if a.analysis == "benchmark-ledger-ops":
+        rows = benchmark_ledger_ops(a.db, params, lview, out_csv=a.out_csv)
+        print(f"{len(rows)} blocks benchmarked" + (
+            f"; CSV at {a.out_csv}" if a.out_csv else ""))
+        return
+    res = revalidate(a.db, params, lview, backend=a.backend,
+                     trace=lambda s: print(s))
+    status = "OK" if res.error is None else f"INVALID at {res.n_valid}: {res.error!r}"
+    print(
+        f"validated {res.n_valid}/{res.n_blocks} headers in {res.wall_s:.1f}s "
+        f"(device {res.device_s:.1f}s) -> {status}"
+    )
+
+
+if __name__ == "__main__":
+    main()

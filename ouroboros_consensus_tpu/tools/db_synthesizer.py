@@ -142,3 +142,35 @@ def synthesize(
     res.wall_s = time.monotonic() - t0
     res.final_state = st
     return res
+
+
+def main(argv=None) -> None:
+    """CLI (app/db-synthesizer.hs + DBSynthesizer/Parsers.hs analog)."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="db_synthesizer", description=__doc__)
+    p.add_argument("--out", required=True, help="chain DB directory to create")
+    p.add_argument("--pools", type=int, default=2)
+    p.add_argument("--kes-depth", type=int, default=7)
+    lim = p.add_mutually_exclusive_group(required=True)
+    lim.add_argument("--slots", type=int)
+    lim.add_argument("--blocks", type=int)
+    lim.add_argument("--epochs", type=int)
+    p.add_argument("--txs-per-block", type=int, default=0)
+    a = p.parse_args(argv)
+    params = default_params(kes_depth=a.kes_depth)
+    pools, lview = make_credentials(a.pools, kes_depth=a.kes_depth)
+    res = synthesize(
+        a.out, params, pools, lview,
+        ForgeLimit(slots=a.slots, blocks=a.blocks, epochs=a.epochs),
+        txs_per_block=a.txs_per_block,
+        trace=lambda s: print(s),
+    )
+    print(
+        f"forged {res.n_blocks} blocks over {res.n_slots} slots "
+        f"in {res.wall_s:.1f}s"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""db-truncater: truncate an ImmutableDB after a given point/slot.
+
+Reference: `Cardano.Tools.DBTruncater` (Tools/DBTruncater/Run.hs
+`truncate`): open the ImmutableDB, find the truncation point, drop
+everything after it. Used to rewind a chain for reproduction runs.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..block.abstract import Point
+from ..storage.immutable import ImmutableDB
+
+
+def truncate(db_path: str, after_slot: int | None) -> int:
+    """Truncate the DB at `db_path` to blocks with slot <= after_slot
+    (None wipes it). Returns the number of blocks remaining."""
+    imm = ImmutableDB(os.path.join(db_path, "immutable"))
+    if after_slot is None:
+        imm.truncate_after(None)
+    else:
+        # find the last block at or before the slot
+        target = None
+        for n in imm._chunks:
+            for e in imm._entries[n]:
+                if e.slot <= after_slot:
+                    target = Point(e.slot, e.hash_)
+        imm.truncate_after(target)
+    imm.flush()
+    return imm.n_blocks()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="db_truncater", description=__doc__)
+    p.add_argument("--db", required=True, help="chain DB directory")
+    p.add_argument(
+        "--truncate-after-slot", type=int, default=None,
+        help="keep blocks with slot <= N (omit to wipe)",
+    )
+    a = p.parse_args(argv)
+    n = truncate(a.db, a.truncate_after_slot)
+    print(f"truncated; {n} blocks remain")
+
+
+if __name__ == "__main__":
+    main()

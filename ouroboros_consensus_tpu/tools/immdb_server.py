@@ -1,0 +1,186 @@
+"""immdb-server: serve an ImmutableDB over ChainSync + BlockFetch
+without a full ChainDB.
+
+Reference: `Cardano.Tools.ImmDBServer` (Tools/ImmDBServer/{Diffusion,
+MiniProtocols}.hs) — a stripped node that answers header/block requests
+straight from an on-disk ImmutableDB, used to feed syncing test nodes.
+
+Here the server speaks the same tuple wire protocol as
+miniprotocol/chainsync+blockfetch, over either sim Channels (tests) or
+an asyncio TCP transport (serve_tcp) using length-prefixed CBOR frames —
+the host-side "DCN" transport of SURVEY.md §5.8.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..block.abstract import Point
+from ..block.praos_block import Block
+from ..storage.immutable import ImmutableDB
+from ..utils import cbor
+from ..utils.sim import Recv, Send
+
+
+class ImmutableChainView:
+    """Adapts an ImmutableDB to the slice of the ChainDB surface the
+    chainsync/blockfetch servers read (static chain: no rollbacks)."""
+
+    def __init__(self, db_path: str):
+        self.imm = ImmutableDB(os.path.join(db_path, "immutable"))
+        self.blocks = [Block.from_bytes(raw) for _, raw in self.imm.stream_all()]
+        self.current_chain = self.blocks  # whole chain is "volatile view"
+
+    def _anchor_point(self) -> Point | None:
+        return None
+
+    def tip_point(self) -> Point | None:
+        return self.blocks[-1].point if self.blocks else None
+
+    def new_follower(self):
+        class _StaticFollower:
+            def take_updates(self):
+                return []
+
+        return _StaticFollower()
+
+
+def serve_sim(view: ImmutableChainView, cs_rx, cs_tx, bf_rx, bf_tx):
+    """Spawn-able pair of server generators over sim channels."""
+    from ..miniprotocol import blockfetch, chainsync
+
+    return (
+        chainsync.server(view, cs_rx, cs_tx, poll_interval=0.5),
+        blockfetch.server(view, bf_rx, bf_tx),
+    )
+
+
+# -- asyncio TCP transport ---------------------------------------------------
+
+
+def _frame(msg) -> bytes:
+    data = cbor.encode(_to_wire(msg))
+    return len(data).to_bytes(4, "big") + data
+
+
+def _to_wire(obj):
+    """Points/None/bytes/ints/tuples -> CBOR-encodable."""
+    if obj is None:
+        return None
+    if isinstance(obj, Point):
+        return ["pt", obj.slot, obj.hash_]
+    if isinstance(obj, (list, tuple)):
+        return [_to_wire(x) for x in obj]
+    return obj
+
+
+def _from_wire(obj):
+    if isinstance(obj, list):
+        if len(obj) == 3 and obj[0] == "pt":
+            return Point(obj[1], obj[2])
+        return tuple(_from_wire(x) for x in obj)
+    return obj
+
+
+async def _read_frame(reader):
+    import asyncio
+
+    hdr = await reader.readexactly(4)
+    n = int.from_bytes(hdr, "big")
+    return _from_wire(cbor.decode(await reader.readexactly(n)))
+
+
+async def serve_tcp(db_path: str, host: str = "127.0.0.1", port: int = 3001):
+    """One TCP service multiplexing chainsync-style requests: each frame
+    is a request tuple; the reply frame(s) follow. Static chain only."""
+    import asyncio
+
+    view = ImmutableChainView(db_path)
+
+    async def handle(reader, writer):
+        try:
+            while True:
+                msg = await _read_frame(reader)
+                kind = msg[0]
+                if kind == "find_intersect":
+                    # same contract as miniprotocol/chainsync.py server:
+                    # None in the offered points = genesis fallback; no
+                    # match at all -> intersect_not_found
+                    points = msg[1]
+                    ours = {b.point: i for i, b in enumerate(view.blocks)}
+                    found = next((p for p in points if p in ours), None)
+                    if found is not None or None in points:
+                        writer.write(
+                            _frame(("intersect_found", found, view.tip_point()))
+                        )
+                    else:
+                        writer.write(_frame(("intersect_not_found", view.tip_point())))
+                elif kind == "request_range":
+                    # same contract as miniprotocol/blockfetch.py server:
+                    # an unsatisfiable range answers no_blocks, never a
+                    # partial/overshooting stream
+                    frm, to = msg[1], msg[2]
+                    out, started = [], frm is None
+                    for b in view.blocks:
+                        if not started:
+                            started = b.point == frm
+                            continue
+                        out.append(b)
+                        if b.point == to:
+                            break
+                    else:
+                        out = []
+                    if out and out[-1].point != to:
+                        out = []
+                    if not out:
+                        writer.write(_frame(("no_blocks",)))
+                    else:
+                        writer.write(_frame(("start_batch",)))
+                        for b in out:
+                            writer.write(_frame(("block", b.bytes_)))
+                        writer.write(_frame(("batch_done",)))
+                elif kind == "headers_from":
+                    # bulk header stream after a point (sync accelerator)
+                    start = msg[1]
+                    idx = 0
+                    if start is not None:
+                        for i, b in enumerate(view.blocks):
+                            if b.point == start:
+                                idx = i + 1
+                                break
+                    for b in view.blocks[idx : idx + 1000]:
+                        writer.write(_frame(("roll_forward", b.header.bytes_, view.tip_point())))
+                    writer.write(_frame(("await_reply",)))
+                elif kind == "done":
+                    break
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    server = await asyncio.start_server(handle, host, port)
+    return server
+
+
+def main(argv=None) -> None:
+    import argparse
+    import asyncio
+
+    p = argparse.ArgumentParser(prog="immdb_server", description=__doc__)
+    p.add_argument("--db", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=3001)
+    a = p.parse_args(argv)
+
+    async def run():
+        server = await serve_tcp(a.db, a.host, a.port)
+        print(f"immdb-server listening on {a.host}:{a.port}")
+        async with server:
+            await server.serve_forever()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

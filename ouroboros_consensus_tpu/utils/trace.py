@@ -1,0 +1,165 @@
+"""Tracing: structured event emission threaded through every component.
+
+Reference: contravariant `Tracer`s everywhere (contra-tracer; master
+record `Tracers'` at diffusion Node/Tracers.hs:50-64; ChainDB's event
+algebra at ChainDB/Impl.hs:10-28) plus `Enclose` start/end brackets for
+latency measurement (Util/Enclose.hs).
+
+The TPU build keeps the same shape with plain callables: a Tracer is any
+`Callable[[event], None]`; combinators below mirror contramap / nullTracer
+/ condTracer; `Enclose` is a context manager stamping monotonic start/end
+events. Events are dataclasses (typed, matchable) — rendering is the
+embedding application's job, exactly as in the reference (§5.5)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+Tracer = Callable[[Any], None]
+
+
+def null_tracer(_event: Any) -> None:
+    """nullTracer: drop everything."""
+
+
+def contramap(f: Callable[[Any], Any], tracer: Tracer) -> Tracer:
+    """contramap: adapt event type before forwarding."""
+
+    def t(ev):
+        tracer(f(ev))
+
+    return t
+
+
+def cond_tracer(pred: Callable[[Any], bool], tracer: Tracer) -> Tracer:
+    def t(ev):
+        if pred(ev):
+            tracer(ev)
+
+    return t
+
+
+def fanout(*tracers: Tracer) -> Tracer:
+    def t(ev):
+        for tr in tracers:
+            tr(ev)
+
+    return t
+
+
+class ListTracer:
+    """Test helper: collect events (the recordingTracerIORef analog)."""
+
+    def __init__(self):
+        self.events: list = []
+
+    def __call__(self, ev):
+        self.events.append(ev)
+
+
+def stderr_tracer(prefix: str = "") -> Tracer:
+    """db-analyser-style locked stderr tracer with monotonic timestamps
+    (DBAnalyser/Run.hs:122-131)."""
+    import sys
+    import threading
+
+    lock = threading.Lock()
+    t0 = time.monotonic()
+
+    def t(ev):
+        with lock:
+            print(f"[{time.monotonic() - t0:10.3f}] {prefix}{ev}", file=sys.stderr)
+
+    return t
+
+
+@dataclass
+class EncloseEvent:
+    """Start/end bracket (Util/Enclose.hs RisingEdge/FallingEdge)."""
+
+    label: str
+    edge: str  # "start" | "end"
+    t: float
+    duration: float | None = None  # set on the end edge
+
+
+class Enclose:
+    """Context manager emitting start/end events around an action:
+
+        with Enclose(tracer, "volatile-write"):
+            ...
+    """
+
+    def __init__(self, tracer: Tracer, label: str):
+        self.tracer = tracer
+        self.label = label
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        self.tracer(EncloseEvent(self.label, "start", self._t0))
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.monotonic()
+        self.tracer(EncloseEvent(self.label, "end", t1, t1 - self._t0))
+        return False
+
+
+# -- the consensus event vocabulary (Tracers' record, condensed) -------------
+
+
+@dataclass(frozen=True)
+class AddedBlock:
+    slot: int
+    block_no: int
+    hash_: bytes
+
+
+@dataclass(frozen=True)
+class SwitchedToFork:
+    n_rollback: int
+    new_tip_slot: int
+
+
+@dataclass(frozen=True)
+class InvalidBlockEvent:
+    slot: int
+    hash_: bytes
+    reason: str
+
+
+@dataclass(frozen=True)
+class ForgedBlock:
+    slot: int
+    block_no: int
+    adopted: bool
+
+
+@dataclass(frozen=True)
+class ValidatedBatch:
+    """The TPU-specific event: one fused device batch completed."""
+
+    n_headers: int
+    n_valid: int
+    device_s: float
+
+
+@dataclass
+class NodeTracers:
+    """Tracers' (Node/Tracers.hs:50): one tracer per subsystem, all
+    defaulting to null."""
+
+    chain_db: Tracer = null_tracer
+    chain_sync_client: Tracer = null_tracer
+    chain_sync_server: Tracer = null_tracer
+    block_fetch: Tracer = null_tracer
+    mempool: Tracer = null_tracer
+    forge: Tracer = null_tracer
+    batch_validation: Tracer = null_tracer
+
+    @classmethod
+    def all_to(cls, tracer: Tracer) -> "NodeTracers":
+        return cls(*([tracer] * 7))

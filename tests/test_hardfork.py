@@ -1,0 +1,177 @@
+"""Hard-fork combinator: time-conversion roundtrips (reference:
+Test/Consensus/HardFork/History.hs) and a two-era chain crossing a real
+transition with state translation (the A→B model test,
+diffusion test/consensus-test HardFork/Combinator.hs)."""
+
+from dataclasses import replace
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.hardfork import (
+    Era,
+    EraParams,
+    HardForkBlock,
+    HardForkProtocol,
+    PastHorizon,
+    decode_block,
+    summarize,
+)
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.protocol.instances import PraosProtocol
+from ouroboros_consensus_tpu.testing import fixtures
+
+# -- history -----------------------------------------------------------------
+
+
+def two_era_summary():
+    return summarize(
+        Fraction(0),
+        [
+            EraParams(epoch_size=20, slot_length=Fraction(1)),
+            EraParams(epoch_size=50, slot_length=Fraction(2)),
+        ],
+        [2, None],  # era 0 ends at epoch 2 (slot 40); era 1 open
+    )
+
+
+def test_summary_bounds():
+    s = two_era_summary()
+    assert s.eras[0].end.slot == 40
+    assert s.eras[0].end.epoch == 2
+    assert s.eras[0].end.time == Fraction(40)
+    assert s.eras[1].end is None
+
+
+def test_slot_epoch_roundtrip():
+    s = two_era_summary()
+    for slot in list(range(0, 41)) + [41, 89, 90, 139, 500]:
+        epoch, in_epoch = s.slot_to_epoch(slot)
+        first = s.epoch_to_first_slot(epoch)
+        assert first + in_epoch == slot
+        assert in_epoch < s.epoch_size(epoch)
+
+
+def test_wallclock_roundtrip():
+    s = two_era_summary()
+    for slot in [0, 5, 39, 40, 41, 100]:
+        t, ln = s.slot_to_wallclock(slot)
+        back, spent = s.wallclock_to_slot(t)
+        assert back == slot and spent == 0
+        back2, spent2 = s.wallclock_to_slot(t + ln / 2)
+        assert back2 == slot and spent2 == ln / 2
+
+
+def test_era_boundary_conversions():
+    s = two_era_summary()
+    # era 0: slots are 1s; era 1 starts at slot 40, time 40, slots are 2s
+    assert s.slot_to_wallclock(40) == (Fraction(40), Fraction(2))
+    assert s.slot_to_wallclock(41) == (Fraction(42), Fraction(2))
+    assert s.slot_to_epoch(40) == (2, 0)
+    assert s.epoch_to_first_slot(3) == 90
+
+
+def test_past_horizon_on_negative():
+    s = two_era_summary()
+    with pytest.raises(PastHorizon):
+        s.wallclock_to_slot(Fraction(-1))
+
+
+# -- combinator: two Praos eras with a parameter change ----------------------
+
+EPOCHS_IN_A = 2
+
+
+def make_hf(pools):
+    lview = fixtures.make_ledger_view(pools)
+    pa = praos.PraosParams(
+        slots_per_kes_period=100, max_kes_evolutions=62, security_param=4,
+        active_slot_coeff=Fraction(1, 2), epoch_length=20, kes_depth=3,
+    )
+    pb = replace(pa, epoch_length=50)
+    summary = summarize(
+        Fraction(0),
+        [EraParams(20, Fraction(1)), EraParams(50, Fraction(1))],
+        [EPOCHS_IN_A, None],
+    )
+    era_a = Era("eraA", PraosProtocol(pa, use_device_batch=False), ledger=None)
+    era_b = Era("eraB", PraosProtocol(pb, use_device_batch=False), ledger=None)
+    return HardForkProtocol([era_a, era_b], summary), (pa, pb), lview
+
+
+def test_two_era_chain_crosses_transition():
+    pools = [fixtures.make_pool(i, kes_depth=3) for i in range(2)]
+    hf, (pa, pb), lview = make_hf(pools)
+    st = hf.initial_state()
+    prev = None
+    n_a = n_b = 0
+    slot = 0
+    while slot < 120 and (n_a < 3 or n_b < 3):
+        ticked = hf.tick(lview, slot, st)
+        era = ticked.era
+        params = pa if era == 0 else pb
+        eta0 = ticked.inner.state.epoch_nonce
+        pool = fixtures.find_leader(params, pools, lview, slot, eta0)
+        if pool is not None:
+            hv = fixtures.forge_header_view(
+                params, pool, slot=slot, epoch_nonce=eta0, prev_hash=prev,
+                body_bytes=b"b%d" % slot,
+            )
+            st = hf.update(hv, slot, ticked)
+            assert st.era == era
+            prev = (b"%032d" % slot)[:32]
+            if era == 0:
+                n_a += 1
+            else:
+                n_b += 1
+        slot += 1
+    assert n_a >= 3 and n_b >= 3
+    assert st.era == 1  # crossed into era B
+    # nonce state carried across the transition (translated, not reset)
+    assert st.inner.evolving_nonce is not None
+
+
+def test_tick_refuses_past_era():
+    pools = [fixtures.make_pool(0, kes_depth=3)]
+    hf, _, lview = make_hf(pools)
+    st = hf.initial_state()
+    st2 = hf._cross_eras(st, 1)
+    with pytest.raises(ValueError):
+        hf.tick(lview, 5, st2)  # slot 5 is era 0, state already in era 1
+
+
+def test_cross_era_candidate_comparison():
+    pools = [fixtures.make_pool(0, kes_depth=3)]
+    hf, (pa, pb), lview = make_hf(pools)
+    nonce = b"\x07" * 32
+    ha = fixtures.forge_header_view  # convenience: need Header-like objs
+
+    # forge one header in each era; wrap minimal select-view comparison
+    from ouroboros_consensus_tpu.block.forge import forge_block
+
+    blk_a = forge_block(pa, pools[0], slot=5, block_no=7, prev_hash=None, epoch_nonce=nonce)
+    blk_b = forge_block(pb, pools[0], slot=45, block_no=9, prev_hash=None, epoch_nonce=nonce)
+    va = hf.select_view(blk_a.header)
+    vb = hf.select_view(blk_b.header)
+    assert va[0] == 0 and vb[0] == 1
+    assert hf.compare_candidates(va, vb) > 0  # higher block_no wins across eras
+    assert hf.compare_candidates(vb, va) < 0
+
+
+def test_hardfork_block_roundtrip():
+    from ouroboros_consensus_tpu.block.forge import forge_block
+    from ouroboros_consensus_tpu.block.praos_block import Block
+
+    pools = [fixtures.make_pool(0, kes_depth=3)]
+    pa = praos.PraosParams(
+        slots_per_kes_period=100, max_kes_evolutions=62, security_param=4,
+        active_slot_coeff=Fraction(1), epoch_length=20, kes_depth=3,
+    )
+    blk = forge_block(pa, pools[0], slot=3, block_no=0, prev_hash=None,
+                      epoch_nonce=b"\x07" * 32, txs=(b"tx1",))
+    hfb = HardForkBlock(1, blk)
+    data = hfb.bytes_
+    back = decode_block(data, [Block.from_bytes, Block.from_bytes])
+    assert back.era == 1
+    assert back.hash_ == blk.hash_
+    assert back.txs == (b"tx1",)

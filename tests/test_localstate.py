@@ -1,0 +1,139 @@
+"""Local mini-protocols (LocalStateQuery / LocalTxSubmission /
+LocalTxMonitor servers) against a real node kernel, under the sim."""
+
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.ledger.extended import ExtLedger
+from ouroboros_consensus_tpu.ledger.mock import (
+    MockConfig,
+    MockLedger,
+    encode_tx,
+    tx_id,
+)
+from ouroboros_consensus_tpu.miniprotocol import localstate
+from ouroboros_consensus_tpu.node.kernel import NodeKernel
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.protocol.instances import PraosProtocol
+from ouroboros_consensus_tpu.storage.open import open_chaindb
+from ouroboros_consensus_tpu.testing import fixtures
+from ouroboros_consensus_tpu.utils.sim import Channel, Recv, Send, Sim
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=100,
+    max_kes_evolutions=62,
+    security_param=4,
+    active_slot_coeff=Fraction(1),  # every slot forges: deterministic
+    epoch_length=1000,
+    kes_depth=3,
+)
+
+
+@pytest.fixture
+def node(tmp_path):
+    pool = fixtures.make_pool(0, kes_depth=3)
+    lview = fixtures.make_ledger_view([pool])
+    ledger = MockLedger(MockConfig(lview, PARAMS.stability_window))
+    proto = PraosProtocol(PARAMS, use_device_batch=False)
+    ext = ExtLedger(ledger, proto)
+    genesis = ext.genesis(ledger.genesis_state([(b"alice", 100)]))
+    db = open_chaindb(str(tmp_path), ext, genesis, k=4)
+    return NodeKernel("n0", db, proto, ledger, pool=pool)
+
+
+def drive(server_gen_factory, requests):
+    """Run a server task against a scripted client; return replies."""
+    rx, tx = Channel(), Channel()
+    replies = []
+
+    def client():
+        for req in requests:
+            yield Send(rx, req)
+            if req[0] != "release":
+                replies.append((yield Recv(tx)))
+        yield Send(rx, ("done",))
+
+    sim = Sim()
+    sim.spawn(server_gen_factory(rx, tx), "server")
+    sim.spawn(client(), "client")
+    sim.run(until=10)
+    return replies
+
+
+def test_state_query(node):
+    node.try_forge(0)
+    node.try_forge(1)
+    replies = drive(
+        lambda rx, tx: localstate.state_query_server(node, rx, tx),
+        [
+            ("acquire", None),
+            ("query", "get_chain_block_no", ()),
+            ("query", "get_tip_slot", ()),
+            ("query", "get_balance", (b"alice",)),
+            ("query", "bogus", ()),
+        ],
+    )
+    assert replies[0] == ("acquired",)
+    assert replies[1] == ("result", 1)
+    assert replies[2] == ("result", 1)
+    assert replies[3] == ("result", 100)
+    assert replies[4][0] == "failed"
+
+
+def test_tx_submission_and_monitor(node):
+    txin = next(iter(node.chain_db.current_ledger().ledger_state.utxo))
+    amt = node.chain_db.current_ledger().ledger_state.utxo[txin][1]
+    good = encode_tx([txin], [(b"bob", amt)])
+    bad = encode_tx([(b"\x00" * 32, 9)], [(b"x", 1)])
+    replies = drive(
+        lambda rx, tx: localstate.tx_submission_server(node, rx, tx),
+        [("submit", good), ("submit", bad)],
+    )
+    assert replies[0] == ("accepted",)
+    assert replies[1][0] == "rejected"
+
+    replies = drive(
+        lambda rx, tx: localstate.tx_monitor_server(node, rx, tx),
+        [
+            ("acquire",),
+            ("has_tx", tx_id(good)),
+            ("next_tx",),
+            ("next_tx",),
+            ("get_sizes",),
+        ],
+    )
+    assert replies[0][0] == "acquired"
+    assert replies[1] == ("bool", True)
+    assert replies[2] == ("tx", good)
+    assert replies[3] == ("no_more",)
+    cap, used, n = replies[4][1:]
+    assert n == 1 and used == len(good)
+
+
+def test_tracers():
+    from ouroboros_consensus_tpu.utils.trace import (
+        Enclose,
+        EncloseEvent,
+        ListTracer,
+        cond_tracer,
+        contramap,
+        fanout,
+    )
+
+    lt = ListTracer()
+    t = contramap(lambda e: ("wrapped", e), lt)
+    t("x")
+    assert lt.events == [("wrapped", "x")]
+
+    lt2 = ListTracer()
+    ct = cond_tracer(lambda e: e > 1, lt2)
+    ct(1)
+    ct(2)
+    assert lt2.events == [2]
+
+    lt3 = ListTracer()
+    with Enclose(lt3, "op"):
+        pass
+    assert [e.edge for e in lt3.events] == ["start", "end"]
+    assert lt3.events[1].duration >= 0

@@ -1,0 +1,97 @@
+"""BFT / PBFT / LeaderSchedule protocol instances (Protocol/{BFT,PBFT,
+LeaderSchedule}.hs semantics: round-robin, signature window, schedule)."""
+
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.ops.host import ed25519 as he
+from ouroboros_consensus_tpu.protocol.instances import (
+    BftInvalidSignature,
+    BftProtocol,
+    BftView,
+    BftWrongLeader,
+    LeaderScheduleProtocol,
+    NotScheduledLeader,
+    PBftExceededSignThreshold,
+    PBftInvalidSignature,
+    PBftNotGenesisDelegate,
+    PBftParams,
+    PBftProtocol,
+    PBftView,
+)
+
+SEEDS = [bytes([i]) * 32 for i in range(3)]
+VKS = [he.secret_to_public(s) for s in SEEDS]
+
+
+def bft_view(node, msg=b"hdr"):
+    return BftView(node, msg, he.sign(SEEDS[node], msg))
+
+
+def test_bft_round_robin():
+    p = BftProtocol(3, VKS)
+    st = p.initial_state()
+    t = p.tick(None, 4, st)
+    st2 = p.update(bft_view(1), 4, t)  # 4 % 3 == 1
+    assert st2.last_slot == 4
+    with pytest.raises(BftWrongLeader):
+        p.update(bft_view(2), 4, t)
+    bad = BftView(1, b"hdr", b"\x00" * 64)
+    with pytest.raises(BftInvalidSignature):
+        p.update(bad, 4, t)
+    assert p.check_is_leader(1, 4, t) == 1
+    assert p.check_is_leader(0, 4, t) is None
+
+
+def pbft_view(node, msg=b"hdr"):
+    return PBftView(VKS[node], msg, he.sign(SEEDS[node], msg))
+
+
+def test_pbft_window_threshold():
+    # window 4, threshold 1/2: max 2 of the last 4 signed by one delegate
+    p = PBftProtocol(PBftParams(3, Fraction(1, 2), 4), VKS)
+    st = p.initial_state()
+    st = p.update(pbft_view(0), 0, p.tick(None, 0, st))
+    st = p.update(pbft_view(0), 1, p.tick(None, 1, st))
+    # a third signature by delegate 0 within the window exceeds 2/4
+    with pytest.raises(PBftExceededSignThreshold):
+        p.update(pbft_view(0), 2, p.tick(None, 2, st))
+    # another delegate is fine; window then slides
+    st = p.update(pbft_view(1), 2, p.tick(None, 2, st))
+    st = p.update(pbft_view(2), 3, p.tick(None, 3, st))
+    st = p.update(pbft_view(1), 4, p.tick(None, 4, st))
+    # window is now [0,1,2,1] -> delegate 0 appears once: allowed again
+    st = p.update(pbft_view(0), 5, p.tick(None, 5, st))
+    assert st.signers[-1] == 0
+
+
+def test_pbft_rejections():
+    p = PBftProtocol(PBftParams(2, Fraction(1, 2), 4), VKS[:2])
+    t = p.tick(None, 0, p.initial_state())
+    rogue = PBftView(VKS[2], b"hdr", he.sign(SEEDS[2], b"hdr"))
+    with pytest.raises(PBftNotGenesisDelegate):
+        p.update(rogue, 0, t)
+    forged = PBftView(VKS[0], b"hdr", he.sign(SEEDS[1], b"hdr"))
+    with pytest.raises(PBftInvalidSignature):
+        p.update(forged, 0, t)
+
+
+def test_pbft_reupdate_skips_crypto():
+    p = PBftProtocol(PBftParams(2, Fraction(1, 2), 4), VKS[:2])
+    t = p.tick(None, 0, p.initial_state())
+    v = PBftView(VKS[0], b"hdr", b"garbage")  # bad sig: reupdate ignores
+    st = p.reupdate(v, 0, t)
+    assert st.signers == (0,)
+
+
+def test_leader_schedule():
+    p = LeaderScheduleProtocol({0: [1], 1: [0, 2], 2: []})
+    t = p.tick(None, 1, p.initial_state())
+    assert p.check_is_leader(0, 1, t) == 0
+    assert p.check_is_leader(1, 1, t) is None
+    st = p.update(2, 1, t)
+    assert st.last_slot == 1
+    with pytest.raises(NotScheduledLeader):
+        p.update(1, 1, t)
+    assert p.check_is_leader(0, 2, p.tick(None, 2, st)) is None
