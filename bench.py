@@ -123,6 +123,9 @@ def measure_cpu_baseline() -> float:
 
 def main() -> None:
     import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/ouroboros-jax-cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     import numpy as np
 
     from ouroboros_consensus_tpu.protocol import batch as pbatch

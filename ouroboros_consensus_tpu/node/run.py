@@ -1,0 +1,181 @@
+"""Node start-up assembly: locks, markers, crash recovery, DB open.
+
+Reference: `ouroboros-consensus-diffusion` `Node.hs:272-580` (`run` /
+`runWith` / `stdWithCheckedDB` / `openChainDB`) and the failure-handling
+modules `Node/{DbLock,DbMarker,Recovery,Exit}.hs`:
+
+  * DB lock — one process per DB directory (DbLock.hs).
+  * DB marker — a magic file binding the directory to a network id so a
+    mainnet node can't open a testnet DB (DbMarker.hs).
+  * clean-shutdown marker — present while a node runs; found on start ⇒
+    the previous run crashed ⇒ open with full validation
+    (Recovery.hs:24-59).
+  * exit triage — map exceptions to exit reasons (Exit.hs:63).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from enum import Enum
+from typing import Callable
+
+from ..ledger.extended import ExtLedger, ExtLedgerState
+from ..storage.open import open_chaindb
+from .kernel import NodeKernel, SlotClock
+
+DB_LOCK = "lock"
+DB_MARKER = "protocolMagicId"
+CLEAN_SHUTDOWN = "clean"  # reference: absence of the marker = crashed
+
+
+class DbLocked(Exception):
+    """Another process holds the DB (DbLock.hs DbLocked)."""
+
+
+class DbMarkerMismatch(Exception):
+    """DB belongs to a different network (DbMarker.hs)."""
+
+
+class ExitReason(Enum):
+    """Node/Exit.hs:63 ExitReason — process exit triage."""
+
+    SUCCESS = 0
+    GENERIC = 1
+    CONFIG_ERROR = 2
+    DB_CORRUPTION = 3
+    NETWORK_ERROR = 4
+
+
+def to_exit_reason(exc: BaseException) -> ExitReason:
+    """toExitReason (Node/Exit.hs:100)."""
+    from ..storage.immutable import ImmutableDBError
+
+    if isinstance(exc, (DbLocked, DbMarkerMismatch)):
+        return ExitReason.CONFIG_ERROR
+    if isinstance(exc, ImmutableDBError):
+        return ExitReason.DB_CORRUPTION
+    if isinstance(exc, (ConnectionError, OSError)):
+        return ExitReason.NETWORK_ERROR
+    return ExitReason.GENERIC
+
+
+class DbLockFile:
+    """flock-based single-process guard (DbLock.hs, 2s timeout)."""
+
+    def __init__(self, db_path: str):
+        self.path = os.path.join(db_path, DB_LOCK)
+        self._fd: int | None = None
+
+    def acquire(self) -> None:
+        import fcntl
+
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            os.close(fd)
+            raise DbLocked(self.path) from e
+        self._fd = fd
+
+    def release(self) -> None:
+        if self._fd is not None:
+            import fcntl
+
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def check_db_marker(db_path: str, network_magic: int) -> None:
+    """checkDbMarker (DbMarker.hs): create on first open, verify after."""
+    p = os.path.join(db_path, DB_MARKER)
+    if os.path.exists(p):
+        with open(p) as f:
+            found = int(f.read().strip())
+        if found != network_magic:
+            raise DbMarkerMismatch(f"DB is for magic {found}, node runs {network_magic}")
+    else:
+        os.makedirs(db_path, exist_ok=True)
+        with open(p, "w") as f:
+            f.write(str(network_magic))
+
+
+def was_clean_shutdown(db_path: str) -> bool:
+    """Recovery.hs:24: the clean marker is REMOVED while running and
+    written back on orderly shutdown; missing at start (after a first
+    run) ⇒ crash ⇒ revalidate everything."""
+    return os.path.exists(os.path.join(db_path, CLEAN_SHUTDOWN))
+
+
+def _has_db(db_path: str) -> bool:
+    return os.path.exists(os.path.join(db_path, DB_MARKER))
+
+
+@dataclass
+class RunningNode:
+    kernel: NodeKernel
+    db_path: str
+    lock: DbLockFile
+    crashed_last_run: bool
+
+    def shutdown(self) -> None:
+        """Orderly stop: final snapshot, clean marker, release lock."""
+        self.kernel.chain_db.close()
+        with open(os.path.join(self.db_path, CLEAN_SHUTDOWN), "w") as f:
+            f.write("clean\n")
+        self.lock.release()
+
+
+def start_node(
+    name: str,
+    db_path: str,
+    ext: ExtLedger,
+    genesis: ExtLedgerState,
+    k: int,
+    *,
+    network_magic: int = 764824073,
+    pool=None,
+    clock: SlotClock | None = None,
+    chunk_size: int = 21600,
+    trace: Callable[[str], None] = lambda s: None,
+) -> RunningNode:
+    """run/runWith condensed (Node.hs:272): lock → marker → recovery
+    check → ChainDB open (validation policy per recovery) → NodeKernel.
+
+    The caller wires mini-protocol tasks and the forging loop into a
+    sim/asyncio runtime (testing/threadnet.py is the reference user).
+    """
+    lock = DbLockFile(db_path)
+    lock.acquire()
+    try:
+        check_db_marker(db_path, network_magic)
+        first_run = not os.path.exists(os.path.join(db_path, "immutable"))
+        crashed = not first_run and not was_clean_shutdown(db_path)
+        clean_marker = os.path.join(db_path, CLEAN_SHUTDOWN)
+        if os.path.exists(clean_marker):
+            os.remove(clean_marker)  # running now: a crash leaves no marker
+        if crashed:
+            trace(f"{name}: unclean shutdown detected -> full revalidation")
+        db = open_chaindb(
+            db_path, ext, genesis, k,
+            validate_all=crashed,
+            chunk_size=chunk_size,
+            trace=trace,
+        )
+        kernel = NodeKernel(
+            name, db, ext.protocol, ext.ledger, pool=pool, clock=clock, trace=trace
+        )
+        return RunningNode(kernel, db_path, lock, crashed)
+    except BaseException:
+        lock.release()
+        raise

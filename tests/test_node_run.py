@@ -1,0 +1,86 @@
+"""Node start-up assembly: DB lock, network marker, crash recovery
+(reference: Node.hs stdWithCheckedDB + Node/{DbLock,DbMarker,Recovery})."""
+
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.ledger.extended import ExtLedger
+from ouroboros_consensus_tpu.ledger.mock import MockConfig, MockLedger
+from ouroboros_consensus_tpu.node import run as node_run
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.protocol.instances import PraosProtocol
+from ouroboros_consensus_tpu.testing import fixtures
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=100,
+    max_kes_evolutions=62,
+    security_param=3,
+    active_slot_coeff=Fraction(1),
+    epoch_length=1000,
+    kes_depth=3,
+)
+
+
+@pytest.fixture
+def setup():
+    pool = fixtures.make_pool(0, kes_depth=3)
+    lview = fixtures.make_ledger_view([pool])
+    ledger = MockLedger(MockConfig(lview, PARAMS.stability_window))
+    proto = PraosProtocol(PARAMS, use_device_batch=False)
+    ext = ExtLedger(ledger, proto)
+    genesis = ext.genesis(ledger.genesis_state([(b"a", 10)]))
+    return pool, ext, genesis
+
+
+def test_lock_excludes_second_node(tmp_path, setup):
+    pool, ext, genesis = setup
+    n1 = node_run.start_node("n1", str(tmp_path), ext, genesis, k=3)
+    with pytest.raises(node_run.DbLocked):
+        node_run.start_node("n2", str(tmp_path), ext, genesis, k=3)
+    n1.shutdown()
+    # released: can start again
+    n2 = node_run.start_node("n2", str(tmp_path), ext, genesis, k=3)
+    n2.shutdown()
+
+
+def test_marker_mismatch(tmp_path, setup):
+    pool, ext, genesis = setup
+    n = node_run.start_node("n", str(tmp_path), ext, genesis, k=3, network_magic=1)
+    n.shutdown()
+    with pytest.raises(node_run.DbMarkerMismatch):
+        node_run.start_node("n", str(tmp_path), ext, genesis, k=3, network_magic=2)
+
+
+def test_crash_recovery_flag(tmp_path, setup):
+    pool, ext, genesis = setup
+    # first run: forge a couple blocks, shut down cleanly
+    n = node_run.start_node("n", str(tmp_path), ext, genesis, k=3, pool=pool)
+    n.kernel.try_forge(0)
+    n.kernel.try_forge(1)
+    n.shutdown()
+    # clean restart: no revalidation flag
+    n = node_run.start_node("n", str(tmp_path), ext, genesis, k=3, pool=pool)
+    assert not n.crashed_last_run
+    assert n.kernel.chain_db.tip_point().slot == 1
+    # simulate crash: do NOT call shutdown (marker stays absent)
+    n.lock.release()
+    n2 = node_run.start_node("n", str(tmp_path), ext, genesis, k=3, pool=pool)
+    assert n2.crashed_last_run  # full revalidation path taken
+    assert n2.kernel.chain_db.tip_point().slot == 1
+    n2.shutdown()
+
+
+def test_exit_reason_triage():
+    from ouroboros_consensus_tpu.storage.immutable import MissingBlock
+
+    assert (
+        node_run.to_exit_reason(node_run.DbLocked())
+        is node_run.ExitReason.CONFIG_ERROR
+    )
+    assert (
+        node_run.to_exit_reason(MissingBlock(None))
+        is node_run.ExitReason.DB_CORRUPTION
+    )
+    assert node_run.to_exit_reason(ConnectionError()) is node_run.ExitReason.NETWORK_ERROR
+    assert node_run.to_exit_reason(ValueError()) is node_run.ExitReason.GENERIC
