@@ -82,8 +82,7 @@ bool skip_item(Cursor& c) {
             return true;
         case 6: return skip_item(c);                     // tag
         case 7:                                          // simple/float
-            if (arg >= 24 && c.peek()) {}
-            return true;
+            return true;       // read_head already consumed the payload
         default: return false;
     }
 }

@@ -31,7 +31,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from ..block.abstract import Point
-from ..block.praos_block import Header
+from ..block.praos_block import Block, Header
 from ..protocol import praos as praos_mod
 from ..utils.sim import Recv, Send, Sleep
 
@@ -103,18 +103,38 @@ def server(chain_db, rx, tx, *, poll_interval: float = 0.05):
             follower.take_updates()
             points = msg[1]
             ours = {b.point: i for i, b in enumerate(chain_db.current_chain)}
+            anchor = chain_db._anchor_point()
+            # the reference server serves from ANY point on the chain,
+            # including the immutable part (Impl/Follower.hs); a miss on
+            # the volatile fragment must fall through to the ImmutableDB
+            # rather than silently streaming a disconnected suffix
             found = None
+            where = None  # "volatile" | "anchor" | "immutable" | "genesis"
             for p in points:
-                if p in ours or p == chain_db._anchor_point():
-                    found = p
-                    break
                 if p is None:
-                    found = None
+                    found, where = None, "genesis"
                     break
-            if found is not None or (points and points[-1] is None):
-                # serve everything after the intersection
+                if p in ours:
+                    found, where = p, "volatile"
+                    break
+                if p == anchor:
+                    found, where = p, "anchor"
+                    break
+                try:
+                    chain_db.immutable.get_block_bytes(p)
+                except Exception:
+                    continue
+                found, where = p, "immutable"
+                break
+            if where is not None:
                 pending.clear()
-                start = ours[found] + 1 if found in ours else 0
+                if where == "genesis":
+                    for _e, raw in chain_db.immutable.stream_all():
+                        pending.append(("addblock", Block.from_bytes(raw)))
+                elif where == "immutable":
+                    for _e, raw in chain_db.immutable.stream_from(found.slot):
+                        pending.append(("addblock", Block.from_bytes(raw)))
+                start = ours[found] + 1 if where == "volatile" else 0
                 for b in chain_db.current_chain[start:]:
                     pending.append(("addblock", b))
                 intersect_done = True

@@ -153,9 +153,11 @@ class NodeKernel:
         """Sim task: wake at every slot start (knownSlotWatcher,
         BlockchainTime/API.hs:59) and attempt to forge."""
         for slot in range(n_slots):
-            # sleep until the slot starts (virtual time)
-            yield Sleep(self.clock.slot_length)
+            # forge at the START of slot `slot` (virtual time
+            # slot*slot_length), then sleep the slot out — forging after
+            # the sleep would shift every block one slot late vs the clock
             self.try_forge(slot)
+            yield Sleep(self.clock.slot_length)
 
     def on_chain_changed(self):
         """Post-adoption bookkeeping shared by fetch/forge paths."""

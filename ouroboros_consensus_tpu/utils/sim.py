@@ -167,10 +167,12 @@ class Sim:
             self._schedule(self.now + eff.dt, task)
         elif isinstance(eff, Recv):
             chan = eff.chan
-            if chan._ready and chan._ready[0][0] <= self.now:
+            if chan._ready and chan._ready[0][0] <= self.now and not chan._waiters:
                 _, _, msg = heapq.heappop(chan._ready)
                 self._schedule(self.now, task, msg)
             else:
+                # earlier receivers are queued: join the FIFO behind them
+                # (a due message must not let a latecomer jump the queue)
                 chan._waiters.append(task)
                 if chan._ready:  # in-flight message: wake at its due time
                     self._schedule_delivery(chan._ready[0][0], chan)

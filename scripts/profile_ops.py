@@ -1,0 +1,67 @@
+"""Microbenchmark the crypto kernel building blocks on the real device.
+
+Usage: python scripts/profile_ops.py [batch]
+Prints per-op wall times so optimization targets the real hot spots.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+from jax import numpy as jnp
+
+from ouroboros_consensus_tpu.ops import curve, field as fe, scalar, sha512
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+rng = np.random.default_rng(0)
+
+
+def timeit(name, fn, *args, n=10):
+    fn_j = jax.jit(fn)
+    out = fn_j(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn_j(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:28s} {dt*1e3:9.3f} ms   ({dt*1e9/B:8.1f} ns/lane)")
+    return dt
+
+
+def rand_fe(shape):
+    return jnp.asarray(rng.integers(0, 8192, size=(*shape, fe.NLIMBS), dtype=np.int32))
+
+
+a = rand_fe((B,))
+b = rand_fe((B,))
+pt = curve.Point(a, b, rand_fe((B,)), rand_fe((B,)))
+
+print(f"batch = {B}, device = {jax.devices()[0]}")
+timeit("field.mul", fe.mul, a, b)
+timeit("field.sqr", fe.sqr, a)
+timeit("field.add", fe.add, a, b)
+timeit("field.canonical", fe.canonical, a)
+timeit("curve.add", curve.add, pt, pt)
+timeit("curve.double", curve.double, pt)
+timeit("field.inv", fe.inv, a, n=3)
+timeit("sqrt_ratio", lambda x, y: fe.sqrt_ratio(x, y)[1], a, b, n=3)
+
+bits = jnp.asarray(rng.integers(0, 2, size=(B, 253), dtype=np.int32))
+digits = scalar.windows4_from_bits(
+    jnp.concatenate([bits, jnp.zeros((B, 3), jnp.int32)], axis=-1)
+)
+timeit("scalar_mul_w4 (253b)", curve.scalar_mul_w4, digits, pt, n=3)
+timeit("base_mul", curve.base_mul, digits, n=3)
+
+enc = jnp.asarray(rng.integers(0, 256, size=(B, 32), dtype=np.int32))
+timeit("decompress", lambda e: curve.decompress(e)[1], enc, n=3)
+timeit("compress", curve.compress, pt, n=3)
+
+blocks = jnp.asarray(rng.integers(0, 2**32, size=(B, 4, 16, 2), dtype=np.uint32))
+nb = jnp.full((B,), 4, jnp.int32)
+timeit("sha512 (4 blocks)", sha512.sha512, blocks, nb, n=3)
