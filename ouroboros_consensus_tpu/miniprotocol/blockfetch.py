@@ -23,37 +23,79 @@ from ..block.praos_block import Block
 from ..utils.sim import Recv, Send, Sleep
 
 
+def _in_immutable(chain_db, point: Point) -> bool:
+    imm = getattr(chain_db, "immutable", None)
+    if imm is None or point is None:
+        return False
+    try:
+        imm.get_block_bytes(point)
+        return True
+    except Exception:
+        return False
+
+
+def _range_stream(chain_db, _from: Point | None, to: Point):
+    """Lazy iterator of blocks strictly after `_from` up to+incl `to`,
+    walking the immutable segment first, then the volatile fragment —
+    or None when the range isn't on our chain. A far-behind peer's
+    fetch range mostly lives in the ImmutableDB (the ChainSync server
+    serves headers from there), so bodies must come from there too."""
+    vol = list(chain_db.current_chain)
+    vol_idx = {b.point: i for i, b in enumerate(vol)}
+    # the endpoint must be ours, else the chain switched away
+    if to not in vol_idx and not _in_immutable(chain_db, to):
+        return None
+
+    if _from in vol_idx:
+        start = vol_idx[_from] + 1
+        imm_iter = None
+    elif _from is None or _from == chain_db._anchor_point() or _in_immutable(
+        chain_db, _from
+    ):
+        start = 0
+        imm = getattr(chain_db, "immutable", None)
+        if imm is None or _from == chain_db._anchor_point():
+            imm_iter = None
+        elif _from is None:
+            imm_iter = imm.stream_all()
+        else:
+            imm_iter = imm.stream_from(_from.slot)
+    else:
+        return None
+
+    def gen():
+        if imm_iter is not None:
+            for _e, raw in imm_iter:
+                b = Block.from_bytes(raw)
+                yield b
+                if b.point == to:
+                    return
+        for b in vol[start:]:
+            yield b
+            if b.point == to:
+                return
+
+    return gen()
+
+
 def server(chain_db, rx, tx):
-    """Serve block bodies from the ChainDB (Server.hs)."""
+    """Serve block bodies from the ChainDB (Server.hs) — immutable part
+    included (see _range_stream)."""
     while True:
         msg = yield Recv(rx)
         if msg[0] == "done":
             return
         if msg[0] != "request_range":
             raise RuntimeError(f"blockfetch server: bad message {msg[0]!r}")
-        _from, to = msg[1], msg[2]
-        # collect the requested window from our chain (volatile part —
-        # candidates only ever reference recent blocks)
-        chain = list(chain_db.current_chain)
-        out = []
-        seen_from = _from is None
-        for b in chain:
-            if not seen_from:
-                if b.point == _from:
-                    seen_from = True
-                continue
-            out.append(b)
-            if b.point == to:
-                break
-        else:
-            if out and out[-1].point != to:
-                out = []
-        if not out:
+        stream = _range_stream(chain_db, msg[1], msg[2])
+        first = next(stream, None) if stream is not None else None
+        if first is None:
             # the chain may have switched away from the candidate
             yield Send(tx, ("no_blocks",))
             continue
         yield Send(tx, ("start_batch",))
-        for b in out:
+        yield Send(tx, ("block", first.bytes_))
+        for b in stream:
             yield Send(tx, ("block", b.bytes_))
         yield Send(tx, ("batch_done",))
 

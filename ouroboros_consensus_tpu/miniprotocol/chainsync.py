@@ -89,6 +89,10 @@ def server(chain_db, rx, tx, *, poll_interval: float = 0.05):
     follower = chain_db.new_follower()
     # pending instructions not yet sent (beyond the intersection)
     pending: list = []
+    # lazy stream of the immutable segment between the intersection and
+    # the volatile fragment (never materialized: the immutable part can
+    # be the whole database)
+    imm_stream = None
     intersect_done = False
 
     def tip():
@@ -128,12 +132,11 @@ def server(chain_db, rx, tx, *, poll_interval: float = 0.05):
                 break
             if where is not None:
                 pending.clear()
+                imm_stream = None
                 if where == "genesis":
-                    for _e, raw in chain_db.immutable.stream_all():
-                        pending.append(("addblock", Block.from_bytes(raw)))
+                    imm_stream = chain_db.immutable.stream_all()
                 elif where == "immutable":
-                    for _e, raw in chain_db.immutable.stream_from(found.slot):
-                        pending.append(("addblock", Block.from_bytes(raw)))
+                    imm_stream = chain_db.immutable.stream_from(found.slot)
                 start = ours[found] + 1 if where == "volatile" else 0
                 for b in chain_db.current_chain[start:]:
                     pending.append(("addblock", b))
@@ -144,6 +147,15 @@ def server(chain_db, rx, tx, *, poll_interval: float = 0.05):
         elif kind == "request_next":
             if not intersect_done:
                 raise RuntimeError("request_next before find_intersect")
+            if imm_stream is not None:
+                nxt = next(imm_stream, None)
+                if nxt is None:
+                    imm_stream = None
+                else:
+                    _e, raw = nxt
+                    header = Block.from_bytes(raw).header
+                    yield Send(tx, ("roll_forward", header.bytes_, tip()))
+                    continue
             while True:
                 pending.extend(follower.take_updates())
                 if pending:

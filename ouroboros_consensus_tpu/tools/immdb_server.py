@@ -24,18 +24,22 @@ from ..utils.sim import Recv, Send
 
 class ImmutableChainView:
     """Adapts an ImmutableDB to the slice of the ChainDB surface the
-    chainsync/blockfetch servers read (static chain: no rollbacks)."""
+    chainsync/blockfetch servers read (static chain: no rollbacks).
+
+    The whole chain is presented as the immutable part (empty volatile
+    fragment), so the servers stream straight off disk instead of
+    materializing every block up front."""
 
     def __init__(self, db_path: str):
         self.imm = ImmutableDB(os.path.join(db_path, "immutable"))
-        self.blocks = [Block.from_bytes(raw) for _, raw in self.imm.stream_all()]
-        self.current_chain = self.blocks  # whole chain is "volatile view"
+        self.immutable = self.imm  # chainsync/blockfetch server surface
+        self.current_chain: list = []
 
     def _anchor_point(self) -> Point | None:
-        return None
+        return self.imm.tip_point()
 
     def tip_point(self) -> Point | None:
-        return self.blocks[-1].point if self.blocks else None
+        return self.imm.tip_point()
 
     def new_follower(self):
         class _StaticFollower:
@@ -107,8 +111,17 @@ async def serve_tcp(db_path: str, host: str = "127.0.0.1", port: int = 3001):
                     # None in the offered points = genesis fallback; no
                     # match at all -> intersect_not_found
                     points = msg[1]
-                    ours = {b.point: i for i, b in enumerate(view.blocks)}
-                    found = next((p for p in points if p in ours), None)
+
+                    def _have(p):
+                        try:
+                            view.imm.get_block_bytes(p)
+                            return True
+                        except Exception:
+                            return False
+
+                    found = next(
+                        (p for p in points if p is not None and _have(p)), None
+                    )
                     if found is not None or None in points:
                         writer.write(
                             _frame(("intersect_found", found, view.tip_point()))
@@ -119,37 +132,31 @@ async def serve_tcp(db_path: str, host: str = "127.0.0.1", port: int = 3001):
                     # same contract as miniprotocol/blockfetch.py server:
                     # an unsatisfiable range answers no_blocks, never a
                     # partial/overshooting stream
-                    frm, to = msg[1], msg[2]
-                    out, started = [], frm is None
-                    for b in view.blocks:
-                        if not started:
-                            started = b.point == frm
-                            continue
-                        out.append(b)
-                        if b.point == to:
-                            break
-                    else:
-                        out = []
-                    if out and out[-1].point != to:
-                        out = []
-                    if not out:
+                    from ..miniprotocol.blockfetch import _range_stream
+
+                    stream = _range_stream(view, msg[1], msg[2])
+                    first = next(stream, None) if stream is not None else None
+                    if first is None:
                         writer.write(_frame(("no_blocks",)))
                     else:
                         writer.write(_frame(("start_batch",)))
-                        for b in out:
+                        writer.write(_frame(("block", first.bytes_)))
+                        for b in stream:
                             writer.write(_frame(("block", b.bytes_)))
                         writer.write(_frame(("batch_done",)))
                 elif kind == "headers_from":
                     # bulk header stream after a point (sync accelerator)
                     start = msg[1]
-                    idx = 0
-                    if start is not None:
-                        for i, b in enumerate(view.blocks):
-                            if b.point == start:
-                                idx = i + 1
-                                break
-                    for b in view.blocks[idx : idx + 1000]:
-                        writer.write(_frame(("roll_forward", b.header.bytes_, view.tip_point())))
+                    it = (
+                        view.imm.stream_all()
+                        if start is None
+                        else view.imm.stream_from(start.slot)
+                    )
+                    for _i, (_e, raw) in zip(range(1000), it):
+                        hdr = Block.from_bytes(raw).header
+                        writer.write(
+                            _frame(("roll_forward", hdr.bytes_, view.tip_point()))
+                        )
                     writer.write(_frame(("await_reply",)))
                 elif kind == "done":
                     break

@@ -20,16 +20,19 @@ B = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
 rng = np.random.default_rng(0)
 
 
+def _sync(out):
+    return jax.tree.map(np.asarray, out)
+
+
 def timeit(name, fn, *args, n=10):
     fn_j = jax.jit(fn)
-    out = fn_j(*args)
-    jax.block_until_ready(out)
+    _sync(fn_j(*args))
     t0 = time.perf_counter()
     for _ in range(n):
         out = fn_j(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     dt = (time.perf_counter() - t0) / n
-    print(f"{name:28s} {dt*1e3:9.3f} ms   ({dt*1e9/B:8.1f} ns/lane)")
+    print(f"{name:28s} {dt*1e3:9.3f} ms   ({dt*1e9/B:8.1f} ns/lane)", flush=True)
     return dt
 
 

@@ -27,20 +27,26 @@ def b8(*shape):
     return jnp.asarray(rng.integers(0, 256, size=shape, dtype=np.uint8))
 
 
+def _sync(out):
+    # axon (tunneled TPU) can return before execution completes even
+    # after block_until_ready; a host transfer is the only reliable sync
+    return jax.tree.map(np.asarray, out)
+
+
 def timeit(name, fn, *args, n=5):
     fn_j = jax.jit(fn)
     t0 = time.perf_counter()
-    out = fn_j(*args)
-    jax.block_until_ready(out)
+    _sync(fn_j(*args))
     compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(n):
         out = fn_j(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     dt = (time.perf_counter() - t0) / n
     print(
         f"{name:22s} {dt*1e3:9.2f} ms  ({dt*1e9/B:9.1f} ns/lane)  "
-        f"compile {compile_s:.1f}s"
+        f"compile {compile_s:.1f}s",
+        flush=True,
     )
     return dt
 
@@ -56,7 +62,7 @@ kes_args = (
     jnp.asarray(rng.integers(0, 2**32, size=(B, NB, 16, 2), dtype=np.uint32)),
     jnp.full((B,), NB, jnp.int32),
 )
-vrf_args = (b8(B, 32), b8(B, 32), b8(B, 16), b8(B, 32), b8(B, 64))
+vrf_args = (b8(B, 32), b8(B, 32), b8(B, 16), b8(B, 32), b8(B, 32))
 
 print(f"batch = {B}, device = {jax.devices()[0]}")
 timeit("ed25519.verify", ed25519_batch.verify, *ed_args)
@@ -65,6 +71,6 @@ timeit("ecvrf.verify", ecvrf_batch.verify, *vrf_args)
 
 full_args = (
     *ed_args, *kes_args, *vrf_args,
-    b8(B, 32), b8(B, 32), b8(B, 32),
+    b8(B, 64), b8(B, 32), b8(B, 32),
 )
 timeit("verify_praos (fused)", pbatch.verify_praos, *full_args)
