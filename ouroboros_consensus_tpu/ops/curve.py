@@ -63,6 +63,29 @@ def double(p: Point) -> Point:
     return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
 
 
+def double_partial(x, y, z):
+    """Doubling on projective (X, Y, Z) only — T is not an input of the
+    doubling formulas, so runs of doublings between window adds can skip
+    the T = E*H product (1 of 8 muls) until the last step."""
+    a = fe.sqr(x)
+    b = fe.sqr(y)
+    c = fe.mul_small(fe.sqr(z), 2)
+    h = fe.add(a, b)
+    e = fe.sub(h, fe.sqr(fe.add(x, y)))
+    g = fe.sub(a, b)
+    f = fe.add(c, g)
+    return fe.mul(e, f), fe.mul(g, h), fe.mul(f, g)
+
+
+def doubles(p: Point, k: int) -> Point:
+    """k successive doublings; T is only materialized by the last one
+    (the doubling formulas never read p.t)."""
+    x, y, z = p.x, p.y, p.z
+    for _ in range(k - 1):
+        x, y, z = double_partial(x, y, z)
+    return double(Point(x, y, z, x))  # .t unused by double()
+
+
 def neg(p: Point) -> Point:
     return Point(fe.neg(p.x), p.y, p.z, fe.neg(p.t))
 
@@ -148,8 +171,22 @@ def scalar_mul_w4(digits, p: Point) -> Point:
     # with a fori_loop + indexed store: the Python-unrolled build (14
     # point adds at trace time) multiplied out to ~15k HLO ops per call
     # site and dominated XLA compile time of the fused verifier.
+    tbl = _build_lane_table(p, batch)
+    rev = jnp.flip(digits, axis=-1)  # msb window first
+
+    def body(i, q):
+        q = doubles(q, 4)
+        dw = lax.dynamic_index_in_dim(rev, i, axis=-1, keepdims=False)  # [...]
+        return add(q, _table_lookup(tbl, dw))
+
+    return lax.fori_loop(0, k, body, identity(batch))
+
+
+def _build_lane_table(p: Point, batch):
+    """Per-lane window table [..., 16, 4, NL] with table[d] = d*P."""
+
     def _stack_pt(q: Point):
-        return jnp.stack([q.x, q.y, q.z, q.t], axis=-2)  # [..., 4, NL]
+        return jnp.stack([q.x, q.y, q.z, q.t], axis=-2)
 
     ident = identity(batch)
     tbl0 = jnp.zeros((*batch, 16, 4, ident.x.shape[-1]), ident.x.dtype)
@@ -162,58 +199,103 @@ def scalar_mul_w4(digits, p: Point) -> Point:
         return tbl.at[..., i, :, :].set(_stack_pt(nxt)), nxt
 
     tbl, _ = lax.fori_loop(2, 16, tbuild, (tbl0, p))
-
-    rev = jnp.flip(digits, axis=-1)  # msb window first
-
-    def body(i, q):
-        for _ in range(4):
-            q = double(q)
-        dw = lax.dynamic_index_in_dim(rev, i, axis=-1, keepdims=False)  # [...]
-        e = jnp.take_along_axis(tbl, dw[..., None, None, None], axis=-3)
-        e = e[..., 0, :, :]
-        pt = Point(e[..., 0, :], e[..., 1, :], e[..., 2, :], e[..., 3, :])
-        return add(q, pt)
-
-    return lax.fori_loop(0, k, body, identity(batch))
-
-
-# Fixed-base table for B: 64 windows of 4 bits; TABLE[w][d] = d * 16^w * B.
-def _build_base_table() -> np.ndarray:
-    tbl = np.zeros((64, 16, 4, fe.NLIMBS), dtype=np.int32)
-    wbase = he.B
-    for w in range(64):
-        acc = he.IDENT
-        for d in range(16):
-            x, y, z, t = acc
-            zi = pow(z, fe.P_INT - 2, fe.P_INT)
-            ax, ay = x * zi % fe.P_INT, y * zi % fe.P_INT
-            tbl[w, d, 0] = fe.int_to_limbs_np(ax)
-            tbl[w, d, 1] = fe.int_to_limbs_np(ay)
-            tbl[w, d, 2] = fe.int_to_limbs_np(1)
-            tbl[w, d, 3] = fe.int_to_limbs_np(ax * ay % fe.P_INT)
-            acc = he.point_add(acc, wbase)
-        for _ in range(4):
-            wbase = he.point_double(wbase)
     return tbl
 
 
-_BASE_TABLE = _build_base_table()
+def _table_lookup(tbl, dw) -> Point:
+    e = jnp.take_along_axis(tbl, dw[..., None, None, None], axis=-3)
+    e = e[..., 0, :, :]
+    return Point(e[..., 0, :], e[..., 1, :], e[..., 2, :], e[..., 3, :])
 
 
-def base_mul(digits) -> Point:
-    """s*B from base-16 digits [..., 64] (s < 2^256, canonical digits)."""
-    table = jnp.asarray(_BASE_TABLE)  # [64, 16, 4, 20]
+def double_scalar_mul_w4(digits_a, pa: Point, digits_b, pb: Point) -> Point:
+    """a*PA + b*PB with a SHARED doubling chain (windowed Strauss-Shamir):
+    one run of 4 doublings per window plus two table adds, instead of two
+    independent ladders — saves the second chain's doublings (the Praos
+    ECVRF V = s*H - c*Gamma computation; cf. the batch-verification trick
+    the reference cites at Praos/VRF.hs:13-14, applied per-lane so
+    acceptance stays bit-exact with sequential verification).
+
+    When b has fewer windows than a, the leading (high) windows run a
+    single-stream phase — no identity adds for the missing b digits."""
+    if digits_a.shape[-1] < digits_b.shape[-1]:
+        digits_a, pa, digits_b, pb = digits_b, pb, digits_a, pa
+    ka, kb = digits_a.shape[-1], digits_b.shape[-1]
+    batch = digits_a.shape[:-1]
+
+    ra = jnp.flip(digits_a, axis=-1)  # msb window first
+    rb = jnp.flip(digits_b, axis=-1)
+    ta = _build_lane_table(pa, batch)
+    tb = _build_lane_table(pb, batch)
+
+    def body_a(i, q):
+        q = doubles(q, 4)
+        da = lax.dynamic_index_in_dim(ra, i, axis=-1, keepdims=False)
+        return add(q, _table_lookup(ta, da))
+
+    def body_ab(i, q):
+        da = lax.dynamic_index_in_dim(ra, (ka - kb) + i, axis=-1, keepdims=False)
+        db = lax.dynamic_index_in_dim(rb, i, axis=-1, keepdims=False)
+        q = doubles(q, 4)
+        q = add(q, _table_lookup(ta, da))
+        return add(q, _table_lookup(tb, db))
+
+    q = lax.fori_loop(0, ka - kb, body_a, identity(batch))
+    return lax.fori_loop(0, kb, body_ab, q)
+
+
+# Fixed-base tables for B: `windows` windows of `wbits` bits each,
+# TABLE[w][d] = d * 2^(wbits*w) * B. Built lazily on the host and cached.
+_BASE_TABLES: dict[int, np.ndarray] = {}
+
+
+def _base_table(wbits: int) -> np.ndarray:
+    if wbits not in _BASE_TABLES:
+        windows = 256 // wbits
+        tbl = np.zeros((windows, 1 << wbits, 4, fe.NLIMBS), dtype=np.int32)
+        wbase = he.B
+        for w in range(windows):
+            acc = he.IDENT
+            for d in range(1 << wbits):
+                x, y, z, t = acc
+                zi = pow(z, fe.P_INT - 2, fe.P_INT)
+                ax, ay = x * zi % fe.P_INT, y * zi % fe.P_INT
+                tbl[w, d, 0] = fe.int_to_limbs_np(ax)
+                tbl[w, d, 1] = fe.int_to_limbs_np(ay)
+                tbl[w, d, 2] = fe.int_to_limbs_np(1)
+                tbl[w, d, 3] = fe.int_to_limbs_np(ax * ay % fe.P_INT)
+                acc = he.point_add(acc, wbase)
+            for _ in range(wbits):
+                wbase = he.point_double(wbase)
+        _BASE_TABLES[wbits] = tbl
+    return _BASE_TABLES[wbits]
+
+
+def _base_mul_windows(digits, wbits: int) -> Point:
+    table = jnp.asarray(_base_table(wbits))  # [windows, 2^wbits, 4, 20]
+    windows = table.shape[0]
 
     def body(w, q):
-        tw = lax.dynamic_index_in_dim(table, w, axis=0, keepdims=False)  # [16,4,20]
-        dw = lax.dynamic_index_in_dim(digits, w, axis=-1, keepdims=False)  # [...]
+        tw = lax.dynamic_index_in_dim(table, w, axis=0, keepdims=False)
+        dw = lax.dynamic_index_in_dim(digits, w, axis=-1, keepdims=False)
         entry = jnp.take(tw, dw, axis=0)  # [..., 4, 20]
         pt = Point(
             entry[..., 0, :], entry[..., 1, :], entry[..., 2, :], entry[..., 3, :]
         )
         return add(q, pt)
 
-    return lax.fori_loop(0, 64, body, identity(digits.shape[:-1]))
+    return lax.fori_loop(0, windows, body, identity(digits.shape[:-1]))
+
+
+def base_mul(digits) -> Point:
+    """s*B from base-16 digits [..., 64] (s < 2^256, canonical digits)."""
+    return _base_mul_windows(digits, 4)
+
+
+def base_mul_w8(digits) -> Point:
+    """s*B from base-256 digits [..., 32]: half the adds of base_mul in
+    exchange for a 256-entry-per-window table (~2.6 MB device constant)."""
+    return _base_mul_windows(digits, 8)
 
 
 # ---------------------------------------------------------------------------

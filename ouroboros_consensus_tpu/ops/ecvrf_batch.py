@@ -89,8 +89,14 @@ def hash_to_curve(pk_bytes, alpha_bytes):
     return curve.mul_cofactor(elligator2(r))
 
 
-def verify(pk, gamma, c, s, alpha):
-    """Device kernel -> (ok bool[B], beta [B, 64] int32 bytes)."""
+def verify_points(pk, gamma, c, s, alpha):
+    """(ok_pre bool[B], points) with points = (H, Γ, U, V, 8Γ) left
+    uncompressed: U = s·B − c·Y (wide fixed-base table + 128-bit c
+    ladder), V = s·H − c·Γ via ONE shared-doubling Strauss ladder
+    (curve.double_scalar_mul_w4). The challenge/beta hashes over the
+    compressed encodings are completed by `finish`, so the fused Praos
+    kernel can share a single Montgomery inversion chain across every
+    point it compresses per lane."""
     pk = jnp.asarray(pk).astype(jnp.int32)
     gamma = jnp.asarray(gamma).astype(jnp.int32)
     c = jnp.asarray(c).astype(jnp.int32)
@@ -106,17 +112,23 @@ def verify(pk, gamma, c, s, alpha):
     s_digits = scalar.windows4_from_bits(scalar.bits_from_bytes(s, 256))
     c_digits = scalar.windows4_from_bits(scalar.bits_from_bytes(c, 128))
 
-    sb = curve.base_mul(s_digits)
-    u_pt = curve.add(sb, curve.scalar_mul_w4(c_digits, curve.neg(y_pt)))
-    sh = curve.scalar_mul_w4(s_digits, h_pt)
-    v_pt = curve.add(sh, curve.scalar_mul_w4(c_digits, curve.neg(g_pt)))
-
-    g8 = curve.mul_cofactor(g_pt)
-    h_enc, gamma_enc, u_enc, v_enc, g8_enc = curve.compress_many(
-        [h_pt, g_pt, u_pt, v_pt, g8]
+    sb = curve.base_mul_w8(
+        scalar.windows8_from_bits(scalar.bits_from_bytes(s, 256))
     )
+    u_pt = curve.add(sb, curve.scalar_mul_w4(c_digits, curve.neg(y_pt)))
+    v_pt = curve.double_scalar_mul_w4(
+        s_digits, h_pt, c_digits, curve.neg(g_pt)
+    )
+    g8 = curve.mul_cofactor(g_pt)
+    return ok_y & ok_g & s_ok, (h_pt, g_pt, u_pt, v_pt, g8)
 
-    batch = pk.shape[:-1]
+
+def finish(ok_pre, c, encs):
+    """Complete verification from the 5 compressed encodings (H, Γ, U,
+    V, 8Γ) -> (ok, beta)."""
+    c = jnp.asarray(c).astype(jnp.int32)
+    h_enc, gamma_enc, u_enc, v_enc, g8_enc = encs
+    batch = c.shape[:-1]
     p2 = jnp.broadcast_to(jnp.asarray([SUITE, 0x02], jnp.int32), (*batch, 2))
     cdata = jnp.concatenate([p2, h_enc, gamma_enc, u_enc, v_enc], axis=-1)  # 130 B
     c_prime = sha512.sha512_fixed(cdata)[..., :16]
@@ -124,8 +136,15 @@ def verify(pk, gamma, c, s, alpha):
     p3 = jnp.broadcast_to(jnp.asarray([SUITE, 0x03], jnp.int32), (*batch, 2))
     beta = sha512.sha512_fixed(jnp.concatenate([p3, g8_enc], axis=-1))
 
-    ok = ok_y & ok_g & s_ok & jnp.all(c_prime == c, axis=-1)
+    ok = ok_pre & jnp.all(c_prime == c, axis=-1)
     return ok, beta
+
+
+def verify(pk, gamma, c, s, alpha):
+    """Device kernel -> (ok bool[B], beta [B, 64] int32 bytes)."""
+    ok_pre, points = verify_points(pk, gamma, c, s, alpha)
+    encs = curve.compress_many(list(points))
+    return finish(ok_pre, c, encs)
 
 
 _JIT = None

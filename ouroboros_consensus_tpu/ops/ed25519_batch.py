@@ -55,25 +55,37 @@ def stage_np(
     return Ed25519Batch(pk, r, s, hblocks, hnblocks)
 
 
-def verify(pk, r, s, hblocks, hnblocks):
-    """Device kernel: -> ok bool[B]. Arguments as in Ed25519Batch."""
+def verify_point(pk, s, hblocks, hnblocks):
+    """(ok_pre bool[B], P Point) with P = s·B − h·A.
+
+    The RFC 8032 cofactorless equation s·B == R + h·A holds iff the
+    canonical compression of P equals the signature's 32 R bytes: a
+    valid R encoding decompresses to exactly one point whose canonical
+    re-compression is itself, and every invalid-or-non-canonical R
+    (y ≥ p, off-curve, x=0 with sign bit) can never equal a canonical
+    compression — so compare-on-bytes is bit-exact with the reference's
+    decompress-then-compare while skipping R's square-root chain."""
     ok_a, a_pt = curve.decompress(jnp.asarray(pk).astype(jnp.int32))
-    ok_r, r_pt = curve.decompress(jnp.asarray(r).astype(jnp.int32))
     s = jnp.asarray(s).astype(jnp.int32)
     s_ok = scalar.is_canonical32(s)
 
     digest = sha512.sha512(jnp.asarray(hblocks), jnp.asarray(hnblocks))
     h = scalar.reduce512(digest)  # [B, 20] limbs < L
 
-    s_digits = scalar.windows4_from_bits(scalar.bits_from_bytes(s, 256))
-    sb = curve.base_mul(s_digits)
-
+    sb = curve.base_mul_w8(
+        scalar.windows8_from_bits(scalar.bits_from_bytes(s, 256))
+    )
     h_digits = scalar.windows4_from_bits(scalar.bits_from_limbs(h, 256))
-    ha = curve.scalar_mul_w4(h_digits, a_pt)
+    nha = curve.scalar_mul_w4(h_digits, curve.neg(a_pt))
+    return ok_a & s_ok, curve.add(sb, nha)
 
-    lhs = sb
-    rhs = curve.add(r_pt, ha)
-    return ok_a & ok_r & s_ok & curve.eq(lhs, rhs)
+
+def verify(pk, r, s, hblocks, hnblocks):
+    """Device kernel: -> ok bool[B]. Arguments as in Ed25519Batch."""
+    ok_pre, p = verify_point(pk, s, hblocks, hnblocks)
+    enc = curve.compress(p)
+    r_bytes = jnp.asarray(r).astype(jnp.int32)
+    return ok_pre & jnp.all(enc == r_bytes, axis=-1)
 
 
 def verify_batch(pks, sigs, msgs) -> np.ndarray:

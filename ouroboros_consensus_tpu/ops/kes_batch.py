@@ -69,6 +69,17 @@ def stage_np(
 
 def verify(vk, period, r, s, vk_leaf, siblings, hblocks, hnblocks, *, depth: int | None = None):
     """Device kernel -> ok bool[B]. depth defaults to siblings.shape[-2]."""
+    ok_pre, p = verify_point(vk, period, s, vk_leaf, siblings, hblocks, hnblocks, depth=depth)
+    enc = curve.compress(p)
+    return ok_pre & jnp.all(enc == jnp.asarray(r).astype(jnp.int32), axis=-1)
+
+
+def verify_point(vk, period, s, vk_leaf, siblings, hblocks, hnblocks, *, depth: int | None = None):
+    """(ok_pre bool[B], P Point): Merkle-root + period checks folded into
+    ok_pre; P = s·B − h·A of the leaf signature must equal the R bytes
+    (compression deferred so the fused kernel shares one inversion)."""
+    from . import ed25519_batch
+
     vk = jnp.asarray(vk).astype(jnp.int32)
     period = jnp.asarray(period)
     vk_leaf = jnp.asarray(vk_leaf).astype(jnp.int32)
@@ -76,19 +87,15 @@ def verify(vk, period, r, s, vk_leaf, siblings, hblocks, hnblocks, *, depth: int
     if depth is None:
         depth = siblings.shape[-2]
 
-    # leaf Ed25519: pk = vk_leaf, challenge hash pre-staged in hblocks
-    ok_a, a_pt = curve.decompress(vk_leaf)
-    ok_r, r_pt = curve.decompress(jnp.asarray(r).astype(jnp.int32))
-    s_arr = jnp.asarray(s).astype(jnp.int32)
-    s_ok = scalar.is_canonical32(s_arr)
-    h = scalar.reduce512(sha512.sha512(jnp.asarray(hblocks), jnp.asarray(hnblocks)))
-    sb = curve.base_mul(scalar.windows4_from_bits(scalar.bits_from_bytes(s_arr, 256)))
-    ha = curve.scalar_mul_w4(
-        scalar.windows4_from_bits(scalar.bits_from_limbs(h, 256)), a_pt
-    )
-    ed_ok = ok_a & ok_r & s_ok & curve.eq(sb, curve.add(r_pt, ha))
+    ok_ed, p = ed25519_batch.verify_point(vk_leaf, s, hblocks, hnblocks)
+    root_ok = merkle_root_ok(vk, period, vk_leaf, siblings, depth)
+    period_ok = (period >= 0) & (period < (1 << depth))
+    return ok_ed & root_ok & period_ok, p
 
-    # Merkle root reconstruction, bottom-up; bit i of period selects side
+
+def merkle_root_ok(vk, period, vk_leaf, siblings, depth: int):
+    """Reconstruct the CompactSum root bottom-up; bit i of the period
+    selects H(vk ‖ sib) vs H(sib ‖ vk) — masked select, batch-uniform."""
     cur = vk_leaf
     for i in range(depth):
         sib = siblings[..., i, :]
@@ -97,10 +104,7 @@ def verify(vk, period, r, s, vk_leaf, siblings, hblocks, hnblocks, *, depth: int
         right = jnp.concatenate([sib, cur], axis=-1)
         data = jnp.where((bit == 1)[..., None], right, left)
         cur = blake2b.blake2b_fixed(data, 64, 32)
-
-    root_ok = jnp.all(cur == vk, axis=-1)
-    period_ok = (period >= 0) & (period < (1 << depth))
-    return ed_ok & root_ok & period_ok
+    return jnp.all(cur == vk, axis=-1)
 
 
 _JIT: dict = {}

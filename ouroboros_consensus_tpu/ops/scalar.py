@@ -79,3 +79,12 @@ def windows4_from_bits(bits):
     assert nb % 4 == 0
     g = bits.reshape(*bits.shape[:-1], nb // 4, 4)
     return jnp.sum(g * jnp.asarray([1, 2, 4, 8], jnp.int32), axis=-1)
+
+
+def windows8_from_bits(bits):
+    """[..., 8k] bits -> [..., k] base-256 digits (wide fixed-base windows:
+    half the adds of base-16 in exchange for a 256-entry shared table)."""
+    nb = bits.shape[-1]
+    assert nb % 8 == 0
+    g = bits.reshape(*bits.shape[:-1], nb // 8, 8)
+    return jnp.sum(g * jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.int32), axis=-1)

@@ -232,13 +232,29 @@ def verify_praos(
 
     XLA fuses the three verifier subgraphs and the Blake2b range
     extensions; everything is batch-uniform control flow (mask lanes).
+    The seven per-lane point compressions (Ed25519 R-check, KES leaf
+    R-check, ECVRF H/Γ/U/V/8Γ) share ONE Montgomery inversion chain.
     """
-    ok_ed = ed25519_batch.verify(ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks)
-    ok_kes = kes_batch.verify(
-        kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+    from ..ops import curve
+
+    ok_ed_pre, ed_point = ed25519_batch.verify_point(
+        ed_pk, ed_s, ed_hblocks, ed_hnblocks
+    )
+    ok_kes_pre, kes_point = kes_batch.verify_point(
+        kes_vk, kes_period, kes_s, kes_vk_leaf, kes_siblings,
         kes_hblocks, kes_hnblocks,
     )
-    ok_proof, beta = ecvrf_batch.verify(vrf_pk, vrf_gamma, vrf_c, vrf_s, vrf_alpha)
+    ok_vrf_pre, vrf_points = ecvrf_batch.verify_points(
+        vrf_pk, vrf_gamma, vrf_c, vrf_s, vrf_alpha
+    )
+    encs = curve.compress_many([ed_point, kes_point, *vrf_points])
+    ok_ed = ok_ed_pre & jnp.all(
+        encs[0] == jnp.asarray(ed_r).astype(jnp.int32), axis=-1
+    )
+    ok_kes = ok_kes_pre & jnp.all(
+        encs[1] == jnp.asarray(kes_r).astype(jnp.int32), axis=-1
+    )
+    ok_proof, beta = ecvrf_batch.finish(ok_vrf_pre, vrf_c, encs[2:])
     beta_decl = jnp.asarray(beta_decl).astype(jnp.int32)
     ok_vrf = ok_proof & jnp.all(beta == beta_decl, axis=-1)
 
