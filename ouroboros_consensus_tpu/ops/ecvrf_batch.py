@@ -147,6 +147,86 @@ def verify(pk, gamma, c, s, alpha):
     return finish(ok_pre, c, encs)
 
 
+# ---------------------------------------------------------------------------
+# Prove side (forging: checkIsLeader VRF evaluation, Praos.hs:375-397)
+# ---------------------------------------------------------------------------
+
+
+def prove(x, prefix, pk, alpha):
+    """Device kernel -> (gamma_enc, c16, s32, beta) int32 byte arrays.
+
+    draft-03 prove with batched curve work: H = h2c(pk, alpha),
+    Γ = x·H, k = SHA512(prefix ‖ H) mod L, c = hash_points(H, Γ, k·B,
+    k·H), s = k + c·x mod L; beta = SHA512(suite ‖ 3 ‖ 8Γ) emitted for
+    the leader check. Mirrors ops/host/ecvrf.prove."""
+    from . import bigint as bi
+
+    x = jnp.asarray(x).astype(jnp.int32)
+    prefix = jnp.asarray(prefix).astype(jnp.int32)
+    pk = jnp.asarray(pk).astype(jnp.int32)
+    alpha = jnp.asarray(alpha).astype(jnp.int32)
+
+    h_pt = hash_to_curve(pk, alpha)
+    h_enc = curve.compress(h_pt)
+
+    x_limbs = bi.bytes_to_limbs(x, 20)
+    x_digits = scalar.windows4_from_bits(scalar.bits_from_bytes(x, 256))
+    gamma = curve.scalar_mul_w4(x_digits, h_pt)
+
+    k = scalar.reduce512(
+        sha512.sha512_fixed(jnp.concatenate([prefix, h_enc], axis=-1))
+    )
+    kb = curve.base_mul_w8(
+        scalar.windows8_from_bits(scalar.bits_from_limbs(k, 256))
+    )
+    k_digits = scalar.windows4_from_bits(scalar.bits_from_limbs(k, 256))
+    kh = curve.scalar_mul_w4(k_digits, h_pt)
+
+    g8 = curve.mul_cofactor(gamma)
+    gamma_enc, u_enc, v_enc, g8_enc = curve.compress_many([gamma, kb, kh, g8])
+
+    batch = pk.shape[:-1]
+    p2 = jnp.broadcast_to(jnp.asarray([SUITE, 0x02], jnp.int32), (*batch, 2))
+    cdata = jnp.concatenate([p2, h_enc, gamma_enc, u_enc, v_enc], axis=-1)
+    c16 = sha512.sha512_fixed(cdata)[..., :16]
+
+    c_limbs = bi.bytes_to_limbs(c16, 20)
+    s = scalar.add_mod_l(k, scalar.mul_mod_l(c_limbs, x_limbs))
+
+    p3 = jnp.broadcast_to(jnp.asarray([SUITE, 0x03], jnp.int32), (*batch, 2))
+    beta = sha512.sha512_fixed(jnp.concatenate([p3, g8_enc], axis=-1))
+    return gamma_enc, c16, scalar.to_bytes32(s), beta
+
+
+_PROVE_JIT = None
+
+
+def prove_batch(seeds, alphas):
+    """Host convenience: -> ([B, 80] uint8 proofs, [B, 64] uint8 betas)."""
+    import jax
+
+    from .host import ed25519 as he
+
+    global _PROVE_JIT
+    if _PROVE_JIT is None:
+        _PROVE_JIT = jax.jit(prove)
+    b = len(seeds)
+    x = np.zeros((b, 32), np.uint8)
+    prefix = np.zeros((b, 32), np.uint8)
+    pk = np.zeros((b, 32), np.uint8)
+    for i, seed in enumerate(seeds):
+        x_bytes, pref, pk_bytes = he.expand_for_staging(seed)
+        x[i] = np.frombuffer(x_bytes, np.uint8)
+        prefix[i] = np.frombuffer(pref, np.uint8)
+        pk[i] = np.frombuffer(pk_bytes, np.uint8)
+    alpha = np.stack([np.frombuffer(a, np.uint8) for a in alphas])
+    g_enc, c16, s32, beta = _PROVE_JIT(x, prefix, pk, alpha)
+    proofs = np.concatenate(
+        [np.asarray(g_enc), np.asarray(c16), np.asarray(s32)], axis=-1
+    ).astype(np.uint8)
+    return proofs, np.asarray(beta).astype(np.uint8)
+
+
 _JIT = None
 
 

@@ -88,6 +88,86 @@ def verify(pk, r, s, hblocks, hnblocks):
     return ok_pre & jnp.all(enc == r_bytes, axis=-1)
 
 
+# ---------------------------------------------------------------------------
+# Sign side (db-synthesizer / forging loop: HotKey.sign + OCert issuance)
+# ---------------------------------------------------------------------------
+
+
+class Ed25519SignBatch(NamedTuple):
+    """SoA staging of a signing batch (host numpy arrays)."""
+
+    a: np.ndarray  # [B, 32] uint8 — clamped secret scalar (LE)
+    a_enc: np.ndarray  # [B, 32] uint8 — public key bytes
+    rblocks: np.ndarray  # SHA-512(prefix ‖ msg) padded blocks
+    rnblocks: np.ndarray
+    hblocks: np.ndarray  # SHA-512(<64-byte hole> ‖ msg) padded blocks
+    hnblocks: np.ndarray
+
+
+def stage_sign_np(seeds: Sequence[bytes], msgs: Sequence[bytes], nb: int | None = None) -> Ed25519SignBatch:
+    """Expand seeds host-side (one SHA-512 each) and stage both hash
+    inputs; the challenge-hash hole is spliced with R ‖ A on device."""
+    from .host import ed25519 as he
+
+    b = len(seeds)
+    a = np.zeros((b, 32), np.uint8)
+    a_enc = np.zeros((b, 32), np.uint8)
+    rmsgs, hmsgs = [], []
+    for i, (seed, m) in enumerate(zip(seeds, msgs)):
+        x_bytes, prefix, pk = he.expand_for_staging(seed)
+        a[i] = np.frombuffer(x_bytes, np.uint8)
+        a_enc[i] = np.frombuffer(pk, np.uint8)
+        rmsgs.append(prefix + m)
+        hmsgs.append(b"\x00" * 64 + m)
+    rblocks, rnblocks = sha512.pad_messages_np(rmsgs, nb)
+    hblocks, hnblocks = sha512.pad_messages_np(hmsgs, nb)
+    return Ed25519SignBatch(a, a_enc, rblocks, rnblocks, hblocks, hnblocks)
+
+
+def sign(a, a_enc, rblocks, rnblocks, hblocks, hnblocks):
+    """Device kernel -> (r_enc [B,32], s [B,32]) int32 byte arrays.
+
+    RFC 8032 sign with the expensive parts batched: r = H(prefix‖M) mod
+    L, R = r·B (wide fixed-base table), h = H(R‖A‖M) mod L (the R‖A hole
+    spliced on device), s = r + h·a mod L. Mirrors ops/host/ed25519.sign;
+    the reference reaches this via HotKey.sign / forgeBlock
+    (ouroboros-consensus-protocol/.../Protocol/Ledger/HotKey.hs:124,
+    shelley Protocol/Praos.hs:102)."""
+    from . import bigint as bi
+
+    r = scalar.reduce512(sha512.sha512(jnp.asarray(rblocks), jnp.asarray(rnblocks)))
+    big_r = curve.base_mul_w8(
+        scalar.windows8_from_bits(scalar.bits_from_limbs(r, 256))
+    )
+    r_enc = curve.compress(big_r)  # [B, 32] int32
+    a_enc = jnp.asarray(a_enc).astype(jnp.int32)
+    spliced = sha512.splice_prefix64(
+        jnp.asarray(hblocks), jnp.concatenate([r_enc, a_enc], axis=-1)
+    )
+    h = scalar.reduce512(sha512.sha512(spliced, jnp.asarray(hnblocks)))
+    a_limbs = bi.bytes_to_limbs(jnp.asarray(a).astype(jnp.int32), 20)
+    s = scalar.add_mod_l(r, scalar.mul_mod_l(h, a_limbs))
+    return r_enc, scalar.to_bytes32(s)
+
+
+_SIGN_JIT = None
+
+
+def sign_batch(seeds, msgs):
+    """Host convenience: -> [B, 64] uint8 signatures (R ‖ s)."""
+    import jax
+
+    global _SIGN_JIT
+    if _SIGN_JIT is None:
+        _SIGN_JIT = jax.jit(sign)
+    batch = stage_sign_np(seeds, msgs)
+    r_enc, s = _SIGN_JIT(*(jnp.asarray(x) for x in batch))
+    out = np.concatenate(
+        [np.asarray(r_enc), np.asarray(s)], axis=-1
+    ).astype(np.uint8)
+    return out
+
+
 def verify_batch(pks, sigs, msgs) -> np.ndarray:
     """Host convenience: stage + run (jit cached by (B, NB) shape)."""
     import jax

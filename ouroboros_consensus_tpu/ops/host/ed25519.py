@@ -105,6 +105,41 @@ def point_neg(p):
     return (P - X if X else 0, Y, Z, P - T if T else 0)
 
 
+_BASE_COMB: list | None = None
+
+
+def _base_comb():
+    """Lazy fixed-base table: COMB[w][d] = d * 256^w * B as extended
+    coords — turns every s*B into 32 point adds (the host synthesizer's
+    per-block Ed25519/KES signing cost would otherwise be a full ladder)."""
+    global _BASE_COMB
+    if _BASE_COMB is None:
+        tbl = []
+        wbase = B
+        for _w in range(32):
+            row = [IDENT]
+            acc = wbase
+            for _d in range(1, 256):
+                row.append(acc)
+                acc = point_add(acc, wbase)
+            tbl.append(row)
+            for _ in range(8):
+                wbase = point_double(wbase)
+        _BASE_COMB = tbl
+    return _BASE_COMB
+
+
+def base_point_mul(s: int):
+    """s*B via the fixed-base comb (s < 2^256)."""
+    tbl = _base_comb()
+    q = IDENT
+    for w in range(32):
+        d = (s >> (8 * w)) & 0xFF
+        if d:
+            q = point_add(q, tbl[w][d])
+    return q
+
+
 def point_mul(s: int, p):
     q = IDENT
     while s > 0:
@@ -179,16 +214,27 @@ def secret_expand(seed: bytes):
     return _clamp(h[:32]), h[32:]
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=4096)
+def expand_for_staging(seed: bytes):
+    """(clamped scalar LE bytes, prefix, pk bytes) — cached: batched
+    forging repeats the same few pool seeds across thousands of lanes."""
+    a, prefix = secret_expand(seed)
+    return int.to_bytes(a, 32, "little"), prefix, secret_to_public(seed)
+
+
 def secret_to_public(seed: bytes) -> bytes:
     a, _ = secret_expand(seed)
-    return point_compress(point_mul(a, B))
+    return point_compress(base_point_mul(a))
 
 
 def sign(seed: bytes, msg: bytes) -> bytes:
     a, prefix = secret_expand(seed)
-    A_enc = point_compress(point_mul(a, B))
+    A_enc = point_compress(base_point_mul(a))
     r = int.from_bytes(_sha512(prefix + msg), "little") % L
-    R_enc = point_compress(point_mul(r, B))
+    R_enc = point_compress(base_point_mul(r))
     h = int.from_bytes(_sha512(R_enc + A_enc + msg), "little") % L
     s = (r + h * a) % L
     return R_enc + int.to_bytes(s, 32, "little")

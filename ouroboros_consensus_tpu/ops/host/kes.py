@@ -105,6 +105,32 @@ def verify(vk: bytes, depth: int, period: int, msg: bytes, sig: bytes) -> bool:
     return _reconstruct_vk(sig, depth, period, msg) == vk
 
 
+def leaf_path(seed: bytes, depth: int, period: int):
+    """(leaf_seed, siblings bottom-up) for `period` — the static part of
+    a CompactSum signature: sign the leaf seed over the message (host or
+    ops/ed25519_batch.sign) and append vk_leaf + this sibling path to
+    assemble the full signature."""
+    if not 0 <= period < (1 << depth):
+        raise ValueError(f"period {period} out of range for depth {depth}")
+    sibs: list[bytes] = []
+
+    def walk(sd: bytes, d: int, per: int) -> bytes:
+        if d == 0:
+            return sd
+        half = 1 << (d - 1)
+        s0, s1 = _seed_left(sd), _seed_right(sd)
+        if per < half:
+            leaf = walk(s0, d - 1, per)
+            sibs.append(derive_vk(s1, d - 1))
+        else:
+            leaf = walk(s1, d - 1, per - half)
+            sibs.append(derive_vk(s0, d - 1))
+        return leaf
+
+    leaf = walk(seed, depth, period)
+    return leaf, sibs
+
+
 def decompose_sig(sig: bytes, depth: int):
     """Split a CompactSum signature into (ed_sig 64, vk_leaf 32, [sibling vks
     bottom-up: level 1 .. depth]). Used by SoA staging for the batch kernel."""

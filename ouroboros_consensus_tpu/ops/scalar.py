@@ -33,13 +33,12 @@ _B_LIMBS = 21
 MU = bi.int_to_limbs_np((1 << (BITS * (_A_LIMBS + _B_LIMBS))) // L_INT, 21)
 
 
-def reduce512(digest_bytes):
-    """[..., 64] little-endian bytes (SHA-512 output) -> [..., 20] limbs < L.
+def _barrett_reduce40(v):
+    """[..., 40] NORMALIZED limbs (value < 2^512) -> [..., 20] limbs < L.
 
     Barrett: q = ((V >> 247) * mu) >> 273, r = V - q*L, then up to three
     conditional subtractions (error bound q - q_hat <= 2).
     """
-    v = bi.bytes_to_limbs(digest_bytes, 40)
     v1 = bi.shift_right_limbs(v, _A_LIMBS)  # 21 limbs
     t = bi.mul(v1, jnp.broadcast_to(jnp.asarray(MU), (*v1.shape[:-1], 21)))
     q = bi.shift_right_limbs(t, _B_LIMBS)[..., :21]  # <= 2^260: 21 limbs
@@ -54,6 +53,35 @@ def reduce512(digest_bytes):
     for _ in range(3):
         r = bi.cond_sub(r, lc)
     return r[..., :NL]
+
+
+def reduce512(digest_bytes):
+    """[..., 64] little-endian bytes (SHA-512 output) -> [..., 20] limbs < L."""
+    return _barrett_reduce40(bi.bytes_to_limbs(digest_bytes, 40))
+
+
+def mul_mod_l(a, b):
+    """a*b mod L for [..., 20]-limb operands with a*b < 2^512 (sign-side
+    h·a and c·x: clamped secret scalars are < 2^255, NOT < L — the only
+    true requirement is the Barrett input bound)."""
+    p = bi.mul(a, b)  # [..., 40], nearly normalized
+    p, _ = bi.seq_carry(p)
+    return _barrett_reduce40(p)
+
+
+def add_mod_l(a, b):
+    """(a + b) mod L for [..., 20]-limb scalars < L."""
+    s, carry_out = bi.seq_carry(a + b)  # sum < 2L < 2^254: no carry-out
+    s = jnp.concatenate([s, carry_out[..., None]], axis=-1)  # 21 limbs
+    lc = jnp.broadcast_to(jnp.asarray(L21), s.shape)
+    return bi.cond_sub(s, lc)[..., :NL]
+
+
+def to_bytes32(x):
+    """[..., 20] normalized limbs (< 2^256) -> [..., 32] int32 LE bytes."""
+    bits = bi.limbs_to_bits(x, 256)
+    groups = bits.reshape(*x.shape[:-1], 32, 8)
+    return jnp.sum(groups * (1 << jnp.arange(8, dtype=jnp.int32)), axis=-1)
 
 
 def is_canonical32(s_bytes):

@@ -103,6 +103,21 @@ def bytes_to_blocks(b):
     return jnp.stack([hi, lo], axis=-1)
 
 
+def splice_prefix64(blocks, prefix_bytes):
+    """Overwrite the first 64 bytes of block 0 with device-computed data.
+
+    blocks: [..., NB, 16, 2] uint32 staged with a 64-byte hole at the
+    front; prefix_bytes: [..., 64] int32. Used by the Ed25519 sign
+    kernel, whose challenge hash input starts with R ‖ A where R is only
+    known on device (R = r·B)."""
+    w = prefix_bytes.astype(jnp.uint32).reshape(*prefix_bytes.shape[:-1], 8, 8)
+    shifts = jnp.asarray([24, 16, 8, 0], jnp.uint32)
+    hi = (w[..., :4] << shifts).sum(axis=-1).astype(jnp.uint32)
+    lo = (w[..., 4:] << shifts).sum(axis=-1).astype(jnp.uint32)
+    words = jnp.stack([hi, lo], axis=-1)  # [..., 8, 2]
+    return blocks.at[..., 0, :8, :].set(words)
+
+
 def _bsig0(x):
     return u64.xor(u64.xor(u64.rotr(x, 28), u64.rotr(x, 34)), u64.rotr(x, 39))
 

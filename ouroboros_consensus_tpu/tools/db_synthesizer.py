@@ -64,6 +64,39 @@ def make_credentials(n_pools: int, kes_depth: int = 7):
     return pools, fixtures.make_ledger_view(pools)
 
 
+_VRF_BUCKET = 4096
+
+
+def _prove_span(pools, slots, eta0):
+    """Batched device VRF evaluation for every (slot, pool) pair of a
+    span. Returns {(slot, pool_index): PraosIsLeader}. The VRF is the
+    only per-header forging cost with no chain dependency (alpha =
+    InputVRF(slot, eta0), Praos/VRF.hs:47), so it batches across the
+    whole span on device; header assembly + KES signing stay sequential
+    because each body embeds the previous header's hash (signature
+    included).
+    """
+    from ..protocol.praos import PraosIsLeader
+
+    from ..ops import ecvrf_batch
+
+    pairs = [(s, i) for s in slots for i in range(len(pools))]
+    out = {}
+    for lo in range(0, len(pairs), _VRF_BUCKET):
+        part = pairs[lo : lo + _VRF_BUCKET]
+        seeds = [pools[i].vrf_seed for _s, i in part]
+        alphas = [nonces.mk_input_vrf(s, eta0) for s, _i in part]
+        # pad to the bucket so the jit caches exactly one shape
+        pad = _VRF_BUCKET - len(part)
+        if pad:
+            seeds.extend([seeds[0]] * pad)
+            alphas.extend([alphas[0]] * pad)
+        proofs, betas = ecvrf_batch.prove_batch(seeds, alphas)
+        for (s, i), proof, beta in zip(part, proofs, betas):
+            out[(s, i)] = PraosIsLeader(beta.tobytes(), proof.tobytes())
+    return out
+
+
 def synthesize(
     db_path: str,
     params: PraosParams,
@@ -72,14 +105,25 @@ def synthesize(
     limit: ForgeLimit,
     txs_per_block: int = 0,
     chunk_size: int = 21600,
+    vrf_backend: str = "auto",
     trace=lambda s: None,
 ) -> ForgeResult:
     """The forging loop (Forging.hs:57): tick → leader check per
-    credential → forge → append, until the limit trips."""
+    credential → forge → append, until the limit trips.
+
+    vrf_backend: "device" evaluates VRFs in epoch-span batches on the
+    accelerator; "host" per-slot on the CPU; "auto" picks device when
+    the run is big enough to amortize the kernel compile."""
     os.makedirs(db_path, exist_ok=True)
     imm = ImmutableDB(os.path.join(db_path, "immutable"), chunk_size=chunk_size)
     if not imm.is_empty:
         raise RuntimeError(f"refusing to forge into non-empty DB at {db_path}")
+
+    n_target = limit.slots or limit.blocks or (
+        (limit.epochs or 0) * params.epoch_length
+    )
+    if vrf_backend == "auto":
+        vrf_backend = "device" if n_target * len(pools) >= 2048 else "host"
 
     res = ForgeResult()
     t0 = time.monotonic()
@@ -98,11 +142,30 @@ def synthesize(
             return True
         return False
 
+    span_proofs: dict = {}
+    span_end = 0
+
     while not done():
         ticked = praos.tick(params, lview, slot, st)
         eta0 = ticked.state.epoch_nonce
-        for pool in pools:
-            is_leader = evaluate_vrf(pool, slot, eta0)
+        if vrf_backend == "device" and slot >= span_end:
+            # next span: up to the epoch boundary (eta0 is epoch-constant)
+            epoch_end = (params.epoch_of(slot) + 1) * params.epoch_length
+            span_end = min(epoch_end, slot + 16 * _VRF_BUCKET)
+            if limit.slots is not None:
+                span_end = min(span_end, limit.slots)
+            if limit.blocks is not None:
+                # don't prove far past where the block limit will trip:
+                # ~1/f slots per block, padded 2x + a margin
+                need = limit.blocks - block_no
+                est = int(2 * need / float(params.active_slot_coeff)) + 64
+                span_end = min(span_end, slot + est)
+            span_proofs = _prove_span(pools, range(slot, span_end), eta0)
+        for pi, pool in enumerate(pools):
+            if vrf_backend == "device":
+                is_leader = span_proofs[(slot, pi)]
+            else:  # host: lazy per-slot evaluation (small runs)
+                is_leader = evaluate_vrf(pool, slot, eta0)
             lv_val = nonces.vrf_leader_value(is_leader.vrf_output)
             entry = lview.pool_distr[pool.pool_id]
             if not check_leader_value(lv_val, entry.stake, params.active_slot_coeff):
