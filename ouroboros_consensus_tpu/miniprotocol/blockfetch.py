@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from ..block.abstract import Point
 from ..block.praos_block import Block
-from ..utils.sim import Recv, Send, Sleep
+from ..utils.sim import Recv, Send, Sleep, Wait
 
 
 def _in_immutable(chain_db, point: Point) -> bool:
@@ -152,7 +152,12 @@ def client(node, peer_name: str, rx, tx, candidate, *, poll_interval: float = 0.
                 break
             assert msg[0] == "block", msg
             block = Block.from_bytes(msg[1])
-            res = node.chain_db.add_block(block)
-            if res.selected:
+            # enqueue to the add-block runner (decoupled mode: peer
+            # tasks never run chain selection themselves) and wait for
+            # the verdict; synchronous mode completes inline
+            p = node.chain_db.add_block_async(block)
+            if p.result is None:
+                yield Wait(p.processed)
+            if p.result.selected:
                 node.on_chain_changed()
         done += 1

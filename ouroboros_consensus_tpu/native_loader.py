@@ -17,9 +17,13 @@ import numpy as np
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native", "headerscan.cpp")
 _SO = os.path.join(os.path.dirname(_SRC), "libheaderscan.so")
+_CSRC = os.path.join(os.path.dirname(_SRC), "hostcrypto.cpp")
+_CSO = os.path.join(os.path.dirname(_SRC), "libhostcrypto.so")
 
 _lib = None
 _tried = False
+_clib = None
+_ctried = False
 
 
 def load():
@@ -72,6 +76,127 @@ def scan_items(buf: bytes, max_items: int = 1 << 20):
         max_items, ctypes.byref(bad),
     )
     return offsets[:n].copy(), sizes[:n].copy(), int(bad.value)
+
+
+def load_crypto():
+    """The native host-crypto library (native/hostcrypto.cpp), building
+    on first use; None if unavailable. This is the libsodium-class
+    single-core verification path — the measured CPU baseline of
+    bench.py and db_analyser --backend native."""
+    global _clib, _ctried
+    if _clib is not None or _ctried:
+        return _clib
+    _ctried = True
+    try:
+        if not os.path.exists(_CSO) or os.path.getmtime(_CSO) < os.path.getmtime(_CSRC):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", _CSO, _CSRC],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_CSO)
+    except Exception:
+        return None
+    lib.oc_ed25519_verify.restype = ctypes.c_int
+    lib.oc_ed25519_verify.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.oc_ecvrf_verify.restype = ctypes.c_int
+    lib.oc_ecvrf_verify.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p,
+    ]
+    lib.oc_kes_verify.restype = ctypes.c_int
+    lib.oc_kes_verify.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.oc_sha512.restype = None
+    lib.oc_sha512.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+    lib.oc_blake2b.restype = None
+    lib.oc_blake2b.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.oc_validate_praos.restype = ctypes.c_long
+    lib.oc_validate_praos.argtypes = (
+        [ctypes.c_long] + [ctypes.c_void_p] * 6 + [ctypes.c_long]
+        + [ctypes.c_void_p] * 8 + [ctypes.POINTER(ctypes.c_long)]
+    )
+    _clib = lib
+    return _clib
+
+
+def native_ed25519_verify(pk: bytes, sig: bytes, msg: bytes) -> bool:
+    lib = load_crypto()
+    assert lib is not None
+    return bool(lib.oc_ed25519_verify(pk, sig, msg, len(msg)))
+
+
+def native_ecvrf_verify(pk: bytes, pi: bytes, alpha: bytes):
+    """beta bytes or None."""
+    lib = load_crypto()
+    assert lib is not None
+    beta = ctypes.create_string_buffer(64)
+    ok = lib.oc_ecvrf_verify(pk, pi, alpha, len(alpha), beta)
+    return beta.raw if ok else None
+
+
+def native_kes_verify(vk: bytes, depth: int, period: int, msg: bytes, sig: bytes) -> bool:
+    lib = load_crypto()
+    assert lib is not None
+    return bool(lib.oc_kes_verify(vk, depth, period, msg, len(msg), sig, len(sig)))
+
+
+def native_validate_praos(
+    cold_vk: np.ndarray,    # [n, 32] uint8
+    ocert_sig: np.ndarray,  # [n, 64]
+    ocert_msg: np.ndarray,  # [n, 48]
+    kes_vk: np.ndarray,     # [n, 32]
+    kes_t: np.ndarray,      # [n] int64
+    kes_sig: np.ndarray,    # [n, 96+32*depth]
+    kes_depth: int,
+    body: bytes,            # flattened signed_bytes
+    body_off: np.ndarray,   # [n+1] int64
+    vrf_vk: np.ndarray,     # [n, 32]
+    vrf_proof: np.ndarray,  # [n, 80]
+    vrf_alpha: np.ndarray,  # [n, 32]
+    vrf_output: np.ndarray, # [n, 64]
+    want_leader_values: bool = True,
+):
+    """(first_bad_index or -1, fail_kind 0|1:ocert|2:kes|3:vrf,
+    leader_values [n, 32] or None, etas [n, 32] or None)."""
+    lib = load_crypto()
+    assert lib is not None
+    n = len(cold_vk)
+    lv = np.zeros((n, 32), np.uint8) if want_leader_values else None
+    eta = np.zeros((n, 32), np.uint8) if want_leader_values else None
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p) if a is not None else None
+
+    arrs = [
+        np.ascontiguousarray(cold_vk, np.uint8),
+        np.ascontiguousarray(ocert_sig, np.uint8),
+        np.ascontiguousarray(ocert_msg, np.uint8),
+        np.ascontiguousarray(kes_vk, np.uint8),
+        np.ascontiguousarray(kes_t, np.int64),
+        np.ascontiguousarray(kes_sig, np.uint8),
+    ]
+    tail = [
+        np.ascontiguousarray(vrf_vk, np.uint8),
+        np.ascontiguousarray(vrf_proof, np.uint8),
+        np.ascontiguousarray(vrf_alpha, np.uint8),
+        np.ascontiguousarray(vrf_output, np.uint8),
+    ]
+    boff = np.ascontiguousarray(body_off, np.int64)
+    body_arr = np.frombuffer(body, np.uint8) if body else np.zeros(1, np.uint8)
+    kind = ctypes.c_long(0)
+    rc = lib.oc_validate_praos(
+        n, *[ptr(a) for a in arrs], kes_depth,
+        ptr(body_arr), ptr(boff), *[ptr(a) for a in tail], ptr(lv), ptr(eta),
+        ctypes.byref(kind),
+    )
+    return int(rc), int(kind.value), lv, eta
 
 
 @dataclass

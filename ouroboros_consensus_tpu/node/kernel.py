@@ -106,8 +106,9 @@ class NodeKernel:
 
     # -- forging (NodeKernel.hs:237-436) ----------------------------------
 
-    def try_forge(self, slot: int):
-        """One forging opportunity: returns the forged Block or None."""
+    def forge_only(self, slot: int):
+        """checkShouldForge + forgeBlock without the ChainDB add —
+        returns the forged Block or None."""
         if self.pool is None:
             return None
         ext = self.chain_db.current_ledger()
@@ -123,7 +124,7 @@ class NodeKernel:
         snap = self.mempool.get_snapshot_for(
             self.ledger.tick(ext.ledger_state, slot).state, slot
         )
-        block = forge_block(
+        return forge_block(
             self.protocol.params,
             self.pool,
             slot=slot,
@@ -134,14 +135,24 @@ class NodeKernel:
             ocert_counter=self._ocert_counter,
             is_leader=is_leader,
         )
-        res = self.chain_db.add_block(block)
+
+    def _post_adoption(self, block, res) -> None:
         if res.selected:
-            self.trace(f"{self.name}: forged+adopted block {block_no}@{slot}")
+            self.trace(
+                f"{self.name}: forged+adopted block {block.block_no}@{block.slot}"
+            )
             self.mempool.sync_with_ledger()
         else:
             # self-forged block not adopted — the adoption check would
             # purge its txs (NodeKernel.hs:402-425); sync covers it
-            self.trace(f"{self.name}: forged block not adopted @{slot}")
+            self.trace(f"{self.name}: forged block not adopted @{block.slot}")
+
+    def try_forge(self, slot: int):
+        """One forging opportunity: returns the forged Block or None."""
+        block = self.forge_only(slot)
+        if block is None:
+            return None
+        self._post_adoption(block, self.chain_db.add_block(block))
         return block
 
     def _can_be_leader(self):
@@ -151,12 +162,22 @@ class NodeKernel:
 
     def forging_loop(self, n_slots: int):
         """Sim task: wake at every slot start (knownSlotWatcher,
-        BlockchainTime/API.hs:59) and attempt to forge."""
+        BlockchainTime/API.hs:59) and attempt to forge. Forged blocks go
+        through the add-block queue like everyone else's
+        (NodeKernel.hs:402 addBlockAsync + adoption wait), so a
+        self-forged block never jumps ahead of enqueued peer blocks."""
+        from ..utils.sim import Wait
+
         for slot in range(n_slots):
             # forge at the START of slot `slot` (virtual time
             # slot*slot_length), then sleep the slot out — forging after
             # the sleep would shift every block one slot late vs the clock
-            self.try_forge(slot)
+            block = self.forge_only(slot)
+            if block is not None:
+                p = self.chain_db.add_block_async(block)
+                if p.result is None:
+                    yield Wait(p.processed)
+                self._post_adoption(block, p.result)
             yield Sleep(self.clock.slot_length)
 
     def on_chain_changed(self):

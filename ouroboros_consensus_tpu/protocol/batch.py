@@ -334,6 +334,69 @@ def _jitted_verify():
     return _JIT["fn"]
 
 
+def run_batch_native(
+    params: PraosParams,
+    ledger_view: LedgerView,
+    epoch_nonce,
+    hvs: Sequence[HeaderView],
+    pre: HostChecks,
+) -> Verdicts:
+    """Native (C++) crypto backend producing the same Verdicts shape as
+    the device kernel — the honest single-core comparison path and the
+    fallback when no accelerator is available (native/hostcrypto.cpp
+    oc_validate_praos). Short-circuits at the first failing lane; lanes
+    past it carry don't-care verdicts, which the sequential epilogue
+    never reads."""
+    from .. import native_loader as nl
+
+    n = len(hvs)
+    cold_vk = np.stack([np.frombuffer(hv.vk_cold, np.uint8) for hv in hvs])
+    ocert_sig = np.stack([np.frombuffer(hv.ocert.sigma, np.uint8) for hv in hvs])
+    ocert_msg = np.stack(
+        [np.frombuffer(hv.ocert.signable(), np.uint8) for hv in hvs]
+    )
+    kes_vk = np.stack([np.frombuffer(hv.ocert.vk_hot, np.uint8) for hv in hvs])
+    kes_sig = np.stack([np.frombuffer(hv.kes_sig, np.uint8) for hv in hvs])
+    body = b"".join(hv.signed_bytes for hv in hvs)
+    body_off = np.zeros(n + 1, np.int64)
+    np.cumsum([len(hv.signed_bytes) for hv in hvs], out=body_off[1:])
+    vrf_vk = np.stack([np.frombuffer(hv.vrf_vk, np.uint8) for hv in hvs])
+    vrf_proof = np.stack([np.frombuffer(hv.vrf_proof, np.uint8) for hv in hvs])
+    vrf_alpha = np.stack(
+        [
+            np.frombuffer(nonces.mk_input_vrf(hv.slot, epoch_nonce), np.uint8)
+            for hv in hvs
+        ]
+    )
+    vrf_output = np.stack([np.frombuffer(hv.vrf_output, np.uint8) for hv in hvs])
+
+    rc, kind, lv, eta = nl.native_validate_praos(
+        cold_vk, ocert_sig, ocert_msg, kes_vk,
+        pre.kes_evolution.astype(np.int64), kes_sig, params.kes_depth,
+        body, body_off, vrf_vk, vrf_proof, vrf_alpha, vrf_output,
+    )
+    ok_ocert = np.ones(n, bool)
+    ok_kes = np.ones(n, bool)
+    ok_vrf = np.ones(n, bool)
+    if rc >= 0:
+        (ok_ocert if kind == 1 else ok_kes if kind == 2 else ok_vrf)[rc] = False
+
+    # leader threshold: bracket compare exactly as the device kernel
+    f = params.active_slot_coeff
+    ok_leader = np.zeros(n, bool)
+    ambiguous = np.zeros(n, bool)
+    stop = n if rc < 0 else rc
+    for i in range(stop):
+        hv = hvs[i]
+        entry = ledger_view.pool_distr.get(hash_key(hv.vk_cold))
+        sigma = entry.stake if entry is not None else Fraction(0)
+        lo, hi = leader_threshold_bracket(Fraction(sigma), Fraction(f))
+        lv_int = int.from_bytes(lv[i].tobytes(), "big")
+        ok_leader[i] = lv_int < lo
+        ambiguous[i] = not ok_leader[i] and lv_int < hi
+    return Verdicts(ok_ocert, ok_kes, ok_vrf, ok_leader, ambiguous, eta, lv)
+
+
 def run_batch(batch: PraosBatch) -> Verdicts:
     """Stage -> device -> host verdict arrays (numpy).
 
@@ -421,12 +484,14 @@ def validate_batch(
     ticked: TickedPraosState,
     hvs: Sequence[HeaderView],
     collect_states: bool = False,
+    backend: str = "device",
 ) -> BatchResult:
-    """Validate a within-epoch run of headers as one device batch.
+    """Validate a within-epoch run of headers as one batch.
 
     Equivalent to folding `praos.update` over `hvs` from `ticked` — same
     resulting state, same first error — but with all crypto executed as a
-    single fused device program. The epoch nonce must be constant across
+    single fused device program (backend="device") or through the C++
+    verifier (backend="native"). The epoch nonce must be constant across
     the run (the caller segments at epoch boundaries; `tick` between
     segments).
     """
@@ -436,10 +501,46 @@ def validate_batch(
     eta0 = ticked.state.epoch_nonce
 
     pre = host_prechecks(params, lview, hvs)
-    batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
-    v = run_batch(batch)
+    if backend == "native":
+        v = run_batch_native(params, lview, eta0, hvs, pre)
+    else:
+        batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
+        v = run_batch(batch)
+    return _epilogue(params, ticked, hvs, pre, v, collect_states)
 
-    # sequential epilogue: counters + nonce fold, stop at first failure
+
+def dispatch_batch(params, lview, eta0, hvs):
+    """Stage a within-epoch window and dispatch the fused kernel WITHOUT
+    waiting: jax execution is asynchronous, so the caller can stage the
+    next window while this one runs on device (the §7.3.6 host/device
+    overlap; the reference's analog is the decoupled add-block queue,
+    ChainSel.hs:217-246). Staging depends only on the epoch nonce and
+    ledger view — never on the sequential fold — which is what makes
+    in-flight windows safe."""
+    pre = host_prechecks(params, lview, hvs)
+    batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
+    b = batch.beta.shape[0]
+    padded = pad_batch_to(batch, bucket_size(b))
+    out = _jitted_verify()(*(jnp.asarray(x) for x in flatten_batch(padded)))
+    return pre, out, b
+
+
+def materialize_verdicts(out, b) -> Verdicts:
+    """Block on a dispatched window's device computation."""
+    return Verdicts(*(np.asarray(x)[:b] for x in out))
+
+
+def _epilogue(
+    params: PraosParams,
+    ticked: TickedPraosState,
+    hvs: Sequence[HeaderView],
+    pre: HostChecks,
+    v: Verdicts,
+    collect_states: bool = False,
+) -> BatchResult:
+    """Sequential epilogue: counters + nonce fold, stop at first failure."""
+    lview = ticked.ledger_view
+    eta0 = ticked.state.epoch_nonce
     st = ticked.state
     counters = dict(st.ocert_counters)
     evolving = st.evolving_nonce
@@ -502,27 +603,64 @@ def validate_chain(
     state: PraosState,
     hvs: Sequence[HeaderView],
     max_batch: int = 8192,
+    backend: str = "device",
+    pipeline_depth: int = 2,
 ) -> BatchResult:
     """Validate an arbitrary run of headers, segmenting at epoch
     boundaries (and at `max_batch` within an epoch) per SURVEY.md §5.7.
 
     `ledger_view_for_epoch(epoch) -> LedgerView` supplies the forecastable
     per-epoch pool distribution (constant within an epoch).
+
+    Device backend: up to `pipeline_depth` windows of the same epoch are
+    in flight at once — window w+1 is staged (host CBOR→SoA + H2D) while
+    window w executes, because staging depends only on the epoch nonce.
+    The pipeline drains at epoch boundaries (the next epoch's nonce needs
+    the previous epoch's fold) and on the first invalid header (in-flight
+    successors are discarded, exactly like queued blocks after a failed
+    chain selection in the reference's add-block queue).
     """
     total_valid = 0
     i = 0
     n = len(hvs)
     while i < n:
         epoch = params.epoch_of(hvs[i].slot)
-        j = i
-        while j < n and params.epoch_of(hvs[j].slot) == epoch and j - i < max_batch:
-            j += 1
+        seg_end = i
+        while seg_end < n and params.epoch_of(hvs[seg_end].slot) == epoch:
+            seg_end += 1
         lview = ledger_view_for_epoch(epoch)
-        ticked = praos.tick(params, lview, hvs[i].slot, state)
-        res = validate_batch(params, ticked, hvs[i:j])
-        state = res.state
-        total_valid += res.n_valid
-        if res.error is not None:
-            return BatchResult(state, total_valid, res.error)
-        i = j
+        eta0_state = praos.tick(params, lview, hvs[i].slot, state).state
+        eta0 = eta0_state.epoch_nonce
+
+        if backend != "device":
+            while i < seg_end:
+                j = min(i + max_batch, seg_end)
+                ticked = praos.tick(params, lview, hvs[i].slot, state)
+                res = validate_batch(params, ticked, hvs[i:j], backend=backend)
+                state = res.state
+                total_valid += res.n_valid
+                if res.error is not None:
+                    return BatchResult(state, total_valid, res.error)
+                i = j
+            continue
+
+        from collections import deque
+
+        inflight: deque = deque()  # (window_start, window_hvs, pre, out, b)
+        w = i
+        while w < seg_end or inflight:
+            while w < seg_end and len(inflight) < pipeline_depth:
+                j = min(w + max_batch, seg_end)
+                pre, out, b = dispatch_batch(params, lview, eta0, hvs[w:j])
+                inflight.append((w, hvs[w:j], pre, out, b))
+                w = j
+            w0, whvs, pre, out, b = inflight.popleft()
+            v = materialize_verdicts(out, b)
+            ticked = praos.tick(params, lview, whvs[0].slot, state)
+            res = _epilogue(params, ticked, whvs, pre, v)
+            state = res.state
+            total_valid += res.n_valid
+            if res.error is not None:
+                return BatchResult(state, total_valid, res.error)
+        i = seg_end
     return BatchResult(state, total_valid, None)

@@ -23,19 +23,30 @@ The batched inversion: candidate suffix validation goes through
 fused batch instead of per-block calls.
 
 Concurrency: the reference serializes chain selection through an STM
-queue + single background thread (cdbBlocksToAdd, ChainSel.hs:217-246);
-here `add_block` IS the serialization point (called from the node's
-single-threaded event loop; utils/sim for deterministic tests).
+queue + single background thread (cdbBlocksToAdd, ChainSel.hs:217-246)
+and runs copy/snapshot/GC on background threads (Impl/Background.hs).
+Both shapes exist here:
+
+  * synchronous (default): `add_block` IS the serialization point and
+    runs the copy/GC step inline — the shape the CLI tools use.
+  * decoupled: `add_block_async` enqueues and returns an
+    AddBlockPromise; `add_block_runner()` (a sim/asyncio task) pops and
+    serializes chain selection, and `background_runner()` performs
+    copy-to-immutable, snapshots and DELAYED VolatileDB GC (the
+    GcSchedule analog) off the adoption path. Peer tasks never block on
+    chain selection, mirroring ChainSel.hs:217-246 + Background.hs:17-38.
 """
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Sequence
 
 from ..block.abstract import Point
 from ..block.praos_block import Block
 from ..ledger.extended import ExtLedger, ExtLedgerState
+from ..utils.sim import Event, Fire, Sleep, Wait
 from .immutable import ImmutableDB
 from .ledgerdb import InvalidBlock, LedgerDB
 from .volatile import VolatileDB
@@ -46,6 +57,16 @@ class AddBlockResult:
     added: bool
     new_tip: Point | None  # tip after (possibly unchanged)
     selected: bool  # did the chain change?
+
+
+@dataclass
+class AddBlockPromise:
+    """The caller-visible side of an enqueued block (API.hs:134
+    AddBlockPromise): `processed` fires once chain selection ran."""
+
+    block: Block
+    processed: Event
+    result: AddBlockResult | None = None
 
 
 class Follower:
@@ -103,6 +124,12 @@ class ChainDB:
         self.current_chain: list[Block] = []  # volatile fragment, ≤ k
         self.invalid: dict[bytes, Exception] = {}  # hash -> reason
         self.followers: list[Follower] = []
+        # decoupled mode state (add_block_runner / background_runner)
+        self._blocks_to_add: deque[AddBlockPromise] = deque()
+        self._queue_event = Event("blocks-to-add")
+        self._chain_event = Event("chain-changed")
+        self._background_decoupled = False
+        self.runtime = None  # object with .fire(Event), set by the node
         self._init_chain_selection()
 
     # -- initial chain selection (ChainSel.hs:96) ----------------------------
@@ -393,7 +420,11 @@ class ChainDB:
         self.current_chain.extend(suffix)
         for f in self.followers:
             f._notify_switch(n_rollback > 0, rollback_point, suffix)
-        self._copy_and_gc()
+        if self._background_decoupled:
+            if self.runtime is not None:
+                self.runtime.fire(self._chain_event)
+        else:
+            self._copy_and_gc()
 
     def close(self) -> None:
         """Clean shutdown: final ledger snapshot + index flush, so the
@@ -404,12 +435,13 @@ class ChainDB:
 
     # -- background (Impl/Background.hs) -------------------------------------
 
-    def _copy_and_gc(self) -> None:
-        """copyAndSnapshotRunner: move blocks > k deep to the ImmutableDB,
-        snapshot the ledger anchor, GC the VolatileDB."""
+    def _copy_step(self) -> int | None:
+        """copyAndSnapshotRunner body: move blocks > k deep to the
+        ImmutableDB, snapshot the ledger anchor on the DiskPolicy
+        cadence. Returns the GC slot bound, or None if nothing moved."""
         excess = len(self.current_chain) - self.k
         if excess <= 0:
-            return
+            return None
         to_copy, self.current_chain = (
             self.current_chain[:excess],
             self.current_chain[excess:],
@@ -423,5 +455,64 @@ class ChainDB:
         ):
             self.ledgerdb.take_snapshot(self.snap_dir)
             self._copied_since_snapshot = 0
-        gc_slot = to_copy[-1].slot + 1
-        self.volatile.garbage_collect(gc_slot)
+        return to_copy[-1].slot + 1
+
+    def _copy_and_gc(self) -> None:
+        """Synchronous-mode step: copy + immediate GC."""
+        gc_slot = self._copy_step()
+        if gc_slot is not None:
+            self.volatile.garbage_collect(gc_slot)
+
+    # -- decoupled mode (ChainSel.hs:217-246 + Background.hs:17-38) ----------
+
+    def start_decoupled(self, runtime) -> list:
+        """Switch to decoupled mode on `runtime` (a Sim or an adapter
+        with .fire(Event)); returns the runner generators for the caller
+        to spawn. Must be called before any add_block_async."""
+        self.runtime = runtime
+        self._background_decoupled = True
+        return [self.add_block_runner(), self.background_runner()]
+
+    def add_block_async(self, block: Block) -> AddBlockPromise:
+        """addBlockAsync (API.hs:134): enqueue for the add-block runner
+        and return a promise. Works in BOTH modes so call sites never
+        branch: synchronous mode runs chain selection inline and returns
+        an already-completed promise. Callers needing the verdict do
+        `if p.result is None: yield Wait(p.processed)`."""
+        p = AddBlockPromise(block, Event(f"processed-{block.slot}"))
+        if not self._background_decoupled:
+            p.result = self.add_block(block)
+            return p
+        self._blocks_to_add.append(p)
+        if self.runtime is not None:
+            self.runtime.fire(self._queue_event)
+        return p
+
+    def add_block_runner(self):
+        """Sim task (Background.hs addBlockRunner): the single consumer
+        of the add-block queue — chain selection is serialized here no
+        matter how many peer tasks feed the queue."""
+        while True:
+            while not self._blocks_to_add:
+                yield Wait(self._queue_event)
+            p = self._blocks_to_add.popleft()
+            p.result = self.add_block(p.block)
+            yield Fire(p.processed)
+
+    def background_runner(self, gc_delay: float = 1.0):
+        """Sim task (copyAndSnapshotRunner + GcSchedule): on every chain
+        change, copy mature blocks to the ImmutableDB + snapshot; GC the
+        VolatileDB only `gc_delay` later, so concurrent readers of the
+        copied blocks (iterators, servers) drain first — the reference's
+        scheduled-GC batching (Background.hs GcSchedule)."""
+        while True:
+            yield Wait(self._chain_event)
+            # chain changes fired while we were sleeping below are not in
+            # the waiter list — re-run the copy step until it finds
+            # nothing, so no adoption's excess blocks are stranded
+            while True:
+                gc_slot = self._copy_step()
+                if gc_slot is None:
+                    break
+                yield Sleep(gc_delay)
+                self.volatile.garbage_collect(gc_slot)

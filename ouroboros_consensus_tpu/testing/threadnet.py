@@ -39,6 +39,8 @@ class ThreadNetConfig:
     active_slot_coeff: Fraction = Fraction(1, 2)
     epoch_length: int = 50
     topology: list[tuple[int, int]] | None = None  # directed edges; None=full
+    async_chaindb: bool = False  # decoupled add-block queue + background GC
+    use_device_batch: bool = False  # candidate validation via fused kernel
 
 
 @dataclass
@@ -66,7 +68,7 @@ def run_thread_network(base_dir: str, cfg: ThreadNetConfig) -> ThreadNetResult:
     nodes: list[NodeKernel] = []
     for i in range(cfg.n_nodes):
         ledger = MockLedger(MockConfig(lview, params.stability_window))
-        protocol = PraosProtocol(params, use_device_batch=False)
+        protocol = PraosProtocol(params, use_device_batch=cfg.use_device_batch)
         ext = ExtLedger(ledger, protocol)
         genesis = ext.genesis(ledger.genesis_state([(b"addr-%d" % i, 100)]))
         db = open_chaindb(
@@ -94,6 +96,10 @@ def run_thread_network(base_dir: str, cfg: ThreadNetConfig) -> ThreadNetResult:
 
     sim = Sim()
     for i, node in enumerate(nodes):
+        if cfg.async_chaindb:
+            runners = node.chain_db.start_decoupled(sim)
+            sim.spawn(runners[0], f"addblock{i}")
+            sim.spawn(runners[1], f"background{i}")
         sim.spawn(node.forging_loop(cfg.n_slots), f"forge{i}")
 
     # edge (i, j): node j syncs FROM node i (i serves, j consumes)

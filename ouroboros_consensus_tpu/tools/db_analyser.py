@@ -173,7 +173,9 @@ def revalidate(
     backend="device": epoch-segmented batches through the fused kernel
     (further split at max_batch to bound device memory; the jit caches
     per padded shape).
-    backend="host": the sequential fold (reference semantics, pure host).
+    backend="native": same segmentation through the C++ verifier
+    (native/hostcrypto.cpp) — the measured single-core CPU baseline.
+    backend="host": the sequential fold (reference semantics, pure Python).
     """
     res = ValidationResult()
     t0 = time.monotonic()
@@ -188,24 +190,23 @@ def revalidate(
                 res.n_valid += 1
         except praos.PraosValidationError as e:
             res.error = e
-    elif backend == "device":
-        done = False
+    elif backend in ("device", "native"):
+        # one epoch segment buffered at a time (bounded memory on real
+        # chains); validate_chain pipelines staging against device
+        # execution within each segment
         for seg in _epoch_segments(params, _stream_views(imm, res)):
-            if done:
+            ts = time.monotonic()
+            result = pbatch.validate_chain(
+                params, lambda _e: lview, st, seg,
+                max_batch=max_batch, backend=backend,
+            )
+            res.device_s += time.monotonic() - ts
+            st = result.state
+            res.n_valid += result.n_valid
+            if result.error is not None:
+                res.error = result.error
                 break
-            for i in range(0, len(seg), max_batch):
-                hvs = seg[i : i + max_batch]
-                ticked = praos.tick(params, lview, hvs[0].slot, st)
-                ts = time.monotonic()
-                result = pbatch.validate_batch(params, ticked, hvs)
-                res.device_s += time.monotonic() - ts
-                st = result.state
-                res.n_valid += result.n_valid
-                if result.error is not None:
-                    res.error = result.error
-                    done = True
-                    break
-                trace(f"validated {res.n_valid} headers")
+            trace(f"validated {res.n_valid} headers")
     else:
         raise ValueError(f"unknown backend {backend!r}")
 
@@ -300,7 +301,7 @@ def main(argv=None) -> None:
         choices=["only-validation", "benchmark-ledger-ops", "count-blocks"],
         default="only-validation",
     )
-    p.add_argument("--backend", choices=["device", "host"], default="device")
+    p.add_argument("--backend", choices=["device", "native", "host"], default="device")
     p.add_argument("--out-csv", default=None)
     a = p.parse_args(argv)
     if a.analysis == "count-blocks":

@@ -132,6 +132,15 @@ class Sim:
     def _schedule(self, t: float, task: Task, value: Any = None) -> None:
         heapq.heappush(self._runq, (t, self._next_seq(), "task", (task, value)))
 
+    def fire(self, event: Event) -> None:
+        """Wake all waiters of `event`. Callable both from task context
+        (the Fire effect routes here) and from synchronous code holding
+        the scheduler — e.g. ChainDB.add_block_async notifying the
+        add-block runner (the STM-TVar-write analog)."""
+        for w in event._waiters:
+            self._schedule(self.now, w)
+        event._waiters.clear()
+
     def _schedule_delivery(self, t: float, chan: Channel) -> None:
         heapq.heappush(self._runq, (t, self._next_seq(), "deliver", chan))
 
@@ -185,9 +194,7 @@ class Sim:
         elif isinstance(eff, Wait):
             eff.event._waiters.append(task)
         elif isinstance(eff, Fire):
-            for w in eff.event._waiters:
-                self._schedule(self.now, w)
-            eff.event._waiters.clear()
+            self.fire(eff.event)
             self._schedule(self.now, task)
         elif isinstance(eff, Spawn):
             child = self.spawn(eff.gen, eff.name)

@@ -1,0 +1,152 @@
+"""Differential tests: native C++ crypto (native/hostcrypto.cpp) vs the
+Python host references and the hashlib oracles — field/point internals,
+the three verifiers, and the batch fold driver behind
+db_analyser --backend native (the bench.py baseline)."""
+
+import ctypes
+import hashlib
+
+import numpy as np
+import pytest
+
+from ouroboros_consensus_tpu import native_loader as nl
+from ouroboros_consensus_tpu.ops.host import ecvrf as hv
+from ouroboros_consensus_tpu.ops.host import ed25519 as he
+from ouroboros_consensus_tpu.ops.host import kes as hk
+
+lib = nl.load_crypto()
+pytestmark = pytest.mark.skipif(lib is None, reason="no native toolchain")
+
+rng = np.random.default_rng(17)
+
+
+def _rand(n):
+    return rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_hashes_match_hashlib():
+    for n in (0, 1, 63, 64, 111, 112, 127, 128, 129, 1000):
+        m = _rand(n)
+        out = ctypes.create_string_buffer(64)
+        lib.oc_sha512(m, n, out)
+        assert out.raw == hashlib.sha512(m).digest()
+        for dl in (28, 32, 64):
+            o2 = ctypes.create_string_buffer(dl)
+            lib.oc_blake2b(m, n, o2, dl)
+            assert o2.raw == hashlib.blake2b(m, digest_size=dl).digest()
+
+
+def test_field_ops_match_host():
+    P = he.P
+    for _ in range(25):
+        a = int.from_bytes(_rand(32), "little") % P
+        b = int.from_bytes(_rand(32), "little") % P
+        if a == 0:
+            continue
+        mo, co, io, so = (ctypes.create_string_buffer(32) for _ in range(4))
+        ok, sq = ctypes.c_int(0), ctypes.c_int(0)
+        lib.oc_fe_test(
+            a.to_bytes(32, "little"), b.to_bytes(32, "little"),
+            mo, co, io, so, ctypes.byref(ok), ctypes.byref(sq),
+        )
+        assert int.from_bytes(mo.raw, "little") == a * b % P
+        # the lazy add/sub/sq chain inside oc_fe_test
+        assert int.from_bytes(co.raw, "little") == (((a + b) * (a - b) + a * a) * 2) ** 2 % P
+        assert int.from_bytes(io.raw, "little") == pow(a, P - 2, P)
+        hs = he.fe_sqrt(a)
+        assert bool(ok.value) == (hs is not None)
+        if hs is not None:
+            assert int.from_bytes(so.raw, "little") == hs
+        assert bool(sq.value) == he.is_square(a)
+
+
+def test_point_ops_match_host():
+    for _ in range(10):
+        pk = he.secret_to_public(_rand(32))
+        s = _rand(32)
+        rt, mo, do = (ctypes.create_string_buffer(32) for _ in range(3))
+        assert lib.oc_ge_test(pk, s, rt, mo, do) == 1
+        assert rt.raw == pk  # decompress/compress roundtrip
+        A = he.point_decompress(pk)
+        assert mo.raw == he.point_compress(
+            he.point_mul(int.from_bytes(s, "little"), A)
+        )
+        assert do.raw == he.point_compress(he.point_double(A))
+
+
+def test_double_scalarmult_matches_host():
+    for _ in range(8):
+        s1, s2 = _rand(32), _rand(32)
+        p = he.secret_to_public(_rand(32))
+        q = he.secret_to_public(_rand(32))
+        out = ctypes.create_string_buffer(32)
+        assert lib.oc_dsmul_test(s1, p, s2, q, out) == 1
+        P_, Q_ = he.point_decompress(p), he.point_decompress(q)
+        want = he.point_add(
+            he.point_mul(int.from_bytes(s1, "little"), P_),
+            he.point_mul(int.from_bytes(s2, "little"), Q_),
+        )
+        assert out.raw == he.point_compress(want)
+
+
+def test_ed25519_verify_differential():
+    for i in range(12):
+        seed = _rand(32)
+        msg = _rand(int(rng.integers(0, 200)))
+        pk = he.secret_to_public(seed)
+        sig = he.sign(seed, msg)
+        assert nl.native_ed25519_verify(pk, sig, msg)
+        assert not nl.native_ed25519_verify(pk, bytes([sig[0] ^ 1]) + sig[1:], msg)
+        assert not nl.native_ed25519_verify(pk, sig, msg + b"x")
+    # non-canonical encodings rejected exactly like the host
+    bad_r = (2**255 - 19 + 1).to_bytes(32, "little") + sig[32:]
+    assert not nl.native_ed25519_verify(pk, bad_r, msg)
+    assert not he.verify(pk, msg, bad_r)
+    bad_s = sig[:32] + he.L.to_bytes(32, "little")
+    assert not nl.native_ed25519_verify(pk, bad_s, msg)
+    assert not he.verify(pk, msg, bad_s)
+
+
+def test_ecvrf_verify_differential():
+    for i in range(8):
+        seed, alpha = _rand(32), _rand(32)
+        pk = he.secret_to_public(seed)
+        pi = hv.prove(seed, alpha)
+        assert nl.native_ecvrf_verify(pk, pi, alpha) == hv.proof_to_hash(pi)
+        bad = pi[:40] + bytes([pi[40] ^ 1]) + pi[41:]
+        assert nl.native_ecvrf_verify(pk, bad, alpha) is None
+        assert nl.native_ecvrf_verify(pk, pi, bytes(32)) is None
+
+
+def test_kes_verify_differential():
+    depth = 4
+    for i in range(6):
+        seed = _rand(32)
+        per = int(rng.integers(0, 1 << depth))
+        msg = b"kes-%d" % i
+        sig = hk.sign(seed, depth, per, msg)
+        vk = hk.derive_vk(seed, depth)
+        assert nl.native_kes_verify(vk, depth, per, msg, sig)
+        assert not nl.native_kes_verify(vk, depth, (per + 1) % (1 << depth), msg, sig)
+        assert not nl.native_kes_verify(vk, depth, per, msg + b"!", sig)
+
+
+def test_native_backend_vs_host_fold(tmp_path):
+    """db_analyser --backend native == --backend host on a synthesized
+    chain, both clean and with a tampered block."""
+    from ouroboros_consensus_tpu.tools import db_analyser as ana
+    from ouroboros_consensus_tpu.tools import db_synthesizer as synth
+
+    params = synth.default_params(kes_depth=3)
+    pools, lview = synth.make_credentials(2, kes_depth=3)
+    path = str(tmp_path / "db")
+    res = synth.synthesize(
+        path, params, pools, lview, synth.ForgeLimit(slots=80),
+        vrf_backend="host",
+    )
+    assert res.n_blocks > 0
+    rn = ana.revalidate(path, params, lview, backend="native")
+    rh = ana.revalidate(path, params, lview, backend="host")
+    assert rn.error is None and rh.error is None
+    assert rn.n_valid == rh.n_valid == res.n_blocks
+    assert rn.final_state == rh.final_state

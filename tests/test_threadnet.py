@@ -46,3 +46,37 @@ def test_deterministic_replay(tmp_path):
     assert [r1.chain_hashes(i) for i in range(2)] == [
         r2.chain_hashes(i) for i in range(2)
     ]
+
+
+@pytest.mark.slow
+def test_async_chaindb_converges(tmp_path):
+    """Decoupled add-block queue + background copy/GC (ChainSel.hs:217,
+    Background.hs): same convergence properties, deterministically."""
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=3, n_slots=30, k=10, msg_delay=0.05, async_chaindb=True
+    )
+    res = threadnet.run_thread_network(str(tmp_path / "a"), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    threadnet.check_chain_growth(res, cfg)
+    tips = {res.chain_hashes(i)[-1] for i in range(cfg.n_nodes)}
+    assert len(tips) == 1, "nodes did not converge to one tip"
+    # determinism holds with the extra runner tasks in the schedule
+    res2 = threadnet.run_thread_network(str(tmp_path / "b"), cfg)
+    assert [res.chain_hashes(i) for i in range(3)] == [
+        res2.chain_hashes(i) for i in range(3)
+    ]
+
+
+@pytest.mark.slow
+def test_device_batch_threadnet(tmp_path):
+    """Multi-node sim with candidate validation through the fused batch
+    kernel (use_device_batch=True) — co-testing networking + device
+    crypto (VERDICT r1: ThreadNet never exercised the device path)."""
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=2, n_slots=12, k=6, msg_delay=0.05, use_device_batch=True,
+        async_chaindb=True,
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    tips = {res.chain_hashes(i)[-1] for i in range(cfg.n_nodes)}
+    assert len(tips) == 1
