@@ -1,0 +1,129 @@
+"""Byron-analog era: PBFT over Ed25519-signed mock blocks.
+
+Reference shape: `ouroboros-consensus-cardano/src/byron/.../Byron/Ledger/
+Block.hs` (delegate-signed headers) under `Protocol/PBFT.hs` (signing
+window) — with the Byron ledger's tx machinery replaced by opaque tx
+bytes, the same strategy the reference's own mock-block library uses for
+ThreadNet (src/mock-block/). This is the first era of the mixed-era
+composite (hardfork/composite.py), giving BASELINE config 5 its
+Byron→Shelley→Babbage shape.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from functools import cached_property
+from typing import Sequence
+
+from ..block.abstract import Point
+from ..ops.host import ed25519 as host_ed25519
+from ..protocol.instances import PBftView
+from ..utils import cbor
+
+
+def _b2b(data: bytes) -> bytes:
+    return hashlib.blake2b(data, digest_size=32).digest()
+
+
+@dataclass(frozen=True)
+class ByronMockHeader:
+    """Header: delegate-signed (cold Ed25519) over the body fields."""
+
+    block_no: int
+    slot: int
+    prev_hash: bytes | None
+    issuer_vk: bytes  # 32 — genesis delegate key
+    body_hash: bytes  # 32
+    sig: bytes  # 64 — Ed25519 over signed_bytes
+
+    @cached_property
+    def signed_bytes(self) -> bytes:
+        return cbor.encode(
+            [self.block_no, self.slot, self.prev_hash, self.issuer_vk,
+             self.body_hash]
+        )
+
+    @cached_property
+    def bytes_(self) -> bytes:
+        return cbor.encode(
+            [self.block_no, self.slot, self.prev_hash, self.issuer_vk,
+             self.body_hash, self.sig]
+        )
+
+    @cached_property
+    def hash_(self) -> bytes:
+        return _b2b(self.bytes_)
+
+    @property
+    def point(self) -> Point:
+        return Point(self.slot, self.hash_)
+
+    def to_view(self) -> PBftView:
+        return PBftView(self.issuer_vk, self.signed_bytes, self.sig)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ByronMockHeader":
+        bn, slot, prev, vk, bh, sig = cbor.decode(data)
+        return cls(bn, slot, prev, vk, bh, sig)
+
+
+def body_hash(txs: Sequence[bytes]) -> bytes:
+    return _b2b(cbor.encode(list(txs)))
+
+
+@dataclass(frozen=True)
+class ByronMockBlock:
+    header: ByronMockHeader
+    txs: tuple[bytes, ...] = ()
+
+    @cached_property
+    def bytes_(self) -> bytes:
+        return cbor.encode([self.header.bytes_, list(self.txs)])
+
+    @property
+    def hash_(self) -> bytes:
+        return self.header.hash_
+
+    @property
+    def slot(self) -> int:
+        return self.header.slot
+
+    @property
+    def block_no(self) -> int:
+        return self.header.block_no
+
+    @property
+    def prev_hash(self) -> bytes | None:
+        return self.header.prev_hash
+
+    @property
+    def point(self) -> Point:
+        return self.header.point
+
+    def check_integrity(self) -> bool:
+        return body_hash(self.txs) == self.header.body_hash
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ByronMockBlock":
+        hdr, txs = cbor.decode(data)
+        return cls(ByronMockHeader.from_bytes(hdr), tuple(txs))
+
+
+def forge_block(
+    seed: bytes,
+    *,
+    slot: int,
+    block_no: int,
+    prev_hash: bytes | None,
+    txs: tuple[bytes, ...] = (),
+) -> ByronMockBlock:
+    """Forge a delegate block (Byron forging: sign the header body with
+    the delegate's Ed25519 key — Byron/Forge.hs shape)."""
+    vk = host_ed25519.secret_to_public(seed)
+    bh = body_hash(txs)
+    unsigned = ByronMockHeader(block_no, slot, prev_hash, vk, bh, b"\x00" * 64)
+    sig = host_ed25519.sign(seed, unsigned.signed_bytes)
+    return ByronMockBlock(
+        ByronMockHeader(block_no, slot, prev_hash, vk, bh, sig), tuple(txs)
+    )

@@ -1,0 +1,332 @@
+"""The mixed-era composite: ByronMock(PBFT) → Shelley(TPraos) →
+Babbage(Praos) through the hard-fork combinator — BASELINE config 5.
+
+Reference: `CardanoBlock` (Cardano/Block.hs:96 — ByronBlock ':
+CardanoShelleyEras), the `CanHardFork` pairwise translations
+(Cardano/CanHardFork.hs:273), and `protocolInfoCardano` (Cardano/Node.hs)
+collapsed to the three protocol classes that matter for consensus: one
+PBFT era and the two Praos-class eras sharing the batched TPU crypto
+backend. Era boundaries are config-driven (TriggerHardForkAtEpoch).
+
+`synthesize` forges a chain crossing both transitions into an on-disk
+ImmutableDB of era-tagged blocks; `revalidate` streams it back and
+validates every segment with the chosen backend — the Praos-class
+segments as fused device batches, the PBFT segment as a batched Ed25519
+verify + host threshold fold.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, replace
+from fractions import Fraction
+from typing import Sequence
+
+import numpy as np
+
+from ..block import forge as praos_forge
+from ..block.praos_block import Block as PraosBlock
+from ..ops import ed25519_batch
+from ..protocol import batch as pbatch
+from ..protocol import nonces, praos, tpraos
+from ..protocol.instances import PBftParams, PBftProtocol, PraosProtocol
+from ..protocol.views import hash_vrf_vk
+from ..storage.immutable import ImmutableDB
+from ..testing import fixtures
+from .byron_mock import ByronMockBlock
+from .combinator import Era, HardForkBlock, HardForkProtocol, decode_block
+from .history import EraParams, summarize
+
+
+@dataclass(frozen=True)
+class CardanoMockConfig:
+    """Genesis-file analog for the 3-era composite."""
+
+    byron_epochs: int = 2
+    byron_epoch_length: int = 40
+    shelley_epochs: int = 2
+    n_delegs: int = 2  # genesis delegates (byron signers = tpraos overlay)
+    shelley_d: Fraction = Fraction(1, 2)
+    shelley_f: Fraction = Fraction(1)
+    babbage_f: Fraction = Fraction(1)
+    epoch_length: int = 60  # shelley + babbage
+    k: int = 5
+    kes_depth: int = 3
+    # with n_delegs=2 round-robin and window k, each delegate signs
+    # ~k/2 + 1 of any window — the threshold must clear that
+    pbft_threshold: Fraction = Fraction(4, 5)
+    shelley_initial_nonce: bytes = b"\x0b" * 32
+
+
+class CardanoMock:
+    """The assembled composite (protocolInfoCardano analog)."""
+
+    def __init__(self, cfg: CardanoMockConfig):
+        self.cfg = cfg
+        self.delegs = [
+            fixtures.make_pool(100 + i, kes_depth=cfg.kes_depth)
+            for i in range(cfg.n_delegs)
+        ]
+        self.pools = [fixtures.make_pool(0, kes_depth=cfg.kes_depth)]
+        base_view = fixtures.make_ledger_view(self.pools)
+        self.praos_view = base_view
+        self.tpraos_view = tpraos.TPraosLedgerView(
+            pool_distr=base_view.pool_distr,
+            gen_delegs=[
+                tpraos.GenDeleg(d.vk_cold, hash_vrf_vk(d.vrf_vk))
+                for d in self.delegs
+            ],
+        )
+        common = dict(
+            slots_per_kes_period=100,
+            max_kes_evolutions=62,
+            security_param=cfg.k,
+            epoch_length=cfg.epoch_length,
+            kes_depth=cfg.kes_depth,
+        )
+        self.tpraos_params = tpraos.TPraosParams(
+            praos=praos.PraosParams(
+                active_slot_coeff=cfg.shelley_f, **common
+            ),
+            decentralization=cfg.shelley_d,
+        )
+        self.praos_params = praos.PraosParams(
+            active_slot_coeff=cfg.babbage_f, **common
+        )
+        self.pbft = PBftProtocol(
+            PBftParams(
+                num_genesis_keys=cfg.n_delegs,
+                threshold=cfg.pbft_threshold,
+                window=cfg.k,
+                security_param=cfg.k,
+            ),
+            [d.vk_cold for d in self.delegs],
+        )
+        self.tpraos_proto = tpraos.TPraosProtocol(self.tpraos_params)
+        nonce = cfg.shelley_initial_nonce
+        self.summary = summarize(
+            Fraction(0),
+            [
+                EraParams(cfg.byron_epoch_length, Fraction(1)),
+                EraParams(cfg.epoch_length, Fraction(1)),
+                EraParams(cfg.epoch_length, Fraction(1)),
+            ],
+            [
+                cfg.byron_epochs,
+                cfg.byron_epochs + cfg.shelley_epochs,
+                None,
+            ],
+        )
+        self.praos_proto = PraosProtocol(self.praos_params)
+        self.eras = [
+            Era("byron", self.pbft, ledger=None),
+            Era(
+                "shelley",
+                self.tpraos_proto,
+                ledger=None,
+                # Byron's PBftState carries nothing Praos-shaped: Shelley
+                # starts from the genesis nonce (CanHardFork.hs
+                # translateLedgerStateByronToShelley + protocol init)
+                translate_chain_dep=lambda _s: replace(
+                    tpraos.TPraosState(), epoch_nonce=nonce
+                ),
+            ),
+            Era(
+                "babbage",
+                self.praos_proto,
+                ledger=None,
+                translate_chain_dep=tpraos.translate_state,
+            ),
+        ]
+        self.hf = HardForkProtocol(self.eras, self.summary)
+        self.decoders = [
+            ByronMockBlock.from_bytes,
+            PraosBlock.from_bytes,
+            PraosBlock.from_bytes,
+        ]
+
+    def view_for_era(self, era: int):
+        return (None, self.tpraos_view, self.praos_view)[era]
+
+
+# ---------------------------------------------------------------------------
+# Synthesis (db-synthesizer over the composite)
+# ---------------------------------------------------------------------------
+
+
+def synthesize(path: str, cfg: CardanoMockConfig, n_slots: int, chunk_size: int = 500):
+    """Forge a chain crossing both era boundaries; returns block count."""
+    from . import byron_mock
+
+    cm = CardanoMock(cfg)
+    os.makedirs(path, exist_ok=True)
+    imm = ImmutableDB(os.path.join(path, "immutable"), chunk_size=chunk_size)
+    if not imm.is_empty:
+        raise RuntimeError(f"refusing to forge into non-empty DB at {path}")
+
+    st = cm.hf.initial_state()
+    prev: bytes | None = None
+    block_no = 0
+    n_blocks = 0
+    for slot in range(n_slots):
+        era = cm.hf.era_of_slot(slot)
+        ticked = cm.hf.tick(cm.view_for_era(era), slot, st)
+        if era == 0:
+            j = slot % cfg.n_delegs
+            blk = byron_mock.forge_block(
+                cm.delegs[j].cold_seed,
+                slot=slot, block_no=block_no, prev_hash=prev,
+                txs=(b"byron-tx-%d" % slot,),
+            )
+        else:
+            params = cm.tpraos_params if era == 1 else cm.praos_params
+            eta0 = ticked.inner.state.epoch_nonce
+            if era == 1:
+                a = tpraos.overlay_slot_assignment(
+                    cm.tpraos_params, cfg.n_delegs, slot
+                )
+                if a is not None:
+                    active, j = a
+                    if not active:
+                        continue  # inactive overlay slot stays empty
+                    creds = cm.delegs[j]
+                else:
+                    creds = cm.pools[0]
+                inner_params = cm.tpraos_params.praos
+            else:
+                creds = cm.pools[0]
+                inner_params = cm.praos_params
+            blk = praos_forge.forge_block(
+                inner_params, creds,
+                slot=slot, block_no=block_no, prev_hash=prev,
+                epoch_nonce=eta0, txs=(b"tx-%d" % slot,),
+            )
+        hfb = HardForkBlock(era, blk)
+        imm.append_block(slot, block_no, hfb.hash_, hfb.bytes_)
+        st = cm.hf.reupdate(blk.header.to_view(), slot, ticked)
+        prev = hfb.hash_
+        block_no += 1
+        n_blocks += 1
+    imm.flush()
+    return n_blocks
+
+
+# ---------------------------------------------------------------------------
+# Revalidation (db-analyser --only-validation over the composite)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MixedResult:
+    n_blocks: int = 0
+    n_valid: int = 0
+    error: Exception | None = None
+    final_state: object | None = None
+    per_era: dict | None = None
+
+
+def _bucket_pad(items, fill):
+    n = pbatch.bucket_size(len(items))
+    return items + [fill] * (n - len(items)), len(items)
+
+
+def _validate_pbft_segment(proto: PBftProtocol, headers, st, backend: str):
+    """Byron segment: signatures batched (device Ed25519 kernel or the
+    native C++ verifier), delegate-membership + window threshold folded
+    sequentially on host — the exact PBft rule order (Protocol/PBFT.hs
+    :284: delegate check, signature, threshold)."""
+    views = [h.to_view() for h in headers]
+    if backend == "host":
+        for i, (h, view) in enumerate(zip(headers, views)):
+            try:
+                st = proto.update(view, h.slot, proto.tick(None, h.slot, st))
+            except Exception as e:
+                return st, i, e
+        return st, len(views), None
+
+    if backend == "native":
+        from .. import native_loader as nl
+
+        sig_ok = [
+            nl.native_ed25519_verify(
+                v.issuer_vk, v.signature, v.signed_bytes
+            )
+            for v in views
+        ]
+    else:
+        padded, n = _bucket_pad(views, views[0])
+        ok = ed25519_batch.verify_batch(
+            [v.issuer_vk for v in padded],
+            [v.signature for v in padded],
+            [v.signed_bytes for v in padded],
+        )
+        sig_ok = list(ok[:n])
+    for i, (h, view) in enumerate(zip(headers, views)):
+        try:
+            st = proto.apply_checked_sig(st, h.slot, view.issuer_vk, sig_ok[i])
+        except Exception as e:
+            return st, i, e
+    return st, len(views), None
+
+
+def revalidate(path: str, cfg: CardanoMockConfig, backend: str = "device") -> MixedResult:
+    """Full mixed-era revalidation (config 5: Cardano/CanHardFork.hs:273
+    semantics): decode era-tagged blocks, walk the telescope, validate
+    each era segment with its protocol — Praos-class eras through the
+    batched backend."""
+    cm = CardanoMock(cfg)
+    imm = ImmutableDB(os.path.join(path, "immutable"))
+    res = MixedResult(per_era={})
+
+    blocks = [decode_block(raw, cm.decoders) for _e, raw in imm.stream_all()]
+    res.n_blocks = len(blocks)
+    st = cm.hf.initial_state()
+    i = 0
+    while i < len(blocks):
+        era = blocks[i].era
+        j = i
+        while j < len(blocks) and blocks[j].era == era:
+            j += 1
+        seg = blocks[i:j]
+        # walk the telescope into this era (translations)
+        st = cm.hf._cross_eras(st, era)
+        proto = cm.eras[era].protocol
+        if era == 0:
+            inner, n_ok, err = _validate_pbft_segment(
+                proto, [b.header for b in seg], st.inner, backend
+            )
+            st = replace(st, inner=inner)
+        else:
+            params = cm.tpraos_params if era == 1 else cm.praos_params
+            lview = cm.view_for_era(era)
+            inner = st.inner
+            n_ok = 0
+            err = None
+            # epoch-segmented batches inside the era segment
+            s0 = 0
+            hvs = [b.header.to_view() for b in seg]
+            inner_backend = "host-fold" if backend == "host" else backend
+            while s0 < len(hvs):
+                s1 = s0
+                ep = params.epoch_of(hvs[s0].slot)
+                while s1 < len(hvs) and params.epoch_of(hvs[s1].slot) == ep:
+                    s1 += 1
+                ticked = proto.tick(lview, hvs[s0].slot, inner)
+                b = proto.validate_batch(
+                    ticked, hvs[s0:s1], backend=inner_backend
+                )
+                inner = b.state
+                n_ok += b.n_valid
+                if b.error is not None:
+                    err = b.error
+                    break
+                s0 = s1
+            st = replace(st, inner=inner)
+        res.n_valid += n_ok
+        res.per_era[cm.eras[era].name] = res.per_era.get(cm.eras[era].name, 0) + n_ok
+        if err is not None:
+            res.error = err
+            break
+        i = j
+    res.final_state = st
+    return res

@@ -537,8 +537,14 @@ def _epilogue(
     pre: HostChecks,
     v: Verdicts,
     collect_states: bool = False,
+    lane_error=None,
 ) -> BatchResult:
-    """Sequential epilogue: counters + nonce fold, stop at first failure."""
+    """Sequential epilogue: counters + nonce fold, stop at first failure.
+
+    `lane_error` defaults to the Praos `_lane_error`; TPraos passes an
+    overlay-aware variant (protocol/tpraos.py)."""
+    if lane_error is None:
+        lane_error = _lane_error
     lview = ticked.ledger_view
     eta0 = ticked.state.epoch_nonce
     st = ticked.state
@@ -549,7 +555,7 @@ def _epilogue(
     last_slot = st.last_slot
     states_out: list | None = [] if collect_states else None
     for i, hv in enumerate(hvs):
-        err = _lane_error(params, lview, eta0, hv, pre, v, i, counters)
+        err = lane_error(params, lview, eta0, hv, pre, v, i, counters)
         if err is not None:
             state = PraosState(
                 last_slot=last_slot,

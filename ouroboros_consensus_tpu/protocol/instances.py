@@ -58,10 +58,38 @@ class PraosProtocol:
         return select.compare_select_views(ours, theirs)
 
     def validate_batch(
-        self, ticked, views: Sequence, collect_states: bool = False
+        self, ticked, views: Sequence, collect_states: bool = False,
+        backend: str | None = None,
     ) -> pbatch.BatchResult:
-        """Batched fold of `update` with fused device crypto."""
-        return pbatch.validate_batch(self.params, ticked, views, collect_states)
+        """Batched fold of `update`: fused device crypto ("device"),
+        the C++ verifier ("native"), or a sequential pure fold
+        ("host-fold" — also the use_device_batch=False default)."""
+        if backend is None:
+            backend = "device" if self.use_device_batch else "host-fold"
+        if backend == "host-fold":
+            return self._host_fold(ticked, views, collect_states)
+        return pbatch.validate_batch(
+            self.params, ticked, views, collect_states, backend=backend
+        )
+
+    def _host_fold(self, ticked, hvs, collect_states):
+        """Sequential fold from an ALREADY-ticked state: the first header
+        must not be ticked again (a second tick at an epoch boundary
+        would rotate the nonce twice); later headers share the epoch, so
+        their ticks are no-ops by construction."""
+        st = ticked.state
+        states = [] if collect_states else None
+        t = ticked
+        for i, hv in enumerate(hvs):
+            if i > 0:
+                t = praos.tick(self.params, ticked.ledger_view, hv.slot, st)
+            try:
+                st = praos.update(self.params, hv, hv.slot, t, self.crypto)
+            except praos.PraosValidationError as e:
+                return pbatch.BatchResult(st, i, e, states)
+            if states is not None:
+                states.append(st)
+        return pbatch.BatchResult(st, len(hvs), None, states)
 
 
 # ---------------------------------------------------------------------------
@@ -216,14 +244,17 @@ class PBftProtocol:
         signers = (st.signers + (signer,))[-self.params.window :]
         return PBftState(signers)
 
-    def update(self, view: PBftView, slot, ticked) -> PBftState:
-        st = ticked.state
-        signer = self._index.get(view.issuer_vk)
+    def apply_checked_sig(
+        self, st: PBftState, slot: int, issuer_vk: bytes, sig_ok: bool
+    ) -> PBftState:
+        """The non-crypto PBft rules given a signature verdict: delegate
+        membership, then signature, then the window threshold — shared
+        by the sequential `update` and the batched byron path
+        (hardfork/composite.py) so the rule can never de-synchronize."""
+        signer = self._index.get(issuer_vk)
         if signer is None:
-            raise PBftNotGenesisDelegate(slot, view.issuer_vk)
-        if not host_ed25519.verify(
-            view.issuer_vk, view.signed_bytes, view.signature
-        ):
+            raise PBftNotGenesisDelegate(slot, issuer_vk)
+        if not sig_ok:
             raise PBftInvalidSignature(slot)
         # threshold check over the window INCLUDING this block
         window = st.signers[-(self.params.window - 1) :] if self.params.window > 1 else ()
@@ -232,6 +263,12 @@ class PBftProtocol:
         if signed > allowed:
             raise PBftExceededSignThreshold(slot, signer, signed, allowed)
         return self._append_signer(st, signer)
+
+    def update(self, view: PBftView, slot, ticked) -> PBftState:
+        sig_ok = host_ed25519.verify(
+            view.issuer_vk, view.signed_bytes, view.signature
+        )
+        return self.apply_checked_sig(ticked.state, slot, view.issuer_vk, sig_ok)
 
     def reupdate(self, view: PBftView, slot, ticked) -> PBftState:
         return self._append_signer(ticked.state, self._index[view.issuer_vk])

@@ -1,0 +1,447 @@
+"""TPraos: Transitional Praos — the Shelley-era protocol with the BFT
+overlay schedule.
+
+Reference: `ouroboros-consensus-protocol/src/.../Protocol/TPraos.hs`
+(ConsensusProtocol instance :304-392). The reference delegates header
+validation to the ledger package's PRTCL/OVERLAY STS rules
+(`SL.updateChainDepState`, TPraos.hs:380); this module implements those
+semantics directly against the same batched crypto backend the Praos
+instance uses — the crypto hot path (OCert Ed25519, CompactSum KES,
+ECVRF — Praos.hs:543,580,582) is IDENTICAL, only the leader rule
+changes:
+
+  * a fraction `d` (decentralization) of each epoch's slots form the
+    OVERLAY schedule (Shelley `overlaySchedule`): position j of slot i
+    advances when ceil((i+1)·d) crosses ceil(i·d);
+  * every ascInv = ceil(1/f)-th overlay position is ACTIVE and assigned
+    round-robin to a genesis delegate — that delegate must issue the
+    block, with full VRF/KES/OCert checks but NO stake threshold
+    (`pbftVrfChecks` vs `praosVrfChecks` in PRTCL);
+  * other overlay positions are inactive: any block there is invalid;
+  * non-overlay slots follow the ordinary Praos lottery.
+
+`translate_state` is the TPraos→Praos ChainDepState translation the HFC
+applies at the era boundary (Protocol/Praos/Translate.hs:1-101): the
+nonces and operational-certificate counters carry over unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from fractions import Fraction
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from . import batch as pbatch
+from . import nonces, praos, select
+from .leader import check_leader_value
+from .praos import (
+    CryptoVerifier,
+    HOST_VERIFIER,
+    PraosParams,
+    PraosState,
+    PraosValidationError,
+)
+from .views import HeaderView, LedgerView, hash_key, hash_vrf_vk
+
+
+# ---------------------------------------------------------------------------
+# Parameters / state / views
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class GenDeleg:
+    """One genesis delegate (SL.GenDelegPair): the operational cold key
+    and registered VRF key hash the overlay check matches against."""
+
+    vk_cold: bytes
+    vrf_key_hash: bytes
+
+
+@dataclass(frozen=True)
+class TPraosParams:
+    """PraosParams + decentralization (TPraos.hs TPraosParams; `d` lives
+    in the protocol parameters on-chain, here static per era)."""
+
+    praos: PraosParams
+    decentralization: Fraction  # d in [0, 1]; 0 = fully decentralized
+
+    def __getattr__(self, name):
+        return getattr(self.praos, name)
+
+
+@dataclass(frozen=True)
+class TPraosLedgerView(LedgerView):
+    """LedgerView + the ordered genesis delegation map (SL.LedgerView
+    lvGenDelegs)."""
+
+    gen_delegs: Sequence[GenDeleg] = ()
+
+
+@dataclass(frozen=True)
+class TPraosState(PraosState):
+    """ChainDepState (TPraos c) — the PRTCL state: same nonce/counter
+    content as Praos (TPraos.hs:219, SL.ChainDepState)."""
+
+
+@dataclass(frozen=True)
+class TickedTPraosState:
+    state: TPraosState
+    ledger_view: TPraosLedgerView
+
+
+# ---------------------------------------------------------------------------
+# Overlay schedule (Shelley overlaySchedule / lookupInOverlaySchedule)
+# ---------------------------------------------------------------------------
+
+
+def _asc_inv(f: Fraction) -> int:
+    return max(1, math.ceil(1 / f))
+
+
+def overlay_position(params: TPraosParams, slot: int) -> int | None:
+    """None if `slot` is not an overlay slot, else its overlay position
+    within the epoch (isOverlaySlot: the ceil(i*d) step function
+    advances exactly on overlay slots)."""
+    d = params.decentralization
+    if d == 0:
+        return None
+    i = slot - params.praos.first_slot_of(params.praos.epoch_of(slot))
+    lo = math.ceil(i * d)
+    hi = math.ceil((i + 1) * d)
+    return lo if hi > lo else None
+
+
+def overlay_slot_assignment(
+    params: TPraosParams, n_delegs: int, slot: int
+) -> tuple[bool, int | None] | None:
+    """None = not an overlay slot; (False, None) = inactive overlay slot
+    (must be empty); (True, j) = active, assigned to delegate j."""
+    pos = overlay_position(params, slot)
+    if pos is None:
+        return None
+    ai = _asc_inv(params.praos.active_slot_coeff)
+    if pos % ai != 0 or n_delegs == 0:
+        # no delegates registered: no overlay slot can ever be led
+        return (False, None)
+    return (True, (pos // ai) % n_delegs)
+
+
+# ---------------------------------------------------------------------------
+# Errors beyond the shared Praos taxonomy
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class WrongGenesisDelegate(PraosValidationError):
+    """An overlay block issued by someone other than the scheduled
+    genesis delegate (OVERLAY WrongGenesisVRFKeyOVERLAY/NotPraosLeader)."""
+
+    slot: int
+    expected: bytes
+    got: bytes
+
+
+@dataclass
+class NonActiveSlot(PraosValidationError):
+    """A block in an inactive overlay slot (OVERLAY NonActiveSlotOVERLAY)."""
+
+    slot: int
+
+
+@dataclass
+class WrongGenesisVRFKey(PraosValidationError):
+    slot: int
+    expected: bytes
+    got: bytes
+
+
+# ---------------------------------------------------------------------------
+# tick / update / reupdate (host semantics)
+# ---------------------------------------------------------------------------
+
+
+def tick(
+    params: TPraosParams, lview: TPraosLedgerView, slot: int, state: TPraosState
+) -> TickedTPraosState:
+    inner = praos.tick(params.praos, lview, slot, state)
+    return TickedTPraosState(
+        TPraosState(**vars(inner.state)), inner.ledger_view
+    )
+
+
+def _overlay_error(
+    params: TPraosParams, lview: TPraosLedgerView, hv: HeaderView
+) -> PraosValidationError | None:
+    """The overlay-side replacement of the Praos pool lookup + threshold
+    (lookupInOverlaySchedule + pbftVrfChecks). None when `hv.slot` is a
+    non-overlay slot (caller falls through to the Praos rules)."""
+    assign = overlay_slot_assignment(params, len(lview.gen_delegs), hv.slot)
+    if assign is None:
+        return None
+    active, j = assign
+    if not active:
+        return NonActiveSlot(hv.slot)
+    deleg = lview.gen_delegs[j]
+    if hv.vk_cold != deleg.vk_cold:
+        return WrongGenesisDelegate(hv.slot, deleg.vk_cold, hv.vk_cold)
+    got_hash = hash_vrf_vk(hv.vrf_vk)
+    if got_hash != deleg.vrf_key_hash:
+        return WrongGenesisVRFKey(hv.slot, deleg.vrf_key_hash, got_hash)
+    return False  # sentinel: overlay slot, delegate checks passed
+
+
+def _validate_vrf_overlay_aware(
+    params: TPraosParams,
+    lview: TPraosLedgerView,
+    epoch_nonce,
+    hv: HeaderView,
+    crypto: CryptoVerifier,
+) -> None:
+    err = _overlay_error(params, lview, hv)
+    if err:  # a real error (False sentinel = overlay ok)
+        raise err
+    alpha = nonces.mk_input_vrf(hv.slot, epoch_nonce)
+    if err is False:
+        # active overlay slot: VRF proof verified, threshold skipped
+        if not crypto.verify_vrf(hv.vrf_vk, hv.vrf_proof, alpha, hv.vrf_output):
+            raise praos.VRFKeyBadProof(hv.slot, epoch_nonce)
+        return
+    # non-overlay slot: the ordinary Praos rules (pool lookup included)
+    praos.validate_vrf_signature(
+        epoch_nonce, lview, params.praos.active_slot_coeff, hv, crypto
+    )
+
+
+def _counters_known(lview: TPraosLedgerView, hk: bytes) -> bool:
+    if hk in lview.pool_distr:
+        return True
+    return any(hash_key(d.vk_cold) == hk for d in lview.gen_delegs)
+
+
+def update(
+    params: TPraosParams,
+    hv: HeaderView,
+    slot: int,
+    ticked: TickedTPraosState,
+    crypto: CryptoVerifier = HOST_VERIFIER,
+) -> TPraosState:
+    """updateChainDepState (TPraos.hs:380 → PRTCL): KES/OCert checks
+    shared with Praos, then the overlay-aware VRF section."""
+    cs = ticked.state
+    lview = ticked.ledger_view
+    # validate_kes_signature consults pool_distr for counter defaults;
+    # genesis delegates also have counters (their ocerts), so fall back
+    oc = hv.ocert
+    hk = hash_key(hv.vk_cold)
+    try:
+        praos.validate_kes_signature(
+            params.praos, lview, cs.ocert_counters, hv, crypto
+        )
+    except praos.NoCounterForKeyHashOCERT:
+        if not _counters_known(lview, hk):
+            raise
+        # genesis delegate with no prior counter: m = 0 (same rule the
+        # pool branch applies, Praos.hs:585-590)
+        m = 0
+        n = oc.counter
+        if not m <= n:
+            raise praos.CounterTooSmallOCERT(m, n)
+        if not n <= m + 1:
+            raise praos.CounterOverIncrementedOCERT(m, n)
+    _validate_vrf_overlay_aware(params, lview, cs.epoch_nonce, hv, crypto)
+    return reupdate(params, hv, slot, ticked)
+
+
+def reupdate(
+    params: TPraosParams, hv: HeaderView, slot: int, ticked: TickedTPraosState
+) -> TPraosState:
+    inner = praos.reupdate(
+        params.praos,
+        hv,
+        slot,
+        praos.TickedPraosState(ticked.state, ticked.ledger_view),
+    )
+    return TPraosState(**vars(inner))
+
+
+def translate_state(state: TPraosState) -> PraosState:
+    """TPraos → Praos ChainDepState translation at the era boundary
+    (Protocol/Praos/Translate.hs): nonces and ocert counters carry
+    over unchanged; the overlay schedule simply ceases to exist."""
+    return PraosState(**vars(state))
+
+
+# ---------------------------------------------------------------------------
+# Forging (checkIsLeader, TPraos.hs:304-355)
+# ---------------------------------------------------------------------------
+
+
+def check_is_leader(
+    params: TPraosParams,
+    can_be_leader: praos.PraosCanBeLeader,
+    slot: int,
+    ticked: TickedTPraosState,
+    deleg_index: int | None = None,
+) -> praos.PraosIsLeader | None:
+    """Overlay slots: lead iff we are the scheduled delegate (the VRF is
+    still evaluated — headers always certify the nonce contribution);
+    non-overlay: the Praos lottery."""
+    from ..ops.host import ecvrf as host_ecvrf
+
+    lview = ticked.ledger_view
+    assign = overlay_slot_assignment(params, len(lview.gen_delegs), slot)
+    eta0 = ticked.state.epoch_nonce
+    if assign is not None:
+        active, j = assign
+        if not active or deleg_index is None or j != deleg_index:
+            return None
+        alpha = nonces.mk_input_vrf(slot, eta0)
+        proof = host_ecvrf.prove(can_be_leader.vrf_sign_seed, alpha)
+        return praos.PraosIsLeader(host_ecvrf.proof_to_hash(proof), proof)
+    inner_ticked = praos.TickedPraosState(ticked.state, lview)
+    return praos.check_is_leader(params.praos, can_be_leader, slot, inner_ticked)
+
+
+# ---------------------------------------------------------------------------
+# Batched validation (device): same kernel, overlay-aware staging
+# ---------------------------------------------------------------------------
+
+
+def host_prechecks(
+    params: TPraosParams, lview: TPraosLedgerView, hvs: Sequence[HeaderView]
+) -> pbatch.HostChecks:
+    """TPraos variant of pbatch.host_prechecks: overlay slots route the
+    VRF-side check through the delegate assignment instead of the pool
+    lookup."""
+    base = pbatch.host_prechecks(params.praos, lview, hvs)
+    vrf_errors = list(base.vrf_lookup_errors)
+    for i, hv in enumerate(hvs):
+        err = _overlay_error(params, lview, hv)
+        if err is None:
+            continue  # non-overlay: keep the pool-lookup result
+        vrf_errors[i] = err if err else None  # False sentinel -> no error
+    return pbatch.HostChecks(
+        base.kes_window_errors, vrf_errors, base.kes_evolution
+    )
+
+
+class TPraosProtocol:
+    """ConsensusProtocol (TPraos c) instance-as-object (TPraos.hs:304)."""
+
+    def __init__(
+        self,
+        params: TPraosParams,
+        crypto: CryptoVerifier = HOST_VERIFIER,
+        use_device_batch: bool = True,
+    ):
+        self.params = params
+        self.crypto = crypto
+        self.security_param = params.praos.security_param
+        self.use_device_batch = use_device_batch
+
+    def initial_state(self) -> TPraosState:
+        return TPraosState()
+
+    def tick(self, ledger_view, slot, state) -> TickedTPraosState:
+        return tick(self.params, ledger_view, slot, state)
+
+    def update(self, view, slot, ticked) -> TPraosState:
+        return update(self.params, view, slot, ticked, self.crypto)
+
+    def reupdate(self, view, slot, ticked) -> TPraosState:
+        return reupdate(self.params, view, slot, ticked)
+
+    def check_is_leader(self, can_be_leader, slot, ticked, deleg_index=None):
+        return check_is_leader(
+            self.params, can_be_leader, slot, ticked, deleg_index
+        )
+
+    def select_view(self, header) -> select.PraosSelectView:
+        # TPraos chain order == Praos chain order (Praos/Common.hs)
+        return select.PraosSelectView.from_header(header)
+
+    def compare_candidates(self, ours, theirs) -> int:
+        return select.compare_select_views(ours, theirs)
+
+    def validate_batch(self, ticked, hvs, collect_states=False, backend=None):
+        """Same fused kernel as Praos; overlay lanes get an always-win
+        threshold (their leader rule was settled by host_prechecks)."""
+        if not hvs:
+            return pbatch.BatchResult(
+                ticked.state, 0, None, [] if collect_states else None
+            )
+        if backend is None:
+            backend = "device" if self.use_device_batch else "host-fold"
+        if backend == "host-fold":
+            return self._host_fold(ticked, hvs, collect_states)
+        params, lview = self.params, ticked.ledger_view
+        eta0 = ticked.state.epoch_nonce
+        pre = host_prechecks(params, lview, hvs)
+        overlay = [
+            overlay_position(params, hv.slot) is not None for hv in hvs
+        ]
+        if backend == "native":
+            v = pbatch.run_batch_native(params.praos, lview, eta0, hvs, pre)
+        else:
+            batch = pbatch.stage(params.praos, lview, eta0, hvs, pre.kes_evolution)
+            v = pbatch.run_batch(batch)
+        # overlay lanes: the leader rule was settled by host_prechecks —
+        # mask the Praos threshold verdict out (exact, not probabilistic)
+        v = self._override_overlay_leader(v, overlay)
+        inner_ticked = praos.TickedPraosState(
+            PraosState(**vars(ticked.state)), lview
+        )
+        res = pbatch._epilogue(
+            params.praos, inner_ticked, hvs, pre, v, collect_states,
+            lane_error=self._lane_error,
+        )
+        states = res.states
+        if states is not None:
+            states = [TPraosState(**vars(s)) for s in states]
+        return replace(
+            res, state=TPraosState(**vars(res.state)), states=states
+        )
+
+    def _lane_error(self, params, lview, eta0, hv, pre, v, i, counters):
+        """Praos `_lane_error` with the genesis-delegate counter default
+        (a delegate with no prior counter starts at m = 0, like pools)."""
+        err = pbatch._lane_error(params, lview, eta0, hv, pre, v, i, counters)
+        if isinstance(err, praos.NoCounterForKeyHashOCERT):
+            hk = hash_key(hv.vk_cold)
+            if _counters_known(lview, hk):
+                return pbatch._lane_error(
+                    params, lview, eta0, hv, pre, v, i, {**counters, hk: 0}
+                )
+        return err
+
+    def _host_fold(self, ticked, hvs, collect_states):
+        """Sequential fold from an ALREADY-ticked state: the first
+        header must not be ticked again (a second tick at an epoch
+        boundary would rotate the nonce twice); later headers share the
+        epoch, so their ticks are no-ops by construction."""
+        st = ticked.state
+        states = [] if collect_states else None
+        t = ticked
+        for i, hv in enumerate(hvs):
+            if i > 0:
+                t = tick(self.params, ticked.ledger_view, hv.slot, st)
+            try:
+                st = update(self.params, hv, hv.slot, t, self.crypto)
+            except PraosValidationError as e:
+                return pbatch.BatchResult(st, i, e, states)
+            if states is not None:
+                states.append(st)
+        return pbatch.BatchResult(st, len(hvs), None, states)
+
+    def _override_overlay_leader(self, v, overlay_lanes):
+        ok_leader = np.array(v.ok_leader, copy=True)
+        ambiguous = np.array(v.leader_ambiguous, copy=True)
+        for i, is_overlay in enumerate(overlay_lanes):
+            if is_overlay:
+                ok_leader[i] = True
+                ambiguous[i] = False
+        return v._replace(ok_leader=ok_leader, leader_ambiguous=ambiguous)
