@@ -1016,3 +1016,178 @@ extern "C" int oc_dsmul_test(const u8 a[32], const u8 penc[32], const u8 b[32],
     ge_tobytes(out, &r);
     return 1;
 }
+
+// ===========================================================================
+// Sign side: Ed25519 sign + ECVRF prove — mirrors ops/host/{ed25519,ecvrf}.py
+// (deterministic; byte-identical to the Python reference signers). Used by
+// db_synthesizer / fixtures so benchmark chains forge at C speed.
+// ===========================================================================
+
+// s_out = (r + c*a) mod L ; all scalars 32-byte LE
+static void sc_muladd(u8 s_out[32], const u8 c[32], const u8 a[32],
+                      const u8 r[32]) {
+    // 512-bit product c*a in 64 LE bytes, + r
+    u8 buf[64] = {0};
+    uint32_t prod[16] = {0};
+    for (int i = 0; i < 8; i++) {
+        u64 ci = ((u64)c[4 * i]) | ((u64)c[4 * i + 1] << 8) |
+                 ((u64)c[4 * i + 2] << 16) | ((u64)c[4 * i + 3] << 24);
+        u64 carry = 0;
+        for (int j = 0; j < 8; j++) {
+            u64 aj = ((u64)a[4 * j]) | ((u64)a[4 * j + 1] << 8) |
+                     ((u64)a[4 * j + 2] << 16) | ((u64)a[4 * j + 3] << 24);
+            unsigned __int128 t = (unsigned __int128)ci * aj + prod[i + j] + carry;
+            prod[i + j] = (uint32_t)t;
+            carry = (u64)(t >> 32);
+        }
+        int k = i + 8;
+        while (carry && k < 16) {
+            u64 t = (u64)prod[k] + (carry & 0xFFFFFFFFu);
+            prod[k] = (uint32_t)t;
+            carry = (carry >> 32) + (t >> 32);
+            k++;
+        }
+    }
+    for (int i = 0; i < 16; i++) {
+        buf[4 * i] = (u8)prod[i];
+        buf[4 * i + 1] = (u8)(prod[i] >> 8);
+        buf[4 * i + 2] = (u8)(prod[i] >> 16);
+        buf[4 * i + 3] = (u8)(prod[i] >> 24);
+    }
+    // + r with carry
+    uint32_t carry2 = 0;
+    for (int i = 0; i < 32; i++) {
+        uint32_t t = (uint32_t)buf[i] + r[i] + carry2;
+        buf[i] = (u8)t;
+        carry2 = t >> 8;
+    }
+    for (int i = 32; i < 64 && carry2; i++) {
+        uint32_t t = (uint32_t)buf[i] + carry2;
+        buf[i] = (u8)t;
+        carry2 = t >> 8;
+    }
+    sc_reduce(s_out, buf, 64);
+}
+
+static void clamp_scalar(u8 a[32]) {
+    a[0] &= 248;
+    a[31] &= 127;
+    a[31] |= 64;
+}
+
+extern "C" void oc_ed25519_public(const u8 seed[32], u8 pk[32]) {
+    init_consts();
+    u8 h[64];
+    sha512(seed, 32, h);
+    clamp_scalar(h);
+    ge A;
+    ge_scalarmult(&A, h, &GE_B);
+    ge_tobytes(pk, &A);
+}
+
+extern "C" void oc_ed25519_sign(const u8 seed[32], const u8* msg, size_t len,
+                                u8 sig[64]) {
+    init_consts();
+    u8 h[64];
+    sha512(seed, 32, h);
+    u8 a[32];
+    memcpy(a, h, 32);
+    clamp_scalar(a);
+    ge A;
+    ge_scalarmult(&A, a, &GE_B);
+    u8 aenc[32];
+    ge_tobytes(aenc, &A);
+    // r = SHA512(prefix || msg) mod L
+    Sha512 hr;
+    hr.init();
+    hr.update(h + 32, 32);
+    hr.update(msg, len);
+    u8 rd[64];
+    hr.final(rd);
+    u8 r[32];
+    sc_reduce(r, rd, 64);
+    ge R;
+    ge_scalarmult(&R, r, &GE_B);
+    ge_tobytes(sig, &R);
+    // k = SHA512(R || A || msg) mod L ; s = (r + k*a) mod L
+    Sha512 hk;
+    hk.init();
+    hk.update(sig, 32);
+    hk.update(aenc, 32);
+    hk.update(msg, len);
+    u8 kd[64];
+    hk.final(kd);
+    u8 k[32];
+    sc_reduce(k, kd, 64);
+    sc_muladd(sig + 32, k, a, r);
+}
+
+extern "C" void oc_ecvrf_prove(const u8 seed[32], const u8* alpha, size_t alen,
+                               u8 pi[80]) {
+    init_consts();
+    u8 h[64];
+    sha512(seed, 32, h);
+    u8 x[32];
+    memcpy(x, h, 32);
+    clamp_scalar(x);
+    ge A;
+    ge_scalarmult(&A, x, &GE_B);
+    u8 pk[32];
+    ge_tobytes(pk, &A);
+    ge H;
+    vrf_hash_to_curve(&H, pk, alpha, alen);
+    u8 henc[32];
+    ge_tobytes(henc, &H);
+    ge Gamma;
+    ge_scalarmult(&Gamma, x, &H);
+    // nonce k = SHA512(prefix || H_enc) mod L (draft-03 5.4.2.2)
+    Sha512 hn;
+    hn.init();
+    hn.update(h + 32, 32);
+    hn.update(henc, 32);
+    u8 nd[64];
+    hn.final(nd);
+    u8 k[32];
+    sc_reduce(k, nd, 64);
+    ge U, V;
+    ge_scalarmult(&U, k, &GE_B);
+    ge_scalarmult(&V, k, &H);
+    u8 genc[32], uenc[32], venc[32];
+    ge_tobytes(genc, &Gamma);
+    ge_tobytes(uenc, &U);
+    ge_tobytes(venc, &V);
+    Sha512 ch;
+    ch.init();
+    u8 pre[2] = {VRF_SUITE, 0x02};
+    ch.update(pre, 2);
+    ch.update(henc, 32);
+    ch.update(genc, 32);
+    ch.update(uenc, 32);
+    ch.update(venc, 32);
+    u8 cd[64];
+    ch.final(cd);
+    u8 c32[32] = {0};
+    memcpy(c32, cd, 16);
+    memcpy(pi, genc, 32);
+    memcpy(pi + 32, cd, 16);
+    sc_muladd(pi + 48, c32, x, k);
+}
+
+extern "C" int oc_ecvrf_proof_to_hash(const u8 pi[80], u8 beta[64]) {
+    init_consts();
+    ge Gamma;
+    if (!ge_frombytes(&Gamma, pi)) return 0;
+    ge G8;
+    ge_double(&G8, &Gamma);
+    ge_double(&G8, &G8);
+    ge_double(&G8, &G8);
+    u8 g8enc[32];
+    ge_tobytes(g8enc, &G8);
+    Sha512 bh;
+    bh.init();
+    u8 pre3[2] = {VRF_SUITE, 0x03};
+    bh.update(pre3, 2);
+    bh.update(g8enc, 32);
+    bh.final(beta);
+    return 1;
+}

@@ -11,6 +11,7 @@ Used by the forging loop (node/), db_synthesizer (tools/) and tests.
 from __future__ import annotations
 
 from ..ops.host import ecvrf as host_ecvrf
+from ..ops.host import fast
 from ..ops.host import kes as host_kes
 from ..protocol import nonces
 from ..protocol.praos import PraosIsLeader, PraosParams
@@ -21,8 +22,8 @@ from .praos_block import Block, Header, HeaderBody, body_hash
 def evaluate_vrf(pool: PoolCredentials, slot: int, epoch_nonce: nonces.Nonce):
     """VRF.evalCertified at InputVRF(slot, eta0) (Praos.hs:397)."""
     alpha = nonces.mk_input_vrf(slot, epoch_nonce)
-    proof = host_ecvrf.prove(pool.vrf_seed, alpha)
-    return PraosIsLeader(host_ecvrf.proof_to_hash(proof), proof)
+    proof = fast.ecvrf_prove(pool.vrf_seed, alpha)
+    return PraosIsLeader(fast.ecvrf_proof_to_hash(proof), proof)
 
 
 def forge_block(
@@ -37,23 +38,26 @@ def forge_block(
     ocert_counter: int = 0,
     is_leader: PraosIsLeader | None = None,
     protocol_version: tuple[int, int] = (9, 0),
+    hotkey=None,  # protocol.hotkey.HotKey: evolve-and-sign in place
+    ocert=None,  # the issued OCert accompanying `hotkey`
 ) -> Block:
     """Forge a protocol-valid block for `slot` (the caller is responsible
     for having won the slot; db_synthesizer checks check_is_leader first).
 
-    The OCert is issued for the KES period containing `slot` rounded down
-    to the evolution window start, and the KES signature is produced at
-    evolution t = period(slot) - c0, mirroring HotKey evolution
-    (Ledger/HotKey.hs:142).
+    With `hotkey`/`ocert` (the node path, NodeKernel), the evolving key
+    signs at its own evolution and the certificate is used as issued
+    (Ledger/HotKey.hs:142). Without them (synthesizer/test path) a
+    throwaway OCert is issued at the containing evolution-window start
+    and the signature derived statically from the pool's root seed.
     """
     if is_leader is None:
         is_leader = evaluate_vrf(pool, slot, epoch_nonce)
     kp = params.kes_period_of(slot)
-    # issue the ocert at the containing evolution-window start so that
-    # 0 <= t < max_kes_evolutions always holds
-    c0 = max(0, kp - (kp % params.max_kes_evolutions))
-    ocert = pool.make_ocert(ocert_counter, c0)
-    t = kp - c0
+    if ocert is None:
+        # issue the ocert at the containing evolution-window start so
+        # that 0 <= t < max_kes_evolutions always holds
+        c0 = max(0, kp - (kp % params.max_kes_evolutions))
+        ocert = pool.make_ocert(ocert_counter, c0)
     body = HeaderBody(
         block_no=block_no,
         slot=slot,
@@ -67,5 +71,9 @@ def forge_block(
         ocert=ocert,
         protocol_version=protocol_version,
     )
-    kes_sig = host_kes.sign(pool.kes_seed, pool.kes_depth, t, body.signed_bytes)
+    if hotkey is not None:
+        kes_sig = hotkey.sign(kp, body.signed_bytes)
+    else:
+        t = kp - ocert.kes_period
+        kes_sig = host_kes.sign(pool.kes_seed, pool.kes_depth, t, body.signed_bytes)
     return Block(Header(body, kes_sig), tuple(txs))

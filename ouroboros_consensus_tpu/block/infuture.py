@@ -1,0 +1,47 @@
+"""CheckInFuture: refuse to select blocks from the future.
+
+Reference: `Ouroboros.Consensus.Fragment.InFuture` — `CheckInFuture m blk`
+(InFuture.hs:45) truncates candidate fragments at the first header whose
+slot onset is ahead of the wallclock, tolerating a configurable
+`ClockSkew` (InFuture.hs:99; `defaultClockSkew` = 5 s). Chain selection
+runs every candidate through this check before comparison, so a peer
+cannot win selection by claiming future slots.
+
+Simplification vs the reference: headers within the skew are ALSO
+deferred here (the reference admits them into a retry queue,
+cdbFutureBlocks, and reprocesses on the next slot tick; callers re-add
+blocks naturally via ChainSync in this framework).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+DEFAULT_CLOCK_SKEW_SECONDS = 5.0  # InFuture.hs:99 defaultClockSkew
+
+
+@dataclass
+class CheckInFuture:
+    """now() is the wallclock source (sim virtual time in tests); slot
+    onset = slot * slot_length relative to the same epoch-0 origin."""
+
+    now: Callable[[], float]
+    slot_length: float = 1.0
+    max_clock_skew: float = DEFAULT_CLOCK_SKEW_SECONDS
+
+    def is_in_future(self, slot: int) -> bool:
+        return slot * self.slot_length > self.now() + self.max_clock_skew
+
+    def truncate(self, blocks: Sequence) -> tuple[list, list]:
+        """(kept prefix, in-future suffix) — a candidate is cut at its
+        FIRST in-future header (InFuture.hs checkInFuture)."""
+        for i, b in enumerate(blocks):
+            if self.is_in_future(b.slot):
+                return list(blocks[:i]), list(blocks[i:])
+        return list(blocks), []
+
+
+def no_check() -> CheckInFuture:
+    """dontCheck (InFuture.hs): for tools replaying historical chains."""
+    return CheckInFuture(now=lambda: float("inf"))

@@ -1,0 +1,45 @@
+"""BlockSupportsMetrics: self-issued detection + node block metrics.
+
+Reference: `Ouroboros.Consensus.Block.SupportsMetrics` —
+`isSelfIssued :: BlockConfig blk -> Header blk -> WhetherSelfIssued`
+(the HFC and era instances dispatch per era), consumed by the node's
+metric reporting (NodeKernel peer metrics; cardano-node maps the
+tracers onto EKG/Prometheus). Here: compare the header's issuer key
+against the node's forging credential, and fold per-adoption counts
+into a `NodeMetrics` record the kernel owns.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+def is_self_issued(header, our_cold_vk: bytes | None) -> bool:
+    """WhetherSelfIssued (SupportsMetrics.hs): did WE forge this block?
+    Blocks without an issuer (mock/BFT-era headers) are never self."""
+    if our_cold_vk is None:
+        return False
+    issuer = getattr(header, "issuer_vk", None)
+    if issuer is None:
+        body = getattr(header, "body", None)
+        issuer = getattr(body, "issuer_vk", None) if body is not None else None
+    return issuer == our_cold_vk
+
+
+@dataclass
+class NodeMetrics:
+    """The kernel's counters (NodeKernel.hs metric reporting analog)."""
+
+    blocks_forged: int = 0
+    blocks_could_not_forge: int = 0
+    blocks_adopted_self: int = 0
+    blocks_adopted_peer: int = 0
+    chain_switches: int = 0
+    slots_led: int = 0
+
+    def note_adopted(self, headers, our_cold_vk: bytes | None) -> None:
+        for h in headers:
+            if is_self_issued(h, our_cold_vk):
+                self.blocks_adopted_self += 1
+            else:
+                self.blocks_adopted_peer += 1

@@ -174,6 +174,34 @@ class HardForkLedger:
         )
         return HFState(ticked.era, inner)
 
+    def inspect(self, old_state: HFState, new_state: HFState) -> list:
+        """InspectLedger for the HFC (Combinator/Ledger.hs
+        inspectHardForkLedger): report era boundary crossings — and
+        delegate to the current era's own inspect when it has one."""
+        from ..ledger.inspect import HardForkEraTransition, inspect_ledger
+
+        events: list = []
+        if new_state.era != old_state.era:
+            events.append(
+                HardForkEraTransition(
+                    message=(
+                        f"era transition: {self.eras[old_state.era].name}"
+                        f" -> {self.eras[new_state.era].name}"
+                    ),
+                    from_era=self.eras[old_state.era].name,
+                    to_era=self.eras[new_state.era].name,
+                )
+            )
+        else:
+            events.extend(
+                inspect_ledger(
+                    self.eras[new_state.era].ledger,
+                    old_state.inner,
+                    new_state.inner,
+                )
+            )
+        return events
+
     def tip_slot(self, state: HFState):
         return self.eras[state.era].ledger.tip_slot(state.inner)
 

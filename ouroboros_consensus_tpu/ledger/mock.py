@@ -96,7 +96,12 @@ class MockLedger:
         return TickedMockState(state, slot)
 
     def apply_tx(self, utxo: dict, tx_bytes: bytes) -> dict:
+        """Validates FULLY before mutating: on failure `utxo` is
+        untouched (atomic-on-failure — the Mempool's fast path applies
+        into its cached view without a defensive copy)."""
         ins, outs = decode_tx(tx_bytes)
+        if len(set(ins)) != len(ins):
+            raise MissingInput(ins[0])  # duplicate input spends
         consumed = 0
         for txin in ins:
             if txin not in utxo:

@@ -79,6 +79,7 @@ class Mempool:
         self._trace = trace
         self._lock = threading.Lock()
         self._txs: list[TxTicket] = []
+        self._size_bytes = 0
         self._next_ticket = 1
         self._anchor_state = None
         self._anchor_slot: int | None = None
@@ -103,13 +104,14 @@ class Mempool:
             except LedgerError:
                 dropped.append(t)
         self._txs = kept
+        self._size_bytes = sum(t.size for t in kept)
         self._cached_utxo = utxo
         if dropped:
             self._trace(f"mempool: dropped {len(dropped)} txs on sync")
         return dropped
 
     def _size_locked(self) -> int:
-        return sum(t.size for t in self._txs)
+        return self._size_bytes
 
     # -- API (Mempool/API.hs:102) -----------------------------------------
 
@@ -122,12 +124,14 @@ class Mempool:
             if self._size_locked() + len(tx) > self.capacity:
                 raise MempoolFull(len(tx), self.capacity)
             # validates and, on success, extends the cached view
-            self._cached_utxo = self._ledger.apply_tx(
-                dict(self._cached_utxo), tx
-            )
+            # in place — apply_tx is atomic-on-failure, so no defensive
+            # copy (the reference folds the same way; a per-tx copy of
+            # the whole UTxO made bulk adds O(n^2))
+            self._cached_utxo = self._ledger.apply_tx(self._cached_utxo, tx)
             t = TxTicket(tx, self._next_ticket, len(tx))
             self._next_ticket += 1
             self._txs.append(t)
+            self._size_bytes += t.size
             return t
 
     def try_add_txs(self, txs: Sequence[bytes]) -> tuple[list[TxTicket], list[bytes]]:
@@ -148,7 +152,7 @@ class Mempool:
         ids = set(tx_ids)
         with self._lock:
             self._txs = [t for t in self._txs if mk_id(t.tx) not in ids]
-            self._sync_locked()
+            self._sync_locked()  # recomputes _size_bytes from the kept set
 
     def sync_with_ledger(self) -> list[TxTicket]:
         """syncWithLedger: called by the node when the chain advances."""

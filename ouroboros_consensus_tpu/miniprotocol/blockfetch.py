@@ -160,4 +160,7 @@ def client(node, peer_name: str, rx, tx, candidate, *, poll_interval: float = 0.
                 yield Wait(p.processed)
             if p.result.selected:
                 node.on_chain_changed()
+                # adoption settles candidate prefixes: the ChainSync
+                # history may now trim down to k (HeaderStateHistory)
+                candidate.trim()
         done += 1

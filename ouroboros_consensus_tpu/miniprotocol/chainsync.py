@@ -32,8 +32,9 @@ from typing import Any
 
 from ..block.abstract import Point
 from ..block.praos_block import Block, Header
+from ..ledger.abstract import OutsideForecastRange
 from ..protocol import praos as praos_mod
-from ..utils.sim import Recv, Send, Sleep
+from ..utils.sim import Recv, Send, Sleep, Wait
 
 K_DEFAULT = 2160
 
@@ -55,6 +56,18 @@ class Candidate:
 
     headers: list = field(default_factory=list)
     states: list = field(default_factory=list)
+    # trim bound (HeaderStateHistory trims to the security parameter k):
+    # a long sync holds O(k) state; rolling back deeper than k fails —
+    # the reference disconnects such peers. None = unbounded (test-only).
+    k: int | None = None
+    trimmed: bool = False  # anchor advanced past the intersection
+    # `settled(point) -> bool`: is that block already adopted on OUR
+    # chain? Only settled headers may be trimmed — dropping a not-yet-
+    # fetched header would orphan BlockFetch's anchor. The candidate
+    # stays bounded anyway: validation cannot outrun the forecast
+    # horizon (~3k/f ahead of our tip), which is what bounds the
+    # reference's fragment too.
+    settled: Any = None
 
     def tip_point(self) -> Point | None:
         return self.headers[-1].point if self.headers else None
@@ -62,15 +75,34 @@ class Candidate:
     def reset(self, base_state) -> None:
         self.headers = []
         self.states = [base_state]
+        self.trimmed = False
 
     def extend(self, header, state) -> None:
         self.headers.append(header)
         self.states.append(state)
+        self.trim()
+
+    def trim(self) -> None:
+        """Advance the anchor while the history exceeds k and its oldest
+        header is settled. Called on extension AND after BlockFetch
+        adopts blocks (settling is what makes trimming safe)."""
+        while self.k is not None and len(self.headers) > self.k:
+            if self.settled is not None and not self.settled(
+                self.headers[0].point
+            ):
+                break
+            del self.headers[0]
+            del self.states[0]
+            self.trimmed = True
 
     def truncate_to(self, point: Point | None) -> bool:
-        """Roll back the suffix to `point` (None = back to the anchor).
-        False if the point is not on the candidate."""
+        """Roll back the suffix to `point` (None = back to the
+        intersection). False if the point is no longer on the candidate
+        — including an intersection rollback after trimming (deeper
+        than k ⇒ disconnect, Client.hs rollback depth check)."""
         if point is None:
+            if self.trimmed:
+                return False
             del self.headers[:]
             del self.states[1:]
             return True
@@ -82,11 +114,21 @@ class Candidate:
         return False
 
 
-def server(chain_db, rx, tx, *, poll_interval: float = 0.05):
+def server(
+    chain_db, rx, tx, *, poll_interval: float = 0.05,
+    include_tentative: bool = True, follower=None,
+):
     """ChainSync server task (Server.hs): answer find_intersect from the
     current chain, then stream follower updates as roll_forward /
-    roll_backward."""
-    follower = chain_db.new_follower()
+    roll_backward. Blocks on the follower's event (the reference blocks
+    in STM on the follower's next instruction) — the Sleep poll is only
+    the fallback when the ChainDB has no runtime to fire events through.
+
+    `include_tentative` serves diffusion pipelining: headers of blocks
+    still being validated stream out early (Impl/Follower.hs tentative
+    followers), retracted by a rollback if validation rejects them."""
+    if follower is None:
+        follower = chain_db.new_follower(include_tentative=include_tentative)
     # pending instructions not yet sent (beyond the intersection)
     pending: list = []
     # lazy stream of the immutable segment between the intersection and
@@ -102,9 +144,10 @@ def server(chain_db, rx, tx, *, poll_interval: float = 0.05):
         msg = yield Recv(rx)
         kind = msg[0]
         if kind == "find_intersect":
-            # drain stale follower updates: everything up to NOW is
-            # covered by the chain snapshot taken below
-            follower.take_updates()
+            # drain stale follower updates (and any pending-tentative
+            # marker): everything up to NOW is covered by the chain
+            # snapshot taken below
+            follower.reset_position()
             points = msg[1]
             ours = {b.point: i for i, b in enumerate(chain_db.current_chain)}
             anchor = chain_db._anchor_point()
@@ -160,10 +203,15 @@ def server(chain_db, rx, tx, *, poll_interval: float = 0.05):
                 pending.extend(follower.take_updates())
                 if pending:
                     break
-                yield Sleep(poll_interval)  # MustReply/await analog
+                if chain_db.runtime is not None:
+                    yield Wait(follower.event)  # blockUntilChanged analog
+                else:
+                    yield Sleep(poll_interval)  # MustReply/await fallback
             op = pending.pop(0)
             if op[0] == "rollback":
                 yield Send(tx, ("roll_backward", op[1], tip()))
+            elif op[0] == "tentative":
+                yield Send(tx, ("roll_forward", op[1].bytes_, tip()))
             else:
                 yield Send(tx, ("roll_forward", op[1].header.bytes_, tip()))
         elif kind == "done":
@@ -180,8 +228,9 @@ def client(
     candidate: Candidate,
     *,
     max_headers: int | None = None,
+    max_in_flight: int = 10,
 ):
-    """ChainSync client task (Client.hs:422).
+    """ChainSync client task (Client.hs:422), message-pipelined.
 
     `node` provides: .protocol (instances.PraosProtocol-shaped),
     .chain_db, .ledger_view_at(slot) — the forecast (bounded-horizon
@@ -189,6 +238,14 @@ def client(
 
     Validates each roll_forward header against the candidate's protocol
     state (full crypto) and extends the candidate; blockfetch drains it.
+
+    Pipelining (`MkPipelineDecision`, Client.hs:422): while the
+    candidate tip is behind the server's announced tip, keep up to
+    `max_in_flight` request_next messages outstanding, collecting
+    responses as they arrive; once caught up, degrade to strict
+    request/response (pipelineDecisionLowHighMark shape). With a
+    per-message channel delay d this turns 2·d per header into d per
+    WINDOW of headers.
     """
     # findIntersect with points of our current chain (newest first —
     # Client.hs:464 uses the standard exponentially-spaced offsets; the
@@ -200,20 +257,49 @@ def client(
     if msg[0] == "intersect_not_found":
         raise ChainSyncClientException(f"{peer_name}: no intersection")
     intersection = msg[1]
+    server_tip = msg[2]
 
     # seed candidate protocol state from OUR state at the intersection
     # (the candidate implicitly shares our chain up to it)
     candidate.reset(node.chain_dep_state_at(intersection))
+    if candidate.k is None:
+        candidate.k = getattr(node.protocol, "security_param", None)
+    if candidate.settled is None:
+        candidate.settled = lambda p: node.chain_db.get_block(p) is not None
 
     n = 0
+    in_flight = 0
     while max_headers is None or n < max_headers:
-        yield Send(tx, ("request_next",))
+        # pipeline decision: how far behind the server's tip are we?
+        tip_pt = candidate.tip_point()
+        behind = server_tip is not None and (
+            tip_pt is None or tip_pt.slot < server_tip.slot
+        )
+        budget = max_in_flight if behind else 1
+        if max_headers is not None:
+            budget = min(budget, max_headers - n)
+        while in_flight < budget:
+            yield Send(tx, ("request_next",))
+            in_flight += 1
         msg = yield Recv(rx)
+        in_flight -= 1
+        server_tip = msg[-1]
         kind = msg[0]
         if kind == "roll_forward":
             header = Header.from_bytes(msg[1])
+            # forecast the ledger view for the header's slot. A header
+            # past OUR forecast horizon is not (yet) validatable: the
+            # reference client BLOCKS in STM until the node's own tip
+            # advances far enough (Client.hs intersection/forecast
+            # retry), it does not disconnect — BlockFetch applying the
+            # already-validated prefix is what extends the horizon.
+            while True:
+                try:
+                    lview = node.ledger_view_at(header.slot)
+                    break
+                except OutsideForecastRange:
+                    yield Sleep(0.05)
             base = candidate.states[-1]
-            lview = node.ledger_view_at(header.slot)
             ticked = node.protocol.tick(lview, header.slot, base)
             try:
                 new_st = node.protocol.update(

@@ -1,0 +1,94 @@
+"""Version negotiation: NetworkProtocolVersion + the handshake exchange.
+
+Reference: `Ouroboros.Consensus.Node.NetworkProtocolVersion` — each block
+type declares its supported `NodeToNodeVersion`s / `NodeToClientVersion`s
+and the codec behavior per version; the network layer's handshake
+protocol picks the highest version both ends support and exchanges
+version data (network magic, diffusion mode — `stdVersionDataNTN`,
+diffusion Node.hs).
+
+Pure negotiation + sim-task client/server; the asyncio transports use
+`negotiate` on their first exchange.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.sim import Recv, Send
+
+# NodeToNodeVersion analog: what each wire version enables. Version
+# gates mirror the reference's capability progression (tx-submission2,
+# peer sharing arriving in later versions).
+NODE_TO_NODE_VERSIONS: dict[int, frozenset] = {
+    1: frozenset({"chainsync", "blockfetch"}),
+    2: frozenset({"chainsync", "blockfetch", "txsubmission2", "keepalive"}),
+    3: frozenset(
+        {"chainsync", "blockfetch", "txsubmission2", "keepalive", "peersharing"}
+    ),
+}
+
+NODE_TO_CLIENT_VERSIONS: dict[int, frozenset] = {
+    1: frozenset({"localstatequery", "localtxsubmission"}),
+    2: frozenset({"localstatequery", "localtxsubmission", "localtxmonitor"}),
+}
+
+
+@dataclass(frozen=True)
+class VersionData:
+    """stdVersionDataNTN: networkMagic guards against cross-net connects
+    (the DbMarker check's wire-level sibling)."""
+
+    network_magic: int
+
+
+class HandshakeRefused(Exception):
+    pass
+
+
+def negotiate(
+    ours: dict[int, VersionData], theirs_proposal: dict[int, VersionData]
+) -> tuple[int, VersionData]:
+    """Highest common version with matching magic, or HandshakeRefused."""
+    common = sorted(set(ours) & set(theirs_proposal), reverse=True)
+    if not common:
+        raise HandshakeRefused(
+            f"no common version: ours {sorted(ours)}, theirs "
+            f"{sorted(theirs_proposal)}"
+        )
+    v = common[0]
+    if ours[v].network_magic != theirs_proposal[v].network_magic:
+        raise HandshakeRefused(
+            f"network magic mismatch at v{v}: "
+            f"{ours[v].network_magic} != {theirs_proposal[v].network_magic}"
+        )
+    return v, ours[v]
+
+
+def client(rx, tx, versions: dict[int, VersionData]):
+    """Propose all our versions; the server picks (handshake initiator)."""
+    yield Send(tx, ("propose_versions", versions))
+    msg = yield Recv(rx)
+    if msg[0] == "refuse":
+        raise HandshakeRefused(msg[1])
+    if msg[0] != "accept_version":
+        raise HandshakeRefused(f"bad handshake reply {msg[0]!r}")
+    version, data = msg[1], msg[2]
+    if version not in versions:
+        raise HandshakeRefused(f"server accepted unknown version {version}")
+    return version, data
+
+
+def server(rx, tx, versions: dict[int, VersionData]):
+    """Accept the highest common version or refuse."""
+    msg = yield Recv(rx)
+    if msg[0] != "propose_versions":
+        yield Send(tx, ("refuse", f"expected propose_versions, got {msg[0]!r}"))
+        raise HandshakeRefused(f"bad first message {msg[0]!r}")
+    try:
+        version, data = negotiate(versions, msg[1])
+    except HandshakeRefused as e:
+        yield Send(tx, ("refuse", str(e)))
+        raise
+    yield Send(tx, ("accept_version", version, data))
+    return version, data

@@ -29,8 +29,32 @@ class QueryError(Exception):
     pass
 
 
-def run_query(node, ext_state, name: str, args):
+class QueryUnsupported(QueryError):
+    """Query requires a newer negotiated NodeToClient version
+    (Ledger/Query.hs queryVersion gating)."""
+
+
+LATEST_QUERY_VERSION = 2
+
+# queryVersion (Ledger/Query.hs): the minimum negotiated version each
+# query needs — older clients cannot name newer queries
+QUERY_MIN_VERSION = {
+    "get_chain_block_no": 1,
+    "get_chain_point": 1,
+    "get_tip_slot": 1,
+    "get_utxo": 1,
+    "get_balance": 1,
+    "get_pool_distr": 2,
+}
+
+
+def run_query(node, ext_state, name: str, args, version: int = LATEST_QUERY_VERSION):
     """The query vocabulary (Ledger/Query.hs + mock ledger queries)."""
+    need = QUERY_MIN_VERSION.get(name)
+    if need is not None and version < need:
+        raise QueryUnsupported(
+            f"query {name!r} needs NodeToClient version {need}, have {version}"
+        )
     ledger_state = ext_state.ledger_state
     hs = ext_state.header_state
     if name == "get_chain_block_no":
@@ -49,8 +73,10 @@ def run_query(node, ext_state, name: str, args):
     raise QueryError(f"unknown query {name!r}")
 
 
-def state_query_server(node, rx, tx):
-    """LocalStateQuery server: acquire/query/release session."""
+def state_query_server(node, rx, tx, version: int = LATEST_QUERY_VERSION):
+    """LocalStateQuery server: acquire/query/release session. `version`
+    is the negotiated NodeToClient version (handshake.py) gating the
+    query vocabulary."""
     acquired = None
     while True:
         msg = yield Recv(rx)
@@ -72,7 +98,7 @@ def state_query_server(node, rx, tx):
                 yield Send(tx, ("failed", "no state acquired"))
                 continue
             try:
-                val = run_query(node, acquired, msg[1], msg[2])
+                val = run_query(node, acquired, msg[1], msg[2], version)
                 yield Send(tx, ("result", val))
             except QueryError as e:
                 yield Send(tx, ("failed", str(e)))

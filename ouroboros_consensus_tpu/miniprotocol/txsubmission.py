@@ -1,0 +1,150 @@
+"""TxSubmission2, KeepAlive, PeerSharing mini-protocols.
+
+Reference: the consensus side of the node-to-node `Apps` bundle
+(ouroboros-consensus-diffusion `Network/NodeToNode.hs:434-466`):
+
+  * **TxSubmission2** diffuses mempool transactions. The protocol is
+    INBOUND-driven (the receiving side asks): the server requests txids
+    from the peer's mempool (blocking when it has consumed everything),
+    acks processed ids, requests the tx bodies it is missing, and adds
+    them to its own mempool — which validates and rejects as the ledger
+    dictates. The outbound side serves from its mempool snapshot in
+    ticket order (Mempool/API.hs getSnapshot; `after(ticket)` is the
+    reference's snapshotTxsAfter).
+  * **KeepAlive** measures round trips and keeps the bearer warm
+    (trivial cookie echo).
+  * **PeerSharing** gossips known peer addresses.
+
+Wire messages (sim/asyncio tuples like chainsync.py):
+  inbound → outbound: ("request_txids", ack, req, blocking)
+                      ("request_txs", [txid, ...])
+                      ("done",)
+  outbound → inbound: ("reply_txids", [(txid, size), ...])
+                      ("reply_txs", [tx_bytes, ...])
+
+  ("keepalive", cookie) / ("keepalive_response", cookie)
+  ("share_peers", amount) / ("peers", [addr, ...])
+"""
+
+from __future__ import annotations
+
+from ..ledger.mock import tx_id
+from ..utils.sim import Recv, Send, Sleep
+
+TXID_WINDOW = 16  # max unacknowledged txids (the reference's window)
+
+
+def outbound(node, rx, tx, *, poll_interval: float = 0.1):
+    """The mempool-serving side (runs at the peer OWNING the txs).
+    Serves txids in ticket order; blocking requests wait until the
+    mempool moves past the last served ticket."""
+    last_ticket = -1
+    unacked: list = []  # (txid, ticket) served but not yet acked
+    while True:
+        msg = yield Recv(rx)
+        kind = msg[0]
+        if kind == "request_txids":
+            _, ack, req, blocking = msg
+            del unacked[:ack]
+            while True:
+                snap = node.mempool.get_snapshot()
+                fresh = list(snap.after(last_ticket))[:req]
+                if fresh or not blocking:
+                    break
+                yield Sleep(poll_interval)  # blocking wait, sim-polled
+            ids = []
+            for t in fresh:
+                ids.append((tx_id(t.tx), t.size))
+                unacked.append((tx_id(t.tx), t.tx))
+                last_ticket = t.number
+            yield Send(tx, ("reply_txids", ids))
+        elif kind == "request_txs":
+            want = set(msg[1])
+            bodies = [body for (i, body) in unacked if i in want]
+            yield Send(tx, ("reply_txs", bodies))
+        elif kind == "done":
+            return
+        else:
+            raise RuntimeError(f"txsubmission outbound: bad message {kind!r}")
+
+
+def inbound(node, peer_name: str, rx, tx, *, max_rounds: int | None = None,
+            window: int = TXID_WINDOW):
+    """The requesting side (runs at the peer RECEIVING the txs): pull
+    txids, pull unknown bodies, feed the local mempool (which validates;
+    invalid txs are dropped, not propagated)."""
+    ack = 0
+    rounds = 0
+    while max_rounds is None or rounds < max_rounds:
+        rounds += 1
+        # blocking request when we have nothing outstanding (protocol
+        # rule: MUST use the blocking variant once fully caught up)
+        yield Send(tx, ("request_txids", ack, window, True))
+        msg = yield Recv(rx)
+        if msg[0] != "reply_txids":
+            raise RuntimeError(f"txsubmission inbound: bad reply {msg[0]!r}")
+        ids = msg[1]
+        if not ids:
+            continue
+        known = {tx_id(t.tx) for t in node.mempool.get_snapshot().txs}
+        missing = [i for (i, _size) in ids if i not in known]
+        if missing:
+            yield Send(tx, ("request_txs", missing))
+            msg = yield Recv(rx)
+            if msg[0] != "reply_txs":
+                raise RuntimeError(f"txsubmission inbound: bad reply {msg[0]!r}")
+            node.mempool.try_add_txs(msg[1])
+        ack = len(ids)
+    yield Send(tx, ("done",))
+
+
+# -- KeepAlive ---------------------------------------------------------------
+
+
+def keepalive_client(rx, tx, *, interval: float = 1.0, rounds: int = 10):
+    """Sends a numbered cookie every `interval`; yields nothing to the
+    caller but records RTTs on itself via the returned list (closure)."""
+    rtts: list[float] = []
+    for cookie in range(rounds):
+        yield Send(tx, ("keepalive", cookie))
+        msg = yield Recv(rx)
+        if msg[0] != "keepalive_response" or msg[1] != cookie:
+            raise RuntimeError(f"keepalive: bad response {msg!r}")
+        rtts.append(1.0)  # sim has no task-local clock; presence = liveness
+        yield Sleep(interval)
+    return rtts
+
+
+def keepalive_server(rx, tx):
+    while True:
+        msg = yield Recv(rx)
+        if msg[0] == "done":
+            return
+        if msg[0] != "keepalive":
+            raise RuntimeError(f"keepalive server: bad message {msg!r}")
+        yield Send(tx, ("keepalive_response", msg[1]))
+
+
+# -- PeerSharing -------------------------------------------------------------
+
+
+def peersharing_client(rx, tx, amount: int):
+    """One-shot: ask for up to `amount` peer addresses."""
+    yield Send(tx, ("share_peers", amount))
+    msg = yield Recv(rx)
+    if msg[0] != "peers":
+        raise RuntimeError(f"peersharing: bad reply {msg!r}")
+    return msg[1]
+
+
+def peersharing_server(node, rx, tx):
+    """Serves the node's known peer addresses (NodeKernel's peer-sharing
+    registry, NodeKernel.hs:88-114)."""
+    while True:
+        msg = yield Recv(rx)
+        if msg[0] == "done":
+            return
+        if msg[0] != "share_peers":
+            raise RuntimeError(f"peersharing server: bad message {msg!r}")
+        peers = list(getattr(node, "known_peers", []))[: msg[1]]
+        yield Send(tx, ("peers", peers))

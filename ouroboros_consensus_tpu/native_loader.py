@@ -122,8 +122,48 @@ def load_crypto():
         [ctypes.c_long] + [ctypes.c_void_p] * 6 + [ctypes.c_long]
         + [ctypes.c_void_p] * 8 + [ctypes.POINTER(ctypes.c_long)]
     )
+    lib.oc_ed25519_public.restype = None
+    lib.oc_ed25519_public.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.oc_ed25519_sign.restype = None
+    lib.oc_ed25519_sign.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+    ]
+    lib.oc_ecvrf_prove.restype = None
+    lib.oc_ecvrf_prove.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+    ]
     _clib = lib
     return _clib
+
+
+def native_ed25519_sign(seed: bytes, msg: bytes) -> bytes | None:
+    """Deterministic RFC 8032 signature via the C library, or None when
+    the library is unavailable (callers fall back to pure Python)."""
+    lib = load_crypto()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(64)
+    lib.oc_ed25519_sign(seed, msg, len(msg), out)
+    return out.raw
+
+
+def native_ed25519_public(seed: bytes) -> bytes | None:
+    lib = load_crypto()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(32)
+    lib.oc_ed25519_public(seed, out)
+    return out.raw
+
+
+def native_ecvrf_prove(seed: bytes, alpha: bytes) -> bytes | None:
+    """Deterministic draft-03 ECVRF proof via the C library, or None."""
+    lib = load_crypto()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(80)
+    lib.oc_ecvrf_prove(seed, alpha, len(alpha), out)
+    return out.raw
 
 
 def native_ed25519_verify(pk: bytes, sig: bytes, msg: bytes) -> bool:

@@ -20,9 +20,11 @@ from typing import Any, Callable
 
 from ..block.abstract import Point
 from ..block.forge import forge_block
+from ..block.metrics import NodeMetrics
 from ..mempool import Mempool
 from ..miniprotocol.chainsync import Candidate
 from ..protocol import praos as praos_mod
+from ..protocol.hotkey import HotKey, KESBeforeStart, KESKeyExpired, issue_ocert
 from ..utils.sim import Sleep
 
 
@@ -53,6 +55,9 @@ class NodeKernel:
         pool=None,  # PoolCredentials when this node forges
         clock: SlotClock | None = None,
         trace: Callable[[str], None] = lambda s: None,
+        hotkey: HotKey | None = None,  # carry an EVOLVED key across a
+        ocert=None,                    # restart (with its certificate)
+        ocert_counter: int = 0,
     ):
         self.name = name
         self.chain_db = chain_db
@@ -62,6 +67,11 @@ class NodeKernel:
         self.clock = clock or SlotClock()
         self.trace = trace
         self.candidates: dict[str, Candidate] = {}  # per-peer
+        self.known_peers: list = []  # PeerSharing registry analog
+        # BlockSupportsMetrics consumer (SupportsMetrics.hs): counts fed
+        # from a dedicated follower on every adoption
+        self.metrics = NodeMetrics()
+        self._metrics_follower = chain_db.new_follower()
         self.mempool = Mempool(
             ledger,
             lambda: (
@@ -71,7 +81,47 @@ class NodeKernel:
                 else None,
             ),
         )
-        self._ocert_counter = 0
+        # forging credentials: an evolving HotKey + its operational
+        # certificate (Ledger/HotKey.hs; ocert counter increments on
+        # every re-issue, checked by Praos.hs:585-605)
+        self._ocert_counter = ocert_counter
+        self.hotkey = hotkey
+        self._ocert = ocert
+        if pool is not None and hotkey is None:
+            # fresh node: derive the hot key from the pool's root seed.
+            # A restart carrying an evolved key passes it in instead —
+            # re-deriving here would resurrect forgotten (forward-secure)
+            # evolutions and waste the 2^depth vk-tree derivation.
+            self._install_hotkey(pool.kes_seed, counter=0, kes_period=0)
+
+    def _install_hotkey(self, kes_seed: bytes, counter: int, kes_period: int):
+        self.hotkey = HotKey(
+            kes_seed,
+            self.pool.kes_depth,
+            kes_period,
+            self.protocol.params.max_kes_evolutions,
+        )
+        self._ocert_counter = counter
+        self._ocert = issue_ocert(
+            self.pool.cold_seed, self.hotkey.vk, counter, kes_period
+        )
+
+    def rekey(self, slot: int, new_kes_seed: bytes | None = None) -> None:
+        """Operational re-keying (ThreadNet/Util/Rekeying.hs analog):
+        forget the old hot key, start a fresh one at `slot`'s KES period,
+        re-issue the ocert with counter+1."""
+        import hashlib
+
+        if self.hotkey is not None:
+            self.hotkey.forget()
+        if new_kes_seed is None:
+            new_kes_seed = hashlib.blake2b(
+                b"rekey" + self.pool.kes_seed + bytes([self._ocert_counter + 1]),
+                digest_size=32,
+            ).digest()
+        kp = self.protocol.params.kes_period_of(slot)
+        self._install_hotkey(new_kes_seed, self._ocert_counter + 1, kp)
+        self.trace(f"{self.name}: rekeyed at slot {slot} (counter {self._ocert_counter})")
 
     # -- hooks used by the miniprotocol clients ---------------------------
 
@@ -119,24 +169,43 @@ class NodeKernel:
         )
         if is_leader is None:
             return None
+        self.metrics.slots_led += 1
         tip = self.chain_db.tip_point()
         block_no = (self.chain_db.tip_block_no() or 0) + 1 if tip else 0
         snap = self.mempool.get_snapshot_for(
             self.ledger.tick(ext.ledger_state, slot).state, slot
         )
-        return forge_block(
-            self.protocol.params,
-            self.pool,
-            slot=slot,
-            block_no=block_no,
-            prev_hash=tip.hash_ if tip else None,
-            epoch_nonce=ticked.state.epoch_nonce,
-            txs=snap.tx_bytes(),
-            ocert_counter=self._ocert_counter,
-            is_leader=is_leader,
-        )
+        try:
+            return forge_block(
+                self.protocol.params,
+                self.pool,
+                slot=slot,
+                block_no=block_no,
+                prev_hash=tip.hash_ if tip else None,
+                epoch_nonce=ticked.state.epoch_nonce,
+                txs=snap.tx_bytes(),
+                is_leader=is_leader,
+                hotkey=self.hotkey,
+                ocert=self._ocert,
+            )
+        except (KESKeyExpired, KESBeforeStart) as e:
+            # checkShouldForge's CannotForge outcome (Block/Forging.hs):
+            # won the slot but the hot key cannot sign — trace, skip
+            self.metrics.blocks_could_not_forge += 1
+            self.trace(f"{self.name}: CannotForge at slot {slot}: {e}")
+            return None
+
+    def _drain_metrics(self) -> None:
+        cold = self.pool.vk_cold if self.pool is not None else None
+        for op in self._metrics_follower.take_updates():
+            if op[0] == "addblock":
+                self.metrics.note_adopted([op[1].header], cold)
+            elif op[0] == "rollback":
+                self.metrics.chain_switches += 1
 
     def _post_adoption(self, block, res) -> None:
+        self.metrics.blocks_forged += 1
+        self._drain_metrics()
         if res.selected:
             self.trace(
                 f"{self.name}: forged+adopted block {block.block_no}@{block.slot}"
@@ -156,19 +225,23 @@ class NodeKernel:
         return block
 
     def _can_be_leader(self):
-        from ..testing.fixtures import can_be_leader
+        return praos_mod.PraosCanBeLeader(
+            ocert=self._ocert,
+            vk_cold=self.pool.vk_cold,
+            vrf_sign_seed=self.pool.vrf_seed,
+        )
 
-        return can_be_leader(self.pool, counter=self._ocert_counter)
-
-    def forging_loop(self, n_slots: int):
+    def forging_loop(self, n_slots: int, start_slot: int = 0):
         """Sim task: wake at every slot start (knownSlotWatcher,
         BlockchainTime/API.hs:59) and attempt to forge. Forged blocks go
         through the add-block queue like everyone else's
         (NodeKernel.hs:402 addBlockAsync + adoption wait), so a
-        self-forged block never jumps ahead of enqueued peer blocks."""
+        self-forged block never jumps ahead of enqueued peer blocks.
+        `start_slot` supports ThreadNet join plans / restarts — the
+        caller aligns the spawn time with that slot's start."""
         from ..utils.sim import Wait
 
-        for slot in range(n_slots):
+        for slot in range(start_slot, n_slots):
             # forge at the START of slot `slot` (virtual time
             # slot*slot_length), then sleep the slot out — forging after
             # the sleep would shift every block one slot late vs the clock
@@ -182,4 +255,5 @@ class NodeKernel:
 
     def on_chain_changed(self):
         """Post-adoption bookkeeping shared by fetch/forge paths."""
+        self._drain_metrics()
         self.mempool.sync_with_ledger()

@@ -1,0 +1,56 @@
+"""Fast host sign-side dispatch: native C++ when available, pure Python
+otherwise — byte-identical either way (both are the deterministic
+RFC 8032 / ECVRF-draft-03 constructions; differential test:
+tests/test_native_crypto.py).
+
+The pure modules (ed25519.py, ecvrf.py, kes.py) stay untouched as the
+REFERENCE implementations; forging-side callers (fixtures, forge,
+hotkey, db_synthesizer) route through here so benchmark chains and
+ThreadNet nodes sign at C speed.
+"""
+
+from __future__ import annotations
+
+from . import ecvrf as _ecvrf
+from . import ed25519 as _ed25519
+
+
+def _lib():
+    from ... import native_loader
+
+    return native_loader.load_crypto()
+
+
+def ed25519_sign(seed: bytes, msg: bytes) -> bytes:
+    if _lib() is not None:
+        from ... import native_loader
+
+        return native_loader.native_ed25519_sign(seed, msg)
+    return _ed25519.sign(seed, msg)
+
+
+def ed25519_public(seed: bytes) -> bytes:
+    if _lib() is not None:
+        from ... import native_loader
+
+        return native_loader.native_ed25519_public(seed)
+    return _ed25519.secret_to_public(seed)
+
+
+def ecvrf_prove(seed: bytes, alpha: bytes) -> bytes:
+    if _lib() is not None:
+        from ... import native_loader
+
+        return native_loader.native_ecvrf_prove(seed, alpha)
+    return _ecvrf.prove(seed, alpha)
+
+
+def ecvrf_proof_to_hash(pi: bytes) -> bytes:
+    lib = _lib()
+    if lib is not None:
+        import ctypes
+
+        out = ctypes.create_string_buffer(64)
+        if lib.oc_ecvrf_proof_to_hash(pi, out):
+            return out.raw
+    return _ecvrf.proof_to_hash(pi)

@@ -53,7 +53,12 @@ def _seed_right(seed: bytes) -> bytes:
 def derive_vk(seed: bytes, depth: int) -> bytes:
     """Verification key of the subtree rooted at `seed` with `depth` levels."""
     if depth == 0:
-        return ed25519.secret_to_public(seed)
+        # leaf key derivation routes through the fast dispatch (native
+        # C when built; byte-identical) — tree derivation dominates the
+        # sign-side cost otherwise
+        from . import fast
+
+        return fast.ed25519_public(seed)
     return _h256(
         derive_vk(_seed_left(seed), depth - 1)
         + derive_vk(_seed_right(seed), depth - 1)
@@ -65,7 +70,9 @@ def sign(seed: bytes, depth: int, period: int, msg: bytes) -> bytes:
     if not 0 <= period < (1 << depth):
         raise ValueError(f"period {period} out of range for depth {depth}")
     if depth == 0:
-        return ed25519.sign(seed, msg) + ed25519.secret_to_public(seed)
+        from . import fast
+
+        return fast.ed25519_sign(seed, msg) + fast.ed25519_public(seed)
     half = 1 << (depth - 1)
     s0, s1 = _seed_left(seed), _seed_right(seed)
     if period < half:

@@ -1,0 +1,180 @@
+"""HotKey: forging-side KES key management with forward-secure evolution.
+
+Reference: `ouroboros-consensus-protocol/src/.../Protocol/Ledger/HotKey.hs`
+— `KESInfo`/`kesStatus` (:45,90), the `HotKey` record with `sign` and
+`evolve` (:124), `mkHotKey` (:169). Evolution FORGETS older key material
+(forward security): after evolving to t, signatures for periods < t are
+impossible — the reference mlocks and zeroes old keys; here the seeds are
+simply dropped (the Python analog of forgetting).
+
+Design: a CompactSum KES secret at evolution t is (leaf seed for t, the
+seeds of the right subtrees hanging off the path root→t that are still
+in the future). `evolve` pops the deepest pending subtree and expands its
+left spine — amortized O(1) hash work per evolution, O(depth) storage.
+The PUBLIC vk tree is precomputed once at construction (vks are not
+secret), so signatures can carry their sibling-vk paths after the seeds
+are gone.
+
+OCert lifecycle: `issue_ocert` binds the KES vk to the cold key with an
+incrementing counter (Praos.hs:585-590 checks monotonicity per issuer);
+a node re-keys by constructing a fresh HotKey + ocert with counter+1
+(ThreadNet/Util/Rekeying.hs is the reference's test driver for this).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..ops.host import fast
+from ..ops.host.kes import _h256, _seed_left, _seed_right
+
+
+class KESKeyExpired(Exception):
+    """Sign requested past the key's last evolution (kesStatus Expired):
+    the forging loop maps this to CannotForge, not a crash."""
+
+
+class KESBeforeStart(Exception):
+    """Sign requested for a period before the key's start, or for an
+    evolution already forgotten (forward security makes it unsignable)."""
+
+
+@dataclass(frozen=True)
+class KESInfo:
+    """HotKey.KESInfo (HotKey.hs:45): the key's period window + current
+    evolution. start/end are ABSOLUTE KES periods, end exclusive."""
+
+    start_period: int
+    end_period: int
+    evolution: int
+
+    @property
+    def current_period(self) -> int:
+        return self.start_period + self.evolution
+
+
+def kes_status(info: KESInfo, period: int) -> str:
+    """kesStatus (HotKey.hs:90): 'before' | 'in_evolution' | 'expired'."""
+    if period < info.start_period:
+        return "before"
+    if period >= info.end_period:
+        return "expired"
+    return "in_evolution"
+
+
+class HotKey:
+    """The evolving KES signing key (HotKey.hs:124)."""
+
+    def __init__(self, kes_seed: bytes, depth: int, start_period: int,
+                 max_evolutions: int | None = None):
+        self.depth = depth
+        self.start_period = start_period
+        self.max_evolutions = min(
+            1 << depth,
+            (1 << depth) if max_evolutions is None else max_evolutions,
+        )
+        self.evolution = 0
+        # secret state: pending right-subtree seeds along the left spine,
+        # deepest last; leaf seed for evolution 0
+        self._pending: list[tuple[bytes, int]] = []
+        seed = kes_seed
+        for level in range(depth):
+            self._pending.append((_seed_right(seed), depth - level - 1))
+            seed = _seed_left(seed)
+        self._leaf_seed: bytes | None = seed
+        # public vk tree: vk[level][index], level 0 = leaves (2^depth),
+        # level depth = root (1). Derived BEFORE dropping any seeds.
+        self._vks = self._derive_vk_tree(kes_seed, depth)
+
+    @staticmethod
+    def _derive_vk_tree(seed: bytes, depth: int) -> list[list[bytes]]:
+        leaves: list[bytes] = []
+
+        def walk(sd: bytes, d: int):
+            if d == 0:
+                leaves.append(fast.ed25519_public(sd))
+                return
+            walk(_seed_left(sd), d - 1)
+            walk(_seed_right(sd), d - 1)
+
+        walk(seed, depth)
+        levels = [leaves]
+        for _ in range(depth):
+            prev = levels[-1]
+            levels.append(
+                [_h256(prev[2 * i] + prev[2 * i + 1]) for i in range(len(prev) // 2)]
+            )
+        return levels
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def vk(self) -> bytes:
+        """The root verification key (what the OCert certifies)."""
+        return self._vks[self.depth][0]
+
+    def kes_info(self) -> KESInfo:
+        return KESInfo(
+            self.start_period,
+            self.start_period + self.max_evolutions,
+            self.evolution,
+        )
+
+    # -- evolution (HotKey.hs evolve; forgets old keys) ----------------------
+
+    def _evolve_once(self) -> None:
+        self._leaf_seed = None  # forget
+        if not self._pending:
+            raise KESKeyExpired(f"KES key exhausted at evolution {self.evolution}")
+        seed, d = self._pending.pop()
+        for level in range(d):
+            self._pending.append((_seed_right(seed), d - level - 1))
+            seed = _seed_left(seed)
+        self._leaf_seed = seed
+        self.evolution += 1
+
+    def evolve_to(self, period: int) -> None:
+        """Evolve (forgetting) until the key signs for ABSOLUTE KES
+        period `period` (updateForgeState's KES tick)."""
+        t = period - self.start_period
+        if t < self.evolution or t < 0:
+            raise KESBeforeStart(
+                f"period {period}: evolution {t} < current {self.evolution}"
+            )
+        if t >= self.max_evolutions:
+            raise KESKeyExpired(
+                f"period {period} >= end {self.start_period + self.max_evolutions}"
+            )
+        while self.evolution < t:
+            self._evolve_once()
+
+    # -- signing -------------------------------------------------------------
+
+    def sign(self, period: int, msg: bytes) -> bytes:
+        """Evolve to `period` and produce the CompactSum signature
+        (HotKey.hs:142 sign = evolve-then-KES.sign)."""
+        self.evolve_to(period)
+        assert self._leaf_seed is not None
+        t = self.evolution
+        sig = fast.ed25519_sign(self._leaf_seed, msg) + self._vks[0][t]
+        idx = t
+        for level in range(self.depth):
+            sibling = self._vks[level][idx ^ 1]
+            sig += sibling
+            idx >>= 1
+        return sig
+
+    def forget(self) -> None:
+        """Drop ALL key material (node shutdown / rekey)."""
+        self._leaf_seed = None
+        self._pending.clear()
+        self.evolution = self.max_evolutions
+
+
+def issue_ocert(cold_seed: bytes, hot_vk: bytes, counter: int, kes_period: int):
+    """Operational certificate: cold-key signature over
+    (kes_vk, counter, period) — OCert.signable, checked at Praos.hs:580."""
+    from .views import OCert
+
+    oc = OCert(hot_vk, counter, kes_period, b"")
+    return OCert(hot_vk, counter, kes_period, fast.ed25519_sign(cold_seed, oc.signable()))

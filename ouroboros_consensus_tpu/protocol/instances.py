@@ -167,9 +167,11 @@ class BftProtocol:
 
 
 # ---------------------------------------------------------------------------
-# PBFT (Protocol/PBFT.hs): permissive BFT — any genesis delegate may sign,
-# but no delegate may have signed more than threshold·window of the last
-# `window` blocks (PBftState tracks the signer window, PBFT/State.hs:82)
+# PBFT (Protocol/PBFT.hs): permissive BFT — the issuer must be a delegate
+# of a genesis key per the CURRENT ledger view's delegation map
+# (PBftLedgerView, PBFT.hs:190), and no genesis key may have signed more
+# than floor(threshold·window) of the last `window` signed blocks
+# (PBftState tracks (slot, genesis-key) pairs, PBFT/State.hs:82).
 # ---------------------------------------------------------------------------
 
 
@@ -185,34 +187,72 @@ class PBftInvalidSignature(ConsensusError):
 
 
 @dataclass
+class PBftInvalidSlot(ConsensusError):
+    """Slot before the last signed slot (PBFT.hs PBftInvalidSlot; the
+    inequality is non-strict because EBBs share their epoch's first
+    slot)."""
+
+    slot: int
+    last_signed: int
+
+
+@dataclass
 class PBftExceededSignThreshold(ConsensusError):
     slot: int
-    signer: int
+    genesis_key: int
     signed: int
     allowed: int
 
 
 @dataclass(frozen=True)
 class PBftParams:
-    """PBftParams (Protocol/PBFT.hs): threshold is the max fraction of
-    the window one delegate may sign; window = k signed blocks."""
+    """PBftParams (Protocol/PBFT.hs:222-240): threshold is the fraction
+    of the window one genesis key may sign; window = k signed blocks
+    (pbftWindowSize = pbftSecurityParam)."""
 
     num_genesis_keys: int
     threshold: Fraction
-    window: int  # number of recent signers retained (k)
+    window: int  # number of recent signed blocks retained (k)
     security_param: int = 2160
 
 
 @dataclass(frozen=True)
-class PBftState:
-    """Last `window` signer indices, oldest first (PBftState)."""
+class PBftLedgerView:
+    """The delegation map (PBFT.hs:190 PBftLedgerView — a Bimap genesis
+    key ↔ delegate key): issuer vk -> genesis key index. Byron's ledger
+    updates it via delegation certificates; the identity view maps each
+    genesis key to itself."""
 
-    signers: tuple[int, ...] = ()
+    delegates: Mapping[bytes, int]
+
+    @classmethod
+    def identity(cls, genesis_keys: Sequence[bytes]) -> "PBftLedgerView":
+        return cls({vk: i for i, vk in enumerate(genesis_keys)})
+
+
+@dataclass(frozen=True)
+class PBftState:
+    """Last `window` signed blocks as (slot, genesis key index), oldest
+    first (PBftState, PBFT/State.hs:82)."""
+
+    signers: tuple[tuple[int, int], ...] = ()
+
+    @property
+    def last_signed_slot(self) -> int | None:
+        return self.signers[-1][0] if self.signers else None
+
+    def count_signed_by(self, gk: int) -> int:
+        """countSignedBy (State.hs:178)."""
+        return sum(1 for (_s, g) in self.signers if g == gk)
 
 
 @dataclass(frozen=True)
 class TickedPBftState:
+    """Carries the TICKED ledger view (delegation map) alongside the
+    chain-dep state (PBFT.hs TickedPBftState)."""
+
     state: PBftState
+    dlg: Mapping[bytes, int]
 
 
 @dataclass(frozen=True)
@@ -231,47 +271,73 @@ class PBftProtocol:
         assert len(genesis_keys) == params.num_genesis_keys
         self.params = params
         self.genesis_keys = list(genesis_keys)
-        self._index = {vk: i for i, vk in enumerate(genesis_keys)}
+        self._identity_dlg = PBftLedgerView.identity(genesis_keys).delegates
         self.security_param = params.security_param
+
+    @property
+    def _threshold_count(self) -> int:
+        # pbftWindowParams (PBFT.hs:393-396): floor(ratio * winSize)
+        return int(self.params.threshold * self.params.window)
 
     def initial_state(self) -> PBftState:
         return PBftState()
 
     def tick(self, ledger_view, slot, state) -> TickedPBftState:
-        return TickedPBftState(state)
+        dlg = (
+            ledger_view.delegates
+            if isinstance(ledger_view, PBftLedgerView)
+            else self._identity_dlg
+        )
+        return TickedPBftState(state, dlg)
 
-    def _append_signer(self, st: PBftState, signer: int) -> PBftState:
-        signers = (st.signers + (signer,))[-self.params.window :]
-        return PBftState(signers)
+    def _append_signer(self, st: PBftState, slot: int, gk: int) -> PBftState:
+        return PBftState((st.signers + ((slot, gk),))[-self.params.window :])
 
     def apply_checked_sig(
-        self, st: PBftState, slot: int, issuer_vk: bytes, sig_ok: bool
+        self,
+        st: PBftState,
+        slot: int,
+        issuer_vk: bytes,
+        sig_ok: bool,
+        dlg: Mapping[bytes, int] | None = None,
     ) -> PBftState:
-        """The non-crypto PBft rules given a signature verdict: delegate
-        membership, then signature, then the window threshold — shared
-        by the sequential `update` and the batched byron path
-        (hardfork/composite.py) so the rule can never de-synchronize."""
-        signer = self._index.get(issuer_vk)
-        if signer is None:
-            raise PBftNotGenesisDelegate(slot, issuer_vk)
+        """The non-crypto PBft rules given a signature verdict, in the
+        reference's order (PBFT.hs:320-352): signature, slot
+        monotonicity, delegation lookup, then the window threshold on
+        the APPENDED state — shared by the sequential `update` and the
+        batched byron path (hardfork/composite.py) so the rule can
+        never de-synchronize."""
         if not sig_ok:
             raise PBftInvalidSignature(slot)
-        # threshold check over the window INCLUDING this block
-        window = st.signers[-(self.params.window - 1) :] if self.params.window > 1 else ()
-        signed = sum(1 for s in window if s == signer) + 1
-        allowed = int(self.params.threshold * self.params.window)
-        if signed > allowed:
-            raise PBftExceededSignThreshold(slot, signer, signed, allowed)
-        return self._append_signer(st, signer)
+        last = st.last_signed_slot
+        if last is not None and slot < last:
+            raise PBftInvalidSlot(slot, last)
+        dlg = self._identity_dlg if dlg is None else dlg
+        gk = dlg.get(issuer_vk)
+        if gk is None:
+            raise PBftNotGenesisDelegate(slot, issuer_vk)
+        new = self._append_signer(st, slot, gk)
+        signed = new.count_signed_by(gk)
+        if signed > self._threshold_count:
+            raise PBftExceededSignThreshold(
+                slot, gk, signed, self._threshold_count
+            )
+        return new
 
-    def update(self, view: PBftView, slot, ticked) -> PBftState:
+    def update(self, view: PBftView, slot, ticked: TickedPBftState) -> PBftState:
         sig_ok = host_ed25519.verify(
             view.issuer_vk, view.signed_bytes, view.signature
         )
-        return self.apply_checked_sig(ticked.state, slot, view.issuer_vk, sig_ok)
+        return self.apply_checked_sig(
+            ticked.state, slot, view.issuer_vk, sig_ok, ticked.dlg
+        )
 
-    def reupdate(self, view: PBftView, slot, ticked) -> PBftState:
-        return self._append_signer(ticked.state, self._index[view.issuer_vk])
+    def reupdate(self, view: PBftView, slot, ticked: TickedPBftState) -> PBftState:
+        """reupdateChainDepState (PBFT.hs:356-372): no signature check;
+        delegation + window append still run (failures are errors, the
+        checks are known to pass)."""
+        gk = ticked.dlg[view.issuer_vk]
+        return self._append_signer(ticked.state, slot, gk)
 
     def check_is_leader(self, node_id: int, slot, ticked):
         """PBFT leadership is round-robin among delegates (Byron)."""
@@ -339,3 +405,57 @@ class LeaderScheduleProtocol:
         o = -1 if ours is None else ours
         t = -1 if theirs is None else theirs
         return (t > o) - (t < o)
+
+
+# ---------------------------------------------------------------------------
+# Chain-selection combinators (Protocol/{ModChainSel,MockChainSel,Signed}.hs)
+# ---------------------------------------------------------------------------
+
+
+class ModChainSel:
+    """Protocol/ModChainSel.hs: the same protocol with its chain order
+    REPLACED. Everything except select_view/compare_candidates delegates
+    to the wrapped instance, so ChainSel/ChainSync/forging run unchanged
+    while candidate preference follows the substituted ordering."""
+
+    def __init__(self, inner, select_view_fn, compare_fn):
+        self._inner = inner
+        self._select_view_fn = select_view_fn
+        self._compare_fn = compare_fn
+
+    def select_view(self, header):
+        return self._select_view_fn(header)
+
+    def compare_candidates(self, ours, theirs) -> int:
+        return self._compare_fn(ours, theirs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def mock_chain_sel(inner, score):
+    """Protocol/MockChainSel.hs shape: longest chain wins, ties broken
+    by `score(header)` (higher preferred) — the mock-block testlib's
+    pluggable tie-breaker."""
+
+    def view(header):
+        return (header.block_no, score(header))
+
+    def cmp(ours, theirs):
+        o = (-1, float("-inf")) if ours is None else ours
+        t = (-1, float("-inf")) if theirs is None else theirs
+        return (t > o) - (t < o)
+
+    return ModChainSel(inner, view, cmp)
+
+
+class SignedHeader:
+    """Protocol/Signed.hs: the 'Signed' seam — headers expose the exact
+    bytes their signature covers. Praos headers satisfy it natively
+    (Header.signed_bytes = the CBOR header body, Praos/Header.hs:120
+    memoised serialisation); protocols that verify signatures batch over
+    precisely these bytes."""
+
+    @staticmethod
+    def header_signed(header) -> bytes:
+        return header.signed_bytes

@@ -208,6 +208,36 @@ class HostVerifier:
 HOST_VERIFIER = HostVerifier()
 
 
+class NativeVerifier:
+    """C++ host crypto (native/hostcrypto.cpp via ctypes) — the same
+    per-header semantics as HostVerifier at libsodium-class speed; used
+    where a test/tool needs many sequential host validations."""
+
+    def verify_dsign(self, vk, msg, sig):
+        from .. import native_loader
+
+        return native_loader.native_ed25519_verify(vk, sig, msg)
+
+    def verify_kes(self, vk, depth, period, msg, sig):
+        from .. import native_loader
+
+        return native_loader.native_kes_verify(vk, depth, period, msg, sig)
+
+    def verify_vrf(self, vk, proof, alpha, output):
+        from .. import native_loader
+
+        beta = native_loader.native_ecvrf_verify(vk, proof, alpha)
+        return beta is not None and beta == output
+
+
+def native_verifier_or_host() -> CryptoVerifier:
+    """NativeVerifier when the C++ library is buildable, else the
+    pure-Python fallback (import-time cheap; load is lazy per call)."""
+    from .. import native_loader
+
+    return NativeVerifier() if native_loader.load_crypto() is not None else HOST_VERIFIER
+
+
 # ---------------------------------------------------------------------------
 # Protocol transitions
 # ---------------------------------------------------------------------------

@@ -52,6 +52,15 @@ from .ledgerdb import InvalidBlock, LedgerDB
 from .volatile import VolatileDB
 
 
+class BlockGCed(Exception):
+    """Iterator hit a block removed from BOTH stores (Impl/Iterator.hs
+    IteratorBlockGCed): the stream fell off the chain's history."""
+
+
+class MissingBlockError(Exception):
+    """Ranged stream bounds not on the current chain (UnknownRange)."""
+
+
 @dataclass
 class AddBlockResult:
     added: bool
@@ -71,11 +80,39 @@ class AddBlockPromise:
 
 class Follower:
     """A push-style consumer of chain updates (Impl/Follower.hs): the
-    ChainSync server reads (rollback, new_blocks) instructions."""
+    ChainSync server reads (rollback, new_blocks) instructions.
 
-    def __init__(self, db: "ChainDB"):
+    `include_tentative` makes this a diffusion-pipelining follower
+    (Impl/Follower.hs tentative followers, ChainSel.hs:949-984): headers
+    of blocks that extend the current tip are announced BEFORE chain
+    selection validates their bodies; if the block is then not adopted,
+    a compensating rollback instruction precedes the real update."""
+
+    def __init__(self, db: "ChainDB", include_tentative: bool = False):
         self.db = db
-        self.updates: list = []  # ("rollback", Point|None) | ("addblock", Block)
+        self.include_tentative = include_tentative
+        # ("rollback", Point|None) | ("addblock", Block) | ("tentative", Header)
+        self.updates: list = []
+        self.event = Event("follower")  # fired on every new instruction
+        self._tentative_hash: bytes | None = None
+        self._tentative_prev: Point | None = None
+
+    def _notify_tentative(self, header, prev_point: Point | None) -> None:
+        if not self.include_tentative or self._tentative_hash is not None:
+            return
+        self._tentative_hash = header.hash_
+        self._tentative_prev = prev_point
+        self.updates.append(("tentative", header))
+        self._wake()
+
+    def _retract_tentative(self, hash_: bytes) -> None:
+        """Chain selection finished WITHOUT adopting the announced block
+        (the trap case): retract the tentative header."""
+        if self._tentative_hash == hash_:
+            self.updates.append(("rollback", self._tentative_prev))
+            self._tentative_hash = None
+            self._tentative_prev = None
+            self._wake()
 
     def _notify_switch(
         self,
@@ -85,14 +122,49 @@ class Follower:
     ):
         # `rolled_back` distinguishes "no rollback" from "rollback to
         # genesis" — rollback_to is None in BOTH cases
+        new_blocks = list(new_blocks)
+        if self._tentative_hash is not None:
+            if (
+                not rolled_back
+                and new_blocks
+                and new_blocks[0].hash_ == self._tentative_hash
+            ):
+                # tentative confirmed: the header was already announced
+                new_blocks = new_blocks[1:]
+            else:
+                # tentative lost (trap / different fork): retract it
+                # before relaying the real update
+                self.updates.append(("rollback", self._tentative_prev))
+            self._tentative_hash = None
+            self._tentative_prev = None
         if rolled_back:
             self.updates.append(("rollback", rollback_to))
         for b in new_blocks:
             self.updates.append(("addblock", b))
+        if self.updates:
+            self._wake()
+
+    def _wake(self) -> None:
+        if self.db.runtime is not None:
+            self.db.runtime.fire(self.event)
 
     def take_updates(self) -> list:
         out, self.updates = self.updates, []
         return out
+
+    def reset_position(self) -> None:
+        """Drop queued instructions AND pending-tentative tracking — the
+        server re-anchors on a chain snapshot at find_intersect, so a
+        not-yet-resolved tentative must be delivered afresh when (if)
+        its block is adopted."""
+        self.updates = []
+        self._tentative_hash = None
+        self._tentative_prev = None
+
+    def close(self) -> None:
+        """Unregister (ChainDB followers are owned by their protocol
+        server; a killed server must not leak its follower)."""
+        self.db.remove_follower(self)
 
 
 class ChainDB:
@@ -109,6 +181,7 @@ class ChainDB:
         snap_dir: str | None = None,
         snapshot_interval: int = 100,
         trace: Callable[[str], None] = lambda s: None,
+        check_in_future=None,  # block.infuture.CheckInFuture | None
     ):
         self.ext = ext
         self.immutable = immutable
@@ -121,8 +194,12 @@ class ChainDB:
         self.snapshot_interval = snapshot_interval
         self._copied_since_snapshot = 0
         self.trace = trace
+        # CheckInFuture (Fragment/InFuture.hs:45): candidates are cut at
+        # their first in-future header before selection; None = dontCheck
+        self.check_in_future = check_in_future
         self.current_chain: list[Block] = []  # volatile fragment, ≤ k
         self.invalid: dict[bytes, Exception] = {}  # hash -> reason
+        self._block_cache: dict[bytes, Block] = {}  # per-selection (BlockCache.hs)
         self.followers: list[Follower] = []
         # decoupled mode state (add_block_runner / background_runner)
         self._blocks_to_add: deque[AddBlockPromise] = deque()
@@ -179,16 +256,78 @@ class ChainDB:
                 return None
         return Block.from_bytes(raw)
 
-    def new_follower(self) -> Follower:
-        f = Follower(self)
+    def new_follower(self, include_tentative: bool = False) -> Follower:
+        f = Follower(self, include_tentative=include_tentative)
         self.followers.append(f)
         return f
+
+    def remove_follower(self, f: Follower) -> None:
+        if f in self.followers:
+            self.followers.remove(f)
 
     def stream_all(self) -> Iterable[Block]:
         """Iterator over the whole current chain, immutable part first."""
         for entry, raw in self.immutable.stream_all():
             yield Block.from_bytes(raw)
         yield from self.current_chain
+
+    def stream(
+        self, from_exclusive: Point | None = None, to_inclusive: Point | None = None
+    ) -> Iterable[Block]:
+        """GC-safe ranged iterator (ChainDB.stream, API.hs:274 +
+        Impl/Iterator.hs): stream the current chain after
+        `from_exclusive` up to `to_inclusive` (None = tip at creation).
+
+        The PLAN (the point sequence) is pinned at creation; each body
+        is resolved lazily at yield time — first from the VolatileDB,
+        then from the ImmutableDB. A block that background copy+GC moved
+        between the stores mid-iteration is therefore still found (the
+        reference's Volatile→Immutable iterator switching); a block
+        found in NEITHER store raises BlockGCed."""
+        plan: list[Point] = []
+        started = from_exclusive is None
+        done = False
+
+        def visit(p: Point) -> None:
+            nonlocal started, done
+            if not started:
+                if p == from_exclusive:
+                    started = True
+                    if to_inclusive == from_exclusive:
+                        done = True  # valid empty range
+                return
+            plan.append(p)
+            if to_inclusive is not None and p == to_inclusive:
+                done = True
+
+        for n in self.immutable._chunks:
+            if done:
+                break
+            for e in self.immutable._entries[n]:
+                visit(Point(e.slot, e.hash_))
+                if done:
+                    break
+        if not done:
+            for b in self.current_chain:
+                visit(b.point)
+                if done:
+                    break
+        if not started:
+            raise MissingBlockError(from_exclusive)
+        if to_inclusive is not None and not done:
+            raise MissingBlockError(to_inclusive)
+
+        def resolve():
+            for p in plan:
+                raw = self.volatile.get_block_bytes(p.hash_)
+                if raw is None:
+                    try:
+                        raw = self.immutable.get_block_bytes(p)
+                    except Exception:
+                        raise BlockGCed(p) from None
+                yield Block.from_bytes(raw)
+
+        return resolve()
 
     # -- candidates (Impl/Paths.hs) ------------------------------------------
 
@@ -261,6 +400,10 @@ class ChainDB:
     def _load_fragment(self, hashes: list[bytes]) -> list[Block] | None:
         blocks = []
         for h in hashes:
+            cached = self._block_cache.get(h)
+            if cached is not None:
+                blocks.append(cached)
+                continue
             raw = self.volatile.get_block_bytes(h)
             if raw is None:
                 return None
@@ -287,6 +430,9 @@ class ChainDB:
         # orders on the tip's SelectView) — parsing whole fragments here
         # would cost O(k) block reads per incoming block on the hot path
         def tip_view(c):
+            cached = self._block_cache.get(c[-1])
+            if cached is not None:
+                return proto.select_view(cached.header)
             raw = self.volatile.get_block_bytes(c[-1])
             if raw is None:
                 return None
@@ -318,7 +464,13 @@ class ChainDB:
         if imm is not None and block.slot <= imm.slot:
             return AddBlockResult(False, self.tip_point(), False)
         self.volatile.put_block(block)
-        selected = self._chain_selection_for_block(block)
+        # BlockCache (Impl/BlockCache.hs): the block in hand need not be
+        # reread/reparsed from the VolatileDB during this selection
+        self._block_cache[block.hash_] = block
+        try:
+            selected = self._chain_selection_for_block(block)
+        finally:
+            self._block_cache.clear()
         return AddBlockResult(True, self.tip_point(), selected)
 
     def _current_select_view(self):
@@ -338,6 +490,26 @@ class ChainDB:
             cand = self._best_candidate_from(anchor, rejected, via=block.hash_)
             if cand is None:
                 return False
+            if self.check_in_future is not None:
+                kept, dropped = self.check_in_future.truncate(cand)
+                if dropped:
+                    self.trace(
+                        f"{len(dropped)} in-future block(s) cut from "
+                        f"candidate (first at slot {dropped[0].slot})"
+                    )
+                    # candidates were RANKED by untruncated tip, so a
+                    # truncated loser must not end the loop — reject it
+                    # and let the next-best (possibly all-present-slot)
+                    # candidate have its turn
+                    kept_view = (
+                        proto.select_view(kept[-1].header) if kept else None
+                    )
+                    if not kept or proto.compare_candidates(
+                        cur_view, kept_view
+                    ) <= 0:
+                        rejected.append([b.hash_ for b in cand])
+                        continue
+                    cand = kept
             cand_view = proto.select_view(cand[-1].header)
             # preferCandidate: only strictly better chains are adopted
             if proto.compare_candidates(cur_view, cand_view) <= 0:
@@ -370,6 +542,7 @@ class ChainDB:
         if not suffix and n_rollback == 0:
             return False
         n_before = self.ledgerdb.volatile_length()
+        state_before = self.ledgerdb.current()
         try:
             if not self.ledgerdb.switch(n_rollback, suffix):
                 # rollback deeper than the LedgerDB holds (> k): the
@@ -403,6 +576,16 @@ class ChainDB:
                 self.ledgerdb.push_many(restore, apply=False)
             return False
         self._install(n_rollback, suffix)
+        # InspectLedger (Ledger/Inspect.hs): trace ledger events of the
+        # adoption — era transitions, protocol-update warnings
+        from ..ledger.inspect import inspect_ledger
+
+        for ev in inspect_ledger(
+            self.ext.ledger,
+            state_before.ledger_state,
+            self.ledgerdb.current().ledger_state,
+        ):
+            self.trace(f"ledger event: {ev}")
         return True
 
     def _install(self, n_rollback: int, suffix: list[Block]) -> None:
@@ -462,6 +645,7 @@ class ChainDB:
         gc_slot = self._copy_step()
         if gc_slot is not None:
             self.volatile.garbage_collect(gc_slot)
+            self.ledgerdb.gc_prev_applied(gc_slot)
 
     # -- decoupled mode (ChainSel.hs:217-246 + Background.hs:17-38) ----------
 
@@ -483,6 +667,13 @@ class ChainDB:
         if not self._background_decoupled:
             p.result = self.add_block(block)
             return p
+        # diffusion pipelining (ChainSel.hs:949-984): a block extending
+        # the current tip is announced to tentative followers as a
+        # header BEFORE its (possibly slow, batched) validation
+        tip = self.tip_point()
+        if block.prev_hash == (tip.hash_ if tip else None):
+            for f in self.followers:
+                f._notify_tentative(block.header, tip)
         self._blocks_to_add.append(p)
         if self.runtime is not None:
             self.runtime.fire(self._queue_event)
@@ -497,6 +688,9 @@ class ChainDB:
                 yield Wait(self._queue_event)
             p = self._blocks_to_add.popleft()
             p.result = self.add_block(p.block)
+            if not p.result.selected:
+                for f in self.followers:
+                    f._retract_tentative(p.block.hash_)
             yield Fire(p.processed)
 
     def background_runner(self, gc_delay: float = 1.0):
@@ -516,3 +710,4 @@ class ChainDB:
                     break
                 yield Sleep(gc_delay)
                 self.volatile.garbage_collect(gc_slot)
+                self.ledgerdb.gc_prev_applied(gc_slot)

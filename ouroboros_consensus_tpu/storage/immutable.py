@@ -29,6 +29,7 @@ from typing import Callable, Iterator
 
 from ..block.abstract import Point
 from ..utils import cbor
+from ..utils.fs import REAL_FS
 
 
 class ImmutableDBError(Exception):
@@ -75,10 +76,12 @@ class ImmutableDB:
         chunk_size: int = 21600,  # slots per chunk (reference: epoch-ish)
         check_integrity: Callable[[bytes], bool] | None = None,
         validate_all: bool = False,
+        fs=None,  # HasFS seam (utils/fs.py); None = the real filesystem
     ):
         self.path = path
         self.chunk_size = chunk_size
-        os.makedirs(path, exist_ok=True)
+        self.fs = fs if fs is not None else REAL_FS
+        self.fs.makedirs(path)
         self._entries: dict[int, list[IndexEntry]] = {}  # chunk -> entries
         self._chunks: list[int] = []
         self._truncated: dict[int, bool] = {}
@@ -88,7 +91,7 @@ class ImmutableDB:
 
     def _chunk_numbers(self) -> list[int]:
         ns = []
-        for f in os.listdir(self.path):
+        for f in self.fs.listdir(self.path):
             if f.endswith(".chunk"):
                 ns.append(int(f.split(".")[0]))
         return sorted(ns)
@@ -127,7 +130,7 @@ class ImmutableDB:
         # data after a crash: reparse any bytes past the indexed end
         end = entries[-1].offset + entries[-1].size if entries else 0
         try:
-            fsize = os.path.getsize(cpath)
+            fsize = self.fs.getsize(cpath)
         except OSError:
             return None
         if fsize > end:
@@ -136,8 +139,7 @@ class ImmutableDB:
         if deep:
             # reparse against the index, truncating at the first corruption
             try:
-                with open(cpath, "rb") as f:
-                    data = f.read()
+                data = self.fs.read_bytes(cpath)
             except OSError:
                 return None
             good = []
@@ -166,8 +168,7 @@ class ImmutableDB:
 
         cpath = os.path.join(self.path, _chunk_name(n))
         try:
-            with open(cpath, "rb") as f:
-                data = f.read()
+            data = self.fs.read_bytes(cpath)
         except OSError:
             return None
 
@@ -244,24 +245,19 @@ class ImmutableDB:
 
     def _rewrite_chunk(self, n: int, data: bytes, entries: list[IndexEntry]):
         end = entries[-1].offset + entries[-1].size if entries else 0
-        with open(os.path.join(self.path, _chunk_name(n)), "wb") as f:
-            f.write(data[:end])
+        self.fs.write_bytes(os.path.join(self.path, _chunk_name(n)), data[:end])
         self._write_index(n, entries)
 
     def _remove_chunk(self, n: int):
         for name in (_chunk_name(n), _index_name(n)):
-            p = os.path.join(self.path, name)
-            if os.path.exists(p):
-                os.remove(p)
+            self.fs.remove(os.path.join(self.path, name))
 
-    @staticmethod
-    def _load_index(ipath: str) -> list[IndexEntry] | None:
+    def _load_index(self, ipath: str) -> list[IndexEntry] | None:
         """Index file = concatenated CBOR entry arrays (append-only, like
         the reference's secondary index). A torn final entry (crash
         mid-append) just ends the list — the fsize-lag check reparses."""
         try:
-            with open(ipath, "rb") as f:
-                data = f.read()
+            data = self.fs.read_bytes(ipath)
         except OSError:
             return None
         entries: list[IndexEntry] = []
@@ -275,13 +271,8 @@ class ImmutableDB:
         return entries
 
     def _write_index(self, n: int, entries: list[IndexEntry]):
-        tmp = os.path.join(self.path, _index_name(n) + ".tmp")
-        with open(tmp, "wb") as f:
-            for e in entries:
-                f.write(cbor.encode(e.to_cbor_obj()))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(self.path, _index_name(n)))
+        data = b"".join(cbor.encode(e.to_cbor_obj()) for e in entries)
+        self.fs.write_atomic(os.path.join(self.path, _index_name(n)), data)
 
     # -- queries -------------------------------------------------------------
 
@@ -314,15 +305,13 @@ class ImmutableDB:
             self._chunks.append(n)
             self._chunks.sort()
         cpath = os.path.join(self.path, _chunk_name(n))
-        offset = os.path.getsize(cpath) if os.path.exists(cpath) else 0
-        with open(cpath, "ab") as f:
-            f.write(raw)
+        offset = self.fs.getsize(cpath) if self.fs.exists(cpath) else 0
+        self.fs.append(cpath, raw)
         e = IndexEntry(slot, block_no, hash_, offset, len(raw), zlib.crc32(raw))
         self._entries[n].append(e)
         # O(1) append-only index write (no fsync: startup validation
         # recovers from torn tails); CRC lives in the entry
-        with open(os.path.join(self.path, _index_name(n)), "ab") as f:
-            f.write(cbor.encode(e.to_cbor_obj()))
+        self.fs.append(os.path.join(self.path, _index_name(n)), cbor.encode(e.to_cbor_obj()))
 
     def flush(self) -> None:
         """fsync chunk + index data of the newest chunk (clean shutdown)."""
@@ -331,19 +320,15 @@ class ImmutableDB:
         n = self._chunks[-1]
         for name in (_chunk_name(n), _index_name(n)):
             p = os.path.join(self.path, name)
-            if os.path.exists(p):
-                fd = os.open(p, os.O_RDONLY)
-                try:
-                    os.fsync(fd)
-                finally:
-                    os.close(fd)
+            if self.fs.exists(p):
+                self.fs.fsync(p)
 
     # -- reading -------------------------------------------------------------
 
     def _read(self, n: int, e: IndexEntry) -> bytes:
-        with open(os.path.join(self.path, _chunk_name(n)), "rb") as f:
-            f.seek(e.offset)
-            return f.read(e.size)
+        return self.fs.read_at(
+            os.path.join(self.path, _chunk_name(n)), e.offset, e.size
+        )
 
     def get_block_bytes(self, point: Point) -> bytes:
         n = point.slot // self.chunk_size
@@ -358,8 +343,7 @@ class ImmutableDB:
             entries = self._entries[n]
             if not entries:
                 continue
-            with open(os.path.join(self.path, _chunk_name(n)), "rb") as f:
-                data = f.read()
+            data = self.fs.read_bytes(os.path.join(self.path, _chunk_name(n)))
             for e in entries:
                 yield e, data[e.offset : e.offset + e.size]
 
@@ -371,8 +355,7 @@ class ImmutableDB:
             entries = self._entries[n]
             if not entries or entries[-1].slot <= after_slot:
                 continue  # chunk entirely at or before the snapshot point
-            with open(os.path.join(self.path, _chunk_name(n)), "rb") as f:
-                data = f.read()
+            data = self.fs.read_bytes(os.path.join(self.path, _chunk_name(n)))
             for e in entries:
                 if e.slot > after_slot:
                     yield e, data[e.offset : e.offset + e.size]
@@ -385,8 +368,7 @@ class ImmutableDB:
             entries = [e for e in self._entries[n] if e.slot <= keep_through]
             if len(entries) != len(self._entries[n]):
                 if entries:
-                    with open(os.path.join(self.path, _chunk_name(n)), "rb") as f:
-                        data = f.read()
+                    data = self.fs.read_bytes(os.path.join(self.path, _chunk_name(n)))
                     self._entries[n] = entries
                     self._rewrite_chunk(n, data, entries)
                 else:

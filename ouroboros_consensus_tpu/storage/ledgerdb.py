@@ -25,6 +25,7 @@ from typing import Callable, Sequence
 from ..block.abstract import Point
 from ..ledger.extended import ExtLedger, ExtLedgerState
 from ..ledger.header_validation import AnnTip, HeaderState, validate_envelope
+from ..utils.fs import REAL_FS
 from . import serialize
 
 
@@ -38,12 +39,18 @@ class LedgerDB:
     """AnchoredSeq of (point, state): index 0 is the anchor (immutable
     tip); at most k volatile checkpoints follow."""
 
-    def __init__(self, ext: ExtLedger, k: int, anchor: ExtLedgerState):
+    def __init__(self, ext: ExtLedger, k: int, anchor: ExtLedgerState, fs=None):
         self.ext = ext
         self.k = k
+        self.fs = fs if fs is not None else REAL_FS
         self._seq: list[tuple[Point | None, ExtLedgerState]] = [
             (ext.tip_point(anchor), anchor)
         ]
+        # LgrDB's varPrevApplied (Impl/LgrDB.hs:86): hash -> slot of
+        # blocks validated before — a fork switch re-crossing them
+        # chooses ReapplyVal (no crypto) instead of ApplyVal
+        # (LgrDB.hs:330); GC'd alongside the VolatileDB
+        self._prev_applied: dict[bytes, int] = {}
 
     # -- queries -------------------------------------------------------------
 
@@ -69,13 +76,23 @@ class LedgerDB:
     # -- updates -------------------------------------------------------------
 
     def push(self, block, apply: bool = True) -> ExtLedgerState:
-        """ledgerDbPush + prune-to-k."""
+        """ledgerDbPush + prune-to-k. `apply` requests full validation,
+        downgraded to reapply for previously-applied blocks (the Ap GADT
+        choice in LgrDB.validate, Impl/LgrDB.hs:330)."""
         st = self.current()
+        requested_apply = apply
+        if apply and block.hash_ in self._prev_applied:
+            apply = False
         new = (
             self.ext.tick_then_apply(st, block)
             if apply
             else self.ext.tick_then_reapply(st, block)
         )
+        if requested_apply:
+            # only VALIDATION records prev-applied (LgrDB.hs adds in
+            # validate, not during replay) — an immutable-replay push
+            # (apply=False) must not grow an O(chain) dict
+            self._prev_applied[block.hash_] = block.slot
         self._seq.append((block.point, new))
         if len(self._seq) > self.k + 1:
             self._seq = self._seq[len(self._seq) - (self.k + 1) :]
@@ -91,10 +108,26 @@ class LedgerDB:
 
     def push_many(self, blocks: Sequence, apply: bool = True) -> None:
         """ledgerDbPushMany; with `apply` and a batching protocol, header
-        crypto runs as fused device batches (epoch-segmented)."""
+        crypto runs as fused device batches (epoch-segmented). Runs of
+        previously-applied blocks skip the kernels entirely (Reapply)."""
         proto = self.ext.protocol
         if apply and getattr(proto, "use_device_batch", False) and len(blocks) > 1:
-            self._push_many_batched(blocks)
+            i, n = 0, len(blocks)
+            while i < n:
+                fresh = blocks[i].hash_ not in self._prev_applied
+                j = i
+                while j < n and (blocks[j].hash_ not in self._prev_applied) == fresh:
+                    j += 1
+                run = blocks[i:j]
+                if fresh:
+                    self._push_many_batched(run)
+                else:
+                    for b in run:
+                        try:
+                            self.push(b, False)
+                        except Exception as e:
+                            raise InvalidBlock(b.point, e) from e
+                i = j
         else:
             for b in blocks:
                 try:
@@ -152,6 +185,7 @@ class LedgerDB:
                         AnnTip(b.slot, b.block_no, b.hash_), res.states[idx]
                     )
                     self._seq.append((b.point, ExtLedgerState(ext_states[idx], hs)))
+                    self._prev_applied[b.hash_] = b.slot
                 if len(self._seq) > self.k + 1:
                     self._seq = self._seq[len(self._seq) - (self.k + 1) :]
                 if res.error is not None:
@@ -159,6 +193,14 @@ class LedgerDB:
             if pending is not None:
                 raise pending
             i = j
+
+    def gc_prev_applied(self, slot: int) -> None:
+        """garbageCollectPrevApplied (Impl/LgrDB.hs): forget hashes with
+        slot < `slot` — the VolatileDB no longer holds those blocks, so
+        they can never be pushed again."""
+        self._prev_applied = {
+            h: s for h, s in self._prev_applied.items() if s >= slot
+        }
 
     def switch(self, n_rollback: int, blocks: Sequence, apply: bool = True) -> bool:
         """ledgerDbSwitch (Update.hs:315): rollback then pushMany."""
@@ -174,30 +216,26 @@ class LedgerDB:
     def take_snapshot(self, snap_dir: str, keep: int = 2) -> str | None:
         """Write the ANCHOR state (immutable tip, Snapshots.hs:108) named
         by its slot; prune to `keep` newest (DiskPolicy: default 2)."""
-        os.makedirs(snap_dir, exist_ok=True)
+        self.fs.makedirs(snap_dir)
         anchor_point, anchor = self._seq[0]
         slot = 0 if anchor_point is None else anchor_point.slot
         name = f"snapshot-{slot}"
         path = os.path.join(snap_dir, name)
-        if os.path.exists(path):
+        if self.fs.exists(path):
             return None
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(serialize.encode_ext_state(anchor))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        snaps = sorted(self.list_snapshots(snap_dir))
+        self.fs.write_atomic(path, serialize.encode_ext_state(anchor))
+        snaps = sorted(self.list_snapshots(snap_dir, fs=self.fs))
         for s in snaps[:-keep]:
-            os.remove(os.path.join(snap_dir, f"snapshot-{s}"))
+            self.fs.remove(os.path.join(snap_dir, f"snapshot-{s}"))
         return name
 
     @classmethod
-    def list_snapshots(cls, snap_dir: str) -> list[int]:
-        if not os.path.isdir(snap_dir):
+    def list_snapshots(cls, snap_dir: str, fs=None) -> list[int]:
+        fs = fs if fs is not None else REAL_FS
+        if not fs.isdir(snap_dir):
             return []
         out = []
-        for f in os.listdir(snap_dir):
+        for f in fs.listdir(snap_dir):
             m = cls.SNAP_RE.match(f)
             if m:
                 out.append(int(m.group(1)))
@@ -212,22 +250,23 @@ class LedgerDB:
         genesis: ExtLedgerState,
         immutable_db,
         trace: Callable[[str], None] = lambda s: None,
+        fs=None,
     ) -> "LedgerDB":
         """initLedgerDB (Init.hs:89-145): newest snapshot first, fall back
         to older ones then genesis; replay immutable blocks after the
         snapshot with tickThenReapply (no crypto)."""
         from ..block.praos_block import Block
 
-        for slot in sorted(cls.list_snapshots(snap_dir), reverse=True):
+        fs = fs if fs is not None else REAL_FS
+        for slot in sorted(cls.list_snapshots(snap_dir, fs=fs), reverse=True):
             path = os.path.join(snap_dir, f"snapshot-{slot}")
             try:
-                with open(path, "rb") as f:
-                    state = serialize.decode_ext_state(f.read())
+                state = serialize.decode_ext_state(fs.read_bytes(path))
             except Exception:
                 trace(f"snapshot-{slot} unreadable; falling back")
-                os.remove(path)
+                fs.remove(path)
                 continue
-            db = cls(ext, k, state)
+            db = cls(ext, k, state, fs=fs)
             tip_slot = ext.tip_slot(state)
             start = -1 if tip_slot is None else tip_slot  # None = genesis
             for entry, raw in immutable_db.stream_from(start):
@@ -235,7 +274,7 @@ class LedgerDB:
                 db._seq = db._seq[-1:]  # replay keeps only the tip state
             trace(f"replayed from snapshot-{slot}")
             return db
-        db = cls(ext, k, genesis)
+        db = cls(ext, k, genesis, fs=fs)
         n = 0
         for entry, raw in immutable_db.stream_all():
             db.push(Block.from_bytes(raw), apply=False)

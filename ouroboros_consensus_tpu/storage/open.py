@@ -39,14 +39,22 @@ def open_chaindb(
     validate_all: bool = False,
     chunk_size: int = 21600,
     trace: Callable[[str], None] = lambda s: None,
+    fs=None,  # HasFS seam — a MockFS here runs the whole ChainDB in memory
+    check_in_future=None,  # block.infuture.CheckInFuture | None
 ) -> ChainDB:
     imm = ImmutableDB(
         os.path.join(path, "immutable"),
         chunk_size=chunk_size,
         check_integrity=default_check_integrity if validate_all else None,
         validate_all=validate_all,
+        fs=fs,
     )
-    vol = VolatileDB(os.path.join(path, "volatile"))
+    vol = VolatileDB(os.path.join(path, "volatile"), fs=fs)
     snap_dir = os.path.join(path, "ledger")
-    ldb = LedgerDB.init_from_snapshots(ext, k, snap_dir, genesis, imm, trace)
-    return ChainDB(ext, imm, vol, ldb, k, snap_dir=snap_dir, trace=trace)
+    ldb = LedgerDB.init_from_snapshots(
+        ext, k, snap_dir, genesis, imm, trace, fs=fs
+    )
+    return ChainDB(
+        ext, imm, vol, ldb, k, snap_dir=snap_dir, trace=trace,
+        check_in_future=check_in_future,
+    )

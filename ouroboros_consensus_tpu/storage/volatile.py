@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from typing import Callable, Iterable
 
 from ..block.abstract import Point
+from ..utils.fs import REAL_FS
 
 
 @dataclass(frozen=True)
@@ -38,10 +39,11 @@ class BlockInfo:
 
 
 class VolatileDB:
-    def __init__(self, path: str, max_blocks_per_file: int = 1000):
+    def __init__(self, path: str, max_blocks_per_file: int = 1000, fs=None):
         self.path = path
         self.max_blocks_per_file = max_blocks_per_file
-        os.makedirs(path, exist_ok=True)
+        self.fs = fs if fs is not None else REAL_FS
+        self.fs.makedirs(path)
         self._info: dict[bytes, BlockInfo] = {}
         self._successors: dict[bytes | None, set[bytes]] = {}
         self._file_counts: dict[int, int] = {}
@@ -51,7 +53,7 @@ class VolatileDB:
 
     def _files(self) -> list[int]:
         ns = []
-        for f in os.listdir(self.path):
+        for f in self.fs.listdir(self.path):
             if f.startswith("blocks-") and f.endswith(".dat"):
                 ns.append(int(f[len("blocks-") : -len(".dat")]))
         return sorted(ns)
@@ -61,8 +63,7 @@ class VolatileDB:
 
         for n in self._files():
             p = self._file_path(n)
-            with open(p, "rb") as f:
-                data = f.read()
+            data = self.fs.read_bytes(p)
             off = 0
             good_end = 0
             while off + 8 <= len(data):
@@ -78,8 +79,7 @@ class VolatileDB:
                 off += 8 + size
                 good_end = off
             if good_end != len(data):  # truncate torn tail
-                with open(p, "r+b") as f:
-                    f.truncate(good_end)
+                self.fs.truncate(p, good_end)
         ns = self._files()
         self._write_file_no = ns[-1] if ns else 0
 
@@ -104,10 +104,8 @@ class VolatileDB:
             n = self._write_file_no = n + 1
         raw = blk.bytes_
         p = self._file_path(n)
-        offset = (os.path.getsize(p) if os.path.exists(p) else 0) + 8
-        with open(p, "ab") as f:
-            f.write(struct.pack("<II", len(raw), zlib.crc32(raw)))
-            f.write(raw)
+        offset = (self.fs.getsize(p) if self.fs.exists(p) else 0) + 8
+        self.fs.append(p, struct.pack("<II", len(raw), zlib.crc32(raw)) + raw)
         self._index(blk, n, offset, len(raw))
 
     def get_block_info(self, hash_: bytes) -> BlockInfo | None:
@@ -120,9 +118,7 @@ class VolatileDB:
         info = self._info.get(hash_)
         if info is None:
             return None
-        with open(self._file_path(info.file_no), "rb") as f:
-            f.seek(info.offset)
-            return f.read(info.size)
+        return self.fs.read_at(self._file_path(info.file_no), info.offset, info.size)
 
     def filter_by_predecessor(self, prev_hash: bytes | None) -> set[bytes]:
         """The successor map ChainSel's path finding walks (Paths.hs)."""
@@ -138,7 +134,7 @@ class VolatileDB:
             if n == self._write_file_no:
                 continue  # never GC the write file
             if all(i.slot < slot for i in infos):
-                os.remove(self._file_path(n))
+                self.fs.remove(self._file_path(n))
                 for i in infos:
                     del self._info[i.hash_]
                     succ = self._successors.get(i.prev_hash)

@@ -10,9 +10,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from fractions import Fraction
+from functools import cached_property
 
 from ..ops.host import ecvrf as hv
 from ..ops.host import ed25519 as he
+from ..ops.host import fast
 from ..ops.host import kes as hk
 from ..protocol import nonces
 from ..protocol.praos import PraosCanBeLeader, PraosParams
@@ -41,25 +43,27 @@ class PoolCredentials:
     kes_seed: bytes
     kes_depth: int
 
-    @property
+    # cached: the seeds are frozen, and each derivation is a scalar
+    # multiplication — forging consults these every slot
+    @cached_property
     def vk_cold(self) -> bytes:
-        return he.secret_to_public(self.cold_seed)
+        return fast.ed25519_public(self.cold_seed)
 
-    @property
+    @cached_property
     def vrf_vk(self) -> bytes:
-        return he.secret_to_public(self.vrf_seed)  # VRF uses Ed25519 keys
+        return fast.ed25519_public(self.vrf_seed)  # VRF uses Ed25519 keys
 
-    @property
+    @cached_property
     def kes_vk(self) -> bytes:
         return hk.derive_vk(self.kes_seed, self.kes_depth)
 
-    @property
+    @cached_property
     def pool_id(self) -> bytes:
         return hash_key(self.vk_cold)
 
     def make_ocert(self, counter: int, kes_period: int) -> OCert:
         oc = OCert(self.kes_vk, counter, kes_period, b"")
-        sig = he.sign(self.cold_seed, oc.signable())
+        sig = fast.ed25519_sign(self.cold_seed, oc.signable())
         return OCert(self.kes_vk, counter, kes_period, sig)
 
 
@@ -127,8 +131,8 @@ def forge_header_view(
     until the real codec (block/) is wired; validation only sees bytes.
     """
     alpha = nonces.mk_input_vrf(slot, epoch_nonce)
-    proof = hv.prove(pool.vrf_seed, alpha)
-    output = hv.proof_to_hash(proof)
+    proof = fast.ecvrf_prove(pool.vrf_seed, alpha)
+    output = fast.ecvrf_proof_to_hash(proof)
     kp = params.kes_period_of(slot)
     ocert = pool.make_ocert(ocert_counter, kp)
     t = 0  # ocert issued for the current period: evolution index 0

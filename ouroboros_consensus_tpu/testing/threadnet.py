@@ -8,6 +8,19 @@ pair over channels with per-message delay, all driven by a virtual clock
 for a fixed number of slots. Properties checked by the tests mirror
 `prop_general` (ThreadNet/General.hs:403): common prefix, chain growth,
 all nodes converge.
+
+Hardening knobs mirroring the reference harness:
+  * join plans (`NodeJoinPlan`): a node's forging loop and its protocol
+    edges only start at its join slot.
+  * restarts (`ThreadNet/Util/NodeRestarts.hs`): at the scheduled slot
+    the node's tasks are killed mid-run, its ChainDB closed and reopened
+    WITH full revalidation (the crashed-marker policy), and fresh
+    protocol edges spawned.
+  * rekeying (`Util/Rekeying.hs`): a restart can hand the node a fresh
+    KES hot key + ocert (counter+1) via NodeKernel.rekey.
+  * `expected_chain_length` — the reference-simulator check (Ref/PBFT.hs
+    analog): for a deterministic leader layout (single forger, f=1) the
+    exact final chain length is predicted from the join/restart plan.
 """
 
 from __future__ import annotations
@@ -18,14 +31,14 @@ from fractions import Fraction
 
 from ..ledger.extended import ExtLedger
 from ..ledger.mock import MockConfig, MockLedger
-from ..miniprotocol import blockfetch, chainsync
+from ..miniprotocol import blockfetch, chainsync, txsubmission
 from ..miniprotocol.chainsync import Candidate
 from ..node.kernel import NodeKernel, SlotClock
 from ..protocol import praos
 from ..protocol.instances import PraosProtocol
 from ..storage.open import open_chaindb
 from ..testing import fixtures
-from ..utils.sim import Channel, Sim
+from ..utils.sim import Channel, Sim, Sleep
 
 
 @dataclass
@@ -41,6 +54,18 @@ class ThreadNetConfig:
     topology: list[tuple[int, int]] | None = None  # directed edges; None=full
     async_chaindb: bool = False  # decoupled add-block queue + background GC
     use_device_batch: bool = False  # candidate validation via fused kernel
+    forgers: list[int] | None = None  # node indices that forge; None = all
+    join_plan: dict[int, int] | None = None  # node -> first slot it's up
+    restarts: list[tuple[int, int]] | None = None  # (slot, node) kill+reopen
+    rekey_on_restart: bool = False  # fresh KES + ocert counter+1 at restart
+    tx_submission: bool = False  # run TxSubmission2 on every edge
+    in_future_check: bool = False  # CheckInFuture vs the sim clock
+    # ThreadNet/TxGen.hs analog: (slot, node, tx_bytes) injected into
+    # that node's mempool at the slot's start
+    tx_injections: list[tuple[int, int, bytes]] | None = None
+    # io-sim schedule exploration (SURVEY §5.2): a seed permutes
+    # same-time task wakeups deterministically; None = FIFO
+    seed: int | None = None
 
 
 @dataclass
@@ -48,91 +73,245 @@ class ThreadNetResult:
     nodes: list[NodeKernel]
     sim: Sim
     chains: list[list] = field(default_factory=list)  # per node: Block list
+    n_restarts: int = 0
 
     def chain_hashes(self, i: int) -> list[bytes]:
         return [b.hash_ for b in self.chains[i]]
 
 
-def run_thread_network(base_dir: str, cfg: ThreadNetConfig) -> ThreadNetResult:
-    params = praos.PraosParams(
-        slots_per_kes_period=100,
-        max_kes_evolutions=62,
-        security_param=cfg.k,
-        active_slot_coeff=cfg.active_slot_coeff,
-        epoch_length=cfg.epoch_length,
-        kes_depth=cfg.kes_depth,
-    )
-    pools = [fixtures.make_pool(i, kes_depth=cfg.kes_depth) for i in range(cfg.n_nodes)]
-    lview = fixtures.make_ledger_view(pools)
+def _delayed(dt: float, gen):
+    """Spawn-later wrapper: sleep dt (virtual), then run `gen`."""
+    if dt > 0:
+        yield Sleep(dt)
+    yield from gen
 
-    nodes: list[NodeKernel] = []
-    for i in range(cfg.n_nodes):
-        ledger = MockLedger(MockConfig(lview, params.stability_window))
-        protocol = PraosProtocol(params, use_device_batch=cfg.use_device_batch)
+
+class _Net:
+    """Mutable network state during a run (vertex/edge respawns)."""
+
+    def __init__(self, base_dir: str, cfg: ThreadNetConfig, sim: Sim):
+        self.base_dir = base_dir
+        self.cfg = cfg
+        self.sim = sim
+        self.params = praos.PraosParams(
+            slots_per_kes_period=100,
+            max_kes_evolutions=62,
+            security_param=cfg.k,
+            active_slot_coeff=cfg.active_slot_coeff,
+            epoch_length=cfg.epoch_length,
+            kes_depth=cfg.kes_depth,
+        )
+        self.pools = [
+            fixtures.make_pool(i, kes_depth=cfg.kes_depth)
+            for i in range(cfg.n_nodes)
+        ]
+        self.lview = fixtures.make_ledger_view(self.pools)
+        self.nodes: list[NodeKernel] = []
+        self.node_tasks: dict[int, list] = {}  # node -> sim Tasks to kill
+        # node -> [(chain_db, follower)] registered by its edges; closed
+        # when either endpoint restarts (a killed server must not leak
+        # its follower on the surviving peer's ChainDB)
+        self.node_followers: dict[int, list] = {}
+        self.n_restarts = 0
+        forgers = cfg.forgers if cfg.forgers is not None else list(range(cfg.n_nodes))
+        self.forgers = set(forgers)
+        self.edges = cfg.topology
+        if self.edges is None:
+            self.edges = [
+                (i, j)
+                for i in range(cfg.n_nodes)
+                for j in range(cfg.n_nodes)
+                if i != j
+            ]
+        self.join = cfg.join_plan or {}
+
+    # -- vertices -----------------------------------------------------------
+
+    def _open_db(self, i: int, validate_all: bool = False):
+        ledger = MockLedger(MockConfig(self.lview, self.params.stability_window))
+        protocol = PraosProtocol(
+            self.params, use_device_batch=self.cfg.use_device_batch
+        )
         ext = ExtLedger(ledger, protocol)
         genesis = ext.genesis(ledger.genesis_state([(b"addr-%d" % i, 100)]))
-        db = open_chaindb(
-            os.path.join(base_dir, f"node{i}"), ext, genesis, cfg.k
-        )
-        nodes.append(
-            NodeKernel(
-                f"node{i}",
-                db,
-                protocol,
-                ledger,
-                pool=pools[i],
-                clock=SlotClock(cfg.slot_length),
+        cif = None
+        if self.cfg.in_future_check:
+            from ..block.infuture import CheckInFuture
+
+            cif = CheckInFuture(
+                now=lambda: self.sim.now, slot_length=self.cfg.slot_length
             )
+        db = open_chaindb(
+            os.path.join(self.base_dir, f"node{i}"), ext, genesis, self.cfg.k,
+            validate_all=validate_all, check_in_future=cif,
         )
+        return db, protocol, ledger
 
-    edges = cfg.topology
-    if edges is None:
-        edges = [
-            (i, j)
-            for i in range(cfg.n_nodes)
-            for j in range(cfg.n_nodes)
-            if i != j
-        ]
+    def make_node(self, i: int) -> NodeKernel:
+        db, protocol, ledger = self._open_db(i)
+        node = NodeKernel(
+            f"node{i}", db, protocol, ledger,
+            pool=self.pools[i] if i in self.forgers else None,
+            clock=SlotClock(self.cfg.slot_length),
+        )
+        self._wire_chaindb(i, node)
+        return node
 
-    sim = Sim()
-    for i, node in enumerate(nodes):
-        if cfg.async_chaindb:
-            runners = node.chain_db.start_decoupled(sim)
-            sim.spawn(runners[0], f"addblock{i}")
-            sim.spawn(runners[1], f"background{i}")
-        sim.spawn(node.forging_loop(cfg.n_slots), f"forge{i}")
+    def _wire_chaindb(self, i: int, node: NodeKernel) -> None:
+        if self.cfg.async_chaindb:
+            runners = node.chain_db.start_decoupled(self.sim)
+            self.node_tasks.setdefault(i, []).append(
+                self.sim.spawn(runners[0], f"addblock{i}")
+            )
+            self.node_tasks[i].append(self.sim.spawn(runners[1], f"background{i}"))
+        else:
+            # followers still fire wakeup events through the sim so the
+            # ChainSync server blocks instead of polling
+            node.chain_db.runtime = self.sim
 
-    # edge (i, j): node j syncs FROM node i (i serves, j consumes)
-    for (i, j) in edges:
-        server_node, client_node = nodes[i], nodes[j]
+    def spawn_vertex(self, i: int, start_slot: int) -> None:
+        node = self.nodes[i]
+        if node.pool is not None:
+            dt = max(0.0, node.clock.start_of(start_slot) - self.sim.now)
+            self.node_tasks.setdefault(i, []).append(
+                self.sim.spawn(
+                    _delayed(dt, node.forging_loop(self.cfg.n_slots, start_slot)),
+                    f"forge{i}",
+                )
+            )
+
+    # -- edges --------------------------------------------------------------
+
+    def spawn_edge(self, i: int, j: int, dt: float = 0.0) -> None:
+        """Edge (i, j): node j syncs FROM node i (i serves, j consumes)."""
+        cfg = self.cfg
+        server_node, client_node = self.nodes[i], self.nodes[j]
         cand = Candidate()
         client_node.candidates[f"node{i}"] = cand
         cs_req = Channel(delay=cfg.msg_delay, name=f"cs-req-{i}-{j}")
         cs_rsp = Channel(delay=cfg.msg_delay, name=f"cs-rsp-{i}-{j}")
         bf_req = Channel(delay=cfg.msg_delay, name=f"bf-req-{i}-{j}")
         bf_rsp = Channel(delay=cfg.msg_delay, name=f"bf-rsp-{i}-{j}")
-        sim.spawn(
-            chainsync.server(server_node.chain_db, cs_req, cs_rsp),
-            f"cs-server-{i}->{j}",
+        cs_follower = server_node.chain_db.new_follower(include_tentative=True)
+        for end in (i, j):
+            self.node_followers.setdefault(end, []).append(
+                (server_node.chain_db, cs_follower)
+            )
+        pairs = [
+            (i, chainsync.server(server_node.chain_db, cs_req, cs_rsp,
+                                 follower=cs_follower),
+             f"cs-server-{i}->{j}"),
+            (j, chainsync.client(client_node, f"node{i}", cs_rsp, cs_req, cand),
+             f"cs-client-{i}->{j}"),
+            (i, blockfetch.server(server_node.chain_db, bf_req, bf_rsp),
+             f"bf-server-{i}->{j}"),
+            (j, blockfetch.client(client_node, f"node{i}", bf_rsp, bf_req, cand),
+             f"bf-client-{i}->{j}"),
+        ]
+        if cfg.tx_submission:
+            ts_req = Channel(delay=cfg.msg_delay, name=f"ts-req-{i}-{j}")
+            ts_rsp = Channel(delay=cfg.msg_delay, name=f"ts-rsp-{i}-{j}")
+            pairs.append(
+                (i, txsubmission.outbound(server_node, ts_req, ts_rsp),
+                 f"ts-outbound-{i}->{j}")
+            )
+            pairs.append(
+                (j, txsubmission.inbound(client_node, f"node{i}", ts_rsp, ts_req),
+                 f"ts-inbound-{i}->{j}")
+            )
+        for owner, gen, name in pairs:
+            task = self.sim.spawn(_delayed(dt, gen), name)
+            # edge tasks die with EITHER endpoint's restart
+            self.node_tasks.setdefault(i, []).append(task)
+            self.node_tasks.setdefault(j, []).append(task)
+
+    # -- restarts (NodeRestarts.hs) -----------------------------------------
+
+    def restart_node(self, i: int, slot: int) -> None:
+        """Kill the node's tasks, reopen its ChainDB with FULL
+        revalidation (crash-marker policy), optionally rekey, respawn."""
+        for t in self.node_tasks.get(i, []):
+            t.alive = False
+        self.node_tasks[i] = []
+        for (db_, f) in self.node_followers.get(i, []):
+            f.close()  # idempotent — the pair is registered at both ends
+        self.node_followers[i] = []
+        old = self.nodes[i]
+        old.chain_db.close()
+        db, protocol, ledger = self._open_db(i, validate_all=True)
+        pool = self.pools[i] if i in self.forgers else None
+        carry = pool is not None and not self.cfg.rekey_on_restart
+        node = NodeKernel(
+            f"node{i}", db, protocol, ledger,
+            pool=pool,
+            clock=SlotClock(self.cfg.slot_length),
+            # carry the EVOLVED hot key + certificate across the restart
+            # (forward security: never re-derive from the root seed)
+            hotkey=old.hotkey if carry else None,
+            ocert=old._ocert if carry else None,
+            ocert_counter=old._ocert_counter if carry else 0,
         )
-        sim.spawn(
-            chainsync.client(client_node, f"node{i}", cs_rsp, cs_req, cand),
-            f"cs-client-{i}->{j}",
-        )
-        sim.spawn(
-            blockfetch.server(server_node.chain_db, bf_req, bf_rsp),
-            f"bf-server-{i}->{j}",
-        )
-        sim.spawn(
-            blockfetch.client(client_node, f"node{i}", bf_rsp, bf_req, cand),
-            f"bf-client-{i}->{j}",
-        )
+        if pool is not None and self.cfg.rekey_on_restart:
+            node._ocert_counter = old._ocert_counter
+            node.rekey(slot)
+        self._wire_chaindb(i, node)
+        self.nodes[i] = node
+        self.n_restarts += 1
+        # resume forging from the NEXT slot boundary; re-establish edges.
+        # Edges to peers that have not yet joined were killed with this
+        # node's tasks: respawn them with their remaining join delay so
+        # the late joiner still gets connected.
+        self.spawn_vertex(i, slot + 1)
+        for (a, b) in self.edges:
+            if i in (a, b):
+                other = b if a == i else a
+                other_join = self.join.get(other, 0)
+                dt = max(
+                    0.0,
+                    other_join * self.cfg.slot_length - self.sim.now,
+                )
+                self.spawn_edge(a, b, dt)
+
+    def restart_controller(self, restarts):
+        last = 0.0
+        for slot, node_ix in sorted(restarts):
+            # restart mid-slot so the node misses that slot's forging
+            at = slot * self.cfg.slot_length + 0.5 * self.cfg.slot_length
+            if at > last:
+                yield Sleep(at - last)
+                last = at
+            self.restart_node(node_ix, slot)
+
+
+def run_thread_network(base_dir: str, cfg: ThreadNetConfig) -> ThreadNetResult:
+    sim = Sim(seed=cfg.seed)
+    net = _Net(base_dir, cfg, sim)
+    for i in range(cfg.n_nodes):
+        net.nodes.append(net.make_node(i))
+    for i in range(cfg.n_nodes):
+        net.spawn_vertex(i, net.join.get(i, 0))
+    for (i, j) in net.edges:
+        # an edge exists once BOTH endpoints have joined
+        dt = max(net.join.get(i, 0), net.join.get(j, 0)) * cfg.slot_length
+        net.spawn_edge(i, j, dt)
+    if cfg.restarts:
+        sim.spawn(net.restart_controller(cfg.restarts), "restart-controller")
+    if cfg.tx_injections:
+        def injector():
+            last = 0.0
+            for slot, node_ix, tx_bytes in sorted(cfg.tx_injections):
+                at = slot * cfg.slot_length
+                if at > last:
+                    yield Sleep(at - last)
+                    last = at
+                net.nodes[node_ix].mempool.add_tx(tx_bytes)
+        sim.spawn(injector(), "tx-injector")
 
     # run: all slots + 2s of virtual drain time for in-flight messages
     sim.run(until=cfg.n_slots * cfg.slot_length + 2.0)
 
-    res = ThreadNetResult(nodes, sim)
-    for node in nodes:
+    res = ThreadNetResult(net.nodes, sim, n_restarts=net.n_restarts)
+    for node in net.nodes:
         res.chains.append(list(node.chain_db.stream_all()))
     return res
 
@@ -164,3 +343,20 @@ def check_chain_growth(res: ThreadNetResult, cfg: ThreadNetConfig) -> None:
     # loose: expect at least n_slots * f / 4 blocks
     expect = int(cfg.n_slots * float(cfg.active_slot_coeff) / 4)
     assert min_len >= expect, f"chain too short: {min_len} < {expect}"
+
+
+def expected_chain_length(cfg: ThreadNetConfig) -> int:
+    """Reference simulator (the Ref/PBFT.hs role) for the DETERMINISTIC
+    layout: a single forger with f=1 forges in every slot it is up —
+    all slots except those before its join slot and the slot of each of
+    its restarts (the restart lands mid-slot, killing that slot's
+    block... which was forged at slot START, so only slots whose forging
+    happened while the node was down are lost: none after a clean
+    mid-slot restart). Requires cfg.forgers == [i] and f == 1."""
+    assert cfg.forgers is not None and len(cfg.forgers) == 1
+    assert cfg.active_slot_coeff == Fraction(1)
+    forger = cfg.forgers[0]
+    join = (cfg.join_plan or {}).get(forger, 0)
+    # a MID-slot restart loses no slots: the slot's block was forged at
+    # the slot START and survives on disk; forging resumes at slot+1
+    return cfg.n_slots - join

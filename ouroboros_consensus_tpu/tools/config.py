@@ -1,0 +1,135 @@
+"""JSON node config + genesis loading for the CLI tools.
+
+Reference: `ouroboros-consensus-cardano/src/tools/Cardano/Node/`
+(Types.hs + Protocol/{Byron,Shelley,Alonzo,Conway}.hs) — db-analyser and
+db-synthesizer read a `config.json` pointing at per-era genesis files and
+credential files (fixture: `test/tools-test/disk/config/config.json`),
+from which `mkProtocolInfo` assembles the protocol configuration.
+
+This framework's single-protocol analog:
+
+  config.json            {"Protocol": "Praos",
+                          "GenesisFile": "genesis.json",
+                          "CredentialsFile": "credentials.json"?}
+  genesis.json           protocol parameters + pool distribution
+                         (verification side: what validation needs)
+  credentials.json       signing seeds per pool (synthesizer side only,
+                         the analog of the bulk credentials file
+                         DBSynthesizer/Run.hs loads)
+
+`write_genesis_files` is the inverse, emitted by db_synthesizer so a
+synthesized chain carries its own config — the tools-test pipeline shape
+(synthesize with config → analyse with the same config).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from fractions import Fraction
+
+from ..protocol.praos import PraosParams
+from ..protocol.views import IndividualPoolStake, LedgerView
+from ..testing.fixtures import PoolCredentials
+
+
+def _params_to_json(p: PraosParams) -> dict:
+    return {
+        "slotsPerKESPeriod": p.slots_per_kes_period,
+        "maxKESEvolutions": p.max_kes_evolutions,
+        "securityParam": p.security_param,
+        "activeSlotsCoeff": [
+            p.active_slot_coeff.numerator, p.active_slot_coeff.denominator
+        ],
+        "epochLength": p.epoch_length,
+        "kesDepth": p.kes_depth,
+    }
+
+
+def _params_from_json(o: dict) -> PraosParams:
+    num, den = o["activeSlotsCoeff"]
+    return PraosParams(
+        slots_per_kes_period=o["slotsPerKESPeriod"],
+        max_kes_evolutions=o["maxKESEvolutions"],
+        security_param=o["securityParam"],
+        active_slot_coeff=Fraction(num, den),
+        epoch_length=o["epochLength"],
+        kes_depth=o["kesDepth"],
+    )
+
+
+def write_genesis_files(
+    dir_path: str,
+    params: PraosParams,
+    lview: LedgerView,
+    pools: list[PoolCredentials] | None = None,
+) -> str:
+    """Write config.json + genesis.json (+ credentials.json when signing
+    material is provided). Returns the config.json path."""
+    os.makedirs(dir_path, exist_ok=True)
+    genesis = {
+        "params": _params_to_json(params),
+        "poolDistr": [
+            {
+                "poolId": pid.hex(),
+                "stake": [ips.stake.numerator, ips.stake.denominator],
+                "vrfKeyHash": ips.vrf_key_hash.hex(),
+            }
+            for pid, ips in sorted(lview.pool_distr.items())
+        ],
+    }
+    with open(os.path.join(dir_path, "genesis.json"), "w") as f:
+        json.dump(genesis, f, indent=1, sort_keys=True)
+    config = {"Protocol": "Praos", "GenesisFile": "genesis.json"}
+    if pools is not None:
+        creds = [
+            {
+                "coldSeed": p.cold_seed.hex(),
+                "vrfSeed": p.vrf_seed.hex(),
+                "kesSeed": p.kes_seed.hex(),
+                "kesDepth": p.kes_depth,
+            }
+            for p in pools
+        ]
+        with open(os.path.join(dir_path, "credentials.json"), "w") as f:
+            json.dump(creds, f, indent=1)
+        config["CredentialsFile"] = "credentials.json"
+    cpath = os.path.join(dir_path, "config.json")
+    with open(cpath, "w") as f:
+        json.dump(config, f, indent=1, sort_keys=True)
+    return cpath
+
+
+def load_config(config_path: str):
+    """mkProtocolInfo analog: (params, ledger_view, pools|None)."""
+    base = os.path.dirname(os.path.abspath(config_path))
+    with open(config_path) as f:
+        config = json.load(f)
+    if config.get("Protocol", "Praos") != "Praos":
+        raise ValueError(f"unsupported Protocol {config.get('Protocol')!r}")
+    with open(os.path.join(base, config["GenesisFile"])) as f:
+        genesis = json.load(f)
+    params = _params_from_json(genesis["params"])
+    lview = LedgerView(
+        pool_distr={
+            bytes.fromhex(e["poolId"]): IndividualPoolStake(
+                Fraction(e["stake"][0], e["stake"][1]),
+                bytes.fromhex(e["vrfKeyHash"]),
+            )
+            for e in genesis["poolDistr"]
+        }
+    )
+    pools = None
+    if "CredentialsFile" in config:
+        with open(os.path.join(base, config["CredentialsFile"])) as f:
+            creds = json.load(f)
+        pools = [
+            PoolCredentials(
+                cold_seed=bytes.fromhex(c["coldSeed"]),
+                vrf_seed=bytes.fromhex(c["vrfSeed"]),
+                kes_seed=bytes.fromhex(c["kesSeed"]),
+                kes_depth=c["kesDepth"],
+            )
+            for c in creds
+        ]
+    return params, lview, pools

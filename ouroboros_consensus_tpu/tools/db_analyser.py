@@ -303,12 +303,27 @@ def main(argv=None) -> None:
     )
     p.add_argument("--backend", choices=["device", "native", "host"], default="device")
     p.add_argument("--out-csv", default=None)
+    p.add_argument("--config", default=None,
+                   help="node config.json (defaults to <db>/config/config.json "
+                        "when present) instead of --pools/--kes-depth")
     a = p.parse_args(argv)
     if a.analysis == "count-blocks":
         print(count_blocks(a.db))
         return
-    params = default_params(kes_depth=a.kes_depth)
-    _, lview = make_credentials(a.pools, kes_depth=a.kes_depth)
+    import os as _os
+
+    config = a.config
+    if config is None:
+        implicit = _os.path.join(a.db, "config", "config.json")
+        if _os.path.exists(implicit):
+            config = implicit
+    if config:
+        from .config import load_config
+
+        params, lview, _pools = load_config(config)
+    else:
+        params = default_params(kes_depth=a.kes_depth)
+        _, lview = make_credentials(a.pools, kes_depth=a.kes_depth)
     if a.analysis == "benchmark-ledger-ops":
         rows = benchmark_ledger_ops(a.db, params, lview, out_csv=a.out_csv)
         print(f"{len(rows)} blocks benchmarked" + (

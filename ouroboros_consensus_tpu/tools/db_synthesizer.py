@@ -123,7 +123,11 @@ def synthesize(
         (limit.epochs or 0) * params.epoch_length
     )
     if vrf_backend == "auto":
-        vrf_backend = "device" if n_target * len(pools) >= 2048 else "host"
+        # host signing runs through the native C library (ops/host/fast)
+        # at ~0.3 ms/proof — robust on every platform; the device span
+        # prover stays opt-in (vrf_backend="device") for chips where the
+        # sign-side kernels compile fast
+        vrf_backend = "host"
 
     res = ForgeResult()
     t0 = time.monotonic()
@@ -220,14 +224,30 @@ def main(argv=None) -> None:
     lim.add_argument("--blocks", type=int)
     lim.add_argument("--epochs", type=int)
     p.add_argument("--txs-per-block", type=int, default=0)
+    p.add_argument("--config", default=None,
+                   help="node config.json (with CredentialsFile) instead "
+                        "of --pools/--kes-depth generated credentials")
     a = p.parse_args(argv)
-    params = default_params(kes_depth=a.kes_depth)
-    pools, lview = make_credentials(a.pools, kes_depth=a.kes_depth)
+    if a.config:
+        from .config import load_config
+
+        params, lview, pools = load_config(a.config)
+        if pools is None:
+            p.error("--config needs a CredentialsFile to forge with")
+    else:
+        params = default_params(kes_depth=a.kes_depth)
+        pools, lview = make_credentials(a.pools, kes_depth=a.kes_depth)
     res = synthesize(
         a.out, params, pools, lview,
         ForgeLimit(slots=a.slots, blocks=a.blocks, epochs=a.epochs),
         txs_per_block=a.txs_per_block,
         trace=lambda s: print(s),
+    )
+    # the chain carries its own config (tools-test pipeline shape)
+    from .config import write_genesis_files
+
+    write_genesis_files(
+        os.path.join(a.out, "config"), params, lview, pools
     )
     print(
         f"forged {res.n_blocks} blocks over {res.n_slots} slots "

@@ -1,0 +1,77 @@
+"""mempool-bench: timed bulk tx additions against a mocked ledger.
+
+Reference: `ouroboros-consensus/bench/mempool-bench/Main.hs:50` — the
+"Just adding" benchmark adds batches of txs (CI sizes 10k and 1M) to a
+mempool backed by a mocked ledger and reports per-batch wall time as
+CSV/JSON for the dashboard (docs/website/docs/benchmarks/index.md).
+
+Usage:  python -m ouroboros_consensus_tpu.tools.mempool_bench \
+            [--sizes 10000,1000000] [--csv out.csv]
+Prints one JSON line per size: {"n_txs": N, "seconds": s, "txs_per_s": r}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from ..ledger import mock as mock_ledger
+from ..mempool import Mempool
+
+
+def build_mempool(n_outputs: int) -> Mempool:
+    ledger = mock_ledger.MockLedger(mock_ledger.MockConfig(None, 100))
+    state = ledger.genesis_state(
+        [(b"addr-%d" % i, 1) for i in range(n_outputs)]
+    )
+    # capacity out of the picture — the ledger fold is what's timed
+    return Mempool(ledger, lambda: (state, 0), capacity_bytes=1 << 62)
+
+
+def gen_txs(n: int) -> list[bytes]:
+    """n independent single-input single-output txs (the benchmark's
+    simple txs: every one validates against the genesis UTxO)."""
+    return [
+        mock_ledger.encode_tx([(bytes(32), i)], [(b"out-%d" % i, 1)])
+        for i in range(n)
+    ]
+
+
+def bench_add_txs(n: int) -> dict:
+    pool = build_mempool(n)
+    txs = gen_txs(n)
+    t0 = time.monotonic()
+    accepted, rejected = pool.try_add_txs(txs)
+    dt = time.monotonic() - t0
+    assert not rejected, f"{len(rejected)} unexpected rejections"
+    assert len(accepted) == n
+    return {
+        "n_txs": n,
+        "seconds": round(dt, 4),
+        "txs_per_s": round(n / dt) if dt else None,
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--sizes", default="10000,1000000",
+        help="comma-separated batch sizes (reference CI: 10k and 1M)",
+    )
+    ap.add_argument("--csv", default=None, help="also append CSV rows here")
+    args = ap.parse_args(argv)
+    rows = []
+    for size in (int(s) for s in args.sizes.split(",")):
+        r = bench_add_txs(size)
+        rows.append(r)
+        print(json.dumps(r))
+    if args.csv:
+        with open(args.csv, "a") as f:
+            for r in rows:
+                f.write(f"{r['n_txs']},{r['seconds']},{r['txs_per_s']}\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,250 @@
+"""HasFS: the filesystem seam.
+
+Reference: the external `fs-api` package (re-exported via
+`Ouroboros.Consensus.Storage.FS`) gives every storage component a
+`HasFS m h` record instead of raw IO, and `fs-sim` provides an in-memory
+implementation with fault injection — the substrate of the q-s-m storage
+state-machine tests (SURVEY §4 tier 2; `Test/Util/FS/Sim/MockFS.hs`,
+`Test/Util/Corruption.hs`).
+
+Here the seam is a small duck-typed interface sized to what the storage
+layer actually does (whole-file reads, positional reads, appends,
+atomic-replace writes, fsync, listing, removal):
+
+  * `RealFS` — thin shim over `os`/`open`; rooted at a directory.
+  * `MockFS` — in-memory files with an fsync watermark. `crash()`
+    reverts every file to its last-synced prefix and then tears the
+    unsynced suffix at a caller-chosen fraction — the torn-write model
+    the reference injects via fs-sim. `corrupt_byte`/`truncate_file`/
+    `wipe` are the q-s-m Corruption commands (StateMachine.hs corrupt/
+    wipe generators).
+
+Paths are plain strings (POSIX-joined); components never hold handles
+open across calls, so the interface is stateless per operation — which
+is also what makes the mock's crash semantics tractable.
+"""
+
+from __future__ import annotations
+
+import os
+import posixpath
+
+
+class FsError(OSError):
+    """Mock analog of the IO errors the real FS raises (FsError in
+    fs-api): storage code catches OSError, so subclass it."""
+
+
+class RealFS:
+    """HasFS over the real filesystem, rooted at `root` (the reference's
+    `ioHasFS` with a MountPoint)."""
+
+    def __init__(self, root: str = "/"):
+        self.root = root
+
+    def _p(self, path: str) -> str:
+        return os.path.join(self.root, path) if self.root != "/" else path
+
+    # -- directories ---------------------------------------------------------
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(self._p(path), exist_ok=True)
+
+    def listdir(self, path: str) -> list[str]:
+        return os.listdir(self._p(path))
+
+    def isdir(self, path: str) -> bool:
+        return os.path.isdir(self._p(path))
+
+    # -- queries -------------------------------------------------------------
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._p(path))
+
+    def getsize(self, path: str) -> int:
+        return os.path.getsize(self._p(path))
+
+    # -- reads ---------------------------------------------------------------
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(self._p(path), "rb") as f:
+            return f.read()
+
+    def read_at(self, path: str, offset: int, size: int) -> bytes:
+        with open(self._p(path), "rb") as f:
+            f.seek(offset)
+            return f.read(size)
+
+    # -- writes --------------------------------------------------------------
+
+    def append(self, path: str, data: bytes) -> None:
+        with open(self._p(path), "ab") as f:
+            f.write(data)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        with open(self._p(path), "wb") as f:
+            f.write(data)
+
+    def write_atomic(self, path: str, data: bytes) -> None:
+        """tmp-write + fsync + rename — the snapshot/index discipline."""
+        tmp = self._p(path) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._p(path))
+
+    def truncate(self, path: str, size: int) -> None:
+        with open(self._p(path), "r+b") as f:
+            f.truncate(size)
+
+    def remove(self, path: str) -> None:
+        if os.path.exists(self._p(path)):
+            os.remove(self._p(path))
+
+    def fsync(self, path: str) -> None:
+        fd = os.open(self._p(path), os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+class _MockFile:
+    __slots__ = ("data", "synced")
+
+    def __init__(self, data: bytes = b""):
+        self.data = bytearray(data)
+        self.synced = len(data)  # fsync watermark (crash keeps ≤ this)
+
+
+class MockFS:
+    """In-memory HasFS with crash/corruption injection (fs-sim analog)."""
+
+    def __init__(self):
+        self._files: dict[str, _MockFile] = {}
+        self._dirs: set[str] = {""}
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        p = posixpath.normpath(path).lstrip("/")
+        return "" if p == "." else p
+
+    # -- directories ---------------------------------------------------------
+
+    def makedirs(self, path: str) -> None:
+        p = self._norm(path)
+        parts = p.split("/") if p else []
+        for i in range(len(parts)):
+            self._dirs.add("/".join(parts[: i + 1]))
+
+    def listdir(self, path: str) -> list[str]:
+        p = self._norm(path)
+        if p not in self._dirs:
+            raise FsError(f"no such directory: {path}")
+        prefix = p + "/" if p else ""
+        out = set()
+        for f in self._files:
+            if f.startswith(prefix):
+                out.add(f[len(prefix):].split("/")[0])
+        for d in self._dirs:
+            if d != p and d.startswith(prefix):
+                out.add(d[len(prefix):].split("/")[0])
+        return sorted(out)
+
+    def isdir(self, path: str) -> bool:
+        return self._norm(path) in self._dirs
+
+    # -- queries -------------------------------------------------------------
+
+    def exists(self, path: str) -> bool:
+        p = self._norm(path)
+        return p in self._files or p in self._dirs
+
+    def getsize(self, path: str) -> int:
+        f = self._files.get(self._norm(path))
+        if f is None:
+            raise FsError(f"no such file: {path}")
+        return len(f.data)
+
+    # -- reads ---------------------------------------------------------------
+
+    def read_bytes(self, path: str) -> bytes:
+        f = self._files.get(self._norm(path))
+        if f is None:
+            raise FsError(f"no such file: {path}")
+        return bytes(f.data)
+
+    def read_at(self, path: str, offset: int, size: int) -> bytes:
+        return self.read_bytes(path)[offset : offset + size]
+
+    # -- writes --------------------------------------------------------------
+
+    def append(self, path: str, data: bytes) -> None:
+        f = self._files.setdefault(self._norm(path), _MockFile())
+        f.data.extend(data)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        p = self._norm(path)
+        f = self._files.get(p)
+        if f is None:
+            self._files[p] = _MockFile(data)
+            self._files[p].synced = 0
+        else:
+            f.data = bytearray(data)
+            f.synced = min(f.synced, 0)
+
+    def write_atomic(self, path: str, data: bytes) -> None:
+        # rename after fsync: atomic + durable in one step
+        p = self._norm(path)
+        nf = _MockFile(data)
+        nf.synced = len(data)
+        self._files[p] = nf
+
+    def truncate(self, path: str, size: int) -> None:
+        f = self._files.get(self._norm(path))
+        if f is None:
+            raise FsError(f"no such file: {path}")
+        del f.data[size:]
+        f.synced = min(f.synced, size)
+
+    def remove(self, path: str) -> None:
+        self._files.pop(self._norm(path), None)
+
+    def fsync(self, path: str) -> None:
+        f = self._files.get(self._norm(path))
+        if f is not None:
+            f.synced = len(f.data)
+
+    # -- fault injection (fs-sim / Test/Util/Corruption.hs) ------------------
+
+    def crash(self, keep_fraction: float = 0.0) -> None:
+        """Simulated process/OS crash: unsynced suffixes survive only up
+        to `keep_fraction` of their length (0 = lose all unsynced bytes,
+        1 = lose nothing) — the torn-write model."""
+        for f in self._files.values():
+            if len(f.data) > f.synced:
+                keep = f.synced + int((len(f.data) - f.synced) * keep_fraction)
+                del f.data[keep:]
+
+    def corrupt_byte(self, path: str, offset: int, xor: int = 0xFF) -> None:
+        f = self._files[self._norm(path)]
+        if 0 <= offset < len(f.data):
+            f.data[offset] ^= xor
+
+    def truncate_file(self, path: str, size: int) -> None:
+        self.truncate(path, size)
+
+    def wipe(self, path: str) -> None:
+        """Remove a file or a whole directory tree."""
+        p = self._norm(path)
+        for k in [k for k in self._files if k == p or k.startswith(p + "/")]:
+            del self._files[k]
+        for d in [d for d in self._dirs if d != p and d.startswith(p + "/")]:
+            self._dirs.discard(d)
+
+    def files(self) -> list[str]:
+        return sorted(self._files)
+
+
+REAL_FS = RealFS()

@@ -1,0 +1,137 @@
+"""ResourceRegistry + RAWLock: structured concurrency for the sim runtime.
+
+Reference: `Ouroboros.Consensus.Util.ResourceRegistry` (1,341 LoC) —
+hierarchical ownership of threads/resources with guaranteed reverse-order
+release and exception linking to the registry owner — and
+`Util/MonadSTM/RAWLock.hs` — the Read/Append/Write lock coordinating
+ImmutableDB readers, the single appender, and exclusive writers (GC).
+
+The sim runtime (utils/sim.py) already gives exception LINKING — a task
+that raises aborts the whole Sim.run with TaskFailed, which is the
+`forkLinkedThread` behavior. The registry adds the ownership half:
+resources/tasks registered here die with the registry, LIFO, exactly
+once (ResourceRegistry.hs releaseAll).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Generator
+
+from .sim import Event, Sim, Wait
+
+
+class RegistryClosed(Exception):
+    pass
+
+
+class ResourceRegistry:
+    """Owns resources + linked tasks; `close()` kills tasks and releases
+    resources in reverse allocation order (ResourceRegistry.hs:releaseAll).
+    Usable as a context manager (the reference's withRegistry)."""
+
+    def __init__(self, sim: Sim | None = None):
+        self.sim = sim
+        self._resources: list[tuple[Any, Callable[[Any], None]]] = []
+        self._tasks: list = []
+        self._closed = False
+
+    # -- resources -----------------------------------------------------------
+
+    def allocate(self, acquire: Callable[[], Any], release: Callable[[Any], None]):
+        """allocate (ResourceRegistry.hs): acquire now, release at close."""
+        if self._closed:
+            raise RegistryClosed()
+        r = acquire()
+        self._resources.append((r, release))
+        return r
+
+    # -- linked tasks --------------------------------------------------------
+
+    def fork_linked(self, gen: Generator, name: str = "linked"):
+        """forkLinkedThread: the task dies with the registry; its
+        exceptions already propagate to Sim.run (TaskFailed)."""
+        if self._closed:
+            raise RegistryClosed()
+        assert self.sim is not None, "fork_linked needs a Sim"
+        task = self.sim.spawn(gen, name)
+        self._tasks.append(task)
+        return task
+
+    # -- shutdown ------------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for t in reversed(self._tasks):
+            t.alive = False
+        for r, release in reversed(self._resources):
+            release(r)
+        self._resources.clear()
+        self._tasks.clear()
+
+    def __enter__(self) -> "ResourceRegistry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class RAWLock:
+    """Read-Append-Write lock (Util/MonadSTM/RAWLock.hs): any number of
+    concurrent readers AND at most one appender; a writer excludes
+    everyone. Writers take priority over new readers/appenders so they
+    cannot starve (the reference's ordering guarantee).
+
+    Usage from sim tasks:   yield from lock.acquire_read()
+                            ... lock.release_read()
+    """
+
+    def __init__(self, runtime):
+        self.runtime = runtime  # anything with .fire(Event)
+        self._readers = 0
+        self._appender = False
+        self._writer = False
+        self._writers_waiting = 0
+        self._changed = Event("rawlock")
+
+    def _wake(self):
+        self.runtime.fire(self._changed)
+
+    # -- read ----------------------------------------------------------------
+
+    def acquire_read(self):
+        while self._writer or self._writers_waiting:
+            yield Wait(self._changed)
+        self._readers += 1
+
+    def release_read(self):
+        assert self._readers > 0
+        self._readers -= 1
+        self._wake()
+
+    # -- append (one at a time, compatible with readers) ---------------------
+
+    def acquire_append(self):
+        while self._appender or self._writer or self._writers_waiting:
+            yield Wait(self._changed)
+        self._appender = True
+
+    def release_append(self):
+        assert self._appender
+        self._appender = False
+        self._wake()
+
+    # -- write (exclusive) ---------------------------------------------------
+
+    def acquire_write(self):
+        self._writers_waiting += 1
+        while self._readers or self._appender or self._writer:
+            yield Wait(self._changed)
+        self._writers_waiting -= 1
+        self._writer = True
+
+    def release_write(self):
+        assert self._writer
+        self._writer = False
+        self._wake()

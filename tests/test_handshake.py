@@ -1,0 +1,57 @@
+"""Handshake / NetworkProtocolVersion negotiation tests.
+
+Reference: Node/NetworkProtocolVersion.hs + stdVersionDataNTN (Node.hs).
+"""
+
+import pytest
+
+from ouroboros_consensus_tpu.miniprotocol import handshake
+from ouroboros_consensus_tpu.miniprotocol.handshake import (
+    HandshakeRefused,
+    VersionData,
+    negotiate,
+)
+from ouroboros_consensus_tpu.utils.sim import Channel, Sim
+
+MAGIC = VersionData(network_magic=764824073)
+
+
+def test_negotiate_highest_common():
+    ours = {1: MAGIC, 2: MAGIC, 3: MAGIC}
+    theirs = {1: MAGIC, 2: MAGIC}
+    assert negotiate(ours, theirs) == (2, MAGIC)
+
+
+def test_negotiate_refuses_disjoint_and_magic_mismatch():
+    with pytest.raises(HandshakeRefused):
+        negotiate({1: MAGIC}, {2: MAGIC})
+    with pytest.raises(HandshakeRefused):
+        negotiate({2: MAGIC}, {2: VersionData(network_magic=42)})
+
+
+def test_handshake_tasks_agree():
+    sim = Sim()
+    req, rsp = Channel(delay=0.01), Channel(delay=0.01)
+    c = sim.spawn(
+        handshake.client(rsp, req, {1: MAGIC, 2: MAGIC}), "client"
+    )
+    s = sim.spawn(
+        handshake.server(req, rsp, {2: MAGIC, 3: MAGIC}), "server"
+    )
+    sim.run(until=1.0)
+    assert c.result == (2, MAGIC)
+    assert s.result == (2, MAGIC)
+    # the negotiated version gates the app bundle (NodeToNode.hs Apps)
+    assert "txsubmission2" in handshake.NODE_TO_NODE_VERSIONS[2]
+    assert "peersharing" not in handshake.NODE_TO_NODE_VERSIONS[2]
+
+
+def test_handshake_refusal_propagates():
+    from ouroboros_consensus_tpu.utils.sim import TaskFailed
+
+    sim = Sim()
+    req, rsp = Channel(), Channel()
+    sim.spawn(handshake.client(rsp, req, {1: MAGIC}), "client")
+    sim.spawn(handshake.server(req, rsp, {3: MAGIC}), "server")
+    with pytest.raises(TaskFailed):
+        sim.run(until=1.0)

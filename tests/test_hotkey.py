@@ -1,0 +1,111 @@
+"""HotKey KES evolution + operational re-keying.
+
+Reference: `Protocol/Ledger/HotKey.hs` (KESInfo/kesStatus :45,90, HotKey
+record :124, mkHotKey :169 — evolution forgets old keys) and the ocert
+counter rules checked at `Praos.hs:585-605`; re-keying is the reference's
+`ThreadNet/Util/Rekeying.hs` scenario.
+"""
+
+import os
+from dataclasses import replace
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.ledger import ExtLedger
+from ouroboros_consensus_tpu.ledger import mock as mock_ledger
+from ouroboros_consensus_tpu.node.kernel import NodeKernel
+from ouroboros_consensus_tpu.ops.host import kes as hk
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.protocol.hotkey import (
+    HotKey,
+    KESBeforeStart,
+    KESKeyExpired,
+    KESInfo,
+    kes_status,
+)
+from ouroboros_consensus_tpu.protocol.instances import PraosProtocol
+from ouroboros_consensus_tpu.storage.open import open_chaindb
+from ouroboros_consensus_tpu.testing import fixtures
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=2,  # tiny: evolutions happen within a short chain
+    max_kes_evolutions=2,
+    security_param=3,
+    active_slot_coeff=Fraction(1),
+    epoch_length=10_000,
+    kes_depth=2,
+)
+POOL = fixtures.make_pool(0, kes_depth=2)
+LVIEW = fixtures.make_ledger_view([POOL])
+ETA0 = b"\x22" * 32
+
+
+def test_hotkey_signatures_match_static_signer():
+    seed, depth = b"\x11" * 32, 3
+    hot = HotKey(seed, depth, start_period=0)
+    assert hot.vk == hk.derive_vk(seed, depth)
+    for t in range(1 << depth):
+        msg = b"msg-%d" % t
+        assert hot.sign(t, msg) == hk.sign(seed, depth, t, msg)
+
+
+def test_hotkey_forgets_and_expires():
+    hot = HotKey(b"\x11" * 32, 2, start_period=5, max_evolutions=3)
+    hot.sign(6, b"a")  # evolution 1
+    with pytest.raises(KESBeforeStart):
+        hot.sign(5, b"b")  # forgotten
+    with pytest.raises(KESKeyExpired):
+        hot.sign(8, b"c")  # >= start+max_evolutions
+    assert kes_status(hot.kes_info(), 4) == "before"
+    assert kes_status(hot.kes_info(), 6) == "in_evolution"
+    assert kes_status(hot.kes_info(), 8) == "expired"
+
+
+def _mk_kernel(tmp_path):
+    ledger = mock_ledger.MockLedger(
+        mock_ledger.MockConfig(LVIEW, PARAMS.stability_window)
+    )
+    protocol = PraosProtocol(PARAMS, use_device_batch=False)
+    ext = ExtLedger(ledger, protocol)
+    st = ext.genesis(ledger.genesis_state([]))
+    st = replace(
+        st,
+        header_state=replace(
+            st.header_state,
+            chain_dep_state=replace(
+                st.header_state.chain_dep_state, epoch_nonce=ETA0
+            ),
+        ),
+    )
+    db = open_chaindb(str(tmp_path / "db"), ext, st, PARAMS.security_param)
+    return NodeKernel("n0", db, protocol, ledger, pool=POOL)
+
+
+def test_kernel_forges_across_kes_evolutions(tmp_path):
+    """Forging in later KES periods evolves the hot key in place; the
+    chain (ocert period 0, evolutions 0 and 1) validates end to end."""
+    kernel = _mk_kernel(tmp_path)
+    for slot in (1, 3):  # kes periods 0, 1
+        blk = kernel.try_forge(slot)
+        assert blk is not None, f"slot {slot}"
+        assert kernel.chain_db.tip_point().hash_ == blk.hash_
+    assert kernel.hotkey.evolution == 1
+
+
+def test_kernel_rekey_restores_forging(tmp_path):
+    """After max_kes_evolutions the key expires (CannotForge, not a
+    crash); rekey() issues counter+1 at the current period and forging —
+    and validation by the node's own ChainDB — resumes."""
+    kernel = _mk_kernel(tmp_path)
+    assert kernel.try_forge(1) is not None
+    # kes period 2 >= max_evolutions: expired => CannotForge
+    assert kernel.forge_only(5) is None
+    kernel.rekey(5)
+    assert kernel._ocert_counter == 1
+    blk = kernel.try_forge(5)
+    assert blk is not None
+    assert kernel.chain_db.tip_point().hash_ == blk.hash_
+    # the re-issued certificate starts at period 2, evolution 0
+    assert kernel._ocert.kes_period == 2
+    assert kernel.hotkey.evolution == 0

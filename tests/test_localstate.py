@@ -137,3 +137,20 @@ def test_tracers():
         pass
     assert [e.edge for e in lt3.events] == ["start", "end"]
     assert lt3.events[1].duration >= 0
+
+
+def test_query_versioning(node):
+    """Ledger/Query.hs queryVersion gating: a v1 session cannot name a
+    v2 query; the latest version can."""
+    import pytest as _pytest
+
+    from ouroboros_consensus_tpu.miniprotocol.localstate import (
+        QueryUnsupported,
+        run_query,
+    )
+
+    st = node.chain_db.current_ledger()
+    assert run_query(node, st, "get_tip_slot", (), version=1) is None
+    with _pytest.raises(QueryUnsupported):
+        run_query(node, st, "get_pool_distr", (), version=1)
+    assert run_query(node, st, "get_pool_distr", (), version=2) is not None

@@ -1,0 +1,33 @@
+"""BlockSupportsMetrics + NodeMetrics.
+
+Reference: Block/SupportsMetrics.hs (isSelfIssued) and the NodeKernel's
+metric reporting (NodeKernel.hs:88-114).
+"""
+
+from fractions import Fraction
+
+from ouroboros_consensus_tpu.block.metrics import NodeMetrics, is_self_issued
+
+from tests.test_hotkey import _mk_kernel  # same tiny-node fixture
+
+
+def test_is_self_issued(tmp_path):
+    kernel = _mk_kernel(tmp_path)
+    blk = kernel.forge_only(1)
+    assert is_self_issued(blk.header, kernel.pool.vk_cold)
+    assert not is_self_issued(blk.header, b"\x00" * 32)
+    assert not is_self_issued(blk.header, None)
+
+
+def test_kernel_metrics_counts(tmp_path):
+    kernel = _mk_kernel(tmp_path)
+    assert kernel.try_forge(1) is not None
+    assert kernel.try_forge(3) is not None
+    m = kernel.metrics
+    assert m.slots_led == 2
+    assert m.blocks_forged == 2
+    assert m.blocks_adopted_self == 2
+    assert m.blocks_adopted_peer == 0
+    # KES expiry at period 2 (max_evolutions=2) is CannotForge
+    assert kernel.forge_only(5) is None
+    assert m.blocks_could_not_forge == 1

@@ -1,0 +1,525 @@
+"""Model-based (stateful) storage tests over the mock filesystem.
+
+Mirror of the reference's strongest correctness tool — the
+quickcheck-state-machine suites run against pure models with fault
+injection (SURVEY §4 tier 2):
+
+  * `test/storage-test/Test/Ouroboros/Storage/ImmutableDB/StateMachine.hs`
+    (1,278 LoC; model `Model.hs`): random appends / reopens / corruption,
+    expecting truncate-the-corrupted-tail recovery.
+  * `.../VolatileDB/StateMachine.hs` (857): random puts (incl. dups),
+    GC by slot with file granularity, reopen-reparses.
+  * `.../ChainDB/StateMachine.hs` (1,710; model `ChainDB/Model.hs`,
+    1,118): addBlock in arbitrary orders vs a pure chain-selection model,
+    plus wipe/corrupt and reopen.
+
+Here: hypothesis `RuleBasedStateMachine`s drive the REAL implementations
+on an in-memory `MockFS` (utils/fs.py — the fs-sim analog) and compare
+them against small pure models after every command.  Crashes use
+MockFS.crash() — unsynced suffixes vanish (the torn-write model), and
+the property is prefix-recovery, exactly the reference's crash spec.
+
+Crypto runs through the native C++ verifier (protocol/praos.py
+NativeVerifier) so hundreds of sequential validations stay cheap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from fractions import Fraction
+
+import pytest
+from hypothesis import HealthCheck, settings
+from hypothesis import strategies as st
+from hypothesis.stateful import (
+    RuleBasedStateMachine,
+    initialize,
+    invariant,
+    precondition,
+    rule,
+)
+
+from ouroboros_consensus_tpu.block import forge_block
+from ouroboros_consensus_tpu.ledger import ExtLedger
+from ouroboros_consensus_tpu.ledger import mock as mock_ledger
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.protocol.instances import PraosProtocol
+from ouroboros_consensus_tpu.storage.immutable import ImmutableDB
+from ouroboros_consensus_tpu.storage.open import open_chaindb
+from ouroboros_consensus_tpu.storage.volatile import VolatileDB
+from ouroboros_consensus_tpu.testing import fixtures
+from ouroboros_consensus_tpu.utils.fs import MockFS
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=100,
+    max_kes_evolutions=62,
+    security_param=4,
+    active_slot_coeff=Fraction(1),  # every pool leads every slot
+    epoch_length=10_000,
+    kes_depth=2,
+)
+POOLS = [fixtures.make_pool(i, kes_depth=PARAMS.kes_depth) for i in range(2)]
+LVIEW = fixtures.make_ledger_view(POOLS)
+ETA0 = b"\x22" * 32
+K = 4
+CHUNK = 4
+CRYPTO = praos.native_verifier_or_host()
+
+
+def _forge(slot, block_no, prev, i=0):
+    return forge_block(
+        PARAMS, POOLS[i % 2], slot=slot, block_no=block_no,
+        prev_hash=prev, epoch_nonce=ETA0,
+    )
+
+
+def _build_tree():
+    """A fixed block tree, forged once: a 10-block main chain (even
+    slots) with 2-block fork branches off heights 2, 5 and 8 (odd
+    slots) — enough shape for chain selection to switch forks, hit the
+    immutability window, and reject older-than-k blocks."""
+    main = []
+    prev = None
+    for i in range(10):
+        b = _forge(2 * i + 2, i, prev, i)
+        main.append(b)
+        prev = b.hash_
+    branches = []
+    for h in (2, 5, 8):
+        parent = main[h]
+        b1 = _forge(parent.slot + 1, h + 1, parent.hash_, h + 1)
+        b2 = _forge(b1.slot + 2, h + 2, b1.hash_, h)
+        branches.extend([b1, b2])
+    return main, branches
+
+
+_TREE = None
+
+
+def tree():
+    global _TREE
+    if _TREE is None:
+        _TREE = _build_tree()
+    return _TREE
+
+
+MACHINE_SETTINGS = settings(
+    max_examples=12,
+    stateful_step_count=25,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow, HealthCheck.data_too_large],
+)
+
+
+# ---------------------------------------------------------------------------
+# ImmutableDB vs a list model (ImmutableDB/StateMachine.hs)
+# ---------------------------------------------------------------------------
+
+
+class ImmutableMachine(RuleBasedStateMachine):
+    PATH = "imm"
+
+    @initialize()
+    def setup(self):
+        self.fs = MockFS()
+        self.blocks = tree()[0]
+        self.db = ImmutableDB(self.PATH, chunk_size=CHUNK, fs=self.fs)
+        self.model: list = []  # appended blocks, in order
+        self.appended = 0
+
+    # -- helpers ------------------------------------------------------------
+
+    def _actual(self):
+        return [(e.slot, e.hash_, raw) for e, raw in self.db.stream_all()]
+
+    def _expected(self):
+        return [(b.slot, b.hash_, b.bytes_) for b in self.model]
+
+    def _chunk_layout(self):
+        """(chunk_file, offset, size) per model block, recomputed the way
+        appends laid them out."""
+        out = []
+        sizes: dict[int, int] = {}
+        for b in self.model:
+            n = b.slot // CHUNK
+            off = sizes.get(n, 0)
+            out.append((f"{self.PATH}/{n:05d}.chunk", off, len(b.bytes_)))
+            sizes[n] = off + len(b.bytes_)
+        return out
+
+    # -- commands -----------------------------------------------------------
+
+    @rule()
+    def append(self):
+        if self.appended >= len(self.blocks):
+            return
+        b = self.blocks[self.appended]
+        self.db.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+        self.model.append(b)
+        self.appended += 1
+
+    @rule()
+    def reopen(self):
+        self.db = ImmutableDB(
+            self.PATH, chunk_size=CHUNK, validate_all=True, fs=self.fs
+        )
+        assert self._actual() == self._expected()
+
+    @rule(keep=st.floats(0.0, 1.0))
+    def crash_and_reopen(self, keep):
+        """Torn-write crash: recovery must yield a PREFIX of the model
+        (nothing reordered, nothing invented), then resync the model."""
+        self.fs.crash(keep)
+        self.db = ImmutableDB(
+            self.PATH, chunk_size=CHUNK, validate_all=True, fs=self.fs
+        )
+        actual = self._actual()
+        assert actual == self._expected()[: len(actual)], "not a prefix"
+        self.model = self.model[: len(actual)]
+        self.appended = len(self.model)
+
+    @rule(data=st.data())
+    def corrupt_block_and_reopen(self, data):
+        """Flip one byte inside a stored block: reopen-with-validation
+        must truncate from that block on (CRC mismatch ⇒ corrupted-tail
+        truncation, Impl/Validation.hs:67)."""
+        if not self.model:
+            return
+        i = data.draw(st.integers(0, len(self.model) - 1))
+        path, off, size = self._chunk_layout()[i]
+        at = data.draw(st.integers(0, size - 1))
+        self.fs.corrupt_byte(path, off + at)
+        self.db = ImmutableDB(
+            self.PATH, chunk_size=CHUNK, validate_all=True, fs=self.fs
+        )
+        self.model = self.model[:i]
+        self.appended = len(self.model)
+        assert self._actual() == self._expected()
+
+    @rule(data=st.data())
+    def truncate_index_and_reopen(self, data):
+        """Index damage alone loses NO blocks: the chunk reparse rebuilds
+        it (crash-before-index-flush recovery)."""
+        if not self.model:
+            return
+        b = self.model[-1]
+        ipath = f"{self.PATH}/{b.slot // CHUNK:05d}.index"
+        if not self.fs.exists(ipath):
+            return
+        size = self.fs.getsize(ipath)
+        self.fs.truncate_file(ipath, data.draw(st.integers(0, max(0, size - 1))))
+        self.db = ImmutableDB(
+            self.PATH, chunk_size=CHUNK, validate_all=True, fs=self.fs
+        )
+        assert self._actual() == self._expected()
+
+    @rule(data=st.data())
+    def truncate_after(self, data):
+        if not self.model:
+            return
+        i = data.draw(st.integers(0, len(self.model) - 1))
+        from ouroboros_consensus_tpu.block.abstract import Point
+
+        self.db.truncate_after(Point(self.model[i].slot, self.model[i].hash_))
+        self.model = self.model[: i + 1]
+        self.appended = len(self.model)
+        assert self._actual() == self._expected()
+
+    # -- invariants ---------------------------------------------------------
+
+    @invariant()
+    def tip_matches(self):
+        if not hasattr(self, "db"):
+            return
+        t = self.db.tip()
+        if not self.model:
+            assert t is None
+        else:
+            assert t is not None
+            assert (t.slot, t.hash_) == (self.model[-1].slot, self.model[-1].hash_)
+
+    @invariant()
+    def reads_match(self):
+        if not hasattr(self, "db") or not self.model:
+            return
+        from ouroboros_consensus_tpu.block.abstract import Point
+
+        b = self.model[-1]
+        assert self.db.get_block_bytes(Point(b.slot, b.hash_)) == b.bytes_
+
+
+TestImmutableModel = ImmutableMachine.TestCase
+TestImmutableModel.settings = MACHINE_SETTINGS
+
+
+# ---------------------------------------------------------------------------
+# VolatileDB vs a file-aware model (VolatileDB/StateMachine.hs)
+# ---------------------------------------------------------------------------
+
+MAX_PER_FILE = 3
+
+
+class VolatileModel:
+    """Pure model of the VolatileDB including its file granularity —
+    which is API-visible through garbageCollect (whole files only)."""
+
+    def __init__(self):
+        self.files: dict[int, list] = {}  # file_no -> blocks in put order
+        self.by_hash: dict[bytes, object] = {}
+        self.write_file = 0
+
+    def put(self, blk):
+        if blk.hash_ in self.by_hash:
+            return
+        if len(self.files.get(self.write_file, [])) >= MAX_PER_FILE:
+            self.write_file += 1
+        self.files.setdefault(self.write_file, []).append(blk)
+        self.by_hash[blk.hash_] = blk
+
+    def gc(self, slot):
+        for n in list(self.files):
+            if n == self.write_file:
+                continue
+            if all(b.slot < slot for b in self.files[n]):
+                for b in self.files.pop(n):
+                    del self.by_hash[b.hash_]
+
+    def successors(self, prev):
+        return {b.hash_ for b in self.by_hash.values() if b.prev_hash == prev}
+
+
+class VolatileMachine(RuleBasedStateMachine):
+    PATH = "vol"
+
+    @initialize()
+    def setup(self):
+        self.fs = MockFS()
+        main, branches = tree()
+        self.pool = main + branches
+        self.db = VolatileDB(self.PATH, max_blocks_per_file=MAX_PER_FILE, fs=self.fs)
+        self.model = VolatileModel()
+
+    @rule(data=st.data())
+    def put(self, data):
+        b = data.draw(st.sampled_from(self.pool))
+        self.db.put_block(b)
+        self.model.put(b)
+
+    @rule(data=st.data())
+    def get(self, data):
+        b = data.draw(st.sampled_from(self.pool))
+        raw = self.db.get_block_bytes(b.hash_)
+        if b.hash_ in self.model.by_hash:
+            assert raw == b.bytes_
+        else:
+            assert raw is None
+
+    @rule(data=st.data())
+    def successors(self, data):
+        b = data.draw(st.sampled_from(self.pool))
+        for prev in (b.prev_hash, b.hash_):
+            assert self.db.filter_by_predecessor(prev) == self.model.successors(prev)
+
+    @rule(slot=st.integers(0, 30))
+    def gc(self, slot):
+        self.db.garbage_collect(slot)
+        self.model.gc(slot)
+        assert set(self.db.all_hashes()) == set(self.model.by_hash)
+
+    @rule()
+    def reopen(self):
+        self.db = VolatileDB(self.PATH, max_blocks_per_file=MAX_PER_FILE, fs=self.fs)
+        assert set(self.db.all_hashes()) == set(self.model.by_hash)
+
+    @rule(keep=st.floats(0.0, 1.0))
+    def crash_and_reopen(self, keep):
+        """After a crash each surviving file is a torn-truncated prefix;
+        reopen reparses what remains. Check per-file prefix, resync."""
+        self.fs.crash(keep)
+        self.db = VolatileDB(self.PATH, max_blocks_per_file=MAX_PER_FILE, fs=self.fs)
+        survived = set(self.db.all_hashes())
+        assert survived <= set(self.model.by_hash)
+        # surviving blocks read back intact
+        for h in survived:
+            blk = self.model.by_hash[h]
+            assert self.db.get_block_bytes(h) == blk.bytes_
+        # resync the model (file numbering restarts at the last file)
+        new = VolatileModel()
+        for n in sorted(self.model.files):
+            kept = [b for b in self.model.files[n] if b.hash_ in survived]
+            if kept:
+                new.files[n] = kept
+                for b in kept:
+                    new.by_hash[b.hash_] = b
+                new.write_file = max(new.write_file, n)
+        ns = sorted(new.files)
+        new.write_file = ns[-1] if ns else 0
+        self.model = new
+
+    @invariant()
+    def member_consistent(self):
+        if not hasattr(self, "db"):
+            return
+        assert set(self.db.all_hashes()) == set(self.model.by_hash)
+
+
+TestVolatileModel = VolatileMachine.TestCase
+TestVolatileModel.settings = MACHINE_SETTINGS
+
+
+# ---------------------------------------------------------------------------
+# ChainDB vs a pure chain-selection model (ChainDB/StateMachine.hs, Model.hs)
+# ---------------------------------------------------------------------------
+
+
+class ChainModel:
+    """Pure model of ChainDB semantics: volatile block graph + the
+    chain-selection rule (adopt the best candidate through the new block
+    iff strictly preferred), the k-deep immutability window, olderThanK
+    rejection, and file-granular volatile GC after copy."""
+
+    def __init__(self, protocol, k):
+        self.protocol = protocol
+        self.k = k
+        self.vol = VolatileModel()
+        self.immutable: list = []
+        self.current: list = []
+
+    def chain(self):
+        return self.immutable + self.current
+
+    def _anchor_hash(self):
+        return self.immutable[-1].hash_ if self.immutable else None
+
+    def _candidates_through(self, via_hash):
+        """Paths from the anchor through `via_hash` in the volatile graph
+        (isReachable + extendWithSuccessors)."""
+        back = []
+        h = via_hash
+        root = self._anchor_hash()
+        while True:
+            blk = self.vol.by_hash.get(h)
+            if blk is None:
+                return []
+            back.append(blk)
+            if blk.prev_hash == root:
+                break
+            h = blk.prev_hash
+            if h is None:
+                return []
+        prefix = list(reversed(back))
+        out = []
+        stack = [prefix]
+        while stack:
+            path = stack.pop()
+            succs = self.vol.successors(path[-1].hash_)
+            if not succs:
+                out.append(path)
+                continue
+            for s in succs:
+                stack.append(path + [self.vol.by_hash[s]])
+        return out
+
+    def add(self, blk):
+        if self.immutable and blk.slot <= self.immutable[-1].slot:
+            return  # olderThanK
+        self.vol.put(blk)
+        cands = self._candidates_through(blk.hash_)
+        if not cands:
+            return
+        sv = self.protocol.select_view
+        cur = sv(self.current[-1].header) if self.current else None
+        best = None
+        best_v = cur
+        for c in cands:
+            v = sv(c[-1].header)
+            if self.protocol.compare_candidates(best_v, v) > 0:
+                best, best_v = c, v
+        if best is None:
+            return
+        self.current = best
+        # copy-to-immutable + GC (file granularity)
+        excess = len(self.current) - self.k
+        if excess > 0:
+            moved, self.current = self.current[:excess], self.current[excess:]
+            self.immutable.extend(moved)
+            self.vol.gc(moved[-1].slot + 1)
+
+
+def _mk_ext():
+    ledger = mock_ledger.MockLedger(
+        mock_ledger.MockConfig(LVIEW, PARAMS.stability_window)
+    )
+    protocol = PraosProtocol(PARAMS, use_device_batch=False, crypto=CRYPTO)
+    return ExtLedger(ledger, protocol)
+
+
+def _genesis(ext):
+    st_ = ext.genesis(ext.ledger.genesis_state([]))
+    return dataclasses.replace(
+        st_,
+        header_state=dataclasses.replace(
+            st_.header_state,
+            chain_dep_state=dataclasses.replace(
+                st_.header_state.chain_dep_state, epoch_nonce=ETA0
+            ),
+        ),
+    )
+
+
+class ChainDBMachine(RuleBasedStateMachine):
+    PATH = "chain"
+
+    @initialize()
+    def setup(self):
+        self.fs = MockFS()
+        main, branches = tree()
+        self.pool = main + branches
+        self.ext = _mk_ext()
+        self.db = open_chaindb(
+            self.PATH, self.ext, _genesis(self.ext), K, fs=self.fs
+        )
+        # the real VolatileDB uses max_blocks_per_file=1000: mirror that
+        # (file granularity never triggers in a 16-block tree)
+        self.model = ChainModel(self.ext.protocol, K)
+        self.model_vol_max = 1000
+
+    def _assert_same_chain(self):
+        actual = [b.hash_ for b in self.db.stream_all()]
+        expected = [b.hash_ for b in self.model.chain()]
+        assert actual == expected, (
+            f"chain mismatch: impl {len(actual)} blocks, model {len(expected)}"
+        )
+
+    @rule(data=st.data())
+    def add_block(self, data):
+        b = data.draw(st.sampled_from(self.pool))
+        self.db.add_block(b)
+        self.model.add(b)
+        self._assert_same_chain()
+
+    @rule(validate_all=st.booleans())
+    def reopen(self, validate_all):
+        """Close (snapshot) and reopen: selection must be rebuilt
+        identically from disk state."""
+        self.db.close()
+        self.db = open_chaindb(
+            self.PATH, self.ext, _genesis(self.ext), K,
+            validate_all=validate_all, fs=self.fs,
+        )
+        self._assert_same_chain()
+
+    @invariant()
+    def tip_consistent(self):
+        if not hasattr(self, "db"):
+            return
+        tp = self.db.tip_point()
+        chain = self.model.chain()
+        if chain:
+            assert tp is not None and tp.hash_ == chain[-1].hash_
+        else:
+            assert tp is None
+
+
+TestChainDBModel = ChainDBMachine.TestCase
+TestChainDBModel.settings = MACHINE_SETTINGS

@@ -1,0 +1,214 @@
+"""ChainSync message pipelining + diffusion pipelining (tentative headers).
+
+Reference: `MkPipelineDecision` (MiniProtocol/ChainSync/Client.hs:422),
+tentative-header followers (ChainDB Impl/Follower.hs, trap logic at
+Impl/ChainSel.hs:949-984), and the blocking (non-polling) ChainSync
+server (Server.hs blocks in STM on the follower's next instruction).
+"""
+
+import os
+from dataclasses import replace
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.block import forge_block
+from ouroboros_consensus_tpu.ledger import ExtLedger
+from ouroboros_consensus_tpu.ledger import mock as mock_ledger
+from ouroboros_consensus_tpu.miniprotocol import chainsync
+from ouroboros_consensus_tpu.miniprotocol.chainsync import Candidate
+from ouroboros_consensus_tpu.node.kernel import NodeKernel
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.protocol.instances import PraosProtocol
+from ouroboros_consensus_tpu.storage.open import open_chaindb
+from ouroboros_consensus_tpu.testing import fixtures
+from ouroboros_consensus_tpu.utils.sim import Channel, Sim
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=1000,
+    max_kes_evolutions=62,
+    security_param=100,  # no trimming interference in these tests
+    active_slot_coeff=Fraction(1),
+    epoch_length=10_000,
+    kes_depth=2,
+)
+POOLS = [fixtures.make_pool(i, kes_depth=2) for i in range(2)]
+LVIEW = fixtures.make_ledger_view(POOLS)
+ETA0 = b"\x22" * 32
+N_HEADERS = 30
+
+
+def _mk_node(tmp_path, name):
+    ledger = mock_ledger.MockLedger(
+        mock_ledger.MockConfig(LVIEW, PARAMS.stability_window)
+    )
+    protocol = PraosProtocol(PARAMS, use_device_batch=False)
+    ext = ExtLedger(ledger, protocol)
+    st = ext.genesis(ledger.genesis_state([]))
+    st = replace(
+        st,
+        header_state=replace(
+            st.header_state,
+            chain_dep_state=replace(
+                st.header_state.chain_dep_state, epoch_nonce=ETA0
+            ),
+        ),
+    )
+    db = open_chaindb(str(tmp_path / name), ext, st, PARAMS.security_param)
+    return NodeKernel(name, db, protocol, ledger, pool=None)
+
+
+def _forge_chain(n):
+    blocks, prev = [], None
+    for i in range(n):
+        b = forge_block(
+            PARAMS, POOLS[i % 2], slot=i + 1, block_no=i,
+            prev_hash=prev, epoch_nonce=ETA0,
+        )
+        blocks.append(b)
+        prev = b.hash_
+    return blocks
+
+
+def _sync_time(tmp_path, label, max_in_flight):
+    """Virtual time for a fresh client to pull N_HEADERS headers from a
+    server over channels with delay 0.1."""
+    server_node = _mk_node(tmp_path, f"server-{label}")
+    client_node = _mk_node(tmp_path, f"client-{label}")
+    for b in _forge_chain(N_HEADERS):
+        server_node.chain_db.add_block(b)
+
+    sim = Sim()
+    server_node.chain_db.runtime = sim
+    req = Channel(delay=0.1, name="req")
+    rsp = Channel(delay=0.1, name="rsp")
+    cand = Candidate()
+    sim.spawn(chainsync.server(server_node.chain_db, req, rsp), "server")
+    client = sim.spawn(
+        chainsync.client(
+            client_node, "peer", rsp, req, cand,
+            max_headers=N_HEADERS, max_in_flight=max_in_flight,
+        ),
+        "client",
+    )
+    sim.run()
+    assert not client.alive  # finished
+    assert len(cand.headers) == N_HEADERS
+    return sim.now
+
+
+def test_pipelined_sync_is_faster(tmp_path):
+    """Pipelining amortizes the round trip: with delay d per message,
+    strict request/response pays 2d per header; a 10-deep pipeline
+    must finish the same sync at least 3x sooner."""
+    strict = _sync_time(tmp_path, "strict", max_in_flight=1)
+    pipelined = _sync_time(tmp_path, "pipe", max_in_flight=10)
+    assert pipelined < strict / 3, (strict, pipelined)
+
+
+def test_candidate_trimmed_to_k(tmp_path):
+    """theirHeaderStateHistory is trimmed to k (HeaderStateHistory.hs):
+    memory stays O(k) on long syncs — but only SETTLED (already-adopted)
+    headers are trimmed, so BlockFetch's anchor never disappears."""
+    from ouroboros_consensus_tpu.miniprotocol import blockfetch
+
+    server_node = _mk_node(tmp_path, "server-t")
+    client_node = _mk_node(tmp_path, "client-t")
+    k = 5
+    client_node.protocol.security_param = k
+    for b in _forge_chain(N_HEADERS):
+        server_node.chain_db.add_block(b)
+    sim = Sim()
+    server_node.chain_db.runtime = sim
+    client_node.chain_db.runtime = sim
+    req, rsp = Channel(name="req"), Channel(name="rsp")
+    bf_req, bf_rsp = Channel(name="bf-req"), Channel(name="bf-rsp")
+    cand = Candidate()
+    sim.spawn(chainsync.server(server_node.chain_db, req, rsp), "server")
+    sim.spawn(
+        chainsync.client(
+            client_node, "peer", rsp, req, cand, max_headers=N_HEADERS
+        ),
+        "client",
+    )
+    sim.spawn(blockfetch.server(server_node.chain_db, bf_req, bf_rsp), "bfs")
+    sim.spawn(
+        blockfetch.client(client_node, "peer", bf_rsp, bf_req, cand), "bfc"
+    )
+    sim.run(until=120.0)
+    # the client fully adopted the server chain; the candidate history
+    # was trimmed down to k as blocks settled
+    assert client_node.chain_db.tip_point() is not None
+    assert (
+        client_node.chain_db.tip_point().hash_
+        == server_node.chain_db.tip_point().hash_
+    )
+    assert len(cand.headers) <= k
+    assert len(cand.states) == len(cand.headers) + 1
+    assert cand.trimmed
+    # rollback to the (trimmed-away) intersection must now fail
+    assert not cand.truncate_to(None)
+
+
+def test_tentative_header_announced_before_validation(tmp_path):
+    """Decoupled mode: a block extending the tip is announced to
+    tentative followers at ENQUEUE time, before the add-block runner
+    validates it; the later adoption does not re-announce it."""
+    node = _mk_node(tmp_path, "n")
+    db = node.chain_db
+    sim = Sim()
+    runners = db.start_decoupled(sim)
+    blocks = _forge_chain(2)
+
+    f_tent = db.new_follower(include_tentative=True)
+    f_plain = db.new_follower()
+
+    db.add_block_async(blocks[0])
+    # BEFORE any runner step: tentative follower saw the header
+    ups = f_tent.take_updates()
+    assert [u[0] for u in ups] == ["tentative"]
+    assert ups[0][1].hash_ == blocks[0].hash_
+    assert f_plain.take_updates() == []
+
+    for i, r in enumerate(runners):
+        sim.spawn(r, f"runner{i}")
+    sim.run(until=10.0)
+
+    # adoption: plain follower gets the block; tentative follower got it
+    # already and must NOT see a duplicate
+    plain = f_plain.take_updates()
+    assert [u[0] for u in plain] == ["addblock"]
+    assert f_tent.take_updates() == []
+
+
+def test_tentative_header_retracted_when_not_adopted(tmp_path):
+    """The trap case (ChainSel.hs:949-984): if validation rejects the
+    announced block, tentative followers receive a compensating
+    rollback to the pre-announcement tip."""
+    node = _mk_node(tmp_path, "n")
+    db = node.chain_db
+    blocks = _forge_chain(2)
+    db.add_block(blocks[0])  # adopted synchronously (still coupled)
+
+    sim = Sim()
+    runners = db.start_decoupled(sim)
+    f_tent = db.new_follower(include_tentative=True)
+
+    # a block extending the tip but with a corrupted KES signature:
+    # announced tentatively, then rejected by chain selection
+    good = blocks[1]
+    bad_sig = bytes([good.header.kes_sig[0] ^ 0xFF]) + good.header.kes_sig[1:]
+    from ouroboros_consensus_tpu.block.praos_block import Block, Header
+
+    bad = Block(Header(good.header.body, bad_sig), good.txs)
+    db.add_block_async(bad)
+    ups = f_tent.take_updates()
+    assert [u[0] for u in ups] == ["tentative"]
+
+    for i, r in enumerate(runners):
+        sim.spawn(r, f"runner{i}")
+    sim.run(until=10.0)
+
+    ups = f_tent.take_updates()
+    assert ("rollback", blocks[0].point) in ups, ups
+    assert db.tip_point().hash_ == blocks[0].hash_

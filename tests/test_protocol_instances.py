@@ -63,7 +63,7 @@ def test_pbft_window_threshold():
     st = p.update(pbft_view(1), 4, p.tick(None, 4, st))
     # window is now [0,1,2,1] -> delegate 0 appears once: allowed again
     st = p.update(pbft_view(0), 5, p.tick(None, 5, st))
-    assert st.signers[-1] == 0
+    assert st.signers[-1] == (5, 0)
 
 
 def test_pbft_rejections():
@@ -77,12 +77,39 @@ def test_pbft_rejections():
         p.update(forged, 0, t)
 
 
+def test_pbft_slot_monotonicity_and_delegation():
+    """PBFT.hs:320-352: slots must be non-decreasing; the delegation map
+    from the TICKED ledger view decides genesis-key membership — a
+    delegation cert redirects a genesis key's signing rights."""
+    from ouroboros_consensus_tpu.protocol.instances import (
+        PBftInvalidSlot,
+        PBftLedgerView,
+    )
+
+    p = PBftProtocol(PBftParams(3, Fraction(1, 2), 4), VKS)
+    st = p.update(pbft_view(0), 5, p.tick(None, 5, p.initial_state()))
+    # same slot is allowed (EBBs share their epoch's first slot)...
+    st2 = p.update(pbft_view(1), 5, p.tick(None, 5, st))
+    # ...an EARLIER slot is not
+    with pytest.raises(PBftInvalidSlot):
+        p.update(pbft_view(1), 4, p.tick(None, 4, st2))
+
+    # delegation: genesis key 0 delegates to VKS[2]'s holder — the NEW
+    # delegate signs as genesis key 0; the old key is rejected
+    dlg = PBftLedgerView({VKS[2]: 0, VKS[1]: 1})
+    t = p.tick(dlg, 6, st2)
+    st3 = p.update(PBftView(VKS[2], b"hdr", he.sign(SEEDS[2], b"hdr")), 6, t)
+    assert st3.signers[-1] == (6, 0)
+    with pytest.raises(PBftNotGenesisDelegate):
+        p.update(pbft_view(0), 6, p.tick(dlg, 6, st2))
+
+
 def test_pbft_reupdate_skips_crypto():
     p = PBftProtocol(PBftParams(2, Fraction(1, 2), 4), VKS[:2])
     t = p.tick(None, 0, p.initial_state())
     v = PBftView(VKS[0], b"hdr", b"garbage")  # bad sig: reupdate ignores
     st = p.reupdate(v, 0, t)
-    assert st.signers == (0,)
+    assert st.signers == ((0, 0),)
 
 
 def test_leader_schedule():

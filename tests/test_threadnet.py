@@ -80,3 +80,140 @@ def test_device_batch_threadnet(tmp_path):
     threadnet.check_common_prefix(res, cfg.k)
     tips = {res.chain_hashes(i)[-1] for i in range(cfg.n_nodes)}
     assert len(tips) == 1
+
+
+@pytest.mark.slow
+def test_join_plan_late_node_syncs(tmp_path):
+    """NodeJoinPlan (ThreadNet/Util/NodeJoinPlan.hs analog): a node
+    joining at slot 10 must still converge with the others, and the
+    single-forger reference simulator predicts the exact chain length."""
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=3, n_slots=20, k=30, msg_delay=0.05,
+        active_slot_coeff=Fraction(1),
+        forgers=[0],
+        join_plan={2: 10},
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    expect = threadnet.expected_chain_length(cfg)
+    assert len(res.chains[0]) == expect
+    # the late joiner caught up fully
+    assert res.chain_hashes(2) == res.chain_hashes(0)
+
+
+@pytest.mark.slow
+def test_node_restart_mid_run(tmp_path):
+    """NodeRestarts (ThreadNet/Util/NodeRestarts.hs analog): the forger
+    restarts mid-run — ChainDB closed, reopened WITH full revalidation —
+    and the network still reaches the reference-predicted length."""
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=2, n_slots=20, k=30, msg_delay=0.05,
+        active_slot_coeff=Fraction(1),
+        forgers=[0],
+        restarts=[(8, 0), (14, 1)],
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    assert res.n_restarts == 2
+    threadnet.check_common_prefix(res, cfg.k)
+    expect = threadnet.expected_chain_length(cfg)
+    assert len(res.chains[0]) == expect
+    assert res.chain_hashes(1) == res.chain_hashes(0)
+
+
+@pytest.mark.slow
+def test_restart_with_rekey(tmp_path):
+    """Rekeying (Util/Rekeying.hs analog): the restarted forger comes
+    back with a FRESH KES key and an ocert at counter+1; its later
+    blocks must still validate on every peer."""
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=2, n_slots=20, k=30, msg_delay=0.05,
+        active_slot_coeff=Fraction(1),
+        forgers=[0],
+        restarts=[(10, 0)],
+        rekey_on_restart=True,
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    assert res.nodes[0]._ocert_counter == 1
+    expect = threadnet.expected_chain_length(cfg)
+    assert len(res.chains[0]) == expect
+    assert res.chain_hashes(1) == res.chain_hashes(0)
+
+
+@pytest.mark.slow
+def test_threadnet_device_batch_path(tmp_path):
+    """Multi-node + device batching co-tested (the fused-kernel
+    candidate validation path that production uses), per VERDICT: the
+    sim network must behave identically when candidate suffixes are
+    validated through protocol/batch.py instead of the host fold."""
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=2, n_slots=10, k=8, msg_delay=0.05,
+        kes_depth=2, use_device_batch=True,
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    threadnet.check_chain_growth(res, cfg)
+    tips = {res.chain_hashes(i)[-1] for i in range(cfg.n_nodes)}
+    assert len(tips) == 1
+
+
+@pytest.mark.slow
+def test_tx_submission_diffuses_to_block(tmp_path):
+    """TxSubmission2 (Network/NodeToNode.hs:434-466): a tx injected at a
+    NON-forging node's mempool must gossip to the forger and appear in a
+    block adopted by everyone."""
+    from ouroboros_consensus_tpu.ledger.mock import encode_tx
+
+    # spends node-genesis output (zero-txid, 0): valid on every node
+    tx = encode_tx([(bytes(32), 0)], [(b"dest", 100)])
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=2, n_slots=12, k=30, msg_delay=0.05,
+        active_slot_coeff=Fraction(1),
+        forgers=[0],
+        tx_submission=True,
+        tx_injections=[(2, 1, tx)],  # node 1 (non-forger) gets the tx
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    included = [
+        b for b in res.chains[0] if any(t == tx for t in b.txs)
+    ]
+    assert included, "injected tx never reached a forged block"
+    # and the non-forger adopted that block too
+    assert any(tx in b.txs for b in res.chains[1])
+
+
+@pytest.mark.slow
+def test_properties_hold_across_schedules(tmp_path):
+    """Schedule exploration (io-sim seed variation, SURVEY §5.2): the
+    consensus properties must hold under PERTURBED task interleavings,
+    not just the FIFO schedule."""
+    for seed in (None, 7, 1234):
+        cfg = threadnet.ThreadNetConfig(
+            n_nodes=3, n_slots=15, k=10, msg_delay=0.05, seed=seed,
+        )
+        res = threadnet.run_thread_network(
+            str(tmp_path / f"s{seed}"), cfg
+        )
+        threadnet.check_common_prefix(res, cfg.k)
+        threadnet.check_chain_growth(res, cfg)
+
+
+@pytest.mark.slow
+def test_restart_before_peer_joins(tmp_path):
+    """Regression: a restart of node A before peer B's join slot used to
+    kill the delayed A<->B edge tasks without respawning them — B then
+    never synced at all. The restart must re-establish edges to
+    not-yet-joined peers with their remaining join delay."""
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=2, n_slots=16, k=30, msg_delay=0.05,
+        active_slot_coeff=Fraction(1),
+        forgers=[0],
+        join_plan={1: 12},
+        restarts=[(5, 0)],
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    assert len(res.chains[0]) == threadnet.expected_chain_length(cfg)
+    assert res.chain_hashes(1) == res.chain_hashes(0), (
+        f"late joiner stuck at {len(res.chains[1])} blocks"
+    )
