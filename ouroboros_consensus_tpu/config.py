@@ -1,0 +1,74 @@
+"""TopLevelConfig: the per-layer static configuration bundle.
+
+Reference: `Ouroboros.Consensus.Config` — `TopLevelConfig`
+(Config.hs:38) groups the protocol / ledger / block / codec / storage
+configurations that `ProtocolInfo` constructors assemble and every
+subsystem picks its slice from; `SecurityParam` (Config/SecurityParam.hs)
+rides inside the protocol config.
+
+This framework's subsystems take their slices directly (PraosParams,
+MockConfig, chunk sizes...), so the bundle is a convenience record with
+an `open_chaindb`-shaped projection — what `mkChainDbArgs` does in the
+reference's node assembly (diffusion Node.hs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Any
+
+
+@dataclass(frozen=True)
+class StorageConfig:
+    """The ChainDB/ImmutableDB/VolatileDB knobs (cdbsArgs analog)."""
+
+    chunk_size: int = 21600
+    snapshot_interval: int = 100
+    max_blocks_per_file: int = 1000
+
+
+@dataclass(frozen=True)
+class BlockConfig:
+    """Static block-production parameters (BlockConfig analog)."""
+
+    protocol_version: tuple[int, int] = (9, 0)
+    max_header_size: int = 1100
+
+
+@dataclass(frozen=True)
+class TopLevelConfig:
+    """topLevelConfig{Protocol,Ledger,Block,Storage} (Config.hs:38-57).
+    The codec slice has no analog: this framework's CBOR codecs are
+    version-independent functions (utils/cbor.py)."""
+
+    protocol: Any  # e.g. protocol.praos.PraosParams
+    ledger: Any  # e.g. ledger.mock.MockConfig
+    block: BlockConfig = field(default_factory=BlockConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+
+    @property
+    def security_param(self) -> int:
+        """configSecurityParam (Config.hs:74)."""
+        return self.protocol.security_param
+
+
+class HardForkSlotClock:
+    """hardForkBlockchainTime (BlockchainTime/WallClock/HardFork.hs:9):
+    wallclock ↔ slot conversions that re-query the HFC summary, so
+    era-varying slot lengths are honored — unlike the fixed-length
+    SlotClock (node/kernel.py) used by single-era tests."""
+
+    def __init__(self, summary, t0: float = 0.0):
+        self.summary = summary
+        self.t0 = t0
+
+    def slot_of(self, now: float) -> int:
+        slot, _offset = self.summary.wallclock_to_slot(
+            Fraction(now - self.t0).limit_denominator(10**9)
+        )
+        return slot
+
+    def start_of(self, slot: int) -> float:
+        start, _length = self.summary.slot_to_wallclock(slot)
+        return self.t0 + float(start)

@@ -18,6 +18,7 @@ from typing import Sequence
 
 from ..block.abstract import Point
 from ..ops.host import ed25519 as host_ed25519
+from ..protocol.instances import PBFT_BOUNDARY_VIEW as BOUNDARY_VIEW
 from ..protocol.instances import PBftView
 from ..utils import cbor
 
@@ -28,27 +29,33 @@ def _b2b(data: bytes) -> bytes:
 
 @dataclass(frozen=True)
 class ByronMockHeader:
-    """Header: delegate-signed (cold Ed25519) over the body fields."""
+    """Header: delegate-signed (cold Ed25519) over the body fields.
+
+    `is_ebb` marks an EPOCH BOUNDARY BLOCK (Block/EBB.hs, Byron/EBBs.hs):
+    unsigned, empty, sharing its epoch's first slot and its PREDECESSOR's
+    block number — validation treats it as PBftValidateBoundary (no
+    signature, no window update, PBFT.hs:326)."""
 
     block_no: int
     slot: int
     prev_hash: bytes | None
-    issuer_vk: bytes  # 32 — genesis delegate key
+    issuer_vk: bytes  # 32 — genesis delegate key (zeros for an EBB)
     body_hash: bytes  # 32
-    sig: bytes  # 64 — Ed25519 over signed_bytes
+    sig: bytes  # 64 — Ed25519 over signed_bytes (zeros for an EBB)
+    is_ebb: bool = False
 
     @cached_property
     def signed_bytes(self) -> bytes:
         return cbor.encode(
             [self.block_no, self.slot, self.prev_hash, self.issuer_vk,
-             self.body_hash]
+             self.body_hash, self.is_ebb]
         )
 
     @cached_property
     def bytes_(self) -> bytes:
         return cbor.encode(
             [self.block_no, self.slot, self.prev_hash, self.issuer_vk,
-             self.body_hash, self.sig]
+             self.body_hash, self.sig, self.is_ebb]
         )
 
     @cached_property
@@ -59,13 +66,17 @@ class ByronMockHeader:
     def point(self) -> Point:
         return Point(self.slot, self.hash_)
 
-    def to_view(self) -> PBftView:
+    def to_view(self):
+        """ValidateView: PBftValidateBoundary for EBBs (a sentinel the
+        protocol recognizes), PBftValidateRegular otherwise."""
+        if self.is_ebb:
+            return BOUNDARY_VIEW
         return PBftView(self.issuer_vk, self.signed_bytes, self.sig)
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "ByronMockHeader":
-        bn, slot, prev, vk, bh, sig = cbor.decode(data)
-        return cls(bn, slot, prev, vk, bh, sig)
+        bn, slot, prev, vk, bh, sig, ebb = cbor.decode(data)
+        return cls(bn, slot, prev, vk, bh, sig, bool(ebb))
 
 
 def body_hash(txs: Sequence[bytes]) -> bytes:
@@ -127,3 +138,16 @@ def forge_block(
     return ByronMockBlock(
         ByronMockHeader(block_no, slot, prev_hash, vk, bh, sig), tuple(txs)
     )
+
+
+def forge_ebb(
+    *, slot: int, block_no: int, prev_hash: bytes | None
+) -> ByronMockBlock:
+    """Forge an epoch boundary block (Byron/EBBs.hs): unsigned, empty;
+    `block_no` must equal the PREDECESSOR's (EBBs do not advance the
+    block count), `slot` the new epoch's first slot."""
+    hdr = ByronMockHeader(
+        block_no, slot, prev_hash, b"\x00" * 32, body_hash(()),
+        b"\x00" * 64, is_ebb=True,
+    )
+    return ByronMockBlock(hdr, ())

@@ -35,6 +35,9 @@ class Era:
     # translations INTO this era from the previous one (identity default)
     translate_chain_dep: Callable[[Any], Any] = lambda s: s
     translate_ledger_state: Callable[[Any], Any] = lambda s: s
+    # tx translation INTO this era from the previous (InjectTxs.hs pair
+    # translations); None = txs cannot cross this boundary
+    translate_tx: Callable[[bytes], bytes] | None = None
 
 
 @dataclass(frozen=True)
@@ -273,3 +276,67 @@ def unwrap(block):
 def decode_block(data: bytes, era_decoders: Sequence[Callable[[bytes], Any]]):
     era, inner = cbor.decode(data)
     return HardForkBlock(era, era_decoders[era](inner))
+
+
+# ---------------------------------------------------------------------------
+# Cross-era transactions + queries
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class HardForkTx:
+    """GenTx (HardForkBlock xs): a transaction tagged with the era whose
+    rules produced it (Combinator/Mempool.hs)."""
+
+    era: int
+    tx: bytes
+
+
+class CannotInjectTx(Exception):
+    """InjectTxs.hs cannotInjectTx: no translation path to the current
+    era (e.g. a Byron tx offered after the Shelley boundary with no
+    Byron→Shelley tx translation configured)."""
+
+
+class TxFromFutureEra(Exception):
+    """A tx tagged with an era the chain has not reached yet."""
+
+
+def inject_tx(eras: Sequence[Era], state_era: int, tx: HardForkTx) -> bytes:
+    """Lift `tx` into the state's era through the pairwise translations
+    (Combinator/InjectTxs.hs) — the HFC mempool runs every incoming tx
+    through this before applying it under the CURRENT era's rules."""
+    era, raw = tx.era, tx.tx
+    if era > state_era:
+        raise TxFromFutureEra(f"tx era {era} > chain era {state_era}")
+    while era < state_era:
+        translate = eras[era + 1].translate_tx
+        if translate is None:
+            raise CannotInjectTx(
+                f"no tx translation {eras[era].name} -> {eras[era + 1].name}"
+            )
+        raw = translate(raw)
+        era += 1
+    return raw
+
+
+def hard_fork_query(
+    ledger: "HardForkLedger", summary: Summary, state: HFState,
+    name: str, args=(),
+):
+    """Query (HardForkBlock xs) (Combinator/Ledger/Query.hs): HFC-level
+    queries answered from the telescope + summary; anything else
+    dispatches to the CURRENT era's ledger."""
+    if name == "get_current_era":
+        return state.era, ledger.eras[state.era].name
+    if name == "get_era_start":
+        return summary.eras[state.era].start.slot
+    if name == "get_interpreter":
+        # the reference ships the whole Summary to clients so they can
+        # run time conversions locally (GetInterpreter)
+        return summary
+    inner = ledger.eras[state.era].ledger
+    fn = getattr(inner, "query", None)
+    if fn is None:
+        raise KeyError(f"unknown hard-fork query {name!r}")
+    return fn(state.inner, name, args)

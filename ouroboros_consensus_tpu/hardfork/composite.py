@@ -172,6 +172,18 @@ def synthesize(path: str, cfg: CardanoMockConfig, n_slots: int, chunk_size: int 
         era = cm.hf.era_of_slot(slot)
         ticked = cm.hf.tick(cm.view_for_era(era), slot, st)
         if era == 0:
+            if slot % cfg.byron_epoch_length == 0:
+                # each Byron epoch opens with an EBB (Byron/EBBs.hs):
+                # unsigned, empty, block number NOT advanced
+                ebb = byron_mock.forge_ebb(
+                    slot=slot, block_no=max(0, block_no - 1), prev_hash=prev
+                )
+                hfb = HardForkBlock(era, ebb)
+                imm.append_block(slot, ebb.block_no, hfb.hash_, hfb.bytes_)
+                st = cm.hf.reupdate(ebb.header.to_view(), slot, ticked)
+                prev = hfb.hash_
+                n_blocks += 1
+                continue  # the EBB owns the epoch's first slot
             j = slot % cfg.n_delegs
             blk = byron_mock.forge_block(
                 cm.delegs[j].cold_seed,
@@ -235,6 +247,8 @@ def _validate_pbft_segment(proto: PBftProtocol, headers, st, backend: str):
     native C++ verifier), delegate-membership + window threshold folded
     sequentially on host — the exact PBft rule order (Protocol/PBFT.hs
     :284: delegate check, signature, threshold)."""
+    from ..protocol.instances import PBFT_BOUNDARY_VIEW
+
     views = [h.to_view() for h in headers]
     if backend == "host":
         for i, (h, view) in enumerate(zip(headers, views)):
@@ -244,25 +258,34 @@ def _validate_pbft_segment(proto: PBftProtocol, headers, st, backend: str):
                 return st, i, e
         return st, len(views), None
 
+    # EBBs (PBftValidateBoundary) carry no signature: exclude their
+    # lanes from the batch and skip them in the host fold below
+    regular = [v for v in views if v is not PBFT_BOUNDARY_VIEW]
     if backend == "native":
         from .. import native_loader as nl
 
-        sig_ok = [
+        reg_ok = [
             nl.native_ed25519_verify(
                 v.issuer_vk, v.signature, v.signed_bytes
             )
-            for v in views
+            for v in regular
         ]
-    else:
-        padded, n = _bucket_pad(views, views[0])
+    elif regular:
+        padded, n = _bucket_pad(regular, regular[0])
         ok = ed25519_batch.verify_batch(
             [v.issuer_vk for v in padded],
             [v.signature for v in padded],
             [v.signed_bytes for v in padded],
         )
-        sig_ok = list(ok[:n])
+        reg_ok = list(ok[:n])
+    else:
+        reg_ok = []
+    it = iter(reg_ok)
+    sig_ok = [True if v is PBFT_BOUNDARY_VIEW else next(it) for v in views]
     for i, (h, view) in enumerate(zip(headers, views)):
         try:
+            if view is PBFT_BOUNDARY_VIEW:
+                continue  # boundary: no state change (PBFT.hs:326)
             st = proto.apply_checked_sig(st, h.slot, view.issuer_vk, sig_ok[i])
         except Exception as e:
             return st, i, e

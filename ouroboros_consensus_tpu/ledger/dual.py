@@ -1,0 +1,186 @@
+"""DualLedger: run an implementation ledger and a SPEC ledger in
+lock-step, failing loudly on any disagreement.
+
+Reference: `Ouroboros.Consensus.Ledger.Dual` — `DualBlock m a` pairs the
+real Byron implementation with the executable `byron-spec-ledger`
+specification (`src/byronspec/`), applied to the same blocks; divergence
+is a conformance bug, surfaced immediately rather than as a consensus
+split months later (driven by `byron-test/Test/ThreadNet/DualByron.hs`).
+
+Here the pair is (MockLedger, SpecLedger): the impl tracks a full UTxO
+map keyed by outpoint; the spec tracks only per-address balances — a
+coarser, independently-written semantics. The agreement relation (the
+reference's `agreeOnUTxO`-style projection) is "the impl's UTxO, summed
+per address, equals the spec's balance table".
+
+The DualLedger satisfies the same duck-typed ledger interface the
+storage layer consumes (ledger/abstract.py shapes), so a ChainDB can run
+entirely on the paired state — which is exactly what the DualByron
+ThreadNet test does.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping
+
+from . import mock as mock_ledger
+from .mock import LedgerError, decode_tx
+
+
+class DualLedgerMismatch(AssertionError):
+    """Impl and spec disagree — a conformance bug, never a valid chain
+    outcome (the reference calls this a 'dual ledger assertion')."""
+
+
+# ---------------------------------------------------------------------------
+# The spec side: per-address balance accounting (independent semantics)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SpecState:
+    balances: Mapping[bytes, int]  # addr -> total unspent value
+    tip_slot_: int | None = None
+
+
+class SpecLedger:
+    """The executable specification: value moves between addresses;
+    inputs are resolved through the IMPL's view of what they are worth
+    (the spec abstracts outpoints away entirely)."""
+
+    def genesis_state(self, initial_outputs) -> SpecState:
+        bal: dict[bytes, int] = {}
+        for addr, amt in initial_outputs:
+            bal[addr] = bal.get(addr, 0) + amt
+        return SpecState(bal)
+
+    def apply_tx(self, state: SpecState, tx_bytes: bytes, resolve) -> SpecState:
+        """`resolve(txin) -> (addr, amount)` supplies the input values
+        (the spec's environment; byron-spec gets them from its own
+        abstract UTxO — here the impl state is the oracle, which is fine
+        because the CONSERVATION and balance bookkeeping are still
+        checked independently)."""
+        ins, outs = decode_tx(tx_bytes)
+        bal = dict(state.balances)
+        for txin in ins:
+            addr, amt = resolve(txin)
+            if bal.get(addr, 0) < amt:
+                raise LedgerError(f"spec: {addr!r} underfunded")
+            bal[addr] -= amt
+            if not bal[addr]:
+                del bal[addr]
+        for addr, amt in outs:
+            bal[addr] = bal.get(addr, 0) + amt
+        return SpecState(bal, state.tip_slot_)
+
+
+# ---------------------------------------------------------------------------
+# The pairing
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DualState:
+    impl: mock_ledger.MockState
+    spec: SpecState
+
+    # the storage layer reads .utxo for mempool anchoring: expose the
+    # impl side (the reference's dualLedgerStateMain projection)
+    @property
+    def utxo(self):
+        return self.impl.utxo
+
+
+@dataclass(frozen=True)
+class TickedDualState:
+    state: DualState
+    slot: int
+
+
+def _project(utxo) -> dict[bytes, int]:
+    """Impl state -> spec abstraction (per-address totals)."""
+    out: dict[bytes, int] = {}
+    for (addr, amt) in utxo.values():
+        out[addr] = out.get(addr, 0) + amt
+    return out
+
+
+class DualLedger:
+    """Ledger interface over the (impl, spec) pair."""
+
+    def __init__(self, config: mock_ledger.MockConfig):
+        self.config = config
+        self.impl = mock_ledger.MockLedger(config)
+        self.spec = SpecLedger()
+
+    def _check_agreement(self, st: DualState, where: str) -> None:
+        projected = _project(st.impl.utxo)
+        if projected != dict(st.spec.balances):
+            raise DualLedgerMismatch(
+                f"{where}: impl projects {projected}, spec has "
+                f"{dict(st.spec.balances)}"
+            )
+
+    # -- ledger interface ----------------------------------------------------
+
+    def genesis_state(self, initial_outputs) -> DualState:
+        st = DualState(
+            self.impl.genesis_state(initial_outputs),
+            self.spec.genesis_state(initial_outputs),
+        )
+        self._check_agreement(st, "genesis")
+        return st
+
+    def tick(self, state: DualState, slot: int) -> TickedDualState:
+        return TickedDualState(state, slot)
+
+    def apply_tx(self, utxo: dict, tx_bytes: bytes) -> dict:
+        """Mempool path: impl-only (the spec pairs at BLOCK granularity,
+        like the reference — DualBlock has no dual mempool)."""
+        return self.impl.apply_tx(utxo, tx_bytes)
+
+    def _apply(self, ticked: TickedDualState, block, check: bool) -> DualState:
+        """One incremental pass: the impl's UTxO fold IS the spec's
+        input-resolution oracle (values read before each tx mutates)."""
+        utxo = dict(ticked.state.impl.utxo)
+        spec = ticked.state.spec
+        for tx in block.txs:
+            ins, _outs = decode_tx(tx)
+            resolved = {i: utxo[i] for i in ins if i in utxo}
+            utxo = self.impl.apply_tx(utxo, tx)
+            spec = self.spec.apply_tx(spec, tx, resolved.__getitem__)
+        out = DualState(
+            mock_ledger.MockState(utxo, ticked.slot),
+            SpecState(spec.balances, block.slot),
+        )
+        if check:
+            self._check_agreement(out, f"block @{block.slot}")
+        return out
+
+    def apply_block(self, ticked: TickedDualState, block) -> DualState:
+        return self._apply(ticked, block, check=True)
+
+    def reapply_block(self, ticked: TickedDualState, block) -> DualState:
+        """Previously validated (LedgerDB replay): both sides still fold
+        — their states must stay paired — but the agreement assertion
+        is skipped, mirroring the reference's reapply (no checks)."""
+        return self._apply(ticked, block, check=False)
+
+    def tip_slot(self, state: DualState):
+        return state.impl.tip_slot_
+
+    def protocol_ledger_view(self, ticked: TickedDualState):
+        return self.config.ledger_view
+
+    def ledger_view_forecast_at(self, state: DualState):
+        return self.impl.ledger_view_forecast_at(state.impl)
+
+    def tick_then_apply(self, state: DualState, block) -> DualState:
+        return self.apply_block(self.tick(state, block.slot), block)
+
+    def tick_then_reapply(self, state: DualState, block) -> DualState:
+        return self.reapply_block(self.tick(state, block.slot), block)
+
+    def inspect(self, old: DualState, new: DualState) -> list:
+        return []

@@ -1,0 +1,126 @@
+"""Versioned mini-protocol application bundles.
+
+Reference: `ouroboros-consensus-diffusion` `Network/NodeToNode.hs:434-466`
+— the `Apps` record groups the consensus side of every node-to-node
+mini-protocol (ChainSync, BlockFetch, TxSubmission2, KeepAlive,
+PeerSharing), assembled per NEGOTIATED version; `Network/NodeToClient.hs`
+does the same for the local protocols. The handshake (handshake.py)
+picks the version; the bundle decides which protocols exist on the
+connection and how they behave.
+
+`connect_peers` is the full wiring: run the handshake over its own
+channel pair, then spawn exactly the version-gated app pairs — the
+`initiator`/`responder` assembly the diffusion layer performs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..miniprotocol import blockfetch, chainsync, handshake, txsubmission
+from ..miniprotocol.chainsync import Candidate
+from ..utils.sim import Channel, Sim
+
+
+@dataclass
+class Apps:
+    """The per-connection app bundle (NodeToNode.hs:434 Apps analog):
+    task generators keyed by protocol name, version-gated."""
+
+    version: int
+    tasks: list = field(default_factory=list)  # (owner, name, generator)
+
+    def protocols(self) -> set[str]:
+        return {name.split(":")[0] for (_o, name, _g) in self.tasks}
+
+
+def node_to_node_apps(
+    server_node,
+    client_node,
+    version: int,
+    *,
+    msg_delay: float = 0.0,
+    candidate: Candidate | None = None,
+) -> Apps:
+    """Build the consensus n2n bundle for a NEGOTIATED version: the app
+    set is exactly handshake.NODE_TO_NODE_VERSIONS[version]."""
+    enabled = handshake.NODE_TO_NODE_VERSIONS[version]
+    apps = Apps(version)
+    cand = candidate if candidate is not None else Candidate()
+    client_node.candidates[server_node.name] = cand
+
+    def chan(name):
+        return Channel(delay=msg_delay, name=name)
+
+    if "chainsync" in enabled:
+        req, rsp = chan("cs-req"), chan("cs-rsp")
+        apps.tasks.append(
+            ("server", "chainsync:server",
+             chainsync.server(server_node.chain_db, req, rsp))
+        )
+        apps.tasks.append(
+            ("client", "chainsync:client",
+             chainsync.client(client_node, server_node.name, rsp, req, cand))
+        )
+    if "blockfetch" in enabled:
+        req, rsp = chan("bf-req"), chan("bf-rsp")
+        apps.tasks.append(
+            ("server", "blockfetch:server",
+             blockfetch.server(server_node.chain_db, req, rsp))
+        )
+        apps.tasks.append(
+            ("client", "blockfetch:client",
+             blockfetch.client(client_node, server_node.name, rsp, req, cand))
+        )
+    if "txsubmission2" in enabled:
+        req, rsp = chan("ts-req"), chan("ts-rsp")
+        apps.tasks.append(
+            ("server", "txsubmission:outbound",
+             txsubmission.outbound(server_node, req, rsp))
+        )
+        apps.tasks.append(
+            ("client", "txsubmission:inbound",
+             txsubmission.inbound(client_node, server_node.name, rsp, req))
+        )
+    if "keepalive" in enabled:
+        req, rsp = chan("ka-req"), chan("ka-rsp")
+        apps.tasks.append(
+            ("server", "keepalive:server", txsubmission.keepalive_server(req, rsp))
+        )
+        apps.tasks.append(
+            ("client", "keepalive:client",
+             txsubmission.keepalive_client(rsp, req))
+        )
+    if "peersharing" in enabled:
+        req, rsp = chan("ps-req"), chan("ps-rsp")
+        apps.tasks.append(
+            ("server", "peersharing:server",
+             txsubmission.peersharing_server(server_node, req, rsp))
+        )
+        apps.tasks.append(
+            ("client", "peersharing:client",
+             txsubmission.peersharing_client(rsp, req, 4))
+        )
+    return apps
+
+
+def connect_peers(
+    sim: Sim,
+    server_node,
+    client_node,
+    server_versions: dict[int, handshake.VersionData],
+    client_versions: dict[int, handshake.VersionData],
+    *,
+    msg_delay: float = 0.0,
+) -> Apps:
+    """Handshake (pure negotiation — the wire exchange is exercised by
+    handshake.client/server tasks in tests) then spawn the version-gated
+    bundle. Raises HandshakeRefused on no common version/magic."""
+    version, _data = handshake.negotiate(server_versions, client_versions)
+    apps = node_to_node_apps(
+        server_node, client_node, version, msg_delay=msg_delay
+    )
+    for owner, name, gen in apps.tasks:
+        sim.spawn(gen, f"{name}:{server_node.name}->{client_node.name}")
+    return apps

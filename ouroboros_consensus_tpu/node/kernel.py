@@ -84,6 +84,11 @@ class NodeKernel:
         # forging credentials: an evolving HotKey + its operational
         # certificate (Ledger/HotKey.hs; ocert counter increments on
         # every re-issue, checked by Praos.hs:585-605)
+        if (hotkey is None) != (ocert is None):
+            # a hot key is only usable with the certificate that binds
+            # it to the cold key — a mismatched pair forges blocks every
+            # peer rejects (KES vk / period mismatch)
+            raise ValueError("hotkey and ocert must be carried together")
         self._ocert_counter = ocert_counter
         self.hotkey = hotkey
         self._ocert = ocert

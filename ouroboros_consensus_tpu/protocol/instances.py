@@ -264,6 +264,17 @@ class PBftView:
     signature: bytes
 
 
+class _PBftBoundaryView:
+    """PBftValidateBoundary (PBFT.hs:312): an EBB carries no signature;
+    validation passes it through with NO state change (:326)."""
+
+    def __repr__(self):
+        return "PBftValidateBoundary"
+
+
+PBFT_BOUNDARY_VIEW = _PBftBoundaryView()
+
+
 class PBftProtocol:
     """ConsensusProtocol (PBft c) (Protocol/PBFT.hs:284)."""
 
@@ -324,7 +335,9 @@ class PBftProtocol:
             )
         return new
 
-    def update(self, view: PBftView, slot, ticked: TickedPBftState) -> PBftState:
+    def update(self, view, slot, ticked: TickedPBftState) -> PBftState:
+        if view is PBFT_BOUNDARY_VIEW:
+            return ticked.state  # EBB: no checks, no state change
         sig_ok = host_ed25519.verify(
             view.issuer_vk, view.signed_bytes, view.signature
         )
@@ -332,10 +345,12 @@ class PBftProtocol:
             ticked.state, slot, view.issuer_vk, sig_ok, ticked.dlg
         )
 
-    def reupdate(self, view: PBftView, slot, ticked: TickedPBftState) -> PBftState:
+    def reupdate(self, view, slot, ticked: TickedPBftState) -> PBftState:
         """reupdateChainDepState (PBFT.hs:356-372): no signature check;
         delegation + window append still run (failures are errors, the
         checks are known to pass)."""
+        if view is PBFT_BOUNDARY_VIEW:
+            return ticked.state
         gk = ticked.dlg[view.issuer_vk]
         return self._append_signer(ticked.state, slot, gk)
 

@@ -300,13 +300,10 @@ class ChainDB:
             if to_inclusive is not None and p == to_inclusive:
                 done = True
 
-        for n in self.immutable._chunks:
+        for p in self.immutable.iter_points():
+            visit(p)
             if done:
                 break
-            for e in self.immutable._entries[n]:
-                visit(Point(e.slot, e.hash_))
-                if done:
-                    break
         if not done:
             for b in self.current_chain:
                 visit(b.point)

@@ -337,6 +337,13 @@ class ImmutableDB:
                 return self._read(n, e)
         raise MissingBlock(point)
 
+    def iter_points(self) -> Iterator[Point]:
+        """All block points in slot order WITHOUT reading bodies — the
+        cheap plan walk ranged ChainDB iterators build on."""
+        for n in self._chunks:
+            for e in self._entries[n]:
+                yield Point(e.slot, e.hash_)
+
     def stream_all(self) -> Iterator[tuple[IndexEntry, bytes]]:
         """Stream every block in slot order (db-analyser processAll)."""
         for n in self._chunks:

@@ -43,7 +43,12 @@ class RealFS:
         self.root = root
 
     def _p(self, path: str) -> str:
-        return os.path.join(self.root, path) if self.root != "/" else path
+        if self.root == "/":
+            return path
+        # a MountPoint must CONTAIN its paths: absolute inputs are
+        # re-rooted, not allowed to escape (os.path.join would discard
+        # the root for an absolute second argument)
+        return os.path.join(self.root, path.lstrip("/"))
 
     # -- directories ---------------------------------------------------------
 

@@ -65,6 +65,12 @@ class ResourceRegistry:
         self._closed = True
         for t in reversed(self._tasks):
             t.alive = False
+            # close the generator so try/finally cleanup (e.g. RAWLock
+            # waiter counters) runs deterministically, not at GC time
+            try:
+                t.gen.close()
+            except Exception:
+                pass
         for r, release in reversed(self._resources):
             release(r)
         self._resources.clear()
@@ -126,9 +132,14 @@ class RAWLock:
 
     def acquire_write(self):
         self._writers_waiting += 1
-        while self._readers or self._appender or self._writer:
-            yield Wait(self._changed)
-        self._writers_waiting -= 1
+        try:
+            while self._readers or self._appender or self._writer:
+                yield Wait(self._changed)
+        finally:
+            # runs on normal exit AND on generator close (a parked
+            # writer killed via ResourceRegistry teardown must not
+            # leave the priority counter stuck, starving readers)
+            self._writers_waiting -= 1
         self._writer = True
 
     def release_write(self):

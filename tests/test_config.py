@@ -1,0 +1,60 @@
+"""TopLevelConfig bundle + hard-fork-aware slot clock.
+
+Reference: Config.hs:38 (TopLevelConfig, configSecurityParam) and
+BlockchainTime/WallClock/HardFork.hs:9 (hardForkBlockchainTime — the
+clock re-queries the HFC summary so era slot-length changes take
+effect at the era boundary).
+"""
+
+from fractions import Fraction
+
+from ouroboros_consensus_tpu.config import (
+    BlockConfig,
+    HardForkSlotClock,
+    StorageConfig,
+    TopLevelConfig,
+)
+from ouroboros_consensus_tpu.hardfork.history import EraParams, summarize
+from ouroboros_consensus_tpu.ledger import mock as mock_ledger
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.testing import fixtures
+
+
+def test_top_level_config_bundle():
+    params = praos.PraosParams(
+        slots_per_kes_period=100, max_kes_evolutions=62, security_param=7,
+        active_slot_coeff=Fraction(1, 2), epoch_length=100, kes_depth=3,
+    )
+    pool = fixtures.make_pool(0, kes_depth=3)
+    lview = fixtures.make_ledger_view([pool])
+    cfg = TopLevelConfig(
+        protocol=params,
+        ledger=mock_ledger.MockConfig(lview, params.stability_window),
+        block=BlockConfig(protocol_version=(10, 0)),
+        storage=StorageConfig(chunk_size=50),
+    )
+    assert cfg.security_param == 7  # configSecurityParam projection
+    assert cfg.storage.chunk_size == 50
+    assert cfg.block.protocol_version == (10, 0)
+
+
+def test_hardfork_slot_clock_era_lengths():
+    """Era A: 2-second slots for 1 epoch (10 slots); era B: 1-second
+    slots. The clock must place wallclock times correctly across the
+    boundary — a fixed-length clock would be wrong in era B."""
+    summary = summarize(
+        Fraction(0),
+        [
+            EraParams(epoch_size=10, slot_length=Fraction(2), safe_zone=2),
+            EraParams(epoch_size=10, slot_length=Fraction(1), safe_zone=2),
+        ],
+        [1, None],  # era A ends at epoch 1
+    )
+    clock = HardForkSlotClock(summary)
+    # era A: slot s starts at 2s
+    assert clock.start_of(3) == 6.0
+    assert clock.slot_of(7.9) == 3
+    # boundary: slot 10 starts at 20.0; era B slots are 1s
+    assert clock.start_of(10) == 20.0
+    assert clock.start_of(15) == 25.0
+    assert clock.slot_of(25.5) == 15

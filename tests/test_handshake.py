@@ -55,3 +55,35 @@ def test_handshake_refusal_propagates():
     sim.spawn(handshake.server(req, rsp, {3: MAGIC}), "server")
     with pytest.raises(TaskFailed):
         sim.run(until=1.0)
+
+
+def test_version_gated_app_bundle(tmp_path):
+    """NodeToNode.hs:434-466: the negotiated version decides the app
+    set — v1 peers run chainsync+blockfetch only; v3 peers add
+    txsubmission2, keepalive and peersharing. The sync itself works
+    through the bundle."""
+    import tests.test_pipelining as tp
+    from ouroboros_consensus_tpu.node.apps import connect_peers
+    from ouroboros_consensus_tpu.utils.sim import Sim
+
+    server = tp._mk_node(tmp_path, "server")
+    client = tp._mk_node(tmp_path, "client")
+    for b in tp._forge_chain(5):
+        server.chain_db.add_block(b)
+
+    sim = Sim()
+    server.chain_db.runtime = sim
+    client.chain_db.runtime = sim
+    v1 = {1: MAGIC}
+    v_all = {1: MAGIC, 2: MAGIC, 3: MAGIC}
+    apps = connect_peers(sim, server, client, v_all, v1)
+    assert apps.version == 1
+    assert apps.protocols() == {"chainsync", "blockfetch"}
+    sim.run(until=30.0)
+    assert client.chain_db.tip_point().hash_ == server.chain_db.tip_point().hash_
+
+    apps3 = connect_peers(Sim(), server, client, v_all, v_all)
+    assert apps3.version == 3
+    assert apps3.protocols() == {
+        "chainsync", "blockfetch", "txsubmission", "keepalive", "peersharing"
+    }

@@ -175,3 +175,51 @@ def test_hardfork_block_roundtrip():
     assert back.era == 1
     assert back.hash_ == blk.hash_
     assert back.txs == (b"tx1",)
+
+
+# -- cross-era txs + queries (InjectTxs.hs, Combinator/Ledger/Query.hs) ------
+
+
+def test_inject_tx_translates_across_eras():
+    from ouroboros_consensus_tpu.hardfork.combinator import (
+        CannotInjectTx,
+        HardForkTx,
+        TxFromFutureEra,
+        inject_tx,
+    )
+
+    # era B's tx format wraps era A's with a version marker
+    era_a = Era("A", None, ledger=None)
+    era_b = Era("B", None, ledger=None,
+                translate_tx=lambda raw: b"v2:" + raw)
+    era_c = Era("C", None, ledger=None)  # no translation INTO C
+
+    eras = [era_a, era_b, era_c]
+    # same-era: unchanged
+    assert inject_tx(eras, 0, HardForkTx(0, b"tx")) == b"tx"
+    # A-era tx offered in era B: translated
+    assert inject_tx(eras, 1, HardForkTx(0, b"tx")) == b"v2:tx"
+    # B-era tx in era C: boundary has no translation
+    with pytest.raises(CannotInjectTx):
+        inject_tx(eras, 2, HardForkTx(1, b"tx"))
+    # future-era tx rejected
+    with pytest.raises(TxFromFutureEra):
+        inject_tx(eras, 0, HardForkTx(1, b"tx"))
+
+
+def test_hard_fork_queries():
+    from ouroboros_consensus_tpu.hardfork.combinator import (
+        HardForkLedger,
+        HFState,
+        hard_fork_query,
+    )
+
+    s = two_era_summary()
+    era_a = Era("eraA", None, ledger=None)
+    era_b = Era("eraB", None, ledger=None)
+    ledger = HardForkLedger([era_a, era_b], s)
+    st = HFState(1, None)
+    assert hard_fork_query(ledger, s, st, "get_current_era") == (1, "eraB")
+    assert hard_fork_query(ledger, s, st, "get_era_start") == 40
+    interp = hard_fork_query(ledger, s, st, "get_interpreter")
+    assert interp.slot_to_epoch(45)[0] >= 2  # clients run conversions locally

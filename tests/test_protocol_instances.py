@@ -122,3 +122,22 @@ def test_leader_schedule():
     with pytest.raises(NotScheduledLeader):
         p.update(1, 1, t)
     assert p.check_is_leader(0, 2, p.tick(None, 2, st)) is None
+
+
+def test_pbft_boundary_blocks():
+    """EBBs (Block/EBB.hs, PBFT.hs PBftValidateBoundary): unsigned epoch
+    boundary blocks validate with NO state change and NO window effect."""
+    from ouroboros_consensus_tpu.hardfork import byron_mock
+    from ouroboros_consensus_tpu.protocol.instances import PBFT_BOUNDARY_VIEW
+
+    p = PBftProtocol(PBftParams(2, Fraction(1, 2), 4), VKS[:2])
+    st = p.update(pbft_view(0), 0, p.tick(None, 0, p.initial_state()))
+    ebb = byron_mock.forge_ebb(slot=40, block_no=0, prev_hash=b"\x00" * 32)
+    assert ebb.header.to_view() is PBFT_BOUNDARY_VIEW
+    assert ebb.check_integrity()
+    # roundtrips through the codec with the EBB marker intact
+    again = byron_mock.ByronMockBlock.from_bytes(ebb.bytes_)
+    assert again.header.is_ebb and again.hash_ == ebb.hash_
+    st2 = p.update(ebb.header.to_view(), 40, p.tick(None, 40, st))
+    assert st2 == st  # no signer-window change
+    assert p.reupdate(ebb.header.to_view(), 40, p.tick(None, 40, st)) == st
