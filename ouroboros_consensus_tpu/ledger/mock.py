@@ -99,7 +99,12 @@ class MockLedger:
         """Validates FULLY before mutating: on failure `utxo` is
         untouched (atomic-on-failure — the Mempool's fast path applies
         into its cached view without a defensive copy)."""
-        ins, outs = decode_tx(tx_bytes)
+        try:
+            ins, outs = decode_tx(tx_bytes)
+        except Exception as e:
+            # malformed bytes are an INVALID TX, not a crash — peers can
+            # gossip arbitrary garbage into the mempool path
+            raise InvalidTx(f"undecodable tx: {e!r}") from e
         if len(set(ins)) != len(ins):
             raise MissingInput(ins[0])  # duplicate input spends
         consumed = 0

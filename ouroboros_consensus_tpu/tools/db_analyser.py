@@ -285,6 +285,126 @@ def count_blocks(db_path: str) -> int:
     return imm.n_blocks()
 
 
+def show_block_stats(db_path: str) -> dict:
+    """GetBlockApplicationMetrics / block-size counts analog
+    (Analysis.hs:75-88 counts/sizes family): min/max/total sizes + slot
+    span without validating anything."""
+    imm = open_immutable(db_path)
+    n = 0
+    total = 0
+    smallest = None
+    largest = None
+    first_slot = last_slot = None
+    for entry, raw in imm.stream_all():
+        n += 1
+        total += len(raw)
+        smallest = len(raw) if smallest is None else min(smallest, len(raw))
+        largest = len(raw) if largest is None else max(largest, len(raw))
+        if first_slot is None:
+            first_slot = entry.slot
+        last_slot = entry.slot
+    return {
+        "n_blocks": n,
+        "total_bytes": total,
+        "min_block_bytes": smallest,
+        "max_block_bytes": largest,
+        "first_slot": first_slot,
+        "last_slot": last_slot,
+    }
+
+
+def store_ledger_state_at(
+    db_path: str,
+    params: PraosParams,
+    lview: LedgerView,
+    slot: int,
+    ledger,
+    genesis_state,
+    snap_dir: str,
+) -> str | None:
+    """StoreLedgerStateAt (Analysis.hs:118): replay (reapply, no crypto)
+    up to the last block with slot <= `slot` and write that
+    ExtLedgerState as a LedgerDB-compatible snapshot — a later
+    db-analyser/node run can start from it instead of genesis."""
+    from ..ledger.extended import ExtLedger, ExtLedgerState
+    from ..ledger.header_validation import AnnTip, HeaderState
+    from ..storage import serialize
+    from ..utils.fs import REAL_FS
+
+    imm = open_immutable(db_path)
+    st = PraosState()
+    lst = genesis_state
+    tip = None
+    for entry, raw in imm.stream_all():
+        if entry.slot > slot:
+            break
+        block = Block.from_bytes(raw)
+        h = block.header
+        ticked = praos.tick(params, lview, h.slot, st)
+        st = praos.reupdate(params, h.to_view(), h.slot, ticked)
+        lst = ledger.tick_then_reapply(lst, block)
+        tip = AnnTip(h.slot, h.block_no, h.hash_)
+    if tip is None:
+        return None
+    ext = ExtLedgerState(lst, HeaderState(tip, st))
+    import os as _os
+
+    REAL_FS.makedirs(snap_dir)
+    name = f"snapshot-{tip.slot}"
+    REAL_FS.write_atomic(
+        _os.path.join(snap_dir, name), serialize.encode_ext_state(ext)
+    )
+    return name
+
+
+def repro_mempool_and_forge(
+    db_path: str,
+    params: PraosParams,
+    lview: LedgerView,
+    ledger,
+    genesis_state,
+    n_blocks: int | None = None,
+) -> list[dict]:
+    """ReproMempoolAndForge (Analysis.hs:615): replay the chain and, at
+    every block, push that block's txs through a mempool against the
+    pre-block ledger state and time the two phases the reference
+    reports — durTick (snapshot revalidation tick) and durSnap
+    (snapshot acquisition) — plus the add time."""
+    from ..mempool import Mempool
+
+    imm = open_immutable(db_path)
+    rows: list[dict] = []
+    lst = genesis_state
+    for i, (entry, raw) in enumerate(imm.stream_all()):
+        if n_blocks is not None and i >= n_blocks:
+            break
+        block = Block.from_bytes(raw)
+        pool_state = lst
+        pool = Mempool(ledger, lambda: (pool_state, block.slot))
+        t = time.monotonic()
+        accepted, rejected = pool.try_add_txs(list(block.txs))
+        add_us = (time.monotonic() - t) * 1e6
+        t = time.monotonic()
+        ticked = ledger.tick(lst, block.slot)
+        tick_us = (time.monotonic() - t) * 1e6
+        t = time.monotonic()
+        snap = pool.get_snapshot_for(ticked.state, block.slot)
+        snap_us = (time.monotonic() - t) * 1e6
+        rows.append(
+            {
+                "slot": block.slot,
+                "n_txs": len(block.txs),
+                "accepted": len(accepted),
+                "rejected": len(rejected),
+                "mut_add_us": add_us,
+                "dur_tick_us": tick_us,
+                "dur_snap_us": snap_us,
+            }
+        )
+        lst = ledger.tick_then_reapply(lst, block)
+    return rows
+
+
 def main(argv=None) -> None:
     """CLI (app/db-analyser.hs + DBAnalyser/Parsers.hs analog)."""
     import argparse
@@ -298,7 +418,8 @@ def main(argv=None) -> None:
     p.add_argument("--kes-depth", type=int, default=7)
     p.add_argument(
         "--analysis",
-        choices=["only-validation", "benchmark-ledger-ops", "count-blocks"],
+        choices=["only-validation", "benchmark-ledger-ops", "count-blocks",
+                 "show-block-stats"],
         default="only-validation",
     )
     p.add_argument("--backend", choices=["device", "native", "host"], default="device")
@@ -309,6 +430,11 @@ def main(argv=None) -> None:
     a = p.parse_args(argv)
     if a.analysis == "count-blocks":
         print(count_blocks(a.db))
+        return
+    if a.analysis == "show-block-stats":
+        import json as _json
+
+        print(_json.dumps(show_block_stats(a.db)))
         return
     import os as _os
 

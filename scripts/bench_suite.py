@@ -1,0 +1,202 @@
+"""BASELINE.md config suite: one JSON line per benchmark config.
+
+Covers the five configs BASELINE.json prescribes (bench.py at the repo
+root is the driver-facing north-star — config 1 at full scale):
+
+  1. db-analyser --only-validation on a db-synthesizer Praos chain
+     (device vs measured single-core C++ baseline)
+  2. standalone batched Ed25519 verify (Praos.hs:580 shape)
+  3. batched Praos VRF leader checks (Praos.hs:528-556 + VRF.hs:55-112)
+  4. batched CompactSum KES verifies (Praos.hs:582)
+  5. mixed-era HFC revalidation (Cardano/CanHardFork.hs:273 shape) with
+     the batched backend on the Praos-class segments
+
+Sizes scale with --scale (1.0 = the BASELINE sizes; use 0.01 on CPU).
+
+Usage: python scripts/bench_suite.py [--scale 0.05] [--configs 1,2,3,4,5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _emit(config: int, metric: str, n: int, device_s: float, baseline_s: float | None):
+    row = {
+        "config": config,
+        "metric": metric,
+        "n": n,
+        "device_per_s": round(n / device_s, 1) if device_s else None,
+        "baseline_per_s": (
+            round(n / baseline_s, 1) if baseline_s else None
+        ),
+        "vs_baseline": (
+            round(baseline_s / device_s, 2) if device_s and baseline_s else None
+        ),
+    }
+    print(json.dumps(row))
+    return row
+
+
+def config1(scale: float, tmp: str):
+    """End-to-end revalidation (10k headers at scale 1.0)."""
+    from fractions import Fraction
+
+    from ouroboros_consensus_tpu.protocol import praos
+    from ouroboros_consensus_tpu.tools import db_analyser, db_synthesizer
+
+    n = max(200, int(10_000 * scale))
+    params = db_synthesizer.default_params(kes_depth=7)
+    pools, lview = db_synthesizer.make_credentials(1, kes_depth=7)
+    path = os.path.join(tmp, f"cfg1-{n}")
+    if not os.path.exists(os.path.join(path, "immutable")):
+        db_synthesizer.synthesize(
+            path, params, pools, lview, db_synthesizer.ForgeLimit(blocks=n)
+        )
+    t0 = time.monotonic()
+    r = db_analyser.revalidate(path, params, lview, backend="device")
+    dev = time.monotonic() - t0
+    assert r.error is None and r.n_valid == n
+    t0 = time.monotonic()
+    rb = db_analyser.revalidate(path, params, lview, backend="native")
+    base = time.monotonic() - t0
+    assert rb.error is None
+    return _emit(1, "headers revalidated end-to-end", n, dev, base)
+
+
+def _ed25519_inputs(n):
+    from ouroboros_consensus_tpu.ops.host import fast
+
+    seeds = [bytes([i % 251 + 1]) * 32 for i in range(n)]
+    msgs = [b"witness-%d" % i for i in range(n)]
+    pks = [fast.ed25519_public(s) for s in seeds]
+    sigs = [fast.ed25519_sign(s, m) for s, m in zip(seeds, msgs)]
+    return pks, sigs, msgs
+
+
+def config2(scale: float, tmp: str):
+    """64k standalone Ed25519 verifies."""
+    import numpy as np
+
+    from ouroboros_consensus_tpu import native_loader as nl
+    from ouroboros_consensus_tpu.ops import ed25519_batch
+
+    n = max(256, int(65_536 * scale))
+    pks, sigs, msgs = _ed25519_inputs(n)
+    ok = ed25519_batch.verify_batch(pks[:8], sigs[:8], msgs[:8])  # warm
+    t0 = time.monotonic()
+    ok = ed25519_batch.verify_batch(pks, sigs, msgs)
+    dev = time.monotonic() - t0
+    assert np.asarray(ok).all()
+    t0 = time.monotonic()
+    for p, s, m in zip(pks, sigs, msgs):
+        assert nl.native_ed25519_verify(p, s, m)
+    base = time.monotonic() - t0
+    return _emit(2, "standalone Ed25519 verifies", n, dev, base)
+
+
+def config3(scale: float, tmp: str):
+    """100k VRF leader checks (verify + leader threshold)."""
+    from fractions import Fraction
+
+    import numpy as np
+
+    from ouroboros_consensus_tpu import native_loader as nl
+    from ouroboros_consensus_tpu.ops import ecvrf_batch
+    from ouroboros_consensus_tpu.ops.host import fast
+    from ouroboros_consensus_tpu.protocol import nonces
+
+    n = max(256, int(100_000 * scale))
+    eta = b"\x07" * 32
+    seeds = [bytes([i % 251 + 1]) * 32 for i in range(n)]
+    alphas = [nonces.mk_input_vrf(i, eta) for i in range(n)]
+    pks = [fast.ed25519_public(s) for s in seeds]
+    pis = [fast.ecvrf_prove(s, a) for s, a in zip(seeds, alphas)]
+    ecvrf_batch.verify_batch(pks[:8], pis[:8], alphas[:8])  # warm
+    t0 = time.monotonic()
+    ok, betas = ecvrf_batch.verify_batch(pks, pis, alphas)
+    dev = time.monotonic() - t0
+    assert np.asarray(ok).all()
+    t0 = time.monotonic()
+    for p, pi, a in zip(pks, pis, alphas):
+        assert nl.native_ecvrf_verify(p, pi, a) is not None
+    base = time.monotonic() - t0
+    return _emit(3, "VRF leader-check verifies", n, dev, base)
+
+
+def config4(scale: float, tmp: str):
+    """50k CompactSum7 KES verifies."""
+    import numpy as np
+
+    from ouroboros_consensus_tpu import native_loader as nl
+    from ouroboros_consensus_tpu.ops import kes_batch
+    from ouroboros_consensus_tpu.ops.host import kes as hk
+
+    n = max(256, int(50_000 * scale))
+    depth = 7
+    # a handful of keys at varied evolutions, repeated across the batch
+    base_keys = [(bytes([i + 1]) * 32, i % 5) for i in range(8)]
+    vks, periods, msgs, sigs = [], [], [], []
+    for i in range(n):
+        seed, t = base_keys[i % len(base_keys)]
+        msg = b"hdr-%d" % i
+        vks.append(hk.derive_vk(seed, depth))
+        periods.append(t)
+        msgs.append(msg)
+        sigs.append(hk.sign(seed, depth, t, msg))
+    kes_batch.verify_batch(vks[:8], periods[:8], msgs[:8], sigs[:8], depth)
+    t0 = time.monotonic()
+    ok = kes_batch.verify_batch(vks, periods, msgs, sigs, depth)
+    dev = time.monotonic() - t0
+    assert np.asarray(ok).all()
+    t0 = time.monotonic()
+    for v, p, m, s in zip(vks, periods, msgs, sigs):
+        assert nl.native_kes_verify(v, depth, p, m, s)
+    base = time.monotonic() - t0
+    return _emit(4, "CompactSum7 KES verifies", n, dev, base)
+
+
+def config5(scale: float, tmp: str):
+    """Mixed-era (Byron→TPraos→Praos) revalidation through the HFC."""
+    from ouroboros_consensus_tpu.hardfork import composite
+
+    n_slots = max(300, int(30_000 * scale))
+    cfg = composite.CardanoMockConfig()
+    path = os.path.join(tmp, f"cfg5-{n_slots}")
+    if not os.path.exists(os.path.join(path, "immutable")):
+        composite.synthesize(path, cfg, n_slots)
+    t0 = time.monotonic()
+    r = composite.revalidate(path, cfg, backend="device")
+    dev = time.monotonic() - t0
+    assert r.error is None
+    t0 = time.monotonic()
+    rb = composite.revalidate(path, cfg, backend="native")
+    base = time.monotonic() - t0
+    assert rb.error is None
+    return _emit(5, "mixed-era HFC blocks revalidated", r.n_valid, dev, base)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--tmp", default="/tmp/oc-bench-suite")
+    args = ap.parse_args(argv)
+    os.makedirs(args.tmp, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/ouroboros-jax-cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+    for c in (int(x) for x in args.configs.split(",")):
+        fns[c](args.scale, args.tmp)
+
+
+if __name__ == "__main__":
+    main()

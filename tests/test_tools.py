@@ -143,3 +143,69 @@ def test_tutorial_runs():
     )
     assert r.returncode == 0, r.stderr
     assert "tutorial complete" in r.stdout
+
+
+def test_show_block_stats(synth_db):
+    path, res = synth_db
+    stats = db_analyser.show_block_stats(path)
+    assert stats["n_blocks"] == res.n_blocks
+    assert stats["min_block_bytes"] > 0
+    assert stats["last_slot"] < 120
+
+
+def _valid_tx_chain(tmp_path):
+    """A chain whose bodies are VALID mock-ledger txs (each block spends
+    a distinct genesis output)."""
+    from fractions import Fraction as F
+
+    from ouroboros_consensus_tpu.block import forge_block
+    from ouroboros_consensus_tpu.ledger import mock as mock_ledger
+    from ouroboros_consensus_tpu.ledger.mock import encode_tx
+    from ouroboros_consensus_tpu.storage.immutable import ImmutableDB
+
+    ledger = mock_ledger.MockLedger(
+        mock_ledger.MockConfig(None, PARAMS.stability_window)
+    )
+    genesis = ledger.genesis_state([(b"a%d" % i, 5) for i in range(8)])
+    pool = fixtures.make_pool(0, kes_depth=PARAMS.kes_depth)
+    path = str(tmp_path / "txchain")
+    imm = ImmutableDB(path + "/immutable", chunk_size=100)
+    prev = None
+    for i in range(6):
+        tx = encode_tx([(bytes(32), i)], [(b"out%d" % i, 5)])
+        b = forge_block(
+            PARAMS, pool, slot=i + 1, block_no=i, prev_hash=prev,
+            epoch_nonce=b"\x22" * 32, txs=(tx,),
+        )
+        imm.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+        prev = b.hash_
+    lview2 = fixtures.make_ledger_view([pool])
+    return path, ledger, genesis, lview2
+
+
+def test_store_ledger_state_at_and_repro_mempool(tmp_path):
+    """StoreLedgerStateAt (Analysis.hs:118) + ReproMempoolAndForge
+    (Analysis.hs:615) over a chain with real mock-ledger txs."""
+    path, ledger, genesis, lview2 = _valid_tx_chain(tmp_path)
+    snap_dir = str(tmp_path / "snaps")
+    name = db_analyser.store_ledger_state_at(
+        path, PARAMS, lview2, slot=4, ledger=ledger,
+        genesis_state=genesis, snap_dir=snap_dir,
+    )
+    assert name == "snapshot-4"
+    from ouroboros_consensus_tpu.storage import serialize
+
+    ext = serialize.decode_ext_state(
+        open(f"{snap_dir}/{name}", "rb").read()
+    )
+    assert ext.header_state.tip.slot == 4
+    # 4 genesis outputs spent by slots 1..4
+    assert (bytes(32), 0) not in ext.ledger_state.utxo
+    assert (bytes(32), 5) in ext.ledger_state.utxo
+
+    rows = db_analyser.repro_mempool_and_forge(
+        path, PARAMS, lview2, ledger, genesis
+    )
+    assert len(rows) == 6
+    assert all(r["accepted"] == 1 and r["rejected"] == 0 for r in rows)
+    assert all(r["dur_snap_us"] >= 0 for r in rows)
