@@ -101,18 +101,23 @@ class MockLedger:
         into its cached view without a defensive copy)."""
         try:
             ins, outs = decode_tx(tx_bytes)
+            # shape checks inside the guard: structurally-decodable
+            # garbage (unhashable inputs, non-int amounts) must also be
+            # an INVALID TX, not a crash — peers gossip arbitrary bytes
+            if len(set(ins)) != len(ins):
+                raise MissingInput(ins[0])  # duplicate input spends
+            consumed = 0
+            for txin in ins:
+                if txin not in utxo:
+                    raise MissingInput(txin)
+                consumed += utxo[txin][1]
+            produced = sum(a for _, a in outs)
+            if not isinstance(produced, int) or not isinstance(consumed, int):
+                raise InvalidTx("non-integer value")
+        except InvalidTx:
+            raise
         except Exception as e:
-            # malformed bytes are an INVALID TX, not a crash — peers can
-            # gossip arbitrary garbage into the mempool path
-            raise InvalidTx(f"undecodable tx: {e!r}") from e
-        if len(set(ins)) != len(ins):
-            raise MissingInput(ins[0])  # duplicate input spends
-        consumed = 0
-        for txin in ins:
-            if txin not in utxo:
-                raise MissingInput(txin)
-            consumed += utxo[txin][1]
-        produced = sum(a for _, a in outs)
+            raise InvalidTx(f"malformed tx: {e!r}") from e
         if self.config.check_value_conservation and consumed != produced:
             raise ValueNotConserved(consumed, produced)
         tid = tx_id(tx_bytes)

@@ -295,14 +295,16 @@ def show_block_stats(db_path: str) -> dict:
     smallest = None
     largest = None
     first_slot = last_slot = None
-    for entry, raw in imm.stream_all():
-        n += 1
-        total += len(raw)
-        smallest = len(raw) if smallest is None else min(smallest, len(raw))
-        largest = len(raw) if largest is None else max(largest, len(raw))
-        if first_slot is None:
-            first_slot = entry.slot
-        last_slot = entry.slot
+    # sizes/slots live in the CRC index — no body reads
+    for chunk in imm._chunks:
+        for entry in imm._entries[chunk]:
+            n += 1
+            total += entry.size
+            smallest = entry.size if smallest is None else min(smallest, entry.size)
+            largest = entry.size if largest is None else max(largest, entry.size)
+            if first_slot is None:
+                first_slot = entry.slot
+            last_slot = entry.slot
     return {
         "n_blocks": n,
         "total_bytes": total,
@@ -326,7 +328,7 @@ def store_ledger_state_at(
     up to the last block with slot <= `slot` and write that
     ExtLedgerState as a LedgerDB-compatible snapshot — a later
     db-analyser/node run can start from it instead of genesis."""
-    from ..ledger.extended import ExtLedger, ExtLedgerState
+    from ..ledger.extended import ExtLedgerState
     from ..ledger.header_validation import AnnTip, HeaderState
     from ..storage import serialize
     from ..utils.fs import REAL_FS
@@ -359,8 +361,6 @@ def store_ledger_state_at(
 
 def repro_mempool_and_forge(
     db_path: str,
-    params: PraosParams,
-    lview: LedgerView,
     ledger,
     genesis_state,
     n_blocks: int | None = None,

@@ -44,21 +44,32 @@ def _emit(config: int, metric: str, n: int, device_s: float, baseline_s: float |
     return row
 
 
+def _synth_once(path: str, forge) -> None:
+    """Synthesize exactly once: a COMPLETE marker guards against reusing
+    a chain left truncated by an interrupted earlier run."""
+    import shutil
+
+    marker = os.path.join(path, "COMPLETE")
+    if os.path.exists(marker):
+        return
+    shutil.rmtree(path, ignore_errors=True)
+    os.makedirs(path, exist_ok=True)
+    forge()
+    with open(marker, "w") as f:
+        f.write("ok")
+
+
 def config1(scale: float, tmp: str):
     """End-to-end revalidation (10k headers at scale 1.0)."""
-    from fractions import Fraction
-
-    from ouroboros_consensus_tpu.protocol import praos
     from ouroboros_consensus_tpu.tools import db_analyser, db_synthesizer
 
     n = max(200, int(10_000 * scale))
     params = db_synthesizer.default_params(kes_depth=7)
     pools, lview = db_synthesizer.make_credentials(1, kes_depth=7)
     path = os.path.join(tmp, f"cfg1-{n}")
-    if not os.path.exists(os.path.join(path, "immutable")):
-        db_synthesizer.synthesize(
-            path, params, pools, lview, db_synthesizer.ForgeLimit(blocks=n)
-        )
+    _synth_once(path, lambda: db_synthesizer.synthesize(
+        path, params, pools, lview, db_synthesizer.ForgeLimit(blocks=n)
+    ))
     t0 = time.monotonic()
     r = db_analyser.revalidate(path, params, lview, backend="device")
     dev = time.monotonic() - t0
@@ -103,8 +114,6 @@ def config2(scale: float, tmp: str):
 
 def config3(scale: float, tmp: str):
     """100k VRF leader checks (verify + leader threshold)."""
-    from fractions import Fraction
-
     import numpy as np
 
     from ouroboros_consensus_tpu import native_loader as nl
@@ -169,8 +178,7 @@ def config5(scale: float, tmp: str):
     n_slots = max(300, int(30_000 * scale))
     cfg = composite.CardanoMockConfig()
     path = os.path.join(tmp, f"cfg5-{n_slots}")
-    if not os.path.exists(os.path.join(path, "immutable")):
-        composite.synthesize(path, cfg, n_slots)
+    _synth_once(path, lambda: composite.synthesize(path, cfg, n_slots))
     t0 = time.monotonic()
     r = composite.revalidate(path, cfg, backend="device")
     dev = time.monotonic() - t0

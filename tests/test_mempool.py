@@ -112,3 +112,20 @@ def test_get_snapshot_for_respects_budget(ledger, genesis):
     assert snap.tx_bytes() == (tx1,)
     full = pool.get_snapshot_for(genesis, 5)
     assert full.tx_bytes() == (tx1, tx2)
+
+
+def test_mempool_rejects_garbage_txs(ledger, genesis):
+    """Gossiped garbage — undecodable bytes AND structurally-decodable
+    nonsense (unhashable inputs, non-int amounts) — must come back as
+    rejections, never crash the mempool."""
+    from ouroboros_consensus_tpu.utils import cbor
+
+    pool = make_pool(ledger, genesis)
+    garbage = [
+        b"\xff\xfe not cbor",
+        cbor.encode([[[b"", []]], []]),       # unhashable input index
+        cbor.encode([[], [[b"a", b"x"]]]),    # non-int amount
+        cbor.encode([1, 2, 3]),               # wrong arity
+    ]
+    ok, bad = pool.try_add_txs(garbage)
+    assert ok == [] and len(bad) == len(garbage)

@@ -203,9 +203,7 @@ def test_store_ledger_state_at_and_repro_mempool(tmp_path):
     assert (bytes(32), 0) not in ext.ledger_state.utxo
     assert (bytes(32), 5) in ext.ledger_state.utxo
 
-    rows = db_analyser.repro_mempool_and_forge(
-        path, PARAMS, lview2, ledger, genesis
-    )
+    rows = db_analyser.repro_mempool_and_forge(path, ledger, genesis)
     assert len(rows) == 6
     assert all(r["accepted"] == 1 and r["rejected"] == 0 for r in rows)
     assert all(r["dur_snap_us"] >= 0 for r in rows)
