@@ -48,11 +48,23 @@ class HFState:
     era: int
     inner: Any
 
+    @property
+    def utxo(self):
+        """Mempool anchoring reads the inner ledger state's UTxO (the
+        HFC mempool projects into the current era, Combinator/Mempool.hs)."""
+        return self.inner.utxo
+
 
 @dataclass(frozen=True)
 class TickedHFState:
     era: int
-    inner: Any  # the era protocol's ticked state
+    inner: Any  # the era protocol's/ledger's ticked state
+
+    @property
+    def state(self) -> Any:
+        """Un-ticked inner payload (mempool snapshot path reads the
+        ticked LEDGER state's .state — delegate to the era's)."""
+        return self.inner.state
 
 
 class HardForkProtocol:
@@ -68,6 +80,14 @@ class HardForkProtocol:
 
     def era_of_slot(self, slot: int) -> int:
         return self.summary.era_index_of_slot(slot)
+
+    @property
+    def params(self):
+        """Forging-side parameter view (KES schedule, leader coeff):
+        Cardano keeps the KES period arithmetic uniform across eras, so
+        the newest era's params stand for the composite (the HFC's
+        forging config shape, Combinator/Forging.hs)."""
+        return self.eras[-1].protocol.params
 
     def initial_state(self) -> HFState:
         return HFState(0, self.eras[0].protocol.initial_state())
@@ -213,6 +233,12 @@ class HardForkLedger:
 
     def ledger_view_forecast_at(self, state: HFState):
         return self.eras[state.era].ledger.ledger_view_forecast_at(state.inner)
+
+    def apply_tx(self, utxo: dict, tx_bytes: bytes) -> dict:
+        """Mempool path: plain txs validate under the newest era's rules
+        (earlier-era txs reach here through inject_tx's translations —
+        Combinator/Mempool.hs dispatches by the GenTx era tag)."""
+        return self.eras[-1].ledger.apply_tx(utxo, tx_bytes)
 
     def tick_then_apply(self, state, block):
         return self.apply_block(self.tick(state, block.slot), block)

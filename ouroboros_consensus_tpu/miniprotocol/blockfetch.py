@@ -63,10 +63,12 @@ def _range_stream(chain_db, _from: Point | None, to: Point):
     else:
         return None
 
+    decode = getattr(chain_db, "decode_block", Block.from_bytes)
+
     def gen():
         if imm_iter is not None:
             for _e, raw in imm_iter:
-                b = Block.from_bytes(raw)
+                b = decode(raw)
                 yield b
                 if b.point == to:
                     return
@@ -151,7 +153,9 @@ def client(node, peer_name: str, rx, tx, candidate, *, poll_interval: float = 0.
             if msg[0] == "batch_done":
                 break
             assert msg[0] == "block", msg
-            block = Block.from_bytes(msg[1])
+            # decode with the node's block codec (era-tagged bytes for
+            # HFC nets; the plain Praos block otherwise)
+            block = node.chain_db.decode_block(msg[1])
             # enqueue to the add-block runner (decoupled mode: peer
             # tasks never run chain selection themselves) and wait for
             # the verdict; synchronous mode completes inline

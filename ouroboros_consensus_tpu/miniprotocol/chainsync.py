@@ -129,6 +129,7 @@ def server(
     followers), retracted by a rollback if validation rejects them."""
     if follower is None:
         follower = chain_db.new_follower(include_tentative=include_tentative)
+    decode = getattr(chain_db, "decode_block", Block.from_bytes)
     # pending instructions not yet sent (beyond the intersection)
     pending: list = []
     # lazy stream of the immutable segment between the intersection and
@@ -196,7 +197,7 @@ def server(
                     imm_stream = None
                 else:
                     _e, raw = nxt
-                    header = Block.from_bytes(raw).header
+                    header = decode(raw).header
                     yield Send(tx, ("roll_forward", header.bytes_, tip()))
                     continue
             while True:

@@ -58,10 +58,13 @@ class NodeKernel:
         hotkey: HotKey | None = None,  # carry an EVOLVED key across a
         ocert=None,                    # restart (with its certificate)
         ocert_counter: int = 0,
+        forge_fn=None,  # block-type seam: forge_fn(node, slot, block_no,
+        # prev_hash, ticked, is_leader, txs) -> Block; None = Praos
     ):
         self.name = name
         self.chain_db = chain_db
         self.protocol = protocol
+        self.forge_fn = forge_fn
         self.ledger = ledger
         self.pool = pool
         self.clock = clock or SlotClock()
@@ -181,6 +184,12 @@ class NodeKernel:
             self.ledger.tick(ext.ledger_state, slot).state, slot
         )
         try:
+            if self.forge_fn is not None:
+                return self.forge_fn(
+                    self, slot, block_no,
+                    tip.hash_ if tip else None,
+                    ticked, is_leader, snap.tx_bytes(),
+                )
             return forge_block(
                 self.protocol.params,
                 self.pool,

@@ -182,11 +182,15 @@ class ChainDB:
         snapshot_interval: int = 100,
         trace: Callable[[str], None] = lambda s: None,
         check_in_future=None,  # block.infuture.CheckInFuture | None
+        decode_block=None,  # block codec seam; default = Praos Block
     ):
         self.ext = ext
         self.immutable = immutable
         self.volatile = volatile
         self.ledgerdb = ledgerdb
+        self.decode_block = (
+            decode_block if decode_block is not None else Block.from_bytes
+        )
         self.k = k
         self.snap_dir = snap_dir
         # DiskPolicy analog (DiskPolicy.hs:87): snapshot every N blocks
@@ -254,7 +258,7 @@ class ChainDB:
                 raw = self.immutable.get_block_bytes(point)
             except Exception:
                 return None
-        return Block.from_bytes(raw)
+        return self.decode_block(raw)
 
     def new_follower(self, include_tentative: bool = False) -> Follower:
         f = Follower(self, include_tentative=include_tentative)
@@ -268,7 +272,7 @@ class ChainDB:
     def stream_all(self) -> Iterable[Block]:
         """Iterator over the whole current chain, immutable part first."""
         for entry, raw in self.immutable.stream_all():
-            yield Block.from_bytes(raw)
+            yield self.decode_block(raw)
         yield from self.current_chain
 
     def stream(
@@ -322,7 +326,7 @@ class ChainDB:
                         raw = self.immutable.get_block_bytes(p)
                     except Exception:
                         raise BlockGCed(p) from None
-                yield Block.from_bytes(raw)
+                yield self.decode_block(raw)
 
         return resolve()
 
@@ -404,7 +408,7 @@ class ChainDB:
             raw = self.volatile.get_block_bytes(h)
             if raw is None:
                 return None
-            blocks.append(Block.from_bytes(raw))
+            blocks.append(self.decode_block(raw))
         return blocks
 
     def _best_candidate_from(
@@ -433,7 +437,7 @@ class ChainDB:
             raw = self.volatile.get_block_bytes(c[-1])
             if raw is None:
                 return None
-            return proto.select_view(Block.from_bytes(raw).header)
+            return proto.select_view(self.decode_block(raw).header)
 
         ranked = [(c, v) for c in cands if (v := tip_view(c)) is not None]
         # best-first: load the full fragment only for the winner; fall

@@ -77,9 +77,11 @@ class ImmutableDB:
         check_integrity: Callable[[bytes], bool] | None = None,
         validate_all: bool = False,
         fs=None,  # HasFS seam (utils/fs.py); None = the real filesystem
+        decode_block=None,  # block codec for index rebuilds; None = Praos
     ):
         self.path = path
         self.chunk_size = chunk_size
+        self._decode_block = decode_block
         self.fs = fs if fs is not None else REAL_FS
         self.fs.makedirs(path)
         self._entries: dict[int, list[IndexEntry]] = {}  # chunk -> entries
@@ -161,10 +163,16 @@ class ImmutableDB:
         """Walk self-delimiting CBOR blocks in the chunk file, rebuilding
         index entries; truncate at the first unparseable/bad block.
 
-        Uses the native scanner (native/headerscan.cpp) when available
-        and no integrity predicate is requested — the pure-Python CBOR
-        walk is the startup-validation bottleneck on large DBs."""
-        from ..block.praos_block import Block
+        Uses the native scanner (native/headerscan.cpp) when available,
+        no integrity predicate is requested and the block codec is the
+        default Praos layout — the pure-Python CBOR walk is the
+        startup-validation bottleneck on large DBs."""
+        if self._decode_block is None:
+            from ..block.praos_block import Block
+
+            decode = Block.from_bytes
+        else:
+            decode = self._decode_block
 
         cpath = os.path.join(self.path, _chunk_name(n))
         try:
@@ -172,7 +180,7 @@ class ImmutableDB:
         except OSError:
             return None
 
-        if check_integrity is None:
+        if check_integrity is None and self._decode_block is None:
             fast = self._reparse_chunk_native(n, data)
             if fast is not None:
                 return fast
@@ -183,7 +191,7 @@ class ImmutableDB:
             try:
                 _, end = cbor.decode_prefix(data, off)
                 blob = data[off:end]
-                blk = Block.from_bytes(blob)
+                blk = decode(blob)
             except Exception:
                 self._truncated[n] = True
                 break
@@ -337,12 +345,17 @@ class ImmutableDB:
                 return self._read(n, e)
         raise MissingBlock(point)
 
+    def iter_entries(self) -> Iterator[IndexEntry]:
+        """All index entries in slot order WITHOUT reading bodies (the
+        secondary index walk: sizes, CRCs, hashes for stats/plans)."""
+        for n in self._chunks:
+            yield from self._entries[n]
+
     def iter_points(self) -> Iterator[Point]:
         """All block points in slot order WITHOUT reading bodies — the
         cheap plan walk ranged ChainDB iterators build on."""
-        for n in self._chunks:
-            for e in self._entries[n]:
-                yield Point(e.slot, e.hash_)
+        for e in self.iter_entries():
+            yield Point(e.slot, e.hash_)
 
     def stream_all(self) -> Iterator[tuple[IndexEntry, bytes]]:
         """Stream every block in slot order (db-analyser processAll)."""

@@ -251,12 +251,15 @@ class LedgerDB:
         immutable_db,
         trace: Callable[[str], None] = lambda s: None,
         fs=None,
+        decode_block=None,
     ) -> "LedgerDB":
         """initLedgerDB (Init.hs:89-145): newest snapshot first, fall back
         to older ones then genesis; replay immutable blocks after the
         snapshot with tickThenReapply (no crypto)."""
-        from ..block.praos_block import Block
+        if decode_block is None:
+            from ..block.praos_block import Block
 
+            decode_block = Block.from_bytes
         fs = fs if fs is not None else REAL_FS
         for slot in sorted(cls.list_snapshots(snap_dir, fs=fs), reverse=True):
             path = os.path.join(snap_dir, f"snapshot-{slot}")
@@ -270,14 +273,14 @@ class LedgerDB:
             tip_slot = ext.tip_slot(state)
             start = -1 if tip_slot is None else tip_slot  # None = genesis
             for entry, raw in immutable_db.stream_from(start):
-                db.push(Block.from_bytes(raw), apply=False)
+                db.push(decode_block(raw), apply=False)
                 db._seq = db._seq[-1:]  # replay keeps only the tip state
             trace(f"replayed from snapshot-{slot}")
             return db
         db = cls(ext, k, genesis, fs=fs)
         n = 0
         for entry, raw in immutable_db.stream_all():
-            db.push(Block.from_bytes(raw), apply=False)
+            db.push(decode_block(raw), apply=False)
             db._seq = db._seq[-1:]
             n += 1
         trace(f"replayed {n} blocks from genesis")

@@ -41,20 +41,27 @@ def open_chaindb(
     trace: Callable[[str], None] = lambda s: None,
     fs=None,  # HasFS seam — a MockFS here runs the whole ChainDB in memory
     check_in_future=None,  # block.infuture.CheckInFuture | None
+    decode_block=None,  # block codec seam; default = Praos Block
+    check_integrity=None,  # per-block-type integrity hook
 ) -> ChainDB:
+    if check_integrity is None and validate_all:
+        check_integrity = default_check_integrity
     imm = ImmutableDB(
         os.path.join(path, "immutable"),
         chunk_size=chunk_size,
-        check_integrity=default_check_integrity if validate_all else None,
+        check_integrity=check_integrity if validate_all else None,
         validate_all=validate_all,
         fs=fs,
+        decode_block=decode_block,
     )
-    vol = VolatileDB(os.path.join(path, "volatile"), fs=fs)
+    vol = VolatileDB(
+        os.path.join(path, "volatile"), fs=fs, decode_block=decode_block
+    )
     snap_dir = os.path.join(path, "ledger")
     ldb = LedgerDB.init_from_snapshots(
-        ext, k, snap_dir, genesis, imm, trace, fs=fs
+        ext, k, snap_dir, genesis, imm, trace, fs=fs, decode_block=decode_block
     )
     return ChainDB(
         ext, imm, vol, ldb, k, snap_dir=snap_dir, trace=trace,
-        check_in_future=check_in_future,
+        check_in_future=check_in_future, decode_block=decode_block,
     )

@@ -39,10 +39,18 @@ class BlockInfo:
 
 
 class VolatileDB:
-    def __init__(self, path: str, max_blocks_per_file: int = 1000, fs=None):
+    def __init__(self, path: str, max_blocks_per_file: int = 1000, fs=None,
+                 decode_block=None):
         self.path = path
         self.max_blocks_per_file = max_blocks_per_file
         self.fs = fs if fs is not None else REAL_FS
+        # block codec seam (the reference is polymorphic in blk):
+        # default = the Praos block, HFC nets pass era-tagged decoders
+        if decode_block is None:
+            from ..block.praos_block import Block
+
+            decode_block = Block.from_bytes
+        self.decode_block = decode_block
         self.fs.makedirs(path)
         self._info: dict[bytes, BlockInfo] = {}
         self._successors: dict[bytes | None, set[bytes]] = {}
@@ -59,8 +67,6 @@ class VolatileDB:
         return sorted(ns)
 
     def _reopen(self) -> None:
-        from ..block.praos_block import Block
-
         for n in self._files():
             p = self._file_path(n)
             data = self.fs.read_bytes(p)
@@ -72,7 +78,7 @@ class VolatileDB:
                 if len(payload) != size or zlib.crc32(payload) != crc:
                     break
                 try:
-                    blk = Block.from_bytes(payload)
+                    blk = self.decode_block(payload)
                 except Exception:
                     break
                 self._index(blk, n, off + 8, size)

@@ -40,6 +40,11 @@ from ..storage.open import open_chaindb
 from ..testing import fixtures
 from ..utils.sim import Channel, Sim, Sleep
 
+# the COMMON genesis UTxO every node starts from: txgen spends these
+# outputs one by one, so count and amount are shared constants
+N_GENESIS_OUTPUTS = 16
+GENESIS_AMOUNT = 100
+
 
 @dataclass
 class ThreadNetConfig:
@@ -66,6 +71,14 @@ class ThreadNetConfig:
     # io-sim schedule exploration (SURVEY §5.2): a seed permutes
     # same-time task wakeups deterministically; None = FIFO
     seed: int | None = None
+    # TxGen (ThreadNet/TxGen.hs analog): every N slots, a rotating node
+    # submits a fresh valid tx spending a distinct genesis output
+    tx_gen_every: int | None = None
+    # 2-era HFC net (the reference's A→B model test, diffusion
+    # test/consensus-test HardFork/Combinator.hs): era A (Praos, these
+    # params) hard-forks into era B (Praos, doubled epoch length) at
+    # this epoch; every node runs the composite protocol/ledger
+    hard_fork_at_epoch: int | None = None
 
 
 @dataclass
@@ -127,13 +140,104 @@ class _Net:
 
     # -- vertices -----------------------------------------------------------
 
+    def _hf_pieces(self):
+        """Protocol+ledger+codec+forge for the 2-era composite."""
+        import dataclasses
+        import functools
+
+        from ..block.forge import forge_block as praos_forge
+        from ..block.praos_block import Block as PraosBlock
+        from ..hardfork.combinator import (
+            Era,
+            HardForkBlock,
+            HardForkLedger,
+            HardForkProtocol,
+            decode_block,
+        )
+        from ..hardfork.history import EraParams as HEraParams
+        from ..hardfork.history import summarize
+        from fractions import Fraction as F
+
+        cfg = self.cfg
+        params_a = self.params
+        # era B: doubled epoch length (a REAL parameter change across
+        # the boundary, like the reference's A→B test)
+        params_b = dataclasses.replace(
+            self.params, epoch_length=2 * self.params.epoch_length
+        )
+        summary = summarize(
+            F(0),
+            [
+                HEraParams(params_a.epoch_length, F(1)),
+                HEraParams(params_b.epoch_length, F(1)),
+            ],
+            [cfg.hard_fork_at_epoch, None],
+        )
+        eras = [
+            Era(
+                "eraA",
+                PraosProtocol(params_a, use_device_batch=cfg.use_device_batch),
+                ledger=MockLedger(
+                    MockConfig(self.lview, params_a.stability_window)
+                ),
+            ),
+            Era(
+                "eraB",
+                PraosProtocol(params_b, use_device_batch=cfg.use_device_batch),
+                ledger=MockLedger(
+                    MockConfig(self.lview, params_b.stability_window)
+                ),
+            ),
+        ]
+        protocol = HardForkProtocol(eras, summary)
+        ledger = HardForkLedger(eras, summary)
+        codec = functools.partial(
+            decode_block,
+            era_decoders=[PraosBlock.from_bytes, PraosBlock.from_bytes],
+        )
+
+        def forge_fn(node, slot, block_no, prev_hash, ticked, is_leader, txs):
+            era = protocol.era_of_slot(slot)
+            inner_params = params_a if era == 0 else params_b
+            blk = praos_forge(
+                inner_params,
+                node.pool,
+                slot=slot,
+                block_no=block_no,
+                prev_hash=prev_hash,
+                epoch_nonce=ticked.inner.state.epoch_nonce,
+                txs=txs,
+                is_leader=is_leader,
+                hotkey=node.hotkey,
+                ocert=node._ocert,
+            )
+            return HardForkBlock(era, blk)
+
+        def check_integrity(raw: bytes) -> bool:
+            try:
+                return codec(raw).check_integrity()
+            except Exception:
+                return False
+
+        return protocol, ledger, codec, forge_fn, check_integrity
+
     def _open_db(self, i: int, validate_all: bool = False):
+        """-> (db, protocol, ledger, forge_fn|None)."""
+        if self.cfg.hard_fork_at_epoch is not None:
+            return self._open_db_hf(i, validate_all)
         ledger = MockLedger(MockConfig(self.lview, self.params.stability_window))
         protocol = PraosProtocol(
             self.params, use_device_batch=self.cfg.use_device_batch
         )
         ext = ExtLedger(ledger, protocol)
-        genesis = ext.genesis(ledger.genesis_state([(b"addr-%d" % i, 100)]))
+        # a COMMON genesis UTxO: generated txs validate on every node
+        # regardless of where they enter the network
+        genesis = ext.genesis(
+            ledger.genesis_state(
+                [(b"genesis-%d" % k, GENESIS_AMOUNT)
+                 for k in range(N_GENESIS_OUTPUTS)]
+            )
+        )
         cif = None
         if self.cfg.in_future_check:
             from ..block.infuture import CheckInFuture
@@ -145,14 +249,45 @@ class _Net:
             os.path.join(self.base_dir, f"node{i}"), ext, genesis, self.cfg.k,
             validate_all=validate_all, check_in_future=cif,
         )
-        return db, protocol, ledger
+        return db, protocol, ledger, None
+
+    def _open_db_hf(self, i: int, validate_all: bool = False):
+        import dataclasses
+
+        protocol, ledger, codec, forge_fn, check_integrity = self._hf_pieces()
+        ext = ExtLedger(ledger, protocol)
+        inner_genesis = ledger.eras[0].ledger.genesis_state(
+            [(b"genesis-%d" % k, GENESIS_AMOUNT)
+             for k in range(N_GENESIS_OUTPUTS)]
+        )
+        genesis = ext.genesis(ledger.genesis_state(inner_genesis))
+        # seed the era-0 Praos epoch nonce inside the telescope
+        from ..hardfork.combinator import HFState
+
+        hs = genesis.header_state
+        inner0 = dataclasses.replace(
+            hs.chain_dep_state.inner, epoch_nonce=b"\x22" * 32
+        )
+        genesis = dataclasses.replace(
+            genesis,
+            header_state=dataclasses.replace(
+                hs, chain_dep_state=HFState(0, inner0)
+            ),
+        )
+        db = open_chaindb(
+            os.path.join(self.base_dir, f"node{i}"), ext, genesis, self.cfg.k,
+            validate_all=validate_all, decode_block=codec,
+            check_integrity=check_integrity,
+        )
+        return db, protocol, ledger, forge_fn
 
     def make_node(self, i: int) -> NodeKernel:
-        db, protocol, ledger = self._open_db(i)
+        db, protocol, ledger, forge_fn = self._open_db(i)
         node = NodeKernel(
             f"node{i}", db, protocol, ledger,
             pool=self.pools[i] if i in self.forgers else None,
             clock=SlotClock(self.cfg.slot_length),
+            forge_fn=forge_fn,
         )
         self._wire_chaindb(i, node)
         return node
@@ -238,7 +373,7 @@ class _Net:
         self.node_followers[i] = []
         old = self.nodes[i]
         old.chain_db.close()
-        db, protocol, ledger = self._open_db(i, validate_all=True)
+        db, protocol, ledger, forge_fn = self._open_db(i, validate_all=True)
         pool = self.pools[i] if i in self.forgers else None
         carry = pool is not None and not self.cfg.rekey_on_restart
         node = NodeKernel(
@@ -250,6 +385,7 @@ class _Net:
             hotkey=old.hotkey if carry else None,
             ocert=old._ocert if carry else None,
             ocert_counter=old._ocert_counter if carry else 0,
+            forge_fn=forge_fn,
         )
         if pool is not None and self.cfg.rekey_on_restart:
             node._ocert_counter = old._ocert_counter
@@ -296,6 +432,32 @@ def run_thread_network(base_dir: str, cfg: ThreadNetConfig) -> ThreadNetResult:
         net.spawn_edge(i, j, dt)
     if cfg.restarts:
         sim.spawn(net.restart_controller(cfg.restarts), "restart-controller")
+    if cfg.tx_gen_every:
+        from ..ledger.mock import encode_tx
+
+        def txgen():
+            from ..ledger.mock import InvalidTx
+            from ..mempool import MempoolFull
+
+            k = 0
+            while True:
+                yield Sleep(cfg.tx_gen_every * cfg.slot_length)
+                if k >= N_GENESIS_OUTPUTS:
+                    return  # genesis outputs exhausted
+                node_ix = k % cfg.n_nodes
+                tx = encode_tx(
+                    [(bytes(32), k)],
+                    [(b"paid-%d" % k, GENESIS_AMOUNT)],
+                )
+                try:
+                    net.nodes[node_ix].mempool.add_tx(tx)
+                except (InvalidTx, MempoolFull) as e:
+                    # a duplicate spend after tx diffusion raced ahead is
+                    # fine; anything else is a generator bug worth seeing
+                    net.nodes[node_ix].trace(f"txgen: rejected: {e!r}")
+                k += 1
+
+        sim.spawn(txgen(), "tx-gen")
     if cfg.tx_injections:
         def injector():
             last = 0.0

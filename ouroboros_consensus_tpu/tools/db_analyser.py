@@ -296,15 +296,14 @@ def show_block_stats(db_path: str) -> dict:
     largest = None
     first_slot = last_slot = None
     # sizes/slots live in the CRC index — no body reads
-    for chunk in imm._chunks:
-        for entry in imm._entries[chunk]:
-            n += 1
-            total += entry.size
-            smallest = entry.size if smallest is None else min(smallest, entry.size)
-            largest = entry.size if largest is None else max(largest, entry.size)
-            if first_slot is None:
-                first_slot = entry.slot
-            last_slot = entry.slot
+    for entry in imm.iter_entries():
+        n += 1
+        total += entry.size
+        smallest = entry.size if smallest is None else min(smallest, entry.size)
+        largest = entry.size if largest is None else max(largest, entry.size)
+        if first_slot is None:
+            first_slot = entry.slot
+        last_slot = entry.slot
     return {
         "n_blocks": n,
         "total_bytes": total,

@@ -21,6 +21,8 @@ from ..storage.immutable import ImmutableDB
 from ..utils import cbor
 from ..utils.sim import Recv, Send
 
+_NETWORK_MAGIC = 764824073  # mainnet magic: the DbMarker/handshake guard
+
 
 class ImmutableChainView:
     """Adapts an ImmutableDB to the slice of the ChainDB surface the
@@ -34,6 +36,7 @@ class ImmutableChainView:
         self.imm = ImmutableDB(os.path.join(db_path, "immutable"))
         self.immutable = self.imm  # chainsync/blockfetch server surface
         self.current_chain: list = []
+        self.runtime = None  # no event runtime: servers poll
 
     def _anchor_point(self) -> Point | None:
         return self.imm.tip_point()
@@ -41,10 +44,18 @@ class ImmutableChainView:
     def tip_point(self) -> Point | None:
         return self.imm.tip_point()
 
-    def new_follower(self):
+    def new_follower(self, include_tentative: bool = False):
         class _StaticFollower:
+            """The chain never changes: no updates, no tentative state."""
+
             def take_updates(self):
                 return []
+
+            def reset_position(self):
+                pass
+
+            def close(self):
+                pass
 
         return _StaticFollower()
 
@@ -94,7 +105,8 @@ async def _read_frame(reader):
     return _from_wire(cbor.decode(await reader.readexactly(n)))
 
 
-async def serve_tcp(db_path: str, host: str = "127.0.0.1", port: int = 3001):
+async def serve_tcp(db_path: str, host: str = "127.0.0.1", port: int = 3001,
+                    network_magic: int = _NETWORK_MAGIC):
     """One TCP service multiplexing chainsync-style requests: each frame
     is a request tuple; the reply frame(s) follow. Static chain only."""
     import asyncio
@@ -102,11 +114,45 @@ async def serve_tcp(db_path: str, host: str = "127.0.0.1", port: int = 3001):
     view = ImmutableChainView(db_path)
 
     async def handle(reader, writer):
+        handshaken = False
         try:
             while True:
                 msg = await _read_frame(reader)
                 kind = msg[0]
-                if kind == "find_intersect":
+                if not handshaken and kind != "propose_versions":
+                    # the reference handshakes BEFORE serving
+                    # (ImmDBServer/Diffusion.hs): an un-negotiated peer
+                    # gets nothing — that is the whole cross-net guard
+                    writer.write(
+                        _frame(("refuse", "handshake required first"))
+                    )
+                    await writer.drain()
+                    break
+                if kind == "propose_versions":
+                    # NodeToNode handshake (miniprotocol/handshake.py):
+                    # the reference immdb-server performs the full wire
+                    # handshake before serving (ImmDBServer/Diffusion.hs)
+                    from ..miniprotocol import handshake as hs
+
+                    ours = {
+                        v: hs.VersionData(network_magic=network_magic)
+                        for v in hs.NODE_TO_NODE_VERSIONS
+                    }
+                    theirs = {
+                        int(v): hs.VersionData(network_magic=d)
+                        for v, d in msg[1]
+                    }
+                    try:
+                        version, data = hs.negotiate(ours, theirs)
+                    except hs.HandshakeRefused as e:
+                        writer.write(_frame(("refuse", str(e))))
+                        await writer.drain()
+                        break
+                    writer.write(
+                        _frame(("accept_version", version, data.network_magic))
+                    )
+                    handshaken = True
+                elif kind == "find_intersect":
                     # same contract as miniprotocol/chainsync.py server:
                     # None in the offered points = genesis fallback; no
                     # match at all -> intersect_not_found
@@ -178,10 +224,13 @@ def main(argv=None) -> None:
     p.add_argument("--db", required=True)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=3001)
+    p.add_argument("--network-magic", type=int, default=_NETWORK_MAGIC,
+                   help="handshake guard; clients proposing a different "
+                        "magic are refused (default: mainnet)")
     a = p.parse_args(argv)
 
     async def run():
-        server = await serve_tcp(a.db, a.host, a.port)
+        server = await serve_tcp(a.db, a.host, a.port, a.network_magic)
         print(f"immdb-server listening on {a.host}:{a.port}")
         async with server:
             await server.serve_forever()

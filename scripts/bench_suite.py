@@ -199,6 +199,12 @@ def main(argv=None):
     os.makedirs(args.tmp, exist_ok=True)
     import jax
 
+    # honor an explicit platform request even under a sitecustomize that
+    # force-prefers a TPU plugin after interpreter start (bench.py does
+    # the same): the env var alone is not enough there
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     jax.config.update("jax_compilation_cache_dir", "/tmp/ouroboros-jax-cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}

@@ -1,0 +1,112 @@
+"""Serialisation golden + roundtrip tests.
+
+Reference: §4 tier 5 — CBOR roundtrip and GOLDEN tests with recorded
+fixtures (`consensus-testlib/Test/Util/Serialisation/{Roundtrip,Golden}.hs`,
+golden outputs committed under `ouroboros-consensus-cardano/golden/`).
+Golden bytes pin the ON-DISK format: an accidental codec change breaks
+these tests BEFORE it corrupts somebody's ChainDB.
+
+The goldens are generated from deterministic fixtures (seeded keys,
+fixed nonce) and committed under tests/golden/. Regenerate ONLY on an
+intentional format change:  python tests/test_golden.py --regen
+"""
+
+import os
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.block import forge_block
+from ouroboros_consensus_tpu.block.praos_block import Block
+from ouroboros_consensus_tpu.ledger.header_validation import AnnTip, HeaderState
+from ouroboros_consensus_tpu.ledger.mock import MockState
+from ouroboros_consensus_tpu.ledger.extended import ExtLedgerState
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.storage import serialize
+from ouroboros_consensus_tpu.testing import fixtures
+from ouroboros_consensus_tpu.utils import cbor
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=100,
+    max_kes_evolutions=62,
+    security_param=4,
+    active_slot_coeff=Fraction(1),
+    epoch_length=500,
+    kes_depth=3,
+)
+POOL = fixtures.make_pool(7, kes_depth=3)
+ETA0 = bytes(range(32))
+
+
+def golden_block() -> Block:
+    return forge_block(
+        PARAMS, POOL, slot=42, block_no=7,
+        prev_hash=b"\x11" * 32, epoch_nonce=ETA0,
+        txs=(b"tx-a", b"tx-b"),
+    )
+
+
+def golden_ext_state() -> ExtLedgerState:
+    st = praos.PraosState(
+        last_slot=42,
+        ocert_counters={POOL.pool_id: 3},
+        evolving_nonce=b"\x01" * 32,
+        candidate_nonce=b"\x02" * 32,
+        epoch_nonce=ETA0,
+        lab_nonce=b"\x03" * 32,
+        last_epoch_block_nonce=b"\x04" * 32,
+    )
+    hs = HeaderState(AnnTip(42, 7, b"\x05" * 32), st)
+    ls = MockState({(bytes(32), 0): (b"alice", 100)}, 42)
+    return ExtLedgerState(ls, hs)
+
+
+CASES = {
+    "praos_block.hex": lambda: golden_block().bytes_,
+    "ext_ledger_state.hex": lambda: serialize.encode_ext_state(golden_ext_state()),
+    "canonical_cbor.hex": lambda: cbor.encode(
+        [0, -1, 23, 24, 255, 65536, b"bytes", "text", [1, [2, [3]]], None, True]
+    ),
+}
+
+
+def _path(name):
+    return os.path.join(GOLDEN_DIR, name)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_golden(name):
+    """Recorded bytes match EXACTLY (Golden.hs goldenTestCBOR)."""
+    produced = CASES[name]()
+    with open(_path(name)) as f:
+        expected = bytes.fromhex(f.read().strip())
+    assert produced == expected, (
+        f"{name}: serialisation changed! If intentional, regenerate with "
+        f"`python tests/test_golden.py --regen` and note the format break."
+    )
+
+
+def test_block_roundtrip():
+    b = golden_block()
+    again = Block.from_bytes(b.bytes_)
+    assert again.hash_ == b.hash_ and again.txs == b.txs
+    assert again.header.to_view().signed_bytes == b.header.to_view().signed_bytes
+
+
+def test_ext_state_roundtrip():
+    ext = golden_ext_state()
+    again = serialize.decode_ext_state(serialize.encode_ext_state(ext))
+    assert again == ext
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--regen" in sys.argv:
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        for name, gen in CASES.items():
+            with open(_path(name), "w") as f:
+                f.write(gen().hex() + "\n")
+            print(f"wrote {name}")

@@ -217,3 +217,55 @@ def test_restart_before_peer_joins(tmp_path):
     assert res.chain_hashes(1) == res.chain_hashes(0), (
         f"late joiner stuck at {len(res.chains[1])} blocks"
     )
+
+
+@pytest.mark.slow
+def test_txgen_diffusion(tmp_path):
+    """TxGen (ThreadNet/TxGen.hs analog): generated txs entering at
+    rotating nodes diffuse via TxSubmission2 and land in blocks on every
+    node's chain."""
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=3, n_slots=16, k=30, msg_delay=0.05,
+        active_slot_coeff=Fraction(1),
+        forgers=[0],
+        tx_submission=True,
+        tx_gen_every=2,
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    included = [tx for b in res.chains[0] for tx in b.txs]
+    assert len(included) >= 3, f"only {len(included)} generated txs adopted"
+    # all nodes converged on the same blocks (txs included)
+    assert res.chain_hashes(1) == res.chain_hashes(0)
+    assert res.chain_hashes(2) == res.chain_hashes(0)
+
+
+@pytest.mark.slow
+def test_two_era_hard_fork_network(tmp_path):
+    """The flagship HFC model test (diffusion test/consensus-test
+    HardFork/Combinator.hs, A→B net): a LIVE multi-node network forges
+    and syncs ACROSS a hard fork — era A (epoch length 10) hands over to
+    era B (epoch length 20) at epoch 2, slot 20 — and still satisfies
+    common-prefix/convergence. Every node runs the composite
+    protocol/ledger with era-tagged blocks on disk."""
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=3, n_slots=40, k=30, msg_delay=0.05,
+        active_slot_coeff=Fraction(1),
+        epoch_length=10,
+        forgers=[0],
+        hard_fork_at_epoch=2,  # era boundary at slot 20
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    assert len(res.chains[0]) == cfg.n_slots  # f=1, single forger
+    # everyone crossed the era boundary and converged
+    assert res.chain_hashes(1) == res.chain_hashes(0)
+    assert res.chain_hashes(2) == res.chain_hashes(0)
+    from ouroboros_consensus_tpu.hardfork.combinator import HardForkBlock
+
+    eras = [b.era for b in res.chains[0] if isinstance(b, HardForkBlock)]
+    assert set(eras) == {0, 1}, "chain never crossed the boundary"
+    assert eras == sorted(eras)
+    # the adopted protocol state sits in era B
+    st = res.nodes[0].chain_db.current_ledger().header_state.chain_dep_state
+    assert st.era == 1
