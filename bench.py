@@ -101,6 +101,33 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     path, params, lview = build_or_load_chain()
+
+    # the TPU tunnel on this box can wedge transiently; ride out a short
+    # outage. Probing must happen in FRESH subprocesses: jax caches
+    # partially-initialized backend state, so an in-process retry after
+    # a failure can silently come back CPU-only. Only when a probe
+    # succeeds do we initialize in THIS process (its first init).
+    import subprocess
+
+    for attempt in range(5):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=300,
+            )
+            err = probe.stderr if probe.returncode else None
+            if probe.returncode == 0:
+                break
+        except subprocess.TimeoutExpired:
+            err = "probe timed out (backend init hung)"
+        print(
+            f"# backend probe failed (attempt {attempt + 1}/5): "
+            f"{str(err).strip().splitlines()[-1] if err else '?'}",
+            file=sys.stderr,
+        )
+        if attempt < 4:
+            time.sleep(60)
     platform = jax.devices()[0].platform
 
     # warmup: compile the kernel on a small prefix replay

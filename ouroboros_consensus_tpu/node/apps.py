@@ -30,6 +30,9 @@ class Apps:
 
     version: int
     tasks: list = field(default_factory=list)  # (owner, name, generator)
+    # per-protocol (req, rsp) channel pairs for callers that drive the
+    # servers directly (node-to-client sessions)
+    channels: dict = field(default_factory=dict)
 
     def protocols(self) -> set[str]:
         return {name.split(":")[0] for (_o, name, _g) in self.tasks}
@@ -123,4 +126,41 @@ def connect_peers(
     )
     for owner, name, gen in apps.tasks:
         sim.spawn(gen, f"{name}:{server_node.name}->{client_node.name}")
+    return apps
+
+
+def node_to_client_apps(node, version: int, *, msg_delay: float = 0.0) -> Apps:
+    """The local (node-to-client) bundle (Network/NodeToClient.hs):
+    LocalStateQuery + LocalTxSubmission always; LocalTxMonitor from v2.
+    The negotiated version also gates the QUERY vocabulary
+    (localstate.QUERY_MIN_VERSION)."""
+    from ..miniprotocol import localstate
+
+    enabled = handshake.NODE_TO_CLIENT_VERSIONS[version]
+    apps = Apps(version)
+
+    def chan(name):
+        return Channel(delay=msg_delay, name=name)
+
+    if "localstatequery" in enabled:
+        req, rsp = chan("lsq-req"), chan("lsq-rsp")
+        apps.tasks.append(
+            ("server", "localstatequery:server",
+             localstate.state_query_server(node, req, rsp, version=version))
+        )
+        apps.channels["localstatequery"] = (req, rsp)
+    if "localtxsubmission" in enabled:
+        req, rsp = chan("lts-req"), chan("lts-rsp")
+        apps.tasks.append(
+            ("server", "localtxsubmission:server",
+             localstate.tx_submission_server(node, req, rsp))
+        )
+        apps.channels["localtxsubmission"] = (req, rsp)
+    if "localtxmonitor" in enabled:
+        req, rsp = chan("ltm-req"), chan("ltm-rsp")
+        apps.tasks.append(
+            ("server", "localtxmonitor:server",
+             localstate.tx_monitor_server(node, req, rsp))
+        )
+        apps.channels["localtxmonitor"] = (req, rsp)
     return apps

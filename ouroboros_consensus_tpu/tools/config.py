@@ -133,3 +133,82 @@ def load_config(config_path: str):
             for c in creds
         ]
     return params, lview, pools
+
+
+# ---------------------------------------------------------------------------
+# TextEnvelope credential files (Cardano.Api shim)
+# ---------------------------------------------------------------------------
+
+# The reference's tools read node credentials from TextEnvelope JSON
+# files ({"type", "description", "cborHex"} — src/tools/Cardano/Api/,
+# KeysShelley.hs / SerialiseTextEnvelope): one file per key. The same
+# format here, with this framework's type strings.
+
+_ENVELOPE_TYPES = {
+    "cold": "ColdSigningKey_ed25519",
+    "vrf": "VrfSigningKey_ecvrf25519",
+    "kes": "KesSigningKey_compactsum",
+}
+
+
+def write_text_envelopes(dir_path: str, pool: PoolCredentials) -> dict:
+    """cold.skey / vrf.skey / kes.skey, one TextEnvelope JSON each
+    (operational certificates are issued at runtime from these keys —
+    protocol/hotkey.issue_ocert). Returns {kind: path}."""
+    from ..utils import cbor as _cbor
+
+    os.makedirs(dir_path, exist_ok=True)
+    paths = {}
+    seeds = {"cold": pool.cold_seed, "vrf": pool.vrf_seed, "kes": pool.kes_seed}
+    for kind, seed in seeds.items():
+        payload = (
+            _cbor.encode([seed, pool.kes_depth]) if kind == "kes"
+            else _cbor.encode(seed)
+        )
+        env = {
+            "type": _ENVELOPE_TYPES[kind],
+            "description": f"{kind} signing key",
+            "cborHex": payload.hex(),
+        }
+        p = os.path.join(dir_path, f"{kind}.skey")
+        with open(p, "w") as f:
+            json.dump(env, f, indent=1)
+        paths[kind] = p
+    return paths
+
+
+def read_text_envelope(path: str, expected_type: str) -> bytes:
+    """One envelope -> raw CBOR payload; type string is CHECKED (the
+    reference fails on a type mismatch, SerialiseTextEnvelope)."""
+    with open(path) as f:
+        env = json.load(f)
+    if env.get("type") != expected_type:
+        raise ValueError(
+            f"{path}: envelope type {env.get('type')!r}, "
+            f"expected {expected_type!r}"
+        )
+    return bytes.fromhex(env["cborHex"])
+
+
+def load_pool_from_envelopes(dir_path: str) -> PoolCredentials:
+    from ..utils import cbor as _cbor
+
+    cold = _cbor.decode(
+        read_text_envelope(
+            os.path.join(dir_path, "cold.skey"), _ENVELOPE_TYPES["cold"]
+        )
+    )
+    vrf = _cbor.decode(
+        read_text_envelope(
+            os.path.join(dir_path, "vrf.skey"), _ENVELOPE_TYPES["vrf"]
+        )
+    )
+    kes_seed, kes_depth = _cbor.decode(
+        read_text_envelope(
+            os.path.join(dir_path, "kes.skey"), _ENVELOPE_TYPES["kes"]
+        )
+    )
+    return PoolCredentials(
+        cold_seed=bytes(cold), vrf_seed=bytes(vrf),
+        kes_seed=bytes(kes_seed), kes_depth=kes_depth,
+    )

@@ -87,3 +87,38 @@ def test_version_gated_app_bundle(tmp_path):
     assert apps3.protocols() == {
         "chainsync", "blockfetch", "txsubmission", "keepalive", "peersharing"
     }
+
+
+def test_node_to_client_bundle(tmp_path):
+    """Network/NodeToClient.hs: v1 lacks LocalTxMonitor; v2 has it, and
+    the negotiated version gates the query vocabulary end to end."""
+    import tests.test_pipelining as tp
+    from ouroboros_consensus_tpu.node.apps import node_to_client_apps
+    from ouroboros_consensus_tpu.utils.sim import Recv, Send, Sim
+
+    node = tp._mk_node(tmp_path, "n")
+    apps1 = node_to_client_apps(node, 1)
+    assert apps1.protocols() == {"localstatequery", "localtxsubmission"}
+    apps2 = node_to_client_apps(node, 2)
+    assert apps2.protocols() == {
+        "localstatequery", "localtxsubmission", "localtxmonitor"
+    }
+
+    # a v1 session is refused the v2-gated query on the wire
+    sim = Sim()
+    for _o, name, gen in apps1.tasks:
+        sim.spawn(gen, name)
+    req, rsp = apps1.channels["localstatequery"]
+
+    def client():
+        yield Send(req, ("acquire", None))
+        assert (yield Recv(rsp))[0] == "acquired"
+        yield Send(req, ("query", "get_pool_distr", ()))
+        r = yield Recv(rsp)
+        assert r[0] == "failed" and "version 2" in r[1], r
+        yield Send(req, ("query", "get_tip_slot", ()))
+        r = yield Recv(rsp)
+        assert r[0] == "result", r
+
+    sim.spawn(client(), "client")
+    sim.run(until=5.0)

@@ -207,3 +207,23 @@ def test_store_ledger_state_at_and_repro_mempool(tmp_path):
     assert len(rows) == 6
     assert all(r["accepted"] == 1 and r["rejected"] == 0 for r in rows)
     assert all(r["dur_snap_us"] >= 0 for r in rows)
+
+
+def test_text_envelope_credentials(tmp_path, pools):
+    """Cardano.Api shim: TextEnvelope key files ({type, description,
+    cborHex}) roundtrip a pool's signing identity; a wrong type string
+    is refused."""
+    import json as _json
+
+    from ouroboros_consensus_tpu.tools import config as node_config
+
+    d = str(tmp_path / "creds")
+    paths = node_config.write_text_envelopes(d, pools[0])
+    assert set(paths) == {"cold", "vrf", "kes"}
+    env = _json.load(open(paths["cold"]))
+    assert set(env) == {"type", "description", "cborHex"}
+    again = node_config.load_pool_from_envelopes(d)
+    assert again == pools[0]
+    assert again.kes_vk == pools[0].kes_vk
+    with pytest.raises(ValueError):
+        node_config.read_text_envelope(paths["cold"], "KesSigningKey_compactsum")
