@@ -523,3 +523,120 @@ class ChainDBMachine(RuleBasedStateMachine):
 
 TestChainDBModel = ChainDBMachine.TestCase
 TestChainDBModel.settings = MACHINE_SETTINGS
+
+
+# ---------------------------------------------------------------------------
+# LedgerDB snapshots vs a model (LedgerDB/OnDisk.hs, 1,197 LoC)
+# ---------------------------------------------------------------------------
+
+
+class LedgerDBMachine(RuleBasedStateMachine):
+    """Push/prune/rollback in memory; snapshot/corrupt/restore on the
+    mock FS — the q-s-m OnDisk suite's command set. The model is the
+    plain list of (point, state) the AnchoredSeq must equal, plus the
+    slot of the newest UNCORRUPTED snapshot for restore checks."""
+
+    SNAP_DIR = "ldb-snaps"
+    K = 3
+
+    @initialize()
+    def setup(self):
+        from ouroboros_consensus_tpu.storage.ledgerdb import LedgerDB
+
+        self.fs = MockFS()
+        self.ext = _mk_ext()
+        self.genesis = _genesis(self.ext)
+        self.db = LedgerDB(self.ext, self.K, self.genesis, fs=self.fs)
+        self.blocks = tree()[0]  # the 10-block main chain
+        self.n_pushed = 0
+        # model: full chain of states from genesis (anchor window = last K+1)
+        self.model_states = [self.genesis]
+        self.good_snapshots: set[int] = set()
+
+    def _window(self):
+        return self.model_states[-(self.K + 1):]
+
+    @rule()
+    def push(self):
+        if self.n_pushed >= len(self.blocks):
+            return
+        b = self.blocks[self.n_pushed]
+        st = self.db.push(b)
+        self.model_states.append(st)
+        self.n_pushed += 1
+
+    @rule(data=st.data())
+    def rollback(self, data):
+        n = data.draw(st.integers(0, self.K + 1))
+        before = self.db.volatile_length()
+        ok = self.db.rollback(n)
+        assert ok == (n <= before)  # beyond-k rollbacks must refuse
+        if ok and n:
+            del self.model_states[-n:]
+            self.n_pushed -= n
+
+    @rule()
+    def snapshot(self):
+        name = self.db.take_snapshot(self.SNAP_DIR, keep=2)
+        anchor = self._window()[0]
+        tip = anchor.header_state.tip
+        slot = 0 if tip is None else tip.slot
+        if name is not None:
+            assert name == f"snapshot-{slot}"
+        self.good_snapshots.add(slot)
+        # keep-2 pruning (DiskPolicy.hs:87)
+        from ouroboros_consensus_tpu.storage.ledgerdb import LedgerDB
+
+        on_disk = LedgerDB.list_snapshots(self.SNAP_DIR, fs=self.fs)
+        assert len(on_disk) <= 2
+        self.good_snapshots &= set(on_disk)
+
+    @rule(data=st.data())
+    def corrupt_snapshot(self, data):
+        from ouroboros_consensus_tpu.storage.ledgerdb import LedgerDB
+
+        snaps = LedgerDB.list_snapshots(self.SNAP_DIR, fs=self.fs)
+        if not snaps:
+            return
+        slot = data.draw(st.sampled_from(snaps))
+        path = f"{self.SNAP_DIR}/snapshot-{slot}"
+        self.fs.corrupt_byte(path, data.draw(
+            st.integers(0, self.fs.getsize(path) - 1)
+        ))
+        self.good_snapshots.discard(slot)
+
+    @rule()
+    def restore(self):
+        """init_from_snapshots: newest USABLE snapshot (corrupt ones
+        skipped and deleted), replayed to the immutable tip — here there
+        is no ImmutableDB, so restore lands exactly on the snapshot."""
+        from ouroboros_consensus_tpu.storage.ledgerdb import LedgerDB
+
+        class _EmptyImm:
+            def stream_from(self, *_a):
+                return iter(())
+
+            def stream_all(self):
+                return iter(())
+
+        db2 = LedgerDB.init_from_snapshots(
+            self.ext, self.K, self.SNAP_DIR, self.genesis, _EmptyImm(),
+            fs=self.fs,
+        )
+        tip = db2.current().header_state.tip
+        got = 0 if tip is None else tip.slot
+        expect = max(self.good_snapshots) if self.good_snapshots else 0
+        assert got == expect, (got, expect)
+
+    @invariant()
+    def window_matches(self):
+        if not hasattr(self, "db"):
+            return
+        win = self._window()
+        assert self.db.volatile_length() == len(win) - 1
+        assert self.db.current() == win[-1]
+        assert self.db.anchor() == win[0]
+
+
+TestLedgerDBModel = LedgerDBMachine.TestCase
+TestLedgerDBModel.settings = MACHINE_SETTINGS
