@@ -217,11 +217,30 @@ class ChainDB:
 
     def _init_chain_selection(self) -> None:
         """Find the best chain through the volatile graph extending the
-        immutable tip; validates via LedgerDB."""
+        immutable tip; validates via LedgerDB. The SAME validate-best /
+        truncate-rejected loop as chainSelectionForBlock: a candidate
+        that truncates to a valid prefix must not end selection — the
+        next-best candidate may beat that prefix (initialChainSelection,
+        ChainSel.hs:96)."""
         self.current_chain = []
-        best = self._best_candidate_from(self._anchor_point(), [])
-        if best:
-            self._try_adopt(0, best)
+        anchor = self._anchor_point()
+        rejected: list[list[bytes]] = []
+        while True:
+            cand = self._best_candidate_from(anchor, rejected)
+            if cand is None:
+                return
+            cur_view = self._current_select_view()
+            cand_view = self.ext.protocol.select_view(cand[-1].header)
+            if (
+                cur_view is not None
+                and self.ext.protocol.compare_candidates(cur_view, cand_view) <= 0
+            ):
+                return
+            n_rollback, suffix = self._diff_against_current(cand)
+            outcome = self._try_adopt(n_rollback, suffix, full_candidate=cand)
+            if outcome == "adopted":
+                return
+            rejected.append([b.hash_ for b in cand])
 
     def _anchor_point(self) -> Point | None:
         return self.immutable.tip_point()
@@ -482,15 +501,20 @@ class ChainDB:
 
     def _chain_selection_for_block(self, block: Block) -> bool:
         """chainSelectionForBlock: consider candidates containing `block`;
-        loop validate-best / truncate-rejected (chainSelection :874)."""
+        loop validate-best / truncate-rejected (chainSelection :874).
+        Adopting a TRUNCATED prefix of a candidate continues the loop —
+        the remaining candidates are compared against the new (prefix)
+        chain, so a longer fully-valid fork is never shadowed by a
+        better-ranked candidate that failed validation."""
         proto = self.ext.protocol
         anchor = self._anchor_point()
         rejected: list[list[bytes]] = []
+        changed = False
         while True:
             cur_view = self._current_select_view()
             cand = self._best_candidate_from(anchor, rejected, via=block.hash_)
             if cand is None:
-                return False
+                return changed
             if self.check_in_future is not None:
                 kept, dropped = self.check_in_future.truncate(cand)
                 if dropped:
@@ -514,11 +538,13 @@ class ChainDB:
             cand_view = proto.select_view(cand[-1].header)
             # preferCandidate: only strictly better chains are adopted
             if proto.compare_candidates(cur_view, cand_view) <= 0:
-                return False
+                return changed
             n_rollback, suffix = self._diff_against_current(cand)
-            ok = self._try_adopt(n_rollback, suffix, full_candidate=cand)
-            if ok:
+            outcome = self._try_adopt(n_rollback, suffix, full_candidate=cand)
+            if outcome == "adopted":
                 return True
+            if outcome == "prefix":
+                changed = True
             rejected.append([b.hash_ for b in cand])
 
     def _diff_against_current(self, cand: list[Block]):
@@ -535,13 +561,17 @@ class ChainDB:
 
     def _try_adopt(
         self, n_rollback: int, suffix: list[Block], full_candidate: list[Block] | None = None
-    ) -> bool:
+    ) -> str:
         """ledgerValidateCandidate (:1053): LedgerDB switch validates the
         suffix (batched header crypto). On invalid blocks, mark + truncate
         and adopt the valid prefix if it still beats the current chain
-        (the truncate-rejected loop)."""
+        (the truncate-rejected loop).
+
+        Returns "adopted" (full candidate installed), "prefix" (an
+        invalid block truncated it; the VALID PREFIX was installed), or
+        "failed" (nothing changed)."""
         if not suffix and n_rollback == 0:
-            return False
+            return "failed"
         n_before = self.ledgerdb.volatile_length()
         state_before = self.ledgerdb.current()
         try:
@@ -549,7 +579,7 @@ class ChainDB:
                 # rollback deeper than the LedgerDB holds (> k): the
                 # candidate forks before our immutability window — reject
                 self.trace(f"rollback {n_rollback} beyond LedgerDB window")
-                return False
+                return "failed"
         except InvalidBlock as e:
             self.invalid[e.point.hash_] = e.reason
             self.trace(f"invalid block at {e.point}: {type(e.reason).__name__}")
@@ -566,7 +596,7 @@ class ChainDB:
                 pref_view = proto.select_view(prefix[-1].header)
                 if proto.compare_candidates(cur_view, pref_view) > 0:
                     self._install(n_rollback, prefix)
-                    return True
+                    return "prefix"
             # restore: rollback the states LedgerDB pushed for the prefix
             pushed = self.ledgerdb.volatile_length() - (n_before - n_rollback)
             if pushed > 0:
@@ -575,7 +605,7 @@ class ChainDB:
             if n_rollback > 0:
                 restore = self.current_chain[len(self.current_chain) - n_rollback :]
                 self.ledgerdb.push_many(restore, apply=False)
-            return False
+            return "failed"
         self._install(n_rollback, suffix)
         # InspectLedger (Ledger/Inspect.hs): trace ledger events of the
         # adoption — era transitions, protocol-update warnings
@@ -587,7 +617,7 @@ class ChainDB:
             self.ledgerdb.current().ledger_state,
         ):
             self.trace(f"ledger event: {ev}")
-        return True
+        return "adopted"
 
     def _install(self, n_rollback: int, suffix: list[Block]) -> None:
         """switchTo (ChainSel.hs:703): swap the fragment, notify

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 import re
+import zlib
 from dataclasses import dataclass
 from typing import Callable, Sequence
 
@@ -33,6 +34,31 @@ from . import serialize
 class InvalidBlock(Exception):
     point: Point
     reason: Exception
+
+
+def encode_snapshot(state: ExtLedgerState) -> bytes:
+    """Snapshot file = u32 CRC32 (LE) ‖ CBOR ExtLedgerState. The CRC
+    makes ANY on-disk corruption detectable at init — a silently
+    bit-flipped nonce would otherwise replay into a divergent chain
+    (the reference pairs snapshots with checksum files for the same
+    reason)."""
+    payload = serialize.encode_ext_state(state)
+    return zlib.crc32(payload).to_bytes(4, "little") + payload
+
+
+def decode_snapshot(data: bytes) -> ExtLedgerState:
+    if len(data) < 4:
+        raise ValueError("snapshot too short")
+    crc, payload = int.from_bytes(data[:4], "little"), data[4:]
+    if zlib.crc32(payload) == crc:
+        return serialize.decode_ext_state(payload)
+    # migration: snapshots written before the CRC framing are raw CBOR —
+    # accept them iff the WHOLE byte string decodes (a corrupted CRC
+    # snapshot cannot: its leading 4 CRC bytes are not valid CBOR here)
+    try:
+        return serialize.decode_ext_state(data)
+    except Exception:
+        raise ValueError("snapshot checksum mismatch") from None
 
 
 class LedgerDB:
@@ -223,7 +249,7 @@ class LedgerDB:
         path = os.path.join(snap_dir, name)
         if self.fs.exists(path):
             return None
-        self.fs.write_atomic(path, serialize.encode_ext_state(anchor))
+        self.fs.write_atomic(path, encode_snapshot(anchor))
         snaps = sorted(self.list_snapshots(snap_dir, fs=self.fs))
         for s in snaps[:-keep]:
             self.fs.remove(os.path.join(snap_dir, f"snapshot-{s}"))
@@ -264,7 +290,7 @@ class LedgerDB:
         for slot in sorted(cls.list_snapshots(snap_dir, fs=fs), reverse=True):
             path = os.path.join(snap_dir, f"snapshot-{slot}")
             try:
-                state = serialize.decode_ext_state(fs.read_bytes(path))
+                state = decode_snapshot(fs.read_bytes(path))
             except Exception:
                 trace(f"snapshot-{slot} unreadable; falling back")
                 fs.remove(path)

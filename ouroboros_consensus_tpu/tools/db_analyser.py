@@ -329,7 +329,7 @@ def store_ledger_state_at(
     db-analyser/node run can start from it instead of genesis."""
     from ..ledger.extended import ExtLedgerState
     from ..ledger.header_validation import AnnTip, HeaderState
-    from ..storage import serialize
+    from ..storage.ledgerdb import encode_snapshot
     from ..utils.fs import REAL_FS
 
     imm = open_immutable(db_path)
@@ -352,9 +352,7 @@ def store_ledger_state_at(
 
     REAL_FS.makedirs(snap_dir)
     name = f"snapshot-{tip.slot}"
-    REAL_FS.write_atomic(
-        _os.path.join(snap_dir, name), serialize.encode_ext_state(ext)
-    )
+    REAL_FS.write_atomic(_os.path.join(snap_dir, name), encode_snapshot(ext))
     return name
 
 

@@ -498,6 +498,44 @@ class ChainDBMachine(RuleBasedStateMachine):
         self.model.add(b)
         self._assert_same_chain()
 
+    @rule(data=st.data())
+    def add_invalid_block(self, data):
+        """A block with a corrupted KES signature extending any tree
+        block: chain selection must reject it, mark it invalid, and
+        NEVER adopt a chain through it (the model ignores it)."""
+        from ouroboros_consensus_tpu.block.praos_block import Block, Header
+
+        parent = data.draw(st.sampled_from(self.pool))
+        good = _forge(parent.slot + 1, parent.block_no + 1, parent.hash_)
+        bad_sig = bytes([good.header.kes_sig[0] ^ 0xFF]) + good.header.kes_sig[1:]
+        bad = Block(Header(good.header.body, bad_sig), good.txs)
+        self.db.add_block(bad)
+        # model unchanged — and the impl must agree
+        self._assert_same_chain()
+        anchor = self.model.immutable[-1] if self.model.immutable else None
+        if anchor is None or bad.slot > anchor.slot:
+            # stored + validated => marked invalid (olderThanK blocks
+            # are dropped before validation and stay unmarked)
+            assert (
+                self.db.get_is_invalid_block(bad.hash_) is not None
+                or bad.hash_ not in self.db.volatile.all_hashes()
+                or not self._connected(bad)
+            )
+
+    def _connected(self, blk):
+        """Is blk's parent reachable (disconnected blocks sit unvalidated
+        in the volatile store until their parent arrives)?"""
+        h = blk.prev_hash
+        anchor = self.model.immutable[-1].hash_ if self.model.immutable else None
+        while h is not None:
+            if h == anchor:
+                return True
+            info = self.db.volatile.get_block_info(h)
+            if info is None:
+                return False
+            h = info.prev_hash
+        return anchor is None
+
     @rule(validate_all=st.booleans())
     def reopen(self, validate_all):
         """Close (snapshot) and reopen: selection must be rebuilt
@@ -549,12 +587,15 @@ class LedgerDBMachine(RuleBasedStateMachine):
         self.db = LedgerDB(self.ext, self.K, self.genesis, fs=self.fs)
         self.blocks = tree()[0]  # the 10-block main chain
         self.n_pushed = 0
-        # model: full chain of states from genesis (anchor window = last K+1)
+        # model: full chain of states from genesis; the anchor index only
+        # moves FORWARD (pruning discards history — rollback cannot pass
+        # it, exactly the k-rollback bound)
         self.model_states = [self.genesis]
+        self.anchor_idx = 0
         self.good_snapshots: set[int] = set()
 
     def _window(self):
-        return self.model_states[-(self.K + 1):]
+        return self.model_states[self.anchor_idx:]
 
     @rule()
     def push(self):
@@ -563,14 +604,15 @@ class LedgerDBMachine(RuleBasedStateMachine):
         b = self.blocks[self.n_pushed]
         st = self.db.push(b)
         self.model_states.append(st)
+        self.anchor_idx = max(self.anchor_idx, len(self.model_states) - 1 - self.K)
         self.n_pushed += 1
 
     @rule(data=st.data())
     def rollback(self, data):
         n = data.draw(st.integers(0, self.K + 1))
-        before = self.db.volatile_length()
         ok = self.db.rollback(n)
-        assert ok == (n <= before)  # beyond-k rollbacks must refuse
+        # rollback must refuse past the ANCHOR (pruned history is gone)
+        assert ok == (n <= len(self._window()) - 1)
         if ok and n:
             del self.model_states[-n:]
             self.n_pushed -= n
@@ -583,7 +625,10 @@ class LedgerDBMachine(RuleBasedStateMachine):
         slot = 0 if tip is None else tip.slot
         if name is not None:
             assert name == f"snapshot-{slot}"
-        self.good_snapshots.add(slot)
+            # only a WRITE makes the snapshot good — take_snapshot
+            # returning None means the (possibly corrupted) file on
+            # disk was left untouched
+            self.good_snapshots.add(slot)
         # keep-2 pruning (DiskPolicy.hs:87)
         from ouroboros_consensus_tpu.storage.ledgerdb import LedgerDB
 

@@ -377,3 +377,45 @@ def test_chaindb_ranged_stream_gc_safe(tmp_path):
 
     with _pytest.raises(MissingBlockError):
         db.stream(Point(999, b"x" * 32), None)
+
+
+def test_init_chain_selection_not_shadowed_by_invalid_candidate(tmp_path):
+    """Regression (found by TestChainDBModel): when the best-RANKED
+    candidate contains an invalid block, selection must fall through to
+    the next-best fully-valid candidate instead of settling on the
+    truncated prefix — both at reopen (initialChainSelection) and in
+    chainSelectionForBlock's loop."""
+    from ouroboros_consensus_tpu.block.praos_block import Block as PB
+    from ouroboros_consensus_tpu.block.praos_block import Header as PH
+
+    db, ext = open_db(tmp_path)
+    main = forge_chain(2)
+    db.add_block(main[0])
+    db.add_block(main[1])
+    # a corrupted-signature SIBLING of main[1] whose tip deterministically
+    # OUTRANKS it (same length -> VRF tie-break; grind slots until the
+    # tie-break favors the bad block), so selection tries it first and
+    # truncates to [main0]
+    proto = ext.protocol
+    bad = None
+    for slot in range(3, 40, 2):
+        cand = forge_chain(1, start_slot=slot, start_bno=1,
+                           prev=main[0].hash_, pool_ix=1)[0]
+        if proto.compare_candidates(
+            proto.select_view(main[1].header), proto.select_view(cand.header)
+        ) > 0:
+            bad = PB(
+                PH(cand.header.body,
+                   bytes([cand.header.kes_sig[0] ^ 0xFF]) + cand.header.kes_sig[1:]),
+                cand.txs,
+            )
+            break
+    assert bad is not None, "no outranking slot found"
+    db.add_block(bad)
+    assert db.tip_point().hash_ == main[1].hash_, "valid chain shadowed"
+
+    # reopen (in-memory invalid set wiped): initial selection must again
+    # end on the fully-valid chain, not the bad candidate's prefix
+    db.close()
+    db2, _ = open_db(tmp_path)
+    assert db2.tip_point().hash_ == main[1].hash_

@@ -193,11 +193,9 @@ def test_store_ledger_state_at_and_repro_mempool(tmp_path):
         genesis_state=genesis, snap_dir=snap_dir,
     )
     assert name == "snapshot-4"
-    from ouroboros_consensus_tpu.storage import serialize
+    from ouroboros_consensus_tpu.storage.ledgerdb import decode_snapshot
 
-    ext = serialize.decode_ext_state(
-        open(f"{snap_dir}/{name}", "rb").read()
-    )
+    ext = decode_snapshot(open(f"{snap_dir}/{name}", "rb").read())
     assert ext.header_state.tip.slot == 4
     # 4 genesis outputs spent by slots 1..4
     assert (bytes(32), 0) not in ext.ledger_state.utxo
