@@ -230,6 +230,27 @@ class ChainDB:
             if cand is None:
                 return
             cur_view = self._current_select_view()
+            if self.check_in_future is not None:
+                kept, dropped = self.check_in_future.truncate(cand)
+                if dropped:
+                    self.trace(
+                        f"init: {len(dropped)} in-future block(s) cut "
+                        f"from candidate"
+                    )
+                    kept_view = (
+                        self.ext.protocol.select_view(kept[-1].header)
+                        if kept else None
+                    )
+                    if not kept or (
+                        cur_view is not None
+                        and self.ext.protocol.compare_candidates(
+                            cur_view, kept_view
+                        ) <= 0
+                    ):
+                        rejected.append([b.hash_ for b in cand])
+                        continue
+                    rejected.append([b.hash_ for b in cand])
+                    cand = kept
             cand_view = self.ext.protocol.select_view(cand[-1].header)
             if (
                 cur_view is not None

@@ -285,6 +285,49 @@ def count_blocks(db_path: str) -> int:
     return imm.n_blocks()
 
 
+def check_state_growth_every(
+    db_path: str,
+    params: PraosParams,
+    lview: LedgerView,
+    ledger,
+    genesis_state,
+    every: int = 100,
+) -> list[dict]:
+    """CheckNoThunksEvery analog (Analysis.hs:84,396-412): the reference
+    walks the ledger state every N blocks looking for space leaks
+    (unforced thunks). Python has no thunks; the equivalent failure mode
+    is UNBOUNDED STATE GROWTH — structures that should be pruned (ocert
+    counters per retired pool, protocol nonce history, UTxO bookkeeping)
+    accreting per block. Samples state sizes every `every` blocks so a
+    leak shows as a monotone slope instead of an OOM at block 10M."""
+    import sys as _sys
+
+    imm = open_immutable(db_path)
+    st = PraosState()
+    lst = genesis_state
+    samples: list[dict] = []
+    for i, (entry, raw) in enumerate(imm.stream_all()):
+        block = Block.from_bytes(raw)
+        h = block.header
+        ticked = praos.tick(params, lview, h.slot, st)
+        st = praos.reupdate(params, h.to_view(), h.slot, ticked)
+        if ledger is not None:
+            lst = ledger.tick_then_reapply(lst, block)
+        if i % every == 0:
+            samples.append(
+                {
+                    "block": i,
+                    "slot": h.slot,
+                    "ocert_counters": len(st.ocert_counters),
+                    "utxo_entries": (
+                        len(lst.utxo) if hasattr(lst, "utxo") else None
+                    ),
+                    "chain_dep_bytes": _sys.getsizeof(st.ocert_counters),
+                }
+            )
+    return samples
+
+
 def show_block_stats(db_path: str) -> dict:
     """GetBlockApplicationMetrics / block-size counts analog
     (Analysis.hs:75-88 counts/sizes family): min/max/total sizes + slot

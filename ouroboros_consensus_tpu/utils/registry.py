@@ -146,3 +146,19 @@ class RAWLock:
         assert self._writer
         self._writer = False
         self._wake()
+
+
+def watcher(read, on_change, event: Event, initial=None):
+    """Watcher (Util/STM.hs:112): a sim task that re-reads `read()`
+    whenever `event` fires and calls `on_change(new)` on every CHANGE of
+    the observed value — the forkLinkedWatcher shape driving the forging
+    loop (slot changes) and fetch decisions (candidate changes) in the
+    reference. Run it under a ResourceRegistry so it dies with its
+    owner."""
+    last = initial
+    while True:
+        cur = read()
+        if cur != last:
+            last = cur
+            on_change(cur)
+        yield Wait(event)

@@ -88,11 +88,22 @@ def test_chaindb_rejects_future_blocks(tmp_path):
         db.add_block(b)
     # wallclock 3.0 + skew 0.5: slots 4,5 are in the future
     assert db.tip_point().slot == 3
+    # REOPEN at the same wallclock: initial chain selection must apply
+    # the same in-future truncation (the stored future blocks sit in
+    # the VolatileDB but may not be selected)
+    db.close()
+    db2 = open_chaindb(
+        str(tmp_path / "db"), ext, st, PARAMS.security_param,
+        check_in_future=CheckInFuture(
+            now=clock, slot_length=1.0, max_clock_skew=0.5
+        ),
+    )
+    assert db2.tip_point().slot == 3
     # time passes; the blocks are still in the VolatileDB, so the next
     # add (or a re-add) reruns selection and picks up the suffix
     clock.t = 10.0
-    db.add_block(blocks[-1])
-    assert db.tip_point().slot == 5
+    db2.add_block(blocks[-1])
+    assert db2.tip_point().slot == 5
 
 
 def test_mempool_bench_smoke():

@@ -157,3 +157,77 @@ def test_mod_chain_sel_overrides_order(tmp_path):
     # (Praos would keep `late` — same length means no switch)
     db.add_block(early)
     assert db.tip_point().hash_ == early.hash_
+
+
+def test_watcher_fires_on_changes_only():
+    """Util/STM.hs Watcher: one callback per VALUE CHANGE, none for
+    wakeups that observe the same value."""
+    from ouroboros_consensus_tpu.utils.registry import watcher
+    from ouroboros_consensus_tpu.utils.sim import Event, Fire, Sleep
+
+    sim = Sim()
+    ev = Event("watched")
+    box = {"v": 0}
+    seen = []
+
+    def mutator():
+        for v in (1, 1, 2, 2, 3):  # repeated writes of the same value
+            box["v"] = v
+            yield Fire(ev)
+            yield Sleep(0.1)
+
+    reg = ResourceRegistry(sim)
+    reg.fork_linked(
+        watcher(lambda: box["v"], seen.append, ev, initial=0), "watch"
+    )
+    sim.spawn(mutator(), "mutator")
+    sim.run(until=5.0)
+    assert seen == [1, 2, 3]
+    reg.close()
+
+
+def test_follower_promptness():
+    """FollowerPromptness (storage-test): in decoupled mode a follower's
+    event fires within the SAME virtual instant as adoption — servers
+    never sit on stale chains (no polling interval in the path)."""
+    import tests.test_pipelining as tp
+    from ouroboros_consensus_tpu.utils.sim import Sim, Wait
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        from pathlib import Path
+
+        node = tp._mk_node(Path(d), "n")
+        db = node.chain_db
+        sim = Sim()
+        runners = db.start_decoupled(sim)
+        for i, r in enumerate(runners):
+            sim.spawn(r, f"runner{i}")
+        f = db.new_follower()
+        blocks = tp._forge_chain(3)
+        seen = []
+
+        def consumer():
+            while len(seen) < 3:
+                ups = f.take_updates()
+                for u in ups:
+                    if u[0] == "addblock":
+                        seen.append((sim.now, u[1].hash_))
+                if len(seen) < 3:
+                    yield Wait(f.event)
+
+        def producer():
+            from ouroboros_consensus_tpu.utils.sim import Sleep
+
+            for b in blocks:
+                db.add_block_async(b)
+                yield Sleep(1.0)
+
+        sim.spawn(consumer(), "consumer")
+        sim.spawn(producer(), "producer")
+        sim.run(until=10.0)
+        assert [h for _t, h in seen] == [b.hash_ for b in blocks]
+        # promptness: delivered at the adoption instant (t=0,1,2), not
+        # on some later polling tick
+        assert [t for t, _h in seen] == [0.0, 1.0, 2.0]

@@ -225,3 +225,19 @@ def test_text_envelope_credentials(tmp_path, pools):
     assert again.kes_vk == pools[0].kes_vk
     with pytest.raises(ValueError):
         node_config.read_text_envelope(paths["cold"], "KesSigningKey_compactsum")
+
+
+def test_check_state_growth(synth_db, lview):
+    """CheckNoThunksEvery analog: sampled state sizes over a replay —
+    the ocert-counter map must stay bounded by the pool count (a
+    per-block accretion would show as a slope)."""
+    path, res = synth_db
+    samples = db_analyser.check_state_growth_every(
+        path, PARAMS, lview, None, None, every=10
+    )
+    assert len(samples) >= 3
+    # bounded by the pool count — and STABLE once both pools have
+    # forged: no per-block accretion slope in the second half
+    assert all(s["ocert_counters"] <= 2 for s in samples)
+    second_half = [s["ocert_counters"] for s in samples[len(samples) // 2:]]
+    assert len(set(second_half)) == 1, second_half
