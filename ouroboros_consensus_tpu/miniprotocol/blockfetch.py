@@ -23,6 +23,17 @@ from ..block.praos_block import Block
 from ..utils.sim import Recv, Send, Sleep, Wait
 
 
+class InvalidBlockFromPeer(Exception):
+    """The peer served a block chain selection marked invalid: punished
+    by disconnection (InvalidBlockPunishment.hs; RethrowPolicy maps this
+    to 'disconnect', not node shutdown)."""
+
+    def __init__(self, peer: str, point):
+        super().__init__(f"peer {peer}: invalid block at {point}")
+        self.peer = peer
+        self.point = point
+
+
 def _in_immutable(chain_db, point: Point) -> bool:
     imm = getattr(chain_db, "immutable", None)
     if imm is None or point is None:
@@ -162,6 +173,12 @@ def client(node, peer_name: str, rx, tx, candidate, *, poll_interval: float = 0.
             p = node.chain_db.add_block_async(block)
             if p.result is None:
                 yield Wait(p.processed)
+            if node.chain_db.get_is_invalid_block(block.hash_) is not None:
+                # InvalidBlockPunishment (ChainSel.hs:1084-1099 +
+                # InvalidBlockPunishment.hs): the peer served a block
+                # that failed validation — disconnect it (the task ends;
+                # the rethrow policy's 'disconnect peer' class)
+                raise InvalidBlockFromPeer(peer_name, block.point)
             if p.result.selected:
                 node.on_chain_changed()
                 # adoption settles candidate prefixes: the ChainSync

@@ -127,20 +127,33 @@ def server(
     `include_tentative` serves diffusion pipelining: headers of blocks
     still being validated stream out early (Impl/Follower.hs tentative
     followers), retracted by a rollback if validation rejects them."""
+    created_follower = follower is None
     if follower is None:
         follower = chain_db.new_follower(include_tentative=include_tentative)
     decode = getattr(chain_db, "decode_block", Block.from_bytes)
     # pending instructions not yet sent (beyond the intersection)
     pending: list = []
+
+    def tip():
+        return chain_db.tip_point()
+
+    try:
+        yield from _server_loop(
+            chain_db, rx, tx, follower, pending, tip, decode,
+            poll_interval,
+        )
+    finally:
+        # a killed/disconnected server must not leak its follower
+        if created_follower:
+            follower.close()
+
+
+def _server_loop(chain_db, rx, tx, follower, pending, tip, decode, poll_interval):
     # lazy stream of the immutable segment between the intersection and
     # the volatile fragment (never materialized: the immutable part can
     # be the whole database)
     imm_stream = None
     intersect_done = False
-
-    def tip():
-        return chain_db.tip_point()
-
     while True:
         msg = yield Recv(rx)
         kind = msg[0]

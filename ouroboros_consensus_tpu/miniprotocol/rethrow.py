@@ -1,0 +1,30 @@
+"""Per-peer rethrow policy: which exceptions mean DISCONNECT.
+
+Reference: `Node/RethrowPolicy.hs` consensusRethrowPolicy — each
+exception type is classified as peer-disconnect or node-shutdown. Here
+the classification lives next to the protocols that raise, and
+`peer_guard` is the reusable task wrapper every spawn site (ThreadNet
+edges, node/apps bundles) applies: a peer violation ends the WHOLE
+connection via `on_disconnect`, anything else still aborts the run
+(node-level failure)."""
+
+from __future__ import annotations
+
+from .blockfetch import InvalidBlockFromPeer
+from .chainsync import ChainSyncClientException
+
+# exceptions that condemn the PEER, not the node (ouroboros-consensus
+# maps these to ShutdownPeer in consensusRethrowPolicy)
+PEER_DISCONNECT_EXCEPTIONS = (ChainSyncClientException, InvalidBlockFromPeer)
+
+
+def peer_guard(gen, name: str, trace, on_disconnect=None):
+    """Run `gen`; a peer violation traces + invokes `on_disconnect()`
+    (tear down the connection's other protocol tasks) and ends this
+    task. Other exceptions propagate — the node-shutdown class."""
+    try:
+        yield from gen
+    except PEER_DISCONNECT_EXCEPTIONS as e:
+        trace(f"{name}: disconnected peer: {e}")
+        if on_disconnect is not None:
+            on_disconnect()

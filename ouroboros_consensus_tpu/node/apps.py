@@ -124,8 +124,28 @@ def connect_peers(
     apps = node_to_node_apps(
         server_node, client_node, version, msg_delay=msg_delay
     )
+    from ..miniprotocol.rethrow import peer_guard
+
+    spawned: list = []
+
+    def disconnect():
+        # a peer violation tears down the whole connection bundle
+        # (RethrowPolicy 'disconnect peer', not node shutdown)
+        for t in spawned:
+            t.alive = False
+            try:
+                t.gen.close()
+            except Exception:
+                pass
+        client_node.candidates.pop(server_node.name, None)
+
     for owner, name, gen in apps.tasks:
-        sim.spawn(gen, f"{name}:{server_node.name}->{client_node.name}")
+        label = f"{name}:{server_node.name}->{client_node.name}"
+        spawned.append(
+            sim.spawn(
+                peer_guard(gen, label, client_node.trace, disconnect), label
+            )
+        )
     return apps
 
 

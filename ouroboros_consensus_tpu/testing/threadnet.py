@@ -32,6 +32,7 @@ from fractions import Fraction
 from ..ledger.extended import ExtLedger
 from ..ledger.mock import MockConfig, MockLedger
 from ..miniprotocol import blockfetch, chainsync, txsubmission
+from ..miniprotocol.rethrow import peer_guard
 from ..miniprotocol.chainsync import Candidate
 from ..node.kernel import NodeKernel, SlotClock
 from ..protocol import praos
@@ -97,6 +98,9 @@ def _delayed(dt: float, gen):
     if dt > 0:
         yield Sleep(dt)
     yield from gen
+
+
+
 
 
 class _Net:
@@ -354,8 +358,30 @@ class _Net:
                 (j, txsubmission.inbound(client_node, f"node{i}", ts_rsp, ts_req),
                  f"ts-inbound-{i}->{j}")
             )
+        # one peer violation tears down the WHOLE edge (all of its
+        # protocol tasks + the candidate + the server-side follower) —
+        # the connection-level disconnect of RethrowPolicy
+        edge_tasks: list = []
+
+        def disconnect_edge():
+            for t in edge_tasks:
+                t.alive = False
+                try:
+                    t.gen.close()
+                except Exception:
+                    pass
+            cs_follower.close()
+            client_node.candidates.pop(f"node{i}", None)
+
         for owner, gen, name in pairs:
-            task = self.sim.spawn(_delayed(dt, gen), name)
+            task = self.sim.spawn(
+                _delayed(
+                    dt,
+                    peer_guard(gen, name, client_node.trace, disconnect_edge),
+                ),
+                name,
+            )
+            edge_tasks.append(task)
             # edge tasks die with EITHER endpoint's restart
             self.node_tasks.setdefault(i, []).append(task)
             self.node_tasks.setdefault(j, []).append(task)

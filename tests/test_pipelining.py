@@ -212,3 +212,75 @@ def test_tentative_header_retracted_when_not_adopted(tmp_path):
     ups = f_tent.take_updates()
     assert ("rollback", blocks[0].point) in ups, ups
     assert db.tip_point().hash_ == blocks[0].hash_
+
+
+def test_invalid_block_punishes_peer(tmp_path):
+    """InvalidBlockPunishment (ChainSel.hs:1084-1099): a peer whose
+    served BODY fails validation is disconnected (the fetch task ends),
+    while the node keeps its valid chain and marks the block invalid."""
+    from ouroboros_consensus_tpu.block.praos_block import Block as PB
+    from ouroboros_consensus_tpu.block.praos_block import Header as PH
+    from ouroboros_consensus_tpu.miniprotocol import blockfetch
+    from ouroboros_consensus_tpu.utils.sim import Recv, Send
+
+    node = _mk_node(tmp_path, "victim")
+    good = _forge_chain(2)
+    node.chain_db.add_block(good[0])
+
+    corrupt = PB(
+        PH(good[1].header.body,
+           bytes([good[1].header.kes_sig[0] ^ 0xFF]) + good[1].header.kes_sig[1:]),
+        good[1].txs,
+    )
+    cand = Candidate()
+    # the candidate claims the (honest-looking) header; the peer serves
+    # a corrupted body for it
+    base = node.chain_dep_state_at(node.chain_db.tip_point())
+    cand.reset(base)
+    cand.headers = [good[1].header]
+    cand.states = [base, base]
+
+    sim = Sim()
+    node.chain_db.runtime = sim
+    req, rsp = Channel(), Channel()
+
+    def evil_server():
+        while True:
+            msg = yield Recv(req)
+            if msg[0] != "request_range":
+                return
+            yield Send(rsp, ("start_batch",))
+            yield Send(rsp, ("block", corrupt.bytes_))
+            yield Send(rsp, ("batch_done",))
+
+    sim.spawn(evil_server(), "evil")
+    disconnects = []
+
+    def guarded():
+        try:
+            yield from blockfetch.client(node, "evil", rsp, req, cand)
+        except blockfetch.InvalidBlockFromPeer as e:
+            disconnects.append(e.peer)
+
+    sim.spawn(guarded(), "fetch")
+    sim.run(until=10.0)
+    assert disconnects == ["evil"]
+    assert node.chain_db.get_is_invalid_block(corrupt.hash_) is not None
+    assert node.chain_db.tip_point().hash_ == good[0].hash_
+
+
+def test_server_follower_closed_on_teardown(tmp_path):
+    """A killed ChainSync server must not leak its follower (the
+    RethrowPolicy disconnect path closes the generator; the server's
+    finally unregisters)."""
+    node = _mk_node(tmp_path, "n")
+    db = node.chain_db
+    before = len(db.followers)
+    req, rsp = Channel(), Channel()
+    gen = chainsync.server(db, req, rsp)
+    sim = Sim()
+    sim.spawn(gen, "server")
+    sim.run(until=0.1)  # server starts, registers its follower, blocks
+    assert len(db.followers) == before + 1
+    gen.close()
+    assert len(db.followers) == before
