@@ -284,3 +284,80 @@ def test_server_follower_closed_on_teardown(tmp_path):
     assert len(db.followers) == before + 1
     gen.close()
     assert len(db.followers) == before
+
+
+def test_edge_teardown_on_adversarial_peer(tmp_path):
+    """Full-edge adversary: honest ChainSync headers, corrupted
+    BlockFetch bodies. The InvalidBlockFromPeer punishment must tear
+    down the WHOLE connection — both protocol tasks end, the candidate
+    is dropped — while the victim keeps its valid chain."""
+    from ouroboros_consensus_tpu.block.praos_block import Block as PB
+    from ouroboros_consensus_tpu.block.praos_block import Header as PH
+    from ouroboros_consensus_tpu.miniprotocol import blockfetch
+    from ouroboros_consensus_tpu.miniprotocol.rethrow import peer_guard
+    from ouroboros_consensus_tpu.utils.sim import Recv, Send
+
+    evil = _mk_node(tmp_path, "evil")
+    victim = _mk_node(tmp_path, "victim")
+    chain = _forge_chain(4)
+    for b in chain:
+        evil.chain_db.add_block(b)
+
+    def corrupt(raw: bytes) -> bytes:
+        b = PB.from_bytes(raw)
+        sig = bytes([b.header.kes_sig[0] ^ 0xFF]) + b.header.kes_sig[1:]
+        return PB(PH(b.header.body, sig), b.txs).bytes_
+
+    sim = Sim()
+    evil.chain_db.runtime = sim
+    victim.chain_db.runtime = sim
+    cs_req, cs_rsp = Channel(delay=0.01), Channel(delay=0.01)
+    bf_req, bf_rsp = Channel(delay=0.01), Channel(delay=0.01)
+
+    def corrupting_bf_server():
+        """Wrap the honest server, corrupting every body on the way out."""
+        inner = blockfetch.server(evil.chain_db, bf_req, bf_rsp)
+        val = None
+        while True:
+            try:
+                eff = inner.send(val)
+            except StopIteration:
+                return
+            if isinstance(eff, Send) and eff.msg[0] == "block":
+                eff = Send(eff.chan, ("block", corrupt(eff.msg[1])))
+            val = yield eff
+
+    cand = Candidate()
+    victim.candidates["evil"] = cand
+    tasks = []
+
+    def disconnect():
+        for t in tasks:
+            t.alive = False
+        victim.candidates.pop("evil", None)
+
+    sim.spawn(chainsync.server(evil.chain_db, cs_req, cs_rsp), "cs-srv")
+    sim.spawn(corrupting_bf_server(), "bf-srv")
+    tasks.append(sim.spawn(
+        peer_guard(
+            chainsync.client(victim, "evil", cs_rsp, cs_req, cand),
+            "cs", victim.trace, disconnect,
+        ), "cs-client",
+    ))
+    tasks.append(sim.spawn(
+        peer_guard(
+            blockfetch.client(victim, "evil", bf_rsp, bf_req, cand),
+            "bf", victim.trace, disconnect,
+        ), "bf-client",
+    ))
+    sim.run(until=30.0)
+
+    assert "evil" not in victim.candidates  # connection torn down
+    assert all(not t.alive for t in tasks)
+    # nothing corrupt was adopted
+    assert victim.chain_db.tip_point() is None or (
+        victim.chain_db.get_is_invalid_block(
+            victim.chain_db.tip_point().hash_
+        ) is None
+    )
+    assert len(victim.chain_db.invalid) >= 1  # the lie was recorded
