@@ -269,3 +269,19 @@ def test_two_era_hard_fork_network(tmp_path):
     # the adopted protocol state sits in era B
     st = res.nodes[0].chain_db.current_ledger().header_state.chain_dep_state
     assert st.era == 1
+
+
+@pytest.mark.slow
+def test_async_chaindb_across_schedules(tmp_path):
+    """Decoupled add-block queue + background GC under PERTURBED
+    schedules: the async architecture must keep the consensus
+    properties for every explored interleaving (io-sim seed variation
+    over the mode with the most concurrency)."""
+    for seed in (None, 11, 97):
+        cfg = threadnet.ThreadNetConfig(
+            n_nodes=3, n_slots=14, k=10, msg_delay=0.05,
+            async_chaindb=True, seed=seed,
+        )
+        res = threadnet.run_thread_network(str(tmp_path / f"s{seed}"), cfg)
+        threadnet.check_common_prefix(res, cfg.k)
+        threadnet.check_chain_growth(res, cfg)
