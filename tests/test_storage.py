@@ -419,3 +419,43 @@ def test_init_chain_selection_not_shadowed_by_invalid_candidate(tmp_path):
     db.close()
     db2, _ = open_db(tmp_path)
     assert db2.tip_point().hash_ == main[1].hash_
+
+
+def test_async_mode_equals_sync_mode(tmp_path):
+    """The decoupled add-block queue + background copy/GC must produce
+    EXACTLY the chain the synchronous path produces for the same add
+    sequence (ChainSel.hs:217-246 decoupling is an execution detail,
+    not a semantics change)."""
+    from ouroboros_consensus_tpu.utils.sim import Sim
+
+    blocks = forge_chain(8)
+    fork = forge_chain(3, start_slot=2, start_bno=3,
+                       prev=blocks[2].hash_, pool_ix=1, slot_step=7)
+    sequence = blocks[:4] + fork + blocks[4:]
+
+    db_sync, _ = open_db(tmp_path, "sync")
+    for b in sequence:
+        db_sync.add_block(b)
+
+    db_async, _ = open_db(tmp_path, "async")
+    sim = Sim()
+    runners = db_async.start_decoupled(sim)
+    for i, r in enumerate(runners):
+        sim.spawn(r, f"runner{i}")
+
+    def feeder():
+        from ouroboros_consensus_tpu.utils.sim import Sleep, Wait
+
+        for b in sequence:
+            p = db_async.add_block_async(b)
+            if p.result is None:
+                yield Wait(p.processed)
+            yield Sleep(0.01)
+
+    sim.spawn(feeder(), "feeder")
+    sim.run(until=60.0)
+
+    assert [b.hash_ for b in db_sync.stream_all()] == [
+        b.hash_ for b in db_async.stream_all()
+    ]
+    assert db_sync.tip_point() == db_async.tip_point()
