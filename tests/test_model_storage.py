@@ -483,6 +483,7 @@ class ChainDBMachine(RuleBasedStateMachine):
         # (file granularity never triggers in a 16-block tree)
         self.model = ChainModel(self.ext.protocol, K)
         self.model_vol_max = 1000
+        self.all_blocks = {b.hash_: b for b in self.pool}
 
     def _assert_same_chain(self):
         actual = [b.hash_ for b in self.db.stream_all()]
@@ -509,6 +510,7 @@ class ChainDBMachine(RuleBasedStateMachine):
         good = _forge(parent.slot + 1, parent.block_no + 1, parent.hash_)
         bad_sig = bytes([good.header.kes_sig[0] ^ 0xFF]) + good.header.kes_sig[1:]
         bad = Block(Header(good.header.body, bad_sig), good.txs)
+        self.all_blocks[bad.hash_] = bad
         self.db.add_block(bad)
         # model unchanged — and the impl must agree
         self._assert_same_chain()
@@ -545,6 +547,35 @@ class ChainDBMachine(RuleBasedStateMachine):
             self.PATH, self.ext, _genesis(self.ext), K,
             validate_all=validate_all, fs=self.fs,
         )
+        self._assert_same_chain()
+
+    @rule(keep=st.floats(0.0, 1.0))
+    def crash_and_reopen(self, keep):
+        """Torn-write crash (no clean shutdown): reopen WITH full
+        revalidation must recover a consistent state — the immutable
+        part is a PREFIX of the model's immutable chain, the selected
+        chain revalidates, and the model resyncs to the survivors (the
+        q-s-m wipe/corrupt recovery property)."""
+        self.fs.crash(keep)
+        self.db = open_chaindb(
+            self.PATH, self.ext, _genesis(self.ext), K,
+            validate_all=True, fs=self.fs,
+        )
+        actual = [b.hash_ for b in self.db.stream_all()]
+        model_imm = [b.hash_ for b in self.model.immutable]
+        # immutable prefix survived (fsynced up to the snapshot/flush
+        # watermark; never reordered or invented)
+        n_imm = self.db.immutable.n_blocks()
+        assert actual[:n_imm] == model_imm[:n_imm]
+        # resync the model to the survivors: volatile contents define
+        # the new selection baseline
+        by_hash = self.all_blocks
+        new = ChainModel(self.ext.protocol, K)
+        new.immutable = [by_hash[h] for h in actual[:n_imm]]
+        for h in self.db.volatile.all_hashes():
+            new.vol.put(by_hash[h])
+        new.current = list(self.db.current_chain)
+        self.model = new
         self._assert_same_chain()
 
     @invariant()
