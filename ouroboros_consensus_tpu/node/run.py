@@ -22,6 +22,7 @@ from typing import Callable
 
 from ..ledger.extended import ExtLedger, ExtLedgerState
 from ..storage.open import open_chaindb
+from ..utils.fs import REAL_FS
 from .kernel import NodeKernel, SlotClock
 
 DB_LOCK = "lock"
@@ -61,13 +62,23 @@ def to_exit_reason(exc: BaseException) -> ExitReason:
 
 
 class DbLockFile:
-    """flock-based single-process guard (DbLock.hs, 2s timeout)."""
+    """Single-process guard (DbLock.hs, 2s timeout): flock on the real
+    filesystem; on a mock FS, the MockFS advisory-lock registry — which
+    MockFS.crash clears, mirroring flock's release-on-process-death."""
 
-    def __init__(self, db_path: str):
+    def __init__(self, db_path: str, fs=None):
         self.path = os.path.join(db_path, DB_LOCK)
+        self.fs = fs  # None = real FS (flock)
         self._fd: int | None = None
+        self._held = False
 
     def acquire(self) -> None:
+        if self.fs is not None:
+            if self.path in self.fs.advisory_locks:
+                raise DbLocked(self.path)
+            self.fs.advisory_locks.add(self.path)
+            self._held = True
+            return
         import fcntl
 
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
@@ -78,8 +89,15 @@ class DbLockFile:
             os.close(fd)
             raise DbLocked(self.path) from e
         self._fd = fd
+        self._held = True
 
     def release(self) -> None:
+        if not self._held:
+            return  # never release a lock another instance holds
+        self._held = False
+        if self.fs is not None:
+            self.fs.advisory_locks.discard(self.path)
+            return
         if self._fd is not None:
             import fcntl
 
@@ -96,29 +114,26 @@ class DbLockFile:
         return False
 
 
-def check_db_marker(db_path: str, network_magic: int) -> None:
+def check_db_marker(db_path: str, network_magic: int, fs=None) -> None:
     """checkDbMarker (DbMarker.hs): create on first open, verify after."""
+    fs = fs if fs is not None else REAL_FS
     p = os.path.join(db_path, DB_MARKER)
-    if os.path.exists(p):
-        with open(p) as f:
-            found = int(f.read().strip())
+    if fs.exists(p):
+        found = int(fs.read_bytes(p).decode().strip())
         if found != network_magic:
             raise DbMarkerMismatch(f"DB is for magic {found}, node runs {network_magic}")
     else:
-        os.makedirs(db_path, exist_ok=True)
-        with open(p, "w") as f:
-            f.write(str(network_magic))
+        fs.makedirs(db_path)
+        # durable: the marker must survive a crash (write_atomic fsyncs)
+        fs.write_atomic(p, str(network_magic).encode())
 
 
-def was_clean_shutdown(db_path: str) -> bool:
+def was_clean_shutdown(db_path: str, fs=None) -> bool:
     """Recovery.hs:24: the clean marker is REMOVED while running and
     written back on orderly shutdown; missing at start (after a first
     run) ⇒ crash ⇒ revalidate everything."""
-    return os.path.exists(os.path.join(db_path, CLEAN_SHUTDOWN))
-
-
-def _has_db(db_path: str) -> bool:
-    return os.path.exists(os.path.join(db_path, DB_MARKER))
+    fs = fs if fs is not None else REAL_FS
+    return fs.exists(os.path.join(db_path, CLEAN_SHUTDOWN))
 
 
 @dataclass
@@ -127,12 +142,15 @@ class RunningNode:
     db_path: str
     lock: DbLockFile
     crashed_last_run: bool
+    fs: object = None
 
     def shutdown(self) -> None:
         """Orderly stop: final snapshot, clean marker, release lock."""
+        fs = self.fs if self.fs is not None else REAL_FS
         self.kernel.chain_db.close()
-        with open(os.path.join(self.db_path, CLEAN_SHUTDOWN), "w") as f:
-            f.write("clean\n")
+        fs.write_atomic(
+            os.path.join(self.db_path, CLEAN_SHUTDOWN), b"clean\n"
+        )
         self.lock.release()
 
 
@@ -148,6 +166,7 @@ def start_node(
     clock: SlotClock | None = None,
     chunk_size: int = 21600,
     trace: Callable[[str], None] = lambda s: None,
+    fs=None,  # HasFS seam: a MockFS runs the WHOLE node in memory
 ) -> RunningNode:
     """run/runWith condensed (Node.hs:272): lock → marker → recovery
     check → ChainDB open (validation policy per recovery) → NodeKernel.
@@ -155,15 +174,16 @@ def start_node(
     The caller wires mini-protocol tasks and the forging loop into a
     sim/asyncio runtime (testing/threadnet.py is the reference user).
     """
-    lock = DbLockFile(db_path)
+    vfs = fs if fs is not None else REAL_FS
+    lock = DbLockFile(db_path, fs=fs)
     lock.acquire()
     try:
-        check_db_marker(db_path, network_magic)
-        first_run = not os.path.exists(os.path.join(db_path, "immutable"))
-        crashed = not first_run and not was_clean_shutdown(db_path)
+        check_db_marker(db_path, network_magic, fs=fs)
+        first_run = not vfs.exists(os.path.join(db_path, "immutable"))
+        crashed = not first_run and not was_clean_shutdown(db_path, fs=fs)
         clean_marker = os.path.join(db_path, CLEAN_SHUTDOWN)
-        if os.path.exists(clean_marker):
-            os.remove(clean_marker)  # running now: a crash leaves no marker
+        if vfs.exists(clean_marker):
+            vfs.remove(clean_marker)  # running now: a crash leaves no marker
         if crashed:
             trace(f"{name}: unclean shutdown detected -> full revalidation")
         db = open_chaindb(
@@ -171,11 +191,12 @@ def start_node(
             validate_all=crashed,
             chunk_size=chunk_size,
             trace=trace,
+            fs=fs,
         )
         kernel = NodeKernel(
             name, db, ext.protocol, ext.ledger, pool=pool, clock=clock, trace=trace
         )
-        return RunningNode(kernel, db_path, lock, crashed)
+        return RunningNode(kernel, db_path, lock, crashed, fs=fs)
     except BaseException:
         lock.release()
         raise

@@ -116,11 +116,15 @@ class RealFS:
 
 
 class _MockFile:
-    __slots__ = ("data", "synced")
+    __slots__ = ("data", "synced", "durable")
 
     def __init__(self, data: bytes = b""):
         self.data = bytearray(data)
         self.synced = len(data)  # fsync watermark (crash keeps ≤ this)
+        # has the file's EXISTENCE been made durable (fsync/atomic
+        # rename)? A created-but-never-synced file's directory entry
+        # need not survive a crash.
+        self.durable = False
 
 
 class MockFS:
@@ -129,6 +133,10 @@ class MockFS:
     def __init__(self):
         self._files: dict[str, _MockFile] = {}
         self._dirs: set[str] = {""}
+        # flock analog: held advisory locks live OUTSIDE the file data —
+        # a crash (all processes die) releases them all, exactly like
+        # the kernel dropping flocks on process death
+        self.advisory_locks: set[str] = set()
 
     @staticmethod
     def _norm(path: str) -> str:
@@ -204,6 +212,7 @@ class MockFS:
         p = self._norm(path)
         nf = _MockFile(data)
         nf.synced = len(data)
+        nf.durable = True
         self._files[p] = nf
 
     def truncate(self, path: str, size: int) -> None:
@@ -220,17 +229,25 @@ class MockFS:
         f = self._files.get(self._norm(path))
         if f is not None:
             f.synced = len(f.data)
+            f.durable = True
 
     # -- fault injection (fs-sim / Test/Util/Corruption.hs) ------------------
 
     def crash(self, keep_fraction: float = 0.0) -> None:
         """Simulated process/OS crash: unsynced suffixes survive only up
         to `keep_fraction` of their length (0 = lose all unsynced bytes,
-        1 = lose nothing) — the torn-write model."""
-        for f in self._files.values():
+        1 = lose nothing) — the torn-write model. Files whose EXISTENCE
+        was never made durable (no fsync/atomic write) and that lose all
+        their bytes vanish entirely — which is also how a crashed
+        process's advisory lock file disappears."""
+        self.advisory_locks.clear()  # every holder died with the crash
+        for name in list(self._files):
+            f = self._files[name]
             if len(f.data) > f.synced:
                 keep = f.synced + int((len(f.data) - f.synced) * keep_fraction)
                 del f.data[keep:]
+            if not f.durable and not f.data:
+                del self._files[name]
 
     def corrupt_byte(self, path: str, offset: int, xor: int = 0xFF) -> None:
         f = self._files[self._norm(path)]

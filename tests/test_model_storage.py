@@ -484,6 +484,7 @@ class ChainDBMachine(RuleBasedStateMachine):
         self.model = ChainModel(self.ext.protocol, K)
         self.model_vol_max = 1000
         self.all_blocks = {b.hash_: b for b in self.pool}
+        self.bad_hashes: set[bytes] = set()
 
     def _assert_same_chain(self):
         actual = [b.hash_ for b in self.db.stream_all()]
@@ -511,6 +512,7 @@ class ChainDBMachine(RuleBasedStateMachine):
         bad_sig = bytes([good.header.kes_sig[0] ^ 0xFF]) + good.header.kes_sig[1:]
         bad = Block(Header(good.header.body, bad_sig), good.txs)
         self.all_blocks[bad.hash_] = bad
+        self.bad_hashes.add(bad.hash_)
         self.db.add_block(bad)
         # model unchanged — and the impl must agree
         self._assert_same_chain()
@@ -567,14 +569,21 @@ class ChainDBMachine(RuleBasedStateMachine):
         # watermark; never reordered or invented)
         n_imm = self.db.immutable.n_blocks()
         assert actual[:n_imm] == model_imm[:n_imm]
-        # resync the model to the survivors: volatile contents define
-        # the new selection baseline
+        # resync the model from the SURVIVING INPUTS only (immutable
+        # prefix + surviving VALID volatile blocks) and let the model
+        # run its OWN chain selection over them — an independent check
+        # that recovery picked the best reachable chain, not merely an
+        # internally-consistent one
         by_hash = self.all_blocks
         new = ChainModel(self.ext.protocol, K)
         new.immutable = [by_hash[h] for h in actual[:n_imm]]
-        for h in self.db.volatile.all_hashes():
-            new.vol.put(by_hash[h])
-        new.current = list(self.db.current_chain)
+        survivors = [
+            by_hash[h]
+            for h in self.db.volatile.all_hashes()
+            if h not in self.bad_hashes
+        ]
+        for b in sorted(survivors, key=lambda b: (b.slot, b.block_no)):
+            new.add(b)
         self.model = new
         self._assert_same_chain()
 
