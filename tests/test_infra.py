@@ -1,0 +1,96 @@
+"""Tests OF the test infrastructure (the reference's infra-test tier,
+test/infra-test/Main.hs): the mock FS's crash semantics and the
+deterministic sim are themselves load-bearing — a bug here silently
+weakens every model/machine test built on top.
+"""
+
+import pytest
+
+from ouroboros_consensus_tpu.utils.fs import MockFS
+from ouroboros_consensus_tpu.utils.sim import Channel, Recv, Send, Sim, Sleep
+
+
+def test_mockfs_crash_respects_fsync_watermark():
+    fs = MockFS()
+    fs.makedirs("d")
+    fs.append("d/f", b"durable")
+    fs.fsync("d/f")
+    fs.append("d/f", b"-torn-tail")
+    fs.crash(0.0)
+    assert fs.read_bytes("d/f") == b"durable"  # synced prefix survives
+    # partial tearing keeps a prefix of the unsynced suffix
+    fs.append("d/f", b"0123456789")
+    fs.crash(0.5)
+    assert fs.read_bytes("d/f") == b"durable01234"
+
+
+def test_mockfs_atomic_write_is_durable():
+    fs = MockFS()
+    fs.makedirs("d")
+    fs.write_atomic("d/snap", b"payload")
+    fs.crash(0.0)
+    assert fs.read_bytes("d/snap") == b"payload"
+
+
+def test_mockfs_unsynced_creation_vanishes_on_crash():
+    fs = MockFS()
+    fs.makedirs("d")
+    fs.append("d/ephemeral", b"x")
+    fs.crash(0.0)
+    assert not fs.exists("d/ephemeral")
+
+
+def test_mockfs_wipe_and_listing():
+    fs = MockFS()
+    fs.makedirs("a/b")
+    fs.append("a/b/f1", b"1")
+    fs.append("a/g", b"2")
+    assert fs.listdir("a") == ["b", "g"]
+    fs.wipe("a/b")
+    assert fs.listdir("a") == ["g"]
+
+
+def test_sim_determinism_bit_identical():
+    """Two runs of the same program produce the same trace — the io-sim
+    property every ThreadNet result rests on."""
+
+    def run():
+        sim = Sim()
+        trace = []
+        ch = Channel(delay=0.3)
+
+        def producer():
+            for i in range(5):
+                yield Send(ch, i)
+                yield Sleep(0.1)
+
+        def consumer():
+            while True:
+                v = yield Recv(ch)
+                trace.append((sim.now, v))
+
+        sim.spawn(producer(), "p")
+        sim.spawn(consumer(), "c")
+        sim.run(until=10.0)
+        return trace
+
+    assert run() == run()
+
+
+def test_sim_channel_fifo_with_delay():
+    sim = Sim()
+    got = []
+    ch = Channel(delay=1.0)
+
+    def sender():
+        yield Send(ch, "a")
+        yield Send(ch, "b")
+
+    def receiver():
+        got.append((yield Recv(ch)))
+        got.append((yield Recv(ch)))
+
+    sim.spawn(sender(), "s")
+    sim.spawn(receiver(), "r")
+    sim.run(until=5.0)
+    assert got == ["a", "b"]
