@@ -258,12 +258,14 @@ class MockFS:
         self.truncate(path, size)
 
     def wipe(self, path: str) -> None:
-        """Remove a file or a whole directory tree."""
+        """Remove a file or a whole directory tree (the directory node
+        itself included — q-s-m's wipe command semantics)."""
         p = self._norm(path)
         for k in [k for k in self._files if k == p or k.startswith(p + "/")]:
             del self._files[k]
-        for d in [d for d in self._dirs if d != p and d.startswith(p + "/")]:
-            self._dirs.discard(d)
+        for d in [d for d in self._dirs if d == p or d.startswith(p + "/")]:
+            if d:  # never drop the root
+                self._dirs.discard(d)
 
     def files(self) -> list[str]:
         return sorted(self._files)
