@@ -264,6 +264,13 @@ def client(
     # findIntersect with points of our current chain (newest first —
     # Client.hs:464 uses the standard exponentially-spaced offsets; the
     # dense recent prefix suffices for test chains)
+    # header codec seam, mirroring the ChainDB's decode_block seam: a
+    # composite (HFC) network's eras may use non-Praos header layouts, so
+    # the node (or its ChainDB) can supply the era-dispatching decoder
+    decode_header = getattr(
+        node, "decode_header",
+        getattr(node.chain_db, "decode_header", Header.from_bytes),
+    )
     our_points = [b.point for b in reversed(node.chain_db.current_chain)]
     our_points.append(None)  # genesis fallback
     yield Send(tx, ("find_intersect", our_points))
@@ -300,7 +307,7 @@ def client(
         server_tip = msg[-1]
         kind = msg[0]
         if kind == "roll_forward":
-            header = Header.from_bytes(msg[1])
+            header = decode_header(msg[1])
             # forecast the ledger view for the header's slot. A header
             # past OUR forecast horizon is not (yet) validatable: the
             # reference client BLOCKS in STM until the node's own tip

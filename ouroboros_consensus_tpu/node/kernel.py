@@ -101,8 +101,18 @@ class NodeKernel:
             # re-deriving here would resurrect forgotten (forward-secure)
             # evolutions and waste the 2^depth vk-tree derivation.
             self._install_hotkey(pool.kes_seed, counter=0, kes_period=0)
+            # provisional: re-issued for the actual start slot's KES
+            # period when the forging loop starts (see forging_loop) —
+            # the reference issues the OCert at the key-creation period
+            # (Ledger/HotKey.hs), not period 0
+            self._hotkey_provisional = True
 
     def _install_hotkey(self, kes_seed: bytes, counter: int, kes_period: int):
+        # any explicit (re)install supersedes the constructor's
+        # provisional period-0 key — without this, a rekey() before the
+        # forging loop starts would be silently discarded and replaced
+        # by a root-seed re-derivation (forward-security violation)
+        self._hotkey_provisional = False
         self.hotkey = HotKey(
             kes_seed,
             self.pool.kes_depth,
@@ -254,6 +264,21 @@ class NodeKernel:
         `start_slot` supports ThreadNet join plans / restarts — the
         caller aligns the spawn time with that slot's start."""
         from ..utils.sim import Wait
+
+        # a provisionally period-0 hot key (fresh node, no explicit key
+        # carried in) is issued properly for the START slot's KES period:
+        # a node joining at a later wallclock must not waste evolutions
+        # covering already-elapsed periods, nor expire at absolute period
+        # max_kes_evolutions regardless of its start time
+        if getattr(self, "_hotkey_provisional", False):
+            self._hotkey_provisional = False
+            kp = self.protocol.params.kes_period_of(start_slot)
+            if kp > 0:
+                self.hotkey.forget()
+                self._install_hotkey(
+                    self.pool.kes_seed, counter=self._ocert_counter,
+                    kes_period=kp,
+                )
 
         for slot in range(start_slot, n_slots):
             # forge at the START of slot `slot` (virtual time

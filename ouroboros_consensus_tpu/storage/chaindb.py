@@ -536,6 +536,13 @@ class ChainDB:
             cand = self._best_candidate_from(anchor, rejected, via=block.hash_)
             if cand is None:
                 return changed
+            # rejection must always record the FULL candidate's hashes:
+            # _best_candidate_from excludes by exact hash-list equality
+            # against the maximal fragments it regenerates, so rejecting
+            # only a truncated prefix would re-select the same candidate
+            # forever when _try_adopt fails without changing any state
+            # (e.g. rollback beyond the LedgerDB window)
+            full_hashes = [b.hash_ for b in cand]
             if self.check_in_future is not None:
                 kept, dropped = self.check_in_future.truncate(cand)
                 if dropped:
@@ -553,7 +560,7 @@ class ChainDB:
                     if not kept or proto.compare_candidates(
                         cur_view, kept_view
                     ) <= 0:
-                        rejected.append([b.hash_ for b in cand])
+                        rejected.append(full_hashes)
                         continue
                     cand = kept
             cand_view = proto.select_view(cand[-1].header)
@@ -566,7 +573,7 @@ class ChainDB:
                 return True
             if outcome == "prefix":
                 changed = True
-            rejected.append([b.hash_ for b in cand])
+            rejected.append(full_hashes)
 
     def _diff_against_current(self, cand: list[Block]):
         """ChainDiff (Fragment/Diff.hs): longest common prefix with the
