@@ -3,22 +3,30 @@
 Mirrors the reference's `db-analyser --only-validation` shape
 (Tools/DBAnalyser/Run.hs:133-143): open the on-disk ImmutableDB of a
 db-synthesizer chain with full integrity checking, stream + parse every
-block (native C++ chunk scanner), stage SoA batches, run the fused TPU
-kernel (Ed25519 OCert + CompactSum KES + ECVRF + leader threshold +
-nonce range extension — Praos.hs:441-606 semantics) with pipelined
-host/device overlap, and fold the sequential epilogue. The measured
-baseline is the SAME end-to-end replay through the single-core C++
-verifier (native/hostcrypto.cpp — the role libsodium plays under the
-reference), on the same chain, same process.
+block (native C++ chunk scanner), stage SoA batches, run the Pallas TPU
+verification kernels (Ed25519 OCert + CompactSum KES + ECVRF + leader
+threshold + nonce range extension — Praos.hs:441-606 semantics, ops/pk)
+with pipelined host/device overlap, and fold the sequential epilogue.
+The measured baseline is the SAME end-to-end replay through the
+single-core C++ verifier (native/hostcrypto.cpp — the role libsodium
+plays under the reference), on the same chain, same process.
+
+Un-killable by design (round-2 postmortem: the TPU tunnel wedged, the
+probe loop had no overall deadline, and the driver recorded rc=124 with
+no JSON): every device interaction runs in a SUBPROCESS under a bounded
+budget; the native baseline is measured first in-process; and the ONE
+JSON line is printed no matter what the tunnel does, with
+"device_unavailable": true when the device result is missing.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "headers/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "headers/s", "vs_baseline": N, ...}
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 from fractions import Fraction
@@ -26,7 +34,12 @@ from fractions import Fraction
 BENCH_HEADERS = int(os.environ.get("BENCH_HEADERS", "100000"))
 KES_DEPTH = int(os.environ.get("BENCH_KES_DEPTH", "7"))
 MAX_BATCH = int(os.environ.get("BENCH_MAX_BATCH", "8192"))
+# total wall budget for device probing (fresh-process trivial op)
+PROBE_BUDGET = float(os.environ.get("BENCH_PROBE_BUDGET", "180"))
+# total wall budget for the device-side measurement subprocess
+DEVICE_BUDGET = float(os.environ.get("BENCH_DEVICE_BUDGET", "1200"))
 CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+JAX_CACHE = "/tmp/ouroboros-jax-cache"
 
 
 def bench_params():
@@ -74,98 +87,153 @@ def build_or_load_chain():
     return path, params, lview
 
 
-def run_replay(path, params, lview, backend: str):
-    from ouroboros_consensus_tpu.tools import db_analyser as ana
-
-    t0 = time.monotonic()
-    r = ana.revalidate(
-        path, params, lview, backend=backend, validate_all=True,
-        max_batch=MAX_BATCH,
-    )
-    wall = time.monotonic() - t0
-    assert r.error is None, f"bench chain must revalidate clean: {r.error!r}"
-    assert r.n_valid == r.n_blocks > 0
-    return r.n_valid, wall, r
-
-
-def main() -> None:
-    import jax
-
-    # honor an explicit platform request even under this box's
-    # sitecustomize (which force-prefers the axon TPU plugin after
-    # interpreter start, making the env var alone insufficient)
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        jax.config.update("jax_platforms", plat)
-    jax.config.update("jax_compilation_cache_dir", "/tmp/ouroboros-jax-cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
-    path, params, lview = build_or_load_chain()
-
-    # the TPU tunnel on this box can wedge transiently; ride out a short
-    # outage. Probing must happen in FRESH subprocesses: jax caches
-    # partially-initialized backend state, so an in-process retry after
-    # a failure can silently come back CPU-only. Only when a probe
-    # succeeds do we initialize in THIS process (its first init).
-    import subprocess
-
-    for attempt in range(5):
+def probe_device() -> bool:
+    """Fresh-subprocess probes with an OVERALL deadline (round-2 lesson:
+    per-attempt timeouts without a total budget ate the driver's run)."""
+    deadline = time.monotonic() + PROBE_BUDGET
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        left = max(5.0, deadline - time.monotonic())
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
-                capture_output=True, text=True, timeout=300,
+                 "import jax, jax.numpy as jnp;"
+                 "assert jax.devices()[0].platform == 'tpu';"
+                 "print(int((jnp.ones((8,8))+1).sum()))"],
+                capture_output=True, text=True,
+                timeout=min(90.0, left),
             )
-            err = probe.stderr if probe.returncode else None
-            if probe.returncode == 0:
-                break
+            if probe.returncode == 0 and probe.stdout.strip() == "128":
+                print(f"# device probe ok (attempt {attempt})", file=sys.stderr)
+                return True
+            err = (probe.stderr or "?").strip().splitlines()
+            err = err[-1] if err else "?"
         except subprocess.TimeoutExpired:
             err = "probe timed out (backend init hung)"
+        print(f"# device probe failed (attempt {attempt}): {err}",
+              file=sys.stderr)
+        if time.monotonic() + 30 < deadline:
+            time.sleep(30)
+        else:
+            break
+    return False
+
+
+_DEVICE_CHILD = r"""
+import json, os, sys, time
+import jax
+jax.config.update("jax_compilation_cache_dir", os.environ["OCT_JAX_CACHE"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+sys.path.insert(0, os.environ["OCT_REPO"])
+from bench import BENCH_HEADERS, KES_DEPTH, MAX_BATCH, bench_params, build_or_load_chain
+from ouroboros_consensus_tpu.tools import db_analyser as ana
+
+path, params, lview = build_or_load_chain()
+t0 = time.monotonic()
+r = ana.revalidate(path, params, lview, backend="device", validate_all=True,
+                   max_batch=MAX_BATCH)
+warm_s = time.monotonic() - t0
+assert r.error is None, repr(r.error)
+assert r.n_valid == r.n_blocks > 0
+best = None
+for _ in range(2):
+    t0 = time.monotonic()
+    r = ana.revalidate(path, params, lview, backend="device",
+                       validate_all=True, max_batch=MAX_BATCH)
+    wall = time.monotonic() - t0
+    assert r.error is None and r.n_valid == r.n_blocks
+    if best is None or wall < best:
+        best = wall
+with open(os.environ["OCT_RESULT"], "w") as f:
+    json.dump({"n": r.n_valid, "best_s": best, "warm_s": warm_s,
+               "platform": jax.devices()[0].platform}, f)
+"""
+
+
+def run_device_subprocess() -> dict | None:
+    """Run the device-side replay in a child with a hard wall budget."""
+    result_path = os.path.join(CACHE, "device_result.json")
+    try:
+        os.remove(result_path)
+    except FileNotFoundError:
+        pass
+    env = dict(os.environ)
+    env["OCT_RESULT"] = result_path
+    env["OCT_REPO"] = os.path.dirname(os.path.abspath(__file__))
+    env["OCT_JAX_CACHE"] = JAX_CACHE
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _DEVICE_CHILD],
+            timeout=DEVICE_BUDGET, env=env,
+            stdout=sys.stderr, stderr=subprocess.STDOUT,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"# device measurement exceeded {DEVICE_BUDGET:.0f}s budget",
+              file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        print(f"# device measurement failed rc={proc.returncode}",
+              file=sys.stderr)
+        return None
+    try:
+        with open(result_path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def main() -> None:
+    # The native baseline and chain synthesis need no accelerator; run
+    # them FIRST so a wedged tunnel can never cost us the whole round.
+    path, params, lview = build_or_load_chain()
+
+    from ouroboros_consensus_tpu.tools import db_analyser as ana
+
+    t0 = time.monotonic()
+    r = ana.revalidate(path, params, lview, backend="native",
+                       validate_all=True, max_batch=MAX_BATCH)
+    nwall = time.monotonic() - t0
+    assert r.error is None, f"bench chain must revalidate clean: {r.error!r}"
+    assert r.n_valid == r.n_blocks > 0
+    baseline = r.n_valid / nwall
+    print(f"# native baseline {baseline:.0f} headers/s ({nwall:.1f}s)",
+          file=sys.stderr)
+
+    device = run_device_subprocess() if probe_device() else None
+
+    if device is not None:
+        rate = device["n"] / device["best_s"]
         print(
-            f"# backend probe failed (attempt {attempt + 1}/5): "
-            f"{str(err).strip().splitlines()[-1] if err else '?'}",
+            f"# platform={device['platform']} headers={device['n']} "
+            f"warmup={device['warm_s']:.1f}s best={device['best_s']:.2f}s",
             file=sys.stderr,
         )
-        if attempt < 4:
-            time.sleep(60)
-    platform = jax.devices()[0].platform
-
-    # warmup: compile the kernel on a small prefix replay
-    t0 = time.monotonic()
-    n0, w0, _ = run_replay(path, params, lview, "device")
-    warm_s = time.monotonic() - t0
-
-    n, best, detail = None, None, None
-    for _ in range(2):
-        n, wall, r = run_replay(path, params, lview, "device")
-        if best is None or wall < best:
-            best, detail = wall, r
-    rate = n / best
-
-    nb, bwall, _ = run_replay(path, params, lview, "native")
-    baseline = nb / bwall
-
-    print(
-        f"# platform={platform} headers={n} warmup={warm_s:.1f}s "
-        f"best={best:.2f}s (validate {detail.device_s:.2f}s) "
-        f"native_baseline={baseline:.0f}/s ({bwall:.1f}s)",
-        file=sys.stderr,
-    )
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "end-to-end db-analyser revalidation of a "
-                    f"{n}-header synthetic Praos chain (disk->parse->"
-                    "stage->Ed25519+KES+VRF+leader->nonce fold), device "
-                    "vs measured single-core C++ (libsodium-class) replay"
-                ),
-                "value": round(rate, 1),
-                "unit": "headers/s",
-                "vs_baseline": round(rate / baseline, 2),
-            }
-        )
-    )
+        out = {
+            "metric": (
+                "end-to-end db-analyser revalidation of a "
+                f"{device['n']}-header synthetic Praos chain (disk->parse->"
+                "stage->Pallas Ed25519+KES+VRF+leader kernels->nonce fold), "
+                "TPU vs measured single-core C++ (libsodium-class) replay"
+            ),
+            "value": round(rate, 1),
+            "unit": "headers/s",
+            "vs_baseline": round(rate / baseline, 2),
+        }
+    else:
+        out = {
+            "metric": (
+                "end-to-end db-analyser revalidation of a "
+                f"{r.n_valid}-header synthetic Praos chain — DEVICE "
+                "UNAVAILABLE this run (TPU tunnel down); value is the "
+                "measured single-core C++ native-backend replay"
+            ),
+            "value": round(baseline, 1),
+            "unit": "headers/s",
+            "vs_baseline": 1.0,
+            "device_unavailable": True,
+        }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

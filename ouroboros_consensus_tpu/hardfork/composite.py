@@ -1,5 +1,14 @@
 """The mixed-era composite: ByronMock(PBFT) → Shelley(TPraos) →
-Babbage(Praos) through the hard-fork combinator — BASELINE config 5.
+Babbage(Praos) [→ Conway(Praos) → Leios(Praos)] through the hard-fork
+combinator — BASELINE config 5.
+
+The optional 4th/5th eras (enabled by `conway_epochs`) are Praos-class
+eras with GENUINELY different ledger parameters — Conway doubles the
+epoch length and halves the active-slot coefficient, Leios changes both
+again — so the HFC translations and the per-era epoch/threshold
+arithmetic are non-trivial, mirroring the 7-era CardanoBlock
+(Cardano/Block.hs:96) where every Shelley-family step changes ledger
+params.
 
 Reference: `CardanoBlock` (Cardano/Block.hs:96 — ByronBlock ':
 CardanoShelleyEras), the `CanHardFork` pairwise translations
@@ -50,6 +59,14 @@ class CardanoMockConfig:
     shelley_f: Fraction = Fraction(1)
     babbage_f: Fraction = Fraction(1)
     epoch_length: int = 60  # shelley + babbage
+    # 4th/5th eras (None = the classic 3-era composite). Conway doubles
+    # the epoch length and changes f; Leios changes both again.
+    conway_epochs: int | None = None  # babbage epochs before conway
+    conway_f: Fraction = Fraction(1, 2)
+    conway_epoch_length: int = 120
+    leios_epochs: int | None = None  # conway epochs before leios
+    leios_f: Fraction = Fraction(1)
+    leios_epoch_length: int = 30
     k: int = 5
     kes_depth: int = 3
     # with n_delegs=2 round-robin and window k, each delegate signs
@@ -93,6 +110,14 @@ class CardanoMock:
         self.praos_params = praos.PraosParams(
             active_slot_coeff=cfg.babbage_f, **common
         )
+        self.conway_params = praos.PraosParams(
+            active_slot_coeff=cfg.conway_f,
+            **{**common, "epoch_length": cfg.conway_epoch_length},
+        )
+        self.leios_params = praos.PraosParams(
+            active_slot_coeff=cfg.leios_f,
+            **{**common, "epoch_length": cfg.leios_epoch_length},
+        )
         self.pbft = PBftProtocol(
             PBftParams(
                 num_genesis_keys=cfg.n_delegs,
@@ -104,19 +129,27 @@ class CardanoMock:
         )
         self.tpraos_proto = tpraos.TPraosProtocol(self.tpraos_params)
         nonce = cfg.shelley_initial_nonce
-        self.summary = summarize(
-            Fraction(0),
-            [
-                EraParams(cfg.byron_epoch_length, Fraction(1)),
-                EraParams(cfg.epoch_length, Fraction(1)),
-                EraParams(cfg.epoch_length, Fraction(1)),
-            ],
-            [
-                cfg.byron_epochs,
-                cfg.byron_epochs + cfg.shelley_epochs,
-                None,
-            ],
-        )
+        era_params = [
+            EraParams(cfg.byron_epoch_length, Fraction(1)),
+            EraParams(cfg.epoch_length, Fraction(1)),
+            EraParams(cfg.epoch_length, Fraction(1)),
+        ]
+        bounds = [
+            cfg.byron_epochs,
+            cfg.byron_epochs + cfg.shelley_epochs,
+            None,
+        ]
+        if cfg.conway_epochs is not None:
+            era_params.append(EraParams(cfg.conway_epoch_length, Fraction(1)))
+            bounds[-1] = bounds[-2] + cfg.conway_epochs
+            bounds.append(None)
+            if cfg.leios_epochs is not None:
+                era_params.append(
+                    EraParams(cfg.leios_epoch_length, Fraction(1))
+                )
+                bounds[-1] = bounds[-2] + cfg.leios_epochs
+                bounds.append(None)
+        self.summary = summarize(Fraction(0), era_params, bounds)
         self.praos_proto = PraosProtocol(self.praos_params)
         self.eras = [
             Era("byron", self.pbft, ledger=None),
@@ -138,15 +171,49 @@ class CardanoMock:
                 translate_chain_dep=tpraos.translate_state,
             ),
         ]
-        self.hf = HardForkProtocol(self.eras, self.summary)
         self.decoders = [
             ByronMockBlock.from_bytes,
             PraosBlock.from_bytes,
             PraosBlock.from_bytes,
         ]
+        if cfg.conway_epochs is not None:
+            # Praos -> Praos translation: the chain-dep state (nonces,
+            # ocert counters) carries over verbatim; what CHANGES is the
+            # era's ledger params (epoch length, f) — the translation is
+            # non-trivial at the time layer, exactly like the
+            # Shelley-family steps of CanHardFork.hs:273
+            self.eras.append(
+                Era(
+                    "conway",
+                    PraosProtocol(self.conway_params),
+                    ledger=None,
+                    translate_chain_dep=lambda s: s,
+                )
+            )
+            self.decoders.append(PraosBlock.from_bytes)
+            if cfg.leios_epochs is not None:
+                self.eras.append(
+                    Era(
+                        "leios",
+                        PraosProtocol(self.leios_params),
+                        ledger=None,
+                        translate_chain_dep=lambda s: s,
+                    )
+                )
+                self.decoders.append(PraosBlock.from_bytes)
+        self.hf = HardForkProtocol(self.eras, self.summary)
+        self.inner_params = [
+            None,
+            self.tpraos_params,
+            self.praos_params,
+            self.conway_params,
+            self.leios_params,
+        ]
 
     def view_for_era(self, era: int):
-        return (None, self.tpraos_view, self.praos_view)[era]
+        return None if era == 0 else (
+            self.tpraos_view if era == 1 else self.praos_view
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -191,7 +258,7 @@ def synthesize(path: str, cfg: CardanoMockConfig, n_slots: int, chunk_size: int 
                 txs=(b"byron-tx-%d" % slot,),
             )
         else:
-            params = cm.tpraos_params if era == 1 else cm.praos_params
+            params = cm.inner_params[era]
             eta0 = ticked.inner.state.epoch_nonce
             if era == 1:
                 a = tpraos.overlay_slot_assignment(
@@ -207,7 +274,22 @@ def synthesize(path: str, cfg: CardanoMockConfig, n_slots: int, chunk_size: int 
                 inner_params = cm.tpraos_params.praos
             else:
                 creds = cm.pools[0]
-                inner_params = cm.praos_params
+                inner_params = params
+                if inner_params.active_slot_coeff != 1:
+                    # f < 1 era: consult the real leader lottery
+                    win = praos.check_is_leader(
+                        inner_params,
+                        fixtures.can_be_leader(creds),
+                        slot,
+                        praos.TickedPraosState(
+                            replace(
+                                praos.PraosState(), epoch_nonce=eta0
+                            ),
+                            cm.praos_view,
+                        ),
+                    )
+                    if win is None:
+                        continue
             blk = praos_forge.forge_block(
                 inner_params, creds,
                 slot=slot, block_no=block_no, prev_hash=prev,
@@ -320,7 +402,7 @@ def revalidate(path: str, cfg: CardanoMockConfig, backend: str = "device") -> Mi
             )
             st = replace(st, inner=inner)
         else:
-            params = cm.tpraos_params if era == 1 else cm.praos_params
+            params = cm.inner_params[era]
             lview = cm.view_for_era(era)
             inner = st.inner
             n_ok = 0

@@ -1,13 +1,25 @@
 """BlockFetch mini-protocol: download bodies for preferred candidates.
 
 Reference: `MiniProtocol/BlockFetch/{ClientInterface,Server}.hs` plus the
-fetch-decision logic the consensus layer feeds (preferAnchoredCandidate:
-only fetch candidates strictly better than our chain by the protocol's
-SelectView order). The full network-layer fetch governor (multi-peer
-de-duplication, in-flight limits) is out of scope for the sim harness —
-one fetch client per peer requests the candidate suffix it is missing
-and pushes completed blocks into the ChainDB (addBlockAsync sink,
-ClientInterface.hs mkBlockFetchConsensusInterface).
+fetch-decision logic the consensus layer feeds:
+
+  * preferAnchoredCandidate — only fetch candidates strictly better than
+    our chain by the protocol's SelectView order;
+  * FetchMode (readFetchModeDefault, ClientInterface.hs:133-158): when
+    the current chain's tip is < 1000 slots behind "now" the governor
+    runs in DEADLINE mode (latency first — fetch the whole preferred
+    suffix, duplicate fetches across peers are acceptable); further
+    behind it runs in BULK-SYNC mode (throughput first — bounded batch
+    sizes, and blocks already in flight from one peer are NOT requested
+    from another);
+  * in-flight limits — each per-peer client keeps at most ONE range
+    outstanding (the reference caps in-flight reqs/bytes per peer;
+    strict sequencing is the conservative instance of that cap), and
+    bulk-sync ranges are capped at `max_fetch_batch` blocks;
+  * multi-peer de-duplication — the node-level `FetchRegistry` (the
+    FetchClientRegistry analog) tracks which peer has claimed which
+    block; bulk-sync clients skip already-claimed blocks and release
+    their claims on completion or disconnection.
 
 Wire messages:
   client → server: ("request_range", Point_from_exclusive|None, Point_to)
@@ -21,6 +33,50 @@ from __future__ import annotations
 from ..block.abstract import Point
 from ..block.praos_block import Block
 from ..utils.sim import Recv, Send, Sleep, Wait
+
+# readFetchModeDefault's threshold (ClientInterface.hs:151)
+MAX_SLOTS_BEHIND = 1000
+
+BULK_SYNC = "bulk_sync"
+DEADLINE = "deadline"
+
+
+def read_fetch_mode(node, max_slots_behind: int = MAX_SLOTS_BEHIND) -> str:
+    """readFetchModeDefault (ClientInterface.hs:133-158): compare the
+    current chain's tip slot against the wallclock slot; < 1000 slots
+    behind -> deadline mode, else bulk sync. With no runtime clock
+    (CurrentSlotUnknown) the reference picks bulk sync."""
+    runtime = getattr(node.chain_db, "runtime", None)
+    clock = getattr(node, "clock", None)
+    if runtime is None or clock is None or not hasattr(runtime, "now"):
+        return BULK_SYNC
+    cur_slot = clock.slot_of(runtime.now)
+    tip = node.chain_db.tip_point()
+    slots_behind = cur_slot + 1 if tip is None else cur_slot - tip.slot
+    return DEADLINE if slots_behind < max_slots_behind else BULK_SYNC
+
+
+class FetchRegistry:
+    """Node-level in-flight block claims (FetchClientRegistry analog):
+    bulk-sync clients claim the blocks of a range before requesting it,
+    so the same bodies are never downloaded from two peers at once."""
+
+    def __init__(self):
+        self._claims: dict[bytes, str] = {}  # block hash -> peer name
+
+    def claim(self, h: bytes, peer: str) -> bool:
+        owner = self._claims.setdefault(h, peer)
+        return owner == peer
+
+    def release(self, h: bytes) -> None:
+        self._claims.pop(h, None)
+
+    def release_peer(self, peer: str) -> None:
+        for h in [h for h, p in self._claims.items() if p == peer]:
+            del self._claims[h]
+
+    def owner(self, h: bytes) -> str | None:
+        return self._claims.get(h)
 
 
 class InvalidBlockFromPeer(Exception):
@@ -113,14 +169,51 @@ def server(chain_db, rx, tx):
         yield Send(tx, ("batch_done",))
 
 
-def client(node, peer_name: str, rx, tx, candidate, *, poll_interval: float = 0.05, rounds: int | None = None):
+def _anchor_point_of(node, headers, first_missing):
+    """The fetch range anchor: the first missing header's predecessor."""
+    frm = first_missing.prev_hash
+    if frm is None:
+        return None
+    for h in headers:
+        if h.hash_ == frm:
+            return h.point
+    for b in node.chain_db.current_chain:
+        if b.hash_ == frm:
+            return b.point
+    return None
+
+
+def client(node, peer_name: str, rx, tx, candidate, *,
+           poll_interval: float = 0.05, rounds: int | None = None,
+           max_fetch_batch: int = 64,
+           max_slots_behind: int = MAX_SLOTS_BEHIND):
     """Fetch-decision + download loop for one peer.
 
     Watches the peer's ChainSync candidate; when the candidate is
     preferred over our current chain (longer per PraosChainSelectView —
     via node.protocol.compare_candidates on select views), requests the
-    missing suffix and feeds blocks to the ChainDB.
+    missing suffix and feeds blocks to the ChainDB. The decision follows
+    the FetchMode (module docstring): deadline mode fetches the whole
+    preferred suffix; bulk-sync mode claims bounded batches through the
+    node's FetchRegistry so concurrent peers never download the same
+    bodies. At most one range is outstanding per peer (in-flight cap).
     """
+    registry = getattr(node, "fetch_registry", None)
+    claimed: list[bytes] = []
+    try:
+        yield from _client_loop(
+            node, peer_name, rx, tx, candidate, poll_interval, rounds,
+            max_fetch_batch, max_slots_behind, registry, claimed,
+        )
+    finally:
+        # a dying client (disconnect/punishment) releases its claims so
+        # other peers can pick the blocks up
+        if registry is not None:
+            registry.release_peer(peer_name)
+
+
+def _client_loop(node, peer_name, rx, tx, candidate, poll_interval, rounds,
+                 max_fetch_batch, max_slots_behind, registry, claimed):
     done = 0
     while rounds is None or done < rounds:
         headers = list(candidate.headers)
@@ -128,9 +221,15 @@ def client(node, peer_name: str, rx, tx, candidate, *, poll_interval: float = 0.
             yield Sleep(poll_interval)
             done += 1
             continue
-        # fetch only headers we don't already have on our chain
+        # fetch only headers whose bodies we don't already HAVE — stored
+        # counts (volatile included), not just selected: a body another
+        # peer delivered moments ago must not be fetched again while
+        # chain selection catches up
         have = {b.hash_ for b in node.chain_db.current_chain}
-        missing = [h for h in headers if h.hash_ not in have]
+        missing = [
+            h for h in headers
+            if h.hash_ not in have and node.chain_db.get_block(h.point) is None
+        ]
         if not missing:
             yield Sleep(poll_interval)
             done += 1
@@ -139,22 +238,39 @@ def client(node, peer_name: str, rx, tx, candidate, *, poll_interval: float = 0.
             yield Sleep(poll_interval)
             done += 1
             continue
-        frm = missing[0].prev_hash
-        frm_point = None
-        if frm is not None:
-            # the fetch range anchor: the predecessor's point
-            for h in headers:
-                if h.hash_ == frm:
-                    frm_point = h.point
+
+        mode = read_fetch_mode(node, max_slots_behind)
+        if mode == BULK_SYNC and registry is not None:
+            # skip blocks another peer already has in flight; claim a
+            # bounded contiguous batch starting at our first fetchable
+            start = 0
+            while start < len(missing) and not registry.claim(
+                missing[start].hash_, peer_name
+            ):
+                start += 1
+            if start == len(missing):
+                # everything in flight elsewhere: wait for it to land
+                yield Sleep(poll_interval)
+                done += 1
+                continue
+            batch = [missing[start]]
+            claimed.append(missing[start].hash_)
+            for h in missing[start + 1 : start + max_fetch_batch]:
+                if not registry.claim(h.hash_, peer_name):
                     break
-            if frm_point is None:
-                for b in node.chain_db.current_chain:
-                    if b.hash_ == frm:
-                        frm_point = b.point
-                        break
-        yield Send(tx, ("request_range", frm_point, missing[-1].point))
+                claimed.append(h.hash_)
+                batch.append(h)
+            first, last = batch[0], batch[-1]
+        else:
+            # deadline mode: latency first — the whole preferred suffix,
+            # even if another peer is fetching the same blocks
+            first, last = missing[0], missing[-1]
+
+        frm_point = _anchor_point_of(node, headers, first)
+        yield Send(tx, ("request_range", frm_point, last.point))
         msg = yield Recv(rx)
         if msg[0] == "no_blocks":
+            _release(registry, claimed)
             yield Sleep(poll_interval)
             done += 1
             continue
@@ -173,6 +289,10 @@ def client(node, peer_name: str, rx, tx, candidate, *, poll_interval: float = 0.
             p = node.chain_db.add_block_async(block)
             if p.result is None:
                 yield Wait(p.processed)
+            if registry is not None:
+                registry.release(block.hash_)
+                if block.hash_ in claimed:
+                    claimed.remove(block.hash_)
             if node.chain_db.get_is_invalid_block(block.hash_) is not None:
                 # InvalidBlockPunishment (ChainSel.hs:1084-1099 +
                 # InvalidBlockPunishment.hs): the peer served a block
@@ -184,4 +304,12 @@ def client(node, peer_name: str, rx, tx, candidate, *, poll_interval: float = 0.
                 # adoption settles candidate prefixes: the ChainSync
                 # history may now trim down to k (HeaderStateHistory)
                 candidate.trim()
+        _release(registry, claimed)
         done += 1
+
+
+def _release(registry, claimed):
+    if registry is not None:
+        for h in claimed:
+            registry.release(h)
+        claimed.clear()

@@ -71,6 +71,11 @@ class NodeKernel:
         self.trace = trace
         self.candidates: dict[str, Candidate] = {}  # per-peer
         self.known_peers: list = []  # PeerSharing registry analog
+        # FetchClientRegistry analog: cross-peer in-flight block claims
+        # for bulk-sync de-duplication (miniprotocol/blockfetch.py)
+        from ..miniprotocol.blockfetch import FetchRegistry
+
+        self.fetch_registry = FetchRegistry()
         # BlockSupportsMetrics consumer (SupportsMetrics.hs): counts fed
         # from a dedicated follower on every adoption
         self.metrics = NodeMetrics()

@@ -1,0 +1,25 @@
+"""Pallas TPU kernels for the Praos crypto hot path (limb-first layout).
+
+Why this package exists (round-3 measurement, scripts/exp_layout3.py):
+the jnp/XLA crypto graphs in ops/ put the 20-limb axis on TPU *lanes*
+(padded to 128) and run every ladder as a `lax.fori_loop` whose
+loop-carried state round-trips HBM each iteration with no cross-
+iteration fusion — a single 64-window scalar ladder costs ~10x its
+component field-muls. Inside a Pallas kernel the whole ladder runs with
+its state in VMEM/registers, and the limb axis sits on *sublanes*
+([NLIMBS, T] with the batch tile T on lanes), so the VPU is fully
+occupied.
+
+Layout convention: every per-lane quantity has the batch-tile axis T
+LAST. Field elements are [20, T] int32 (13-bit limbs, little-endian,
+nearly normalized exactly as ops/field.py); byte strings are [n, T];
+per-lane scalars are [T].
+
+All functions are pure jnp on values, so they run identically inside a
+`pallas_call` kernel (Mosaic), under `interpret=True` (tests on CPU),
+and under plain jit (differential tests against ops/field, ops/curve).
+
+Reference equivalent: same as ops/field.py / ops/curve.py — the
+libsodium fe25519/ge25519 arithmetic reached from the reference hot path
+(Protocol/Praos.hs:543,580,582 via cardano-crypto-{class,praos}).
+"""

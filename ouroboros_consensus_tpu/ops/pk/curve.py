@@ -1,0 +1,311 @@
+"""Limb-first edwards25519 point ops + scalar ladders for Pallas kernels.
+
+Points are extended homogeneous (X, Y, Z, T) coordinates, each [20, T]
+(ops/pk/limbs.py layout). Same unified addition law and mask-lane
+discipline as ops/curve.py; the differences are all mechanical
+consequences of the kernel setting:
+
+  * ladders run `lax.fori_loop`s whose carried point lives in
+    VMEM/registers for the whole walk (inside a Pallas kernel there is
+    no per-iteration HBM round-trip, which is what made the XLA twin
+    ~10x slower than its component muls — scripts/exp_layout3.py);
+  * per-lane window tables are Python lists of 16 points selected by a
+    4-level binary select tree (no gather — Mosaic has no per-lane
+    gather on values);
+  * the SHARED fixed-base tables (s*B) are looked up by one-hot fp32
+    matmuls that Mosaic places on the MXU: entries are 13-bit limbs, so
+    a [2^w, 80] f32 table row contracted with a {0,1} one-hot matrix is
+    exact in f32 (single nonzero term per output).
+
+Reference equivalent: libsodium ge25519 double-scalarmult/scalarmult as
+used by crypto_sign_verify_detached and the vendored ECVRF
+(Protocol/Praos.hs:543,580,582).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+from jax import lax
+from jax import numpy as jnp
+
+from .. import curve as _xc
+from . import limbs as fe
+
+
+class Point(NamedTuple):
+    x: jnp.ndarray  # [20, T]
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+def identity(t: int) -> Point:
+    return Point(fe.zeros(t), fe.ones(t), fe.ones(t), fe.zeros(t))
+
+
+def add(p: Point, q: Point) -> Point:
+    a = fe.mul(fe.sub(p.y, p.x), fe.sub(q.y, q.x))
+    b = fe.mul(fe.add(p.y, p.x), fe.add(q.y, q.x))
+    c = fe.mul(fe.mul_small(fe.mul(p.t, q.t), 2), fe.constant(fe.D_INT))
+    d = fe.mul_small(fe.mul(p.z, q.z), 2)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def double(p: Point) -> Point:
+    a = fe.sqr(p.x)
+    b = fe.sqr(p.y)
+    c = fe.mul_small(fe.sqr(p.z), 2)
+    h = fe.add(a, b)
+    e = fe.sub(h, fe.sqr(fe.add(p.x, p.y)))
+    g = fe.sub(a, b)
+    f = fe.add(c, g)
+    return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def _double_partial(x, y, z):
+    a = fe.sqr(x)
+    b = fe.sqr(y)
+    c = fe.mul_small(fe.sqr(z), 2)
+    h = fe.add(a, b)
+    e = fe.sub(h, fe.sqr(fe.add(x, y)))
+    g = fe.sub(a, b)
+    f = fe.add(c, g)
+    return fe.mul(e, f), fe.mul(g, h), fe.mul(f, g)
+
+
+def doubles(p: Point, k: int) -> Point:
+    """k successive doublings; T materialized only by the last."""
+    x, y, z = p.x, p.y, p.z
+    for _ in range(k - 1):
+        x, y, z = _double_partial(x, y, z)
+    return double(Point(x, y, z, x))  # .t unused by double()
+
+
+def neg(p: Point) -> Point:
+    return Point(fe.neg(p.x), p.y, p.z, fe.neg(p.t))
+
+
+def select(cond, p: Point, q: Point) -> Point:
+    return Point(*(fe.select(cond, a, b) for a, b in zip(p, q)))
+
+
+def mul_cofactor(p: Point) -> Point:
+    return double(double(double(p)))
+
+
+# ---------------------------------------------------------------------------
+# Per-lane window tables (variable base)
+# ---------------------------------------------------------------------------
+
+
+def _build_table16(p: Point) -> list[Point]:
+    """[identity, P, 2P, ..., 15P] — 14 adds at trace time (inside the
+    kernel this is compute, not graph bloat: Mosaic compiles the loop
+    body once per textual op, and the adds all reuse the same code)."""
+    t = p.x.shape[-1]
+    tbl = [identity(t), p]
+    for _ in range(14):
+        tbl.append(add(tbl[-1], p))
+    return tbl
+
+
+def _select16(tbl: list[Point], dw) -> Point:
+    """Binary select tree over 16 table entries by digit dw[T]."""
+    level = tbl
+    for bit in range(4):
+        b = (dw >> bit) & 1
+        level = [
+            select(b == 1, level[2 * i + 1], level[2 * i])
+            for i in range(len(level) // 2)
+        ]
+    return level[0]
+
+
+def _rotate_up(d):
+    """Rotate rows up by one (row 0 to the back) — Mosaic has no
+    dynamic_slice on values, so ladders read row 0 (static) and rotate."""
+    return jnp.concatenate([d[1:], d[:1]], axis=0)
+
+
+def scalar_mul_w4(digits_msb, p: Point) -> Point:
+    """Windowed variable-base mul. digits_msb: [k, T] base-16 digits,
+    MSB-window-first (produced that way at staging — no device-side
+    reverse). The fori carries the digit array and rotates it so each
+    iteration's window is the STATIC row 0."""
+    k = digits_msb.shape[0]
+    t = p.x.shape[-1]
+    tbl = _build_table16(p)
+
+    def body(_, carry):
+        q, d = carry
+        q = doubles(q, 4)
+        q = add(q, _select16(tbl, d[0]))
+        return q, _rotate_up(d)
+
+    q, _ = lax.fori_loop(0, k, body, (identity(t), digits_msb))
+    return q
+
+
+def double_scalar_mul_w4(da_msb, pa: Point, db_msb, pb: Point) -> Point:
+    """a*PA + b*PB, shared doubling chain; len(da) >= len(db) required
+    (the Praos shapes: 64-window s against 32-window c)."""
+    ka, kb = da_msb.shape[0], db_msb.shape[0]
+    assert ka >= kb
+    t = pa.x.shape[-1]
+    ta = _build_table16(pa)
+    tb = _build_table16(pb)
+
+    def body_a(_, carry):
+        q, d = carry
+        q = doubles(q, 4)
+        q = add(q, _select16(ta, d[0]))
+        return q, _rotate_up(d)
+
+    def body_ab(_, carry):
+        q, d1, d2 = carry
+        q = doubles(q, 4)
+        q = add(q, _select16(ta, d1[0]))
+        q = add(q, _select16(tb, d2[0]))
+        return q, _rotate_up(d1), _rotate_up(d2)
+
+    q, da_rot = lax.fori_loop(0, ka - kb, body_a, (identity(t), da_msb))
+    q, _, _ = lax.fori_loop(0, kb, body_ab, (q, da_rot, db_msb))
+    return q
+
+
+# ---------------------------------------------------------------------------
+# Shared fixed-base tables (s*B) via one-hot MXU matmuls
+# ---------------------------------------------------------------------------
+
+
+import jax  # noqa: E402
+
+
+def _build_base8_np() -> np.ndarray:
+    """[32, 160, 256] float32 — transposed flattened (x, y, z, t) limb
+    rows of d * 2^(8w) * B, each 13-bit limb SPLIT into (hi, lo) halves
+    with hi = limb >> 6 (< 128) and lo = limb & 63: the TPU MXU runs f32
+    matmuls through bf16 passes whose 8-bit mantissa cannot represent a
+    13-bit integer, but both halves (and the {0,1} one-hot operand) are
+    exact in bf16, so the split lookup is bit-exact. Rows 0..79 are hi,
+    80..159 lo. Reuses ops/curve's cached host table build."""
+    tbl = _xc._base_table(8)  # [32, 256, 4, 20] int32
+    w, n, _, _ = tbl.shape
+    flat = tbl.reshape(w, n, 80).transpose(0, 2, 1)  # [32, 80, 256]
+    hi = flat >> 6
+    lo = flat & 63
+    return np.ascontiguousarray(
+        np.concatenate([hi, lo], axis=1)
+    ).astype(np.float32)
+
+
+BASE8_NP = _build_base8_np()
+
+# kernel context for the shared table (see limbs.kernel_consts rationale)
+_KCTX: dict = {"base8": None}
+
+
+def kernel_base8(value):
+    class _Ctx:
+        def __enter__(self):
+            _KCTX["base8"] = value
+
+        def __exit__(self, *exc):
+            _KCTX["base8"] = None
+
+    return _Ctx()
+
+
+def _base8():
+    v = _KCTX["base8"]
+    return jnp.asarray(BASE8_NP) if v is None else v
+
+
+def _onehot_lookup(table_w, dw) -> Point:
+    """table_w [160, n] f32 (hi/lo split rows); dw [T] int32 -> Point.
+
+    onehot[n, T] = (iota == dw); hi/lo = table_w @ onehot — one MXU
+    matmul, exact even through bf16 passes (all values < 2^7, one
+    nonzero per output). Recombined as hi*64 + lo in int32.
+    """
+    n = table_w.shape[-1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (n, dw.shape[-1]), 0)
+    onehot = (iota == dw[None, :]).astype(jnp.float32)
+    both = jax.lax.dot_general(
+        table_w, onehot,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)  # [160, T]
+    entry = both[:80] * 64 + both[80:]
+    return Point(entry[0:20], entry[20:40], entry[40:60], entry[60:80])
+
+
+def base_mul_w8(digits_lsb) -> Point:
+    """s*B from base-256 digits [32, T] (LSB-window-first, matching the
+    table's window order)."""
+    tbl = _base8()
+    t = digits_lsb.shape[-1]
+    q = identity(t)
+    for w in range(tbl.shape[0]):
+        dw = digits_lsb[w]
+        q = add(q, _onehot_lookup(tbl[w], dw))
+    return q
+
+
+# ---------------------------------------------------------------------------
+# Encoding
+# ---------------------------------------------------------------------------
+
+
+def decompress(b32) -> tuple[jnp.ndarray, Point]:
+    """[32, T] bytes -> (ok[T], Point). Same rejection rules as
+    ops/curve.decompress (non-canonical y, non-residue, x=0 w/ sign)."""
+    b32 = b32.astype(jnp.int32)
+    sign = (b32[31] >> 7) & 1
+    y_bytes = jnp.concatenate([b32[:31], (b32[31] & 0x7F)[None]], axis=0)
+    y = fe.from_bytes32(y_bytes)
+    p_col = jnp.broadcast_to(fe.p_col(), y.shape)
+    y_ok = ~fe.geq_limbs(y, p_col)
+    t = b32.shape[-1]
+    one = fe.ones(t)
+    y2 = fe.sqr(y)
+    num = fe.sub(y2, one)
+    den = fe.add(fe.mul(y2, fe.constant(fe.D_INT)), one)
+    ok_sqrt, x = fe.sqrt_ratio(num, den)
+    x_zero = fe.is_zero(x)
+    flip = (fe.parity(x) != sign) & ~x_zero
+    x = fe.select(flip, fe.neg(x), x)
+    ok = y_ok & ok_sqrt & ~(x_zero & (sign == 1))
+    return ok, Point(x, y, one, fe.mul(x, y))
+
+
+def compress_many(points: list[Point]) -> list[jnp.ndarray]:
+    """Compress k points sharing ONE inversion (Montgomery's trick);
+    returns [32, T] byte arrays."""
+    zs = [p.z for p in points]
+    prefix = [zs[0]]
+    for z in zs[1:]:
+        prefix.append(fe.mul(prefix[-1], z))
+    acc = fe.inv(prefix[-1])
+    invs: list = [None] * len(zs)
+    for i in range(len(zs) - 1, 0, -1):
+        invs[i] = fe.mul(acc, prefix[i - 1])
+        acc = fe.mul(acc, zs[i])
+    invs[0] = acc
+    outs = []
+    for p, zi in zip(points, invs):
+        x = fe.canonical(fe.mul(p.x, zi))
+        b = fe.to_bytes(fe.mul(p.y, zi))
+        top = b[31] + ((x[0] & 1) << 7)
+        outs.append(jnp.concatenate([b[:31], top[None]], axis=0))
+    return outs
+
+
+def compress(p: Point) -> jnp.ndarray:
+    return compress_many([p])[0]

@@ -1,0 +1,299 @@
+"""Limb-first SHA-512 + Blake2b for Pallas kernels.
+
+Byte strings are [n, T] int32 (values 0..255), T = batch tile on lanes.
+64-bit words are (hi, lo) pairs of uint32 [T] arrays, exactly as
+ops/u64.py, but kept as Python tuples/lists so every round is straight-
+line code over [T] vectors — inside a Pallas kernel the whole message
+schedule lives in registers/VMEM.
+
+The rounds are Python-unrolled (80 for SHA-512, 12 for Blake2b) ON TPU:
+Mosaic compiles the straight-line body quickly, and unrolling makes
+every SIGMA message permutation and round constant STATIC — no gathers.
+On CPU the same public functions delegate to the rolled XLA twins
+(ops/sha512.py, ops/blake2b.py) through layout adapters, because
+XLA:CPU's LLVM pipeline takes minutes on the unrolled HLO — the exact
+pathology those twins were built to avoid. Both paths are byte-exact
+(differentially tested against hashlib).
+
+Reference equivalent: libsodium SHA-512 / Blake2b as used by Ed25519,
+the vendored ECVRF, and CompactSum KES (see ops/sha512.py docstring).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from jax import numpy as jnp
+
+from .. import blake2b as _xb
+from .. import sha512 as _xs
+from .. import u64
+from ..blake2b import _SIGMA, IV as _B2B_IV
+from ..sha512 import H0 as _SHA_H0, K as _SHA_K
+
+BLOCK = 128
+
+# "tpu" -> unrolled limb-first rounds; anything else -> rolled XLA twins
+# via layout adapters. Overridable for testing the unrolled path on CPU.
+FORCE_IMPL = os.environ.get("OCT_PK_HASH_IMPL", "")
+
+
+def _unrolled() -> bool:
+    if FORCE_IMPL:
+        return FORCE_IMPL == "unrolled"
+    import jax
+
+    return jax.devices()[0].platform == "tpu"
+
+
+def const_rows(vals, t):
+    """[len(vals), t] int32 built from scalar-immediate fills — kernels
+    cannot close over array constants, and Mosaic cannot broadcast
+    column vectors, but scalar->vector fills are native."""
+    return jnp.stack([jnp.full((t,), int(v), jnp.int32) for v in vals], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Bytes [128, T] -> 16 (hi, lo) word pairs
+# ---------------------------------------------------------------------------
+
+
+def _words_be(block_bytes):
+    """[128, T] bytes -> list of 16 (hi, lo) uint32 [T] pairs (big-endian,
+    SHA-512 order)."""
+    b = block_bytes.astype(jnp.uint32)
+    words = []
+    for w in range(16):
+        o = 8 * w
+        hi = (b[o] << 24) | (b[o + 1] << 16) | (b[o + 2] << 8) | b[o + 3]
+        lo = (b[o + 4] << 24) | (b[o + 5] << 16) | (b[o + 6] << 8) | b[o + 7]
+        words.append((hi, lo))
+    return words
+
+
+def _words_le(block_bytes):
+    """[128, T] bytes -> 16 (hi, lo) pairs (little-endian, Blake2b order)."""
+    b = block_bytes.astype(jnp.uint32)
+    words = []
+    for w in range(16):
+        o = 8 * w
+        lo = b[o] | (b[o + 1] << 8) | (b[o + 2] << 16) | (b[o + 3] << 24)
+        hi = b[o + 4] | (b[o + 5] << 8) | (b[o + 6] << 16) | (b[o + 7] << 24)
+        words.append((hi, lo))
+    return words
+
+
+def _digest_bytes_be(words):
+    """8 (hi, lo) pairs -> [64, T] int32 bytes (SHA-512 digest order)."""
+    rows = []
+    for h, l in words:
+        for p in (h >> 24, h >> 16, h >> 8, h, l >> 24, l >> 16, l >> 8, l):
+            rows.append((p & jnp.uint32(0xFF)).astype(jnp.int32))
+    return jnp.stack(rows, axis=0)
+
+
+def _digest_bytes_le(words, nbytes: int):
+    """(hi, lo) pairs -> [nbytes, T] int32 bytes (Blake2b digest order)."""
+    rows = []
+    for h, l in words:
+        for p in (l, l >> 8, l >> 16, l >> 24, h, h >> 8, h >> 16, h >> 24):
+            rows.append((p & jnp.uint32(0xFF)).astype(jnp.int32))
+    return jnp.stack(rows[:nbytes], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# SHA-512
+# ---------------------------------------------------------------------------
+
+
+def _bsig0(x):
+    return u64.xor(u64.xor(u64.rotr(x, 28), u64.rotr(x, 34)), u64.rotr(x, 39))
+
+
+def _bsig1(x):
+    return u64.xor(u64.xor(u64.rotr(x, 14), u64.rotr(x, 18)), u64.rotr(x, 41))
+
+
+def _ssig0(x):
+    return u64.xor(u64.xor(u64.rotr(x, 1), u64.rotr(x, 8)), u64.shr(x, 7))
+
+
+def _ssig1(x):
+    return u64.xor(u64.xor(u64.rotr(x, 19), u64.rotr(x, 61)), u64.shr(x, 6))
+
+
+_K_PAIRS = [(int(h), int(l)) for h, l in np.asarray(_SHA_K)]
+_H0_PAIRS = [(int(h), int(l)) for h, l in np.asarray(_SHA_H0)]
+_B2B_IV_PAIRS = [(int(h), int(l)) for h, l in np.asarray(_B2B_IV)]
+
+
+def sha512_compress(state, block_bytes):
+    """One compression. state: list of 8 (hi, lo) pairs; block [128, T]."""
+    w = _words_be(block_bytes)
+    a, b, c, d, e, f, g, h = state
+    for t in range(80):
+        if t >= 16:
+            wn = u64.add_many(
+                _ssig1(w[t - 2]), w[t - 7], _ssig0(w[t - 15]), w[t - 16]
+            )
+            w.append(wn)
+        kt = (jnp.uint32(_K_PAIRS[t][0]), jnp.uint32(_K_PAIRS[t][1]))
+        ch = u64.xor(u64.and_(e, f), u64.and_(u64.not_(e), g))
+        maj = u64.xor(u64.xor(u64.and_(a, b), u64.and_(a, c)), u64.and_(b, c))
+        t1 = u64.add_many(h, _bsig1(e), ch, kt, w[t])
+        t2 = u64.add(_bsig0(a), maj)
+        h, g, f, e, d, c, b, a = g, f, e, u64.add(d, t1), c, b, a, u64.add(t1, t2)
+    out = []
+    for s0, s1 in zip(state, (a, b, c, d, e, f, g, h)):
+        out.append(u64.add(s0, s1))
+    return out
+
+
+def _sha512_fixed_unrolled(data, length: int | None = None):
+    """SHA-512 of [n, T] byte arrays with STATIC common length -> [64, T].
+
+    Padding is compile-time; n <= 2*BLOCK-17 supported (1 or 2 blocks),
+    which covers every fixed-shape hash in the Praos path (66/130-byte
+    ECVRF inputs)."""
+    n = data.shape[0] if length is None else length
+    t = data.shape[-1]
+    nb = (n + 1 + 16 + BLOCK - 1) // BLOCK
+    pad_len = nb * BLOCK - n
+    tail = [0] * pad_len
+    tail[0] = 0x80
+    for i, byte in enumerate((8 * n).to_bytes(16, "big")):
+        tail[pad_len - 16 + i] = byte
+    padded = jnp.concatenate(
+        [data.astype(jnp.int32), const_rows(tail, t)], axis=0
+    )
+    state = [
+        (jnp.full((t,), p[0], jnp.uint32), jnp.full((t,), p[1], jnp.uint32))
+        for p in _H0_PAIRS
+    ]
+    for i in range(nb):
+        state = sha512_compress(state, padded[i * BLOCK : (i + 1) * BLOCK])
+    return _digest_bytes_be(state)
+
+
+def _sha512_var_unrolled(blocks_bytes, nblocks):
+    """SHA-512 over pre-padded blocks with PER-LANE block counts.
+
+    blocks_bytes: [NB, 128, T] int32 (host-staged standard padding);
+    nblocks: [T] int32. Lanes with fewer blocks mask later updates."""
+    nb = blocks_bytes.shape[0]
+    t = blocks_bytes.shape[-1]
+    state = [
+        (jnp.full((t,), p[0], jnp.uint32), jnp.full((t,), p[1], jnp.uint32))
+        for p in _H0_PAIRS
+    ]
+    for i in range(nb):
+        nxt = sha512_compress(state, blocks_bytes[i])
+        if i == 0:
+            state = nxt
+        else:
+            active = i < nblocks
+            state = [
+                (jnp.where(active, nh, sh), jnp.where(active, nl, sl))
+                for (nh, nl), (sh, sl) in zip(nxt, state)
+            ]
+    return _digest_bytes_be(state)
+
+
+# ---------------------------------------------------------------------------
+# Blake2b
+# ---------------------------------------------------------------------------
+
+
+def blake2b_compress(state, block_bytes, t_bytes, is_final):
+    """state: 8 pairs; block [128, T]; t_bytes [T] int32; is_final bool[T]."""
+    m = _words_le(block_bytes)
+    t = block_bytes.shape[-1]
+    v = list(state) + [
+        (jnp.full((t,), p[0], jnp.uint32), jnp.full((t,), p[1], jnp.uint32))
+        for p in _B2B_IV_PAIRS
+    ]
+    v[12] = (v[12][0], v[12][1] ^ t_bytes.astype(jnp.uint32))
+    fmask = jnp.where(is_final, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    v[14] = (v[14][0] ^ fmask, v[14][1] ^ fmask)
+
+    def g(a, b, c, d, x, y):
+        v[a] = u64.add_many(v[a], v[b], x)
+        v[d] = u64.rotr(u64.xor(v[d], v[a]), 32)
+        v[c] = u64.add(v[c], v[d])
+        v[b] = u64.rotr(u64.xor(v[b], v[c]), 24)
+        v[a] = u64.add_many(v[a], v[b], y)
+        v[d] = u64.rotr(u64.xor(v[d], v[a]), 16)
+        v[c] = u64.add(v[c], v[d])
+        v[b] = u64.rotr(u64.xor(v[b], v[c]), 63)
+
+    for r in range(12):
+        s = _SIGMA[r % 10]
+        g(0, 4, 8, 12, m[s[0]], m[s[1]])
+        g(1, 5, 9, 13, m[s[2]], m[s[3]])
+        g(2, 6, 10, 14, m[s[4]], m[s[5]])
+        g(3, 7, 11, 15, m[s[6]], m[s[7]])
+        g(0, 5, 10, 15, m[s[8]], m[s[9]])
+        g(1, 6, 11, 12, m[s[10]], m[s[11]])
+        g(2, 7, 8, 13, m[s[12]], m[s[13]])
+        g(3, 4, 9, 14, m[s[14]], m[s[15]])
+    return [
+        (sh ^ v[i][0] ^ v[i + 8][0], sl ^ v[i][1] ^ v[i + 8][1])
+        for i, (sh, sl) in enumerate(state)
+    ]
+
+
+def _b2b_init(t: int, digest_size: int):
+    state = []
+    for i, p in enumerate(_B2B_IV_PAIRS):
+        hi, lo = p
+        if i == 0:
+            lo = lo ^ (0x01010000 ^ digest_size)
+        state.append((jnp.full((t,), hi, jnp.uint32), jnp.full((t,), lo, jnp.uint32)))
+    return state
+
+
+def _blake2b_fixed_unrolled(data, data_len: int, digest_size: int = 32):
+    """Single-block Blake2b of [n, T] bytes, STATIC length <= 128."""
+    assert 0 < data_len <= BLOCK
+    t = data.shape[-1]
+    pad = BLOCK - data.shape[0]
+    if pad:
+        data = jnp.concatenate(
+            [data.astype(jnp.int32), jnp.zeros((pad, t), jnp.int32)], axis=0
+        )
+    state = _b2b_init(t, digest_size)
+    tb = jnp.full((t,), data_len, jnp.int32)
+    fin = jnp.full((t,), True)
+    state = blake2b_compress(state, data, tb, fin)
+    return _digest_bytes_le(state, digest_size)
+
+
+# ---------------------------------------------------------------------------
+# Public dispatchers (unrolled on TPU, rolled XLA twins elsewhere)
+# ---------------------------------------------------------------------------
+
+
+def sha512_fixed(data, length: int | None = None):
+    """SHA-512 of [n, T] byte arrays with STATIC common length -> [64, T]."""
+    if _unrolled():
+        return _sha512_fixed_unrolled(data, length)
+    return jnp.transpose(_xs.sha512_fixed(jnp.transpose(data)))
+
+
+def sha512_var(blocks_bytes, nblocks):
+    """SHA-512 over pre-padded [NB, 128, T] blocks, per-lane counts [T]."""
+    if _unrolled():
+        return _sha512_var_unrolled(blocks_bytes, nblocks)
+    bm = jnp.moveaxis(blocks_bytes.astype(jnp.int32), -1, 0)  # [T, NB, 128]
+    words = _xs.bytes_to_blocks(bm)  # [T, NB, 16, 2]
+    return jnp.transpose(_xs.sha512(words, nblocks))
+
+
+def blake2b_fixed(data, data_len: int, digest_size: int = 32):
+    """Single-block Blake2b of [n, T] bytes, STATIC length <= 128."""
+    if _unrolled():
+        return _blake2b_fixed_unrolled(data, data_len, digest_size)
+    return jnp.transpose(
+        _xb.blake2b_fixed(jnp.transpose(data), data_len, digest_size)
+    )

@@ -1,0 +1,234 @@
+"""Pallas TPU kernel wrappers for the Praos verifier cores.
+
+Each stage of ops/pk/verify.py runs as ONE `pallas_call` with a 1-D grid
+over batch tiles: inputs arrive [*, B] (limb-first), each program sees a
+[*, TILE] block in VMEM and runs the full core — ladders, hash rounds,
+inversion chains — with every intermediate in VMEM/registers. The four
+stages chain inside a single jit, so a verification batch is one host
+dispatch regardless of tile count.
+
+Kernels cannot close over array constants (jax requires them as
+inputs): small field/Barrett constants are materialized inside the
+kernel from Python-int scalar fills (limbs.kernel_consts), and the one
+genuinely large constant — the [32, 80, 256] f32 fixed-base table
+(curve.BASE8_NP) — is passed as a grid-invariant VMEM input where
+fixed-base muls occur (curve.kernel_base8).
+
+On non-TPU backends the same kernels run under `interpret=True`
+(functionally identical, used by the CPU test suite), so correctness is
+established once by the differential tests for both execution modes.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import numpy as np
+from jax import numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import curve as pc
+from . import limbs as fe
+from . import verify as pv
+
+TILE = int(os.environ.get("OCT_PK_TILE", "256"))
+
+_BASE8_SHAPE = pc.BASE8_NP.shape  # [32, 80, 256] f32
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _tile_spec(shape_prefix, tile):
+    """BlockSpec for an array [*shape_prefix, B] tiled on the last axis."""
+    nd = len(shape_prefix)
+    return pl.BlockSpec(
+        (*shape_prefix, tile),
+        lambda i, _nd=nd: (*(0,) * _nd, i),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _full_spec(shape):
+    """BlockSpec for a grid-invariant input (consts pack, base table)."""
+    nd = len(shape)
+    return pl.BlockSpec(
+        tuple(shape), lambda i, _nd=nd: (0,) * _nd, memory_space=pltpu.VMEM
+    )
+
+
+def _call(kernel, b, in_prefixes, out_prefixes, args, with_base8: bool):
+    tile = min(TILE, b)
+    assert b % tile == 0
+    const_args = []
+    const_specs = []
+    if with_base8:
+        const_args.append(jnp.asarray(pc.BASE8_NP))
+        const_specs.append(_full_spec(_BASE8_SHAPE))
+    return pl.pallas_call(
+        kernel,
+        grid=(b // tile,),
+        in_specs=const_specs + [_tile_spec(p, tile) for p in in_prefixes],
+        out_specs=tuple(_tile_spec(p, tile) for p in out_prefixes),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((*p, b), jnp.int32) for p in out_prefixes
+        ),
+        interpret=_interpret(),
+    )(*const_args, *args)
+
+
+# ---------------------------------------------------------------------------
+# Stage kernels
+# ---------------------------------------------------------------------------
+
+
+def _ed_kernel(base8_ref, pk_ref, s_ref, hb_ref, hnb_ref, ok_ref, pt_ref):
+    tile = pk_ref.shape[-1]
+    with fe.kernel_consts(tile), pc.kernel_base8(base8_ref[:]):
+        ok, p = pv.ed_core(pk_ref[:], s_ref[:], hb_ref[:], hnb_ref[:][0])
+        ok_ref[:] = ok.astype(jnp.int32)[None, :]
+        pt_ref[:] = jnp.concatenate([p.x, p.y, p.z, p.t], axis=0)
+
+
+def ed_points(pk, s, hblocks, hnblocks):
+    """pk, s: [32, B]; hblocks [NB, 128, B]; hnblocks [1, B] ->
+    (ok [1, B] int32, point [80, B] int32)."""
+    nb = hblocks.shape[0]
+    b = pk.shape[-1]
+    return _call(
+        _ed_kernel, b,
+        [(32,), (32,), (nb, 128), (1,)],
+        [(1,), (80,)],
+        (pk, s, hblocks, hnblocks),
+        with_base8=True,
+    )
+
+
+def _kes_kernel(depth, base8_ref, vk_ref, per_ref, s_ref,
+                leaf_ref, sib_ref, hb_ref, hnb_ref, ok_ref, pt_ref):
+    tile = vk_ref.shape[-1]
+    with fe.kernel_consts(tile), pc.kernel_base8(base8_ref[:]):
+        ok, p = pv.kes_core(
+            vk_ref[:], per_ref[:][0], s_ref[:], leaf_ref[:], sib_ref[:],
+            hb_ref[:], hnb_ref[:][0], depth,
+        )
+        ok_ref[:] = ok.astype(jnp.int32)[None, :]
+        pt_ref[:] = jnp.concatenate([p.x, p.y, p.z, p.t], axis=0)
+
+
+def kes_points(vk, period, s, vk_leaf, siblings, hblocks, hnblocks, depth):
+    nb = hblocks.shape[0]
+    b = vk.shape[-1]
+    return _call(
+        functools.partial(_kes_kernel, depth), b,
+        [(32,), (1,), (32,), (32,), (depth, 32), (nb, 128), (1,)],
+        [(1,), (80,)],
+        (vk, period, s, vk_leaf, siblings, hblocks, hnblocks),
+        with_base8=True,
+    )
+
+
+def _vrf_kernel(base8_ref, pk_ref, g_ref, c_ref, s_ref, al_ref,
+                ok_ref, pts_ref):
+    tile = pk_ref.shape[-1]
+    with fe.kernel_consts(tile), pc.kernel_base8(base8_ref[:]):
+        ok, pts = pv.vrf_core(
+            pk_ref[:], g_ref[:], c_ref[:], s_ref[:], al_ref[:]
+        )
+        ok_ref[:] = ok.astype(jnp.int32)[None, :]
+        pts_ref[:] = jnp.concatenate(
+            [jnp.concatenate([p.x, p.y, p.z, p.t], axis=0) for p in pts],
+            axis=0,
+        )
+
+
+def vrf_points(pk, gamma, c, s, alpha):
+    b = pk.shape[-1]
+    return _call(
+        _vrf_kernel, b,
+        [(32,), (32,), (16,), (32,), (32,)],
+        [(1,), (400,)],
+        (pk, gamma, c, s, alpha),
+        with_base8=True,
+    )
+
+
+def _unstack_point(flat):
+    return pc.Point(flat[0:20], flat[20:40], flat[40:60], flat[60:80])
+
+
+def _finish_kernel(edok_ref, edpt_ref, edr_ref, kesok_ref,
+                   kespt_ref, kesr_ref, vrfok_ref, vrfpts_ref, c_ref,
+                   beta_ref, tlo_ref, thi_ref, out_ref, eta_ref, lv_ref):
+    tile = c_ref.shape[-1]
+    with fe.kernel_consts(tile):
+        vrf_flat = vrfpts_ref[:]
+        pts = [_unstack_point(vrf_flat[80 * i : 80 * (i + 1)]) for i in range(5)]
+        v = pv.finish_core(
+            edok_ref[:][0] != 0, _unstack_point(edpt_ref[:]), edr_ref[:],
+            kesok_ref[:][0] != 0, _unstack_point(kespt_ref[:]), kesr_ref[:],
+            vrfok_ref[:][0] != 0, pts, c_ref[:],
+            beta_ref[:], tlo_ref[:], thi_ref[:],
+        )
+        out_ref[:] = jnp.stack(
+            [
+                v.ok_ocert_sig.astype(jnp.int32),
+                v.ok_kes_sig.astype(jnp.int32),
+                v.ok_vrf.astype(jnp.int32),
+                v.ok_leader.astype(jnp.int32),
+                v.leader_ambiguous.astype(jnp.int32),
+            ],
+            axis=0,
+        )
+        eta_ref[:] = v.eta
+        lv_ref[:] = v.leader_value
+
+
+def finish(ed_ok, ed_pt, ed_r, kes_ok, kes_pt, kes_r, vrf_ok, vrf_pts,
+           c, beta_decl, thr_lo, thr_hi):
+    b = c.shape[-1]
+    return _call(
+        _finish_kernel, b,
+        [(1,), (80,), (32,), (1,), (80,), (32,), (1,), (400,), (16,),
+         (64,), (32,), (32,)],
+        [(5,), (32,), (32,)],
+        (ed_ok, ed_pt, ed_r, kes_ok, kes_pt, kes_r, vrf_ok, vrf_pts,
+         c, beta_decl, thr_lo, thr_hi),
+        with_base8=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused driver (one jit = one host dispatch)
+# ---------------------------------------------------------------------------
+
+
+def verify_praos_tiles(
+    ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
+    kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+    kes_hblocks, kes_hnblocks,
+    vrf_pk, vrf_gamma, vrf_c, vrf_s, vrf_alpha,
+    beta_decl, thr_lo, thr_hi,
+    *, kes_depth: int,
+):
+    """All inputs limb-first ([*, B], B a multiple of the tile) ->
+    (verdicts [5, B] int32, eta [32, B], leader_value [32, B]).
+
+    Verdict rows: ok_ocert_sig, ok_kes_sig, ok_vrf, ok_leader,
+    leader_ambiguous — protocol/batch._pk_materialize re-wraps them into
+    the Verdicts the sequential epilogue consumes.
+    """
+    ed_ok, ed_pt = ed_points(ed_pk, ed_s, ed_hblocks, ed_hnblocks)
+    kes_ok, kes_pt = kes_points(
+        kes_vk, kes_period, kes_s, kes_vk_leaf, kes_siblings,
+        kes_hblocks, kes_hnblocks, kes_depth,
+    )
+    vrf_ok, vrf_pts = vrf_points(vrf_pk, vrf_gamma, vrf_c, vrf_s, vrf_alpha)
+    return finish(
+        ed_ok, ed_pt, ed_r, kes_ok, kes_pt, kes_r, vrf_ok, vrf_pts,
+        vrf_c, beta_decl, thr_lo, thr_hi,
+    )

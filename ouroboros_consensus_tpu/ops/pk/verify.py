@@ -1,0 +1,228 @@
+"""Limb-first Praos verifier cores (pure jnp; run inside Pallas kernels).
+
+The four stages mirror the fused XLA path (protocol/batch.verify_praos):
+
+  ed_core     — Ed25519 verify-point of the OCert cold-key signature
+                (Praos.hs:580): P = s·B − h·A, compression deferred.
+  kes_core    — CompactSum KES leaf verify-point + Merkle root walk
+                (Praos.hs:582).
+  vrf_core    — ECVRF-ED25519-SHA512-Elligator2 draft-03 points
+                (Praos.hs:543): H, Γ, U = s·B − c·Y, V = s·H − c·Γ, 8Γ.
+  finish_core — ONE shared Montgomery inversion compresses all 7 points,
+                then the ECVRF challenge/beta hashes, the R-byte
+                compare-on-bytes checks, Blake2b leader/nonce range
+                extensions (Praos/VRF.hs:103,116) and the bracketed
+                leader-threshold compare.
+
+Layout: batch tile T last everywhere (bytes [n, T] int32, points
+[20, T] limb coordinates). All control flow is batch-uniform; failures
+are mask lanes. Differentially tested against the host verifiers and
+the XLA twins in tests/test_pk_verify.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+from jax import numpy as jnp
+
+from ..host import ed25519 as he
+from . import curve as pc
+from . import hashes as ph
+from . import limbs as fe
+
+SUITE = 0x04
+
+
+# ---------------------------------------------------------------------------
+# Ed25519
+# ---------------------------------------------------------------------------
+
+
+def ed_core(pk, s, hblocks, hnblocks):
+    """(ok_pre[T], Point): P = s·B − h·A with h = SHA-512(R‖A‖M) mod L.
+
+    pk, s: [32, T] bytes; hblocks: [NB, 128, T] padded bytes; hnblocks [T].
+    """
+    ok_a, a_pt = pc.decompress(pk)
+    s_ok = fe.is_canonical_scalar(s)
+    digest = ph.sha512_var(hblocks, hnblocks)
+    h = fe.reduce512(digest)
+    sb = pc.base_mul_w8(fe.windows8_from_bytes(s, 256))
+    h_digits = fe.windows4_from_limbs(h, 256, msb_first=True)
+    nha = pc.scalar_mul_w4(h_digits, pc.neg(a_pt))
+    return ok_a & s_ok, pc.add(sb, nha)
+
+
+# ---------------------------------------------------------------------------
+# KES (CompactSum)
+# ---------------------------------------------------------------------------
+
+
+def kes_merkle_ok(vk, period, vk_leaf, siblings, depth: int):
+    """Bottom-up CompactSum root reconstruction; bit i of the period
+    selects H(vk ‖ sib) vs H(sib ‖ vk)."""
+    cur = vk_leaf.astype(jnp.int32)
+    for i in range(depth):
+        sib = siblings[i]
+        bit = (period >> i) & 1
+        left = jnp.concatenate([cur, sib], axis=0)
+        right = jnp.concatenate([sib, cur], axis=0)
+        data = jnp.where((bit == 1)[None, :], right, left)
+        cur = ph.blake2b_fixed(data, 64, 32)
+    return jnp.all(cur == vk, axis=0)
+
+
+def kes_core(vk, period, s, vk_leaf, siblings, hblocks, hnblocks, depth: int):
+    """(ok_pre[T], Point) — leaf Ed25519 verify-point + root + period
+    window check. siblings: [depth, 32, T]."""
+    ok_ed, p = ed_core(vk_leaf, s, hblocks, hnblocks)
+    root_ok = kes_merkle_ok(vk, period, vk_leaf, siblings, depth)
+    period_ok = (period >= 0) & (period < (1 << depth))
+    return ok_ed & root_ok & period_ok, p
+
+
+# ---------------------------------------------------------------------------
+# ECVRF (draft-03)
+# ---------------------------------------------------------------------------
+
+
+def elligator2(r):
+    """[20, T] field element -> Point (even-x convention, matching
+    ops/host/ecvrf.elligator2)."""
+    t = r.shape[-1]
+    one = fe.ones(t)
+    mont_a = fe.constant(he.MONT_A)
+    denom = fe.add(fe.mul_small(fe.sqr(r), 2), one)
+    denom = fe.select(fe.is_zero(denom), one, denom)
+    u1 = fe.mul(fe.neg(mont_a), fe.inv(denom))
+    w1 = fe.mul(u1, fe.add(fe.mul(fe.add(u1, mont_a), u1), one))
+    is_sq = fe.eq(fe.legendre(w1), one) | fe.is_zero(w1)
+    u2 = fe.sub(fe.neg(u1), mont_a)
+    u = fe.select(is_sq, u1, u2)
+    w = fe.mul(u, fe.add(fe.mul(fe.add(u, mont_a), u), one))
+    _, v = fe.sqrt(w)
+    x = fe.mul(fe.mul(fe.constant(he.SQRT_M486664), u), fe.inv(v))
+    y = fe.mul(fe.sub(u, one), fe.inv(fe.add(u, one)))
+    x = fe.select(fe.parity(x) == 1, fe.neg(x), x)
+    return pc.Point(x, y, one, fe.mul(x, y))
+
+
+def hash_to_curve(pk_bytes, alpha_bytes):
+    """H = 8 * Elligator2(SHA-512(suite ‖ 1 ‖ pk ‖ alpha) mod 2^255)."""
+    t = pk_bytes.shape[-1]
+    prefix = ph.const_rows([SUITE, 0x01], t)
+    data = jnp.concatenate([prefix, pk_bytes, alpha_bytes], axis=0)  # [66, T]
+    digest = ph.sha512_fixed(data)
+    r32 = jnp.concatenate(
+        [digest[:31], (digest[31] & 0x7F)[None]], axis=0
+    )
+    r = fe.canonical(fe.from_bytes32(r32))
+    return pc.mul_cofactor(elligator2(r))
+
+
+def vrf_core(pk, gamma, c, s, alpha):
+    """(ok_pre[T], (H, Γ, U, V, 8Γ)) — points left uncompressed for the
+    shared inversion in finish_core. c: [16, T]; others [32, T]."""
+    ok_y, y_pt = pc.decompress(pk)
+    ok_g, g_pt = pc.decompress(gamma)
+    s_ok = fe.is_canonical_scalar(s)
+
+    h_pt = hash_to_curve(pk, alpha)
+
+    s_digits = fe.windows4_from_bytes(s, 256, msb_first=True)
+    c_digits = fe.windows4_from_bytes(c, 128, msb_first=True)
+
+    sb = pc.base_mul_w8(fe.windows8_from_bytes(s, 256))
+    u_pt = pc.add(sb, pc.scalar_mul_w4(c_digits, pc.neg(y_pt)))
+    v_pt = pc.double_scalar_mul_w4(s_digits, h_pt, c_digits, pc.neg(g_pt))
+    g8 = pc.mul_cofactor(g_pt)
+    return ok_y & ok_g & s_ok, (h_pt, g_pt, u_pt, v_pt, g8)
+
+
+# ---------------------------------------------------------------------------
+# Finish: shared compression + challenge/beta + leader checks
+# ---------------------------------------------------------------------------
+
+
+class CoreVerdicts(NamedTuple):
+    ok_ocert_sig: jnp.ndarray  # [T] bool
+    ok_kes_sig: jnp.ndarray
+    ok_vrf: jnp.ndarray
+    ok_leader: jnp.ndarray
+    leader_ambiguous: jnp.ndarray
+    eta: jnp.ndarray  # [32, T] int32 bytes
+    leader_value: jnp.ndarray  # [32, T] int32 bytes (big-endian value)
+
+
+def _lt_be(a, b):
+    """Big-endian lexicographic a < b over [32, T] byte arrays -> bool[T]."""
+    lt = jnp.zeros_like(a[0], dtype=bool)
+    gt = jnp.zeros_like(lt)
+    for i in range(a.shape[0]):
+        lt = lt | (~gt & (a[i] < b[i]))
+        gt = gt | (~lt & (a[i] > b[i]))
+    return lt
+
+
+def finish_core(
+    ok_ed_pre, ed_point, ed_r,
+    ok_kes_pre, kes_point, kes_r,
+    ok_vrf_pre, vrf_points, c,
+    beta_decl, thr_lo, thr_hi,
+):
+    """All byte arrays [n, T] int32; points limb-first."""
+    t = c.shape[-1]
+    encs = pc.compress_many([ed_point, kes_point, *vrf_points])
+    ok_ed = ok_ed_pre & jnp.all(encs[0] == ed_r.astype(jnp.int32), axis=0)
+    ok_kes = ok_kes_pre & jnp.all(encs[1] == kes_r.astype(jnp.int32), axis=0)
+
+    h_enc, gamma_enc, u_enc, v_enc, g8_enc = encs[2:]
+    p2 = ph.const_rows([SUITE, 0x02], t)
+    cdata = jnp.concatenate([p2, h_enc, gamma_enc, u_enc, v_enc], axis=0)
+    c_prime = ph.sha512_fixed(cdata)[:16]
+    p3 = ph.const_rows([SUITE, 0x03], t)
+    beta = ph.sha512_fixed(jnp.concatenate([p3, g8_enc], axis=0))
+
+    c = c.astype(jnp.int32)
+    beta_decl = beta_decl.astype(jnp.int32)
+    ok_proof = ok_vrf_pre & jnp.all(c_prime == c, axis=0)
+    ok_vrf = ok_proof & jnp.all(beta == beta_decl, axis=0)
+
+    tag_l = ph.const_rows([ord("L")], t)
+    lv = ph.blake2b_fixed(jnp.concatenate([tag_l, beta_decl], axis=0), 65, 32)
+    tag_n = ph.const_rows([ord("N")], t)
+    eta1 = ph.blake2b_fixed(jnp.concatenate([tag_n, beta_decl], axis=0), 65, 32)
+    eta = ph.blake2b_fixed(eta1, 32, 32)
+
+    thr_lo = thr_lo.astype(jnp.int32)
+    thr_hi = thr_hi.astype(jnp.int32)
+    certain_win = _lt_be(lv, thr_lo)
+    certain_loss = ~_lt_be(lv, thr_hi)
+    ambiguous = ~certain_win & ~certain_loss
+    return CoreVerdicts(ok_ed, ok_kes, ok_vrf, certain_win, ambiguous, eta, lv)
+
+
+def verify_praos_core(
+    ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
+    kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+    kes_hblocks, kes_hnblocks,
+    vrf_pk, vrf_gamma, vrf_c, vrf_s, vrf_alpha,
+    beta_decl, thr_lo, thr_hi,
+    *, kes_depth: int,
+) -> CoreVerdicts:
+    """The whole fused hot path over one tile (argument order mirrors
+    protocol/batch.verify_praos, transposed to limb-first layout)."""
+    ok_ed_pre, ed_point = ed_core(ed_pk, ed_s, ed_hblocks, ed_hnblocks)
+    ok_kes_pre, kes_point = kes_core(
+        kes_vk, kes_period, kes_s, kes_vk_leaf, kes_siblings,
+        kes_hblocks, kes_hnblocks, kes_depth,
+    )
+    ok_vrf_pre, vrf_points = vrf_core(vrf_pk, vrf_gamma, vrf_c, vrf_s, vrf_alpha)
+    return finish_core(
+        ok_ed_pre, ed_point, ed_r,
+        ok_kes_pre, kes_point, kes_r,
+        ok_vrf_pre, vrf_points, vrf_c,
+        beta_decl, thr_lo, thr_hi,
+    )

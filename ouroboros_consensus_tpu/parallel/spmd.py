@@ -48,15 +48,24 @@ def make_mesh(devices=None) -> Mesh:
 
 
 def pad_batch(batch: pbatch.PraosBatch, multiple: int):
-    """Pad every column of `batch` to a batch size divisible by
-    `multiple`, returning (padded_batch, original_size).
+    """Pad every column of `batch` to the next POWER-OF-TWO bucket that
+    is divisible by `multiple`, returning (padded_batch, original_size).
 
-    Pad lanes replicate lane 0 (guaranteed decodable inputs) — their
-    verdicts are sliced off before the host epilogue, and the
-    first-failure reduction masks them out by position.
+    Bucketing (same rationale as pbatch.run_batch) keeps the
+    jit-of-shard_map cache bounded: one compile per bucket shape, not
+    one per epoch-segment length. Pad lanes replicate lane 0
+    (guaranteed decodable inputs) — their verdicts are sliced off
+    before the host epilogue, and the first-failure reduction masks
+    them out by position.
     """
     b = batch.beta.shape[0]
-    target = b + ((-b) % multiple)
+    # floor of 32 lanes: small batches (tests, chain tails) all share
+    # ONE compiled shard_map shape; production batches are far larger
+    minimum = max(multiple, 32)
+    target = pbatch.bucket_size(max(b, minimum), minimum=minimum)
+    # power-of-two buckets are only divisible by power-of-two meshes;
+    # round up for any other device count
+    target += (-target) % multiple
     return pbatch.pad_batch_to(batch, target), b
 
 

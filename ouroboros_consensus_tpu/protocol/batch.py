@@ -286,10 +286,100 @@ def verify_praos(
 
 _JIT: dict = {}
 
+# device implementation: "pk" = Pallas kernels (ops/pk, limb-first,
+# ladders in VMEM — the TPU production path), "xla" = the original jnp
+# graph (the cross-check twin; also the CPU default, where the pk path
+# only exists as interpret-mode and compiles far slower than it runs)
+DEVICE_IMPL = __import__("os").environ.get("OCT_DEVICE_IMPL", "")
+
+
+def _impl() -> str:
+    if DEVICE_IMPL:
+        return DEVICE_IMPL
+    import jax
+
+    return "pk" if jax.devices()[0].platform == "tpu" else "xla"
+
 
 def flatten_batch(batch: PraosBatch) -> list:
     """PraosBatch -> flat array list in verify_praos argument order."""
     return [*batch.ed, *batch.kes, *batch.vrf, batch.beta, batch.thr_lo, batch.thr_hi]
+
+
+def _words_to_byte_blocks(w: np.ndarray) -> np.ndarray:
+    """SHA-512 word blocks [B, NB, 16, 2] uint32 -> [NB, 128, B] int32
+    byte blocks (the ops/pk limb-first hash input layout)."""
+    b_, nb = w.shape[0], w.shape[1]
+    out = np.zeros((b_, nb, 16, 8), np.int32)
+    for k in range(4):
+        out[..., k] = ((w[..., 0] >> (24 - 8 * k)) & 0xFF).astype(np.int32)
+        out[..., 4 + k] = ((w[..., 1] >> (24 - 8 * k)) & 0xFF).astype(np.int32)
+    return np.ascontiguousarray(out.reshape(b_, nb, 128).transpose(1, 2, 0))
+
+
+def _t(a: np.ndarray) -> np.ndarray:
+    """[B, n] -> [n, B] int32, contiguous."""
+    return np.ascontiguousarray(np.asarray(a).astype(np.int32).T)
+
+
+def pk_arrays(batch: PraosBatch) -> list[np.ndarray]:
+    """PraosBatch ([B, ...] staging) -> limb-first arrays in
+    ops/pk/kernels.verify_praos_tiles argument order."""
+    ed, kes, vrf = batch.ed, batch.kes, batch.vrf
+    b = batch.beta.shape[0]
+    return [
+        _t(ed.pk), _t(ed.r), _t(ed.s),
+        _words_to_byte_blocks(ed.hblocks),
+        np.ascontiguousarray(ed.hnblocks.astype(np.int32).reshape(1, b)),
+        _t(kes.vk),
+        np.ascontiguousarray(kes.period.astype(np.int32).reshape(1, b)),
+        _t(kes.r), _t(kes.s), _t(kes.vk_leaf),
+        np.ascontiguousarray(
+            np.asarray(kes.siblings).astype(np.int32).transpose(1, 2, 0)
+        ),
+        _words_to_byte_blocks(kes.hblocks),
+        np.ascontiguousarray(kes.hnblocks.astype(np.int32).reshape(1, b)),
+        _t(vrf.pk), _t(vrf.gamma), _t(vrf.c), _t(vrf.s), _t(vrf.alpha),
+        _t(batch.beta), _t(batch.thr_lo), _t(batch.thr_hi),
+    ]
+
+
+def _jitted_pk(kes_depth: int):
+    import functools
+
+    import jax
+
+    key = ("pk", kes_depth)
+    if key not in _JIT:
+        from ..ops.pk import kernels as pk_kernels
+
+        _JIT[key] = jax.jit(
+            functools.partial(
+                pk_kernels.verify_praos_tiles, kes_depth=kes_depth
+            )
+        )
+    return _JIT[key]
+
+
+def _pk_dispatch(batch: PraosBatch):
+    """Stage + dispatch the Pallas path (async); -> opaque handle."""
+    depth = batch.kes.siblings.shape[-2]
+    arrays = pk_arrays(batch)
+    out = _jitted_pk(depth)(*(jnp.asarray(x) for x in arrays))
+    return out
+
+
+def _pk_materialize(out, b: int) -> Verdicts:
+    flags, eta, lv = (np.asarray(x) for x in out)
+    return Verdicts(
+        ok_ocert_sig=flags[0, :b] != 0,
+        ok_kes_sig=flags[1, :b] != 0,
+        ok_vrf=flags[2, :b] != 0,
+        ok_leader=flags[3, :b] != 0,
+        leader_ambiguous=flags[4, :b] != 0,
+        eta=np.ascontiguousarray(eta[:, :b].T),
+        leader_value=np.ascontiguousarray(lv[:, :b].T),
+    )
 
 
 def pad_batch_to(batch: PraosBatch, size: int) -> PraosBatch:
@@ -406,6 +496,8 @@ def run_batch(batch: PraosBatch) -> Verdicts:
     """
     b = batch.beta.shape[0]
     padded = pad_batch_to(batch, bucket_size(b))
+    if _impl() == "pk":
+        return _pk_materialize(_pk_dispatch(padded), b)
     out = _jitted_verify()(*(jnp.asarray(x) for x in flatten_batch(padded)))
     return Verdicts(*(np.asarray(x)[:b] for x in out))
 
@@ -485,6 +577,7 @@ def validate_batch(
     hvs: Sequence[HeaderView],
     collect_states: bool = False,
     backend: str = "device",
+    mesh=None,  # backend="sharded": the jax.sharding.Mesh (None = all devices)
 ) -> BatchResult:
     """Validate a within-epoch run of headers as one batch.
 
@@ -503,6 +596,13 @@ def validate_batch(
     pre = host_prechecks(params, lview, hvs)
     if backend == "native":
         v = run_batch_native(params, lview, eta0, hvs, pre)
+    elif backend == "sharded":
+        # multi-chip SPMD: batch axis over the device mesh, psum/pmin
+        # verdict collectives (parallel/spmd.py; SURVEY.md §5.8)
+        from ..parallel import spmd
+
+        batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
+        v, _first_bad, _n_ok = spmd.sharded_run_batch(batch, mesh)
     else:
         batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
         v = run_batch(batch)
@@ -521,12 +621,17 @@ def dispatch_batch(params, lview, eta0, hvs):
     batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
     b = batch.beta.shape[0]
     padded = pad_batch_to(batch, bucket_size(b))
+    if _impl() == "pk":
+        return pre, ("pk", _pk_dispatch(padded)), b
     out = _jitted_verify()(*(jnp.asarray(x) for x in flatten_batch(padded)))
-    return pre, out, b
+    return pre, ("xla", out), b
 
 
-def materialize_verdicts(out, b) -> Verdicts:
+def materialize_verdicts(tagged, b) -> Verdicts:
     """Block on a dispatched window's device computation."""
+    impl, out = tagged
+    if impl == "pk":
+        return _pk_materialize(out, b)
     return Verdicts(*(np.asarray(x)[:b] for x in out))
 
 
@@ -611,6 +716,7 @@ def validate_chain(
     max_batch: int = 8192,
     backend: str = "device",
     pipeline_depth: int = 2,
+    mesh=None,  # backend="sharded": the jax.sharding.Mesh (None = all devices)
 ) -> BatchResult:
     """Validate an arbitrary run of headers, segmenting at epoch
     boundaries (and at `max_batch` within an epoch) per SURVEY.md §5.7.
@@ -642,7 +748,9 @@ def validate_chain(
             while i < seg_end:
                 j = min(i + max_batch, seg_end)
                 ticked = praos.tick(params, lview, hvs[i].slot, state)
-                res = validate_batch(params, ticked, hvs[i:j], backend=backend)
+                res = validate_batch(
+                    params, ticked, hvs[i:j], backend=backend, mesh=mesh
+                )
                 state = res.state
                 total_valid += res.n_valid
                 if res.error is not None:

@@ -107,6 +107,10 @@ def synthesize(
     chunk_size: int = 21600,
     vrf_backend: str = "auto",
     trace=lambda s: None,
+    ledger_view_for_epoch=None,  # epoch -> LedgerView (epoch-varying
+    # stake: forge against the distribution validators will derive);
+    # None = the constant `lview`
+    txs_for_block=None,  # (slot, block_no) -> tuple[bytes, ...]
 ) -> ForgeResult:
     """The forging loop (Forging.hs:57): tick → leader check per
     credential → forge → append, until the limit trips.
@@ -150,7 +154,12 @@ def synthesize(
     span_end = 0
 
     while not done():
-        ticked = praos.tick(params, lview, slot, st)
+        lv_now = (
+            ledger_view_for_epoch(params.epoch_of(slot))
+            if ledger_view_for_epoch is not None
+            else lview
+        )
+        ticked = praos.tick(params, lv_now, slot, st)
         eta0 = ticked.state.epoch_nonce
         if vrf_backend == "device" and slot >= span_end:
             # next span: up to the epoch boundary (eta0 is epoch-constant)
@@ -171,13 +180,18 @@ def synthesize(
             else:  # host: lazy per-slot evaluation (small runs)
                 is_leader = evaluate_vrf(pool, slot, eta0)
             lv_val = nonces.vrf_leader_value(is_leader.vrf_output)
-            entry = lview.pool_distr[pool.pool_id]
+            entry = lv_now.pool_distr.get(pool.pool_id)
+            if entry is None:
+                continue  # pool has no stake this epoch
             if not check_leader_value(lv_val, entry.stake, params.active_slot_coeff):
                 continue
             n = counters.get(pool.pool_id, 0)
-            txs = tuple(
-                b"tx-%d-%d" % (slot, i) for i in range(txs_per_block)
-            )
+            if txs_for_block is not None:
+                txs = tuple(txs_for_block(slot, block_no))
+            else:
+                txs = tuple(
+                    b"tx-%d-%d" % (slot, i) for i in range(txs_per_block)
+                )
             block = forge_block(
                 params,
                 pool,

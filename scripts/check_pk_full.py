@@ -1,0 +1,141 @@
+"""End-to-end check + timing of the Pallas verify path on real headers.
+
+Forges a valid Praos chain segment (host sign-side), corrupts a few
+lanes in distinct ways, and compares the pk kernel verdicts against the
+native C++ verifier lane by lane. Then times the full pipeline at a
+production batch size.
+
+Usage: python scripts/check_pk_full.py [B] [timing_B]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from fractions import Fraction
+
+import numpy as np
+import jax
+from jax import numpy as jnp
+
+from ouroboros_consensus_tpu.protocol import batch as pbatch
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.testing import fixtures
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+TB = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=100,
+    max_kes_evolutions=62,
+    security_param=4,
+    active_slot_coeff=Fraction(1, 2),
+    epoch_length=100_000,
+    kes_depth=3,
+)
+ETA0 = b"\x07" * 32
+
+pools = [fixtures.make_pool(i, kes_depth=PARAMS.kes_depth) for i in range(3)]
+lview = fixtures.make_ledger_view(pools)
+
+print(f"forging {B} headers...", flush=True)
+hvs = []
+slot = 1
+prev = None
+while len(hvs) < B:
+    pool = fixtures.find_leader(PARAMS, pools, lview, slot, ETA0)
+    if pool is not None:
+        hv = fixtures.forge_header_view(
+            PARAMS, pool, slot=slot, epoch_nonce=ETA0, prev_hash=prev,
+            body_bytes=b"body-%d" % len(hvs),
+        )
+        hvs.append(hv)
+        prev = (b"%032d" % len(hvs))[:32]
+    slot += 1
+
+# corrupt lanes: ocert sig, kes sig, vrf proof, vrf beta
+import dataclasses
+
+
+def corrupt(hv, **kw):
+    return dataclasses.replace(hv, **kw)
+
+
+bad = {}
+hvs[10] = corrupt(hvs[10], ocert=dataclasses.replace(
+    hvs[10].ocert, sigma=hvs[10].ocert.sigma[:-1] + bytes([hvs[10].ocert.sigma[-1] ^ 1])))
+bad[10] = "ocert"
+hvs[20] = corrupt(hvs[20], kes_sig=hvs[20].kes_sig[:-1] + bytes([hvs[20].kes_sig[-1] ^ 1]))
+bad[20] = "kes"
+hvs[30] = corrupt(hvs[30], vrf_proof=hvs[30].vrf_proof[:1] + bytes([hvs[30].vrf_proof[1] ^ 1]) + hvs[30].vrf_proof[2:])
+bad[30] = "vrf"
+hvs[40] = corrupt(hvs[40], vrf_output=hvs[40].vrf_output[:1] + bytes([hvs[40].vrf_output[1] ^ 1]) + hvs[40].vrf_output[2:])
+bad[40] = "beta"
+
+pre = pbatch.host_prechecks(PARAMS, lview, hvs)
+staged = pbatch.stage(PARAMS, lview, ETA0, hvs, pre.kes_evolution)
+
+t0 = time.time()
+out = pbatch._pk_dispatch(staged)
+v = pbatch._pk_materialize(out, B)
+print(f"pk pipeline (compile+run) {time.time()-t0:.1f}s", flush=True)
+
+vn = pbatch.run_batch_native(PARAMS, lview, ETA0, hvs, pre)
+
+mism = []
+for i in range(B):
+    stop = min(bad.keys(), default=B)
+    # native short-circuits at first failure; compare only up to there
+    if i > min(bad, default=B):
+        break
+    for f_ in ("ok_ocert_sig", "ok_kes_sig", "ok_vrf"):
+        a = bool(getattr(v, f_)[i])
+        b_ = bool(getattr(vn, f_)[i])
+        if a != b_:
+            mism.append((i, f_, a, b_))
+if mism:
+    print("MISMATCH vs native:", mism[:10])
+else:
+    print("verdicts match native up to first failure")
+
+# full-batch verdict sanity: exactly the corrupted lanes fail
+fails = {
+    i: [f_ for f_ in ("ok_ocert_sig", "ok_kes_sig", "ok_vrf")
+        if not getattr(v, f_)[i]]
+    for i in range(B)
+    if not (v.ok_ocert_sig[i] and v.ok_kes_sig[i] and v.ok_vrf[i])
+}
+print("failing lanes:", {k: tuple(fv) for k, fv in sorted(fails.items())})
+expect = {10: ("ok_ocert_sig",), 20: ("ok_kes_sig",), 30: ("ok_vrf",), 40: ("ok_vrf",)}
+ok = set(fails) == set(expect) and all(tuple(fails[k]) == expect[k] for k in expect)
+print("corruption pattern:", "OK" if ok else "WRONG")
+
+# eta/leader_value spot check vs native
+eta_ok = (v.eta[:9] == vn.eta[:9]).all()
+lv_ok = (v.leader_value[:9] == vn.leader_value[:9]).all()
+print("eta match:", bool(eta_ok), "leader_value match:", bool(lv_ok))
+
+# ---- timing at TB ---------------------------------------------------------
+if TB:
+    reps = (TB + B - 1) // B
+    big = pbatch.PraosBatch(
+        ed=type(staged.ed)(*(np.concatenate([np.asarray(c)] * reps)[:TB] for c in staged.ed)),
+        kes=type(staged.kes)(*(np.concatenate([np.asarray(c)] * reps)[:TB] for c in staged.kes)),
+        vrf=type(staged.vrf)(*(np.concatenate([np.asarray(c)] * reps)[:TB] for c in staged.vrf)),
+        beta=np.concatenate([staged.beta] * reps)[:TB],
+        thr_lo=np.concatenate([staged.thr_lo] * reps)[:TB],
+        thr_hi=np.concatenate([staged.thr_hi] * reps)[:TB],
+    )
+    t0 = time.time()
+    out = pbatch._pk_dispatch(big)
+    v = pbatch._pk_materialize(out, TB)
+    print(f"B={TB} first (compile+run) {time.time()-t0:.1f}s", flush=True)
+    best = 1e9
+    for _ in range(3):
+        t0 = time.time()
+        out = pbatch._pk_dispatch(big)
+        v = pbatch._pk_materialize(out, TB)
+        best = min(best, time.time() - t0)
+    print(f"B={TB} hot: {best*1e3:.1f}ms -> {TB/best:.0f} headers/s (kernel only)")

@@ -1,0 +1,211 @@
+"""Component-wise TPU bisection of the pk kernels: each suspect piece in
+its own tiny pallas_call, checked against host references."""
+
+import hashlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+from jax import numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ouroboros_consensus_tpu.ops import field as fe_b
+from ouroboros_consensus_tpu.ops.host import ed25519 as he
+from ouroboros_consensus_tpu.ops.pk import curve as pc
+from ouroboros_consensus_tpu.ops.pk import hashes as ph
+from ouroboros_consensus_tpu.ops.pk import limbs as fe
+
+B = 256
+rng = np.random.default_rng(5)
+
+
+def run_kernel(body, outs, *args, base8=False):
+    """outs: list of (prefix_shape, dtype). All args [*, B]."""
+    in_specs = []
+    call_args = []
+    if base8:
+        call_args.append(jnp.asarray(pc.BASE8_NP))
+        in_specs.append(
+            pl.BlockSpec(pc.BASE8_NP.shape, lambda: (0, 0, 0), memory_space=pltpu.VMEM)
+        )
+    for a in args:
+        call_args.append(jnp.asarray(a))
+        in_specs.append(
+            pl.BlockSpec(np.asarray(a).shape, lambda *_, _n=np.asarray(a).ndim: (0,) * _n,
+                         memory_space=pltpu.VMEM)
+        )
+    return pl.pallas_call(
+        body,
+        in_specs=in_specs,
+        out_specs=tuple(
+            pl.BlockSpec((*p, B), lambda *_, _n=len(p) + 1: (0,) * _n,
+                         memory_space=pltpu.VMEM)
+            for p, _ in outs
+        ),
+        out_shape=tuple(jax.ShapeDtypeStruct((*p, B), d) for p, d in outs),
+    )(*call_args)
+
+
+which = set(sys.argv[1:]) or {"sha", "blake", "base", "ladder", "decomp", "scalar"}
+
+# --- 1. unrolled sha512_fixed (66 bytes) ------------------------------------
+if "sha" in which:
+    data = rng.integers(0, 256, (66, B), dtype=np.int32)
+
+    def k_sha(d_ref, o_ref):
+        with fe.kernel_consts(B):
+            o_ref[:] = ph._sha512_fixed_unrolled(d_ref[:])
+
+    out = np.asarray(run_kernel(k_sha, [((64,), jnp.int32)], data)[0])
+    want = np.stack(
+        [np.frombuffer(hashlib.sha512(bytes(data[:, i].astype(np.uint8))).digest(), np.uint8)
+         for i in range(B)], axis=1)
+    print("sha512_fixed unrolled:", "OK" if (out == want).all() else "MISMATCH")
+
+    # var variant, 2 blocks mixed
+    msgs = [rng.bytes(int(rng.integers(1, 200))) for _ in range(B)]
+    nb = 2
+    byts = np.zeros((nb, 128, B), np.int32)
+    nblocks = np.zeros((B,), np.int32)
+    for i, m in enumerate(msgs):
+        k = (len(m) + 17 + 127) // 128
+        padded = bytearray(k * 128)
+        padded[: len(m)] = m
+        padded[len(m)] = 0x80
+        padded[-16:] = (8 * len(m)).to_bytes(16, "big")
+        for blk in range(k):
+            byts[blk, :, i] = np.frombuffer(bytes(padded[blk*128:(blk+1)*128]), np.uint8)
+        nblocks[i] = k
+
+    def k_shav(d_ref, n_ref, o_ref):
+        with fe.kernel_consts(B):
+            o_ref[:] = ph._sha512_var_unrolled(d_ref[:], n_ref[:][0])
+
+    out = np.asarray(run_kernel(k_shav, [((64,), jnp.int32)], byts, nblocks.reshape(1, B))[0])
+    want = np.stack([np.frombuffer(hashlib.sha512(m).digest(), np.uint8) for m in msgs], axis=1)
+    print("sha512_var unrolled:", "OK" if (out == want).all() else "MISMATCH")
+
+# --- 2. unrolled blake2b (64 bytes, ds 32) ----------------------------------
+if "blake" in which:
+    data = rng.integers(0, 256, (64, B), dtype=np.int32)
+
+    def k_b2b(d_ref, o_ref):
+        with fe.kernel_consts(B):
+            o_ref[:] = ph._blake2b_fixed_unrolled(d_ref[:], 64, 32)
+
+    out = np.asarray(run_kernel(k_b2b, [((32,), jnp.int32)], data)[0])
+    want = np.stack(
+        [np.frombuffer(hashlib.blake2b(bytes(data[:, i].astype(np.uint8)), digest_size=32).digest(), np.uint8)
+         for i in range(B)], axis=1)
+    print("blake2b unrolled:", "OK" if (out == want).all() else "MISMATCH")
+
+# --- 3. base_mul_w8 (MXU one-hot) -------------------------------------------
+if "base" in which:
+    ks = [int.from_bytes(rng.bytes(32), "little") for _ in range(B)]
+    digits = np.zeros((32, B), np.int32)
+    for i, k in enumerate(ks):
+        for w in range(32):
+            digits[w, i] = (k >> (8 * w)) & 0xFF
+
+    def k_base(b8_ref, d_ref, o_ref):
+        with fe.kernel_consts(B), pc.kernel_base8(b8_ref[:]):
+            p = pc.base_mul_w8(d_ref[:])
+            o_ref[:] = jnp.concatenate([p.x, p.y, p.z, p.t], axis=0)
+
+    out = np.asarray(run_kernel(k_base, [((80,), jnp.int32)], digits, base8=True)[0])
+    okall = True
+    for i in range(0, B, 37):
+        x = fe_b.limbs_to_int_np(out[0:20, i]) % fe.P_INT
+        y = fe_b.limbs_to_int_np(out[20:40, i]) % fe.P_INT
+        z = fe_b.limbs_to_int_np(out[40:60, i]) % fe.P_INT
+        zi = pow(z, fe.P_INT - 2, fe.P_INT)
+        want = he.point_mul(ks[i], he.B)
+        wzi = pow(want[2], fe.P_INT - 2, fe.P_INT)
+        if (x * zi % fe.P_INT, y * zi % fe.P_INT) != (want[0] * wzi % fe.P_INT, want[1] * wzi % fe.P_INT):
+            okall = False
+    print("base_mul_w8:", "OK" if okall else "MISMATCH")
+
+# --- 4. scalar_mul_w4 rotate-ladder ----------------------------------------
+if "ladder" in which:
+    pts = []
+    for i in range(B):
+        k = int(rng.integers(1, 2**60))
+        p = he.point_mul(k, he.B)
+        zi = pow(p[2], fe.P_INT - 2, fe.P_INT)
+        pts.append((p[0] * zi % fe.P_INT, p[1] * zi % fe.P_INT))
+    px = np.stack([fe_b.int_to_limbs_np(p[0]) for p in pts], axis=1)
+    py = np.stack([fe_b.int_to_limbs_np(p[1]) for p in pts], axis=1)
+    pt_ = np.stack([fe_b.int_to_limbs_np(p[0] * p[1] % fe.P_INT) for p in pts], axis=1)
+    pz = np.tile(fe_b.int_to_limbs_np(1)[:, None], (1, B))
+    flat_in = np.concatenate([px, py, pz, pt_], axis=0).astype(np.int32)
+    ks = [int.from_bytes(rng.bytes(32), "little") >> 3 for _ in range(B)]
+    digits = np.zeros((64, B), np.int32)
+    for i, k in enumerate(ks):
+        for w in range(64):
+            digits[w, i] = (k >> (4 * w)) & 0xF
+    digits_msb = digits[::-1].copy()
+
+    def k_lad(p_ref, d_ref, o_ref):
+        with fe.kernel_consts(B):
+            pt = pc.Point(p_ref[0:20], p_ref[20:40], p_ref[40:60], p_ref[60:80])
+            q = pc.scalar_mul_w4(d_ref[:], pt)
+            o_ref[:] = jnp.concatenate([q.x, q.y, q.z, q.t], axis=0)
+
+    out = np.asarray(run_kernel(k_lad, [((80,), jnp.int32)], flat_in, digits_msb)[0])
+    okall = True
+    for i in range(0, B, 37):
+        x = fe_b.limbs_to_int_np(out[0:20, i]) % fe.P_INT
+        y = fe_b.limbs_to_int_np(out[20:40, i]) % fe.P_INT
+        z = fe_b.limbs_to_int_np(out[40:60, i]) % fe.P_INT
+        zi = pow(z, fe.P_INT - 2, fe.P_INT)
+        xx, yy = pts[i]
+        want = he.point_mul(ks[i], (xx, yy, 1, xx * yy % fe.P_INT))
+        wzi = pow(want[2], fe.P_INT - 2, fe.P_INT)
+        if (x * zi % fe.P_INT, y * zi % fe.P_INT) != (want[0] * wzi % fe.P_INT, want[1] * wzi % fe.P_INT):
+            okall = False
+    print("scalar_mul_w4:", "OK" if okall else "MISMATCH")
+
+# --- 5. decompress + compress ----------------------------------------------
+if "decomp" in which:
+    encs = []
+    for i in range(B):
+        k = int(rng.integers(1, 2**60))
+        encs.append(he.point_compress(he.point_mul(k, he.B)))
+    enc_arr = np.stack([np.frombuffer(e, np.uint8) for e in encs], axis=1).astype(np.int32)
+
+    def k_dec(e_ref, ok_ref, o_ref):
+        with fe.kernel_consts(B):
+            ok, p = pc.decompress(e_ref[:])
+            ok_ref[:] = ok.astype(jnp.int32)[None, :]
+            o_ref[:] = pc.compress(p)
+
+    ok, out = run_kernel(k_dec, [((1,), jnp.int32), ((32,), jnp.int32)], enc_arr)
+    ok = np.asarray(ok); out = np.asarray(out)
+    print("decompress/compress:", "OK" if (ok[0] != 0).all() and (out == enc_arr).all() else "MISMATCH",
+          f"(ok {(ok[0]!=0).sum()}/{B}, enc match {(out==enc_arr).all(axis=0).sum()}/{B})")
+
+# --- 6. reduce512 + is_canonical_scalar -------------------------------------
+if "scalar" in which:
+    raw = rng.integers(0, 256, (64, B), dtype=np.int32)
+
+    def k_red(d_ref, o_ref, c_ref):
+        with fe.kernel_consts(B):
+            o_ref[:] = fe.reduce512(d_ref[:])
+            c_ref[:] = fe.is_canonical_scalar(d_ref[:][:32]).astype(jnp.int32)[None, :]
+
+    out, canon = run_kernel(k_red, [((20,), jnp.int32), ((1,), jnp.int32)], raw)
+    out = np.asarray(out); canon = np.asarray(canon)
+    okall = True
+    for i in range(0, B, 17):
+        v = int.from_bytes(bytes(raw[:, i].astype(np.uint8)), "little")
+        if fe_b.limbs_to_int_np(out[:, i]) != v % fe.L_INT:
+            okall = False
+        s = int.from_bytes(bytes(raw[:32, i].astype(np.uint8)), "little")
+        if bool(canon[0, i]) != (s < fe.L_INT):
+            okall = False
+    print("reduce512/is_canonical:", "OK" if okall else "MISMATCH")

@@ -101,3 +101,90 @@ def test_shelley_nonce_continuity(chain):
     st = res.final_state
     assert st.inner.epoch_nonce is not None
     assert st.inner.evolving_nonce is not None
+
+
+# -- 5-era composite (VERDICT r2 item 8) -------------------------------------
+
+CFG5 = composite.CardanoMockConfig(
+    byron_epochs=1,
+    byron_epoch_length=30,
+    shelley_epochs=2,
+    epoch_length=40,
+    n_delegs=2,
+    shelley_d=Fraction(1, 2),
+    k=5,
+    kes_depth=3,
+    # Conway: DOUBLED epoch length and f=1/2 (a real lottery);
+    # Leios: epoch length changes again, back to f=1
+    conway_epochs=1,       # babbage runs one epoch before conway
+    conway_f=Fraction(1, 2),
+    conway_epoch_length=80,
+    leios_epochs=1,        # conway runs one (80-slot) epoch before leios
+    leios_f=Fraction(1),
+    leios_epoch_length=20,
+)
+# byron 30 + shelley 80 + babbage 40 + conway 80 + some leios
+N_SLOTS5 = 30 + 80 + 40 + 80 + 45
+
+
+@pytest.fixture(scope="module")
+def chain5(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("mixed5") / "db")
+    n = composite.synthesize(path, CFG5, N_SLOTS5)
+    return path, n
+
+
+def test_five_era_synthesize_and_revalidate(chain5):
+    """5-era chain (PBFT -> TPraos -> Praos -> Praos' -> Praos'') with
+    per-era epoch length AND active-slot-coefficient changes crosses
+    all four boundaries and revalidates clean (Cardano/Block.hs:96,
+    CanHardFork.hs:273 shape)."""
+    path, n = chain5
+    res = composite.revalidate(path, CFG5, backend="native")
+    assert res.error is None, repr(res.error)
+    assert res.n_valid == res.n_blocks == n
+    assert set(res.per_era) == {"byron", "shelley", "babbage", "conway", "leios"}
+    # conway ran a real f=1/2 lottery: strictly fewer blocks than slots
+    assert 0 < res.per_era["conway"] < 80
+    # every other Praos-class era is full-occupancy (f=1, minus the
+    # TPraos overlay's inactive slots in shelley)
+    assert res.per_era["leios"] > 0
+    assert res.per_era["babbage"] == 40
+
+
+def test_five_era_tamper_detected_in_conway(chain5, tmp_path):
+    """A corrupted block inside the 4th era is caught by revalidation."""
+    import os
+    import shutil
+
+    path, n = chain5
+    cpath = str(tmp_path / "tampered")
+    shutil.copytree(path, cpath)
+    # find a chunk holding conway blocks (slots 150..230) and flip a bit
+    from ouroboros_consensus_tpu.storage.immutable import ImmutableDB
+
+    imm = ImmutableDB(os.path.join(cpath, "immutable"))
+    target = None
+    for e in imm.iter_entries():
+        if 155 <= e.slot < 225:
+            target = e
+            break
+    assert target is not None
+    import glob
+
+    chunk_files = sorted(glob.glob(os.path.join(cpath, "immutable", "*.chunk")))
+    # locate the chunk containing the target offset (chunk files are
+    # sequential; entry offsets are file-relative) — flip a byte in the
+    # middle of the target entry
+    for cf in chunk_files:
+        size = os.path.getsize(cf)
+        # entries know their chunk via the DB internals; easiest: try
+        # flipping in each file at the entry offset and accept the one
+        # that changes revalidation
+        if target.offset + 16 < size:
+            data = bytearray(open(cf, "rb").read())
+            data[target.offset + 12] ^= 0x01
+            open(cf, "wb").write(bytes(data))
+            break
+    res = composite.revalidate(cpath, CFG5, backend="native")
+    assert res.error is not None or res.n_valid < n

@@ -100,3 +100,35 @@ def test_pad_batch_roundtrip(lview, chain):
     np.testing.assert_array_equal(padded.beta[:b], batch.beta)
     # pad lanes replicate lane 0
     np.testing.assert_array_equal(padded.beta[b:], np.repeat(batch.beta[:1], padded.beta.shape[0] - b, axis=0))
+
+
+def test_sharded_backend_through_db_analyser(tmp_path, lview, pools):
+    """The PRODUCTION sharded path (VERDICT r2 item 3): synthesize an
+    on-disk chain crossing epoch boundaries, then run the real
+    db-analyser revalidation with backend="sharded" — epoch-segmented
+    staging, batch axis sharded over the 8-device mesh, psum/pmin
+    verdict collectives — and require the exact host-fold result."""
+    from ouroboros_consensus_tpu.tools import db_analyser as ana
+    from ouroboros_consensus_tpu.tools import db_synthesizer as synth
+
+    # epoch_length 24 with f=1/2 gives ~12-block segments -> the SAME
+    # 16-lane bucket the other tests compile, so this e2e adds no extra
+    # jit-of-shard_map compile (one mega-compile per bucket shape)
+    params = replace(PARAMS, epoch_length=24, security_param=2)
+    path = str(tmp_path / "chain")
+    res = synth.synthesize(
+        path, params, pools, lview, synth.ForgeLimit(slots=72),
+    )
+    assert res.n_blocks > 25  # ~36 expected at f=1/2
+
+    host = ana.revalidate(path, params, lview, backend="host")
+    assert host.error is None and host.n_valid == res.n_blocks
+
+    sharded = ana.revalidate(path, params, lview, backend="sharded")
+    assert sharded.error is None
+    assert sharded.n_valid == res.n_blocks
+    assert sharded.final_state.evolving_nonce == host.final_state.evolving_nonce
+    assert sharded.final_state.epoch_nonce == host.final_state.epoch_nonce
+    assert (
+        sharded.final_state.ocert_counters == host.final_state.ocert_counters
+    )

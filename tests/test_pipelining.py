@@ -361,3 +361,62 @@ def test_edge_teardown_on_adversarial_peer(tmp_path):
         ) is None
     )
     assert len(victim.chain_db.invalid) >= 1  # the lie was recorded
+
+
+def test_server_blocks_without_polling(tmp_path):
+    """The caught-up ChainSync server BLOCKS on the follower's event
+    (Server.hs blocks in STM on the next instruction) — with a runtime
+    attached there is no poll timer, so a quiescent network leaves the
+    sim with an EMPTY event queue: sim.run(until=T) returns long before
+    T instead of ticking poll wakeups until the horizon."""
+    server_node = _mk_node(tmp_path, "server-block")
+    client_node = _mk_node(tmp_path, "client-block")
+    for b in _forge_chain(5):
+        server_node.chain_db.add_block(b)
+    sim = Sim()
+    server_node.chain_db.runtime = sim
+    req, rsp = Channel(delay=0.01, name="req"), Channel(delay=0.01, name="rsp")
+    cand = Candidate()
+    sim.spawn(chainsync.server(server_node.chain_db, req, rsp), "server")
+    # client pulls the 5 available headers, then issues one request_next
+    # that can never be answered (no new blocks) -> both endpoints block
+    sim.spawn(
+        chainsync.client(client_node, "peer", rsp, req, cand, max_headers=6),
+        "client",
+    )
+    end = sim.run(until=1000.0)
+    assert len(cand.headers) == 5
+    assert end < 10.0, f"sim ran to {end}: the server is polling"
+
+
+def test_server_wakes_on_new_block_event(tmp_path):
+    """A blocked server resumes promptly when chain selection adopts a
+    new block and fires the follower event (no poll latency)."""
+    server_node = _mk_node(tmp_path, "server-wake")
+    client_node = _mk_node(tmp_path, "client-wake")
+    chain = _forge_chain(6)
+    for b in chain[:5]:
+        server_node.chain_db.add_block(b)
+    sim = Sim()
+    server_node.chain_db.runtime = sim
+    req, rsp = Channel(delay=0.01, name="req"), Channel(delay=0.01, name="rsp")
+    cand = Candidate()
+    sim.spawn(chainsync.server(server_node.chain_db, req, rsp), "server")
+    cl = sim.spawn(
+        chainsync.client(client_node, "peer", rsp, req, cand, max_headers=6),
+        "client",
+    )
+
+    def late_block():
+        from ouroboros_consensus_tpu.utils.sim import Sleep as S
+
+        yield S(50.0)
+        server_node.chain_db.add_block(chain[5])
+
+    sim.spawn(late_block(), "late")
+    sim.run(until=1000.0)
+    assert not cl.alive
+    assert len(cand.headers) == 6
+    # 6th header arrives right after t=50 (plus channel delays), far
+    # sooner than any poll-interval-quantized schedule would show drift
+    assert sim.now < 60.0

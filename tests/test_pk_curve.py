@@ -1,0 +1,183 @@
+"""Differential tests: ops/pk/curve vs the host reference point arithmetic.
+
+The ladder tests (scalar_mul_w4 / double_scalar_mul_w4 / base_mul_w8 /
+compress chains) compile for minutes on single-core XLA:CPU, so they are
+gated behind OCT_SLOW_TESTS=1; add/double/decompress stay in the default
+suite. TPU coverage: scripts/debug_pk_tpu.py + bench.py run the same
+code through Mosaic on hardware.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+_slow = pytest.mark.skipif(
+    not os.environ.get("OCT_SLOW_TESTS"),
+    reason="multi-minute XLA:CPU compile; set OCT_SLOW_TESTS=1",
+)
+
+import jax
+from jax import numpy as jnp
+
+from ouroboros_consensus_tpu.ops import field as fe_b
+from ouroboros_consensus_tpu.ops.host import ed25519 as he
+from ouroboros_consensus_tpu.ops.pk import curve as pc
+from ouroboros_consensus_tpu.ops.pk import limbs as fe
+
+B = 32
+rng = np.random.default_rng(7)
+
+
+def host_points(b=B):
+    """Random curve points (multiples of B) as host affine ints."""
+    pts = []
+    for i in range(b):
+        k = int(rng.integers(1, 2**60))
+        p = he.point_mul(k, he.B)
+        zi = pow(p[2], fe.P_INT - 2, fe.P_INT)
+        pts.append((p[0] * zi % fe.P_INT, p[1] * zi % fe.P_INT))
+    return pts
+
+
+def stage_points(pts):
+    """Affine host points -> device Point [20, B]."""
+    x = np.stack([fe_b.int_to_limbs_np(p[0]) for p in pts], axis=1)
+    y = np.stack([fe_b.int_to_limbs_np(p[1]) for p in pts], axis=1)
+    t = np.stack(
+        [fe_b.int_to_limbs_np(p[0] * p[1] % fe.P_INT) for p in pts], axis=1
+    )
+    one = np.tile(fe_b.int_to_limbs_np(1)[:, None], (1, len(pts)))
+    return pc.Point(jnp.asarray(x), jnp.asarray(y), jnp.asarray(one), jnp.asarray(t))
+
+
+def affine_of(point) -> list[tuple[int, int]]:
+    x, y, z = (np.asarray(c) for c in (point.x, point.y, point.z))
+    out = []
+    for i in range(x.shape[1]):
+        zi = pow(fe_b.limbs_to_int_np(z[:, i]) % fe.P_INT, fe.P_INT - 2, fe.P_INT)
+        out.append(
+            (
+                fe_b.limbs_to_int_np(x[:, i]) * zi % fe.P_INT,
+                fe_b.limbs_to_int_np(y[:, i]) * zi % fe.P_INT,
+            )
+        )
+    return out
+
+
+def host_affine(p):
+    zi = pow(p[2], fe.P_INT - 2, fe.P_INT)
+    return (p[0] * zi % fe.P_INT, p[1] * zi % fe.P_INT)
+
+
+@pytest.fixture(scope="module")
+def pts():
+    hp = host_points()
+    return hp, stage_points(hp)
+
+
+def test_add_double(pts):
+    hp, dp = pts
+    got = affine_of(jax.jit(pc.double)(dp))
+    want = [host_affine(he.point_double((x, y, 1, x * y % fe.P_INT))) for x, y in hp]
+    assert got == want
+    hp2 = list(reversed(hp))
+    dp2 = stage_points(hp2)
+    got = affine_of(jax.jit(pc.add)(dp, dp2))
+    want = [
+        host_affine(
+            he.point_add((x1, y1, 1, x1 * y1 % fe.P_INT), (x2, y2, 1, x2 * y2 % fe.P_INT))
+        )
+        for (x1, y1), (x2, y2) in zip(hp, hp2)
+    ]
+    assert got == want
+
+
+@_slow
+def test_scalar_mul_w4(pts):
+    hp, dp = pts
+    ks = [int.from_bytes(rng.bytes(32), 'little') >> 3 for _ in range(B)]
+    digits = np.zeros((64, B), np.int32)
+    for i, k in enumerate(ks):
+        for w in range(64):
+            digits[w, i] = (k >> (4 * w)) & 0xF
+    digits_msb = jnp.asarray(digits[::-1].copy())
+    got = affine_of(jax.jit(pc.scalar_mul_w4)(digits_msb, dp))
+    want = [
+        host_affine(he.point_mul(k, (x, y, 1, x * y % fe.P_INT)))
+        for k, (x, y) in zip(ks, hp)
+    ]
+    assert got == want
+
+
+@_slow
+def test_double_scalar_mul_w4(pts):
+    hp, dp = pts
+    hp2 = list(reversed(hp))
+    dp2 = stage_points(hp2)
+    kas = [int.from_bytes(rng.bytes(32), 'little') >> 3 for _ in range(B)]
+    kbs = [int.from_bytes(rng.bytes(16), 'little') for _ in range(B)]
+    da = np.zeros((64, B), np.int32)
+    db = np.zeros((32, B), np.int32)
+    for i in range(B):
+        for w in range(64):
+            da[w, i] = (kas[i] >> (4 * w)) & 0xF
+        for w in range(32):
+            db[w, i] = (kbs[i] >> (4 * w)) & 0xF
+    got = affine_of(
+        jax.jit(pc.double_scalar_mul_w4)(
+            jnp.asarray(da[::-1].copy()), dp, jnp.asarray(db[::-1].copy()), dp2
+        )
+    )
+    want = []
+    for i in range(B):
+        x1, y1 = hp[i]
+        x2, y2 = hp2[i]
+        pa = he.point_mul(kas[i], (x1, y1, 1, x1 * y1 % fe.P_INT))
+        pb = he.point_mul(kbs[i], (x2, y2, 1, x2 * y2 % fe.P_INT))
+        want.append(host_affine(he.point_add(pa, pb)))
+    assert got == want
+
+
+@_slow
+def test_base_mul_w8():
+    ks = [int.from_bytes(rng.bytes(32), 'little') for _ in range(B)]
+    digits = np.zeros((32, B), np.int32)
+    for i, k in enumerate(ks):
+        for w in range(32):
+            digits[w, i] = (k >> (8 * w)) & 0xFF
+    got = affine_of(jax.jit(pc.base_mul_w8)(jnp.asarray(digits)))
+    want = [host_affine(he.point_mul(k, he.B)) for k in ks]
+    assert got == want
+
+
+@_slow
+def test_compress_decompress(pts):
+    hp, dp = pts
+    enc = jax.jit(pc.compress)(dp)
+    enc_np = np.asarray(enc)
+    for i, (x, y) in enumerate(hp):
+        want = he.point_compress((x, y, 1, x * y % fe.P_INT))
+        assert bytes(enc_np[:, i].astype(np.uint8)) == want
+    ok, back = jax.jit(pc.decompress)(enc)
+    assert np.asarray(ok).all()
+    assert affine_of(back) == hp
+
+    # invalid encodings are mask lanes, not crashes
+    bad = np.asarray(enc).copy()
+    bad[:, 0] = 255  # y >= p
+    ok2, _ = jax.jit(pc.decompress)(jnp.asarray(bad))
+    assert not np.asarray(ok2)[0]
+
+
+@_slow
+def test_compress_many_shared_inversion(pts):
+    hp, dp = pts
+    d2 = jax.jit(pc.double)(dp)
+    encs = jax.jit(lambda a, b: pc.compress_many([a, b]))(dp, d2)
+    e1 = np.asarray(encs[0])
+    e2 = np.asarray(encs[1])
+    for i, (x, y) in enumerate(hp):
+        p = (x, y, 1, x * y % fe.P_INT)
+        assert bytes(e1[:, i].astype(np.uint8)) == he.point_compress(p)
+        assert bytes(e2[:, i].astype(np.uint8)) == he.point_compress(he.point_double(p))

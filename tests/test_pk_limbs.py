@@ -1,0 +1,125 @@
+"""Differential tests: ops/pk/limbs (limb-first) vs ops/field + host ints.
+
+Everything runs on CPU under plain jit — the pk functions are pure jnp,
+so correctness established here carries to the Pallas kernels that call
+them (same trace).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax import numpy as jnp
+
+from ouroboros_consensus_tpu.ops import field as fe
+from ouroboros_consensus_tpu.ops.pk import limbs as pk
+
+B = 64
+rng = np.random.default_rng(42)
+
+
+def rand_fe_cols(b=B):
+    """[20, b] nearly-normalized random elements + their int values."""
+    arr = rng.integers(0, fe.B_MAX, size=(fe.NLIMBS, b), dtype=np.int32)
+    vals = [fe.limbs_to_int_np(arr[:, i]) for i in range(b)]
+    return jnp.asarray(arr), vals
+
+
+def col_ints(x):
+    x = np.asarray(x)
+    return [fe.limbs_to_int_np(x[:, i]) for i in range(x.shape[1])]
+
+
+@pytest.fixture(scope="module")
+def ab():
+    a, av = rand_fe_cols()
+    b, bv = rand_fe_cols()
+    return a, av, b, bv
+
+
+def test_mul_sqr_add_sub(ab):
+    a, av, b, bv = ab
+    got = col_ints(jax.jit(pk.mul)(a, b))
+    assert [g % fe.P_INT for g in got] == [
+        (x * y) % fe.P_INT for x, y in zip(av, bv)
+    ]
+    got = col_ints(jax.jit(pk.sqr)(a))
+    assert [g % fe.P_INT for g in got] == [x * x % fe.P_INT for x in av]
+    got = col_ints(jax.jit(pk.add)(a, b))
+    assert [g % fe.P_INT for g in got] == [(x + y) % fe.P_INT for x, y in zip(av, bv)]
+    got = col_ints(jax.jit(pk.sub)(a, b))
+    assert [g % fe.P_INT for g in got] == [(x - y) % fe.P_INT for x, y in zip(av, bv)]
+
+
+def test_canonical_parity_eq(ab):
+    a, av, b, bv = ab
+    got = col_ints(jax.jit(pk.canonical)(a))
+    assert got == [x % fe.P_INT for x in av]
+    par = np.asarray(jax.jit(pk.parity)(a))
+    assert list(par) == [(x % fe.P_INT) & 1 for x in av]
+    assert not np.asarray(jax.jit(pk.eq)(a, b)).any()
+    assert np.asarray(jax.jit(pk.eq)(a, a)).all()
+
+
+def test_inv_legendre_sqrt(ab):
+    a, av, b, bv = ab
+    got = col_ints(jax.jit(pk.inv)(a))
+    assert [g % fe.P_INT for g in got] == [
+        pow(x % fe.P_INT, fe.P_INT - 2, fe.P_INT) for x in av
+    ]
+    leg = col_ints(jax.jit(pk.legendre)(a))
+    assert [g % fe.P_INT for g in leg] == [
+        pow(x % fe.P_INT, (fe.P_INT - 1) // 2, fe.P_INT) for x in av
+    ]
+    # sqrt of squares round-trips
+    sq = jax.jit(pk.sqr)(a)
+    ok, r = jax.jit(pk.sqrt)(sq)
+    assert np.asarray(ok).all()
+    r2 = col_ints(jax.jit(pk.sqr)(r))
+    assert [g % fe.P_INT for g in r2] == [x * x % fe.P_INT for x in av]
+
+
+def test_bytes_roundtrip(ab):
+    a, av, _, _ = ab
+    by = jax.jit(pk.to_bytes)(a)
+    by_np = np.asarray(by)
+    for i in range(B):
+        want = (av[i] % fe.P_INT).to_bytes(32, "little")
+        assert bytes(by_np[:, i].astype(np.uint8)) == want
+    back = col_ints(jax.jit(pk.from_bytes32)(by))
+    assert back == [x % fe.P_INT for x in av]
+
+
+def test_scalar_reduce512_and_canonical():
+    raw = rng.integers(0, 256, size=(64, B), dtype=np.int32)
+    got = col_ints(jax.jit(pk.reduce512)(jnp.asarray(raw)))
+    for i in range(B):
+        v = int.from_bytes(bytes(raw[:, i].astype(np.uint8)), "little")
+        assert got[i] == v % pk.L_INT
+
+    s = rng.integers(0, 256, size=(32, B), dtype=np.int32)
+    s[:, 0] = 0
+    s[:, 1] = 255  # 2^256-1 > L
+    canon = np.asarray(jax.jit(pk.is_canonical_scalar)(jnp.asarray(s)))
+    for i in range(B):
+        v = int.from_bytes(bytes(s[:, i].astype(np.uint8)), "little")
+        assert canon[i] == (v < pk.L_INT)
+
+
+def test_windows():
+    s = rng.integers(0, 256, size=(32, B), dtype=np.int32)
+    w4 = np.asarray(jax.jit(lambda x: pk.windows4_from_bytes(x, 256))(jnp.asarray(s)))
+    w8 = np.asarray(jax.jit(lambda x: pk.windows8_from_bytes(x, 256))(jnp.asarray(s)))
+    for i in range(B):
+        v = int.from_bytes(bytes(s[:, i].astype(np.uint8)), "little")
+        assert [int(d) for d in w4[:, i]] == [(v >> (4 * k)) & 0xF for k in range(64)]
+        assert [int(d) for d in w8[:, i]] == [(v >> (8 * k)) & 0xFF for k in range(32)]
+
+    a, av = rand_fe_cols()
+    ac = jax.jit(pk.canonical)(a)
+    w4l = np.asarray(jax.jit(lambda x: pk.windows4_from_limbs(x, 256))(ac))
+    w8l = np.asarray(jax.jit(lambda x: pk.windows8_from_limbs(x, 256))(ac))
+    for i in range(B):
+        v = av[i] % fe.P_INT
+        assert [int(d) for d in w4l[:, i]] == [(v >> (4 * k)) & 0xF for k in range(64)]
+        assert [int(d) for d in w8l[:, i]] == [(v >> (8 * k)) & 0xFF for k in range(32)]

@@ -459,3 +459,79 @@ def test_async_mode_equals_sync_mode(tmp_path):
         b.hash_ for b in db_async.stream_all()
     ]
     assert db_sync.tip_point() == db_async.tip_point()
+
+
+# -- DiskPolicy (Storage/LedgerDB/DiskPolicy.hs:87-108) ----------------------
+
+
+def test_disk_policy_fresh_run_snapshots_at_k():
+    from ouroboros_consensus_tpu.storage.chaindb import DiskPolicy
+
+    p = DiskPolicy(k=2160)
+    assert p.interval_s == 4320.0  # k*2 seconds = 72 min at k=2160
+    # NoSnapshotTakenYet: only the k-block rule applies, time irrelevant
+    assert not p.should_take_snapshot(2159, now_s=1e9)
+    assert p.should_take_snapshot(2160, now_s=0.0)
+
+
+def test_disk_policy_time_interval_and_burst():
+    from ouroboros_consensus_tpu.storage.chaindb import DiskPolicy
+
+    p = DiskPolicy(k=2160)
+    p.snapshot_taken(1000.0)
+    # below the interval with few blocks: no
+    assert not p.should_take_snapshot(10, now_s=1000.0 + 4319.0)
+    # interval reached: yes, regardless of block count
+    assert p.should_take_snapshot(0, now_s=1000.0 + 4320.0)
+    # burst rule: >= 50k blocks AND >= 6 min
+    assert not p.should_take_snapshot(50_000, now_s=1000.0 + 359.0)
+    assert p.should_take_snapshot(50_000, now_s=1000.0 + 360.0)
+    assert not p.should_take_snapshot(49_999, now_s=1000.0 + 360.0)
+    # explicit requested interval overrides the default
+    q = DiskPolicy(k=4, requested_interval_s=100.0)
+    q.snapshot_taken(0.0)
+    assert q.should_take_snapshot(1, now_s=100.0)
+    assert not q.should_take_snapshot(1, now_s=99.0)
+
+
+def test_chaindb_time_based_snapshots_on_sim_clock(tmp_path):
+    """The ChainDB honors the time-based DiskPolicy against the node's
+    VIRTUAL clock: advancing sim time past the interval triggers exactly
+    the expected snapshots as blocks are copied to the immutable tier."""
+    from ouroboros_consensus_tpu.storage.chaindb import DiskPolicy
+    from ouroboros_consensus_tpu.storage.ledgerdb import LedgerDB
+
+    class FakeRuntime:
+        now = 0.0
+
+        def fire(self, ev):
+            pass
+
+    ext = mk_ext()
+    gen = genesis_state(ext)
+    db = open_chaindb(str(tmp_path / "db"), ext, gen, k=PARAMS.security_param)
+    db.runtime = FakeRuntime()
+    db.disk_policy = DiskPolicy(k=PARAMS.security_param,
+                                requested_interval_s=60.0)
+    snap_dir = db.snap_dir
+    blocks = forge_chain(20)
+    # fresh run: first snapshot once k (=3) blocks were copied
+    for b in blocks[:8]:
+        db.add_block(b)
+    first = LedgerDB.list_snapshots(snap_dir)
+    assert first, "fresh-run k-block snapshot missing"
+    n0 = len(first)
+
+    # time below interval: copying more blocks must NOT snapshot
+    db.runtime.now = 30.0
+    for b in blocks[8:14]:
+        db.add_block(b)
+    assert len(LedgerDB.list_snapshots(snap_dir)) == n0 or \
+        LedgerDB.list_snapshots(snap_dir) == first
+
+    # past the interval: next copy takes a snapshot
+    db.runtime.now = 100.0
+    for b in blocks[14:]:
+        db.add_block(b)
+    after = LedgerDB.list_snapshots(snap_dir)
+    assert after != first
