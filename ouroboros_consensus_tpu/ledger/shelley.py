@@ -1,0 +1,807 @@
+"""Shelley-class ledger: real tx-level STS rules, certificates, deposits,
+mark/set/go stake snapshots, reward calculation, and protocol-parameter
+updates — the depth the mock ledger deliberately omits.
+
+Reference (behavioral parity, re-designed):
+  - `ouroboros-consensus-cardano/src/shelley/.../Shelley/Ledger/Ledger.hs`
+    (applyBlockLedgerResult / ledgerViewForecastAt around :584)
+  - the Shelley ledger STS rule family it delegates to (cardano-ledger):
+    LEDGER = UTXOW -> UTXO -> DELEGS -> POOL; TICK -> NEWEPOCH ->
+    (RUPD rewards, SNAP snapshot rotation, POOLREAP retirements, and
+    PPUP protocol-parameter adoption)
+  - `Ledger/SupportsProtocol.hs` ledgerViewForecastAt: the LedgerView
+    served for an epoch is the sealed "set" snapshot (mark/set/go
+    rotation: stake decided two boundaries back).
+
+Everything is value-semantics: `apply` returns new frozen states; the
+per-tx fast path used by the Mempool mutates ONLY a `TxView` scratch
+object obtained from `mempool_view` (atomic-on-failure, like the mock
+ledger's apply_tx).
+
+Wire format (deterministic CBOR, ../utils/cbor.py):
+  tx      = [inputs, outputs, fee, ttl, certs, withdrawals]
+  input   = [txid/32, ix]
+  output  = [addr, coin];  addr = [payment/28, stake/28|null]
+  cert    = [0, cred]                     -- stake key registration
+          | [1, cred]                     -- stake key deregistration
+          | [2, cred, pool_id]            -- delegation
+          | [3, pool_id, vrf_hash, pledge, cost, margin_num, margin_den,
+               reward_cred, [owner_cred...]]  -- pool registration/update
+          | [4, pool_id, epoch]           -- pool retirement
+          | [5, proposer_id, {pparam: value}] -- pparam update proposal
+  withdrawal = [cred, coin]   (must withdraw the FULL reward balance)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from fractions import Fraction
+from typing import Mapping
+
+from ..ops.host.hashes import blake2b_256
+from ..protocol.views import IndividualPoolStake, LedgerView
+from ..utils import cbor
+from .abstract import Forecast, LedgerError
+
+
+class ShelleyTxError(LedgerError):
+    pass
+
+
+@dataclass
+class BadInputs(ShelleyTxError):
+    txin: tuple[bytes, int]
+
+
+@dataclass
+class ExpiredTx(ShelleyTxError):
+    ttl: int
+    slot: int
+
+
+@dataclass
+class FeeTooSmall(ShelleyTxError):
+    supplied: int
+    required: int
+
+
+@dataclass
+class ValueNotConserved(ShelleyTxError):
+    consumed: int
+    produced: int
+
+
+@dataclass
+class MaxTxSizeExceeded(ShelleyTxError):
+    size: int
+    limit: int
+
+
+@dataclass
+class DelegError(ShelleyTxError):
+    why: str
+
+
+@dataclass
+class PoolError(ShelleyTxError):
+    why: str
+
+
+@dataclass
+class WithdrawalError(ShelleyTxError):
+    why: str
+
+
+def tx_id(tx_bytes: bytes) -> bytes:
+    return blake2b_256(tx_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Wire format
+# ---------------------------------------------------------------------------
+
+
+def encode_addr(payment: bytes, stake: bytes | None) -> list:
+    return [payment, stake]
+
+
+def encode_tx(ins, outs, fee=0, ttl=2**62, certs=(), withdrawals=()) -> bytes:
+    """outs: [(payment, stake|None, coin)]."""
+    return cbor.encode([
+        [list(i) for i in ins],
+        [[encode_addr(p, s), c] for p, s, c in outs],
+        fee, ttl,
+        [list(c) for c in certs],
+        [list(w) for w in withdrawals],
+    ])
+
+
+@dataclass(frozen=True)
+class Tx:
+    ins: tuple[tuple[bytes, int], ...]
+    outs: tuple[tuple[tuple[bytes, bytes | None], int], ...]
+    fee: int
+    ttl: int
+    certs: tuple[tuple, ...]
+    withdrawals: tuple[tuple[bytes, int], ...]
+    size: int
+
+
+def decode_tx(tx_bytes: bytes) -> Tx:
+    try:
+        ins, outs, fee, ttl, certs, wdrls = cbor.decode(tx_bytes)
+        return Tx(
+            ins=tuple((bytes(i[0]), int(i[1])) for i in ins),
+            outs=tuple(
+                ((bytes(a[0]), None if a[1] is None else bytes(a[1])), int(c))
+                for a, c in outs
+            ),
+            fee=int(fee),
+            ttl=int(ttl),
+            certs=tuple(tuple(c) for c in certs),
+            withdrawals=tuple((bytes(w[0]), int(w[1])) for w in wdrls),
+            size=len(tx_bytes),
+        )
+    except ShelleyTxError:
+        raise
+    except Exception as e:  # malformed gossip is an invalid tx, not a crash
+        raise ShelleyTxError(f"malformed tx: {e!r}") from e
+
+
+# ---------------------------------------------------------------------------
+# Parameters / state
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PParams:
+    """The protocol parameters the rules consume (a real subset of
+    Shelley's PParams; updatable via [5, ...] proposals)."""
+
+    min_fee_a: int = 44
+    min_fee_b: int = 155381
+    max_tx_size: int = 16384
+    key_deposit: int = 2_000_000
+    pool_deposit: int = 500_000_000
+    e_max: int = 18  # max retirement horizon in epochs
+    n_opt: int = 3  # k: target pool count (saturation z0 = 1/n_opt)
+    a0: Fraction = Fraction(3, 10)  # pledge influence
+    rho: Fraction = Fraction(3, 1000)  # monetary expansion per epoch
+    tau: Fraction = Fraction(1, 5)  # treasury cut
+    min_pool_cost: int = 0
+
+    UPDATABLE = (
+        "min_fee_a", "min_fee_b", "max_tx_size", "key_deposit",
+        "pool_deposit", "e_max", "n_opt", "a0", "rho", "tau",
+        "min_pool_cost",
+    )
+
+    def with_updates(self, upd: Mapping[str, object]) -> "PParams":
+        clean = {}
+        for k, v in upd.items():
+            k = k.decode() if isinstance(k, bytes) else k
+            if k not in self.UPDATABLE:
+                raise ShelleyTxError(f"not an updatable pparam: {k}")
+            cur = getattr(self, k)
+            if isinstance(cur, Fraction):
+                # fractions travel on the wire as [num, den]
+                clean[k] = (
+                    Fraction(int(v[0]), int(v[1]))
+                    if isinstance(v, (list, tuple)) else Fraction(v)
+                )
+            else:
+                clean[k] = int(v)
+        return replace(self, **clean)
+
+
+@dataclass(frozen=True)
+class PoolParams:
+    pool_id: bytes  # operator key hash (28)
+    vrf_hash: bytes  # Blake2b-256 of the pool's VRF vk
+    pledge: int
+    cost: int
+    margin: Fraction
+    reward_cred: bytes
+    owners: tuple[bytes, ...]
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    """A sealed stake distribution: per-credential stake plus the
+    delegation map and pool params AS OF the capture boundary."""
+
+    stake: Mapping[bytes, int]
+    delegations: Mapping[bytes, bytes]
+    pools: Mapping[bytes, PoolParams]
+
+    def pool_stake(self) -> dict[bytes, int]:
+        per: dict[bytes, int] = {}
+        for cred, amt in self.stake.items():
+            pid = self.delegations.get(cred)
+            if pid is not None and pid in self.pools:
+                per[pid] = per.get(pid, 0) + amt
+        return per
+
+
+EMPTY_SNAPSHOT = Snapshot({}, {}, {})
+
+
+@dataclass(frozen=True)
+class ShelleyGenesis:
+    pparams: PParams
+    epoch_length: int
+    stability_window: int  # forecast horizon (3k/f for Praos)
+    genesis_delegates: tuple[bytes, ...] = ()  # pparam-update proposers
+    update_quorum: int = 1
+    # total supply is conserved: utxo + pots (fees/deposits/treasury/
+    # reserves/rewards); anything not in the genesis utxo starts in
+    # reserves, funding monetary expansion
+    max_supply: int = 45_000_000_000_000_000
+
+
+@dataclass(frozen=True)
+class ShelleyState:
+    utxo: Mapping[tuple[bytes, int], tuple[tuple[bytes, bytes | None], int]]
+    fees: int  # fee pot of the CURRENT epoch
+    deposits: int
+    treasury: int
+    reserves: int
+    stake_creds: Mapping[bytes, int]  # cred -> held deposit
+    rewards: Mapping[bytes, int]  # reward accounts of registered creds
+    delegations: Mapping[bytes, bytes]
+    pools: Mapping[bytes, PoolParams]
+    retiring: Mapping[bytes, int]  # pool_id -> retirement epoch
+    mark: Snapshot
+    set_: Snapshot
+    go: Snapshot
+    blocks_current: Mapping[bytes, int]  # pool -> blocks this epoch
+    blocks_prev: Mapping[bytes, int]  # pool -> blocks previous epoch
+    prev_fees: int  # previous epoch's fee pot (feeds its reward pot)
+    pparams: PParams
+    proposals: Mapping[bytes, tuple]  # proposer -> sorted pparam updates
+    epoch: int
+    tip_slot_: int | None = None
+
+
+@dataclass(frozen=True)
+class TickedShelleyState:
+    state: ShelleyState
+    slot: int
+
+
+@dataclass
+class TxView:
+    """Mutable scratch for per-tx validation (the Mempool's cached view).
+    Carries exactly the sub-state the LEDGER rules read/write."""
+
+    utxo: dict
+    stake_creds: dict
+    rewards: dict
+    delegations: dict
+    pools: dict
+    retiring: dict
+    proposals: dict
+    pparams: PParams
+    epoch: int
+    slot: int
+    deposit_delta: int = 0
+    fee_delta: int = 0
+
+
+def total_ada(gen: ShelleyGenesis, st: ShelleyState) -> int:
+    """Conservation invariant: every lovelace is in exactly one pot."""
+    return (
+        sum(c for _a, c in st.utxo.values())
+        + st.fees + st.prev_fees + st.deposits + st.treasury + st.reserves
+        + sum(st.rewards.values())
+    )
+
+
+# ---------------------------------------------------------------------------
+# The ledger
+# ---------------------------------------------------------------------------
+
+
+class ShelleyLedger:
+    """Ledger instance (ledger/abstract.py) for the Shelley-class rules."""
+
+    def __init__(self, genesis: ShelleyGenesis):
+        self.genesis = genesis
+
+    # -- construction ------------------------------------------------------
+
+    def genesis_state(self, initial_outputs) -> ShelleyState:
+        """initial_outputs: [(payment, stake|None, coin)] spendable as
+        (zero-txid, ix); the rest of max_supply starts in reserves."""
+        utxo = {
+            (bytes(32), ix): ((p, s), c)
+            for ix, (p, s, c) in enumerate(initial_outputs)
+        }
+        circulating = sum(c for _p, _s, c in initial_outputs)
+        if circulating > self.genesis.max_supply:
+            raise ValueError("genesis outputs exceed max_supply")
+        return ShelleyState(
+            utxo=utxo, fees=0, deposits=0, treasury=0,
+            reserves=self.genesis.max_supply - circulating,
+            stake_creds={}, rewards={}, delegations={}, pools={},
+            retiring={}, mark=EMPTY_SNAPSHOT, set_=EMPTY_SNAPSHOT,
+            go=EMPTY_SNAPSHOT, blocks_current={}, blocks_prev={},
+            prev_fees=0, pparams=self.genesis.pparams, proposals={},
+            epoch=0,
+        )
+
+    # -- LEDGER rules (per tx) ---------------------------------------------
+
+    def _apply_cert(self, v: TxView, cert: tuple) -> tuple[int, int]:
+        """DELEGS/POOL/PPUP rules; returns (deposit_taken, refund_given)."""
+        tag = cert[0]
+        if tag == 0:  # stake key registration
+            cred = bytes(cert[1])
+            if cred in v.stake_creds:
+                raise DelegError(f"already registered: {cred.hex()[:8]}")
+            dep = v.pparams.key_deposit
+            v.stake_creds[cred] = dep
+            v.rewards[cred] = 0
+            return dep, 0
+        if tag == 1:  # deregistration
+            cred = bytes(cert[1])
+            if cred not in v.stake_creds:
+                raise DelegError(f"not registered: {cred.hex()[:8]}")
+            if v.rewards.get(cred, 0) != 0:
+                raise DelegError("non-zero rewards; withdraw first")
+            refund = v.stake_creds.pop(cred)
+            v.rewards.pop(cred, None)
+            v.delegations.pop(cred, None)
+            return 0, refund
+        if tag == 2:  # delegation
+            cred, pid = bytes(cert[1]), bytes(cert[2])
+            if cred not in v.stake_creds:
+                raise DelegError(f"delegator not registered: {cred.hex()[:8]}")
+            if pid not in v.pools:
+                raise DelegError(f"unknown pool: {pid.hex()[:8]}")
+            v.delegations[cred] = pid
+            return 0, 0
+        if tag == 3:  # pool registration / re-registration (update)
+            (_t, pid, vrf_hash, pledge, cost, m_num, m_den,
+             reward_cred, owners) = cert
+            margin = Fraction(int(m_num), int(m_den))
+            if not (0 <= margin <= 1):
+                raise PoolError(f"margin out of range: {margin}")
+            if int(cost) < v.pparams.min_pool_cost:
+                raise PoolError(f"cost below minPoolCost: {cost}")
+            pp = PoolParams(
+                pool_id=bytes(pid), vrf_hash=bytes(vrf_hash),
+                pledge=int(pledge), cost=int(cost), margin=margin,
+                reward_cred=bytes(reward_cred),
+                owners=tuple(bytes(o) for o in owners),
+            )
+            fresh = pp.pool_id not in v.pools
+            v.pools[pp.pool_id] = pp
+            # re-registration also cancels a pending retirement
+            v.retiring.pop(pp.pool_id, None)
+            return (v.pparams.pool_deposit, 0) if fresh else (0, 0)
+        if tag == 4:  # retirement
+            pid, epoch = bytes(cert[1]), int(cert[2])
+            if pid not in v.pools:
+                raise PoolError(f"unknown pool: {pid.hex()[:8]}")
+            if not (v.epoch < epoch <= v.epoch + v.pparams.e_max):
+                raise PoolError(
+                    f"retirement epoch {epoch} outside "
+                    f"({v.epoch}, {v.epoch + v.pparams.e_max}]"
+                )
+            v.retiring[pid] = epoch
+            return 0, 0
+        if tag == 5:  # pparam update proposal (PPUP)
+            proposer, upd = bytes(cert[1]), cert[2]
+            if proposer not in self.genesis.genesis_delegates:
+                raise ShelleyTxError(
+                    f"pparam proposer is not a genesis delegate: "
+                    f"{proposer.hex()[:8]}"
+                )
+            v.pparams.with_updates(upd)  # validates keys/values
+            v.proposals[proposer] = tuple(sorted(
+                (k.decode() if isinstance(k, bytes) else k,
+                 tuple(v2) if isinstance(v2, (list, tuple)) else v2)
+                for k, v2 in upd.items()
+            ))
+            return 0, 0
+        raise ShelleyTxError(f"unknown certificate tag: {tag!r}")
+
+    def apply_tx(self, view: TxView, tx_bytes: bytes) -> TxView:
+        """Full UTXOW/UTXO/DELEGS/POOL validation; mutates `view` only
+        on success (atomic-on-failure for the Mempool fast path)."""
+        tx = decode_tx(tx_bytes)
+        pp = view.pparams
+        if not tx.ins:
+            raise ShelleyTxError("empty input set")
+        if len(set(tx.ins)) != len(tx.ins):
+            raise BadInputs(tx.ins[0])
+        if tx.ttl < view.slot:
+            raise ExpiredTx(tx.ttl, view.slot)
+        if tx.size > pp.max_tx_size:
+            raise MaxTxSizeExceeded(tx.size, pp.max_tx_size)
+        min_fee = pp.min_fee_a * tx.size + pp.min_fee_b
+        if tx.fee < min_fee:
+            raise FeeTooSmall(tx.fee, min_fee)
+        if any(c < 0 for _a, c in tx.outs):
+            raise ShelleyTxError("negative output")
+
+        consumed = 0
+        for txin in tx.ins:
+            if txin not in view.utxo:
+                raise BadInputs(txin)
+            consumed += view.utxo[txin][1]
+
+        # run certs/withdrawals against a scratch copy so a late rule
+        # failure can't leave the view half-mutated
+        scratch = TxView(
+            utxo=view.utxo,  # utxo itself is only read until commit
+            stake_creds=dict(view.stake_creds),
+            rewards=dict(view.rewards),
+            delegations=dict(view.delegations),
+            pools=dict(view.pools),
+            retiring=dict(view.retiring),
+            proposals=dict(view.proposals),
+            pparams=view.pparams, epoch=view.epoch, slot=view.slot,
+        )
+        deposits_taken = refunds = 0
+        for cert in tx.certs:
+            try:
+                dep, ref = self._apply_cert(scratch, cert)
+            except ShelleyTxError:
+                raise
+            except Exception as e:
+                # wrong arity, zero-denominator margins, non-int fields:
+                # malformed gossip is an INVALID TX, not a crash
+                raise ShelleyTxError(f"malformed certificate: {e!r}") from e
+            deposits_taken += dep
+            refunds += ref
+        withdrawn = 0
+        seen = set()
+        for cred, amt in tx.withdrawals:
+            if cred in seen:
+                raise WithdrawalError("duplicate withdrawal")
+            seen.add(cred)
+            if cred not in scratch.rewards:
+                raise WithdrawalError(f"unregistered: {cred.hex()[:8]}")
+            if scratch.rewards[cred] != amt:
+                raise WithdrawalError(
+                    f"must withdraw full balance "
+                    f"{scratch.rewards[cred]}, got {amt}"
+                )
+            scratch.rewards[cred] = 0
+            withdrawn += amt
+
+        produced_out = sum(c for _a, c in tx.outs)
+        if (consumed + withdrawn + refunds
+                != produced_out + tx.fee + deposits_taken):
+            raise ValueNotConserved(
+                consumed + withdrawn + refunds,
+                produced_out + tx.fee + deposits_taken,
+            )
+
+        # commit
+        tid = tx_id(tx_bytes)
+        for txin in tx.ins:
+            del view.utxo[txin]
+        for ix, (addr, coin) in enumerate(tx.outs):
+            view.utxo[(tid, ix)] = (addr, coin)
+        view.stake_creds = scratch.stake_creds
+        view.rewards = scratch.rewards
+        view.delegations = scratch.delegations
+        view.pools = scratch.pools
+        view.retiring = scratch.retiring
+        view.proposals = scratch.proposals
+        view.deposit_delta += deposits_taken - refunds
+        view.fee_delta += tx.fee
+        return view
+
+    # -- Mempool seam ------------------------------------------------------
+
+    def mempool_view(self, state: ShelleyState, slot: int) -> TxView:
+        return TxView(
+            utxo=dict(state.utxo),
+            stake_creds=dict(state.stake_creds),
+            rewards=dict(state.rewards),
+            delegations=dict(state.delegations),
+            pools=dict(state.pools),
+            retiring=dict(state.retiring),
+            proposals=dict(state.proposals),
+            pparams=state.pparams,
+            epoch=state.epoch,
+            slot=slot,
+        )
+
+    # -- epoch boundary (TICK -> NEWEPOCH) ---------------------------------
+
+    def _stake_distr(self, st: ShelleyState) -> Snapshot:
+        """SNAP: per-credential stake = held utxo value (outputs whose
+        address names the credential) + reward balance."""
+        stake: dict[bytes, int] = {}
+        for (addr, coin) in st.utxo.values():
+            cred = addr[1]
+            if cred is not None and cred in st.stake_creds:
+                stake[cred] = stake.get(cred, 0) + coin
+        for cred, amt in st.rewards.items():
+            if amt:
+                stake[cred] = stake.get(cred, 0) + amt
+        return Snapshot(stake, dict(st.delegations), dict(st.pools))
+
+    def _reward_update(self, st: ShelleyState) -> ShelleyState:
+        """RUPD/MIR: distribute the previous epoch's reward pot using the
+        GO snapshot and that epoch's per-pool block counts.
+
+        pot = rho * reserves + prev_fees;  treasury takes tau * pot; the
+        member/operator split uses the maxPool formula
+        (cardano-ledger Shelley spec §11.8, re-derived):
+          z0 = 1/n_opt, sigma' = min(sigma, z0), p' = min(pledge/T, z0)
+          maxP = R/(1+a0) * (sigma' + p'*a0*(sigma' - p'*(z0-sigma')/z0)/z0)
+        scaled by apparent performance beta = blocks/expected. Unclaimed
+        rewards (unregistered accounts) return to reserves."""
+        go = st.go
+        pool_stake = go.pool_stake()
+        total_stake = sum(go.stake.values())
+        total_blocks = sum(st.blocks_prev.values())
+        pp = st.pparams
+        pot = int(pp.rho * st.reserves) + st.prev_fees
+        treasury_cut = int(pp.tau * pot)
+        big_r = pot - treasury_cut
+        rewards = dict(st.rewards)
+        paid = 0
+        if total_blocks and total_stake and big_r > 0:
+            z0 = Fraction(1, pp.n_opt)
+            for pid, n_blocks in sorted(st.blocks_prev.items()):
+                pparams_pool = go.pools.get(pid)
+                if pparams_pool is None or n_blocks == 0:
+                    continue
+                pstake = pool_stake.get(pid, 0)
+                sigma = Fraction(pstake, total_stake)
+                p = min(Fraction(pparams_pool.pledge, total_stake), z0)
+                s_c = min(sigma, z0)
+                max_p = int(
+                    Fraction(big_r, 1) / (1 + pp.a0)
+                    * (s_c + p * pp.a0 * (s_c - p * (z0 - s_c) / z0) / z0)
+                )
+                beta = Fraction(n_blocks, total_blocks)
+                expected = sigma if sigma > 0 else Fraction(1)
+                perf = min(Fraction(1), beta / expected)
+                pool_r = int(max_p * perf)
+                if pool_r <= 0:
+                    continue
+                # operator: cost + margin of the rest (+ member share of
+                # owner stake); members: stake-proportional remainder
+                cost = min(pparams_pool.cost, pool_r)
+                rest = pool_r - cost
+                op_take = cost + int(pparams_pool.margin * rest)
+                member_pot = pool_r - op_take
+                owner_creds = set(pparams_pool.owners)
+                member_stake = sum(
+                    amt for cred, amt in go.stake.items()
+                    if go.delegations.get(cred) == pid
+                    and cred not in owner_creds
+                )
+                distributed = 0
+                if member_stake > 0 and member_pot > 0:
+                    for cred, amt in sorted(go.stake.items()):
+                        if (go.delegations.get(cred) != pid
+                                or cred in owner_creds):
+                            continue
+                        share = member_pot * amt // member_stake
+                        if share and cred in st.stake_creds:
+                            rewards[cred] = rewards.get(cred, 0) + share
+                            distributed += share
+                op_total = op_take + (member_pot - distributed
+                                      if member_stake == 0 else 0)
+                if pparams_pool.reward_cred in st.stake_creds:
+                    rewards[pparams_pool.reward_cred] = (
+                        rewards.get(pparams_pool.reward_cred, 0) + op_total
+                    )
+                    distributed += op_total
+                paid += distributed
+        # conservation: prev_fees is consumed; rho*reserves funds the
+        # rest of the pot; unclaimed big_r returns to reserves implicitly
+        return replace(
+            st,
+            treasury=st.treasury + treasury_cut,
+            reserves=st.reserves + st.prev_fees - treasury_cut - paid,
+            rewards=rewards,
+            prev_fees=0,
+        )
+
+    def _pool_reap(self, st: ShelleyState, epoch: int) -> ShelleyState:
+        """POOLREAP: delete pools whose retirement epoch arrived; refund
+        the pool deposit to the operator's reward account (treasury if
+        the account is gone); drop delegations to dead pools."""
+        dead = {pid for pid, e in st.retiring.items() if e <= epoch}
+        if not dead:
+            return st
+        pools = {p: pp for p, pp in st.pools.items() if p not in dead}
+        retiring = {p: e for p, e in st.retiring.items() if p not in dead}
+        rewards = dict(st.rewards)
+        deposits = st.deposits
+        treasury = st.treasury
+        for pid in sorted(dead):
+            pp = st.pools[pid]
+            deposits -= st.pparams.pool_deposit
+            if pp.reward_cred in st.stake_creds:
+                rewards[pp.reward_cred] = (
+                    rewards.get(pp.reward_cred, 0) + st.pparams.pool_deposit
+                )
+            else:
+                treasury += st.pparams.pool_deposit
+        delegations = {
+            c: p for c, p in st.delegations.items() if p not in dead
+        }
+        return replace(
+            st, pools=pools, retiring=retiring, rewards=rewards,
+            deposits=deposits, treasury=treasury, delegations=delegations,
+        )
+
+    def _adopt_pparams(self, st: ShelleyState) -> ShelleyState:
+        """PPUP adoption: an update carried by >= update_quorum genesis
+        delegates with IDENTICAL content is adopted at the boundary."""
+        if not st.proposals:
+            return st
+        votes: dict[tuple, int] = {}
+        for upd in st.proposals.values():
+            votes[upd] = votes.get(upd, 0) + 1
+        winner = None
+        for upd, n in sorted(votes.items(), key=lambda kv: (kv[1], repr(kv[0]))):
+            if n >= self.genesis.update_quorum:
+                winner = upd
+        pparams = st.pparams
+        if winner is not None:
+            pparams = pparams.with_updates(dict(winner))
+        return replace(st, pparams=pparams, proposals={})
+
+    def _new_epoch(self, st: ShelleyState, epoch: int) -> ShelleyState:
+        """One boundary crossing, in the reference's NEWEPOCH order:
+        rewards (from GO + prev blocks), snapshot rotation, pool reap,
+        pparam adoption."""
+        st = self._reward_update(st)
+        st = replace(
+            st,
+            mark=self._stake_distr(st),
+            set_=st.mark,
+            go=st.set_,
+            blocks_prev=st.blocks_current,
+            blocks_current={},
+            prev_fees=st.fees,
+            fees=0,
+            epoch=epoch,
+        )
+        st = self._pool_reap(st, epoch)
+        return self._adopt_pparams(st)
+
+    def tick(self, state: ShelleyState, slot: int) -> TickedShelleyState:
+        e_now = slot // self.genesis.epoch_length
+        st = state
+        while st.epoch < e_now:
+            st = self._new_epoch(st, st.epoch + 1)
+        return TickedShelleyState(st, slot)
+
+    # -- block application -------------------------------------------------
+
+    def _issuer_pool(self, block) -> bytes | None:
+        header = getattr(block, "header", None)
+        vk = getattr(header, "issuer_vk", None) if header else None
+        if vk is None:
+            return None
+        from ..protocol.views import hash_key
+
+        return hash_key(vk)
+
+    def _count_block(self, st: ShelleyState, block) -> ShelleyState:
+        pid = self._issuer_pool(block)
+        if pid is None:
+            return st
+        blocks = dict(st.blocks_current)
+        blocks[pid] = blocks.get(pid, 0) + 1
+        return replace(st, blocks_current=blocks)
+
+    def apply_block(self, ticked: TickedShelleyState, block) -> ShelleyState:
+        st = ticked.state
+        view = self.mempool_view(st, ticked.slot)
+        for tx in block.txs:
+            view = self.apply_tx(view, tx)
+        st = replace(
+            st,
+            utxo=view.utxo,
+            stake_creds=view.stake_creds,
+            rewards=view.rewards,
+            delegations=view.delegations,
+            pools=view.pools,
+            retiring=view.retiring,
+            proposals=view.proposals,
+            fees=st.fees + view.fee_delta,
+            deposits=st.deposits + view.deposit_delta,
+            tip_slot_=ticked.slot,
+        )
+        return self._count_block(st, block)
+
+    def reapply_block(self, ticked: TickedShelleyState, block) -> ShelleyState:
+        """Previously validated: replay the value movements without the
+        rule checks (mirrors the mock ledger's reapply shape)."""
+        st = ticked.state
+        view = self.mempool_view(st, ticked.slot)
+        for tx_bytes in block.txs:
+            tx = decode_tx(tx_bytes)
+            tid = tx_id(tx_bytes)
+            for txin in tx.ins:
+                view.utxo.pop(txin, None)
+            for ix, (addr, coin) in enumerate(tx.outs):
+                view.utxo[(tid, ix)] = (addr, coin)
+            dep = ref = 0
+            for cert in tx.certs:
+                d, r = self._apply_cert(view, cert)
+                dep += d
+                ref += r
+            for cred, amt in tx.withdrawals:
+                view.rewards[cred] = 0
+            view.deposit_delta += dep - ref
+            view.fee_delta += tx.fee
+        st = replace(
+            st,
+            utxo=view.utxo,
+            stake_creds=view.stake_creds,
+            rewards=view.rewards,
+            delegations=view.delegations,
+            pools=view.pools,
+            retiring=view.retiring,
+            proposals=view.proposals,
+            fees=st.fees + view.fee_delta,
+            deposits=st.deposits + view.deposit_delta,
+            tip_slot_=ticked.slot,
+        )
+        return self._count_block(st, block)
+
+    # -- protocol interface ------------------------------------------------
+
+    def tip_slot(self, state: ShelleyState) -> int | None:
+        return state.tip_slot_
+
+    def _view_from_snapshot(self, snap: Snapshot) -> LedgerView:
+        per = snap.pool_stake()
+        total = sum(per.values())
+        if total == 0:
+            return LedgerView(pool_distr={})
+        return LedgerView(pool_distr={
+            pid: IndividualPoolStake(
+                Fraction(amt, total), snap.pools[pid].vrf_hash
+            )
+            for pid, amt in sorted(per.items())
+        })
+
+    def protocol_ledger_view(self, ticked: TickedShelleyState) -> LedgerView:
+        """Election view for the ticked slot's epoch: the SET snapshot
+        (sealed two boundaries back — forgers and validators agree on it
+        before the epoch starts)."""
+        return self._view_from_snapshot(ticked.state.set_)
+
+    def view_for_epoch(self, state: ShelleyState, epoch: int) -> LedgerView:
+        """db-analyser seam (same contract as MockLedger.view_for_epoch):
+        the view epoch E elects with, given a state already in E."""
+        if epoch < state.epoch:
+            raise ValueError(f"state is past epoch {epoch}")
+        st = state
+        while st.epoch < epoch:
+            st = self._new_epoch(st, st.epoch + 1)
+        return self._view_from_snapshot(st.set_)
+
+    def ledger_view_forecast_at(self, state: ShelleyState) -> Forecast:
+        at = -1 if state.tip_slot_ is None else state.tip_slot_
+
+        def view_fn(s):
+            return self.protocol_ledger_view(self.tick(state, s))
+
+        return Forecast(
+            at=at,
+            max_for=at + 1 + self.genesis.stability_window,
+            view_fn=view_fn,
+        )
+
+    def tick_then_apply(self, state, block):
+        return self.apply_block(self.tick(state, block.slot), block)
+
+    def tick_then_reapply(self, state, block):
+        return self.reapply_block(self.tick(state, block.slot), block)

@@ -34,7 +34,10 @@ from . import curve as pc
 from . import limbs as fe
 from . import verify as pv
 
-TILE = int(os.environ.get("OCT_PK_TILE", "256"))
+# 128 lanes/tile: the ed/kes/vrf cores peak ~17MB of scoped VMEM at 256
+# lanes on v5e (16MB limit) — measured OOM on hardware; 128 fits with
+# headroom and matches the lane register width.
+TILE = int(os.environ.get("OCT_PK_TILE", "128"))
 
 _BASE8_SHAPE = pc.BASE8_NP.shape  # [32, 80, 256] f32
 

@@ -85,8 +85,7 @@ def _sharded_verify(mesh, *cols):
         big = jnp.iinfo(jnp.int32).max
         local_first_bad = jnp.min(jnp.where(ok, big, pos))
         first_bad = jax.lax.pmin(local_first_bad, BATCH_AXIS)
-        n_ok = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
-        return v, ok, first_bad, n_ok
+        return v, ok, first_bad
 
     spec = P(BATCH_AXIS)
     out = jax.shard_map(
@@ -97,7 +96,6 @@ def _sharded_verify(mesh, *cols):
             pbatch.Verdicts(*(spec,) * 7),
             spec,
             P(),  # first_bad: replicated scalar
-            P(),  # n_ok: replicated scalar
         ),
         check_vma=False,
     )(*cols)
@@ -122,9 +120,11 @@ def sharded_run_batch(batch: pbatch.PraosBatch, mesh: Mesh | None = None):
         )
         for c in pbatch.flatten_batch(padded)
     ]
-    v, ok, first_bad, n_ok = _sharded_verify(mesh, *cols)
+    v, ok, first_bad = _sharded_verify(mesh, *cols)
     v = pbatch.Verdicts(*(np.asarray(x)[:b] for x in v))
     ok = np.asarray(ok)[:b]
     fb = int(first_bad)
-    n_pad_ok = int(np.sum(np.asarray(ok))) if b else 0
-    return v, (fb if fb < b else None), n_pad_ok
+    # counted host-side over the REAL lanes only (the mesh-divisibility
+    # pad lanes must not be included, so a device psum can't be used as-is)
+    n_ok = int(np.sum(ok)) if b else 0
+    return v, (fb if fb < b else None), n_ok

@@ -343,14 +343,19 @@ def count_blocks(db_path: str) -> int:
     return imm.n_blocks()
 
 
+def _stream_decoded(db_path: str, decode_block=None):
+    """Shared streaming loop of the per-block analyses: yield decoded
+    blocks in chain order (one decoder seam for all of them)."""
+    decode = decode_block or Block.from_bytes
+    for _entry, raw in open_immutable(db_path).stream_all():
+        yield decode(raw)
+
+
 def show_slot_block_no(db_path: str, out=None, decode_block=None) -> int:
     """ShowSlotBlockNo (Analysis.hs:76, showSlotBlockNo): print every
     block's slot and block number while streaming the ImmutableDB."""
-    imm = open_immutable(db_path)
-    decode = decode_block or Block.from_bytes
     n = 0
-    for entry, raw in imm.stream_all():
-        b = decode(raw)
+    for b in _stream_decoded(db_path, decode_block):
         h = b.header
         if out is not None:
             out(f"slot: {h.slot}, blockNo: {h.block_no}")
@@ -365,11 +370,8 @@ def count_tx_outputs(db_path: str, decode_block=None) -> int:
     `show_slot_block_no`-style streaming on demand)."""
     from ..ledger.mock import decode_tx
 
-    imm = open_immutable(db_path)
-    decode = decode_block or Block.from_bytes
     total = 0
-    for entry, raw in imm.stream_all():
-        b = decode(raw)
+    for b in _stream_decoded(db_path, decode_block):
         for tx in getattr(b, "txs", ()):
             try:
                 _ins, outs = decode_tx(tx)
@@ -386,12 +388,9 @@ def show_ebbs(db_path: str, decode_block=None, out=None) -> list[dict]:
     the reference checks against its hard-coded EBB table (we have no
     such table — synthetic chains — so `known` reports whether the EBB
     chains onto the previous block we streamed)."""
-    imm = open_immutable(db_path)
-    decode = decode_block or Block.from_bytes
     ebbs: list[dict] = []
     prev_hash = None
-    for entry, raw in imm.stream_all():
-        b = decode(raw)
+    for b in _stream_decoded(db_path, decode_block):
         h = b.header
         if getattr(h, "is_ebb", False) or getattr(
             getattr(h, "body", None), "is_ebb", False
@@ -514,6 +513,40 @@ def show_block_stats(db_path: str) -> dict:
     }
 
 
+def show_block_header_size(db_path: str, out=None, decode_block=None) -> int:
+    """ShowBlockHeaderSize (Analysis.hs:78, showHeaderSize): per-block
+    header byte size (HeaderSizeEvent) and the running maximum, which is
+    returned (MaxHeaderSizeEvent)."""
+    max_size = 0
+    for b in _stream_decoded(db_path, decode_block):
+        h = b.header
+        size = len(h.bytes_)
+        max_size = max(max_size, size)
+        if out is not None:
+            out(f"slot: {h.slot}, blockNo: {h.block_no}, headerSize: {size}")
+    if out is not None:
+        out(f"maxHeaderSize: {max_size}")
+    return max_size
+
+
+def show_block_txs_size(db_path: str, out=None, decode_block=None) -> tuple[int, int]:
+    """ShowBlockTxsSize (Analysis.hs:79, showTxSize): per-block tx count
+    and total tx byte size; returns the chain totals."""
+    n_txs = 0
+    total = 0
+    for b in _stream_decoded(db_path, decode_block):
+        txs = getattr(b, "txs", ())
+        block_bytes = sum(len(tx) for tx in txs)
+        n_txs += len(txs)
+        total += block_bytes
+        if out is not None:
+            out(f"slot: {b.header.slot}, numBlockTxs: {len(txs)}, "
+                f"blockTxsSize: {block_bytes}")
+    if out is not None:
+        out(f"total: {n_txs} txs, {total} bytes")
+    return n_txs, total
+
+
 def store_ledger_state_at(
     db_path: str,
     params: PraosParams,
@@ -617,7 +650,8 @@ def main(argv=None) -> None:
         "--analysis",
         choices=["only-validation", "benchmark-ledger-ops", "count-blocks",
                  "show-block-stats", "show-slot-block-no",
-                 "count-tx-outputs", "show-ebbs"],
+                 "count-tx-outputs", "show-ebbs", "show-block-header-size",
+                 "show-block-txs-size"],
         default="only-validation",
     )
     p.add_argument("--backend", choices=["device", "native", "sharded", "host"], default="device")
@@ -644,6 +678,13 @@ def main(argv=None) -> None:
     if a.analysis == "show-ebbs":
         rows = show_ebbs(a.db, out=print)
         print(f"{len(rows)} EBBs")
+        return
+    if a.analysis == "show-block-header-size":
+        print(f"maxHeaderSize: {show_block_header_size(a.db, out=print)}")
+        return
+    if a.analysis == "show-block-txs-size":
+        n, total = show_block_txs_size(a.db, out=print)
+        print(f"{n} txs, {total} bytes")
         return
     import os as _os
 

@@ -263,6 +263,29 @@ def test_count_tx_outputs(tmp_path):
     assert db_analyser.count_tx_outputs(path) == 6
 
 
+def test_show_block_header_size(synth_db):
+    """ShowBlockHeaderSize (Analysis.hs:78): one row per block, max is
+    the maximum of the per-block sizes and matches the real encoding."""
+    path, res = synth_db
+    lines = []
+    max_size = db_analyser.show_block_header_size(path, out=lines.append)
+    assert lines[-1] == f"maxHeaderSize: {max_size}"
+    sizes = [int(l.split("headerSize: ")[1]) for l in lines[:-1]]
+    assert len(sizes) == res.n_blocks
+    assert max(sizes) == max_size > 0
+
+
+def test_show_block_txs_size(tmp_path):
+    """ShowBlockTxsSize (Analysis.hs:79): per-block tx sizes over a
+    chain with real mock txs sum to the returned totals."""
+    path, ledger, genesis, lview2 = _valid_tx_chain(tmp_path)
+    lines = []
+    n, total = db_analyser.show_block_txs_size(path, out=lines.append)
+    assert n == 6
+    per_block = [int(l.split("blockTxsSize: ")[1]) for l in lines[:-1]]
+    assert sum(per_block) == total > 0
+
+
 def test_show_ebbs_none_on_praos_chain(synth_db):
     """ShowEBBs (Analysis.hs:81): a pure-Praos chain has no EBBs."""
     path, _res = synth_db
