@@ -250,6 +250,7 @@ class ShelleyState:
     rewards: Mapping[bytes, int]  # reward accounts of registered creds
     delegations: Mapping[bytes, bytes]
     pools: Mapping[bytes, PoolParams]
+    pool_deposits: Mapping[bytes, int]  # pool_id -> deposit actually taken
     retiring: Mapping[bytes, int]  # pool_id -> retirement epoch
     mark: Snapshot
     set_: Snapshot
@@ -279,6 +280,7 @@ class TxView:
     rewards: dict
     delegations: dict
     pools: dict
+    pool_deposits: dict
     retiring: dict
     proposals: dict
     pparams: PParams
@@ -324,6 +326,7 @@ class ShelleyLedger:
             utxo=utxo, fees=0, deposits=0, treasury=0,
             reserves=self.genesis.max_supply - circulating,
             stake_creds={}, rewards={}, delegations={}, pools={},
+            pool_deposits={},
             retiring={}, mark=EMPTY_SNAPSHOT, set_=EMPTY_SNAPSHOT,
             go=EMPTY_SNAPSHOT, blocks_current={}, blocks_prev={},
             prev_fees=0, pparams=self.genesis.pparams, proposals={},
@@ -379,7 +382,12 @@ class ShelleyLedger:
             v.pools[pp.pool_id] = pp
             # re-registration also cancels a pending retirement
             v.retiring.pop(pp.pool_id, None)
-            return (v.pparams.pool_deposit, 0) if fresh else (0, 0)
+            if fresh:
+                # record the deposit ACTUALLY taken so POOLREAP refunds
+                # exactly it even if pparams.pool_deposit changes later
+                v.pool_deposits[pp.pool_id] = v.pparams.pool_deposit
+                return v.pparams.pool_deposit, 0
+            return 0, 0
         if tag == 4:  # retirement
             pid, epoch = bytes(cert[1]), int(cert[2])
             if pid not in v.pools:
@@ -440,22 +448,14 @@ class ShelleyLedger:
             rewards=dict(view.rewards),
             delegations=dict(view.delegations),
             pools=dict(view.pools),
+            pool_deposits=dict(view.pool_deposits),
             retiring=dict(view.retiring),
             proposals=dict(view.proposals),
             pparams=view.pparams, epoch=view.epoch, slot=view.slot,
         )
-        deposits_taken = refunds = 0
-        for cert in tx.certs:
-            try:
-                dep, ref = self._apply_cert(scratch, cert)
-            except ShelleyTxError:
-                raise
-            except Exception as e:
-                # wrong arity, zero-denominator margins, non-int fields:
-                # malformed gossip is an INVALID TX, not a crash
-                raise ShelleyTxError(f"malformed certificate: {e!r}") from e
-            deposits_taken += dep
-            refunds += ref
+        # withdrawals BEFORE certificates (the DELEGS rule applies the
+        # wdrls in its base case, so withdraw-and-deregister in one tx is
+        # valid — the cert's zero-rewards check sees the drained account)
         withdrawn = 0
         seen = set()
         for cred, amt in tx.withdrawals:
@@ -471,6 +471,18 @@ class ShelleyLedger:
                 )
             scratch.rewards[cred] = 0
             withdrawn += amt
+        deposits_taken = refunds = 0
+        for cert in tx.certs:
+            try:
+                dep, ref = self._apply_cert(scratch, cert)
+            except ShelleyTxError:
+                raise
+            except Exception as e:
+                # wrong arity, zero-denominator margins, non-int fields:
+                # malformed gossip is an INVALID TX, not a crash
+                raise ShelleyTxError(f"malformed certificate: {e!r}") from e
+            deposits_taken += dep
+            refunds += ref
 
         produced_out = sum(c for _a, c in tx.outs)
         if (consumed + withdrawn + refunds
@@ -490,6 +502,7 @@ class ShelleyLedger:
         view.rewards = scratch.rewards
         view.delegations = scratch.delegations
         view.pools = scratch.pools
+        view.pool_deposits = scratch.pool_deposits
         view.retiring = scratch.retiring
         view.proposals = scratch.proposals
         view.deposit_delta += deposits_taken - refunds
@@ -505,6 +518,7 @@ class ShelleyLedger:
             rewards=dict(state.rewards),
             delegations=dict(state.delegations),
             pools=dict(state.pools),
+            pool_deposits=dict(state.pool_deposits),
             retiring=dict(state.retiring),
             proposals=dict(state.proposals),
             pparams=state.pparams,
@@ -616,25 +630,32 @@ class ShelleyLedger:
         if not dead:
             return st
         pools = {p: pp for p, pp in st.pools.items() if p not in dead}
+        pool_deposits = {
+            p: d for p, d in st.pool_deposits.items() if p not in dead
+        }
         retiring = {p: e for p, e in st.retiring.items() if p not in dead}
         rewards = dict(st.rewards)
         deposits = st.deposits
         treasury = st.treasury
         for pid in sorted(dead):
             pp = st.pools[pid]
-            deposits -= st.pparams.pool_deposit
+            # refund the deposit RECORDED at registration, not the current
+            # pparam (which a PPUP update may have changed since); every
+            # registered pool has an entry — a KeyError here means a
+            # desynced registration path, which must fail loudly
+            dep = st.pool_deposits[pid]
+            deposits -= dep
             if pp.reward_cred in st.stake_creds:
-                rewards[pp.reward_cred] = (
-                    rewards.get(pp.reward_cred, 0) + st.pparams.pool_deposit
-                )
+                rewards[pp.reward_cred] = rewards.get(pp.reward_cred, 0) + dep
             else:
-                treasury += st.pparams.pool_deposit
+                treasury += dep
         delegations = {
             c: p for c, p in st.delegations.items() if p not in dead
         }
         return replace(
-            st, pools=pools, retiring=retiring, rewards=rewards,
-            deposits=deposits, treasury=treasury, delegations=delegations,
+            st, pools=pools, pool_deposits=pool_deposits, retiring=retiring,
+            rewards=rewards, deposits=deposits, treasury=treasury,
+            delegations=delegations,
         )
 
     def _adopt_pparams(self, st: ShelleyState) -> ShelleyState:
@@ -711,6 +732,7 @@ class ShelleyLedger:
             rewards=view.rewards,
             delegations=view.delegations,
             pools=view.pools,
+            pool_deposits=view.pool_deposits,
             retiring=view.retiring,
             proposals=view.proposals,
             fees=st.fees + view.fee_delta,
@@ -731,13 +753,15 @@ class ShelleyLedger:
                 view.utxo.pop(txin, None)
             for ix, (addr, coin) in enumerate(tx.outs):
                 view.utxo[(tid, ix)] = (addr, coin)
+            # same order as apply_tx: withdrawals drain the account before
+            # any deregistration cert re-checks it
+            for cred, amt in tx.withdrawals:
+                view.rewards[cred] = 0
             dep = ref = 0
             for cert in tx.certs:
                 d, r = self._apply_cert(view, cert)
                 dep += d
                 ref += r
-            for cred, amt in tx.withdrawals:
-                view.rewards[cred] = 0
             view.deposit_delta += dep - ref
             view.fee_delta += tx.fee
         st = replace(
@@ -747,6 +771,7 @@ class ShelleyLedger:
             rewards=view.rewards,
             delegations=view.delegations,
             pools=view.pools,
+            pool_deposits=view.pool_deposits,
             retiring=view.retiring,
             proposals=view.proposals,
             fees=st.fees + view.fee_delta,

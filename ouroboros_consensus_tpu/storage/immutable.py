@@ -118,6 +118,14 @@ class ImmutableDB:
                 for m in chunks[i + 1 :]:
                     self._remove_chunk(m)
                 break
+        # sweep ORPHANED index files: an index written atomically (hence
+        # durable) whose chunk file's creation was never synced survives a
+        # crash alone; a later append to that chunk would extend the stale
+        # index and duplicate entries (ImmutableModel finding)
+        live = set(self._chunks)
+        for f in self.fs.listdir(self.path):
+            if f.endswith(".index") and int(f.split(".")[0]) not in live:
+                self.fs.remove(os.path.join(self.path, f))
 
     def _load_chunk(self, n: int, deep: bool, check_integrity):
         ipath = os.path.join(self.path, _index_name(n))

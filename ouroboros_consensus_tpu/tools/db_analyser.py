@@ -680,11 +680,11 @@ def main(argv=None) -> None:
         print(f"{len(rows)} EBBs")
         return
     if a.analysis == "show-block-header-size":
-        print(f"maxHeaderSize: {show_block_header_size(a.db, out=print)}")
+        # the analysis prints its own summary line through `out`
+        show_block_header_size(a.db, out=print)
         return
     if a.analysis == "show-block-txs-size":
-        n, total = show_block_txs_size(a.db, out=print)
-        print(f"{n} txs, {total} bytes")
+        show_block_txs_size(a.db, out=print)
         return
     import os as _os
 

@@ -343,7 +343,11 @@ class VolatileMachine(RuleBasedStateMachine):
         for h in survived:
             blk = self.model.by_hash[h]
             assert self.db.get_block_bytes(h) == blk.bytes_
-        # resync the model (file numbering restarts at the last file)
+        # resync the model. The write file is the HIGHEST-numbered file on
+        # disk, not the highest with surviving blocks: a tail file torn to
+        # zero records still exists, is the write file, and no longer
+        # shields earlier files from GC (reopen semantics, volatile.py
+        # _reopen; found by this machine).
         new = VolatileModel()
         for n in sorted(self.model.files):
             kept = [b for b in self.model.files[n] if b.hash_ in survived]
@@ -351,9 +355,12 @@ class VolatileMachine(RuleBasedStateMachine):
                 new.files[n] = kept
                 for b in kept:
                     new.by_hash[b.hash_] = b
-                new.write_file = max(new.write_file, n)
-        ns = sorted(new.files)
-        new.write_file = ns[-1] if ns else 0
+        ns = [
+            int(f[len("blocks-"):-len(".dat")])
+            for f in self.fs.listdir(self.PATH)
+            if f.startswith("blocks-") and f.endswith(".dat")
+        ]
+        new.write_file = max(ns) if ns else 0
         self.model = new
 
     @invariant()

@@ -274,6 +274,70 @@ def test_withdrawal_full_balance_rule():
     assert sh.total_ada(g, st3) == sh.total_ada(g, st2)
 
 
+def test_withdraw_and_deregister_in_one_tx():
+    """DELEGS applies withdrawals before certificates: the standard
+    'drain the reward account and deregister the stake key' tx is valid
+    in one go (the dereg cert's zero-rewards check sees the drained
+    account)."""
+    g, led, st0 = genesis([(pay(0), cred(0), 50000)])
+    fee = 1000
+    tx = sh.encode_tx(
+        [(bytes(32), 0)],
+        [(pay(0), cred(0), 50000 - fee - PP.key_deposit - PP.pool_deposit)],
+        fee=fee, certs=[(0, cred(0)), reg_pool_cert(1, reward=cred(0)),
+                        (4, pool_id(1), 1)],
+    )
+    st1 = apply_txs(led, st0, 1, tx)
+    st2 = led.tick(st1, EPOCH + 1).state  # reap -> rewards[cred0] = deposit
+    bal = st2.rewards[cred(0)]
+    assert bal == PP.pool_deposit
+    tx2 = sh.encode_tx(
+        [(sh.tx_id(tx), 0)],
+        [(pay(1), None, 50000 - 2 * fee - PP.pool_deposit + bal)],
+        fee=fee, withdrawals=[(cred(0), bal)], certs=[(1, cred(0))],
+    )
+    blk = FakeBlock(EPOCH + 2, [tx2])
+    st3 = led.apply_block(led.tick(st2, EPOCH + 2), blk)
+    assert cred(0) not in st3.stake_creds
+    assert cred(0) not in st3.rewards
+    assert sh.total_ada(g, st3) == sh.total_ada(g, st2)
+    # reapply replays the same order
+    assert led.reapply_block(led.tick(st2, EPOCH + 2), blk) == st3
+
+
+def test_pool_reap_refunds_recorded_deposit():
+    """POOLREAP refunds the deposit TAKEN at registration, not the
+    current pparams.pool_deposit a PPUP update may have changed since."""
+    gd = (b"G1" + b"\x00" * 26,)
+    g, led, st0 = genesis(
+        [(pay(0), cred(0), 50000)], genesis_delegates=gd, update_quorum=1,
+    )
+    fee = 1000
+    tx = sh.encode_tx(
+        [(bytes(32), 0)],
+        [(pay(0), cred(0), 50000 - fee - PP.key_deposit - PP.pool_deposit)],
+        fee=fee, certs=[(0, cred(0)), reg_pool_cert(1, reward=cred(0)),
+                        (5, gd[0], {"pool_deposit": PP.pool_deposit * 5})],
+    )
+    st1 = apply_txs(led, st0, 1, tx)
+    assert st1.pool_deposits[pool_id(1)] == PP.pool_deposit
+    st2 = led.tick(st1, EPOCH + 1).state  # adopts pool_deposit*5
+    assert st2.pparams.pool_deposit == PP.pool_deposit * 5
+    tx2 = sh.encode_tx(
+        [(sh.tx_id(tx), 0)],
+        [(pay(0), cred(0), 50000 - 2 * fee - PP.key_deposit - PP.pool_deposit)],
+        fee=fee, certs=[(4, pool_id(1), 2)],
+    )
+    st3 = apply_txs(led, st2, EPOCH + 2, tx2)
+    st4 = led.tick(st3, 2 * EPOCH + 1).state  # reap
+    assert pool_id(1) not in st4.pools
+    assert pool_id(1) not in st4.pool_deposits
+    # refund is the RECORDED deposit, and the pot zeroes out exactly
+    assert st4.rewards[cred(0)] == PP.pool_deposit
+    assert st4.deposits == PP.key_deposit
+    assert sh.total_ada(g, st4) == sh.total_ada(g, st0)
+
+
 # ---------------------------------------------------------------------------
 # Snapshots / ledger view / rewards
 # ---------------------------------------------------------------------------

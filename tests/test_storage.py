@@ -106,6 +106,34 @@ def test_immutable_corrupt_tail_truncates(tmp_path):
     assert db2.tip().slot == blocks[4].slot
 
 
+def test_immutable_orphan_index_swept_on_open():
+    """Crash recipe from the ImmutableModel: the chunk file's creation was
+    never synced (vanishes on crash) but a reparse had atomically written
+    the index (durable). Reopening over the orphan index must remove it —
+    otherwise a later append extends the stale index and the same block
+    appears twice."""
+    from ouroboros_consensus_tpu.utils.fs import MockFS
+
+    fs = MockFS()
+    b = forge_chain(1)[0]
+    db = ImmutableDB("imm", chunk_size=4, fs=fs)
+    db.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+    # index damage + reopen: reparse rebuilds the index (atomic => durable)
+    fs.truncate_file("imm/00000.index", 0)
+    db = ImmutableDB("imm", chunk_size=4, validate_all=True, fs=fs)
+    assert db.n_blocks() == 1
+    # crash: unsynced chunk file vanishes, durable index survives alone
+    fs.crash(0.0)
+    assert not fs.exists("imm/00000.chunk")
+    db = ImmutableDB("imm", chunk_size=4, validate_all=True, fs=fs)
+    assert db.is_empty
+    assert not fs.exists("imm/00000.index")  # orphan swept
+    # re-appending the block after recovery must not duplicate it
+    db.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+    db = ImmutableDB("imm", chunk_size=4, validate_all=True, fs=fs)
+    assert [(e.slot, raw) for e, raw in db.stream_all()] == [(b.slot, b.bytes_)]
+
+
 def test_immutable_truncate_after(tmp_path):
     db = ImmutableDB(str(tmp_path / "imm"), chunk_size=4)
     blocks = forge_chain(10)
