@@ -312,9 +312,21 @@ class ShelleyLedger:
 
     # -- construction ------------------------------------------------------
 
-    def genesis_state(self, initial_outputs) -> ShelleyState:
+    def genesis_state(
+        self,
+        initial_outputs,
+        initial_pools: tuple[PoolParams, ...] = (),
+        initial_delegations: tuple[tuple[bytes, bytes], ...] = (),
+    ) -> ShelleyState:
         """initial_outputs: [(payment, stake|None, coin)] spendable as
-        (zero-txid, ix); the rest of max_supply starts in reserves."""
+        (zero-txid, ix); the rest of max_supply starts in reserves.
+
+        `initial_pools` / `initial_delegations` are GENESIS STAKING (the
+        reference shelley-genesis `sgStaking` field): pools and stake
+        credentials pre-registered with no deposits taken, and all three
+        stake snapshots sealed from the genesis distribution — so
+        epoch-0/1 elections have stake before any on-chain registration
+        could possibly rotate into the SET snapshot."""
         utxo = {
             (bytes(32), ix): ((p, s), c)
             for ix, (p, s, c) in enumerate(initial_outputs)
@@ -322,16 +334,41 @@ class ShelleyLedger:
         circulating = sum(c for _p, _s, c in initial_outputs)
         if circulating > self.genesis.max_supply:
             raise ValueError("genesis outputs exceed max_supply")
-        return ShelleyState(
+        pools: dict[bytes, PoolParams] = {}
+        for p in initial_pools:
+            # same POOL-rule checks certificate registration enforces —
+            # an invalid genesis pool must not corrupt the reward math
+            if not (0 <= p.margin <= 1):
+                raise ValueError(f"genesis pool margin out of range: {p.margin}")
+            if p.cost < self.genesis.pparams.min_pool_cost:
+                raise ValueError(f"genesis pool cost below minPoolCost: {p.cost}")
+            if p.pool_id in pools:
+                raise ValueError(f"duplicate genesis pool {p.pool_id.hex()[:8]}")
+            pools[p.pool_id] = p
+        seen_creds = set()
+        for cred, pid in initial_delegations:
+            if pid not in pools:
+                raise ValueError(f"delegation to unknown pool {pid.hex()[:8]}")
+            if cred in seen_creds:
+                raise ValueError(f"duplicate genesis delegation {cred.hex()[:8]}")
+            seen_creds.add(cred)
+        st = ShelleyState(
             utxo=utxo, fees=0, deposits=0, treasury=0,
             reserves=self.genesis.max_supply - circulating,
-            stake_creds={}, rewards={}, delegations={}, pools={},
-            pool_deposits={},
+            stake_creds={cred: 0 for cred, _ in initial_delegations},
+            rewards={cred: 0 for cred, _ in initial_delegations},
+            delegations=dict(initial_delegations),
+            pools=pools,
+            pool_deposits={pid: 0 for pid in pools},
             retiring={}, mark=EMPTY_SNAPSHOT, set_=EMPTY_SNAPSHOT,
             go=EMPTY_SNAPSHOT, blocks_current={}, blocks_prev={},
             prev_fees=0, pparams=self.genesis.pparams, proposals={},
             epoch=0,
         )
+        if pools or initial_delegations:
+            snap = self._stake_distr(st)
+            st = replace(st, mark=snap, set_=snap, go=snap)
+        return st
 
     # -- LEDGER rules (per tx) ---------------------------------------------
 

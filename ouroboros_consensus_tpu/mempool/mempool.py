@@ -88,13 +88,23 @@ class Mempool:
 
     # -- internal ----------------------------------------------------------
 
+    def _validation_view(self, state, slot):
+        """The scratch state the per-tx rules fold over: the ledger's
+        `mempool_view` when it has one (the Shelley TxView — full
+        UTXOW/DELEGS/POOL scratch), else a plain UTxO dict (mock
+        ledgers). Both are consumed solely through `apply_tx`."""
+        mk = getattr(self._ledger, "mempool_view", None)
+        if mk is not None:
+            return mk(state, slot if slot is not None else 0)
+        return dict(state.utxo)
+
     def _sync_locked(self) -> list[TxTicket]:
         """Revalidate the pool against the current ledger anchor
         (syncWithLedger, Mempool/API.hs:191). Returns dropped tickets."""
         state, slot = self._get_ledger_state()
         self._anchor_state = state
         self._anchor_slot = slot
-        utxo = dict(state.utxo)
+        utxo = self._validation_view(state, slot)
         kept: list[TxTicket] = []
         dropped: list[TxTicket] = []
         for t in self._txs:
@@ -172,7 +182,7 @@ class Mempool:
         without mutating the pool; optionally cap to a block's budget."""
         with self._lock:
             txs = list(self._txs)
-        utxo = dict(state.utxo)
+        utxo = self._validation_view(state, slot)
         kept: list[TxTicket] = []
         used = 0
         for t in txs:

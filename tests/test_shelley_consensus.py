@@ -1,0 +1,230 @@
+"""Shelley ledger wired into the CONSENSUS stack: ExtLedger + ChainDB
+with the Praos protocol electing from LEDGER-DERIVED views.
+
+This is the real-era integration the reference gets from
+`ouroboros-consensus-cardano` Shelley: `protocol_ledger_view` serves the
+SET snapshot of the real STS state (Shelley/Ledger/Ledger.hs:584 area),
+so who may forge is decided by on-chain stake — registered via genesis
+staking (sgStaking analog) or via certificates in blocks, becoming
+electable only two epoch boundaries later (mark -> set rotation).
+
+With f = 1 the Praos leader check is deterministic in the view: a pool
+with positive SET-snapshot stake certainly wins, a pool with zero stake
+certainly loses — so chain-level adoption/rejection of forged blocks IS
+an assertion about the derived ledger view.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from fractions import Fraction
+
+from ouroboros_consensus_tpu.block import forge_block
+from ouroboros_consensus_tpu.ledger import ExtLedger
+from ouroboros_consensus_tpu.ledger import shelley as sh
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.protocol.instances import PraosProtocol
+from ouroboros_consensus_tpu.protocol.views import hash_key, hash_vrf_vk
+from ouroboros_consensus_tpu.storage.open import open_chaindb
+from ouroboros_consensus_tpu.testing import fixtures
+from ouroboros_consensus_tpu.utils.fs import MockFS
+
+EPOCH = 30
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=1000,
+    max_kes_evolutions=62,
+    security_param=3,
+    active_slot_coeff=Fraction(1),
+    epoch_length=EPOCH,
+    kes_depth=3,
+)
+PP = sh.PParams(
+    min_fee_a=0, min_fee_b=0, key_deposit=100, pool_deposit=1000,
+    e_max=5, n_opt=2,
+)
+ETA0 = b"\x2d" * 32
+
+POOL_A = fixtures.make_pool(0, kes_depth=PARAMS.kes_depth)
+POOL_B = fixtures.make_pool(1, kes_depth=PARAMS.kes_depth)
+POOL_C = fixtures.make_pool(2, kes_depth=PARAMS.kes_depth)
+
+
+def cred(i):
+    return b"c%02d" % i + b"\x00" * 25
+
+
+def pay(i):
+    return b"y%02d" % i + b"\x00" * 25
+
+
+def pool_params(pool, reward_cred):
+    return sh.PoolParams(
+        pool_id=hash_key(pool.vk_cold), vrf_hash=hash_vrf_vk(pool.vrf_vk),
+        pledge=0, cost=0, margin=Fraction(0), reward_cred=reward_cred,
+        owners=(),
+    )
+
+
+def build():
+    g = sh.ShelleyGenesis(
+        pparams=PP, epoch_length=EPOCH,
+        stability_window=PARAMS.stability_window, max_supply=10_000_000,
+    )
+    ledger = sh.ShelleyLedger(g)
+    st0 = ledger.genesis_state(
+        [(pay(0), cred(0), 60000), (pay(1), cred(1), 30000),
+         (pay(2), cred(2), 90000)],
+        initial_pools=(
+            pool_params(POOL_A, cred(0)), pool_params(POOL_B, cred(1)),
+        ),
+        initial_delegations=((cred(0), hash_key(POOL_A.vk_cold)),
+                             (cred(1), hash_key(POOL_B.vk_cold))),
+    )
+    ext = ExtLedger(ledger, PraosProtocol(PARAMS, use_device_batch=False))
+    genesis = ext.genesis(st0)
+    genesis = replace(
+        genesis,
+        header_state=replace(
+            genesis.header_state,
+            chain_dep_state=replace(
+                genesis.header_state.chain_dep_state, epoch_nonce=ETA0
+            ),
+        ),
+    )
+    return ext, genesis
+
+
+def current_nonce(ticked):
+    return ticked.ticked_header_state.ticked_chain_dep_state.state.epoch_nonce
+
+
+def test_mempool_over_shelley_ledger():
+    """The generic Mempool runs over the Shelley TxView seam: the full
+    STS rules validate adds (Mempool/API.hs addTx), and advancing the
+    anchor past a tx's TTL drops it on sync."""
+    from ouroboros_consensus_tpu.mempool import Mempool
+
+    ext, genesis = build()
+    ledger = ext.ledger
+    anchor = {"state": genesis.ledger_state, "slot": 1}
+    pool = Mempool(
+        ledger, lambda: (anchor["state"], anchor["slot"]),
+    )
+    spend = sh.encode_tx(
+        [(bytes(32), 0)], [(pay(9), None, 60000)], fee=0, ttl=10,
+    )
+    pool.add_tx(spend)
+    # double-spend of the same genesis input: rejected against the
+    # pool-extended view
+    import pytest
+
+    with pytest.raises(sh.ShelleyTxError):
+        pool.add_tx(sh.encode_tx(
+            [(bytes(32), 0)], [(pay(8), None, 60000)], fee=0,
+        ))
+    assert len(pool.get_snapshot().txs) == 1
+    # TTL expiry: advancing the anchor past slot 10 drops the tx
+    anchor["slot"] = 11
+    dropped = pool.sync_with_ledger()
+    assert [t.tx for t in dropped] == [spend]
+    assert not pool.get_snapshot().txs
+
+
+def test_genesis_staking_seeds_all_snapshots():
+    ext, genesis = build()
+    view = ext.tick(genesis, 1).ledger_view
+    distr = view.pool_distr
+    assert set(distr) == {hash_key(POOL_A.vk_cold), hash_key(POOL_B.vk_cold)}
+    # stake = utxo held by the delegating creds: 60000 vs 30000
+    assert distr[hash_key(POOL_A.vk_cold)].stake == Fraction(2, 3)
+    assert distr[hash_key(POOL_B.vk_cold)].stake == Fraction(1, 3)
+    assert distr[hash_key(POOL_A.vk_cold)].vrf_key_hash == hash_vrf_vk(POOL_A.vrf_vk)
+
+
+def test_chaindb_elects_from_ledger_derived_views():
+    """Drive a ChainDB whose election views come from the Shelley STS
+    state: genesis pools forge from slot 1; a pool registered ON CHAIN in
+    epoch 0 is rejected through epoch 1 (not yet in SET) and accepted in
+    epoch 2 (mark -> set rotation) — at chain-adoption level."""
+    ext, genesis = build()
+    db = open_chaindb("db", ext, genesis, k=PARAMS.security_param,
+                      chunk_size=50, fs=MockFS())
+
+    # the registration tx for pool C, delegating the rich cred(2) to it
+    reg_tx = sh.encode_tx(
+        [(bytes(32), 2)],
+        [(pay(2), cred(2), 90000 - PP.key_deposit - PP.pool_deposit)],
+        fee=0,
+        certs=[(0, cred(2)),
+               (3, hash_key(POOL_C.vk_cold), hash_vrf_vk(POOL_C.vrf_vk),
+                0, 0, 0, 1, cred(2), []),
+               (2, cred(2), hash_key(POOL_C.vk_cold))],
+    )
+
+    cur = genesis
+    prev = None
+    block_no = 0
+    c_rejected_epoch1 = False
+    c_adopted_epoch2 = False
+    slot = 1
+    while slot < 2 * EPOCH + EPOCH // 2:
+        ticked = ext.tick(cur, slot)
+        nonce = current_nonce(ticked)
+        view = ticked.ledger_view
+        epoch = slot // EPOCH
+
+        if epoch == 1 and not c_rejected_epoch1:
+            # C has been registered on chain since epoch 0 but is NOT in
+            # the SET snapshot yet: its block must be rejected
+            bad = forge_block(
+                PARAMS, POOL_C, slot=slot, block_no=block_no,
+                prev_hash=prev, epoch_nonce=nonce,
+            )
+            db.add_block(bad)
+            assert db.tip_point() is None or db.tip_point().hash_ != bad.hash_
+            assert bad.hash_ in db.invalid
+            c_rejected_epoch1 = True
+
+        leader = fixtures.find_leader(
+            PARAMS, [POOL_A, POOL_B, POOL_C], view, slot, nonce
+        )
+        if epoch < 2:
+            assert leader in (POOL_A, POOL_B), f"slot {slot}"
+        txs = (reg_tx,) if slot == 2 else ()
+        blk = forge_block(
+            PARAMS, leader, slot=slot, block_no=block_no, prev_hash=prev,
+            epoch_nonce=nonce, txs=txs,
+        )
+        db.add_block(blk)
+        assert db.tip_point() is not None
+        assert db.tip_point().hash_ == blk.hash_, f"slot {slot} not adopted"
+        cur = ext.apply_block(ticked, blk)
+        prev = blk.hash_
+        block_no += 1
+
+        if epoch == 2 and not c_adopted_epoch2:
+            # C's stake (90000 - deposits delegated at slot 2) is in SET
+            # from the epoch-2 boundary: now C forges and is ADOPTED
+            assert hash_key(POOL_C.vk_cold) in view.pool_distr
+            slot += 1
+            ticked = ext.tick(cur, slot)
+            nonce = current_nonce(ticked)
+            cblk = forge_block(
+                PARAMS, POOL_C, slot=slot, block_no=block_no,
+                prev_hash=prev, epoch_nonce=nonce,
+            )
+            db.add_block(cblk)
+            assert db.tip_point().hash_ == cblk.hash_
+            cur = ext.apply_block(ticked, cblk)
+            prev = cblk.hash_
+            block_no += 1
+            c_adopted_epoch2 = True
+        slot += 1
+
+    assert c_rejected_epoch1 and c_adopted_epoch2
+    # and the ledger really processed the registration: pool C is a
+    # real pool with a recorded deposit in the final state
+    final = cur.ledger_state
+    assert hash_key(POOL_C.vk_cold) in final.pools
+    assert final.pool_deposits[hash_key(POOL_C.vk_cold)] == PP.pool_deposit
+    db.close()
