@@ -160,6 +160,17 @@ class HardForkProtocol:
         ) else res
 
 
+class _HFMempoolView:
+    """Era-tagged mempool scratch: the inner view plus which era's rules
+    fold it (Combinator/Mempool.hs's era-indexed WrapValidatedGenTx)."""
+
+    __slots__ = ("era", "inner")
+
+    def __init__(self, era: int, inner):
+        self.era = era
+        self.inner = inner
+
+
 class HardForkLedger:
     """LedgerState (HardForkBlock xs) (Combinator/Ledger.hs) — same
     telescope walk for ledger states."""
@@ -234,11 +245,40 @@ class HardForkLedger:
     def ledger_view_forecast_at(self, state: HFState):
         return self.eras[state.era].ledger.ledger_view_forecast_at(state.inner)
 
-    def apply_tx(self, utxo: dict, tx_bytes: bytes) -> dict:
-        """Mempool path: plain txs validate under the newest era's rules
-        (earlier-era txs reach here through inject_tx's translations —
-        Combinator/Mempool.hs dispatches by the GenTx era tag)."""
-        return self.eras[-1].ledger.apply_tx(utxo, tx_bytes)
+    def mempool_view(self, state: HFState, slot: int):
+        """Mempool projection into the era of `slot` (the HFC mempool
+        validates against the current era, Combinator/Mempool.hs): the
+        anchor state is walked across any boundary first, then the inner
+        ledger's own view seam applies (Shelley TxView / mock dict)."""
+        target = self.summary.era_index_of_slot(slot)
+        if isinstance(state, HFState):
+            if target > state.era:
+                state = self._cross_eras(state, target)
+            era, inner_state = state.era, state.inner
+        else:
+            # an already-projected inner state: the forge path passes
+            # TickedHFState.state, which unwraps to the era's own ledger
+            # state — it belongs to the era of `slot`
+            era, inner_state = target, state
+        ledger = self.eras[era].ledger
+        mk = getattr(ledger, "mempool_view", None)
+        inner = mk(inner_state, slot) if mk is not None else dict(
+            inner_state.utxo
+        )
+        return _HFMempoolView(era, inner)
+
+    def apply_tx(self, view, tx_bytes: bytes):
+        """Mempool path: an era-tagged view (from `mempool_view`)
+        validates under ITS era's rules; a plain dict (legacy callers)
+        under the newest era's (earlier-era txs reach here through
+        inject_tx's translations — Combinator/Mempool.hs dispatches by
+        the GenTx era tag)."""
+        if isinstance(view, _HFMempoolView):
+            view.inner = self.eras[view.era].ledger.apply_tx(
+                view.inner, tx_bytes
+            )
+            return view
+        return self.eras[-1].ledger.apply_tx(view, tx_bytes)
 
     def tick_then_apply(self, state, block):
         return self.apply_block(self.tick(state, block.slot), block)

@@ -370,6 +370,49 @@ class ShelleyLedger:
             st = replace(st, mark=snap, set_=snap, go=snap)
         return st
 
+    def translate_from_utxo_ledger(
+        self,
+        prev_state,
+        at_slot: int,
+        stake_of=None,  # payment addr -> stake cred | None
+        initial_pools: tuple[PoolParams, ...] = (),
+        initial_delegations: tuple[tuple[bytes, bytes], ...] = (),
+    ) -> ShelleyState:
+        """Era translation INTO Shelley (the Byron->Shelley shape,
+        Cardano/CanHardFork.hs translateLedgerStateByronToShelleyWrapper):
+        the previous era's UTxO (outpoint -> (addr, coin)) is carried
+        over verbatim, re-addressed with the configured stake credential
+        per payment address, the Shelley genesis staking registers pools
+        and delegations exactly as `genesis_state` does, and all three
+        snapshots seal the carried-over distribution — elections in the
+        first Shelley epochs run on it, just as the reference bootstraps
+        from sgStaking across the Byron boundary."""
+        if at_slot % self.genesis.epoch_length != 0:
+            raise ValueError(
+                f"era boundary slot {at_slot} must start a Shelley epoch "
+                f"(epoch_length={self.genesis.epoch_length})"
+            )
+        stake_fn = stake_of if stake_of is not None else (lambda _a: None)
+        st = self.genesis_state(
+            [], initial_pools=initial_pools,
+            initial_delegations=initial_delegations,
+        )
+        utxo = {
+            k: ((addr, stake_fn(addr)), int(amt))
+            for k, (addr, amt) in prev_state.utxo.items()
+        }
+        circulating = sum(c for _a, c in utxo.values())
+        if circulating > self.genesis.max_supply:
+            raise ValueError("carried-over UTxO exceeds max_supply")
+        st = replace(
+            st, utxo=utxo,
+            reserves=self.genesis.max_supply - circulating,
+            epoch=at_slot // self.genesis.epoch_length,
+            tip_slot_=getattr(prev_state, "tip_slot_", None),
+        )
+        snap = self._stake_distr(st)
+        return replace(st, mark=snap, set_=snap, go=snap)
+
     # -- LEDGER rules (per tx) ---------------------------------------------
 
     def _apply_cert(self, v: TxView, cert: tuple) -> tuple[int, int]:

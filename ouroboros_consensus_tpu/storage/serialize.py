@@ -68,12 +68,246 @@ def decode_mock_state(o) -> MockState:
     return MockState(utxo, o[1])
 
 
-def encode_ext_state(st: ExtLedgerState) -> bytes:
-    return cbor.encode(
-        [encode_mock_state(st.ledger_state), encode_header_state(st.header_state)]
+# -- Shelley / HFC state codecs (tagged, format v2) --------------------------
+#
+# The original snapshot format is the UNTAGGED 2-list
+# [mock_state, header_state(praos)] — kept verbatim (golden-pinned,
+# tests/golden/ext_ledger_state.hex). Any other (ledger, chain-dep)
+# combination writes the 3-list ["v2", tagged_ledger, tagged_header];
+# decode dispatches on the shape. This mirrors the reference's
+# per-block-type EncodeDisk instances selected by the codec config
+# (Storage/Serialisation.hs), collapsed to runtime type dispatch.
+
+
+def _enc_fraction(f: Fraction):
+    return [f.numerator, f.denominator]
+
+
+def _dec_fraction(o) -> Fraction:
+    return Fraction(int(o[0]), int(o[1]))
+
+
+def _enc_shelley_snapshot(snap):
+    return [
+        sorted([c, v] for c, v in snap.stake.items()),
+        sorted([c, p] for c, p in snap.delegations.items()),
+        sorted(_enc_pool(p) for p in snap.pools.values()),
+    ]
+
+
+def _dec_shelley_snapshot(o):
+    from ..ledger import shelley as sh
+
+    return sh.Snapshot(
+        stake={bytes(c): int(v) for c, v in o[0]},
+        delegations={bytes(c): bytes(p) for c, p in o[1]},
+        pools={p.pool_id: p for p in (_dec_pool(e) for e in o[2])},
     )
+
+
+def _enc_pool(p):
+    return [
+        p.pool_id, p.vrf_hash, p.pledge, p.cost, _enc_fraction(p.margin),
+        p.reward_cred, sorted(p.owners),
+    ]
+
+
+def _dec_pool(o):
+    from ..ledger import shelley as sh
+
+    return sh.PoolParams(
+        pool_id=bytes(o[0]), vrf_hash=bytes(o[1]), pledge=int(o[2]),
+        cost=int(o[3]), margin=_dec_fraction(o[4]), reward_cred=bytes(o[5]),
+        owners=tuple(bytes(w) for w in o[6]),
+    )
+
+
+def _enc_pparams(pp):
+    # field list = PParams.UPDATABLE (single source of truth: a new
+    # updatable parameter extends the snapshot format automatically)
+    out = []
+    for f in type(pp).UPDATABLE:
+        v = getattr(pp, f)
+        out.append(_enc_fraction(v) if isinstance(v, Fraction) else v)
+    return out
+
+
+def _dec_pparams(o):
+    from ..ledger import shelley as sh
+
+    fields = sh.PParams.UPDATABLE
+    if len(o) != len(fields):
+        raise ValueError(
+            f"pparams snapshot has {len(o)} fields, expected {len(fields)}"
+        )
+    kw = {}
+    for f, v in zip(fields, o):
+        kw[f] = _dec_fraction(v) if isinstance(v, (list, tuple)) else int(v)
+    return sh.PParams(**kw)
+
+
+def encode_shelley_state(st) -> list:
+    utxo = sorted(
+        [txid, ix, a[0], a[1], c]
+        for (txid, ix), (a, c) in st.utxo.items()
+    )
+    return [
+        utxo, st.fees, st.deposits, st.treasury, st.reserves,
+        sorted([c, d] for c, d in st.stake_creds.items()),
+        sorted([c, v] for c, v in st.rewards.items()),
+        sorted([c, p] for c, p in st.delegations.items()),
+        sorted(_enc_pool(p) for p in st.pools.values()),
+        sorted([p, d] for p, d in st.pool_deposits.items()),
+        sorted([p, e] for p, e in st.retiring.items()),
+        _enc_shelley_snapshot(st.mark),
+        _enc_shelley_snapshot(st.set_),
+        _enc_shelley_snapshot(st.go),
+        sorted([p, n] for p, n in st.blocks_current.items()),
+        sorted([p, n] for p, n in st.blocks_prev.items()),
+        st.prev_fees,
+        _enc_pparams(st.pparams),
+        sorted(
+            [p, [[k, list(v) if isinstance(v, (list, tuple)) else v]
+                 for k, v in upd]]
+            for p, upd in st.proposals.items()
+        ),
+        st.epoch,
+        st.tip_slot_,
+    ]
+
+
+def decode_shelley_state(o):
+    from ..ledger import shelley as sh
+
+    return sh.ShelleyState(
+        utxo={
+            (bytes(e[0]), int(e[1])): (
+                (bytes(e[2]), None if e[3] is None else bytes(e[3])),
+                int(e[4]),
+            )
+            for e in o[0]
+        },
+        fees=int(o[1]), deposits=int(o[2]), treasury=int(o[3]),
+        reserves=int(o[4]),
+        stake_creds={bytes(c): int(d) for c, d in o[5]},
+        rewards={bytes(c): int(v) for c, v in o[6]},
+        delegations={bytes(c): bytes(p) for c, p in o[7]},
+        pools={p.pool_id: p for p in (_dec_pool(e) for e in o[8])},
+        pool_deposits={bytes(p): int(d) for p, d in o[9]},
+        retiring={bytes(p): int(e) for p, e in o[10]},
+        mark=_dec_shelley_snapshot(o[11]),
+        set_=_dec_shelley_snapshot(o[12]),
+        go=_dec_shelley_snapshot(o[13]),
+        blocks_current={bytes(p): int(n) for p, n in o[14]},
+        blocks_prev={bytes(p): int(n) for p, n in o[15]},
+        prev_fees=int(o[16]),
+        pparams=_dec_pparams(o[17]),
+        proposals={
+            bytes(p): tuple(
+                (k.decode() if isinstance(k, bytes) else k,
+                 tuple(v) if isinstance(v, list) else v)
+                for k, v in upd
+            )
+            for p, upd in o[18]
+        },
+        epoch=int(o[19]),
+        tip_slot_=o[20],
+    )
+
+
+def encode_ledger_state_tagged(st) -> list:
+    """Type-dispatched ledger-state codec (v2 snapshot payloads)."""
+    from ..hardfork.combinator import HFState
+    from ..ledger import shelley as sh
+
+    if isinstance(st, MockState):
+        return ["mock", encode_mock_state(st)]
+    if isinstance(st, sh.ShelleyState):
+        return ["shelley", encode_shelley_state(st)]
+    if isinstance(st, HFState):
+        return ["hf", st.era, encode_ledger_state_tagged(st.inner)]
+    raise TypeError(f"no snapshot codec for ledger state {type(st).__name__}")
+
+
+def decode_ledger_state_tagged(o):
+    from ..hardfork.combinator import HFState
+
+    tag = o[0].decode() if isinstance(o[0], bytes) else o[0]
+    if tag == "mock":
+        return decode_mock_state(o[1])
+    if tag == "shelley":
+        return decode_shelley_state(o[1])
+    if tag == "hf":
+        return HFState(int(o[1]), decode_ledger_state_tagged(o[2]))
+    raise ValueError(f"unknown ledger-state tag {tag!r}")
+
+
+def encode_chain_dep_tagged(st) -> list:
+    from ..hardfork.combinator import HFState
+    from ..protocol.instances import PBftState
+    from ..protocol.tpraos import TPraosState
+
+    if isinstance(st, TPraosState):  # subclass of PraosState: check first
+        return ["tpraos", encode_praos_state(st)]
+    if isinstance(st, PraosState):
+        return ["praos", encode_praos_state(st)]
+    if isinstance(st, PBftState):
+        return ["pbft", [list(s) for s in st.signers]]
+    if isinstance(st, HFState):
+        return ["hf", st.era, encode_chain_dep_tagged(st.inner)]
+    raise TypeError(f"no snapshot codec for chain-dep state {type(st).__name__}")
+
+
+def decode_chain_dep_tagged(o):
+    from ..hardfork.combinator import HFState
+    from ..protocol.instances import PBftState
+    from ..protocol.tpraos import TPraosState
+
+    tag = o[0].decode() if isinstance(o[0], bytes) else o[0]
+    if tag == "praos":
+        return decode_praos_state(o[1])
+    if tag == "tpraos":
+        import dataclasses
+
+        return TPraosState(**dataclasses.asdict(decode_praos_state(o[1])))
+    if tag == "pbft":
+        return PBftState(tuple((int(s), int(g)) for s, g in o[1]))
+    if tag == "hf":
+        return HFState(int(o[1]), decode_chain_dep_tagged(o[2]))
+    raise ValueError(f"unknown chain-dep tag {tag!r}")
+
+
+def _encode_header_state_tagged(hs: HeaderState):
+    tip = None if hs.tip is None else [hs.tip.slot, hs.tip.block_no, hs.tip.hash_]
+    return [tip, encode_chain_dep_tagged(hs.chain_dep_state)]
+
+
+def _decode_header_state_tagged(o) -> HeaderState:
+    tip = None if o[0] is None else AnnTip(o[0][0], o[0][1], bytes(o[0][2]))
+    return HeaderState(tip, decode_chain_dep_tagged(o[1]))
+
+
+def encode_ext_state(st: ExtLedgerState) -> bytes:
+    if isinstance(st.ledger_state, MockState) and type(
+        st.header_state.chain_dep_state
+    ) is PraosState:
+        # the original (golden-pinned) untagged format
+        return cbor.encode(
+            [encode_mock_state(st.ledger_state),
+             encode_header_state(st.header_state)]
+        )
+    return cbor.encode([
+        "v2",
+        encode_ledger_state_tagged(st.ledger_state),
+        _encode_header_state_tagged(st.header_state),
+    ])
 
 
 def decode_ext_state(data: bytes) -> ExtLedgerState:
     o = cbor.decode(data)
+    tag = o[0].decode() if isinstance(o[0], bytes) else o[0]
+    if tag == "v2":
+        return ExtLedgerState(
+            decode_ledger_state_tagged(o[1]), _decode_header_state_tagged(o[2])
+        )
     return ExtLedgerState(decode_mock_state(o[0]), decode_header_state(o[1]))

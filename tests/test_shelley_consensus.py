@@ -98,6 +98,241 @@ def current_nonce(ticked):
     return ticked.ticked_header_state.ticked_chain_dep_state.state.epoch_nonce
 
 
+def test_two_era_hfc_with_live_shelley_ledger():
+    """A 2-era HFC composite whose SECOND era runs the real Shelley STS
+    ledger: era A (MockLedger, Praos) hands its UTxO across the boundary
+    via translate_from_utxo_ledger (the Byron->Shelley translation
+    shape), stake seals from the carried distribution, and Shelley rules
+    are LIVE after the fork — an invalid Shelley tx makes its block
+    rejected at chain level, a valid one moves real value."""
+    import dataclasses
+    import functools
+
+    from ouroboros_consensus_tpu.block.praos_block import Block as PraosBlock
+    from ouroboros_consensus_tpu.hardfork.combinator import (
+        Era,
+        HardForkBlock,
+        HardForkLedger,
+        HardForkProtocol,
+        HFState,
+        decode_block,
+    )
+    from ouroboros_consensus_tpu.hardfork.history import (
+        EraParams as HEraParams,
+    )
+    from ouroboros_consensus_tpu.hardfork.history import summarize
+    from ouroboros_consensus_tpu.ledger import mock as mock_ledger
+
+    EP_A = 10
+    HF_EPOCH = 2  # boundary at slot 20
+    params_a = dataclasses.replace(PARAMS, epoch_length=EP_A)
+    params_b = dataclasses.replace(PARAMS, epoch_length=EP_A)
+
+    g = sh.ShelleyGenesis(
+        pparams=PP, epoch_length=EP_A,
+        stability_window=PARAMS.stability_window, max_supply=10_000_000,
+    )
+    shelley = sh.ShelleyLedger(g)
+    mock_view = fixtures.make_ledger_view([POOL_A])
+    mock = mock_ledger.MockLedger(
+        mock_ledger.MockConfig(mock_view, params_a.stability_window)
+    )
+
+    addr = b"rich-addr"
+    staking = dict(
+        stake_of=lambda a: cred(0) if a == addr else None,
+        initial_pools=(pool_params(POOL_A, cred(0)),),
+        initial_delegations=((cred(0), hash_key(POOL_A.vk_cold)),),
+    )
+    eras = [
+        Era("mockA", PraosProtocol(params_a, use_device_batch=False),
+            ledger=mock),
+        Era(
+            "shelleyB", PraosProtocol(params_b, use_device_batch=False),
+            ledger=shelley,
+            translate_ledger_state=lambda st: shelley.translate_from_utxo_ledger(
+                st, at_slot=HF_EPOCH * EP_A, **staking
+            ),
+        ),
+    ]
+    summary = summarize(
+        Fraction(0),
+        [HEraParams(EP_A, Fraction(1)), HEraParams(EP_A, Fraction(1))],
+        [HF_EPOCH, None],
+    )
+    protocol = HardForkProtocol(eras, summary)
+    hf_ledger = HardForkLedger(eras, summary)
+    codec = functools.partial(
+        decode_block,
+        era_decoders=[PraosBlock.from_bytes, PraosBlock.from_bytes],
+    )
+
+    ext = ExtLedger(hf_ledger, protocol)
+    genesis = ext.genesis(
+        hf_ledger.genesis_state(mock.genesis_state([(addr, 50000)]))
+    )
+    hs = genesis.header_state
+    genesis = replace(
+        genesis,
+        header_state=replace(
+            hs,
+            chain_dep_state=HFState(
+                0, replace(hs.chain_dep_state.inner, epoch_nonce=ETA0)
+            ),
+        ),
+    )
+    db = open_chaindb(
+        "db", ext, genesis, k=PARAMS.security_param, chunk_size=50,
+        fs=MockFS(), decode_block=codec,
+    )
+
+    cur, prev, bno = genesis, None, 0
+    shelley_rules_hit = False
+    for slot in range(1, 3 * EP_A):
+        era = protocol.era_of_slot(slot)
+        ticked = ext.tick(cur, slot)
+        nonce = ticked.ticked_header_state.ticked_chain_dep_state.inner.state.epoch_nonce
+        view = ticked.ledger_view
+        leader = fixtures.find_leader(PARAMS, [POOL_A], view, slot, nonce)
+        assert leader is POOL_A, f"slot {slot}: no leader in era {era}"
+
+        txs = ()
+        if era == 1 and not shelley_rules_hit:
+            # Shelley rules are live: a tx spending a missing input is
+            # rejected WITH ITS BLOCK at chain level...
+            bad_tx = sh.encode_tx(
+                [(b"\x77" * 32, 0)], [(pay(5), None, 5)], fee=0
+            )
+            bad = HardForkBlock(1, forge_block(
+                params_b, POOL_A, slot=slot, block_no=bno, prev_hash=prev,
+                epoch_nonce=nonce, txs=(bad_tx,),
+            ))
+            db.add_block(bad)
+            assert bad.hash_ in db.invalid
+            # ...and a valid one spending the CARRIED-OVER mock-era
+            # outpoint moves real value under the STS rules
+            txs = (sh.encode_tx(
+                [(bytes(32), 0)], [(pay(6), cred(0), 50000)], fee=0,
+            ),)
+            shelley_rules_hit = True
+
+        blk = HardForkBlock(era, forge_block(
+            params_a if era == 0 else params_b, POOL_A, slot=slot,
+            block_no=bno, prev_hash=prev, epoch_nonce=nonce, txs=txs,
+        ))
+        db.add_block(blk)
+        assert db.tip_point().hash_ == blk.hash_, f"slot {slot} (era {era})"
+        cur = ext.apply_block(ticked, blk)
+        prev, bno = blk.hash_, bno + 1
+
+    assert shelley_rules_hit
+    final = cur.ledger_state
+    assert final.era == 1
+    assert isinstance(final.inner, sh.ShelleyState)
+    # the spend really moved through the Shelley UTxO
+    assert any(a[0] == pay(6) for (a, _c) in final.inner.utxo.values())
+    # and stake still elects POOL_A from the carried-over distribution
+    assert hash_key(POOL_A.vk_cold) in ext.tick(
+        cur, 3 * EP_A
+    ).ledger_view.pool_distr
+    db.close()
+
+
+def test_mempool_over_hfc_shelley_era():
+    """The Mempool anchored past the fork validates under the SHELLEY
+    era's rules through HardForkLedger.mempool_view: a double spend of
+    the carried-over outpoint is rejected by the STS rules."""
+    import dataclasses
+
+    from ouroboros_consensus_tpu.hardfork.combinator import (
+        Era, HardForkLedger, HFState,
+    )
+    from ouroboros_consensus_tpu.hardfork.history import (
+        EraParams as HEraParams,
+    )
+    from ouroboros_consensus_tpu.hardfork.history import summarize
+    from ouroboros_consensus_tpu.ledger import mock as mock_ledger
+    from ouroboros_consensus_tpu.mempool import Mempool
+
+    EP_A = 10
+    params_a = dataclasses.replace(PARAMS, epoch_length=EP_A)
+    g = sh.ShelleyGenesis(
+        pparams=PP, epoch_length=EP_A,
+        stability_window=PARAMS.stability_window, max_supply=10_000_000,
+    )
+    shelley = sh.ShelleyLedger(g)
+    mock = mock_ledger.MockLedger(
+        mock_ledger.MockConfig(
+            fixtures.make_ledger_view([POOL_A]), params_a.stability_window
+        )
+    )
+    eras = [
+        Era("mockA", None, ledger=mock),
+        Era("shelleyB", None, ledger=shelley,
+            translate_ledger_state=lambda st:
+                shelley.translate_from_utxo_ledger(st, at_slot=2 * EP_A)),
+    ]
+    summary = summarize(
+        Fraction(0),
+        [HEraParams(EP_A, Fraction(1)), HEraParams(EP_A, Fraction(1))],
+        [2, None],
+    )
+    hf = HardForkLedger(eras, summary)
+    anchor = HFState(0, mock.genesis_state([(b"a0", 7000)]))
+    pool = Mempool(hf, lambda: (anchor, 2 * EP_A + 1))  # past the fork
+    pool.add_tx(sh.encode_tx(
+        [(bytes(32), 0)], [(pay(3), None, 7000)], fee=0,
+    ))
+    import pytest
+
+    with pytest.raises(sh.ShelleyTxError):
+        pool.add_tx(sh.encode_tx(
+            [(bytes(32), 0)], [(pay(4), None, 7000)], fee=0,
+        ))
+    assert len(pool.get_snapshot().txs) == 1
+
+
+def test_shelley_and_hf_snapshot_roundtrip():
+    """The v2 tagged snapshot codec: a Shelley state (with pools,
+    rewards, retiring, proposals, snapshots) inside an HFState, paired
+    with a Praos header state, survives encode -> decode exactly; the
+    legacy mock format is untouched (golden-pinned separately)."""
+    from ouroboros_consensus_tpu.hardfork.combinator import HFState
+    from ouroboros_consensus_tpu.ledger.extended import ExtLedgerState
+    from ouroboros_consensus_tpu.ledger.header_validation import HeaderState
+    from ouroboros_consensus_tpu.storage import serialize
+
+    ext, genesis = build()
+    led = ext.ledger
+    st = genesis.ledger_state
+    # make the state non-trivial: a real tx + an epoch boundary
+    tx = sh.encode_tx(
+        [(bytes(32), 2)],
+        [(pay(2), cred(2), 90000 - PP.key_deposit - PP.pool_deposit)],
+        fee=0,
+        certs=[(0, cred(2)),
+               (3, hash_key(POOL_C.vk_cold), hash_vrf_vk(POOL_C.vrf_vk),
+                0, 0, 1, 4, cred(2), [cred(2)]),
+               (2, cred(2), hash_key(POOL_C.vk_cold)),
+               (4, hash_key(POOL_C.vk_cold), 3)],
+    )
+
+    class Blk:
+        slot = 5
+        txs = [tx]
+
+    st = led.apply_block(led.tick(st, 5), Blk())
+    st = led.tick(st, EPOCH + 1).state  # rotate snapshots
+
+    hs = genesis.header_state
+    pair = ExtLedgerState(
+        HFState(1, st),
+        HeaderState(hs.tip, HFState(1, hs.chain_dep_state)),
+    )
+    back = serialize.decode_ext_state(serialize.encode_ext_state(pair))
+    assert back == pair
+
+
 def test_mempool_over_shelley_ledger():
     """The generic Mempool runs over the Shelley TxView seam: the full
     STS rules validate adds (Mempool/API.hs addTx), and advancing the
