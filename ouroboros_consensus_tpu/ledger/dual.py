@@ -7,11 +7,17 @@ specification (`src/byronspec/`), applied to the same blocks; divergence
 is a conformance bug, surfaced immediately rather than as a consensus
 split months later (driven by `byron-test/Test/ThreadNet/DualByron.hs`).
 
-Here the pair is (MockLedger, SpecLedger): the impl tracks a full UTxO
-map keyed by outpoint; the spec tracks only per-address balances — a
-coarser, independently-written semantics. The agreement relation (the
-reference's `agreeOnUTxO`-style projection) is "the impl's UTxO, summed
-per address, equals the spec's balance table".
+Here the pair is (MockLedger, SpecLedger). The spec is INDEPENDENTLY
+WRITTEN small-step semantics with its own abstract state and its own
+rule code: it decodes the wire bytes itself, computes tx ids itself
+(hashlib, not the impl's hash helpers), and owns an abstract UTxO — no
+impl state is consulted while it folds. Conformance is checked two ways
+per tx, exactly the reference's applyHelper pairing:
+
+  * VALIDITY agreement — impl and spec must accept/reject the same txs
+    (one accepting while the other rejects is a DualLedgerMismatch);
+  * STATE agreement — after each block the impl's UTxO and the spec's,
+    projected to per-address balances (`agreeOnUTxO`-style), must match.
 
 The DualLedger satisfies the same duck-typed ledger interface the
 storage layer consumes (ledger/abstract.py shapes), so a ChainDB can run
@@ -21,11 +27,13 @@ ThreadNet test does.
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass
 from typing import Mapping
 
+from ..utils import cbor
 from . import mock as mock_ledger
-from .mock import LedgerError, decode_tx
+from .mock import LedgerError
 
 
 class DualLedgerMismatch(AssertionError):
@@ -34,45 +42,79 @@ class DualLedgerMismatch(AssertionError):
 
 
 # ---------------------------------------------------------------------------
-# The spec side: per-address balance accounting (independent semantics)
+# The spec side: an independently written executable UTxO semantics
 # ---------------------------------------------------------------------------
+
+
+class SpecRejected(Exception):
+    """The spec's own invalid-tx verdict (never escapes the pairing)."""
 
 
 @dataclass(frozen=True)
 class SpecState:
-    balances: Mapping[bytes, int]  # addr -> total unspent value
+    """The spec's own abstract state: outpoint -> (owner, value)."""
+
+    utxo: Mapping[tuple[bytes, int], tuple[bytes, int]]
     tip_slot_: int | None = None
+
+    @property
+    def balances(self) -> dict[bytes, int]:
+        """Per-address totals — the agreement projection."""
+        out: dict[bytes, int] = {}
+        for addr, amt in self.utxo.values():
+            out[addr] = out.get(addr, 0) + amt
+        return out
 
 
 class SpecLedger:
-    """The executable specification: value moves between addresses;
-    inputs are resolved through the IMPL's view of what they are worth
-    (the spec abstracts outpoints away entirely)."""
+    """The executable specification, written from the wire format down:
+    its own decoder, its own tx-id computation, its own rules. It shares
+    nothing with MockLedger but the generic CBOR library (as byron-spec
+    shares cardano-binary)."""
+
+    def __init__(self, check_value_conservation: bool = True):
+        self.check_value_conservation = check_value_conservation
+
+    @staticmethod
+    def _tx_id(tx_bytes: bytes) -> bytes:
+        return hashlib.blake2b(tx_bytes, digest_size=32).digest()
 
     def genesis_state(self, initial_outputs) -> SpecState:
-        bal: dict[bytes, int] = {}
-        for addr, amt in initial_outputs:
-            bal[addr] = bal.get(addr, 0) + amt
-        return SpecState(bal)
+        return SpecState({
+            (bytes(32), ix): (addr, amt)
+            for ix, (addr, amt) in enumerate(initial_outputs)
+        })
 
-    def apply_tx(self, state: SpecState, tx_bytes: bytes, resolve) -> SpecState:
-        """`resolve(txin) -> (addr, amount)` supplies the input values
-        (the spec's environment; byron-spec gets them from its own
-        abstract UTxO — here the impl state is the oracle, which is fine
-        because the CONSERVATION and balance bookkeeping are still
-        checked independently)."""
-        ins, outs = decode_tx(tx_bytes)
-        bal = dict(state.balances)
+    def apply_tx(self, state: SpecState, tx_bytes: bytes) -> SpecState:
+        try:
+            obj = cbor.decode(tx_bytes)
+            ins = [(bytes(i[0]), i[1]) for i in obj[0]]
+            outs = [(bytes(o[0]), o[1]) for o in obj[1]]
+            # int() coercion would ACCEPT whole floats the impl rejects,
+            # turning an agreed rejection into a false mismatch
+            if not all(isinstance(ix, int) for _t, ix in ins):
+                raise SpecRejected("non-integer input index")
+            if not all(isinstance(amt, int) for _a, amt in outs):
+                raise SpecRejected("non-integer amount")
+        except SpecRejected:
+            raise
+        except Exception as e:
+            raise SpecRejected(f"undecodable: {e!r}") from e
+        if len(set(ins)) != len(ins):
+            raise SpecRejected("duplicate input")
+        utxo = dict(state.utxo)
+        consumed = 0
         for txin in ins:
-            addr, amt = resolve(txin)
-            if bal.get(addr, 0) < amt:
-                raise LedgerError(f"spec: {addr!r} underfunded")
-            bal[addr] -= amt
-            if not bal[addr]:
-                del bal[addr]
-        for addr, amt in outs:
-            bal[addr] = bal.get(addr, 0) + amt
-        return SpecState(bal, state.tip_slot_)
+            if txin not in utxo:
+                raise SpecRejected(f"missing input {txin!r}")
+            consumed += utxo.pop(txin)[1]
+        produced = sum(amt for _a, amt in outs)
+        if self.check_value_conservation and consumed != produced:
+            raise SpecRejected(f"not conserved: {consumed} != {produced}")
+        tid = self._tx_id(tx_bytes)
+        for ix, (addr, amt) in enumerate(outs):
+            utxo[(tid, ix)] = (addr, amt)
+        return SpecState(utxo, state.tip_slot_)
 
 
 # ---------------------------------------------------------------------------
@@ -112,7 +154,7 @@ class DualLedger:
     def __init__(self, config: mock_ledger.MockConfig):
         self.config = config
         self.impl = mock_ledger.MockLedger(config)
-        self.spec = SpecLedger()
+        self.spec = SpecLedger(config.check_value_conservation)
 
     def _check_agreement(self, st: DualState, where: str) -> None:
         projected = _project(st.impl.utxo)
@@ -141,18 +183,31 @@ class DualLedger:
         return self.impl.apply_tx(utxo, tx_bytes)
 
     def _apply(self, ticked: TickedDualState, block, check: bool) -> DualState:
-        """One incremental pass: the impl's UTxO fold IS the spec's
-        input-resolution oracle (values read before each tx mutates)."""
+        """Fold BOTH ledgers independently over the same txs, requiring
+        validity agreement per tx (the reference applyHelper pairs the
+        two outcomes) and state agreement per block."""
         utxo = dict(ticked.state.impl.utxo)
         spec = ticked.state.spec
         for tx in block.txs:
-            ins, _outs = decode_tx(tx)
-            resolved = {i: utxo[i] for i in ins if i in utxo}
-            utxo = self.impl.apply_tx(utxo, tx)
-            spec = self.spec.apply_tx(spec, tx, resolved.__getitem__)
+            impl_err = spec_err = None
+            try:
+                utxo = self.impl.apply_tx(utxo, tx)
+            except LedgerError as e:
+                impl_err = e
+            try:
+                spec = self.spec.apply_tx(spec, tx)
+            except SpecRejected as e:
+                spec_err = e
+            if (impl_err is None) != (spec_err is None):
+                raise DualLedgerMismatch(
+                    f"block @{block.slot}: validity disagreement — "
+                    f"impl: {impl_err!r}, spec: {spec_err!r}"
+                )
+            if impl_err is not None:
+                raise impl_err  # both agree the tx is invalid
         out = DualState(
             mock_ledger.MockState(utxo, ticked.slot),
-            SpecState(spec.balances, block.slot),
+            SpecState(spec.utxo, block.slot),
         )
         if check:
             self._check_agreement(out, f"block @{block.slot}")

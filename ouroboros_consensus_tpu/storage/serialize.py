@@ -106,9 +106,11 @@ def _dec_shelley_snapshot(o):
 
 
 def _enc_pool(p):
+    # owners keep their wire order (certificates store them as-is):
+    # sorting here would break the decode(encode(st)) == st identity
     return [
         p.pool_id, p.vrf_hash, p.pledge, p.cost, _enc_fraction(p.margin),
-        p.reward_cred, sorted(p.owners),
+        p.reward_cred, list(p.owners),
     ]
 
 
@@ -219,6 +221,7 @@ def encode_ledger_state_tagged(st) -> list:
     """Type-dispatched ledger-state codec (v2 snapshot payloads)."""
     from ..hardfork.combinator import HFState
     from ..ledger import shelley as sh
+    from ..ledger.dual import DualState
 
     if isinstance(st, MockState):
         return ["mock", encode_mock_state(st)]
@@ -226,6 +229,12 @@ def encode_ledger_state_tagged(st) -> list:
         return ["shelley", encode_shelley_state(st)]
     if isinstance(st, HFState):
         return ["hf", st.era, encode_ledger_state_tagged(st.inner)]
+    if isinstance(st, DualState):
+        spec = st.spec
+        utxo = sorted(
+            [t, ix, a, v] for (t, ix), (a, v) in spec.utxo.items()
+        )
+        return ["dual", encode_mock_state(st.impl), [utxo, spec.tip_slot_]]
     raise TypeError(f"no snapshot codec for ledger state {type(st).__name__}")
 
 
@@ -239,6 +248,16 @@ def decode_ledger_state_tagged(o):
         return decode_shelley_state(o[1])
     if tag == "hf":
         return HFState(int(o[1]), decode_ledger_state_tagged(o[2]))
+    if tag == "dual":
+        from ..ledger.dual import DualState, SpecState
+
+        spec_utxo = {
+            (bytes(e[0]), int(e[1])): (bytes(e[2]), int(e[3]))
+            for e in o[2][0]
+        }
+        return DualState(
+            decode_mock_state(o[1]), SpecState(spec_utxo, o[2][1])
+        )
     raise ValueError(f"unknown ledger-state tag {tag!r}")
 
 

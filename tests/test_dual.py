@@ -79,15 +79,73 @@ def test_dual_ledger_lockstep(tmp_path):
     assert dict(st.spec.balances) == {b"dave": 50, b"erin": 20, b"bob": 30}
 
 
+def test_dual_state_snapshot_roundtrip():
+    """DualState survives the v2 snapshot codec (the ChainDB writes a
+    final snapshot on close, so the dual net must be serializable)."""
+    from ouroboros_consensus_tpu.ledger.header_validation import HeaderState
+    from ouroboros_consensus_tpu.ledger.extended import ExtLedgerState
+    from ouroboros_consensus_tpu.protocol.praos import PraosState
+    from ouroboros_consensus_tpu.storage import serialize
+
+    ledger = DualLedger(mock_ledger.MockConfig(LVIEW, PARAMS.stability_window))
+    st = ledger.genesis_state(GENESIS_OUTS)
+    pair = ExtLedgerState(st, HeaderState(None, PraosState(epoch_nonce=ETA0)))
+    assert serialize.decode_ext_state(serialize.encode_ext_state(pair)) == pair
+
+
 def test_dual_ledger_catches_divergence():
     """Tampering with one side's state makes the next block application
     throw DualLedgerMismatch — the conformance alarm."""
     ledger = DualLedger(mock_ledger.MockConfig(LVIEW, PARAMS.stability_window))
     st = ledger.genesis_state(GENESIS_OUTS)
-    # corrupt the SPEC side: bob's balance off by one
-    bad = DualState(st.impl, SpecState({b"alice": 70, b"bob": 29}))
+    # corrupt the SPEC side's own abstract UTxO: bob's output off by one
+    bad_utxo = dict(st.spec.utxo)
+    bad_utxo[(bytes(32), 1)] = (b"bob", 29)
+    bad = DualState(st.impl, SpecState(bad_utxo))
     tx = encode_tx([(bytes(32), 0)], [(b"carol", 70)])
     b = forge_block(PARAMS, POOL, slot=1, block_no=0, prev_hash=None,
                     epoch_nonce=ETA0, txs=(tx,))
     with pytest.raises(DualLedgerMismatch):
         ledger.tick_then_apply(bad, b)
+
+
+def test_dual_ledger_catches_validity_disagreement():
+    """If one side accepts a tx the other rejects, the pairing throws:
+    here the spec is missing the spent outpoint entirely, so the spec
+    rejects (missing input) while the impl accepts."""
+    ledger = DualLedger(mock_ledger.MockConfig(LVIEW, PARAMS.stability_window))
+    st = ledger.genesis_state(GENESIS_OUTS)
+    spec_utxo = dict(st.spec.utxo)
+    del spec_utxo[(bytes(32), 0)]  # alice's output unknown to the spec
+    bad = DualState(st.impl, SpecState(spec_utxo))
+    tx = encode_tx([(bytes(32), 0)], [(b"carol", 70)])
+    b = forge_block(PARAMS, POOL, slot=1, block_no=0, prev_hash=None,
+                    epoch_nonce=ETA0, txs=(tx,))
+    with pytest.raises(DualLedgerMismatch, match="validity disagreement"):
+        ledger.tick_then_apply(bad, b)
+
+
+def test_dual_both_sides_reject_invalid_tx():
+    """An invalid tx (value not conserved) is rejected by BOTH sides in
+    agreement: the impl's error propagates, no mismatch is raised."""
+    from ouroboros_consensus_tpu.ledger.mock import ValueNotConserved
+
+    ledger = DualLedger(mock_ledger.MockConfig(LVIEW, PARAMS.stability_window))
+    st = ledger.genesis_state(GENESIS_OUTS)
+    tx = encode_tx([(bytes(32), 0)], [(b"carol", 71)])  # creates value
+    b = forge_block(PARAMS, POOL, slot=1, block_no=0, prev_hash=None,
+                    epoch_nonce=ETA0, txs=(tx,))
+    with pytest.raises(ValueNotConserved):
+        ledger.tick_then_apply(st, b)
+
+    # a float amount (decodable, non-int) must be an AGREED rejection,
+    # not a validity disagreement: the spec rejects non-int amounts
+    # rather than coercing 70.0 -> 70
+    from ouroboros_consensus_tpu.ledger.mock import InvalidTx
+    from ouroboros_consensus_tpu.utils import cbor
+
+    float_tx = cbor.encode([[[bytes(32), 0]], [[b"carol", 70.0]]])
+    b2 = forge_block(PARAMS, POOL, slot=1, block_no=0, prev_hash=None,
+                     epoch_nonce=ETA0, txs=(float_tx,))
+    with pytest.raises(InvalidTx):
+        ledger.tick_then_apply(st, b2)
