@@ -87,9 +87,11 @@ class SpecLedger:
 
     def apply_tx(self, state: SpecState, tx_bytes: bytes) -> SpecState:
         try:
-            obj = cbor.decode(tx_bytes)
-            ins = [(bytes(i[0]), i[1]) for i in obj[0]]
-            outs = [(bytes(o[0]), o[1]) for o in obj[1]]
+            # exactly-two unpack: extra trailing elements must be an
+            # agreed rejection (the impl's decode_tx unpacks the same way)
+            ins_o, outs_o = cbor.decode(tx_bytes)
+            ins = [(bytes(i[0]), i[1]) for i in ins_o]
+            outs = [(bytes(o[0]), o[1]) for o in outs_o]
             # int() coercion would ACCEPT whole floats the impl rejects,
             # turning an agreed rejection into a false mismatch
             if not all(isinstance(ix, int) for _t, ix in ins):

@@ -210,6 +210,11 @@ class MockLedger:
             # an INVALID TX, not a crash — peers gossip arbitrary bytes
             if len(set(ins)) != len(ins):
                 raise MissingInput(ins[0])  # duplicate input spends
+            if not all(isinstance(ix, int) for _t, ix in ins):
+                # a float index like 0.0 would FIND the int-keyed
+                # outpoint (0.0 == 0 under dict lookup) — reject the
+                # malformed encoding instead
+                raise InvalidTx("non-integer input index")
             consumed = 0
             for txin in ins:
                 if txin not in utxo:

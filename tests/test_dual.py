@@ -149,3 +149,15 @@ def test_dual_both_sides_reject_invalid_tx():
                      epoch_nonce=ETA0, txs=(float_tx,))
     with pytest.raises(InvalidTx):
         ledger.tick_then_apply(st, b2)
+
+    # likewise a float input INDEX (0.0 finds the int-keyed outpoint
+    # under dict lookup) and a tx with trailing garbage elements: both
+    # must be agreed rejections, not mismatches
+    for bad in (
+        cbor.encode([[[bytes(32), 0.0]], [[b"carol", 70]]]),
+        cbor.encode([[[bytes(32), 0]], [[b"carol", 70]], 99]),
+    ):
+        bb = forge_block(PARAMS, POOL, slot=1, block_no=0, prev_hash=None,
+                         epoch_nonce=ETA0, txs=(bad,))
+        with pytest.raises(InvalidTx):
+            ledger.tick_then_apply(st, bb)

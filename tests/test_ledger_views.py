@@ -172,3 +172,104 @@ def test_forecast_serves_derived_views(pools):
     fc = ledger.ledger_view_forecast_at(st)
     v = fc.view_fn(5)  # same epoch
     assert v.pool_distr[pools[0].pool_id].stake == Fraction(9, 10)
+
+
+# ---------------------------------------------------------------------------
+# The same discipline over the REAL Shelley STS ledger (ledger/shelley.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def shelley_chain(tmp_path_factory, pools):
+    """A Shelley-backed on-disk chain where a pool registered ON CHAIN
+    (epoch 0) starts forging in epoch 2: only ledger-derived views can
+    revalidate it."""
+    from ouroboros_consensus_tpu.ledger import shelley as sh
+    from ouroboros_consensus_tpu.protocol.views import hash_key
+    from ouroboros_consensus_tpu.storage.immutable import ImmutableDB
+    from ouroboros_consensus_tpu.block import forge_block
+
+    pool_c = fixtures.make_pool(7, kes_depth=PARAMS.kes_depth)
+    pp = sh.PParams(min_fee_a=0, min_fee_b=0, key_deposit=10, pool_deposit=10)
+    g = sh.ShelleyGenesis(
+        pparams=pp, epoch_length=PARAMS.epoch_length,
+        stability_window=PARAMS.stability_window, max_supply=1_000_000,
+    )
+    ledger = sh.ShelleyLedger(g)
+
+    def cred(i):
+        return b"sc%d" % i + b"\x00" * 25
+
+    def pool_params(p, rc):
+        return sh.PoolParams(
+            pool_id=hash_key(p.vk_cold), vrf_hash=hash_vrf_vk(p.vrf_vk),
+            pledge=0, cost=0, margin=Fraction(0), reward_cred=rc, owners=(),
+        )
+
+    st0 = ledger.genesis_state(
+        [(b"pay-a", cred(0), 900), (b"pay-c", cred(2), 100)],
+        initial_pools=(pool_params(pools[0], cred(0)),),
+        initial_delegations=((cred(0), hash_key(pools[0].vk_cold)),),
+    )
+    reg_tx = sh.encode_tx(
+        [(bytes(32), 1)], [(b"pay-c", cred(2), 100 - 20)], fee=0,
+        certs=[(0, cred(2)),
+               (3, hash_key(pool_c.vk_cold), hash_vrf_vk(pool_c.vrf_vk),
+                0, 0, 0, 1, cred(2), []),
+               (2, cred(2), hash_key(pool_c.vk_cold))],
+    )
+
+    path = str(tmp_path_factory.mktemp("shelley_chain"))
+    import os
+
+    imm = ImmutableDB(
+        os.path.join(path, "immutable"), chunk_size=PARAMS.epoch_length
+    )
+    forgers = [pools[0], pool_c]
+    st, lst, prev, bno = praos.PraosState(), st0, None, 0
+    c_forged = 0
+    for slot in range(1, 3 * PARAMS.epoch_length):
+        tls = ledger.tick(lst, slot)
+        view = ledger.view_for_epoch(tls.state, PARAMS.epoch_of(slot))
+        ticked = praos.tick(PARAMS, view, slot, st)
+        nonce = ticked.state.epoch_nonce
+        leader = fixtures.find_leader(PARAMS, forgers, view, slot, nonce)
+        if leader is None:
+            continue
+        if leader is pool_c:
+            c_forged += 1
+        txs = (reg_tx,) if bno == 0 else ()
+        blk = forge_block(
+            PARAMS, leader, slot=slot, block_no=bno, prev_hash=prev,
+            epoch_nonce=nonce, txs=txs,
+        )
+        imm.append_block(blk.slot, blk.block_no, blk.hash_, blk.bytes_)
+        st = praos.update(PARAMS, blk.header.to_view(), slot, ticked)
+        lst = ledger.tick_then_apply(lst, blk)
+        prev, bno = blk.hash_, bno + 1
+    imm.flush()
+    assert c_forged > 0, "pool C must have forged in epoch >= 2"
+    return path, bno, ledger, st0
+
+
+def test_shelley_revalidation_with_derived_views(shelley_chain):
+    """db-analyser replays the REAL STS ledger and derives each epoch's
+    pool distribution from its stake snapshots; the chain (including the
+    on-chain-registered pool's blocks) validates clean."""
+    path, n_blocks, ledger, st0 = shelley_chain
+    out = db_analyser.revalidate(
+        path, PARAMS, lview=None, backend="native",
+        ledger=ledger, genesis_state=st0,
+    )
+    assert out.error is None, repr(out.error)
+    assert out.n_valid == out.n_blocks == n_blocks
+
+
+def test_shelley_wrong_view_fails(shelley_chain):
+    """Replaying against the constant GENESIS view rejects the first
+    block forged by the on-chain-registered pool (unknown stake pool)."""
+    path, n_blocks, ledger, st0 = shelley_chain
+    genesis_view = ledger.view_for_epoch(st0, 0)
+    out = db_analyser.revalidate(path, PARAMS, genesis_view, backend="native")
+    assert out.error is not None
+    assert out.n_valid < n_blocks

@@ -292,6 +292,38 @@ def test_mempool_over_hfc_shelley_era():
     assert len(pool.get_snapshot().txs) == 1
 
 
+def test_node_kernel_forges_over_shelley_ledger():
+    """A full NodeKernel over the Shelley ledger: the forging loop's
+    leadership comes from the ledger-derived view, the mempool snapshot
+    (full STS validation) feeds the block body, and adoption syncs the
+    pool — the NodeKernel.hs forge path on a real-era ledger."""
+    from ouroboros_consensus_tpu.node.kernel import NodeKernel, SlotClock
+
+    ext, genesis = build()
+    db = open_chaindb("db", ext, genesis, k=PARAMS.security_param,
+                      chunk_size=50, fs=MockFS())
+    node = NodeKernel(
+        "n0", db, ext.protocol, ext.ledger, pool=POOL_A,
+        clock=SlotClock(1.0),
+    )
+    spend = sh.encode_tx(
+        [(bytes(32), 0)], [(pay(9), cred(0), 60000)], fee=0,
+    )
+    node.mempool.add_tx(spend)
+    forged = []
+    for slot in range(1, 8):
+        blk = node.try_forge(slot)
+        if blk is not None:
+            forged.append(blk)
+    assert forged, "POOL_A has genesis stake and f=1: it must forge"
+    assert any(spend in b.txs for b in forged), "mempool tx not included"
+    st = db.current_ledger().ledger_state
+    assert any(a[0] == pay(9) for (a, _c) in st.utxo.values())
+    # adoption synced the mempool: the included tx is gone
+    assert not node.mempool.get_snapshot().txs
+    db.close()
+
+
 def test_shelley_and_hf_snapshot_roundtrip():
     """The v2 tagged snapshot codec: a Shelley state (with pools,
     rewards, retiring, proposals, snapshots) inside an HFState, paired
