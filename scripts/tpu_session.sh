@@ -1,0 +1,33 @@
+#!/bin/bash
+# One-shot TPU measurement session for round 3. Run when the axon tunnel
+# is healthy. Stages are separate processes so one wedge loses one stage,
+# not the session; everything lands in the persistent compilation cache
+# (/tmp/ouroboros-jax-cache) so the driver's bench.py run compiles
+# NOTHING. Logs to scripts/tpu_session_logs/.
+set -u
+cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR=/tmp/ouroboros-jax-cache
+LOGDIR=scripts/tpu_session_logs
+mkdir -p "$LOGDIR"
+
+stage() {  # stage <name> <timeout-s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "== $name (budget ${tmo}s) $(date -u +%H:%M:%S)"
+  timeout "$tmo" "$@" > "$LOGDIR/$name.log" 2>&1
+  echo "   rc=$? $(tail -1 "$LOGDIR/$name.log" | cut -c1-120)"
+}
+
+# 0. probe
+stage probe 120 python -c "import jax, jax.numpy as jnp; assert jax.devices()[0].platform=='tpu'; print((jnp.ones((8,8))+1).sum())" || true
+
+# 1. per-kernel compile attribution + hot timing at production batch
+#    (tile=128). This ALSO populates the cache for every kernel.
+stage time_kernels 3500 python -u scripts/time_pk_kernels.py 8192
+
+# 2. end-to-end bench exactly as the driver runs it (cache now warm)
+stage bench 1800 python -u bench.py
+
+# 3. the BASELINE config suite (configs 2-5 device-side numbers)
+stage bench_suite 3600 python -u scripts/bench_suite.py --scale 0.5
+
+echo "session done $(date -u +%H:%M:%S); logs in $LOGDIR"
