@@ -31,6 +31,9 @@ NODE_TO_NODE_VERSIONS: dict[int, frozenset] = {
 NODE_TO_CLIENT_VERSIONS: dict[int, frozenset] = {
     1: frozenset({"localstatequery", "localtxsubmission"}),
     2: frozenset({"localstatequery", "localtxsubmission", "localtxmonitor"}),
+    # v3 extends only the QUERY vocabulary (the Shelley ledger queries,
+    # localstate.QUERY_MIN_VERSION) — same protocol set as v2
+    3: frozenset({"localstatequery", "localtxsubmission", "localtxmonitor"}),
 }
 
 

@@ -34,10 +34,22 @@ class QueryUnsupported(QueryError):
     (Ledger/Query.hs queryVersion gating)."""
 
 
-LATEST_QUERY_VERSION = 2
+LATEST_QUERY_VERSION = 3
 
 # queryVersion (Ledger/Query.hs): the minimum negotiated version each
 # query needs — older clients cannot name newer queries
+# the Shelley ledger query family (shelley Ledger/Query.hs): era-
+# specific — on a non-Shelley state they fail with EraMismatch, exactly
+# the HFC's QueryIfCurrent behavior. Single source of truth: version
+# gating below derives from this set.
+_SHELLEY_QUERIES = frozenset({
+    "get_epoch_no", "get_stake_distribution", "get_stake_pools",
+    "get_stake_pool_params", "get_current_pparams",
+    "get_proposed_pparams_updates", "get_rewards",
+    "get_delegations_and_rewards", "get_utxo_by_address",
+    "get_account_state",
+})
+
 QUERY_MIN_VERSION = {
     "get_chain_block_no": 1,
     "get_chain_point": 1,
@@ -45,7 +57,74 @@ QUERY_MIN_VERSION = {
     "get_utxo": 1,
     "get_balance": 1,
     "get_pool_distr": 2,
+    **{q: 3 for q in _SHELLEY_QUERIES},
 }
+
+
+class EraMismatch(QueryError):
+    """An era-specific query hit a state of another era — the HFC's
+    QueryIfCurrent mismatch result (HardFork/Combinator/Ledger/Query.hs),
+    surfaced as a failure the client can retry after the era bump."""
+
+
+def _shelley_state(ledger_state):
+    """Unwrap (possibly HFC-nested) state to a ShelleyState or raise
+    EraMismatch."""
+    from ..hardfork.combinator import HFState
+    from ..ledger.shelley import ShelleyState
+
+    st = ledger_state
+    while isinstance(st, HFState):
+        st = st.inner
+    if not isinstance(st, ShelleyState):
+        raise EraMismatch(
+            f"Shelley query against {type(st).__name__} state"
+        )
+    return st
+
+
+def _run_shelley_query(st, name: str, args):
+    """shelley Ledger/Query.hs vocabulary over the REAL STS state."""
+    from fractions import Fraction
+
+    if name == "get_epoch_no":
+        return st.epoch
+    if name == "get_stake_distribution":
+        # GetStakeDistribution: the SET snapshot's per-pool fractions
+        # (what the current epoch elects with)
+        per = st.set_.pool_stake()
+        total = sum(per.values())
+        if total == 0:
+            return {}
+        return {pid: Fraction(amt, total) for pid, amt in sorted(per.items())}
+    if name == "get_stake_pools":
+        return set(st.pools)
+    if name == "get_stake_pool_params":
+        (pids,) = args
+        return {pid: st.pools[pid] for pid in pids if pid in st.pools}
+    if name == "get_current_pparams":
+        return st.pparams
+    if name == "get_proposed_pparams_updates":
+        return dict(st.proposals)
+    if name == "get_rewards":
+        (creds,) = args
+        return {c: st.rewards[c] for c in creds if c in st.rewards}
+    if name == "get_delegations_and_rewards":
+        (creds,) = args
+        return (
+            {c: st.delegations[c] for c in creds if c in st.delegations},
+            {c: st.rewards[c] for c in creds if c in st.rewards},
+        )
+    if name == "get_utxo_by_address":
+        (addrs,) = args
+        want = set(addrs)
+        return {
+            k: (a, c) for k, (a, c) in st.utxo.items() if a[0] in want
+        }
+    if name == "get_account_state":
+        # GetAccountState: the treasury and reserves pots
+        return {"treasury": st.treasury, "reserves": st.reserves}
+    raise QueryError(f"unknown Shelley query {name!r}")
 
 
 def run_query(node, ext_state, name: str, args, version: int = LATEST_QUERY_VERSION):
@@ -67,9 +146,19 @@ def run_query(node, ext_state, name: str, args, version: int = LATEST_QUERY_VERS
         return dict(ledger_state.utxo)
     if name == "get_balance":
         addr = args[0]
-        return sum(amt for (a, amt) in ledger_state.utxo.values() if a == addr)
+        # era-shape aware: mock utxo values are (addr, amt); Shelley's
+        # are ((payment, staking), amt) — match on the payment part so
+        # a v1 client gets the right balance on any era's state
+        total = 0
+        for (a, amt) in ledger_state.utxo.values():
+            payment = a[0] if isinstance(a, tuple) else a
+            if payment == addr:
+                total += amt
+        return total
     if name == "get_pool_distr":
         return node.ledger_view_at(hs.tip.slot if hs.tip else 0).pool_distr
+    if name in _SHELLEY_QUERIES:
+        return _run_shelley_query(_shelley_state(ledger_state), name, args)
     raise QueryError(f"unknown query {name!r}")
 
 
@@ -102,6 +191,11 @@ def state_query_server(node, rx, tx, version: int = LATEST_QUERY_VERSION):
                 yield Send(tx, ("result", val))
             except QueryError as e:
                 yield Send(tx, ("failed", str(e)))
+            except (ValueError, IndexError, TypeError, KeyError) as e:
+                # malformed client args (wrong arity/shape) must get a
+                # failure REPLY, not kill the server task and hang the
+                # client forever
+                yield Send(tx, ("failed", f"malformed query args: {e!r}"))
         elif kind == "release":
             acquired = None
         elif kind == "done":

@@ -154,3 +154,102 @@ def test_query_versioning(node):
     with _pytest.raises(QueryUnsupported):
         run_query(node, st, "get_pool_distr", (), version=1)
     assert run_query(node, st, "get_pool_distr", (), version=2) is not None
+
+
+# ---------------------------------------------------------------------------
+# The Shelley ledger query family (shelley Ledger/Query.hs, v3 vocabulary)
+# ---------------------------------------------------------------------------
+
+
+def _shelley_node(tmp_path):
+    from ouroboros_consensus_tpu.ledger import shelley as sh
+    from ouroboros_consensus_tpu.protocol.views import hash_key, hash_vrf_vk
+
+    pool = fixtures.make_pool(0, kes_depth=3)
+    cred = b"q-cred" + b"\x00" * 22
+    pp = sh.PParams(min_fee_a=0, min_fee_b=0, key_deposit=7, pool_deposit=11)
+    g = sh.ShelleyGenesis(
+        pparams=pp, epoch_length=PARAMS.epoch_length,
+        stability_window=PARAMS.stability_window, max_supply=10_000,
+    )
+    ledger = sh.ShelleyLedger(g)
+    st0 = ledger.genesis_state(
+        [(b"pay-x", cred, 100)],
+        initial_pools=(sh.PoolParams(
+            hash_key(pool.vk_cold), hash_vrf_vk(pool.vrf_vk), 0, 0,
+            Fraction(0), cred, (),
+        ),),
+        initial_delegations=((cred, hash_key(pool.vk_cold)),),
+    )
+    proto = PraosProtocol(PARAMS, use_device_batch=False)
+    ext = ExtLedger(ledger, proto)
+    genesis = ext.genesis(st0)
+    db = open_chaindb(str(tmp_path / "shq"), ext, genesis, k=4)
+    return NodeKernel("nq", db, proto, ledger, pool=pool), cred, pool, pp
+
+
+def test_shelley_query_family(tmp_path):
+    from ouroboros_consensus_tpu.protocol.views import hash_key
+
+    node, cred, pool, pp = _shelley_node(tmp_path)
+    st = node.chain_db.current_ledger()
+    pid = hash_key(pool.vk_cold)
+    q = lambda name, *args: localstate.run_query(node, st, name, args)
+
+    assert q("get_epoch_no") == 0
+    assert q("get_stake_distribution") == {pid: Fraction(1)}
+    assert q("get_stake_pools") == {pid}
+    assert q("get_stake_pool_params", [pid])[pid].reward_cred == cred
+    assert q("get_current_pparams") == pp
+    assert q("get_proposed_pparams_updates") == {}
+    assert q("get_rewards", [cred]) == {cred: 0}
+    delegs, rewards = q("get_delegations_and_rewards", [cred])
+    assert delegs == {cred: pid} and rewards == {cred: 0}
+    utxo = q("get_utxo_by_address", [b"pay-x"])
+    assert list(utxo.values()) == [((b"pay-x", cred), 100)]
+    acct = q("get_account_state")
+    assert acct["reserves"] == 10_000 - 100 and acct["treasury"] == 0
+
+
+def test_shelley_query_era_mismatch_and_versioning(node, tmp_path):
+    # era mismatch: the mock-ledger node rejects Shelley queries
+    st = node.chain_db.current_ledger()
+    with pytest.raises(localstate.EraMismatch):
+        localstate.run_query(node, st, "get_epoch_no", ())
+    # version gating: v2 clients cannot name v3 queries
+    shelley_node, _cred, _pool, _pp = _shelley_node(tmp_path)
+    sst = shelley_node.chain_db.current_ledger()
+    with pytest.raises(localstate.QueryUnsupported):
+        localstate.run_query(shelley_node, sst, "get_epoch_no", (), version=2)
+    assert localstate.run_query(
+        shelley_node, sst, "get_epoch_no", (), version=3
+    ) == 0
+
+
+def test_query_malformed_args_and_v1_balance_on_shelley(tmp_path):
+    """Wrong-arity args get a failure REPLY (not a dead server), and the
+    v1 get_balance matches payment addresses on Shelley-era states."""
+    node, cred, _pool, _pp = _shelley_node(tmp_path)
+    st = node.chain_db.current_ledger()
+    assert localstate.run_query(node, st, "get_balance", (b"pay-x",),
+                                version=1) == 100
+
+    rx, tx = Channel(), Channel()
+    replies = []
+
+    def client():
+        yield Send(rx, ("acquire", None))
+        replies.append((yield Recv(tx)))
+        yield Send(rx, ("query", "get_rewards", ()))  # wrong arity
+        replies.append((yield Recv(tx)))
+        yield Send(rx, ("query", "get_epoch_no", ()))  # server still alive
+        replies.append((yield Recv(tx)))
+        yield Send(rx, ("done",))
+
+    sim = Sim()
+    sim.spawn(localstate.state_query_server(node, rx, tx, version=3), "s")
+    sim.spawn(client(), "c")
+    sim.run(until=10)
+    assert replies[0][0] == "acquired"
+    assert replies[1][0] == "failed" and "malformed" in replies[1][1]
+    assert replies[2] == ("result", 0)
