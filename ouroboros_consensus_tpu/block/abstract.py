@@ -46,3 +46,15 @@ class HeaderFields:
 
 def block_point(b) -> Point:
     return Point(b.slot, b.hash_)
+
+
+def issuer_vk_of(header):
+    """The forging pool's cold vk, wherever the block type keeps it:
+    on the header itself, or inside the KES-signed header body (the
+    real Praos layout, praos_block.HeaderBody). None for issuerless
+    headers (mock/BFT-era, EBBs)."""
+    issuer = getattr(header, "issuer_vk", None)
+    if issuer is None:
+        body = getattr(header, "body", None)
+        issuer = getattr(body, "issuer_vk", None) if body is not None else None
+    return issuer

@@ -19,11 +19,9 @@ def is_self_issued(header, our_cold_vk: bytes | None) -> bool:
     Blocks without an issuer (mock/BFT-era headers) are never self."""
     if our_cold_vk is None:
         return False
-    issuer = getattr(header, "issuer_vk", None)
-    if issuer is None:
-        body = getattr(header, "body", None)
-        issuer = getattr(body, "issuer_vk", None) if body is not None else None
-    return issuer == our_cold_vk
+    from .abstract import issuer_vk_of
+
+    return issuer_vk_of(header) == our_cold_vk
 
 
 @dataclass

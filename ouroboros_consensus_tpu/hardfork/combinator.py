@@ -243,7 +243,38 @@ class HardForkLedger:
         return self.eras[ticked.era].ledger.protocol_ledger_view(ticked.inner)
 
     def ledger_view_forecast_at(self, state: HFState):
-        return self.eras[state.era].ledger.ledger_view_forecast_at(state.inner)
+        """Forecast that CROSSES era boundaries (the reference's
+        cross-era forecast, HardFork/Combinator/Ledger.hs): a view for a
+        slot past the next transition comes from the target era's ledger
+        over the TRANSLATED state — forging and validation must agree on
+        boundary-straddling views when eras derive them differently.
+        The horizon stays the anchor era's (nothing past it is
+        knowable)."""
+        base = self.eras[state.era].ledger.ledger_view_forecast_at(state.inner)
+        crossed_fc: dict[int, Any] = {}  # target era -> its Forecast
+
+        def view_fn(slot):
+            target = self.summary.era_index_of_slot(slot)
+            if target <= state.era:
+                # slots of the anchor era (or before it — the anchor
+                # era's ledger still holds that history)
+                return base.view_fn(slot)
+            if target not in crossed_fc:
+                # translate ONCE per target era (the anchor state is
+                # immutable; Shelley's translation re-seals the whole
+                # stake distribution — not per-slot work)
+                crossed = self._cross_eras(state, target)
+                crossed_fc[target] = self.eras[
+                    target
+                ].ledger.ledger_view_forecast_at(crossed.inner)
+            # forecast_for, not view_fn: the TARGET era's own horizon
+            # must also hold, or a pre-fork node would forge with views
+            # a post-fork node refuses to produce
+            return crossed_fc[target].forecast_for(slot)
+
+        from ..ledger.abstract import Forecast
+
+        return Forecast(at=base.at, max_for=base.max_for, view_fn=view_fn)
 
     def mempool_view(self, state: HFState, slot: int):
         """Mempool projection into the era of `slot` (the HFC mempool

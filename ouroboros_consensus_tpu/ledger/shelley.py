@@ -784,8 +784,10 @@ class ShelleyLedger:
     # -- block application -------------------------------------------------
 
     def _issuer_pool(self, block) -> bytes | None:
+        from ..block.abstract import issuer_vk_of
+
         header = getattr(block, "header", None)
-        vk = getattr(header, "issuer_vk", None) if header else None
+        vk = issuer_vk_of(header) if header is not None else None
         if vk is None:
             return None
         from ..protocol.views import hash_key

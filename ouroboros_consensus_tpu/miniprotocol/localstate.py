@@ -83,9 +83,23 @@ def _shelley_state(ledger_state):
     return st
 
 
+_SHELLEY_QUERY_ARITY = {
+    "get_stake_pool_params": 1,
+    "get_rewards": 1,
+    "get_delegations_and_rewards": 1,
+    "get_utxo_by_address": 1,
+}
+
+
 def _run_shelley_query(st, name: str, args):
     """shelley Ledger/Query.hs vocabulary over the REAL STS state."""
     from fractions import Fraction
+
+    want = _SHELLEY_QUERY_ARITY.get(name, 0)
+    if len(args) != want or (want == 1 and not hasattr(args[0], "__iter__")):
+        # client-fault shapes are a QUERY failure (the server stays up
+        # and the client can tell its own mistake from a server bug)
+        raise QueryError(f"{name} takes {want} argument(s), got {args!r}")
 
     if name == "get_epoch_no":
         return st.epoch
@@ -192,10 +206,10 @@ def state_query_server(node, rx, tx, version: int = LATEST_QUERY_VERSION):
             except QueryError as e:
                 yield Send(tx, ("failed", str(e)))
             except (ValueError, IndexError, TypeError, KeyError) as e:
-                # malformed client args (wrong arity/shape) must get a
-                # failure REPLY, not kill the server task and hang the
-                # client forever
-                yield Send(tx, ("failed", f"malformed query args: {e!r}"))
+                # anything else escaping a handler is a SERVER-side
+                # defect: reply distinctly (triageable, not confusable
+                # with client fault) but keep the session alive
+                yield Send(tx, ("failed", f"internal query error: {e!r}"))
         elif kind == "release":
             acquired = None
         elif kind == "done":

@@ -80,6 +80,12 @@ class ThreadNetConfig:
     # params) hard-forks into era B (Praos, doubled epoch length) at
     # this epoch; every node runs the composite protocol/ledger
     hard_fork_at_epoch: int | None = None
+    # era B runs the REAL Shelley STS ledger (same epoch length as A so
+    # Shelley's slot/epoch arithmetic aligns with the boundary): the
+    # translation carries the mock-era UTxO and seals genesis staking
+    # that delegates every genesis output's stake round-robin to the
+    # forger pools (the DualByron-test shape on the Shelley side)
+    hf_shelley_era: bool = False
 
 
 @dataclass
@@ -144,6 +150,69 @@ class _Net:
 
     # -- vertices -----------------------------------------------------------
 
+    def _shelley_era_b(self, params_b):
+        """Era B over the REAL Shelley STS ledger: the boundary
+        translation carries the mock UTxO and seals genesis staking
+        that delegates each genesis output round-robin to the forger
+        pools — so era-B elections run on ledger-derived stake."""
+        from fractions import Fraction as F
+
+        from ..hardfork.combinator import Era
+        from ..ledger import shelley as sh
+        from ..protocol.views import hash_key, hash_vrf_vk
+
+        import zlib
+
+        cfg = self.cfg
+        forger_pools = [self.pools[i] for i in sorted(self.forgers)]
+        if not forger_pools:
+            raise ValueError(
+                "hf_shelley_era needs at least one forger: era-B "
+                "elections run on stake delegated to the forger pools"
+            )
+        # EVERY address keeps stake across the boundary: a deterministic
+        # address->credential map (not just the pristine genesis-k
+        # addresses — the mock-era TxGen re-addresses outputs, and spent
+        # stake silently vanishing would stall era B)
+        cred_list = [b"tn-cred-%03d" % k for k in range(N_GENESIS_OUTPUTS)]
+
+        def stake_of(addr: bytes) -> bytes:
+            return cred_list[zlib.crc32(addr) % len(cred_list)]
+
+        initial_pools = tuple(
+            sh.PoolParams(
+                pool_id=hash_key(p.vk_cold),
+                vrf_hash=hash_vrf_vk(p.vrf_vk),
+                pledge=0, cost=0, margin=F(0),
+                reward_cred=cred_list[i % len(cred_list)], owners=(),
+            )
+            for i, p in enumerate(forger_pools)
+        )
+        initial_delegations = tuple(
+            (cred, hash_key(forger_pools[k % len(forger_pools)].vk_cold))
+            for k, cred in enumerate(cred_list)
+        )
+        genesis = sh.ShelleyGenesis(
+            pparams=sh.PParams(min_fee_a=0, min_fee_b=0),
+            epoch_length=params_b.epoch_length,
+            stability_window=params_b.stability_window,
+            max_supply=N_GENESIS_OUTPUTS * GENESIS_AMOUNT * 100,
+        )
+        ledger = sh.ShelleyLedger(genesis)
+        boundary_slot = cfg.hard_fork_at_epoch * self.params.epoch_length
+
+        return Era(
+            "shelleyB",
+            PraosProtocol(params_b, use_device_batch=cfg.use_device_batch),
+            ledger=ledger,
+            translate_ledger_state=lambda st: ledger.translate_from_utxo_ledger(
+                st, at_slot=boundary_slot,
+                stake_of=stake_of,
+                initial_pools=initial_pools,
+                initial_delegations=initial_delegations,
+            ),
+        )
+
     def _hf_pieces(self):
         """Protocol+ledger+codec+forge for the 2-era composite."""
         import dataclasses
@@ -164,11 +233,16 @@ class _Net:
 
         cfg = self.cfg
         params_a = self.params
-        # era B: doubled epoch length (a REAL parameter change across
-        # the boundary, like the reference's A→B test)
-        params_b = dataclasses.replace(
-            self.params, epoch_length=2 * self.params.epoch_length
-        )
+        if cfg.hf_shelley_era:
+            # the era CHANGE is the ledger itself — epoch arithmetic
+            # stays aligned (Shelley derives epochs from global slots)
+            params_b = params_a
+        else:
+            # era B: doubled epoch length (a REAL parameter change
+            # across the boundary, like the reference's A→B test)
+            params_b = dataclasses.replace(
+                self.params, epoch_length=2 * self.params.epoch_length
+            )
         summary = summarize(
             F(0),
             [
@@ -177,6 +251,16 @@ class _Net:
             ],
             [cfg.hard_fork_at_epoch, None],
         )
+        if cfg.hf_shelley_era:
+            era_b = self._shelley_era_b(params_b)
+        else:
+            era_b = Era(
+                "eraB",
+                PraosProtocol(params_b, use_device_batch=cfg.use_device_batch),
+                ledger=MockLedger(
+                    MockConfig(self.lview, params_b.stability_window)
+                ),
+            )
         eras = [
             Era(
                 "eraA",
@@ -185,13 +269,7 @@ class _Net:
                     MockConfig(self.lview, params_a.stability_window)
                 ),
             ),
-            Era(
-                "eraB",
-                PraosProtocol(params_b, use_device_batch=cfg.use_device_batch),
-                ledger=MockLedger(
-                    MockConfig(self.lview, params_b.stability_window)
-                ),
-            ),
+            era_b,
         ]
         protocol = HardForkProtocol(eras, summary)
         ledger = HardForkLedger(eras, summary)

@@ -251,5 +251,5 @@ def test_query_malformed_args_and_v1_balance_on_shelley(tmp_path):
     sim.spawn(client(), "c")
     sim.run(until=10)
     assert replies[0][0] == "acquired"
-    assert replies[1][0] == "failed" and "malformed" in replies[1][1]
+    assert replies[1][0] == "failed" and "takes 1 argument" in replies[1][1]
     assert replies[2] == ("result", 0)

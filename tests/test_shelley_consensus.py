@@ -495,3 +495,60 @@ def test_chaindb_elects_from_ledger_derived_views():
     assert hash_key(POOL_C.vk_cold) in final.pools
     assert final.pool_deposits[hash_key(POOL_C.vk_cold)] == PP.pool_deposit
     db.close()
+
+
+def test_hf_forecast_crosses_era_boundary():
+    """A node whose tip is still pre-fork must FORGE with the same view
+    validators will enforce post-fork: ledger_view_forecast_at on the
+    HFC translates the state across the boundary and serves the target
+    era's (Shelley-derived) view, not the anchor era's mock view."""
+    import dataclasses
+
+    from ouroboros_consensus_tpu.hardfork.combinator import (
+        Era, HardForkLedger, HFState,
+    )
+    from ouroboros_consensus_tpu.hardfork.history import (
+        EraParams as HEraParams,
+    )
+    from ouroboros_consensus_tpu.hardfork.history import summarize
+    from ouroboros_consensus_tpu.ledger import mock as mock_ledger
+
+    EP = 10
+    g = sh.ShelleyGenesis(
+        pparams=PP, epoch_length=EP,
+        stability_window=10_000,  # horizon reaches past the boundary
+        max_supply=10_000_000,
+    )
+    shelley = sh.ShelleyLedger(g)
+    mock_view = fixtures.make_ledger_view([POOL_A, POOL_B])
+    mock = mock_ledger.MockLedger(mock_ledger.MockConfig(mock_view, 10_000))
+    addr = b"rich"
+    staking = dict(
+        stake_of=lambda a: cred(0),
+        initial_pools=(pool_params(POOL_A, cred(0)),),
+        initial_delegations=((cred(0), hash_key(POOL_A.vk_cold)),),
+    )
+    eras = [
+        Era("mockA", None, ledger=mock),
+        Era("shelleyB", None, ledger=shelley,
+            translate_ledger_state=lambda st:
+                shelley.translate_from_utxo_ledger(
+                    st, at_slot=2 * EP, **staking)),
+    ]
+    summary = summarize(
+        Fraction(0),
+        [HEraParams(EP, Fraction(1)), HEraParams(EP, Fraction(1))],
+        [2, None],
+    )
+    hf = HardForkLedger(eras, summary)
+    pre = HFState(0, mock.genesis_state([(addr, 1000)]))
+
+    fc = hf.ledger_view_forecast_at(pre)
+    # same era: the mock fixture view (both pools)
+    assert set(fc.forecast_for(5).pool_distr) == {
+        hash_key(POOL_A.vk_cold), hash_key(POOL_B.vk_cold)
+    }
+    # past the boundary: the SHELLEY-derived view (only the staked pool)
+    post = fc.forecast_for(2 * EP + 1)
+    assert set(post.pool_distr) == {hash_key(POOL_A.vk_cold)}
+    assert post.pool_distr[hash_key(POOL_A.vk_cold)].stake == Fraction(1)

@@ -285,3 +285,36 @@ def test_async_chaindb_across_schedules(tmp_path):
         res = threadnet.run_thread_network(str(tmp_path / f"s{seed}"), cfg)
         threadnet.check_common_prefix(res, cfg.k)
         threadnet.check_chain_growth(res, cfg)
+
+
+def test_two_era_network_with_live_shelley_ledger(tmp_path):
+    """The A→B HFC net where era B is the REAL Shelley STS ledger: the
+    boundary translation carries the mock UTxO across, genesis staking
+    delegates it to the forger pools, and post-fork blocks are forged,
+    diffused, validated and adopted by every node against LEDGER-DERIVED
+    Shelley stake."""
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=3, n_slots=40, k=30, msg_delay=0.05,
+        active_slot_coeff=Fraction(1),
+        epoch_length=10,
+        forgers=[0, 1],
+        hard_fork_at_epoch=2,  # era boundary at slot 20
+        hf_shelley_era=True,
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    assert res.chain_hashes(1) == res.chain_hashes(0)
+    assert res.chain_hashes(2) == res.chain_hashes(0)
+    from ouroboros_consensus_tpu.hardfork.combinator import HardForkBlock
+    from ouroboros_consensus_tpu.ledger.shelley import ShelleyState
+
+    eras = [b.era for b in res.chains[0] if isinstance(b, HardForkBlock)]
+    assert set(eras) == {0, 1}, "chain never crossed the boundary"
+    # the adopted LEDGER state is a real Shelley state with the carried
+    # UTxO and per-pool block counts from the post-fork forging
+    st = res.nodes[2].chain_db.current_ledger().ledger_state
+    assert st.era == 1 and isinstance(st.inner, ShelleyState)
+    assert sum(c for _a, c in st.inner.utxo.values()) > 0
+    assert sum(st.inner.blocks_current.values()) + sum(
+        st.inner.blocks_prev.values()
+    ) == sum(1 for e in eras if e == 1)
