@@ -83,23 +83,43 @@ def _shelley_state(ledger_state):
     return st
 
 
-_SHELLEY_QUERY_ARITY = {
-    "get_stake_pool_params": 1,
-    "get_rewards": 1,
-    "get_delegations_and_rewards": 1,
-    "get_utxo_by_address": 1,
+# argument spec per query: () = no args, "scalar" = one bytes-like,
+# "collection" = one list/tuple/set (bytes would silently iterate as
+# ints, so it is explicitly NOT a collection). Client-fault shapes are a
+# QUERY failure — the server stays up and the client can tell its own
+# mistake from a server bug.
+_QUERY_ARGSPEC = {
+    "get_balance": "scalar",
+    "get_stake_pool_params": "collection",
+    "get_rewards": "collection",
+    "get_delegations_and_rewards": "collection",
+    "get_utxo_by_address": "collection",
 }
+
+
+def _check_args(name: str, args) -> None:
+    spec = _QUERY_ARGSPEC.get(name)
+    if spec is None:
+        if len(args) != 0:
+            raise QueryError(f"{name} takes no arguments, got {args!r}")
+        return
+    if len(args) != 1:
+        raise QueryError(f"{name} takes 1 argument, got {args!r}")
+    if spec == "collection" and not isinstance(
+        args[0], (list, tuple, set, frozenset)
+    ):
+        raise QueryError(
+            f"{name} takes a collection, got {type(args[0]).__name__}"
+        )
+    if spec == "scalar" and not isinstance(args[0], (bytes, bytearray)):
+        raise QueryError(
+            f"{name} takes an address, got {type(args[0]).__name__}"
+        )
 
 
 def _run_shelley_query(st, name: str, args):
     """shelley Ledger/Query.hs vocabulary over the REAL STS state."""
     from fractions import Fraction
-
-    want = _SHELLEY_QUERY_ARITY.get(name, 0)
-    if len(args) != want or (want == 1 and not hasattr(args[0], "__iter__")):
-        # client-fault shapes are a QUERY failure (the server stays up
-        # and the client can tell its own mistake from a server bug)
-        raise QueryError(f"{name} takes {want} argument(s), got {args!r}")
 
     if name == "get_epoch_no":
         return st.epoch
@@ -148,6 +168,8 @@ def run_query(node, ext_state, name: str, args, version: int = LATEST_QUERY_VERS
         raise QueryUnsupported(
             f"query {name!r} needs NodeToClient version {need}, have {version}"
         )
+    if need is not None:
+        _check_args(name, args)
     ledger_state = ext_state.ledger_state
     hs = ext_state.header_state
     if name == "get_chain_block_no":

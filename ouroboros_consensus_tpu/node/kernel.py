@@ -21,6 +21,7 @@ from typing import Any, Callable
 from ..block.abstract import Point
 from ..block.forge import forge_block
 from ..block.metrics import NodeMetrics
+from ..ledger.abstract import OutsideForecastRange
 from ..mempool import Mempool
 from ..miniprotocol.chainsync import Candidate
 from ..protocol import praos as praos_mod
@@ -185,7 +186,15 @@ class NodeKernel:
         if self.pool is None:
             return None
         ext = self.chain_db.current_ledger()
-        lview = self.ledger_view_at(slot)
+        try:
+            lview = self.ledger_view_at(slot)
+        except OutsideForecastRange as e:
+            # checkShouldForge's ForgeStateUpdateError shape: the slot
+            # is beyond what our (possibly pre-era-boundary) tip can
+            # forecast — skip the opportunity, do NOT kill the loop
+            self.metrics.blocks_could_not_forge += 1
+            self.trace(f"{self.name}: no forecast for slot {slot}: {e}")
+            return None
         ticked = self.protocol.tick(lview, slot, ext.header_state.chain_dep_state)
         is_leader = self.protocol.check_is_leader(
             self._can_be_leader(), slot, ticked

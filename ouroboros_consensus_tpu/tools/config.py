@@ -212,3 +212,120 @@ def load_pool_from_envelopes(dir_path: str) -> PoolCredentials:
         cold_seed=bytes(cold), vrf_seed=bytes(vrf),
         kes_seed=bytes(kes_seed), kes_depth=kes_depth,
     )
+
+
+# ---------------------------------------------------------------------------
+# Shelley genesis files (the reference's shelley-genesis.json shape:
+# sgProtocolParams / sgInitialFunds / sgStaking — Node config points at
+# it per era; cardano-node ShelleyGenesis + protocolInfoShelley)
+# ---------------------------------------------------------------------------
+
+
+def _frac_json(f):
+    from fractions import Fraction
+
+    if isinstance(f, Fraction):
+        return [f.numerator, f.denominator]
+    return f
+
+
+def write_shelley_genesis(
+    dir_path: str,
+    genesis,  # ledger.shelley.ShelleyGenesis
+    initial_funds,  # [(payment, stake|None, coin)]
+    initial_pools=(),  # [shelley.PoolParams]
+    initial_delegations=(),  # [(cred, pool_id)]
+    filename: str = "shelley-genesis.json",
+) -> str:
+    """Write a Shelley genesis file (sgInitialFunds + sgStaking)."""
+    from ..ledger import shelley as sh
+
+    pp = genesis.pparams
+    obj = {
+        "protocolParams": {
+            f: _frac_json(getattr(pp, f)) for f in sh.PParams.UPDATABLE
+        },
+        "epochLength": genesis.epoch_length,
+        "stabilityWindow": genesis.stability_window,
+        "maxSupply": genesis.max_supply,
+        "updateQuorum": genesis.update_quorum,
+        "genDelegs": [d.hex() for d in genesis.genesis_delegates],
+        "initialFunds": [
+            [p.hex(), None if s is None else s.hex(), c]
+            for p, s, c in initial_funds
+        ],
+        "staking": {
+            "pools": [
+                {
+                    "poolId": p.pool_id.hex(),
+                    "vrfKeyHash": p.vrf_hash.hex(),
+                    "pledge": p.pledge,
+                    "cost": p.cost,
+                    "margin": _frac_json(p.margin),
+                    "rewardCred": p.reward_cred.hex(),
+                    "owners": [o.hex() for o in p.owners],
+                }
+                for p in initial_pools
+            ],
+            "stake": [
+                [c.hex(), pid.hex()] for c, pid in initial_delegations
+            ],
+        },
+    }
+    path = os.path.join(dir_path, filename)
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+    return path
+
+
+def load_shelley_genesis(path: str):
+    """-> (ShelleyLedger, genesis ShelleyState) — protocolInfoShelley."""
+    from fractions import Fraction
+
+    from ..ledger import shelley as sh
+
+    with open(path) as f:
+        obj = json.load(f)
+    pp_kw = {}
+    for k, v in obj["protocolParams"].items():
+        pp_kw[k] = Fraction(v[0], v[1]) if isinstance(v, list) else int(v)
+    genesis = sh.ShelleyGenesis(
+        pparams=sh.PParams(**pp_kw),
+        epoch_length=int(obj["epochLength"]),
+        stability_window=int(obj["stabilityWindow"]),
+        max_supply=int(obj["maxSupply"]),
+        genesis_delegates=tuple(
+            bytes.fromhex(d) for d in obj.get("genDelegs", [])
+        ),
+        update_quorum=int(obj.get("updateQuorum", 1)),
+    )
+    ledger = sh.ShelleyLedger(genesis)
+    staking = obj.get("staking", {})
+    pools = tuple(
+        sh.PoolParams(
+            pool_id=bytes.fromhex(p["poolId"]),
+            vrf_hash=bytes.fromhex(p["vrfKeyHash"]),
+            pledge=int(p["pledge"]),
+            cost=int(p["cost"]),
+            margin=(
+                Fraction(p["margin"][0], p["margin"][1])
+                if isinstance(p["margin"], list) else Fraction(p["margin"])
+            ),
+            reward_cred=bytes.fromhex(p["rewardCred"]),
+            owners=tuple(bytes.fromhex(o) for o in p.get("owners", [])),
+        )
+        for p in staking.get("pools", [])
+    )
+    delegations = tuple(
+        (bytes.fromhex(c), bytes.fromhex(pid))
+        for c, pid in staking.get("stake", [])
+    )
+    state = ledger.genesis_state(
+        [
+            (bytes.fromhex(p), None if s is None else bytes.fromhex(s), c)
+            for p, s, c in obj.get("initialFunds", [])
+        ],
+        initial_pools=pools,
+        initial_delegations=delegations,
+    )
+    return ledger, state

@@ -253,3 +253,16 @@ def test_query_malformed_args_and_v1_balance_on_shelley(tmp_path):
     assert replies[0][0] == "acquired"
     assert replies[1][0] == "failed" and "takes 1 argument" in replies[1][1]
     assert replies[2] == ("result", 0)
+
+
+def test_query_arg_shape_validation(tmp_path):
+    """A single bytes address where a collection is expected is a CLIENT
+    fault (bytes would silently iterate as ints and match nothing);
+    get_balance's missing arg is a client fault too, not an internal
+    error."""
+    node, cred, _pool, _pp = _shelley_node(tmp_path)
+    st = node.chain_db.current_ledger()
+    with pytest.raises(localstate.QueryError, match="collection"):
+        localstate.run_query(node, st, "get_utxo_by_address", (b"pay-x",))
+    with pytest.raises(localstate.QueryError, match="takes 1 argument"):
+        localstate.run_query(node, st, "get_balance", ())

@@ -348,3 +348,41 @@ def test_trace_ledger_processing(tmp_path):
         path, PARAMS, lview2, Chatty(ledger), genesis,
     )
     assert len(events) == 6
+
+
+def test_shelley_genesis_roundtrip(tmp_path):
+    """shelley-genesis.json (sgInitialFunds + sgStaking shape) feeds
+    protocolInfoShelley: write -> load -> genesis state identical to
+    building it in process, elections included."""
+    from fractions import Fraction
+
+    from ouroboros_consensus_tpu.ledger import shelley as sh
+    from ouroboros_consensus_tpu.protocol.views import hash_key, hash_vrf_vk
+    from ouroboros_consensus_tpu.testing import fixtures
+    from ouroboros_consensus_tpu.tools import config as cfg_tools
+
+    pool = fixtures.make_pool(3, kes_depth=2)
+    cred = b"g-cred" + b"\x00" * 22
+    g = sh.ShelleyGenesis(
+        pparams=sh.PParams(min_fee_a=0, min_fee_b=0, key_deposit=5,
+                           pool_deposit=9, a0=Fraction(1, 4)),
+        epoch_length=100, stability_window=300, max_supply=1_000_000,
+        genesis_delegates=(b"GD" + b"\x00" * 26,), update_quorum=1,
+    )
+    funds = [(b"p" * 28, cred, 777), (b"q" * 28, None, 23)]
+    pools = (sh.PoolParams(hash_key(pool.vk_cold), hash_vrf_vk(pool.vrf_vk),
+                           1, 2, Fraction(1, 8), cred, (cred,)),)
+    delegs = ((cred, hash_key(pool.vk_cold)),)
+
+    path = cfg_tools.write_shelley_genesis(
+        str(tmp_path), g, funds, pools, delegs
+    )
+    ledger, state = cfg_tools.load_shelley_genesis(path)
+    direct = sh.ShelleyLedger(g).genesis_state(
+        funds, initial_pools=pools, initial_delegations=delegs
+    )
+    assert ledger.genesis == g
+    assert state == direct
+    # elections work off the loaded state
+    view = ledger.protocol_ledger_view(ledger.tick(state, 1))
+    assert view.pool_distr[hash_key(pool.vk_cold)].stake == Fraction(1)
