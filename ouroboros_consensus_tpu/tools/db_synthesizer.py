@@ -111,6 +111,11 @@ def synthesize(
     # stake: forge against the distribution validators will derive);
     # None = the constant `lview`
     txs_for_block=None,  # (slot, block_no) -> tuple[bytes, ...]
+    ledger=None,  # LEDGER IN THE LOOP: fold this ledger (view_for_epoch
+    genesis_state=None,  # + tick_then_apply) over the forged blocks and
+    # derive each epoch's election view from ITS stake snapshots — the
+    # forging twin of db_analyser's ledger-derived revalidation (so
+    # Shelley-backed chains synthesize at tool level)
 ) -> ForgeResult:
     """The forging loop (Forging.hs:57): tick → leader check per
     credential → forge → append, until the limit trips.
@@ -140,6 +145,30 @@ def synthesize(
     block_no = 0
     slot = 0
     counters: dict[bytes, int] = {}
+
+    if ledger is not None:
+        if genesis_state is None:
+            raise ValueError("ledger mode needs genesis_state")
+        if ledger_view_for_epoch is not None:
+            raise ValueError("pass ledger OR ledger_view_for_epoch")
+        ledger_epoch_len = getattr(
+            getattr(ledger, "genesis", None), "epoch_length", None
+        )
+        if ledger_epoch_len is not None and ledger_epoch_len != params.epoch_length:
+            raise ValueError(
+                f"ledger epoch_length {ledger_epoch_len} != protocol "
+                f"epoch_length {params.epoch_length}: the two epoch "
+                "clocks would silently desync"
+            )
+        lst = genesis_state
+        _view_cache: dict[int, object] = {}
+
+        def ledger_view_for_epoch(epoch):  # noqa: F811 — the seam above
+            # epoch-constant: derive once per epoch, not per slot
+            if epoch not in _view_cache:
+                tls = ledger.tick(lst, max(slot, 1))
+                _view_cache[epoch] = ledger.view_for_epoch(tls.state, epoch)
+            return _view_cache[epoch]
 
     def done() -> bool:
         if limit.slots is not None and slot >= limit.slots:
@@ -203,6 +232,11 @@ def synthesize(
                 ocert_counter=n,
                 is_leader=is_leader,
             )
+            if ledger is not None:
+                # the fold MUST accept what we forged BEFORE the block
+                # is persisted — a rejected tx must not leave an
+                # invalid block on disk
+                lst = ledger.tick_then_apply(lst, block)
             imm.append_block(slot, block_no, block.hash_, block.bytes_)
             st = praos.reupdate(params, block.header.to_view(), slot, ticked)
             counters[pool.pool_id] = n

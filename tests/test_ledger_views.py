@@ -273,3 +273,41 @@ def test_shelley_wrong_view_fails(shelley_chain):
     out = db_analyser.revalidate(path, PARAMS, genesis_view, backend="native")
     assert out.error is not None
     assert out.n_valid < n_blocks
+
+
+def test_synthesizer_ledger_mode_shelley(tmp_path, pools):
+    """db-synthesizer with the LEDGER IN THE LOOP: forge a Shelley-
+    backed chain (views derived from the folding STS state) and
+    revalidate it with db-analyser's ledger-derived path — the full
+    tool-level round trip on a real-era ledger."""
+    from ouroboros_consensus_tpu.ledger import shelley as sh
+
+    cred = b"synth-cred" + b"\x00" * 18
+    pp = sh.PParams(min_fee_a=0, min_fee_b=0, key_deposit=10, pool_deposit=10)
+    g = sh.ShelleyGenesis(
+        pparams=pp, epoch_length=PARAMS.epoch_length,
+        stability_window=PARAMS.stability_window, max_supply=1_000_000,
+    )
+    ledger = sh.ShelleyLedger(g)
+    st0 = ledger.genesis_state(
+        [(b"pay-s", cred, 1000)],
+        initial_pools=(sh.PoolParams(
+            pool_id=pools[0].pool_id, vrf_hash=hash_vrf_vk(pools[0].vrf_vk),
+            pledge=0, cost=0, margin=Fraction(0), reward_cred=cred,
+            owners=(),
+        ),),
+        initial_delegations=((cred, pools[0].pool_id),),
+    )
+    path = str(tmp_path / "shelley_synth")
+    res = db_synthesizer.synthesize(
+        path, PARAMS, [pools[0]], lview=None,
+        limit=db_synthesizer.ForgeLimit(slots=3 * PARAMS.epoch_length),
+        ledger=ledger, genesis_state=st0,
+    )
+    assert res.n_blocks > 10
+    out = db_analyser.revalidate(
+        path, PARAMS, lview=None, backend="native",
+        ledger=ledger, genesis_state=st0,
+    )
+    assert out.error is None, repr(out.error)
+    assert out.n_valid == out.n_blocks == res.n_blocks
