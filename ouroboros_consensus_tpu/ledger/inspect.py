@@ -49,3 +49,18 @@ def inspect_ledger(ledger, old_state, new_state) -> list[LedgerEvent]:
     if fn is None:
         return []
     return fn(old_state, new_state)
+
+
+@dataclass(frozen=True)
+class ShelleyUpdatedProposals(LedgerUpdate):
+    """Protocol-parameter update proposals changed (the Shelley
+    InspectLedger instance's ShelleyUpdatedProtocolUpdates)."""
+
+    proposals: tuple = ()
+
+
+@dataclass(frozen=True)
+class ShelleyPParamsAdopted(LedgerUpdate):
+    """An epoch boundary adopted new protocol parameters (PPUP NEWPP)."""
+
+    changed: tuple = ()  # (field, old, new) triples

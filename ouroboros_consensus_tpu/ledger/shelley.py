@@ -907,6 +907,36 @@ class ShelleyLedger:
             view_fn=view_fn,
         )
 
+
+    def inspect(self, old: ShelleyState, new: ShelleyState) -> list:
+        """InspectLedger instance (reference shelley Ledger/Inspect.hs
+        ShelleyLedgerUpdate): report proposal-set changes and boundary
+        pparam adoptions — the events cardano-node logs for operators."""
+        from .inspect import ShelleyPParamsAdopted, ShelleyUpdatedProposals
+
+        events: list = []
+        if new.proposals != old.proposals:
+            props = tuple(sorted(
+                (p.hex(), upd) for p, upd in new.proposals.items()
+            ))
+            events.append(ShelleyUpdatedProposals(
+                message=(
+                    f"protocol update proposals: {len(new.proposals)} open"
+                ),
+                proposals=props,
+            ))
+        if new.pparams != old.pparams:
+            changed = tuple(
+                (f, getattr(old.pparams, f), getattr(new.pparams, f))
+                for f in PParams.UPDATABLE
+                if getattr(old.pparams, f) != getattr(new.pparams, f)
+            )
+            events.append(ShelleyPParamsAdopted(
+                message=f"adopted pparam update: {[c[0] for c in changed]}",
+                changed=changed,
+            ))
+        return events
+
     def tick_then_apply(self, state, block):
         return self.apply_block(self.tick(state, block.slot), block)
 

@@ -151,6 +151,12 @@ def synthesize(
             raise ValueError("ledger mode needs genesis_state")
         if ledger_view_for_epoch is not None:
             raise ValueError("pass ledger OR ledger_view_for_epoch")
+        if txs_per_block and txs_for_block is None:
+            raise ValueError(
+                "ledger mode folds every tx through the ledger rules: "
+                "placeholder txs_per_block txs would not decode — "
+                "supply real txs via txs_for_block"
+            )
         ledger_epoch_len = getattr(
             getattr(ledger, "genesis", None), "epoch_length", None
         )

@@ -534,3 +534,33 @@ def test_mempool_view_atomic_on_failure():
     assert v.utxo == utxo_before
     assert v.stake_creds == regs_before
     assert v.deposit_delta == 0 and v.fee_delta == 0
+
+
+def test_inspect_events_on_proposals_and_adoption():
+    """InspectLedger: a proposal tx emits ShelleyUpdatedProposals; the
+    adopting boundary emits ShelleyPParamsAdopted with the changed
+    fields (Ledger/Inspect.hs ShelleyLedgerUpdate)."""
+    from ouroboros_consensus_tpu.ledger.inspect import (
+        ShelleyPParamsAdopted,
+        ShelleyUpdatedProposals,
+        inspect_ledger,
+    )
+
+    gd = (b"G1" + b"\x00" * 26,)
+    g, led, st0 = genesis(
+        [(pay(0), None, 100000)], genesis_delegates=gd, update_quorum=1,
+    )
+    tx = sh.encode_tx(
+        [(bytes(32), 0)], [(pay(0), None, 100000 - 1000)], fee=1000,
+        certs=[(5, gd[0], {"min_fee_b": 9})],
+    )
+    st1 = apply_txs(led, st0, 1, tx)
+    ev = inspect_ledger(led, st0, st1)
+    assert any(isinstance(e, ShelleyUpdatedProposals) for e in ev)
+
+    st2 = led.tick(st1, EPOCH + 1).state
+    ev2 = inspect_ledger(led, st1, st2)
+    adopted = [e for e in ev2 if isinstance(e, ShelleyPParamsAdopted)]
+    assert adopted and adopted[0].changed == (
+        ("min_fee_b", PP.min_fee_b, 9),
+    )
