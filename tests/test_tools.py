@@ -137,12 +137,13 @@ def test_tutorial_runs():
     import subprocess
     import sys
 
-    r = subprocess.run(
-        [sys.executable, "tutorials/simple_protocol.py"],
-        capture_output=True, text=True, timeout=120,
-    )
-    assert r.returncode == 0, r.stderr
-    assert "tutorial complete" in r.stdout
+    for script in ("tutorials/simple_protocol.py", "tutorials/shelley_node.py"):
+        r = subprocess.run(
+            [sys.executable, script],
+            capture_output=True, text=True, timeout=240,
+        )
+        assert r.returncode == 0, (script, r.stderr)
+        assert "tutorial complete" in r.stdout, script
 
 
 def test_show_block_stats(synth_db):
