@@ -40,18 +40,15 @@ class EcvrfBatch(NamedTuple):
 def stage_np(pks: Sequence[bytes], proofs: Sequence[bytes], alphas: Sequence[bytes]) -> EcvrfBatch:
     b = len(pks)
     assert len(proofs) == b and len(alphas) == b
-    pk = np.zeros((b, 32), np.uint8)
-    gamma = np.zeros((b, 32), np.uint8)
-    c = np.zeros((b, 16), np.uint8)
-    s = np.zeros((b, 32), np.uint8)
-    alpha = np.zeros((b, 32), np.uint8)
-    for i, (p, pi, al) in enumerate(zip(pks, proofs, alphas)):
-        assert len(p) == 32 and len(pi) == 80 and len(al) == 32
-        pk[i] = np.frombuffer(p, np.uint8)
-        gamma[i] = np.frombuffer(pi[:32], np.uint8)
-        c[i] = np.frombuffer(pi[32:48], np.uint8)
-        s[i] = np.frombuffer(pi[48:80], np.uint8)
-        alpha[i] = np.frombuffer(al, np.uint8)
+    assert all(len(p) == 32 for p in pks)
+    assert all(len(pi) == 80 for pi in proofs)
+    assert all(len(al) == 32 for al in alphas)
+    pk = np.frombuffer(b"".join(pks), np.uint8).reshape(b, 32).copy()
+    pr = np.frombuffer(b"".join(proofs), np.uint8).reshape(b, 80)
+    gamma = np.ascontiguousarray(pr[:, :32])
+    c = np.ascontiguousarray(pr[:, 32:48])
+    s = np.ascontiguousarray(pr[:, 48:80])
+    alpha = np.frombuffer(b"".join(alphas), np.uint8).reshape(b, 32).copy()
     return EcvrfBatch(pk, gamma, c, s, alpha)
 
 

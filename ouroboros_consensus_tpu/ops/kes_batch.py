@@ -45,24 +45,22 @@ def stage_np(
 ) -> KesBatch:
     b = len(vks)
     assert len(periods) == len(msgs) == len(sigs) == b
-    vk = np.zeros((b, 32), np.uint8)
-    period = np.zeros((b,), np.int32)
-    r = np.zeros((b, 32), np.uint8)
-    s = np.zeros((b, 32), np.uint8)
-    vk_leaf = np.zeros((b, 32), np.uint8)
-    siblings = np.zeros((b, depth, 32), np.uint8)
-    hmsgs = []
-    for i, (v, p, m, sig) in enumerate(zip(vks, periods, msgs, sigs)):
-        assert len(v) == 32 and len(sig) == hk.sig_bytes(depth)
-        ed_sig, leaf, sibs = hk.decompose_sig(sig, depth)
-        vk[i] = np.frombuffer(v, np.uint8)
-        period[i] = p
-        r[i] = np.frombuffer(ed_sig[:32], np.uint8)
-        s[i] = np.frombuffer(ed_sig[32:], np.uint8)
-        vk_leaf[i] = np.frombuffer(leaf, np.uint8)
-        for j, sb in enumerate(sibs):
-            siblings[i, j] = np.frombuffer(sb, np.uint8)
-        hmsgs.append(ed_sig[:32] + leaf + m)
+    sig_len = hk.sig_bytes(depth)
+    assert all(len(v) == 32 for v in vks)
+    assert all(len(sig) == sig_len for sig in sigs)
+    # CompactSum signature layout is fixed-width: slice the whole batch
+    # column-wise out of ONE buffer (sig = ed_sig(64) ‖ leaf(32) ‖
+    # siblings(depth*32) — hk.decompose_sig per lane, vectorized)
+    vk = np.frombuffer(b"".join(vks), np.uint8).reshape(b, 32).copy()
+    period = np.asarray(periods, np.int32)
+    sg = np.frombuffer(b"".join(sigs), np.uint8).reshape(b, sig_len)
+    r = np.ascontiguousarray(sg[:, :32])
+    s = np.ascontiguousarray(sg[:, 32:64])
+    vk_leaf = np.ascontiguousarray(sg[:, 64:96])
+    siblings = np.ascontiguousarray(sg[:, 96:].reshape(b, depth, 32))
+    hmsgs = [
+        sig[:32] + sig[64:96] + m for sig, m in zip(sigs, msgs)
+    ]
     hblocks, hnblocks = sha512.pad_messages_np(hmsgs, nb)
     return KesBatch(vk, period, r, s, vk_leaf, siblings, hblocks, hnblocks)
 

@@ -147,6 +147,21 @@ def host_prechecks(
     return HostChecks(kes_errors, vrf_errors, evol)
 
 
+@lru_cache(maxsize=4096)
+def _threshold_rows(sigma: Fraction, f: Fraction):
+    """Encoded (lo, hi) threshold byte rows per (sigma, f) — the
+    bracket itself is lru_cached too, but the per-header Fraction wrap
+    + 32-byte to_bytes/frombuffer encoding dominated staging before
+    this was hoisted. Clamped to the 256-bit compare domain: a
+    threshold of 2^256 means "every value wins", encoded as all-0xFF +
+    the hi-inclusive trick."""
+    lo, hi = leader_threshold_bracket(sigma, f)
+    return (
+        np.frombuffer(min(lo, (1 << 256) - 1).to_bytes(32, "big"), np.uint8),
+        np.frombuffer(min(hi, (1 << 256) - 1).to_bytes(32, "big"), np.uint8),
+    )
+
+
 def stage(
     params: PraosParams,
     ledger_view: LedgerView,
@@ -173,23 +188,19 @@ def stage(
         [hv.vrf_proof for hv in hvs],
         [nonces.mk_input_vrf(hv.slot, epoch_nonce) for hv in hvs],
     )
-    beta = np.zeros((b, 64), np.uint8)
+    assert all(len(hv.vrf_output) == 64 for hv in hvs)
+    beta = np.frombuffer(
+        b"".join(hv.vrf_output for hv in hvs), np.uint8
+    ).reshape(b, 64).copy()
     thr_lo = np.zeros((b, 32), np.uint8)
     thr_hi = np.zeros((b, 32), np.uint8)
-    f = params.active_slot_coeff
+    f = Fraction(params.active_slot_coeff)
     for i, hv in enumerate(hvs):
-        beta[i] = np.frombuffer(hv.vrf_output, np.uint8)
         entry = ledger_view.pool_distr.get(hash_key(hv.vk_cold))
         sigma = entry.stake if entry is not None else Fraction(0)
-        lo, hi = leader_threshold_bracket(Fraction(sigma), Fraction(f))
-        # clamp to the 256-bit compare domain: a threshold of 2^256 means
-        # "every value wins", encoded as all-0xFF + the hi-inclusive trick
-        thr_lo[i] = np.frombuffer(
-            min(lo, (1 << 256) - 1).to_bytes(32, "big"), np.uint8
-        )
-        thr_hi[i] = np.frombuffer(
-            min(hi, (1 << 256) - 1).to_bytes(32, "big"), np.uint8
-        )
+        lo_row, hi_row = _threshold_rows(sigma, f)
+        thr_lo[i] = lo_row
+        thr_hi[i] = hi_row
     return PraosBatch(ed, kes, vrf, beta, thr_lo, thr_hi)
 
 
