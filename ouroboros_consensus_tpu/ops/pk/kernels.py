@@ -235,3 +235,60 @@ def verify_praos_tiles(
         ed_ok, ed_pt, ed_r, kes_ok, kes_pt, kes_r, vrf_ok, vrf_pts,
         vrf_c, beta_decl, thr_lo, thr_hi,
     )
+
+
+# ---------------------------------------------------------------------------
+# Batch-first entry: relayout on DEVICE
+# ---------------------------------------------------------------------------
+
+
+def _bf(a):
+    """[B, n] host-staged (any int dtype) -> [n, B] int32, in XLA: the
+    transpose+widen costs ~20 us/header on host (pk_arrays) and ~nothing
+    fused into the device infeed."""
+    return jnp.transpose(jnp.asarray(a).astype(jnp.int32))
+
+
+def _bf_blocks(w):
+    """SHA-512 word blocks [B, NB, 16, 2] uint32 -> [NB, 128, B] int32
+    byte blocks (the limb-first hash input layout), in XLA."""
+    w = jnp.asarray(w)
+    b, nb = w.shape[0], w.shape[1]
+    shifts = jnp.asarray([24, 16, 8, 0], jnp.uint32)
+    hi = (w[..., 0:1] >> shifts) & jnp.uint32(0xFF)
+    lo = (w[..., 1:2] >> shifts) & jnp.uint32(0xFF)
+    by = jnp.concatenate([hi, lo], axis=-1)  # [B, NB, 16, 8]
+    return jnp.transpose(
+        by.reshape(b, nb, 128), (1, 2, 0)
+    ).astype(jnp.int32)
+
+
+def verify_praos_staged(
+    ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
+    kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+    kes_hblocks, kes_hnblocks,
+    vrf_pk, vrf_gamma, vrf_c, vrf_s, vrf_alpha,
+    beta, thr_lo, thr_hi,
+    *, kes_depth: int,
+):
+    """verify_praos_tiles over the HOST-STAGED batch-first layout
+    (protocol/batch.stage's uint8/uint32 [B, ...] columns): every
+    transpose/widen happens inside the jit so the host dispatch is a
+    plain argument pass."""
+    b = beta.shape[0]
+    return verify_praos_tiles(
+        _bf(ed_pk), _bf(ed_r), _bf(ed_s),
+        _bf_blocks(ed_hblocks),
+        jnp.asarray(ed_hnblocks).astype(jnp.int32).reshape(1, b),
+        _bf(kes_vk),
+        jnp.asarray(kes_period).astype(jnp.int32).reshape(1, b),
+        _bf(kes_r), _bf(kes_s), _bf(kes_vk_leaf),
+        jnp.transpose(
+            jnp.asarray(kes_siblings).astype(jnp.int32), (1, 2, 0)
+        ),
+        _bf_blocks(kes_hblocks),
+        jnp.asarray(kes_hnblocks).astype(jnp.int32).reshape(1, b),
+        _bf(vrf_pk), _bf(vrf_gamma), _bf(vrf_c), _bf(vrf_s), _bf(vrf_alpha),
+        _bf(beta), _bf(thr_lo), _bf(thr_hi),
+        kes_depth=kes_depth,
+    )

@@ -366,17 +366,25 @@ def _jitted_pk(kes_depth: int):
 
         _JIT[key] = jax.jit(
             functools.partial(
-                pk_kernels.verify_praos_tiles, kes_depth=kes_depth
+                pk_kernels.verify_praos_staged, kes_depth=kes_depth
             )
         )
     return _JIT[key]
 
 
 def _pk_dispatch(batch: PraosBatch):
-    """Stage + dispatch the Pallas path (async); -> opaque handle."""
+    """Dispatch the Pallas path (async); -> opaque handle. The staged
+    [B, ...] uint8 columns go straight to the jit — transposes and the
+    byte expansion run in XLA (pk_arrays on host cost ~20 us/header)."""
     depth = batch.kes.siblings.shape[-2]
-    arrays = pk_arrays(batch)
-    out = _jitted_pk(depth)(*(jnp.asarray(x) for x in arrays))
+    ed, kes, vrf = batch.ed, batch.kes, batch.vrf
+    out = _jitted_pk(depth)(
+        ed.pk, ed.r, ed.s, ed.hblocks, ed.hnblocks,
+        kes.vk, kes.period, kes.r, kes.s, kes.vk_leaf, kes.siblings,
+        kes.hblocks, kes.hnblocks,
+        vrf.pk, vrf.gamma, vrf.c, vrf.s, vrf.alpha,
+        batch.beta, batch.thr_lo, batch.thr_hi,
+    )
     return out
 
 

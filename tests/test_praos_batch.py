@@ -203,3 +203,49 @@ def test_leader_threshold_bracket_sane():
     assert 0 < lo <= hi < pbatch.leader.LEADER_VALUE_MAX
     assert hi - lo <= 1 << 200  # tight bracket (width << 2^256)
     assert pbatch.leader_threshold_bracket(Fraction(0), Fraction(1, 20)) == (0, 0)
+
+
+def test_staged_relayout_matches_pk_arrays(monkeypatch):
+    """verify_praos_staged (the PRODUCTION dispatch marshalling) must
+    hand verify_praos_tiles EXACTLY what the host-side pk_arrays built —
+    column for column, dtype for dtype. Captures the tiles call's real
+    arguments instead of re-implementing the relayout, so a swapped
+    argument in the staged entry fails here."""
+    import functools
+
+    import numpy as np
+
+    from ouroboros_consensus_tpu.ops.pk import kernels as K
+
+    pools = [fixtures.make_pool(i, kes_depth=PARAMS.kes_depth)
+             for i in range(3)]
+    lview = fixtures.make_ledger_view(pools)
+    hvs = make_chain(24, pools, lview=lview)
+    pre = pbatch.host_prechecks(PARAMS, lview, hvs)
+    staged = pbatch.stage(PARAMS, lview, b"\x07" * 32, hvs, pre.kes_evolution)
+    ref = pbatch.pk_arrays(staged)
+
+    captured = {}
+
+    def capture(*args, kes_depth):
+        captured["args"] = args
+        captured["kes_depth"] = kes_depth
+        return None
+
+    monkeypatch.setattr(K, "verify_praos_tiles", capture)
+    ed, kes, vrf = staged.ed, staged.kes, staged.vrf
+    K.verify_praos_staged(
+        ed.pk, ed.r, ed.s, ed.hblocks, ed.hnblocks,
+        kes.vk, kes.period, kes.r, kes.s, kes.vk_leaf, kes.siblings,
+        kes.hblocks, kes.hnblocks,
+        vrf.pk, vrf.gamma, vrf.c, vrf.s, vrf.alpha,
+        staged.beta, staged.thr_lo, staged.thr_hi,
+        kes_depth=PARAMS.kes_depth,
+    )
+    got = captured["args"]
+    assert captured["kes_depth"] == PARAMS.kes_depth
+    assert len(ref) == len(got) == 21
+    for i, (a, b) in enumerate(zip(ref, got)):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.shape == b.shape and a.dtype == b.dtype == np.int32, i
+        assert (a == b).all(), i
