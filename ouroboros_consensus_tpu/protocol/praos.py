@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field, replace
 from fractions import Fraction
+from functools import cached_property
 from typing import Mapping, Protocol as TyProtocol
 
 from ..ops.host import ecvrf as host_ecvrf
@@ -49,9 +50,12 @@ class PraosParams:
     epoch_length: int = 432000  # fixed EpochInfo (slots per epoch)
     kes_depth: int = host_kes.DEFAULT_DEPTH  # CompactSum tree depth
 
-    @property
+    @cached_property
     def stability_window(self) -> int:
-        """3k/f rounded up (cardano-ledger computeStabilityWindow)."""
+        """3k/f rounded up (cardano-ledger computeStabilityWindow).
+        Cached: the Fraction division costs ~12 us and the replay fold
+        asks once per header (frozen dataclass — the value is stored in
+        the instance __dict__, bypassing the frozen setattr guard)."""
         w = 3 * self.security_param / self.active_slot_coeff
         return int(-(-w // 1))
 

@@ -11,13 +11,18 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from fractions import Fraction
+from functools import lru_cache
 from typing import Mapping
 
 from ..ops.host.hashes import blake2b_224, blake2b_256
 
 
+@lru_cache(maxsize=65536)
 def hash_key(vk_cold: bytes) -> bytes:
-    """KeyHash (Blake2b-224) of an Ed25519 cold verification key."""
+    """KeyHash (Blake2b-224) of an Ed25519 cold verification key.
+
+    Cached: a chain has few distinct issuers but the replay hot path
+    asks several times per header (staging, counter fold, views)."""
     return blake2b_224(vk_cold)
 
 
