@@ -540,7 +540,7 @@ def run_thread_network(base_dir: str, cfg: ThreadNetConfig) -> ThreadNetResult:
         from ..ledger.mock import encode_tx
 
         def txgen():
-            from ..ledger.mock import InvalidTx
+            from ..ledger.abstract import LedgerError
             from ..mempool import MempoolFull
 
             k = 0
@@ -555,9 +555,11 @@ def run_thread_network(base_dir: str, cfg: ThreadNetConfig) -> ThreadNetResult:
                 )
                 try:
                     net.nodes[node_ix].mempool.add_tx(tx)
-                except (InvalidTx, MempoolFull) as e:
+                except (LedgerError, MempoolFull) as e:
                     # a duplicate spend after tx diffusion raced ahead is
-                    # fine; anything else is a generator bug worth seeing
+                    # fine, as is a mock-era tx offered to a node whose
+                    # mempool already anchors past a hard fork (another
+                    # era's rules reject it — GenTx era mismatch)
                     net.nodes[node_ix].trace(f"txgen: rejected: {e!r}")
                 k += 1
 

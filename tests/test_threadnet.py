@@ -318,3 +318,37 @@ def test_two_era_network_with_live_shelley_ledger(tmp_path):
     assert sum(st.inner.blocks_current.values()) + sum(
         st.inner.blocks_prev.values()
     ) == sum(1 for e in eras if e == 1)
+
+
+def test_shelley_era_network_under_lottery_and_txgen(tmp_path):
+    """The mock->Shelley net with a REAL leader lottery (f = 1/2), every
+    node forging, and TxGen spending mock-era outputs across the run:
+    pre-fork nodes must forecast era-B leadership with the SHELLEY view
+    (the cross-era forecast path), re-addressed outputs keep their stake
+    through the boundary translation, and post-fork mock-era txs are
+    rejected by era dispatch without killing the generator."""
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=3, n_slots=60, k=40, msg_delay=0.05,
+        active_slot_coeff=Fraction(1, 2),
+        epoch_length=10,
+        hard_fork_at_epoch=2,
+        hf_shelley_era=True,
+        tx_gen_every=3,
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    threadnet.check_chain_growth(res, cfg)
+    assert res.chain_hashes(1) == res.chain_hashes(0) == res.chain_hashes(2)
+    from ouroboros_consensus_tpu.hardfork.combinator import HardForkBlock
+    from ouroboros_consensus_tpu.ledger.shelley import ShelleyState
+
+    eras = [b.era for b in res.chains[0] if isinstance(b, HardForkBlock)]
+    assert 1 in eras, "no era-B blocks under the lottery"
+    st = res.nodes[0].chain_db.current_ledger().ledger_state
+    assert st.era == 1 and isinstance(st.inner, ShelleyState)
+    # at least one pre-fork TxGen spend moved a genesis output, and the
+    # re-addressed outputs still carry stake in the translated state
+    spent = [a for (a, _c) in st.inner.utxo.values()
+             if a[0].startswith(b"paid-")]
+    assert spent, "TxGen never landed a pre-fork spend"
+    assert all(s is not None for (_p, s) in spent)
