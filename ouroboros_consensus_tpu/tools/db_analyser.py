@@ -101,25 +101,40 @@ def _epoch_segments(params: PraosParams, headers):
 
 
 def _views_from_columns(cols):
-    """native_loader.HeaderColumns -> HeaderViews (no Python CBOR)."""
+    """native_loader.HeaderColumns -> HeaderViews (no Python CBOR).
+
+    Whole-column tobytes + slicing: per-row numpy bytes() conversions
+    cost ~10 us/header, bytes slicing ~0.1 us."""
     from ..protocol.views import HeaderView, OCert
 
+    n = cols.n
+    prev_b = cols.prev_hash.tobytes()
+    issuer_b = cols.issuer_vk.tobytes()
+    vrf_vk_b = cols.vrf_vk.tobytes()
+    vrf_out_b = cols.vrf_output.tobytes()
+    vrf_prf_b = cols.vrf_proof.tobytes()
+    ocert_vk_b = cols.ocert_vk.tobytes()
+    has_prev = cols.has_prev.tolist()
+    counters = cols.ocert_counter.tolist()
+    kes_periods = cols.ocert_kes_period.tolist()
+    slots = cols.slot.tolist()
     out = []
-    for i in range(cols.n):
+    for i in range(n):
+        o32 = 32 * i
         out.append(
             HeaderView(
-                prev_hash=bytes(cols.prev_hash[i]) if cols.has_prev[i] else None,
-                vk_cold=bytes(cols.issuer_vk[i]),
-                vrf_vk=bytes(cols.vrf_vk[i]),
-                vrf_output=bytes(cols.vrf_output[i]),
-                vrf_proof=bytes(cols.vrf_proof[i]),
+                prev_hash=prev_b[o32:o32 + 32] if has_prev[i] else None,
+                vk_cold=issuer_b[o32:o32 + 32],
+                vrf_vk=vrf_vk_b[o32:o32 + 32],
+                vrf_output=vrf_out_b[64 * i:64 * i + 64],
+                vrf_proof=vrf_prf_b[80 * i:80 * i + 80],
                 ocert=OCert(
-                    bytes(cols.ocert_vk[i]),
-                    int(cols.ocert_counter[i]),
-                    int(cols.ocert_kes_period[i]),
+                    ocert_vk_b[o32:o32 + 32],
+                    counters[i],
+                    kes_periods[i],
                     cols.ocert_sigma[i],
                 ),
-                slot=int(cols.slot[i]),
+                slot=slots[i],
                 signed_bytes=cols.signed_bytes[i],
                 kes_sig=cols.kes_sig[i],
             )
