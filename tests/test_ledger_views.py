@@ -311,3 +311,44 @@ def test_synthesizer_ledger_mode_shelley(tmp_path, pools):
     )
     assert out.error is None, repr(out.error)
     assert out.n_valid == out.n_blocks == res.n_blocks
+
+
+def test_store_ledger_state_at_shelley(shelley_chain, tmp_path):
+    """StoreLedgerStateAt over the REAL STS ledger: the stored snapshot
+    (v2 codec) decodes to exactly the (ledger state, tip, protocol
+    state) a direct fold reaches — the payload a resumed replay seeds
+    from."""
+    from ouroboros_consensus_tpu.block.praos_block import Block
+    from ouroboros_consensus_tpu.ledger.shelley import ShelleyState
+    from ouroboros_consensus_tpu.storage.ledgerdb import decode_snapshot
+
+    path, n_blocks, ledger, st0 = shelley_chain
+    at = 2 * PARAMS.epoch_length  # into epoch 2
+    lview0 = ledger.view_for_epoch(st0, 0)
+    name = db_analyser.store_ledger_state_at(
+        path, PARAMS, lview0, at, ledger, st0, str(tmp_path / "snaps"),
+    )
+    assert name is not None
+    with open(tmp_path / "snaps" / name, "rb") as f:
+        ext = decode_snapshot(f.read())
+    assert isinstance(ext.ledger_state, ShelleyState)
+    # the snapshot equals the direct fold to the same point — ledger
+    # state, the exact tip, AND the protocol (nonce/counter) state
+    imm = db_analyser.open_immutable(path)
+    lst = st0
+    st = praos.PraosState()
+    last = None
+    for entry, raw in imm.stream_all():
+        if entry.slot > at:
+            break
+        b = Block.from_bytes(raw)
+        ticked = praos.tick(PARAMS, lview0, b.header.slot, st)
+        st = praos.reupdate(PARAMS, b.header.to_view(), b.header.slot, ticked)
+        lst = ledger.tick_then_reapply(lst, b)
+        last = b
+    assert ext.ledger_state == lst
+    tip = ext.header_state.tip
+    assert (tip.slot, tip.block_no, tip.hash_) == (
+        last.header.slot, last.header.block_no, last.hash_
+    )
+    assert ext.header_state.chain_dep_state == st
