@@ -188,29 +188,45 @@ def run_device_subprocess() -> dict | None:
     env["OCT_RESULT"] = result_path
     env["OCT_REPO"] = os.path.dirname(os.path.abspath(__file__))
     env["OCT_JAX_CACHE"] = JAX_CACHE
-    budget = min(DEVICE_BUDGET, _remaining() - 30)  # 30s to emit the line
-    if budget <= 60:
-        print("# no wall budget left for the device measurement",
-              file=sys.stderr)
-        return None
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _DEVICE_CHILD],
-            timeout=budget, env=env,
-            stdout=sys.stderr, stderr=subprocess.STDOUT,
-        )
-    except subprocess.TimeoutExpired:
-        # a timeout after the warmup replay still yields a real
-        # end-to-end number — read the provisional checkpoint
-        print(f"# device measurement exceeded {budget:.0f}s budget "
-              "(keeping any provisional checkpoint)", file=sys.stderr)
-    else:
-        if proc.returncode != 0:
-            # an assertion/crash in the child means the device produced
-            # WRONG results somewhere — never report its checkpoint
-            print(f"# device measurement failed rc={proc.returncode}",
+    # Two attempts inside the budget: the pk dispatch is per-stage jits
+    # (ops/pk/kernels.verify_praos_split), so every stage a killed child
+    # DID compile is already in the persistent cache — the retry resumes
+    # at the first uncompiled stage instead of starting over. First
+    # attempt gets the lion's share; the retry only makes sense if real
+    # time remains.
+    for attempt in (1, 2):
+        budget = min(DEVICE_BUDGET, _remaining() - 30)  # 30s to emit
+        if budget <= 60:
+            print("# no wall budget left for the device measurement",
                   file=sys.stderr)
-            return None
+            break
+        if attempt == 1:
+            budget = min(budget, max(60.0, _remaining() * 0.7))
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _DEVICE_CHILD],
+                timeout=budget, env=env,
+                stdout=sys.stderr, stderr=subprocess.STDOUT,
+            )
+        except subprocess.TimeoutExpired:
+            # a timeout after the warmup replay still yields a real
+            # end-to-end number — read the provisional checkpoint; if
+            # there is none, the retry rides the now-warmer cache
+            print(
+                f"# device attempt {attempt} exceeded {budget:.0f}s "
+                "budget (keeping any provisional checkpoint)",
+                file=sys.stderr,
+            )
+            if not os.path.exists(result_path):
+                continue
+        else:
+            if proc.returncode != 0:
+                # an assertion/crash in the child means the device
+                # produced WRONG results — never report its checkpoint
+                print(f"# device measurement failed rc={proc.returncode}",
+                      file=sys.stderr)
+                return None
+        break
     try:
         with open(result_path) as f:
             return json.load(f)

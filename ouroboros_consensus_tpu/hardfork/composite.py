@@ -43,7 +43,13 @@ from ..protocol.views import hash_vrf_vk
 from ..storage.immutable import ImmutableDB
 from ..testing import fixtures
 from .byron_mock import ByronMockBlock
-from .combinator import Era, HardForkBlock, HardForkProtocol, decode_block
+from .combinator import (
+    Era,
+    HardForkBlock,
+    HardForkLedger,
+    HardForkProtocol,
+    decode_block,
+)
 from .history import EraParams, summarize
 
 
@@ -73,6 +79,14 @@ class CardanoMockConfig:
     # ~k/2 + 1 of any window — the threshold must clear that
     pbft_threshold: Fraction = Fraction(4, 5)
     shelley_initial_nonce: bytes = b"\x0b" * 32
+    # LEDGERS IN THE LOOP: era 0 = real Byron-class UTxO+delegation
+    # ledger, era 1 = real Shelley STS, era 2 = Mary-class multi-asset
+    # rules — synthesize forges real value-moving txs and revalidate
+    # folds every block through the era ledgers (the reference's
+    # db-analyser always replays the real ledger; here it is opt-in so
+    # the consensus-only bench path stays unchanged). Requires the
+    # Byron era to end exactly on a Shelley epoch boundary.
+    with_ledgers: bool = False
 
 
 class CardanoMock:
@@ -209,6 +223,90 @@ class CardanoMock:
             self.conway_params,
             self.leios_params,
         ]
+        self.hf_ledger = None
+        if cfg.with_ledgers:
+            self._init_ledgers()
+
+    # the well-known spending key of the ledger-backed composite: the
+    # whole synthesized value chain rides on it (revalidate re-derives
+    # the genesis outputs from it)
+    LEDGER_SPEND_SEED = b"\x51" * 32
+    LEDGER_GENESIS_COIN = 10_000_000
+    LEDGER_BYRON_FEE = 10
+    MINT_POLICY_SEED = b"\x52" * 32
+    MINT_ASSET = b"MIX"
+
+    def _init_ledgers(self) -> None:
+        from ..ledger import mary as mary_mod
+        from ..ledger.byron import ByronGenesis, ByronLedger, ByronPParams
+        from ..ledger.mary import MaryLedger
+        from ..ledger.shelley import (
+            PParams as ShPParams,
+            ShelleyGenesis,
+            ShelleyLedger,
+        )
+
+        cfg = self.cfg
+        if cfg.conway_epochs is not None:
+            raise ValueError("with_ledgers covers the 3-era composite")
+        shelley_start = self.summary.eras[1].start.slot
+        if shelley_start % cfg.epoch_length != 0:
+            raise ValueError(
+                f"with_ledgers: Byron must end on a Shelley epoch "
+                f"boundary (era start {shelley_start}, epoch_length "
+                f"{cfg.epoch_length})"
+            )
+        self.byron_ledger = ByronLedger(ByronGenesis(
+            pparams=ByronPParams(
+                min_fee_a=self.LEDGER_BYRON_FEE, min_fee_b=0
+            ),
+            genesis_keys=tuple(d.vk_cold for d in self.delegs),
+            epoch_length=cfg.byron_epoch_length,
+            security_param=cfg.k,
+        ))
+        sh_gen = ShelleyGenesis(
+            pparams=ShPParams(min_fee_a=0, min_fee_b=0),
+            epoch_length=cfg.epoch_length,
+            stability_window=3 * cfg.k,
+        )
+        self.shelley_ledger = ShelleyLedger(sh_gen)
+        self.mary_ledger = MaryLedger(sh_gen)
+        ledger_eras = [
+            replace(self.eras[0], ledger=self.byron_ledger),
+            replace(
+                self.eras[1],
+                ledger=self.shelley_ledger,
+                # Byron->Shelley: carry the UTxO verbatim
+                # (CanHardFork.hs translateLedgerStateByronToShelley)
+                translate_ledger_state=(
+                    lambda st: self.shelley_ledger.translate_from_utxo_ledger(
+                        st, at_slot=shelley_start
+                    )
+                ),
+            ),
+            replace(
+                self.eras[2],
+                ledger=self.mary_ledger,
+                # Shelley->Mary: Coin widens to MaryValue
+                translate_ledger_state=self.mary_ledger.translate_from_shelley,
+                translate_tx=mary_mod.translate_tx_from_shelley,
+            ),
+        ]
+        self.eras = ledger_eras
+        self.hf = HardForkProtocol(self.eras, self.summary)
+        self.hf_ledger = HardForkLedger(self.eras, self.summary)
+
+    def ledger_genesis_state(self):
+        """The HFState the ledger-backed chain starts from (Byron era,
+        one genesis output held by the well-known spending key)."""
+        from ..ledger.byron import addr_of
+        from ..ops.host import ed25519 as host_ed25519
+
+        addr = addr_of(host_ed25519.secret_to_public(self.LEDGER_SPEND_SEED))
+        inner = self.byron_ledger.genesis_state(
+            [(addr, self.LEDGER_GENESIS_COIN)]
+        )
+        return self.hf_ledger.genesis_state(inner)
 
     def view_for_era(self, era: int):
         return None if era == 0 else (
@@ -219,6 +317,69 @@ class CardanoMock:
 # ---------------------------------------------------------------------------
 # Synthesis (db-synthesizer over the composite)
 # ---------------------------------------------------------------------------
+
+
+class _LedgerTxChain:
+    """The value chain the ledger-backed composite forges: era-0 txs
+    spend Byron UTxO (fee-paying, witnessed), the carried output is
+    spent under the Shelley rules, and the Mary-class era mints a native
+    asset that rides the rest of the chain — so revalidation proves
+    era-0 value stayed spendable across BOTH translations."""
+
+    def __init__(self, cm: "CardanoMock"):
+        from ..ledger.byron import addr_of
+        from ..ops.host import ed25519 as host_ed25519
+
+        self.cm = cm
+        self.vk = host_ed25519.secret_to_public(cm.LEDGER_SPEND_SEED)
+        self.addr = addr_of(self.vk)
+        self.outpoint = (bytes(32), 0)
+        self.value = cm.LEDGER_GENESIS_COIN
+        self.assets: dict = {}
+        self.minted = False
+
+    def tx_for(self, era: int) -> bytes:
+        from ..ledger import byron as byron_led
+        from ..ledger import mary as mary_mod
+        from ..ledger import shelley as shelley_mod
+        from ..ops.host import ed25519 as host_ed25519
+
+        if era == 0:
+            fee = self.cm.LEDGER_BYRON_FEE
+            outs = [(self.addr, self.value - fee)]
+            tx = byron_led.make_tx(
+                [self.outpoint], outs, [self.cm.LEDGER_SPEND_SEED]
+            )
+            self.outpoint = (byron_led.tx_id_of([self.outpoint], outs), 0)
+            self.value -= fee
+            return tx
+        if era == 1:
+            tx = shelley_mod.encode_tx(
+                [self.outpoint], [(self.addr, None, self.value)],
+                fee=0, ttl=2**62,
+            )
+            self.outpoint = (shelley_mod.tx_id(tx), 0)
+            return tx
+        # Mary-class era: mint once, then carry the asset along
+        pid = mary_mod.policy_id(
+            host_ed25519.secret_to_public(self.cm.MINT_POLICY_SEED)
+        )
+        if not self.minted:
+            self.assets = {(pid, self.cm.MINT_ASSET): 1_000}
+            outs = [(self.addr, None,
+                     mary_mod.MaryValue(self.value, self.assets))]
+            wit = mary_mod.make_mint_witness(
+                self.cm.MINT_POLICY_SEED, [self.outpoint], outs, 0,
+                (None, None), {self.cm.MINT_ASSET: 1_000},
+            )
+            tx = mary_mod.encode_tx([self.outpoint], outs, mint=[wit])
+            self.minted = True
+        else:
+            outs = [(self.addr, None,
+                     mary_mod.MaryValue(self.value, self.assets))]
+            tx = mary_mod.encode_tx([self.outpoint], outs)
+        self.outpoint = (shelley_mod.tx_id(tx), 0)
+        return tx
 
 
 def synthesize(path: str, cfg: CardanoMockConfig, n_slots: int, chunk_size: int = 500):
@@ -232,6 +393,8 @@ def synthesize(path: str, cfg: CardanoMockConfig, n_slots: int, chunk_size: int 
         raise RuntimeError(f"refusing to forge into non-empty DB at {path}")
 
     st = cm.hf.initial_state()
+    chain = _LedgerTxChain(cm) if cfg.with_ledgers else None
+    lst = cm.ledger_genesis_state() if cfg.with_ledgers else None
     prev: bytes | None = None
     block_no = 0
     n_blocks = 0
@@ -248,6 +411,8 @@ def synthesize(path: str, cfg: CardanoMockConfig, n_slots: int, chunk_size: int 
                 hfb = HardForkBlock(era, ebb)
                 imm.append_block(slot, ebb.block_no, hfb.hash_, hfb.bytes_)
                 st = cm.hf.reupdate(ebb.header.to_view(), slot, ticked)
+                if lst is not None:
+                    lst = cm.hf_ledger.tick_then_apply(lst, hfb)
                 prev = hfb.hash_
                 n_blocks += 1
                 continue  # the EBB owns the epoch's first slot
@@ -255,7 +420,10 @@ def synthesize(path: str, cfg: CardanoMockConfig, n_slots: int, chunk_size: int 
             blk = byron_mock.forge_block(
                 cm.delegs[j].cold_seed,
                 slot=slot, block_no=block_no, prev_hash=prev,
-                txs=(b"byron-tx-%d" % slot,),
+                txs=(
+                    (chain.tx_for(0),) if chain is not None
+                    else (b"byron-tx-%d" % slot,)
+                ),
             )
         else:
             params = cm.inner_params[era]
@@ -293,11 +461,17 @@ def synthesize(path: str, cfg: CardanoMockConfig, n_slots: int, chunk_size: int 
             blk = praos_forge.forge_block(
                 inner_params, creds,
                 slot=slot, block_no=block_no, prev_hash=prev,
-                epoch_nonce=eta0, txs=(b"tx-%d" % slot,),
+                epoch_nonce=eta0,
+                txs=(
+                    (chain.tx_for(era),) if chain is not None
+                    else (b"tx-%d" % slot,)
+                ),
             )
         hfb = HardForkBlock(era, blk)
         imm.append_block(slot, block_no, hfb.hash_, hfb.bytes_)
         st = cm.hf.reupdate(blk.header.to_view(), slot, ticked)
+        if lst is not None:
+            lst = cm.hf_ledger.tick_then_apply(lst, hfb)
         prev = hfb.hash_
         block_no += 1
         n_blocks += 1
@@ -317,6 +491,7 @@ class MixedResult:
     error: Exception | None = None
     final_state: object | None = None
     per_era: dict | None = None
+    final_ledger_state: object | None = None  # with_ledgers only
 
 
 def _bucket_pad(items, fill):
@@ -434,4 +609,18 @@ def revalidate(path: str, cfg: CardanoMockConfig, backend: str = "device") -> Mi
             break
         i = j
     res.final_state = st
+    if cfg.with_ledgers and res.error is None:
+        # the ledger replay (db-analyser always does this; opt-in here):
+        # full rule application per block, translations at era crossings;
+        # a ledger-rule failure reports through MixedResult.error exactly
+        # like a consensus-segment failure
+        from ..ledger.abstract import LedgerError
+
+        lst = cm.ledger_genesis_state()
+        try:
+            for blk in blocks:
+                lst = cm.hf_ledger.tick_then_apply(lst, blk)
+        except LedgerError as e:
+            res.error = e
+        res.final_ledger_state = lst
     return res

@@ -1,0 +1,300 @@
+"""ByronSpec: an independently written executable specification of the
+Byron-class rules, run in lock-step with the implementation ledger.
+
+Reference: `src/byronspec/` (wraps `byron-spec-ledger`) + `Ledger/Dual.hs`
+— the real Byron impl and the executable spec applied to the same
+blocks, any disagreement surfaced immediately (DualByron ThreadNet test,
+`byron-test/Test/ThreadNet/DualByron.hs`).
+
+Independence contract (same as ledger/dual.py's mock pairing): the spec
+decodes wire bytes itself, computes tx ids itself (hashlib directly),
+and owns its abstract state; it shares only the FOUNDATION libraries
+with the impl — generic CBOR and the Ed25519 primitive — exactly as
+byron-spec-ledger shares cardano-binary/cardano-crypto with the real
+implementation. No impl code is consulted while the spec folds.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Mapping
+
+from ..ops.host import ed25519 as _ed
+from ..utils import cbor
+from . import byron as impl_byron
+from .byron import ByronGenesis, ByronTxError
+
+
+class DualByronMismatch(AssertionError):
+    """Impl and spec disagree — a conformance bug, never a valid-chain
+    outcome."""
+
+
+class SpecRejected(Exception):
+    """The spec's own invalid verdict (never escapes the pairing)."""
+
+
+@dataclass(frozen=True)
+class ByronSpecState:
+    """Abstract state: utxo (outpoint -> (owner, value)) + the
+    delegation relation, nothing else."""
+
+    utxo: Mapping[tuple[bytes, int], tuple[bytes, int]]
+    delegation: Mapping[bytes, bytes]
+    fees: int = 0
+
+    @property
+    def balances(self) -> dict[bytes, int]:
+        out: dict[bytes, int] = {}
+        for addr, amt in self.utxo.values():
+            out[addr] = out.get(addr, 0) + amt
+        return out
+
+
+class ByronSpecLedger:
+    """The executable spec, written from the wire format down."""
+
+    def __init__(self, genesis_keys, pparams, epoch_length: int):
+        self.genesis_keys = set(genesis_keys)
+        self.fee_a = pparams.min_fee_a
+        self.fee_b = pparams.min_fee_b
+        self.max_size = pparams.max_tx_size
+        self.epoch_length = epoch_length
+
+    @staticmethod
+    def _hash(data: bytes, n: int) -> bytes:
+        return hashlib.blake2b(data, digest_size=n).digest()
+
+    def genesis_state(self, initial_outputs) -> ByronSpecState:
+        return ByronSpecState(
+            utxo={(bytes(32), ix): (bytes(a), int(c))
+                  for ix, (a, c) in enumerate(initial_outputs)},
+            delegation={vk: vk for vk in self.genesis_keys},
+        )
+
+    def apply_payload(self, st: ByronSpecState, raw: bytes,
+                      slot: int) -> ByronSpecState:
+        try:
+            tag, body = cbor.decode(raw)
+        except Exception as e:
+            raise SpecRejected(f"undecodable: {e!r}") from e
+        if tag == 0:
+            return self._apply_tx(st, body, raw)
+        if tag == 1:
+            return self._apply_dcert(st, body, slot)
+        raise SpecRejected(f"unknown tag {tag!r}")
+
+    def _apply_tx(self, st: ByronSpecState, body, raw: bytes) -> ByronSpecState:
+        try:
+            ins_o, outs_o, wits_o = body
+            ins = [(bytes(i[0]), i[1]) for i in ins_o]
+            outs = [(bytes(a), c) for a, c in outs_o]
+            wits = [(bytes(vk), bytes(sg)) for vk, sg in wits_o]
+            if not all(isinstance(ix, int) for _t, ix in ins):
+                raise SpecRejected("non-integer index")
+            if not all(isinstance(c, int) for _a, c in outs):
+                raise SpecRejected("non-integer amount")
+        except SpecRejected:
+            raise
+        except Exception as e:
+            raise SpecRejected(f"malformed tx: {e!r}") from e
+        if len(raw) > self.max_size:
+            raise SpecRejected("oversize")
+        if not ins or len(set(ins)) != len(ins):
+            raise SpecRejected("empty or duplicate inputs")
+        if any(c <= 0 for _a, c in outs):
+            raise SpecRejected("non-positive output")
+        # the spec's own signing-data derivation
+        sig_data = self._hash(cbor.encode([
+            [[t, ix] for t, ix in ins], [[a, c] for a, c in outs],
+        ]), 32)
+        wit_by_addr = {self._hash(vk, 28): (vk, sg) for vk, sg in wits}
+        utxo = dict(st.utxo)
+        consumed = 0
+        for txin in ins:
+            if txin not in utxo:
+                raise SpecRejected(f"missing input {txin!r}")
+            addr, amt = utxo.pop(txin)
+            w = wit_by_addr.get(addr)
+            if w is None:
+                raise SpecRejected("unwitnessed input")
+            consumed += amt
+        for vk, sg in wits:
+            if not _ed.verify(vk, sig_data, sg):
+                raise SpecRejected("bad witness signature")
+        produced = sum(c for _a, c in outs)
+        if consumed < produced:
+            raise SpecRejected("value not conserved")
+        fee = consumed - produced
+        if fee < self.fee_a + self.fee_b * len(raw):
+            raise SpecRejected("fee too small")
+        tid = sig_data  # tx id = hash of the witness-free body
+        for ix, (addr, amt) in enumerate(outs):
+            utxo[(tid, ix)] = (addr, amt)
+        return ByronSpecState(utxo, st.delegation, st.fees + fee)
+
+    def _apply_dcert(self, st: ByronSpecState, body, slot: int) -> ByronSpecState:
+        try:
+            gvk, dvk, epoch, sig = body
+            gvk, dvk, sig = bytes(gvk), bytes(dvk), bytes(sig)
+            epoch = int(epoch)
+        except Exception as e:
+            raise SpecRejected(f"malformed dcert: {e!r}") from e
+        if gvk not in self.genesis_keys:
+            raise SpecRejected("not a genesis key")
+        if epoch != slot // self.epoch_length:
+            raise SpecRejected("wrong epoch")
+        if not _ed.verify(gvk, cbor.encode([dvk, epoch]), sig):
+            raise SpecRejected("bad cert signature")
+        dlg = dict(st.delegation)
+        for gk, cur in dlg.items():
+            if cur == dvk and gk != gvk:
+                raise SpecRejected("delegate already in use")
+        dlg[gvk] = dvk
+        return ByronSpecState(st.utxo, dlg, st.fees)
+
+
+# ---------------------------------------------------------------------------
+# The pairing
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DualByronState:
+    impl: impl_byron.ByronState
+    spec: ByronSpecState
+
+    @property
+    def utxo(self):
+        return self.impl.utxo
+
+    @property
+    def delegation(self):
+        return self.impl.delegation
+
+    @property
+    def tip_slot_(self):
+        return self.impl.tip_slot_
+
+
+@dataclass(frozen=True)
+class TickedDualByronState:
+    state: DualByronState
+    slot: int
+
+
+class DualByronLedger:
+    """Ledger interface over the (ByronLedger, ByronSpecLedger) pair —
+    the DualByron conformance harness as a drop-in ledger."""
+
+    def __init__(self, genesis: ByronGenesis):
+        self.genesis = genesis
+        self.impl = impl_byron.ByronLedger(genesis)
+        self.spec = ByronSpecLedger(
+            genesis.genesis_keys, genesis.pparams, genesis.epoch_length
+        )
+
+    def _check_agreement(self, st: DualByronState, where: str) -> None:
+        impl_bal: dict[bytes, int] = {}
+        for addr, amt in st.impl.utxo.values():
+            impl_bal[addr] = impl_bal.get(addr, 0) + amt
+        if impl_bal != dict(st.spec.balances):
+            raise DualByronMismatch(
+                f"{where}: impl balances {impl_bal} != spec "
+                f"{dict(st.spec.balances)}"
+            )
+        if dict(st.impl.delegation) != dict(st.spec.delegation):
+            raise DualByronMismatch(
+                f"{where}: delegation maps disagree: "
+                f"{st.impl.delegation} != {st.spec.delegation}"
+            )
+        if st.impl.fees != st.spec.fees:
+            raise DualByronMismatch(
+                f"{where}: fee pots disagree: {st.impl.fees} != "
+                f"{st.spec.fees}"
+            )
+
+    def genesis_state(self, initial_outputs) -> DualByronState:
+        st = DualByronState(
+            self.impl.genesis_state(initial_outputs),
+            self.spec.genesis_state(initial_outputs),
+        )
+        self._check_agreement(st, "genesis")
+        return st
+
+    def tick(self, state: DualByronState, slot: int) -> TickedDualByronState:
+        return TickedDualByronState(state, slot)
+
+    def _apply(self, ticked: TickedDualByronState, block,
+               check: bool) -> DualByronState:
+        hdr = getattr(block, "header", None)
+        impl_ticked = self.impl.tick(ticked.state.impl, ticked.slot)
+        if hdr is not None and getattr(hdr, "is_ebb", False):
+            return DualByronState(
+                self.impl.apply_block(impl_ticked, block), ticked.state.spec
+            )
+        # fold BOTH ledgers per payload, demanding validity agreement
+        # (the reference applyHelper pairing)
+        impl_view = self.impl.mempool_view(ticked.state.impl, ticked.slot)
+        spec = ticked.state.spec
+        for raw in block.txs:
+            impl_err = spec_err = None
+            try:
+                impl_view = self.impl.apply_tx(impl_view, raw)
+            except ByronTxError as e:
+                impl_err = e
+            try:
+                spec = self.spec.apply_payload(spec, raw, ticked.slot)
+            except SpecRejected as e:
+                spec_err = e
+            if (impl_err is None) != (spec_err is None):
+                raise DualByronMismatch(
+                    f"block @{block.slot}: validity disagreement — "
+                    f"impl: {impl_err!r}, spec: {spec_err!r}"
+                )
+            if impl_err is not None:
+                raise impl_err
+        out = DualByronState(
+            impl_byron.ByronState(
+                utxo=impl_view.utxo, delegation=impl_view.delegation,
+                fees=ticked.state.impl.fees + impl_view.fee_delta,
+                tip_slot_=ticked.slot,
+            ),
+            spec,
+        )
+        if check:
+            self._check_agreement(out, f"block @{block.slot}")
+        return out
+
+    def apply_block(self, ticked, block) -> DualByronState:
+        return self._apply(ticked, block, check=True)
+
+    def reapply_block(self, ticked, block) -> DualByronState:
+        return self._apply(ticked, block, check=False)
+
+    def tip_slot(self, state: DualByronState):
+        return state.impl.tip_slot_
+
+    def mempool_view(self, state: DualByronState, slot: int):
+        return self.impl.mempool_view(state.impl, slot)
+
+    def apply_tx(self, view, tx_bytes: bytes):
+        return self.impl.apply_tx(view, tx_bytes)
+
+    def protocol_ledger_view(self, ticked: TickedDualByronState):
+        return self.impl.protocol_ledger_view(
+            self.impl.tick(ticked.state.impl, ticked.slot)
+        )
+
+    def ledger_view_forecast_at(self, state: DualByronState):
+        return self.impl.ledger_view_forecast_at(state.impl)
+
+    def tick_then_apply(self, state, block):
+        return self.apply_block(self.tick(state, block.slot), block)
+
+    def tick_then_reapply(self, state, block):
+        return self.reapply_block(self.tick(state, block.slot), block)
+
+    def inspect(self, old, new) -> list:
+        return []

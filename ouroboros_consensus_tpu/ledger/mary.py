@@ -1,0 +1,346 @@
+"""Mary-class era: the Shelley rules extended with MULTI-ASSET values,
+MINTING, and Allegra-style VALIDITY INTERVALS — a post-Shelley era whose
+LEDGER genuinely differs (new tx wire format, new rules, new state
+value type), not just different protocol parameters.
+
+Reference: the ShelleyMA eras (`Shelley/Eras.hs:82-97` StandardAllegra /
+StandardMary) and their `CanHardFork` translations
+(`Cardano/CanHardFork.hs:273`+ — Shelley→Allegra→Mary carry state while
+the value type widens Coin → MaryValue); rule deltas re-derived from
+cardano-ledger's ShelleyMA UTXO rule (validity interval replaces TTL,
+`consumed + mint == produced` per asset, minting policy witnesses).
+
+Wire format (era-tagged; decode_tx of shelley.py CANNOT parse it):
+  tx       = [inputs, outputs, fee, [start|null, end|null],
+              certs, withdrawals, mint]
+  output   = [addr, coin]                     -- ada-only, or
+             [addr, [coin, assets]]           -- multi-asset
+  assets   = [[policy_id/28, [[name, qty]...]]...]
+  mint     = [[policy_vk/32, sig/64, [[name, qty]...]]...]
+             -- policy id = blake2b-224(policy_vk); sig over the
+                witness-free body hash (mint_sig_data); qty may be
+                negative (burn)
+  certs / withdrawals / addr exactly as Shelley (shelley.py docstring)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Mapping
+
+from ..ops.host import ed25519 as host_ed25519
+from ..ops.host.hashes import blake2b_224, blake2b_256
+from ..utils import cbor
+from .shelley import (
+    BadInputs,
+    ExpiredTx,
+    FeeTooSmall,
+    MaxTxSizeExceeded,
+    ShelleyLedger,
+    ShelleyState,
+    ShelleyTxError,
+    TxView,
+    ValueNotConserved,
+    tx_id,
+)
+
+
+class OutsideValidityInterval(ShelleyTxError):
+    def __init__(self, start, end, slot):
+        super().__init__(f"slot {slot} outside validity [{start}, {end}]")
+        self.start, self.end, self.slot = start, end, slot
+
+
+class MintError(ShelleyTxError):
+    pass
+
+
+class MaryValue(int):
+    """ADA coin (the int value) + native assets. Subclassing int keeps
+    every Shelley accounting path (stake sums, pot conservation) correct
+    on the ADA component with no changes; the Mary rules alone read
+    `.assets` (canonical sorted tuple of ((policy_id, name), qty))."""
+
+    def __new__(cls, coin: int, assets=()) -> "MaryValue":
+        self = super().__new__(cls, coin)
+        object.__setattr__(
+            self, "assets",
+            tuple(sorted((k, int(q)) for k, q in dict(assets).items() if q)),
+        )
+        return self
+
+    def __setattr__(self, k, v):  # immutable after construction
+        raise AttributeError("MaryValue is immutable")
+
+    def asset_map(self) -> dict:
+        return dict(self.assets)
+
+    def __repr__(self):
+        return f"MaryValue({int(self)}, {dict(self.assets)})"
+
+
+def _decode_value(wire) -> MaryValue:
+    if isinstance(wire, int):
+        return MaryValue(wire)
+    coin, assets = wire
+    amap: dict[tuple[bytes, bytes], int] = {}
+    for pid, pairs in assets:
+        for name, qty in pairs:
+            if int(qty) < 0:
+                raise ShelleyTxError("negative asset quantity in output")
+            amap[(bytes(pid), bytes(name))] = (
+                amap.get((bytes(pid), bytes(name)), 0) + int(qty)
+            )
+    return MaryValue(int(coin), amap)
+
+
+def _encode_value(v) -> object:
+    if not isinstance(v, MaryValue) or not v.assets:
+        return int(v)
+    by_pid: dict[bytes, list] = {}
+    for (pid, name), qty in v.assets:
+        by_pid.setdefault(pid, []).append([name, qty])
+    return [int(v), [[pid, pairs] for pid, pairs in sorted(by_pid.items())]]
+
+
+def encode_tx(ins, outs, fee=0, validity=(None, None), certs=(),
+              withdrawals=(), mint=()) -> bytes:
+    """outs: [(payment, stake|None, value)] where value is an int or a
+    MaryValue; mint: [(policy_vk, sig, {name: qty})]."""
+    return cbor.encode([
+        [list(i) for i in ins],
+        [[[p, s], _encode_value(v)] for p, s, v in outs],
+        fee,
+        [validity[0], validity[1]],
+        [list(c) for c in certs],
+        [list(w) for w in withdrawals],
+        [[vk, sg, [[n, q] for n, q in sorted(dict(am).items())]]
+         for vk, sg, am in mint],
+    ])
+
+
+def mint_sig_data(ins, outs_wire, fee, validity) -> bytes:
+    """What a minting policy key signs: the hash of the value-moving
+    body (inputs, outputs, fee, validity) — binding the mint to THIS tx."""
+    return blake2b_256(cbor.encode([
+        [list(i) for i in ins], outs_wire, fee,
+        [validity[0], validity[1]],
+    ]))
+
+
+def make_mint_witness(policy_seed: bytes, ins, outs, fee, validity,
+                      assets: Mapping[bytes, int]):
+    """Sign-side helper: (policy_vk, sig, {name: qty}) for encode_tx's
+    mint argument; outs as encode_tx takes them."""
+    outs_wire = [[[p, s], _encode_value(v)] for p, s, v in outs]
+    sd = mint_sig_data(ins, outs_wire, fee, validity)
+    vk = host_ed25519.secret_to_public(policy_seed)
+    return (vk, host_ed25519.sign(policy_seed, sd), dict(assets))
+
+
+def policy_id(policy_vk: bytes) -> bytes:
+    return blake2b_224(policy_vk)
+
+
+@dataclass(frozen=True)
+class MaryTx:
+    ins: tuple[tuple[bytes, int], ...]
+    outs: tuple[tuple[tuple[bytes, bytes | None], MaryValue], ...]
+    fee: int
+    start: int | None
+    end: int | None
+    certs: tuple[tuple, ...]
+    withdrawals: tuple[tuple[bytes, int], ...]
+    mint: tuple[tuple[bytes, bytes, tuple], ...]  # (vk, sig, ((name, qty)..))
+    outs_wire: tuple  # as decoded, for mint_sig_data recomputation
+    size: int
+
+
+def decode_tx(tx_bytes: bytes) -> MaryTx:
+    try:
+        ins, outs, fee, validity, certs, wdrls, mint = cbor.decode(tx_bytes)
+        start, end = validity
+        return MaryTx(
+            ins=tuple((bytes(i[0]), int(i[1])) for i in ins),
+            outs=tuple(
+                ((bytes(a[0]), None if a[1] is None else bytes(a[1])),
+                 _decode_value(v))
+                for a, v in outs
+            ),
+            fee=int(fee),
+            start=None if start is None else int(start),
+            end=None if end is None else int(end),
+            certs=tuple(tuple(c) for c in certs),
+            withdrawals=tuple((bytes(w[0]), int(w[1])) for w in wdrls),
+            mint=tuple(
+                (bytes(vk), bytes(sg),
+                 tuple((bytes(n), int(q)) for n, q in pairs))
+                for vk, sg, pairs in mint
+            ),
+            outs_wire=outs,
+            size=len(tx_bytes),
+        )
+    except ShelleyTxError:
+        raise
+    except Exception as e:
+        raise ShelleyTxError(f"malformed mary tx: {e!r}") from e
+
+
+def translate_tx_from_shelley(tx_bytes: bytes) -> bytes:
+    """InjectTxs translation Shelley→Mary (Cardano/CanHardFork.hs tx
+    injection): ttl becomes [null, ttl], mint is empty; certs and
+    withdrawals carry verbatim."""
+    ins, outs, fee, ttl, certs, wdrls = cbor.decode(tx_bytes)
+    return cbor.encode([ins, outs, fee, [None, ttl], certs, wdrls, []])
+
+
+class MaryLedger(ShelleyLedger):
+    """ShelleyLedger with the ShelleyMA rule deltas. Certificates,
+    epoch boundaries, snapshots, rewards, pool reap and PPUP adoption
+    are INHERITED — the Mary era changes the value/tx layer only, like
+    the reference's ShelleyMA eras sharing the Shelley rule family."""
+
+    # -- era translation INTO Mary ----------------------------------------
+
+    def translate_from_shelley(self, prev: ShelleyState) -> ShelleyState:
+        """Shelley→Mary state translation: identical fields; every UTxO
+        value widens Coin → MaryValue (ada-only). Snapshots/pots carry
+        verbatim (CanHardFork.hs:273 Shelley-family steps)."""
+        return replace(
+            prev,
+            utxo={
+                k: (addr, MaryValue(int(c)))
+                for k, (addr, c) in prev.utxo.items()
+            },
+        )
+
+    # -- the Mary UTXOW/UTXO rules ----------------------------------------
+
+    def apply_tx(self, view: TxView, tx_bytes: bytes) -> TxView:
+        tx = decode_tx(tx_bytes)
+        pp = view.pparams
+        if not tx.ins:
+            raise ShelleyTxError("empty input set")
+        if len(set(tx.ins)) != len(tx.ins):
+            raise BadInputs(tx.ins[0])
+        # Allegra validity interval (replaces Shelley's TTL): the slot
+        # must lie in [start, end]
+        if tx.start is not None and view.slot < tx.start:
+            raise OutsideValidityInterval(tx.start, tx.end, view.slot)
+        if tx.end is not None and view.slot > tx.end:
+            raise ExpiredTx(tx.end, view.slot)
+        if tx.size > pp.max_tx_size:
+            raise MaxTxSizeExceeded(tx.size, pp.max_tx_size)
+        min_fee = pp.min_fee_a * tx.size + pp.min_fee_b
+        if tx.fee < min_fee:
+            raise FeeTooSmall(tx.fee, min_fee)
+        if any(int(v) < 0 for _a, v in tx.outs):
+            raise ShelleyTxError("negative output")
+
+        consumed = 0
+        consumed_assets: dict[tuple[bytes, bytes], int] = {}
+        for txin in tx.ins:
+            if txin not in view.utxo:
+                raise BadInputs(txin)
+            val = view.utxo[txin][1]
+            consumed += int(val)
+            if isinstance(val, MaryValue):
+                for k, q in val.assets:
+                    consumed_assets[k] = consumed_assets.get(k, 0) + q
+
+        # FORGE (mint) rule: every group witnessed by its policy key
+        minted: dict[tuple[bytes, bytes], int] = {}
+        if tx.mint:
+            sd = mint_sig_data(
+                [list(i) for i in tx.ins], tx.outs_wire, tx.fee,
+                (tx.start, tx.end),
+            )
+            for vk, sig, pairs in tx.mint:
+                if not host_ed25519.verify(vk, sd, sig):
+                    raise MintError(
+                        f"bad minting-policy signature for "
+                        f"{policy_id(vk).hex()[:8]}"
+                    )
+                pid = policy_id(vk)
+                for name, qty in pairs:
+                    if qty == 0:
+                        continue
+                    minted[(pid, name)] = minted.get((pid, name), 0) + qty
+
+        # scratch for certs/withdrawals — Shelley's machinery verbatim
+        scratch = TxView(
+            utxo=view.utxo,
+            stake_creds=dict(view.stake_creds),
+            rewards=dict(view.rewards),
+            delegations=dict(view.delegations),
+            pools=dict(view.pools),
+            pool_deposits=dict(view.pool_deposits),
+            retiring=dict(view.retiring),
+            proposals=dict(view.proposals),
+            pparams=view.pparams, epoch=view.epoch, slot=view.slot,
+        )
+        withdrawn = 0
+        seen = set()
+        for cred, amt in tx.withdrawals:
+            if cred in seen:
+                raise ShelleyTxError("duplicate withdrawal")
+            seen.add(cred)
+            if cred not in scratch.rewards:
+                raise ShelleyTxError(f"unregistered: {cred.hex()[:8]}")
+            if scratch.rewards[cred] != amt:
+                raise ShelleyTxError(
+                    f"must withdraw full balance {scratch.rewards[cred]}"
+                )
+            scratch.rewards[cred] = 0
+            withdrawn += amt
+        deposits_taken = refunds = 0
+        for cert in tx.certs:
+            try:
+                dep, ref = self._apply_cert(scratch, cert)
+            except ShelleyTxError:
+                raise
+            except Exception as e:
+                raise ShelleyTxError(f"malformed certificate: {e!r}") from e
+            deposits_taken += dep
+            refunds += ref
+
+        # ADA conservation (the Shelley equation, mint moves no ada)
+        produced_out = sum(int(v) for _a, v in tx.outs)
+        if (consumed + withdrawn + refunds
+                != produced_out + tx.fee + deposits_taken):
+            raise ValueNotConserved(
+                consumed + withdrawn + refunds,
+                produced_out + tx.fee + deposits_taken,
+            )
+        # per-asset conservation: consumed + minted == produced
+        produced_assets: dict[tuple[bytes, bytes], int] = {}
+        for _a, v in tx.outs:
+            if isinstance(v, MaryValue):
+                for k, q in v.assets:
+                    produced_assets[k] = produced_assets.get(k, 0) + q
+        lhs: dict[tuple[bytes, bytes], int] = dict(consumed_assets)
+        for k, q in minted.items():
+            lhs[k] = lhs.get(k, 0) + q
+        lhs = {k: q for k, q in lhs.items() if q}
+        if lhs != produced_assets:
+            raise ValueNotConserved(
+                sum(consumed_assets.values()) + sum(minted.values()),
+                sum(produced_assets.values()),
+            )
+
+        # commit
+        tid = tx_id(tx_bytes)
+        for txin in tx.ins:
+            del view.utxo[txin]
+        for ix, (addr, val) in enumerate(tx.outs):
+            view.utxo[(tid, ix)] = (addr, val)
+        view.stake_creds = scratch.stake_creds
+        view.rewards = scratch.rewards
+        view.delegations = scratch.delegations
+        view.pools = scratch.pools
+        view.pool_deposits = scratch.pool_deposits
+        view.retiring = scratch.retiring
+        view.proposals = scratch.proposals
+        view.deposit_delta += deposits_taken - refunds
+        view.fee_delta += tx.fee
+        return view

@@ -115,14 +115,17 @@ class Candidate:
 
 
 def server(
-    chain_db, rx, tx, *, poll_interval: float = 0.05,
+    chain_db, rx, tx, *, poll_interval: float | None = None,
     include_tentative: bool = True, follower=None,
 ):
     """ChainSync server task (Server.hs): answer find_intersect from the
     current chain, then stream follower updates as roll_forward /
-    roll_backward. Blocks on the follower's event (the reference blocks
-    in STM on the follower's next instruction) — the Sleep poll is only
-    the fallback when the ChainDB has no runtime to fire events through.
+    roll_backward. The MustReply wait BLOCKS on the follower's event
+    (the reference blocks in STM on the follower's next instruction,
+    MiniProtocol/ChainSync/Server.hs) — the serving ChainDB must have a
+    runtime attached to fire it. `poll_interval` is an explicit opt-in
+    for STATIC chain views whose followers have no event to fire
+    (immdb-server's ImmutableChainView), never a silent fallback.
 
     `include_tentative` serves diffusion pipelining: headers of blocks
     still being validated stream out early (Impl/Follower.hs tentative
@@ -217,10 +220,10 @@ def _server_loop(chain_db, rx, tx, follower, pending, tip, decode, poll_interval
                 pending.extend(follower.take_updates())
                 if pending:
                     break
-                if chain_db.runtime is not None:
-                    yield Wait(follower.event)  # blockUntilChanged analog
+                if poll_interval is not None:
+                    yield Sleep(poll_interval)  # static-view opt-in only
                 else:
-                    yield Sleep(poll_interval)  # MustReply/await fallback
+                    yield Wait(follower.event)  # blockUntilChanged analog
             op = pending.pop(0)
             if op[0] == "rollback":
                 yield Send(tx, ("roll_backward", op[1], tip()))

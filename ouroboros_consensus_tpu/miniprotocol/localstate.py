@@ -48,6 +48,11 @@ _SHELLEY_QUERIES = frozenset({
     "get_proposed_pparams_updates", "get_rewards",
     "get_delegations_and_rewards", "get_utxo_by_address",
     "get_account_state",
+    # round-4 breadth (shelley Ledger/Query.hs parity): genesis config,
+    # pool lifecycle state, the three stake snapshots, the reward
+    # calculation's inputs, and the full-state debug dump
+    "get_genesis_config", "get_pool_state", "get_stake_snapshots",
+    "get_reward_provenance", "debug_new_epoch_state",
 })
 
 QUERY_MIN_VERSION = {
@@ -94,6 +99,8 @@ _QUERY_ARGSPEC = {
     "get_rewards": "collection",
     "get_delegations_and_rewards": "collection",
     "get_utxo_by_address": "collection",
+    "get_pool_state": "collection",
+    "get_stake_snapshots": "collection",
 }
 
 
@@ -158,6 +165,56 @@ def _run_shelley_query(st, name: str, args):
     if name == "get_account_state":
         # GetAccountState: the treasury and reserves pots
         return {"treasury": st.treasury, "reserves": st.reserves}
+    if name == "get_pool_state":
+        # GetPoolState: registered params + pending retirements +
+        # the deposits actually held, for the requested pools
+        (pids,) = args
+        want = set(pids)
+        return {
+            "pools": {p: st.pools[p] for p in want if p in st.pools},
+            "retiring": {
+                p: st.retiring[p] for p in want if p in st.retiring
+            },
+            "deposits": {
+                p: st.pool_deposits[p]
+                for p in want if p in st.pool_deposits
+            },
+        }
+    if name == "get_stake_snapshots":
+        # GetStakeSnapshots: per-pool stake in each of mark/set/go plus
+        # the snapshot totals (the cardano-cli "stake-snapshot" shape)
+        (pids,) = args
+        want = set(pids)
+        out = {}
+        for label, snap in (("mark", st.mark), ("set", st.set_),
+                            ("go", st.go)):
+            per = snap.pool_stake()
+            out[label] = {
+                "pools": {p: per.get(p, 0) for p in want},
+                "total": sum(snap.stake.values()),
+            }
+        return out
+    if name == "get_reward_provenance":
+        # GetRewardProvenance (simplified to our RUPD inputs): what the
+        # NEXT reward update will be computed from
+        return {
+            "epoch": st.epoch,
+            "pots": {
+                "treasury": st.treasury, "reserves": st.reserves,
+                "fees": st.fees, "prev_fees": st.prev_fees,
+                "deposits": st.deposits,
+            },
+            "blocks_prev": dict(st.blocks_prev),
+            "blocks_current": dict(st.blocks_current),
+            "total_go_stake": sum(st.go.stake.values()),
+        }
+    if name == "debug_new_epoch_state":
+        # DebugNewEpochState: the whole ledger state — deep-copied (the
+        # reference serializes it for offline inspection; handing out
+        # the node's LIVE mutable dicts would let a client corrupt it)
+        import copy
+
+        return copy.deepcopy(st)
     raise QueryError(f"unknown Shelley query {name!r}")
 
 
@@ -193,9 +250,27 @@ def run_query(node, ext_state, name: str, args, version: int = LATEST_QUERY_VERS
         return total
     if name == "get_pool_distr":
         return node.ledger_view_at(hs.tip.slot if hs.tip else 0).pool_distr
+    if name == "get_genesis_config":
+        # GetGenesisConfig: the static Shelley genesis the LEDGER was
+        # configured with (not part of the state) — era-checked like
+        # every Shelley query
+        _shelley_state(ledger_state)
+        return _shelley_genesis_of(node.ledger)
     if name in _SHELLEY_QUERIES:
         return _run_shelley_query(_shelley_state(ledger_state), name, args)
     raise QueryError(f"unknown query {name!r}")
+
+
+def _shelley_genesis_of(ledger):
+    """Find the ShelleyGenesis behind a (possibly HFC-composed) ledger."""
+    from ..ledger.shelley import ShelleyGenesis, ShelleyLedger
+
+    if isinstance(ledger, ShelleyLedger):
+        return ledger.genesis
+    for era in getattr(ledger, "eras", ()):
+        if isinstance(era.ledger, ShelleyLedger):
+            return era.ledger.genesis
+    raise QueryError("no Shelley ledger behind this node")
 
 
 def state_query_server(node, rx, tx, version: int = LATEST_QUERY_VERSION):

@@ -1,0 +1,296 @@
+"""TCP transport for the node-to-node bundle: framed CBOR over asyncio
+sockets, multiplexed mini-protocol channels, full versioned wiring.
+
+Reference: the reference hands its mini-protocol `Apps` to
+`ouroboros-network`'s diffusion — session-typed protocols, CBOR codecs,
+multiplexed over ONE TCP bearer per peer (`Node.hs:103-120`,
+`Network/NodeToNode.hs:434-466`). This module is that layer for the TPU
+framework: one socket per peer, each mini-protocol on its own mux
+channel (`[channel_id, payload]` frames), the wire handshake FIRST, then
+exactly the version-gated app set — the same `Apps` assembly as the
+in-memory `node/apps.py`, interpreted by `utils/aio.AsyncRuntime`
+instead of the deterministic Sim (the IOLike seam).
+
+The framing (4-byte length prefix + deterministic CBOR) is shared with
+`tools/immdb_server.py`, which predates this module and now imports it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from ..block.abstract import Point
+from ..miniprotocol import blockfetch, chainsync, handshake, txsubmission
+from ..miniprotocol.chainsync import Candidate
+from ..miniprotocol.rethrow import peer_guard
+from ..utils import cbor
+from ..utils.aio import AsyncRuntime
+from ..utils.sim import Channel
+
+# -- wire encoding (shared with immdb_server) --------------------------------
+
+
+def to_wire(obj) -> Any:
+    """Points/VersionData/dicts/tuples -> CBOR-encodable structures."""
+    if obj is None:
+        return None
+    if isinstance(obj, Point):
+        return ["pt", obj.slot, obj.hash_]
+    if isinstance(obj, handshake.VersionData):
+        return ["vd", obj.network_magic]
+    if isinstance(obj, dict):
+        return ["map", [[to_wire(k), to_wire(v)] for k, v in obj.items()]]
+    if isinstance(obj, (list, tuple)):
+        return [to_wire(x) for x in obj]
+    return obj
+
+
+def from_wire(obj) -> Any:
+    if isinstance(obj, list):
+        if len(obj) == 3 and obj[0] == "pt":
+            return Point(obj[1], obj[2])
+        if len(obj) == 2 and obj[0] == "vd":
+            return handshake.VersionData(network_magic=obj[1])
+        if len(obj) == 2 and obj[0] == "map" and isinstance(obj[1], list):
+            return {from_wire(k): from_wire(v) for k, v in obj[1]}
+        return tuple(from_wire(x) for x in obj)
+    return obj
+
+
+def frame(msg) -> bytes:
+    data = cbor.encode(to_wire(msg))
+    return len(data).to_bytes(4, "big") + data
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    hdr = await reader.readexactly(4)
+    n = int.from_bytes(hdr, "big")
+    return from_wire(cbor.decode(await reader.readexactly(n)))
+
+
+# -- mux ---------------------------------------------------------------------
+
+
+class RemoteChannel(Channel):
+    """A Channel whose Send effect goes straight to the socket (the
+    AsyncRuntime checks for `remote_send`)."""
+
+    def __init__(self, mux: "Mux", chan_id: str):
+        super().__init__(name=chan_id)
+        self._mux = mux
+        self.chan_id = chan_id
+
+    def remote_send(self, msg) -> None:
+        self._mux.send(self.chan_id, msg)
+
+
+class Mux:
+    """One TCP bearer, many mini-protocol channels (the `mux` analog):
+    outbound messages are `[chan_id, payload]` frames; the rx pump
+    routes inbound frames to registered local channels."""
+
+    def __init__(self, reader, writer, runtime: AsyncRuntime):
+        self.reader = reader
+        self.writer = writer
+        self.runtime = runtime
+        self._inbound: dict[str, Channel] = {}
+        self.closed = asyncio.Event()
+
+    def outbound(self, chan_id: str) -> RemoteChannel:
+        return RemoteChannel(self, chan_id)
+
+    def inbound(self, chan_id: str) -> Channel:
+        ch = Channel(name=chan_id)
+        self._inbound[chan_id] = ch
+        return ch
+
+    def send(self, chan_id: str, msg) -> None:
+        self.writer.write(frame([chan_id, msg]))
+
+    async def pump(self) -> None:
+        """Route inbound frames until the peer hangs up."""
+        try:
+            while True:
+                chan_id, payload = await read_frame(self.reader)
+                ch = self._inbound.get(chan_id)
+                if ch is not None:
+                    self.runtime.deliver(ch, payload)
+                # unknown channel: the peer speaks a protocol this side
+                # did not negotiate — drop the frame (mux discards, the
+                # version gate already agreed what runs)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            self.closed.set()
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
+    def channel_pair(self, proto: str, *, initiator: bool):
+        """(rx, tx) for this side of `proto`: the initiator transmits on
+        `proto:req` and receives on `proto:rsp`; the responder mirrors."""
+        if initiator:
+            return self.inbound(f"{proto}:rsp"), self.outbound(f"{proto}:req")
+        return self.inbound(f"{proto}:req"), self.outbound(f"{proto}:rsp")
+
+
+# -- the versioned bundle over a mux ----------------------------------------
+
+
+def _spawn_bundle(
+    runtime: AsyncRuntime,
+    mux: Mux,
+    node,
+    peer_name: str,
+    version: int,
+    *,
+    initiator: bool,
+    trace=lambda s: None,
+) -> list:
+    """Spawn THIS side's half of the version-gated app set — the same
+    protocol gating as node/apps.py node_to_node_apps, but each side
+    builds only its own tasks, channels bound to the mux."""
+    enabled = handshake.NODE_TO_NODE_VERSIONS[version]
+    tasks = []
+
+    def disconnect():
+        for t in tasks:
+            t.cancel()
+        node.candidates.pop(peer_name, None)
+
+    def spawn(name, gen):
+        label = f"{name}:{peer_name}"
+        tasks.append(
+            runtime.spawn(peer_guard(gen, label, trace, disconnect), label)
+        )
+
+    if initiator:
+        cand = Candidate()
+        node.candidates[peer_name] = cand
+        if "chainsync" in enabled:
+            rx, tx = mux.channel_pair("chainsync", initiator=True)
+            spawn("chainsync:client",
+                  chainsync.client(node, peer_name, rx, tx, cand))
+        if "blockfetch" in enabled:
+            rx, tx = mux.channel_pair("blockfetch", initiator=True)
+            spawn("blockfetch:client",
+                  blockfetch.client(node, peer_name, rx, tx, cand))
+        if "txsubmission2" in enabled:
+            rx, tx = mux.channel_pair("txsubmission", initiator=True)
+            spawn("txsubmission:inbound",
+                  txsubmission.inbound(node, peer_name, rx, tx))
+        if "keepalive" in enabled:
+            rx, tx = mux.channel_pair("keepalive", initiator=True)
+            spawn("keepalive:client", txsubmission.keepalive_client(rx, tx))
+        if "peersharing" in enabled:
+            rx, tx = mux.channel_pair("peersharing", initiator=True)
+            spawn("peersharing:client",
+                  txsubmission.peersharing_client(rx, tx, 4))
+    else:
+        if "chainsync" in enabled:
+            rx, tx = mux.channel_pair("chainsync", initiator=False)
+            spawn("chainsync:server",
+                  chainsync.server(node.chain_db, rx, tx))
+        if "blockfetch" in enabled:
+            rx, tx = mux.channel_pair("blockfetch", initiator=False)
+            spawn("blockfetch:server",
+                  blockfetch.server(node.chain_db, rx, tx))
+        if "txsubmission2" in enabled:
+            rx, tx = mux.channel_pair("txsubmission", initiator=False)
+            spawn("txsubmission:outbound",
+                  txsubmission.outbound(node, rx, tx))
+        if "keepalive" in enabled:
+            rx, tx = mux.channel_pair("keepalive", initiator=False)
+            spawn("keepalive:server",
+                  txsubmission.keepalive_server(rx, tx))
+        if "peersharing" in enabled:
+            rx, tx = mux.channel_pair("peersharing", initiator=False)
+            spawn("peersharing:server",
+                  txsubmission.peersharing_server(node, rx, tx))
+    return tasks
+
+
+async def serve_node(
+    node,
+    runtime: AsyncRuntime,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    *,
+    versions: dict[int, handshake.VersionData] | None = None,
+    trace=lambda s: None,
+):
+    """Listen for peers; per connection: wire handshake (responder),
+    then the responder half of the bundle. Returns the asyncio server
+    (its .sockets[0].getsockname()[1] is the bound port)."""
+    ours = versions if versions is not None else {
+        v: handshake.VersionData(network_magic=764824073)
+        for v in handshake.NODE_TO_NODE_VERSIONS
+    }
+
+    async def handle(reader, writer):
+        peer = writer.get_extra_info("peername")
+        mux = Mux(reader, writer, runtime)
+        hs_rx = mux.inbound("handshake:req")
+        hs_tx = mux.outbound("handshake:rsp")
+        pump = asyncio.ensure_future(mux.pump())
+        hs_task = runtime.spawn(
+            handshake.server(hs_rx, hs_tx, ours), f"handshake:{peer}"
+        )
+        tasks: list = []
+        try:
+            version, _data = await hs_task
+            trace(f"{node.name}: peer {peer} negotiated v{version}")
+            tasks = _spawn_bundle(
+                runtime, mux, node, f"tcp:{peer}", version,
+                initiator=False, trace=trace,
+            )
+            await mux.closed.wait()
+        except handshake.HandshakeRefused as e:
+            trace(f"{node.name}: refused {peer}: {e}")
+        finally:
+            for t in tasks:
+                t.cancel()
+            pump.cancel()
+
+    return await asyncio.start_server(handle, host, port)
+
+
+async def connect_node(
+    node,
+    runtime: AsyncRuntime,
+    host: str,
+    port: int,
+    *,
+    versions: dict[int, handshake.VersionData] | None = None,
+    trace=lambda s: None,
+) -> Mux:
+    """Dial a peer: wire handshake (initiator), then the initiator half
+    of the bundle (ChainSync/BlockFetch/... clients feeding this node's
+    ChainDB). Returns the live Mux; closing it tears the bundle down."""
+    ours = versions if versions is not None else {
+        v: handshake.VersionData(network_magic=764824073)
+        for v in handshake.NODE_TO_NODE_VERSIONS
+    }
+    reader, writer = await asyncio.open_connection(host, port)
+    mux = Mux(reader, writer, runtime)
+    hs_rx = mux.inbound("handshake:rsp")
+    hs_tx = mux.outbound("handshake:req")
+    pump = asyncio.ensure_future(mux.pump())
+    try:
+        version, _data = await runtime.spawn(
+            handshake.client(hs_rx, hs_tx, ours), "handshake:client"
+        )
+    except BaseException:
+        pump.cancel()
+        writer.close()
+        raise
+    trace(f"{node.name}: connected to {host}:{port} at v{version}")
+    tasks = _spawn_bundle(
+        runtime, mux, node, f"tcp:{host}:{port}", version,
+        initiator=True, trace=trace,
+    )
+    mux.tasks = tasks  # for teardown by the caller
+    mux.pump_task = pump
+    return mux

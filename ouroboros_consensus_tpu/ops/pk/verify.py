@@ -122,15 +122,20 @@ def hash_to_curve(pk_bytes, alpha_bytes):
     return pc.mul_cofactor(elligator2(r))
 
 
-def vrf_core(pk, gamma, c, s, alpha):
-    """(ok_pre[T], (H, Γ, U, V, 8Γ)) — points left uncompressed for the
-    shared inversion in finish_core. c: [16, T]; others [32, T]."""
+def vrf_core_prep(pk, gamma, c, s, alpha):
+    """Stage A of the VRF check: decode/validate + hash-to-curve (field
+    ops and SHA-512 only, no ladders). Split from the ladders so the
+    Pallas kernel compiles as two small Mosaic modules instead of one
+    31.8 MB / 185k-op monolith (round-3 compile-time attribution)."""
     ok_y, y_pt = pc.decompress(pk)
     ok_g, g_pt = pc.decompress(gamma)
     s_ok = fe.is_canonical_scalar(s)
-
     h_pt = hash_to_curve(pk, alpha)
+    return ok_y & ok_g & s_ok, h_pt, y_pt, g_pt
 
+
+def vrf_core_ladders(c, s, h_pt, y_pt, g_pt):
+    """Stage B: the three scalar ladders (U = sB - cY, V = sH - cΓ, 8Γ)."""
     s_digits = fe.windows4_from_bytes(s, 256, msb_first=True)
     c_digits = fe.windows4_from_bytes(c, 128, msb_first=True)
 
@@ -138,7 +143,14 @@ def vrf_core(pk, gamma, c, s, alpha):
     u_pt = pc.add(sb, pc.scalar_mul_w4(c_digits, pc.neg(y_pt)))
     v_pt = pc.double_scalar_mul_w4(s_digits, h_pt, c_digits, pc.neg(g_pt))
     g8 = pc.mul_cofactor(g_pt)
-    return ok_y & ok_g & s_ok, (h_pt, g_pt, u_pt, v_pt, g8)
+    return h_pt, g_pt, u_pt, v_pt, g8
+
+
+def vrf_core(pk, gamma, c, s, alpha):
+    """(ok_pre[T], (H, Γ, U, V, 8Γ)) — points left uncompressed for the
+    shared inversion in finish_core. c: [16, T]; others [32, T]."""
+    ok_pre, h_pt, y_pt, g_pt = vrf_core_prep(pk, gamma, c, s, alpha)
+    return ok_pre, vrf_core_ladders(c, s, h_pt, y_pt, g_pt)
 
 
 # ---------------------------------------------------------------------------

@@ -357,6 +357,7 @@ def pk_arrays(batch: PraosBatch) -> list[np.ndarray]:
 
 def _jitted_pk(kes_depth: int):
     import functools
+    import os
 
     import jax
 
@@ -364,11 +365,21 @@ def _jitted_pk(kes_depth: int):
     if key not in _JIT:
         from ..ops.pk import kernels as pk_kernels
 
-        _JIT[key] = jax.jit(
-            functools.partial(
-                pk_kernels.verify_praos_staged, kes_depth=kes_depth
+        if os.environ.get("OCT_PK_FUSED"):
+            # the original single-jit composition (one cache entry for
+            # the whole program) — opt-in for A/B measurement
+            _JIT[key] = jax.jit(
+                functools.partial(
+                    pk_kernels.verify_praos_staged, kes_depth=kes_depth
+                )
             )
-        )
+        else:
+            # default: per-stage jits (kernels.verify_praos_split) — a
+            # wedged compile costs one stage and the persistent cache
+            # accumulates stage entries across retries (VERDICT r3 #2)
+            _JIT[key] = functools.partial(
+                pk_kernels.verify_praos_split, kes_depth=kes_depth
+            )
     return _JIT[key]
 
 

@@ -18,8 +18,6 @@ import os
 from ..block.abstract import Point
 from ..block.praos_block import Block
 from ..storage.immutable import ImmutableDB
-from ..utils import cbor
-from ..utils.sim import Recv, Send
 
 _NETWORK_MAGIC = 764824073  # mainnet magic: the DbMarker/handshake guard
 
@@ -71,38 +69,11 @@ def serve_sim(view: ImmutableChainView, cs_rx, cs_tx, bf_rx, bf_tx):
 
 
 # -- asyncio TCP transport ---------------------------------------------------
+# Framing shared with the full node-to-node transport (node/transport.py
+# owns it now; this tool predates it and keeps its local aliases).
 
-
-def _frame(msg) -> bytes:
-    data = cbor.encode(_to_wire(msg))
-    return len(data).to_bytes(4, "big") + data
-
-
-def _to_wire(obj):
-    """Points/None/bytes/ints/tuples -> CBOR-encodable."""
-    if obj is None:
-        return None
-    if isinstance(obj, Point):
-        return ["pt", obj.slot, obj.hash_]
-    if isinstance(obj, (list, tuple)):
-        return [_to_wire(x) for x in obj]
-    return obj
-
-
-def _from_wire(obj):
-    if isinstance(obj, list):
-        if len(obj) == 3 and obj[0] == "pt":
-            return Point(obj[1], obj[2])
-        return tuple(_from_wire(x) for x in obj)
-    return obj
-
-
-async def _read_frame(reader):
-    import asyncio
-
-    hdr = await reader.readexactly(4)
-    n = int.from_bytes(hdr, "big")
-    return _from_wire(cbor.decode(await reader.readexactly(n)))
+from ..node.transport import frame as _frame  # noqa: E402
+from ..node.transport import read_frame as _read_frame  # noqa: E402
 
 
 async def serve_tcp(db_path: str, host: str = "127.0.0.1", port: int = 3001,

@@ -1,0 +1,122 @@
+"""AsyncRuntime: the asyncio interpreter of the sim effect language —
+the IO side of the IOLike seam.
+
+Reference: `Util/IOLike.hs` — the reference writes every component
+against `IOLike m` so the SAME code runs under io-sim (deterministic
+tests) or IO (the real node). Here the mini-protocols, forging loop and
+ChainDB runners are generators yielding Sleep/Recv/Send/Wait/Fire/Spawn
+effects (utils/sim.py); this module interprets those SAME generators on
+asyncio with real time and real sockets — nothing in the protocol code
+changes between a ThreadNet run and a TCP deployment, which is the whole
+point of the seam (SURVEY §1 layer 1).
+
+The runtime also satisfies the two attributes synchronous node code
+reads: `.fire(event)` (ChainDB notifying followers/add-block runners)
+and `.now` (monotonic seconds since runtime start, the wallclock analog
+of the Sim's virtual time).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Generator
+
+from .sim import Channel, Event, Fire, Recv, Send, Sleep, Spawn, Stop, Wait
+
+
+class AsyncRuntime:
+    """Drives sim-effect generators on an asyncio event loop."""
+
+    def __init__(self):
+        self.t0 = time.monotonic()
+        self._chan_q: dict[int, asyncio.Queue] = {}  # id(Channel) -> queue
+        self._ev: dict[int, asyncio.Event] = {}  # id(Event) -> generation
+        self.tasks: list[asyncio.Task] = []
+
+    @property
+    def now(self) -> float:
+        return time.monotonic() - self.t0
+
+    # -- channels ----------------------------------------------------------
+
+    def _q(self, chan: Channel) -> asyncio.Queue:
+        q = self._chan_q.get(id(chan))
+        if q is None:
+            q = self._chan_q[id(chan)] = asyncio.Queue()
+        return q
+
+    def deliver(self, chan: Channel, msg: Any) -> None:
+        """Push an inbound message (the transport's rx pump calls this)."""
+        self._q(chan).put_nowait(msg)
+
+    def send(self, chan: Channel, msg: Any) -> None:
+        remote = getattr(chan, "remote_send", None)
+        if remote is not None:
+            remote(msg)  # a transport-bound channel: straight to the wire
+        elif chan.delay:
+            asyncio.get_running_loop().call_later(
+                chan.delay, self._q(chan).put_nowait, msg
+            )
+        else:
+            self._q(chan).put_nowait(msg)
+
+    # -- events ------------------------------------------------------------
+
+    def fire(self, event: Event) -> None:
+        """Wake ALL current waiters (broadcast): the per-generation
+        asyncio.Event is set and retired; later waiters get a fresh one.
+        Callable from synchronous code inside a task step — the
+        STM-TVar-write analog, same contract as Sim.fire."""
+        ev = self._ev.pop(id(event), None)
+        if ev is not None:
+            ev.set()
+
+    def _wait_event(self, event: Event) -> asyncio.Event:
+        ev = self._ev.get(id(event))
+        if ev is None:
+            ev = self._ev[id(event)] = asyncio.Event()
+        return ev
+
+    # -- task driving ------------------------------------------------------
+
+    def spawn(self, gen: Generator, name: str = "task") -> asyncio.Task:
+        t = asyncio.get_running_loop().create_task(
+            self._drive(gen, name), name=name
+        )
+        self.tasks.append(t)
+        return t
+
+    async def _drive(self, gen: Generator, name: str) -> Any:
+        value: Any = None
+        try:
+            while True:
+                try:
+                    eff = gen.send(value)
+                except StopIteration as e:
+                    return e.value
+                value = None
+                if isinstance(eff, Sleep):
+                    await asyncio.sleep(eff.dt)
+                elif isinstance(eff, Recv):
+                    value = await self._q(eff.chan).get()
+                elif isinstance(eff, Send):
+                    self.send(eff.chan, eff.msg)
+                elif isinstance(eff, Wait):
+                    await self._wait_event(eff.event).wait()
+                elif isinstance(eff, Fire):
+                    self.fire(eff.event)
+                elif isinstance(eff, Spawn):
+                    value = self.spawn(eff.gen, eff.name)
+                elif isinstance(eff, Stop):
+                    return None
+                else:
+                    raise TypeError(f"task {name!r} yielded {eff!r}")
+        finally:
+            gen.close()
+
+    async def shutdown(self) -> None:
+        for t in self.tasks:
+            t.cancel()
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+        self.tasks.clear()

@@ -266,3 +266,43 @@ def test_query_arg_shape_validation(tmp_path):
         localstate.run_query(node, st, "get_utxo_by_address", (b"pay-x",))
     with pytest.raises(localstate.QueryError, match="takes 1 argument"):
         localstate.run_query(node, st, "get_balance", ())
+
+
+def test_shelley_query_breadth_round4(tmp_path):
+    """The round-4 additions (shelley Ledger/Query.hs parity):
+    GetGenesisConfig, GetPoolState, GetStakeSnapshots,
+    GetRewardProvenance, DebugNewEpochState."""
+    from ouroboros_consensus_tpu.ledger import shelley as sh
+    from ouroboros_consensus_tpu.protocol.views import hash_key
+
+    node, cred, pool, pp = _shelley_node(tmp_path)
+    st = node.chain_db.current_ledger()
+    pid = hash_key(pool.vk_cold)
+    q = lambda name, *args: localstate.run_query(node, st, name, args)
+
+    g = q("get_genesis_config")
+    assert isinstance(g, sh.ShelleyGenesis) and g.pparams == pp
+
+    ps = q("get_pool_state", [pid, b"\xee" * 28])
+    assert set(ps["pools"]) == {pid}
+    assert ps["retiring"] == {} and ps["deposits"] == {pid: 0}
+
+    snaps = q("get_stake_snapshots", [pid])
+    assert set(snaps) == {"mark", "set", "go"}
+    for label in ("mark", "set", "go"):
+        assert snaps[label]["pools"][pid] == snaps[label]["total"] == 100
+
+    prov = q("get_reward_provenance")
+    assert prov["epoch"] == 0
+    assert prov["pots"]["reserves"] == 10_000 - 100
+    assert prov["total_go_stake"] == 100
+
+    dump = q("debug_new_epoch_state")
+    assert isinstance(dump, sh.ShelleyState)
+
+    # all five are v3-gated like the rest of the family
+    with pytest.raises(localstate.QueryUnsupported):
+        localstate.run_query(node, st, "get_pool_state", ([pid],), version=2)
+    # collection argspec enforced
+    with pytest.raises(localstate.QueryError):
+        q("get_stake_snapshots", pid)

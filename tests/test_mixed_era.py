@@ -188,3 +188,155 @@ def test_five_era_tamper_detected_in_conway(chain5, tmp_path):
             break
     res = composite.revalidate(cpath, CFG5, backend="native")
     assert res.error is not None or res.n_valid < n
+
+
+# ---------------------------------------------------------------------------
+# Ledger-backed composite: real Byron UTxO -> Shelley STS -> Mary-class
+# ---------------------------------------------------------------------------
+
+LEDGER_CFG = composite.CardanoMockConfig(
+    byron_epochs=1,
+    byron_epoch_length=40,
+    shelley_epochs=2,
+    epoch_length=40,  # byron ends at 40 = a shelley epoch boundary
+    n_delegs=2,
+    shelley_d=Fraction(1, 2),
+    k=5,
+    kes_depth=3,
+    with_ledgers=True,
+)
+LEDGER_N_SLOTS = 40 + 2 * 40 + 30
+
+
+@pytest.fixture(scope="module")
+def ledger_chain(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("mixed_ledger") / "db")
+    n = composite.synthesize(path, LEDGER_CFG, LEDGER_N_SLOTS)
+    return path, n
+
+
+def test_ledger_backed_chain_moves_value_across_all_eras(ledger_chain):
+    """VERDICT r3 items 5+6: era-0 (real Byron rules) txs move value
+    that is STILL SPENDABLE after the Byron->Shelley translation and
+    again after the Shelley->Mary translation; the Mary-class segment
+    mints a native asset. The whole chain revalidates end-to-end with
+    full rule application (witnesses, fees, conservation)."""
+    from ouroboros_consensus_tpu.ledger.mary import MaryValue, policy_id
+    from ouroboros_consensus_tpu.ledger.shelley import ShelleyState
+    from ouroboros_consensus_tpu.ops.host import ed25519 as ed
+
+    path, n = ledger_chain
+    res = composite.revalidate(path, LEDGER_CFG, backend="host")
+    assert res.error is None, repr(res.error)
+    assert res.n_valid == res.n_blocks == n
+    assert set(res.per_era) == {"byron", "shelley", "babbage"}
+
+    lst = res.final_ledger_state
+    assert lst.era == 2 and isinstance(lst.inner, ShelleyState)
+    # exactly one live output: the value chain's head, fee-decremented
+    # by every Byron tx, carrying the minted asset
+    [(addr, val)] = list(lst.inner.utxo.values())
+    n_byron_txs = sum(
+        1 for s in range(1, 40) if s % LEDGER_CFG.byron_epoch_length != 0
+    )
+    cm = composite.CardanoMock(LEDGER_CFG)
+    expected = (
+        cm.LEDGER_GENESIS_COIN - n_byron_txs * cm.LEDGER_BYRON_FEE
+    )
+    assert int(val) == expected
+    pid = policy_id(ed.secret_to_public(cm.MINT_POLICY_SEED))
+    assert isinstance(val, MaryValue)
+    assert val.asset_map() == {(pid, cm.MINT_ASSET): 1_000}
+    # Byron's fee pot folded into Shelley reserves at the boundary:
+    # conservation over the whole composite
+    total = int(val) + lst.inner.fees + lst.inner.prev_fees + \
+        lst.inner.reserves + lst.inner.treasury + lst.inner.deposits
+    assert total == cm.shelley_ledger.genesis.max_supply
+
+
+def test_ledger_backed_chain_rejects_tampered_tx(ledger_chain, tmp_path):
+    """Corrupting one Byron tx's witness makes the LEDGER replay fail
+    even though the consensus (header) checks still pass."""
+    import glob
+    import shutil
+
+    from ouroboros_consensus_tpu.ledger.byron import ByronInvalidWitness
+    from ouroboros_consensus_tpu.utils import cbor as cbor_mod
+
+    path, _n = ledger_chain
+    broken = str(tmp_path / "broken")
+    shutil.copytree(path, broken)
+    cm = composite.CardanoMock(LEDGER_CFG)
+    from ouroboros_consensus_tpu.storage.immutable import ImmutableDB
+
+    imm = ImmutableDB(broken + "/immutable")
+    blocks = [
+        combinator.decode_block(raw, cm.decoders)
+        for _e, raw in imm.stream_all()
+    ]
+    # find the first Byron tx-bearing block and flip a witness bit
+    target = next(
+        b for b in blocks if b.era == 0 and b.block.txs
+    )
+    tag, body = cbor_mod.decode(target.block.txs[0])
+    assert tag == 0
+    ins, outs, wits = body
+    vk, sig = wits[0]
+    bad_payload = cbor_mod.encode(
+        [0, [ins, outs, [[vk, sig[:-1] + bytes([sig[-1] ^ 1])]]]]
+    )
+
+    lst = cm.ledger_genesis_state()
+    ticked = cm.hf_ledger.tick(lst, target.slot)
+
+    class _B:
+        slot = target.slot
+        txs = (bad_payload,)
+        header = target.block.header
+
+    with pytest.raises(ByronInvalidWitness):
+        cm.hf_ledger.apply_block(ticked, composite.HardForkBlock(0, _B()))
+
+
+def test_ledger_backed_revalidate_reports_ledger_error(tmp_path):
+    """A chain whose headers pass consensus but whose body breaks the
+    LEDGER rules reports through MixedResult.error (the db-analyser
+    contract), not an uncaught exception."""
+    from ouroboros_consensus_tpu.ledger import byron as byron_led
+    from ouroboros_consensus_tpu.ledger.byron import ByronInvalidWitness
+    from ouroboros_consensus_tpu.storage.immutable import ImmutableDB
+
+    cm = composite.CardanoMock(LEDGER_CFG)
+    path = str(tmp_path / "bad")
+    import os
+
+    os.makedirs(path, exist_ok=True)
+    imm = ImmutableDB(path + "/immutable", chunk_size=100)
+
+    ebb = byron_mock.forge_ebb(slot=0, block_no=0, prev_hash=None)
+    hfb = composite.HardForkBlock(0, ebb)
+    imm.append_block(0, 0, hfb.hash_, hfb.bytes_)
+
+    # a consensus-valid delegate block carrying a corrupted-witness tx
+    good_tx = byron_led.make_tx(
+        [(bytes(32), 0)],
+        [(composite._LedgerTxChain(cm).addr,
+          cm.LEDGER_GENESIS_COIN - cm.LEDGER_BYRON_FEE)],
+        [cm.LEDGER_SPEND_SEED],
+    )
+    p = byron_led.decode_payload(good_tx)
+    vk, sig = p.witnesses[0]
+    bad_tx = byron_led.encode_tx(
+        p.ins, p.outs, [(vk, sig[:-1] + bytes([sig[-1] ^ 1]))]
+    )
+    blk = byron_mock.forge_block(
+        cm.delegs[1].cold_seed, slot=1, block_no=0, prev_hash=hfb.hash_,
+        txs=(bad_tx,),
+    )
+    hfb2 = composite.HardForkBlock(0, blk)
+    imm.append_block(1, 0, hfb2.hash_, hfb2.bytes_)
+    imm.flush()
+
+    res = composite.revalidate(path, LEDGER_CFG, backend="host")
+    assert isinstance(res.error, ByronInvalidWitness), repr(res.error)
+    assert res.final_ledger_state is not None

@@ -525,13 +525,27 @@ class ChainDBMachine(RuleBasedStateMachine):
         self._assert_same_chain()
         anchor = self.model.immutable[-1] if self.model.immutable else None
         if anchor is None or bad.slot > anchor.slot:
-            # stored + validated => marked invalid (olderThanK blocks
-            # are dropped before validation and stay unmarked)
-            assert (
-                self.db.get_is_invalid_block(bad.hash_) is not None
-                or bad.hash_ not in self.db.volatile.all_hashes()
-                or not self._connected(bad)
+            # validation only happens for candidates PREFERRED over the
+            # current chain (ChainSel.hs:874 sorts then validates): a
+            # corrupted block on a LOSING fork is stored, stays
+            # unvalidated and therefore unmarked — only a preferred
+            # candidate must end up marked invalid (olderThanK blocks
+            # are dropped before validation and stay unmarked too)
+            sv = self.model.protocol.select_view
+            cur_v = (
+                sv(self.model.current[-1].header)
+                if self.model.current else None
             )
+            preferred = (
+                self.model.protocol.compare_candidates(cur_v, sv(bad.header))
+                > 0
+            )
+            if preferred:
+                assert (
+                    self.db.get_is_invalid_block(bad.hash_) is not None
+                    or bad.hash_ not in self.db.volatile.all_hashes()
+                    or not self._connected(bad)
+                )
 
     def _connected(self, blk):
         """Is blk's parent reachable (disconnected blocks sit unvalidated

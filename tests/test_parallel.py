@@ -132,3 +132,54 @@ def test_sharded_backend_through_db_analyser(tmp_path, lview, pools):
     assert (
         sharded.final_state.ocert_counters == host.final_state.ocert_counters
     )
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("OCT_SLOW_TESTS"),
+    reason="10k-header sharded replay + two fused compiles on XLA:CPU; "
+    "set OCT_SLOW_TESTS=1 (default-run scale coverage: "
+    "__graft_entry__.dryrun_multichip stage 3 at 2048 headers)",
+)
+def test_sharded_replay_at_scale(tmp_path):
+    """VERDICT r3 item 8: a >=10k-block on-disk chain through the
+    8-device sharded backend, with 1-device-vs-8-device throughput
+    recorded (the scaling shape; absolute numbers are virtual-CPU)."""
+    import time
+
+    from ouroboros_consensus_tpu.tools import db_analyser, db_synthesizer
+
+    params = praos.PraosParams(
+        slots_per_kes_period=2000,
+        max_kes_evolutions=62,
+        security_param=4,
+        active_slot_coeff=Fraction(1),
+        epoch_length=100_000,
+        kes_depth=3,
+    )
+    pools_ = [fixtures.make_pool(0, kes_depth=3)]
+    lview_ = fixtures.make_ledger_view(pools_)
+    n = 10_000
+    fr = db_synthesizer.synthesize(
+        str(tmp_path / "db"), params, pools_, lview_,
+        db_synthesizer.ForgeLimit(blocks=n), chunk_size=4096,
+    )
+    assert fr.n_blocks == n
+
+    rates = {}
+    for n_dev in (1, 8):
+        mesh = spmd.make_mesh(jax.devices()[:n_dev])
+        # go through validate_chain's sharded path with an explicit mesh
+        imm = db_analyser.open_immutable(str(tmp_path / "db"))
+        res_acc = db_analyser.ValidationResult()
+        hvs = list(db_analyser._stream_views(imm, res_acc))
+        t0 = time.time()
+        result = pbatch.validate_chain(
+            params, lambda _e: lview_, praos.PraosState(), hvs,
+            backend="sharded", mesh=mesh, max_batch=2048,
+        )
+        dt = time.time() - t0
+        assert result.error is None, repr(result.error)
+        assert result.n_valid == n
+        rates[n_dev] = n / dt
+    # record the scaling shape for PERF.md (stdout shows under -s)
+    print(f"sharded replay scaling: {rates}")

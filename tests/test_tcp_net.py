@@ -1,0 +1,162 @@
+"""Node-to-node over real TCP sockets: the full versioned bundle
+(handshake + ChainSync + BlockFetch + TxSubmission2 + KeepAlive) between
+two complete nodes on localhost.
+
+Reference: the diffusion layer handing the mini-protocol Apps to
+socket-based `ouroboros-network` (`Node.hs:103-120`,
+`Network/NodeToNode.hs:434-466`); SURVEY §7.2 step 8 ("in-memory channel
+transport first, TCP second"). The SAME protocol generators ThreadNet
+drives under the deterministic Sim run here under utils/aio.AsyncRuntime
+— the IOLike seam, crossed for real.
+"""
+
+import asyncio
+import os
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.ledger.extended import ExtLedger
+from ouroboros_consensus_tpu.ledger.mock import MockConfig, MockLedger
+from ouroboros_consensus_tpu.node import transport
+from ouroboros_consensus_tpu.node.kernel import NodeKernel, SlotClock
+from ouroboros_consensus_tpu.miniprotocol import handshake
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.protocol.instances import PraosProtocol
+from ouroboros_consensus_tpu.storage.open import open_chaindb
+from ouroboros_consensus_tpu.testing import fixtures
+from ouroboros_consensus_tpu.utils.aio import AsyncRuntime
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=100,
+    max_kes_evolutions=62,
+    security_param=60,
+    active_slot_coeff=Fraction(1),
+    epoch_length=10_000,
+    kes_depth=3,
+)
+POOLS = [fixtures.make_pool(0, kes_depth=3)]
+LVIEW = fixtures.make_ledger_view(POOLS)
+N_SLOTS = 120
+SLOT_LEN = 0.02
+
+
+def _mk_node(base: str, i: int, *, forger: bool) -> NodeKernel:
+    ledger = MockLedger(MockConfig(LVIEW, PARAMS.stability_window))
+    protocol = PraosProtocol(PARAMS, use_device_batch=False)
+    ext = ExtLedger(ledger, protocol)
+    genesis = ext.genesis(
+        ledger.genesis_state([(b"g-%d" % k, 100) for k in range(4)])
+    )
+    db = open_chaindb(
+        os.path.join(base, f"node{i}"), ext, genesis, PARAMS.security_param
+    )
+    return NodeKernel(
+        f"node{i}", db, protocol, ledger,
+        pool=POOLS[0] if forger else None,
+        clock=SlotClock(SLOT_LEN),
+    )
+
+
+def _chain_len(node) -> int:
+    return len(list(node.chain_db.stream_all()))
+
+
+async def _converged(node, want: int, timeout: float = 30.0) -> int:
+    t0 = asyncio.get_event_loop().time()
+    while True:
+        n = _chain_len(node)
+        if n >= want:
+            return n
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            return n
+        await asyncio.sleep(0.05)
+
+
+def test_sync_over_tcp(tmp_path):
+    """A fresh node syncs 100+ blocks from a forger over a localhost
+    socket and converges to the identical chain (VERDICT r3 item 7)."""
+
+    async def run():
+        runtime = AsyncRuntime()
+        forger = _mk_node(str(tmp_path), 0, forger=True)
+        syncer = _mk_node(str(tmp_path), 1, forger=False)
+        forger.chain_db.runtime = runtime
+        syncer.chain_db.runtime = runtime
+        server = await transport.serve_node(forger, runtime)
+        port = server.sockets[0].getsockname()[1]
+        runtime.spawn(forger.forging_loop(N_SLOTS), "forge")
+        mux = await transport.connect_node(
+            syncer, runtime, "127.0.0.1", port
+        )
+        assert mux is not None
+        n = await _converged(syncer, N_SLOTS)
+        forged = _chain_len(forger)
+        assert forged >= 100, f"forger only made {forged} blocks"
+        assert n == forged, f"syncer at {n}/{forged}"
+        a = [b.hash_ for b in forger.chain_db.stream_all()]
+        b = [b.hash_ for b in syncer.chain_db.stream_all()]
+        assert a == b
+        server.close()
+        await runtime.shutdown()
+
+    asyncio.run(run())
+
+
+def test_tx_diffusion_over_tcp(tmp_path):
+    """TxSubmission2 over the socket: a tx submitted to the FORGER
+    reaches the downstream peer's mempool through the outbound/inbound
+    pair (the reference's tx flow is server→client pull)."""
+    from ouroboros_consensus_tpu.ledger.mock import encode_tx
+
+    async def run():
+        runtime = AsyncRuntime()
+        forger = _mk_node(str(tmp_path), 0, forger=True)
+        syncer = _mk_node(str(tmp_path), 1, forger=False)
+        forger.chain_db.runtime = runtime
+        syncer.chain_db.runtime = runtime
+        server = await transport.serve_node(forger, runtime)
+        port = server.sockets[0].getsockname()[1]
+        await transport.connect_node(syncer, runtime, "127.0.0.1", port)
+        tx = encode_tx([(bytes(32), 0)], [(b"tcp-paid", 100)])
+        forger.mempool.add_tx(tx)
+        for _ in range(100):
+            if syncer.mempool.get_snapshot().txs:
+                break
+            await asyncio.sleep(0.05)
+        got = [t.tx for t in syncer.mempool.get_snapshot().txs]
+        assert tx in got, "tx never diffused over TCP"
+        server.close()
+        await runtime.shutdown()
+
+    asyncio.run(run())
+
+
+def test_handshake_magic_mismatch_refused(tmp_path):
+    """Cross-network dial: mismatched network magic is refused at the
+    wire handshake, no protocols start (stdVersionDataNTN guard)."""
+
+    async def run():
+        runtime = AsyncRuntime()
+        forger = _mk_node(str(tmp_path), 0, forger=True)
+        syncer = _mk_node(str(tmp_path), 1, forger=False)
+        server = await transport.serve_node(
+            forger, runtime,
+            versions={
+                v: handshake.VersionData(network_magic=1)
+                for v in handshake.NODE_TO_NODE_VERSIONS
+            },
+        )
+        port = server.sockets[0].getsockname()[1]
+        with pytest.raises(handshake.HandshakeRefused):
+            await transport.connect_node(
+                syncer, runtime, "127.0.0.1", port,
+                versions={
+                    v: handshake.VersionData(network_magic=2)
+                    for v in handshake.NODE_TO_NODE_VERSIONS
+                },
+            )
+        server.close()
+        await runtime.shutdown()
+
+    asyncio.run(run())
