@@ -55,6 +55,11 @@ _SHELLEY_QUERIES = frozenset({
     "get_reward_provenance", "debug_new_epoch_state",
 })
 
+# Byron-era queries (byron Ledger/Query.hs GetUpdateInterfaceState
+# shape, collapsed to the delegation/fee surface our Byron ledger has):
+# era-checked exactly like the Shelley family
+_BYRON_QUERIES = frozenset({"get_delegation_map", "get_byron_state"})
+
 QUERY_MIN_VERSION = {
     "get_chain_block_no": 1,
     "get_chain_point": 1,
@@ -63,6 +68,7 @@ QUERY_MIN_VERSION = {
     "get_balance": 1,
     "get_pool_distr": 2,
     **{q: 3 for q in _SHELLEY_QUERIES},
+    **{q: 3 for q in _BYRON_QUERIES},
 }
 
 
@@ -250,6 +256,8 @@ def run_query(node, ext_state, name: str, args, version: int = LATEST_QUERY_VERS
         return total
     if name == "get_pool_distr":
         return node.ledger_view_at(hs.tip.slot if hs.tip else 0).pool_distr
+    if name in _BYRON_QUERIES:
+        return _run_byron_query(_byron_state(ledger_state), name)
     if name == "get_genesis_config":
         # GetGenesisConfig: the static Shelley genesis the LEDGER was
         # configured with (not part of the state) — era-checked like
@@ -259,6 +267,33 @@ def run_query(node, ext_state, name: str, args, version: int = LATEST_QUERY_VERS
     if name in _SHELLEY_QUERIES:
         return _run_shelley_query(_shelley_state(ledger_state), name, args)
     raise QueryError(f"unknown query {name!r}")
+
+
+def _byron_state(ledger_state):
+    """Unwrap (possibly HFC-nested, possibly Dual-paired) state to a
+    ByronState or raise EraMismatch."""
+    from ..hardfork.combinator import HFState
+    from ..ledger.byron import ByronState
+    from ..ledger.byron_spec import DualByronState
+
+    st = ledger_state
+    while isinstance(st, HFState):
+        st = st.inner
+    if isinstance(st, DualByronState):
+        st = st.impl
+    if not isinstance(st, ByronState):
+        raise EraMismatch(f"Byron query against {type(st).__name__} state")
+    return st
+
+
+def _run_byron_query(st, name: str):
+    import copy
+
+    if name == "get_delegation_map":
+        return dict(st.delegation)
+    if name == "get_byron_state":
+        return copy.deepcopy(st)  # debug dump, isolated from the node
+    raise QueryError(f"unknown Byron query {name!r}")
 
 
 def _shelley_genesis_of(ledger):

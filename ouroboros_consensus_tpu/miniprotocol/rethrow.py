@@ -21,9 +21,10 @@ PEER_DISCONNECT_EXCEPTIONS = (ChainSyncClientException, InvalidBlockFromPeer)
 def peer_guard(gen, name: str, trace, on_disconnect=None):
     """Run `gen`; a peer violation traces + invokes `on_disconnect()`
     (tear down the connection's other protocol tasks) and ends this
-    task. Other exceptions propagate — the node-shutdown class."""
+    task. Other exceptions propagate — the node-shutdown class. The
+    inner task's return value passes through (peersharing's peer list)."""
     try:
-        yield from gen
+        return (yield from gen)
     except PEER_DISCONNECT_EXCEPTIONS as e:
         trace(f"{name}: disconnected peer: {e}")
         if on_disconnect is not None:

@@ -61,11 +61,15 @@ class NodeKernel:
         ocert_counter: int = 0,
         forge_fn=None,  # block-type seam: forge_fn(node, slot, block_no,
         # prev_hash, ticked, is_leader, txs) -> Block; None = Praos
+        can_be_leader=None,  # protocol-shaped leadership credential
+        # (Block/Forging.hs canBeLeader): PBFT nodes pass their genesis
+        # key INDEX, Praos nodes default to PraosCanBeLeader from `pool`
     ):
         self.name = name
         self.chain_db = chain_db
         self.protocol = protocol
         self.forge_fn = forge_fn
+        self._can_be_leader_override = can_be_leader
         self.ledger = ledger
         self.pool = pool
         self.clock = clock or SlotClock()
@@ -101,7 +105,10 @@ class NodeKernel:
         self._ocert_counter = ocert_counter
         self.hotkey = hotkey
         self._ocert = ocert
-        if pool is not None and hotkey is None:
+        if (pool is not None and hotkey is None
+                and hasattr(protocol.params, "max_kes_evolutions")):
+            # KES-capable protocols only: a PBFT (Byron) node signs with
+            # its delegate's cold Ed25519 key, no hot key to evolve
             # fresh node: derive the hot key from the pool's root seed.
             # A restart carrying an evolved key passes it in instead —
             # re-deriving here would resurrect forgotten (forward-secure)
@@ -263,6 +270,8 @@ class NodeKernel:
         return block
 
     def _can_be_leader(self):
+        if self._can_be_leader_override is not None:
+            return self._can_be_leader_override
         return praos_mod.PraosCanBeLeader(
             ocert=self._ocert,
             vk_cold=self.pool.vk_cold,

@@ -32,28 +32,75 @@ from ..utils.sim import Channel
 
 
 def to_wire(obj) -> Any:
-    """Points/VersionData/dicts/tuples -> CBOR-encodable structures."""
-    if obj is None:
-        return None
+    """Anything a mini-protocol or query can produce -> CBOR-encodable.
+    TOTAL by construction: known rich types get tagged encodings;
+    dataclasses (query results like PoolParams/ShelleyGenesis, debug
+    state dumps) travel as tagged field maps and arrive as plain dicts
+    (the reference likewise serializes query results — the class
+    identity is a codec concern, not wire data); anything else falls
+    back to its repr — a lossy but NON-FATAL encoding, so an exotic
+    result can never kill a server task mid-Send."""
+    import dataclasses
+    from fractions import Fraction
+
+    if obj is None or isinstance(obj, (bytes, str, bool)):
+        return obj
     if isinstance(obj, Point):
         return ["pt", obj.slot, obj.hash_]
     if isinstance(obj, handshake.VersionData):
         return ["vd", obj.network_magic]
+    if isinstance(obj, Fraction):
+        return ["fr", obj.numerator, obj.denominator]
+    try:
+        from ..ledger.mary import MaryValue
+    except ImportError:  # pragma: no cover
+        MaryValue = ()
+    if MaryValue and isinstance(obj, MaryValue):
+        return ["mv", int(obj),
+                [[pid, name, q] for (pid, name), q in obj.assets]]
+    if isinstance(obj, int):
+        return obj
     if isinstance(obj, dict):
         return ["map", [[to_wire(k), to_wire(v)] for k, v in obj.items()]]
+    if isinstance(obj, (set, frozenset)):
+        return ["set", [to_wire(x) for x in sorted(obj)]]
     if isinstance(obj, (list, tuple)):
         return [to_wire(x) for x in obj]
-    return obj
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return ["dc", type(obj).__name__, [
+            [f.name, to_wire(getattr(obj, f.name))]
+            for f in dataclasses.fields(obj)
+        ]]
+    return ["repr", repr(obj)]
 
 
 def from_wire(obj) -> Any:
+    from fractions import Fraction
+
     if isinstance(obj, list):
         if len(obj) == 3 and obj[0] == "pt":
             return Point(obj[1], obj[2])
         if len(obj) == 2 and obj[0] == "vd":
             return handshake.VersionData(network_magic=obj[1])
+        if len(obj) == 3 and obj[0] == "fr":
+            return Fraction(obj[1], obj[2])
+        if len(obj) == 3 and obj[0] == "mv":
+            from ..ledger.mary import MaryValue
+
+            return MaryValue(
+                obj[1], {(bytes(p), bytes(n)): q for p, n, q in obj[2]}
+            )
         if len(obj) == 2 and obj[0] == "map" and isinstance(obj[1], list):
             return {from_wire(k): from_wire(v) for k, v in obj[1]}
+        if len(obj) == 2 and obj[0] == "set" and isinstance(obj[1], list):
+            return frozenset(from_wire(x) for x in obj[1])
+        if len(obj) == 3 and obj[0] == "dc" and isinstance(obj[2], list):
+            # dataclass results arrive as {"__type__": name, **fields}
+            out = {from_wire(k): from_wire(v) for k, v in obj[2]}
+            out["__type__"] = obj[1]
+            return out
+        if len(obj) == 2 and obj[0] == "repr":
+            return ("opaque", obj[1])
         return tuple(from_wire(x) for x in obj)
     return obj
 
@@ -134,6 +181,48 @@ class Mux:
         if initiator:
             return self.inbound(f"{proto}:rsp"), self.outbound(f"{proto}:req")
         return self.inbound(f"{proto}:req"), self.outbound(f"{proto}:rsp")
+
+
+async def open_mux(
+    reader,
+    writer,
+    runtime: AsyncRuntime,
+    versions: dict[int, handshake.VersionData],
+    *,
+    initiator: bool,
+    label: str,
+) -> tuple[Mux, int]:
+    """The per-connection scaffolding every endpoint shares: fresh Mux,
+    rx pump, wire handshake FIRST (initiator proposes, responder picks),
+    cleanup on refusal. Returns (mux, negotiated_version); the pump task
+    is parked on mux.pump_task."""
+    mux = Mux(reader, writer, runtime)
+    if initiator:
+        hs_gen = handshake.client(
+            mux.inbound("handshake:rsp"), mux.outbound("handshake:req"),
+            versions,
+        )
+    else:
+        hs_gen = handshake.server(
+            mux.inbound("handshake:req"), mux.outbound("handshake:rsp"),
+            versions,
+        )
+    pump = asyncio.ensure_future(mux.pump())
+    try:
+        version, _data = await runtime.spawn(hs_gen, label)
+    except BaseException:
+        pump.cancel()
+        try:
+            writer.close()
+        except Exception:
+            pass
+        raise
+    mux.pump_task = pump
+    return mux, version
+
+
+def _default_versions(table: dict) -> dict[int, handshake.VersionData]:
+    return {v: handshake.VersionData(network_magic=764824073) for v in table}
 
 
 # -- the versioned bundle over a mux ----------------------------------------
@@ -224,23 +313,19 @@ async def serve_node(
     """Listen for peers; per connection: wire handshake (responder),
     then the responder half of the bundle. Returns the asyncio server
     (its .sockets[0].getsockname()[1] is the bound port)."""
-    ours = versions if versions is not None else {
-        v: handshake.VersionData(network_magic=764824073)
-        for v in handshake.NODE_TO_NODE_VERSIONS
-    }
+    ours = versions if versions is not None else _default_versions(
+        handshake.NODE_TO_NODE_VERSIONS
+    )
 
     async def handle(reader, writer):
         peer = writer.get_extra_info("peername")
-        mux = Mux(reader, writer, runtime)
-        hs_rx = mux.inbound("handshake:req")
-        hs_tx = mux.outbound("handshake:rsp")
-        pump = asyncio.ensure_future(mux.pump())
-        hs_task = runtime.spawn(
-            handshake.server(hs_rx, hs_tx, ours), f"handshake:{peer}"
-        )
         tasks: list = []
+        mux = None
         try:
-            version, _data = await hs_task
+            mux, version = await open_mux(
+                reader, writer, runtime, ours,
+                initiator=False, label=f"handshake:{peer}",
+            )
             trace(f"{node.name}: peer {peer} negotiated v{version}")
             tasks = _spawn_bundle(
                 runtime, mux, node, f"tcp:{peer}", version,
@@ -252,9 +337,126 @@ async def serve_node(
         finally:
             for t in tasks:
                 t.cancel()
-            pump.cancel()
+            if mux is not None:
+                mux.pump_task.cancel()
 
     return await asyncio.start_server(handle, host, port)
+
+
+async def serve_node_to_client(
+    node,
+    runtime: AsyncRuntime,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    *,
+    versions: dict[int, handshake.VersionData] | None = None,
+    trace=lambda s: None,
+):
+    """The node-to-client side over TCP (Network/NodeToClient.hs — the
+    reference serves wallets/CLIs over a local socket): wire handshake
+    against NODE_TO_CLIENT_VERSIONS, then the version-gated local bundle
+    (LocalStateQuery, LocalTxSubmission, LocalTxMonitor). The negotiated
+    version also gates the query vocabulary
+    (localstate.QUERY_MIN_VERSION)."""
+    from ..miniprotocol import localstate
+
+    ours = versions if versions is not None else _default_versions(
+        handshake.NODE_TO_CLIENT_VERSIONS
+    )
+
+    async def handle(reader, writer):
+        peer = writer.get_extra_info("peername")
+        tasks: list = []
+        mux = None
+        try:
+            mux, version = await open_mux(
+                reader, writer, runtime, ours,
+                initiator=False, label=f"n2c-handshake:{peer}",
+            )
+            enabled = handshake.NODE_TO_CLIENT_VERSIONS[version]
+            if "localstatequery" in enabled:
+                rx, tx = mux.channel_pair("localstatequery", initiator=False)
+                tasks.append(runtime.spawn(
+                    localstate.state_query_server(
+                        node, rx, tx, version=version
+                    ),
+                    f"lsq:{peer}",
+                ))
+            if "localtxsubmission" in enabled:
+                rx, tx = mux.channel_pair(
+                    "localtxsubmission", initiator=False
+                )
+                tasks.append(runtime.spawn(
+                    localstate.tx_submission_server(node, rx, tx),
+                    f"lts:{peer}",
+                ))
+            if "localtxmonitor" in enabled:
+                rx, tx = mux.channel_pair("localtxmonitor", initiator=False)
+                tasks.append(runtime.spawn(
+                    localstate.tx_monitor_server(node, rx, tx),
+                    f"ltm:{peer}",
+                ))
+            await mux.closed.wait()
+        except handshake.HandshakeRefused as e:
+            trace(f"{node.name}: refused n2c {peer}: {e}")
+        finally:
+            for t in tasks:
+                t.cancel()
+            if mux is not None:
+                mux.pump_task.cancel()
+
+    return await asyncio.start_server(handle, host, port)
+
+
+class LocalClient:
+    """A minimal node-to-client session over TCP: handshake, then
+    request/reply on the local protocols (the wallet/CLI side)."""
+
+    def __init__(self, mux: Mux, runtime: AsyncRuntime, version: int):
+        self.mux = mux
+        self.runtime = runtime
+        self.version = version
+        self._chans: dict[str, tuple] = {}
+
+    @classmethod
+    async def connect(cls, runtime: AsyncRuntime, host: str, port: int, *,
+                      versions=None):
+        ours = versions if versions is not None else _default_versions(
+            handshake.NODE_TO_CLIENT_VERSIONS
+        )
+        reader, writer = await asyncio.open_connection(host, port)
+        mux, version = await open_mux(
+            reader, writer, runtime, ours,
+            initiator=True, label="n2c-handshake",
+        )
+        return cls(mux, runtime, version)
+
+    def _chan(self, proto: str):
+        if proto not in self._chans:
+            rx, tx = self.mux.channel_pair(proto, initiator=True)
+            self._chans[proto] = (rx, tx)
+        return self._chans[proto]
+
+    async def request(self, proto: str, msg) -> Any:
+        """One request/reply; raises ConnectionError if the connection
+        dies mid-request instead of blocking forever."""
+        rx, tx = self._chan(proto)
+        self.runtime.send(tx, msg)
+        await self.mux.writer.drain()
+        get = asyncio.ensure_future(self.runtime._q(rx).get())
+        closed = asyncio.ensure_future(self.mux.closed.wait())
+        done, _pending = await asyncio.wait(
+            {get, closed}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if get in done:
+            closed.cancel()
+            return get.result()
+        get.cancel()
+        raise ConnectionError("node-to-client connection closed")
+
+    def close(self) -> None:
+        self.mux.pump_task.cancel()
+        self.mux.writer.close()
 
 
 async def connect_node(
@@ -269,28 +471,22 @@ async def connect_node(
     """Dial a peer: wire handshake (initiator), then the initiator half
     of the bundle (ChainSync/BlockFetch/... clients feeding this node's
     ChainDB). Returns the live Mux; closing it tears the bundle down."""
-    ours = versions if versions is not None else {
-        v: handshake.VersionData(network_magic=764824073)
-        for v in handshake.NODE_TO_NODE_VERSIONS
-    }
+    ours = versions if versions is not None else _default_versions(
+        handshake.NODE_TO_NODE_VERSIONS
+    )
     reader, writer = await asyncio.open_connection(host, port)
-    mux = Mux(reader, writer, runtime)
-    hs_rx = mux.inbound("handshake:rsp")
-    hs_tx = mux.outbound("handshake:req")
-    pump = asyncio.ensure_future(mux.pump())
-    try:
-        version, _data = await runtime.spawn(
-            handshake.client(hs_rx, hs_tx, ours), "handshake:client"
-        )
-    except BaseException:
-        pump.cancel()
-        writer.close()
-        raise
+    mux, version = await open_mux(
+        reader, writer, runtime, ours,
+        initiator=True, label="handshake:client",
+    )
     trace(f"{node.name}: connected to {host}:{port} at v{version}")
+    # the peers we dialed are what WE can share (the PeerSharing
+    # registry's outbound side, NodeKernel.hs:88-114)
+    if [host, port] not in node.known_peers:
+        node.known_peers.append([host, port])
     tasks = _spawn_bundle(
         runtime, mux, node, f"tcp:{host}:{port}", version,
         initiator=True, trace=trace,
     )
     mux.tasks = tasks  # for teardown by the caller
-    mux.pump_task = pump
     return mux

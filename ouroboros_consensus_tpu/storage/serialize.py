@@ -148,9 +148,30 @@ def _dec_pparams(o):
     return sh.PParams(**kw)
 
 
+def _enc_value(v):
+    """UTxO value column: plain coin stays a bare int (golden-stable);
+    a Mary multi-asset value becomes [coin, [[policy, name, qty]...]]."""
+    assets = getattr(v, "assets", ())
+    if not assets:
+        return int(v)
+    return [int(v), [[pid, name, q] for (pid, name), q in assets]]
+
+
+def _dec_value(o):
+    if isinstance(o, int):
+        return o
+    from ..ledger.mary import MaryValue
+
+    coin, assets = o
+    return MaryValue(
+        int(coin),
+        {(bytes(p), bytes(n)): int(q) for p, n, q in assets},
+    )
+
+
 def encode_shelley_state(st) -> list:
     utxo = sorted(
-        [txid, ix, a[0], a[1], c]
+        [txid, ix, a[0], a[1], _enc_value(c)]
         for (txid, ix), (a, c) in st.utxo.items()
     )
     return [
@@ -185,7 +206,7 @@ def decode_shelley_state(o):
         utxo={
             (bytes(e[0]), int(e[1])): (
                 (bytes(e[2]), None if e[3] is None else bytes(e[3])),
-                int(e[4]),
+                _dec_value(e[4]),
             )
             for e in o[0]
         },
@@ -217,16 +238,50 @@ def decode_shelley_state(o):
     )
 
 
+def encode_byron_state(st) -> list:
+    return [
+        sorted([t, ix, a, c] for (t, ix), (a, c) in st.utxo.items()),
+        sorted([g, d] for g, d in st.delegation.items()),
+        st.fees,
+        st.tip_slot_,
+    ]
+
+
+def decode_byron_state(o):
+    from ..ledger.byron import ByronState
+
+    return ByronState(
+        utxo={(bytes(e[0]), int(e[1])): (bytes(e[2]), int(e[3]))
+              for e in o[0]},
+        delegation={bytes(g): bytes(d) for g, d in o[1]},
+        fees=int(o[2]),
+        tip_slot_=o[3],
+    )
+
+
 def encode_ledger_state_tagged(st) -> list:
     """Type-dispatched ledger-state codec (v2 snapshot payloads)."""
     from ..hardfork.combinator import HFState
+    from ..ledger import byron as byron_led
     from ..ledger import shelley as sh
+    from ..ledger.byron_spec import DualByronState
     from ..ledger.dual import DualState
 
     if isinstance(st, MockState):
         return ["mock", encode_mock_state(st)]
     if isinstance(st, sh.ShelleyState):
+        # Mary-era states reuse this codec: the value column widens
+        # per-entry (see _enc_value), ada-only entries stay golden-stable
         return ["shelley", encode_shelley_state(st)]
+    if isinstance(st, byron_led.ByronState):
+        return ["byron", encode_byron_state(st)]
+    if isinstance(st, DualByronState):
+        spec = st.spec
+        return ["dual_byron", encode_byron_state(st.impl), [
+            sorted([t, ix, a, v] for (t, ix), (a, v) in spec.utxo.items()),
+            sorted([g, d] for g, d in spec.delegation.items()),
+            spec.fees,
+        ]]
     if isinstance(st, HFState):
         return ["hf", st.era, encode_ledger_state_tagged(st.inner)]
     if isinstance(st, DualState):
@@ -246,6 +301,20 @@ def decode_ledger_state_tagged(o):
         return decode_mock_state(o[1])
     if tag == "shelley":
         return decode_shelley_state(o[1])
+    if tag == "byron":
+        return decode_byron_state(o[1])
+    if tag == "dual_byron":
+        from ..ledger.byron_spec import ByronSpecState, DualByronState
+
+        return DualByronState(
+            decode_byron_state(o[1]),
+            ByronSpecState(
+                utxo={(bytes(e[0]), int(e[1])): (bytes(e[2]), int(e[3]))
+                      for e in o[2][0]},
+                delegation={bytes(g): bytes(d) for g, d in o[2][1]},
+                fees=int(o[2][2]),
+            ),
+        )
     if tag == "hf":
         return HFState(int(o[1]), decode_ledger_state_tagged(o[2]))
     if tag == "dual":

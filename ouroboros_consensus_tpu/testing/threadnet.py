@@ -86,6 +86,11 @@ class ThreadNetConfig:
     # that delegates every genesis output's stake round-robin to the
     # forger pools (the DualByron-test shape on the Shelley side)
     hf_shelley_era: bool = False
+    # third era: the Shelley state translates again into the MARY-class
+    # ledger (multi-asset values, minting, validity intervals) at this
+    # epoch — a 3-era net crossing two GENUINE rule changes (requires
+    # hf_shelley_era)
+    hf_mary_at_epoch: int | None = None
 
 
 @dataclass
@@ -243,14 +248,18 @@ class _Net:
             params_b = dataclasses.replace(
                 self.params, epoch_length=2 * self.params.epoch_length
             )
-        summary = summarize(
-            F(0),
-            [
-                HEraParams(params_a.epoch_length, F(1)),
-                HEraParams(params_b.epoch_length, F(1)),
-            ],
-            [cfg.hard_fork_at_epoch, None],
-        )
+        era_params = [
+            HEraParams(params_a.epoch_length, F(1)),
+            HEraParams(params_b.epoch_length, F(1)),
+        ]
+        bounds: list = [cfg.hard_fork_at_epoch, None]
+        if cfg.hf_mary_at_epoch is not None:
+            if not cfg.hf_shelley_era:
+                raise ValueError("hf_mary_at_epoch requires hf_shelley_era")
+            era_params.append(HEraParams(params_b.epoch_length, F(1)))
+            bounds[-1] = cfg.hf_mary_at_epoch
+            bounds.append(None)
+        summary = summarize(F(0), era_params, bounds)
         if cfg.hf_shelley_era:
             era_b = self._shelley_era_b(params_b)
         else:
@@ -271,11 +280,24 @@ class _Net:
             ),
             era_b,
         ]
+        if cfg.hf_mary_at_epoch is not None:
+            from ..ledger import mary as mary_mod
+
+            mary_ledger = mary_mod.MaryLedger(era_b.ledger.genesis)
+            eras.append(Era(
+                "maryC",
+                PraosProtocol(params_b, use_device_batch=cfg.use_device_batch),
+                ledger=mary_ledger,
+                # Shelley→Mary: Coin widens to MaryValue, rules change
+                # (CanHardFork.hs:273 Shelley-family step)
+                translate_ledger_state=mary_ledger.translate_from_shelley,
+                translate_tx=mary_mod.translate_tx_from_shelley,
+            ))
         protocol = HardForkProtocol(eras, summary)
         ledger = HardForkLedger(eras, summary)
         codec = functools.partial(
             decode_block,
-            era_decoders=[PraosBlock.from_bytes, PraosBlock.from_bytes],
+            era_decoders=[PraosBlock.from_bytes] * len(eras),
         )
 
         def forge_fn(node, slot, block_no, prev_hash, ticked, is_leader, txs):

@@ -110,3 +110,69 @@ if __name__ == "__main__":
             with open(_path(name), "w") as f:
                 f.write(gen().hex() + "\n")
             print(f"wrote {name}")
+
+
+def test_byron_and_mary_snapshot_roundtrip():
+    """Round-4 codecs: ByronState, DualByronState, and Mary multi-asset
+    values riding the Shelley snapshot's value column (ada-only entries
+    keep the golden-stable bare-int encoding)."""
+    from ouroboros_consensus_tpu.ledger.byron import (
+        ByronGenesis, ByronLedger, ByronPParams,
+    )
+    from ouroboros_consensus_tpu.ledger.byron_spec import DualByronLedger
+    from ouroboros_consensus_tpu.ledger.mary import MaryValue
+    from ouroboros_consensus_tpu.ledger.shelley import (
+        PParams, ShelleyGenesis, ShelleyLedger,
+    )
+    from ouroboros_consensus_tpu.hardfork.combinator import HFState
+    from ouroboros_consensus_tpu.ops.host import ed25519 as ed
+    from ouroboros_consensus_tpu.utils import cbor
+
+    def rt(st):
+        wire = cbor.encode(serialize.encode_ledger_state_tagged(st))
+        return serialize.decode_ledger_state_tagged(cbor.decode(wire))
+
+    gen = ByronGenesis(
+        pparams=ByronPParams(min_fee_a=10, min_fee_b=0),
+        genesis_keys=(ed.secret_to_public(b"\x10" * 32),),
+    )
+    led = ByronLedger(gen)
+    b_st = led.genesis_state([(b"\x0a" * 28, 500)])
+    again = rt(b_st)
+    assert dict(again.utxo) == dict(b_st.utxo)
+    assert dict(again.delegation) == dict(b_st.delegation)
+    assert again.fees == b_st.fees and again.tip_slot_ == b_st.tip_slot_
+    # HF-wrapped too (the composite's snapshot shape)
+    hf = rt(HFState(0, b_st))
+    assert hf.era == 0 and dict(hf.inner.utxo) == dict(b_st.utxo)
+
+    dual = DualByronLedger(gen)
+    d_st = dual.genesis_state([(b"\x0a" * 28, 500)])
+    d_again = rt(d_st)
+    assert dict(d_again.impl.utxo) == dict(d_st.impl.utxo)
+    assert dict(d_again.spec.utxo) == dict(d_st.spec.utxo)
+    assert dict(d_again.spec.delegation) == dict(d_st.spec.delegation)
+
+    sh_led = ShelleyLedger(ShelleyGenesis(
+        pparams=PParams(), epoch_length=100, stability_window=30,
+    ))
+    pid = b"\x77" * 28
+    s_st = sh_led.genesis_state([(b"\x0b" * 28, None, 100)])
+    s_st = __import__("dataclasses").replace(
+        s_st,
+        utxo={
+            **s_st.utxo,
+            (b"\xfe" * 32, 0): (
+                (b"\x0c" * 28, None),
+                MaryValue(7, {(pid, b"tok"): 9}),
+            ),
+        },
+    )
+    m_again = rt(s_st)
+    vals = sorted(
+        (int(v), tuple(getattr(v, "assets", ())))
+        for _a, v in m_again.utxo.values()
+    )
+    assert vals == [(7, (((pid, b"tok"), 9),)), (100, ())]
+    mary_val = [v for _a, v in m_again.utxo.values() if int(v) == 7][0]
+    assert isinstance(mary_val, MaryValue)

@@ -306,3 +306,55 @@ def test_shelley_query_breadth_round4(tmp_path):
     # collection argspec enforced
     with pytest.raises(localstate.QueryError):
         q("get_stake_snapshots", pid)
+
+
+def test_byron_query_family(tmp_path):
+    """Byron-era queries (byron Ledger/Query.hs analog): the delegation
+    map + debug dump, era-checked (EraMismatch on a Shelley state)."""
+    from fractions import Fraction as F
+
+    from ouroboros_consensus_tpu.ledger.byron import (
+        ByronGenesis, ByronLedger, ByronPParams, addr_of,
+    )
+    from ouroboros_consensus_tpu.ledger.extended import ExtLedger
+    from ouroboros_consensus_tpu.ops.host import ed25519 as ed
+    from ouroboros_consensus_tpu.protocol.instances import (
+        PBftParams, PBftProtocol,
+    )
+    from ouroboros_consensus_tpu.storage.open import open_chaindb
+    from ouroboros_consensus_tpu.hardfork.byron_mock import ByronMockBlock
+
+    gvk = ed.secret_to_public(b"\x10" * 32)
+    led = ByronLedger(ByronGenesis(
+        pparams=ByronPParams(min_fee_a=0, min_fee_b=0),
+        genesis_keys=(gvk,),
+    ))
+    proto = PBftProtocol(
+        PBftParams(num_genesis_keys=1, threshold=F(1), window=5,
+                   security_param=4),
+        [gvk],
+    )
+    ext = ExtLedger(led, proto)
+    st0 = ext.genesis(led.genesis_state([(addr_of(gvk), 77)]))
+    db = open_chaindb(
+        str(tmp_path / "bq"), ext, st0, 4,
+        decode_block=ByronMockBlock.from_bytes,
+    )
+    node = NodeKernel("bq", db, proto, led)
+    est = db.current_ledger()
+
+    dlg = localstate.run_query(node, est, "get_delegation_map", ())
+    assert dlg == {gvk: gvk}
+    dump = localstate.run_query(node, est, "get_byron_state", ())
+    dump.utxo.clear()  # isolated from the live state
+    assert len(db.current_ledger().ledger_state.utxo) == 1
+    # era mismatch both directions: byron query on a shelley node...
+    sh_node, _c, _p, _pp = _shelley_node(tmp_path)
+    with pytest.raises(localstate.EraMismatch):
+        localstate.run_query(
+            sh_node, sh_node.chain_db.current_ledger(),
+            "get_delegation_map", (),
+        )
+    # ...and a shelley query on this byron node
+    with pytest.raises(localstate.EraMismatch):
+        localstate.run_query(node, est, "get_epoch_no", ())

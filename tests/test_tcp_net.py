@@ -160,3 +160,165 @@ def test_handshake_magic_mismatch_refused(tmp_path):
         await runtime.shutdown()
 
     asyncio.run(run())
+
+
+def test_node_to_client_over_tcp(tmp_path):
+    """The node-to-client bundle over a local socket (the reference's
+    wallet/CLI surface, Network/NodeToClient.hs): handshake, then
+    LocalStateQuery acquire/query/release, LocalTxSubmission, and
+    LocalTxMonitor against a live forging node."""
+    from ouroboros_consensus_tpu.ledger.mock import encode_tx
+
+    async def run():
+        runtime = AsyncRuntime()
+        node = _mk_node(str(tmp_path), 0, forger=True)
+        node.chain_db.runtime = runtime
+        server = await transport.serve_node_to_client(node, runtime)
+        port = server.sockets[0].getsockname()[1]
+        runtime.spawn(node.forging_loop(20), "forge")
+        await asyncio.sleep(0.5)  # a few blocks first
+
+        cli = await transport.LocalClient.connect(
+            runtime, "127.0.0.1", port
+        )
+        assert cli.version == max(handshake.NODE_TO_CLIENT_VERSIONS)
+
+        # LocalStateQuery session
+        r = await cli.request("localstatequery", ("acquire", None))
+        assert r == ("acquired",)
+        r = await cli.request(
+            "localstatequery", ("query", "get_tip_slot", ())
+        )
+        assert r[0] == "result" and r[1] >= 1
+        r = await cli.request(
+            "localstatequery", ("query", "get_balance", (b"g-0",))
+        )
+        assert r == ("result", 100)
+        # era-mismatch failure travels the wire as a failure, not a hang
+        r = await cli.request(
+            "localstatequery", ("query", "get_epoch_no", ())
+        )
+        assert r[0] == "failed"
+
+        # LocalTxSubmission: a valid tx accepted, a garbage one rejected
+        tx = encode_tx([(bytes(32), 1)], [(b"n2c-paid", 100)])
+        r = await cli.request("localtxsubmission", ("submit", tx))
+        assert r == ("accepted",)
+        r = await cli.request("localtxsubmission", ("submit", b"junk"))
+        assert r[0] == "rejected"
+
+        # LocalTxMonitor sees the submitted tx
+        r = await cli.request("localtxmonitor", ("acquire",))
+        assert r[0] == "acquired"
+        r = await cli.request("localtxmonitor", ("next_tx",))
+        assert r[0] == "tx" and r[1] == tx
+
+        cli.close()
+        server.close()
+        await runtime.shutdown()
+
+    asyncio.run(run())
+
+
+def test_peer_discovery_over_tcp(tmp_path):
+    """PeerSharing mechanics over sockets: C dials relay R, learns the
+    forger F's address from R's sharing registry, dials F directly and
+    syncs — the discovery handoff the reference's P2P governor drives
+    (the governor itself lives in ouroboros-network, out of consensus
+    scope; consensus contributes the registry + the mini-protocol)."""
+
+    async def run():
+        runtime = AsyncRuntime()
+        forger = _mk_node(str(tmp_path), 0, forger=True)
+        relay = _mk_node(str(tmp_path), 1, forger=False)
+        edge = _mk_node(str(tmp_path), 2, forger=False)
+        for n in (forger, relay, edge):
+            n.chain_db.runtime = runtime
+
+        f_srv = await transport.serve_node(forger, runtime)
+        f_port = f_srv.sockets[0].getsockname()[1]
+        r_srv = await transport.serve_node(relay, runtime)
+        r_port = r_srv.sockets[0].getsockname()[1]
+
+        runtime.spawn(forger.forging_loop(60), "forge")
+        await transport.connect_node(relay, runtime, "127.0.0.1", f_port)
+        assert [("127.0.0.1"), f_port] in [
+            list(p) for p in relay.known_peers
+        ]
+
+        mux = await transport.connect_node(
+            edge, runtime, "127.0.0.1", r_port
+        )
+        ps_task = next(
+            t for t in mux.tasks if "peersharing" in t.get_name()
+        )
+        peers = await ps_task
+        assert ["127.0.0.1", f_port] in [list(p) for p in peers]
+
+        # act on the discovery: dial the forger directly and converge
+        host, port = peers[0]
+        await transport.connect_node(edge, runtime, host, port)
+        n = await _converged(edge, 55, timeout=20)
+        assert n >= 55, n
+
+        f_srv.close()
+        r_srv.close()
+        await runtime.shutdown()
+
+    asyncio.run(run())
+
+
+def test_n2c_wire_totality_and_disconnect(tmp_path):
+    """The wire codec is TOTAL: dataclass query results travel as
+    tagged field maps (never killing the server task), Mary values keep
+    their assets, and a dropped connection surfaces as ConnectionError
+    on the client instead of a hang."""
+    from ouroboros_consensus_tpu.ledger.mary import MaryValue
+    from ouroboros_consensus_tpu.node.transport import from_wire, to_wire
+
+    # round-trip the rich types the query surface produces
+    mv = MaryValue(70, {(b"p" * 28, b"tok"): 9})
+    back = from_wire(to_wire(mv))
+    assert isinstance(back, MaryValue) and int(back) == 70
+    assert back.asset_map() == {(b"p" * 28, b"tok"): 9}
+    from ouroboros_consensus_tpu.ledger.shelley import PParams
+
+    dumped = from_wire(to_wire(PParams()))
+    assert dumped["__type__"] == "PParams"
+    assert dumped["min_fee_a"] == PParams().min_fee_a
+    # the desperate fallback is lossy but non-fatal
+    assert from_wire(to_wire(object()))[0] == "opaque"
+
+    async def run():
+        runtime = AsyncRuntime()
+        node = _mk_node(str(tmp_path), 0, forger=False)
+        node.chain_db.runtime = runtime
+        server = await transport.serve_node_to_client(node, runtime)
+        port = server.sockets[0].getsockname()[1]
+        cli = await transport.LocalClient.connect(
+            runtime, "127.0.0.1", port
+        )
+        r = await cli.request("localstatequery", ("acquire", None))
+        assert r == ("acquired",)
+        # a dataclass-rich result crosses the wire as a tagged map
+        r = await cli.request(
+            "localstatequery", ("query", "get_utxo", ())
+        )
+        assert r[0] == "result" and len(r[1]) == 4
+        # drop the connection; an in-flight request must raise, not
+        # hang (the client's pump sees EOF and sets mux.closed)
+        server.close()
+        cli.mux.writer.close()
+        try:
+            await asyncio.wait_for(
+                cli.request("localstatequery", ("query", "get_utxo", ())),
+                timeout=5,
+            )
+            raise AssertionError("request should have failed")
+        except (ConnectionError, OSError):
+            pass  # ConnectionError from mux.closed, or the closed writer
+        except asyncio.TimeoutError:
+            raise AssertionError("request hung on a dead connection")
+        await runtime.shutdown()
+
+    asyncio.run(run())

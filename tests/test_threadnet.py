@@ -352,3 +352,79 @@ def test_shelley_era_network_under_lottery_and_txgen(tmp_path):
              if a[0].startswith(b"paid-")]
     assert spent, "TxGen never landed a pre-fork spend"
     assert all(s is not None for (_p, s) in spent)
+
+
+def test_three_era_network_mock_shelley_mary(tmp_path):
+    """A 3-era net crossing TWO genuine rule changes: mock -> Shelley
+    STS at epoch 2, Shelley -> Mary-class at epoch 4. A multi-asset
+    MINT tx injected after the second boundary validates under the Mary
+    rules, is forged, diffused and adopted by every node — and the SAME
+    wire bytes would be malformed under Shelley (the era really
+    changed)."""
+    from ouroboros_consensus_tpu.hardfork.combinator import HardForkBlock
+    from ouroboros_consensus_tpu.ledger import mary as mary_mod
+    from ouroboros_consensus_tpu.ledger.mary import MaryValue, policy_id
+    from ouroboros_consensus_tpu.ledger.shelley import ShelleyState
+    from ouroboros_consensus_tpu.ops.host import ed25519 as ed
+
+    policy_seed = b"\x5a" * 32
+    pid = policy_id(ed.secret_to_public(policy_seed))
+    # spend genesis output #7 (untouched by TxGen: tx_gen off), minting
+    # 42 "NET" into the new output — a MARY-format tx
+    genesis_in = (bytes(32), 7)
+    outs = [(b"mary-paid", None, MaryValue(100, {(pid, b"NET"): 42}))]
+    wit = mary_mod.make_mint_witness(
+        policy_seed, [genesis_in], outs, 0, (None, None), {b"NET": 42}
+    )
+    mint_tx = mary_mod.encode_tx([genesis_in], outs, mint=[wit])
+
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=3, n_slots=60, k=40, msg_delay=0.05,
+        active_slot_coeff=Fraction(1),
+        epoch_length=10,
+        # ONE forger: two forgers racing the same slot can strand the
+        # mint tx (the loser's mempool drops it when it momentarily
+        # adopts its own tx-block — reference-faithful: abandoned-block
+        # txs are not resurrected)
+        forgers=[0],
+        hard_fork_at_epoch=2,   # mock -> Shelley at slot 20
+        hf_shelley_era=True,
+        hf_mary_at_epoch=4,     # Shelley -> Mary at slot 40
+        tx_submission=True,
+        tx_injections=[(45, 0, mint_tx)],
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    assert res.chain_hashes(1) == res.chain_hashes(0) == res.chain_hashes(2)
+
+    eras = [b.era for b in res.chains[0] if isinstance(b, HardForkBlock)]
+    assert set(eras) == {0, 1, 2}, f"eras seen: {set(eras)}"
+
+    st = res.nodes[0].chain_db.current_ledger().ledger_state
+    assert st.era == 2 and isinstance(st.inner, ShelleyState)
+    # the minted asset landed and survived adoption on every node
+    minted = [
+        v for _a, v in st.inner.utxo.values()
+        if isinstance(v, MaryValue) and v.assets
+    ]
+    assert minted and minted[0].asset_map() == {(pid, b"NET"): 42}
+    for n in res.nodes[1:]:
+        st_i = n.chain_db.current_ledger().ledger_state
+        assert any(
+            getattr(v, "assets", ()) for _a, v in st_i.inner.utxo.values()
+        )
+    # era differentiation: the same bytes are REJECTED by the Shelley
+    # rules (malformed 7-element wire)
+    from ouroboros_consensus_tpu.ledger.shelley import (
+        ShelleyLedger, ShelleyTxError,
+    )
+    import pytest as _pytest
+
+    sh_led = ShelleyLedger(res.nodes[0].ledger.eras[1].ledger.genesis)
+    with _pytest.raises(ShelleyTxError):
+        sh_led.apply_tx(
+            sh_led.mempool_view(
+                sh_led.genesis_state([(b"x", None, 100)]), 1
+            ),
+            mint_tx,
+        )

@@ -137,7 +137,8 @@ def test_tutorial_runs():
     import subprocess
     import sys
 
-    for script in ("tutorials/simple_protocol.py", "tutorials/shelley_node.py"):
+    for script in ("tutorials/simple_protocol.py", "tutorials/shelley_node.py",
+                   "tutorials/cardano_node.py"):
         r = subprocess.run(
             [sys.executable, script],
             capture_output=True, text=True, timeout=240,
