@@ -75,6 +75,18 @@ class MaryValue(int):
     def asset_map(self) -> dict:
         return dict(self.assets)
 
+    def to_triples(self) -> list:
+        """Canonical flat wire form [[policy, name, qty]...] — THE one
+        asset codec (snapshot and transport codecs both consume it)."""
+        return [[pid, name, q] for (pid, name), q in self.assets]
+
+    @classmethod
+    def from_triples(cls, coin: int, triples) -> "MaryValue":
+        return cls(
+            int(coin),
+            {(bytes(p), bytes(n)): int(q) for p, n, q in triples},
+        )
+
     def __repr__(self):
         return f"MaryValue({int(self)}, {dict(self.assets)})"
 
@@ -200,6 +212,9 @@ class MaryLedger(ShelleyLedger):
     are INHERITED — the Mary era changes the value/tx layer only, like
     the reference's ShelleyMA eras sharing the Shelley rule family."""
 
+    # the inherited REAPPLY path must parse the Mary wire format
+    _decode_tx = staticmethod(decode_tx)
+
     # -- era translation INTO Mary ----------------------------------------
 
     def translate_from_shelley(self, prev: ShelleyState) -> ShelleyState:
@@ -268,17 +283,7 @@ class MaryLedger(ShelleyLedger):
                     minted[(pid, name)] = minted.get((pid, name), 0) + qty
 
         # scratch for certs/withdrawals — Shelley's machinery verbatim
-        scratch = TxView(
-            utxo=view.utxo,
-            stake_creds=dict(view.stake_creds),
-            rewards=dict(view.rewards),
-            delegations=dict(view.delegations),
-            pools=dict(view.pools),
-            pool_deposits=dict(view.pool_deposits),
-            retiring=dict(view.retiring),
-            proposals=dict(view.proposals),
-            pparams=view.pparams, epoch=view.epoch, slot=view.slot,
-        )
+        scratch = self._scratch_of(view)
         withdrawn = 0
         seen = set()
         for cred, amt in tx.withdrawals:
@@ -334,13 +339,5 @@ class MaryLedger(ShelleyLedger):
             del view.utxo[txin]
         for ix, (addr, val) in enumerate(tx.outs):
             view.utxo[(tid, ix)] = (addr, val)
-        view.stake_creds = scratch.stake_creds
-        view.rewards = scratch.rewards
-        view.delegations = scratch.delegations
-        view.pools = scratch.pools
-        view.pool_deposits = scratch.pool_deposits
-        view.retiring = scratch.retiring
-        view.proposals = scratch.proposals
-        view.deposit_delta += deposits_taken - refunds
-        view.fee_delta += tx.fee
+        self._commit_scratch(view, scratch, deposits_taken, refunds, tx.fee)
         return view

@@ -29,12 +29,18 @@ Wire format (deterministic CBOR, ../utils/cbor.py):
                reward_cred, [owner_cred...]]  -- pool registration/update
           | [4, pool_id, epoch]           -- pool retirement
           | [5, proposer_id, {pparam: value}] -- pparam update proposal
+          | [6, pot, proposer_id, [[cred, amount]...]]
+               -- MIR (move instantaneous rewards): pot 0 = reserves,
+                  1 = treasury; genesis-delegate-proposed; applied at
+                  the NEXT epoch boundary (later certs override earlier
+                  same-(pot, cred) allocations, the reference's MIR
+                  combining rule)
   withdrawal = [cred, coin]   (must withdraw the FULL reward balance)
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, replace
+from dataclasses import dataclass, field, replace
 from fractions import Fraction
 from typing import Mapping
 
@@ -262,6 +268,11 @@ class ShelleyState:
     proposals: Mapping[bytes, tuple]  # proposer -> sorted pparam updates
     epoch: int
     tip_slot_: int | None = None
+    # MIR allocations awaiting the boundary: (pot, cred) -> amount
+    # (pot 0 = reserves, 1 = treasury)
+    pending_mir: Mapping[tuple[int, bytes], int] = field(
+        default_factory=dict
+    )
 
 
 @dataclass(frozen=True)
@@ -288,6 +299,10 @@ class TxView:
     slot: int
     deposit_delta: int = 0
     fee_delta: int = 0
+    pending_mir: dict = field(default_factory=dict)
+    # pot balances the MIR rule guards against (read-only in the rules)
+    reserves: int = 0
+    treasury: int = 0
 
 
 def total_ada(gen: ShelleyGenesis, st: ShelleyState) -> int:
@@ -479,6 +494,35 @@ class ShelleyLedger:
                 )
             v.retiring[pid] = epoch
             return 0, 0
+        if tag == 6:  # MIR — move instantaneous rewards
+            pot, proposer = int(cert[1]), bytes(cert[2])
+            if pot not in (0, 1):
+                raise ShelleyTxError(f"MIR pot must be 0 or 1: {pot}")
+            if proposer not in self.genesis.genesis_delegates:
+                raise ShelleyTxError(
+                    f"MIR proposer is not a genesis delegate: "
+                    f"{proposer.hex()[:8]}"
+                )
+            allocs: dict[bytes, int] = {}
+            for cred, amt in cert[3]:
+                if int(amt) <= 0:
+                    raise ShelleyTxError("non-positive MIR amount")
+                allocs[bytes(cred)] = int(amt)
+            # guard the pot: all pending allocations to this pot (with
+            # this cert's overrides applied) must fit its balance
+            merged = {
+                c: a for (p, c), a in v.pending_mir.items() if p == pot
+            }
+            merged.update(allocs)
+            balance = v.reserves if pot == 0 else v.treasury
+            if sum(merged.values()) > balance:
+                raise ShelleyTxError(
+                    f"MIR over-allocates pot {pot}: "
+                    f"{sum(merged.values())} > {balance}"
+                )
+            for cred, amt in allocs.items():
+                v.pending_mir[(pot, cred)] = amt
+            return 0, 0
         if tag == 5:  # pparam update proposal (PPUP)
             proposer, upd = bytes(cert[1]), cert[2]
             if proposer not in self.genesis.genesis_delegates:
@@ -494,6 +538,38 @@ class ShelleyLedger:
             ))
             return 0, 0
         raise ShelleyTxError(f"unknown certificate tag: {tag!r}")
+
+    @staticmethod
+    def _scratch_of(view: TxView) -> TxView:
+        """The certs/withdrawals scratch copy (shared with the Mary
+        subclass so a new TxView field can never diverge between eras)."""
+        return TxView(
+            utxo=view.utxo,  # utxo itself is only read until commit
+            stake_creds=dict(view.stake_creds),
+            rewards=dict(view.rewards),
+            delegations=dict(view.delegations),
+            pools=dict(view.pools),
+            pool_deposits=dict(view.pool_deposits),
+            retiring=dict(view.retiring),
+            proposals=dict(view.proposals),
+            pparams=view.pparams, epoch=view.epoch, slot=view.slot,
+            pending_mir=dict(view.pending_mir),
+            reserves=view.reserves, treasury=view.treasury,
+        )
+
+    @staticmethod
+    def _commit_scratch(view: TxView, scratch: TxView,
+                        deposits_taken: int, refunds: int, fee: int) -> None:
+        view.stake_creds = scratch.stake_creds
+        view.rewards = scratch.rewards
+        view.delegations = scratch.delegations
+        view.pools = scratch.pools
+        view.pool_deposits = scratch.pool_deposits
+        view.retiring = scratch.retiring
+        view.proposals = scratch.proposals
+        view.pending_mir = scratch.pending_mir
+        view.deposit_delta += deposits_taken - refunds
+        view.fee_delta += fee
 
     def apply_tx(self, view: TxView, tx_bytes: bytes) -> TxView:
         """Full UTXOW/UTXO/DELEGS/POOL validation; mutates `view` only
@@ -522,17 +598,7 @@ class ShelleyLedger:
 
         # run certs/withdrawals against a scratch copy so a late rule
         # failure can't leave the view half-mutated
-        scratch = TxView(
-            utxo=view.utxo,  # utxo itself is only read until commit
-            stake_creds=dict(view.stake_creds),
-            rewards=dict(view.rewards),
-            delegations=dict(view.delegations),
-            pools=dict(view.pools),
-            pool_deposits=dict(view.pool_deposits),
-            retiring=dict(view.retiring),
-            proposals=dict(view.proposals),
-            pparams=view.pparams, epoch=view.epoch, slot=view.slot,
-        )
+        scratch = self._scratch_of(view)
         # withdrawals BEFORE certificates (the DELEGS rule applies the
         # wdrls in its base case, so withdraw-and-deregister in one tx is
         # valid — the cert's zero-rewards check sees the drained account)
@@ -578,15 +644,7 @@ class ShelleyLedger:
             del view.utxo[txin]
         for ix, (addr, coin) in enumerate(tx.outs):
             view.utxo[(tid, ix)] = (addr, coin)
-        view.stake_creds = scratch.stake_creds
-        view.rewards = scratch.rewards
-        view.delegations = scratch.delegations
-        view.pools = scratch.pools
-        view.pool_deposits = scratch.pool_deposits
-        view.retiring = scratch.retiring
-        view.proposals = scratch.proposals
-        view.deposit_delta += deposits_taken - refunds
-        view.fee_delta += tx.fee
+        self._commit_scratch(view, scratch, deposits_taken, refunds, tx.fee)
         return view
 
     # -- Mempool seam ------------------------------------------------------
@@ -604,6 +662,9 @@ class ShelleyLedger:
             pparams=state.pparams,
             epoch=state.epoch,
             slot=slot,
+            pending_mir=dict(state.pending_mir),
+            reserves=state.reserves,
+            treasury=state.treasury,
         )
 
     # -- epoch boundary (TICK -> NEWEPOCH) ---------------------------------
@@ -755,10 +816,37 @@ class ShelleyLedger:
             pparams = pparams.with_updates(dict(winner))
         return replace(st, pparams=pparams, proposals={})
 
+    def _apply_mir(self, st: ShelleyState) -> ShelleyState:
+        """Apply pending MIR allocations (the reference's MIR rule at
+        the boundary tick): funds move pot -> registered reward
+        accounts; allocations to unregistered credentials (or exceeding
+        the pot, possible if the pot shrank since the cert) stay put."""
+        if not st.pending_mir:
+            return st
+        rewards = dict(st.rewards)
+        reserves, treasury = st.reserves, st.treasury
+        for (pot, cred), amt in sorted(st.pending_mir.items()):
+            if cred not in st.stake_creds:
+                continue
+            if pot == 0:
+                if amt > reserves:
+                    continue
+                reserves -= amt
+            else:
+                if amt > treasury:
+                    continue
+                treasury -= amt
+            rewards[cred] = rewards.get(cred, 0) + amt
+        return replace(
+            st, rewards=rewards, reserves=reserves, treasury=treasury,
+            pending_mir={},
+        )
+
     def _new_epoch(self, st: ShelleyState, epoch: int) -> ShelleyState:
         """One boundary crossing, in the reference's NEWEPOCH order:
-        rewards (from GO + prev blocks), snapshot rotation, pool reap,
-        pparam adoption."""
+        MIR application, rewards (from GO + prev blocks), snapshot
+        rotation, pool reap, pparam adoption."""
+        st = self._apply_mir(st)
         st = self._reward_update(st)
         st = replace(
             st,
@@ -817,11 +905,16 @@ class ShelleyLedger:
             pool_deposits=view.pool_deposits,
             retiring=view.retiring,
             proposals=view.proposals,
+            pending_mir=view.pending_mir,
             fees=st.fees + view.fee_delta,
             deposits=st.deposits + view.deposit_delta,
             tip_slot_=ticked.slot,
         )
         return self._count_block(st, block)
+
+    # tx-layer decode seam: era subclasses (Mary) override so the
+    # REAPPLY path parses their wire format too
+    _decode_tx = staticmethod(decode_tx)
 
     def reapply_block(self, ticked: TickedShelleyState, block) -> ShelleyState:
         """Previously validated: replay the value movements without the
@@ -829,7 +922,7 @@ class ShelleyLedger:
         st = ticked.state
         view = self.mempool_view(st, ticked.slot)
         for tx_bytes in block.txs:
-            tx = decode_tx(tx_bytes)
+            tx = self._decode_tx(tx_bytes)
             tid = tx_id(tx_bytes)
             for txin in tx.ins:
                 view.utxo.pop(txin, None)
@@ -856,6 +949,7 @@ class ShelleyLedger:
             pool_deposits=view.pool_deposits,
             retiring=view.retiring,
             proposals=view.proposals,
+            pending_mir=view.pending_mir,
             fees=st.fees + view.fee_delta,
             deposits=st.deposits + view.deposit_delta,
             tip_slot_=ticked.slot,

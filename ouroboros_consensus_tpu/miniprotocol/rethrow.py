@@ -12,10 +12,15 @@ from __future__ import annotations
 
 from .blockfetch import InvalidBlockFromPeer
 from .chainsync import ChainSyncClientException
+from .txsubmission import KeepAliveTimeout
 
 # exceptions that condemn the PEER, not the node (ouroboros-consensus
 # maps these to ShutdownPeer in consensusRethrowPolicy)
-PEER_DISCONNECT_EXCEPTIONS = (ChainSyncClientException, InvalidBlockFromPeer)
+PEER_DISCONNECT_EXCEPTIONS = (
+    ChainSyncClientException,
+    InvalidBlockFromPeer,
+    KeepAliveTimeout,
+)
 
 
 def peer_guard(gen, name: str, trace, on_disconnect=None):

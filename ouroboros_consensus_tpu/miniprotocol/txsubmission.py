@@ -101,13 +101,28 @@ def inbound(node, peer_name: str, rx, tx, *, max_rounds: int | None = None,
 # -- KeepAlive ---------------------------------------------------------------
 
 
-def keepalive_client(rx, tx, *, interval: float = 1.0, rounds: int = 10):
-    """Sends a numbered cookie every `interval`; yields nothing to the
-    caller but records RTTs on itself via the returned list (closure)."""
+class KeepAliveTimeout(Exception):
+    """The peer missed the KeepAlive response deadline — a
+    peer-disconnect violation (the reference's KeepAlive agency timeout
+    tears the connection down via the mux)."""
+
+
+def keepalive_client(rx, tx, *, interval: float = 1.0, rounds: int = 10,
+                     timeout: float = 10.0):
+    """Sends a numbered cookie every `interval` and DEMANDS the echo
+    within `timeout` — a missed deadline raises KeepAliveTimeout, which
+    peer_guard classifies as a connection teardown (the reference's
+    keep-alive timeout semantics)."""
+    from ..utils.sim import TIMEOUT, RecvTimeout
+
     rtts: list[float] = []
     for cookie in range(rounds):
         yield Send(tx, ("keepalive", cookie))
-        msg = yield Recv(rx)
+        msg = yield RecvTimeout(rx, timeout)
+        if msg is TIMEOUT:
+            raise KeepAliveTimeout(
+                f"no keepalive response within {timeout}s (cookie {cookie})"
+            )
         if msg[0] != "keepalive_response" or msg[1] != cookie:
             raise RuntimeError(f"keepalive: bad response {msg!r}")
         rtts.append(1.0)  # sim has no task-local clock; presence = liveness

@@ -43,7 +43,7 @@ def to_wire(obj) -> Any:
     import dataclasses
     from fractions import Fraction
 
-    if obj is None or isinstance(obj, (bytes, str, bool)):
+    if obj is None or isinstance(obj, (bytes, str, bool, float)):
         return obj
     if isinstance(obj, Point):
         return ["pt", obj.slot, obj.hash_]
@@ -51,19 +51,20 @@ def to_wire(obj) -> Any:
         return ["vd", obj.network_magic]
     if isinstance(obj, Fraction):
         return ["fr", obj.numerator, obj.denominator]
-    try:
-        from ..ledger.mary import MaryValue
-    except ImportError:  # pragma: no cover
-        MaryValue = ()
-    if MaryValue and isinstance(obj, MaryValue):
-        return ["mv", int(obj),
-                [[pid, name, q] for (pid, name), q in obj.assets]]
+    from ..ledger.mary import MaryValue
+
+    if isinstance(obj, MaryValue):
+        return ["mv", int(obj), obj.to_triples()]
     if isinstance(obj, int):
         return obj
     if isinstance(obj, dict):
         return ["map", [[to_wire(k), to_wire(v)] for k, v in obj.items()]]
     if isinstance(obj, (set, frozenset)):
-        return ["set", [to_wire(x) for x in sorted(obj)]]
+        try:
+            members = sorted(obj)
+        except TypeError:  # unorderable mix: deterministic repr order
+            members = sorted(obj, key=repr)
+        return ["set", [to_wire(x) for x in members]]
     if isinstance(obj, (list, tuple)):
         return [to_wire(x) for x in obj]
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
@@ -87,9 +88,7 @@ def from_wire(obj) -> Any:
         if len(obj) == 3 and obj[0] == "mv":
             from ..ledger.mary import MaryValue
 
-            return MaryValue(
-                obj[1], {(bytes(p), bytes(n)): q for p, n, q in obj[2]}
-            )
+            return MaryValue.from_triples(obj[1], obj[2])
         if len(obj) == 2 and obj[0] == "map" and isinstance(obj[1], list):
             return {from_wire(k): from_wire(v) for k, v in obj[1]}
         if len(obj) == 2 and obj[0] == "set" and isinstance(obj[1], list):

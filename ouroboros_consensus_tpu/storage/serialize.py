@@ -150,11 +150,11 @@ def _dec_pparams(o):
 
 def _enc_value(v):
     """UTxO value column: plain coin stays a bare int (golden-stable);
-    a Mary multi-asset value becomes [coin, [[policy, name, qty]...]]."""
-    assets = getattr(v, "assets", ())
-    if not assets:
+    a Mary multi-asset value becomes [coin, MaryValue.to_triples()] —
+    the canonical asset flattening lives on MaryValue itself."""
+    if not getattr(v, "assets", ()):
         return int(v)
-    return [int(v), [[pid, name, q] for (pid, name), q in assets]]
+    return [int(v), v.to_triples()]
 
 
 def _dec_value(o):
@@ -162,11 +162,8 @@ def _dec_value(o):
         return o
     from ..ledger.mary import MaryValue
 
-    coin, assets = o
-    return MaryValue(
-        int(coin),
-        {(bytes(p), bytes(n)): int(q) for p, n, q in assets},
-    )
+    coin, triples = o
+    return MaryValue.from_triples(coin, triples)
 
 
 def encode_shelley_state(st) -> list:
@@ -196,6 +193,7 @@ def encode_shelley_state(st) -> list:
         ),
         st.epoch,
         st.tip_slot_,
+        sorted([p, c, a] for (p, c), a in st.pending_mir.items()),
     ]
 
 
@@ -235,6 +233,11 @@ def decode_shelley_state(o):
         },
         epoch=int(o[19]),
         tip_slot_=o[20],
+        # round-3 snapshots predate MIR: tolerate the shorter list
+        pending_mir=(
+            {(int(p), bytes(c)): int(a) for p, c, a in o[21]}
+            if len(o) > 21 else {}
+        ),
     )
 
 

@@ -22,7 +22,19 @@ import asyncio
 import time
 from typing import Any, Generator
 
-from .sim import Channel, Event, Fire, Recv, Send, Sleep, Spawn, Stop, Wait
+from .sim import (
+    TIMEOUT,
+    Channel,
+    Event,
+    Fire,
+    Recv,
+    RecvTimeout,
+    Send,
+    Sleep,
+    Spawn,
+    Stop,
+    Wait,
+)
 
 
 class AsyncRuntime:
@@ -100,6 +112,13 @@ class AsyncRuntime:
                     await asyncio.sleep(eff.dt)
                 elif isinstance(eff, Recv):
                     value = await self._q(eff.chan).get()
+                elif isinstance(eff, RecvTimeout):
+                    try:
+                        value = await asyncio.wait_for(
+                            self._q(eff.chan).get(), eff.dt
+                        )
+                    except asyncio.TimeoutError:
+                        value = TIMEOUT
                 elif isinstance(eff, Send):
                     self.send(eff.chan, eff.msg)
                 elif isinstance(eff, Wait):

@@ -47,6 +47,24 @@ class Recv:
 
 
 @dataclass(frozen=True)
+class RecvTimeout:
+    """Recv that resumes with the TIMEOUT sentinel after `dt` if no
+    message arrived — the typed-protocols timeout analog (the reference
+    enforces e.g. the KeepAlive response deadline this way)."""
+
+    chan: "Channel"
+    dt: float
+
+
+class _Timeout:
+    def __repr__(self):
+        return "TIMEOUT"
+
+
+TIMEOUT = _Timeout()  # the RecvTimeout sentinel (identity-compared)
+
+
+@dataclass(frozen=True)
 class Send:
     chan: "Channel"
     msg: Any
@@ -108,6 +126,7 @@ class Task:
     gen: Generator
     alive: bool = True
     result: Any = None
+    wait_seq: int = 0  # identifies the CURRENT park (stale-timeout guard)
 
 
 class Sim:
@@ -201,7 +220,7 @@ class Sim:
 
         if isinstance(eff, Sleep):
             self._schedule(self.now + eff.dt, task)
-        elif isinstance(eff, Recv):
+        elif isinstance(eff, (Recv, RecvTimeout)):
             chan = eff.chan
             if chan._ready and chan._ready[0][0] <= self.now and not chan._waiters:
                 _, _, msg = heapq.heappop(chan._ready)
@@ -210,8 +229,15 @@ class Sim:
                 # earlier receivers are queued: join the FIFO behind them
                 # (a due message must not let a latecomer jump the queue)
                 chan._waiters.append(task)
+                task.wait_seq = self._next_seq()
                 if chan._ready:  # in-flight message: wake at its due time
                     self._schedule_delivery(chan._ready[0][0], chan)
+                if isinstance(eff, RecvTimeout):
+                    seq = self._next_seq()
+                    heapq.heappush(self._runq, (
+                        self.now + eff.dt, self._order_key(seq), seq,
+                        "timeout", (chan, task, task.wait_seq),
+                    ))
         elif isinstance(eff, Send):
             due = self.now + eff.chan.delay
             heapq.heappush(eff.chan._ready, (due, self._next_seq(), eff.msg))
@@ -246,6 +272,16 @@ class Sim:
             self.now = max(self.now, t)
             if kind == "deliver":
                 self._flush_channel(payload)
+                continue
+            if kind == "timeout":
+                chan, task, wait_seq = payload
+                # fire only if the task is STILL in this very park (a
+                # delivered message, or a later re-park on the same
+                # channel, invalidates the timer)
+                if (task.alive and task.wait_seq == wait_seq
+                        and task in chan._waiters):
+                    chan._waiters.remove(task)
+                    self._schedule(self.now, task, TIMEOUT)
                 continue
             task, value = payload
             self._step(task, value)

@@ -228,3 +228,24 @@ def test_mary_inherits_shelley_certs_and_epochs():
     st3 = led.tick(st2, 100).state
     assert st3.epoch == 1
     assert st3.mark.stake.get(stake_cred, 0) > 0
+
+
+def test_mary_reapply_parses_mary_wire():
+    """REAPPLY (the LedgerDB fast path for previously-validated blocks:
+    fork-switch replay, crash recovery) must parse the MARY wire format
+    — the inherited Shelley reapply decoding Mary txs was a crash
+    (round-4 review finding)."""
+    led = _ledger()
+    st = _state(led)
+    pid = policy_id(ed.secret_to_public(POLICY_SEED))
+    outs = [(BOB, None, MaryValue(1_000, {(pid, b"tok"): 5}))]
+    wit = make_mint_witness(
+        POLICY_SEED, [GENESIS_IN], outs, 0, (None, None), {b"tok": 5}
+    )
+    tx = encode_tx([GENESIS_IN], outs, mint=[wit])
+    blk = _Blk(3, [tx])
+    applied = led.apply_block(led.tick(st, 3), blk)
+    reapplied = led.reapply_block(led.tick(st, 3), blk)
+    assert dict(reapplied.utxo) == dict(applied.utxo)
+    (val,) = [v for _a, v in reapplied.utxo.values()]
+    assert isinstance(val, MaryValue) and val.asset_map() == {(pid, b"tok"): 5}

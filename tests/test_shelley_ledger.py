@@ -564,3 +564,81 @@ def test_inspect_events_on_proposals_and_adoption():
     assert adopted and adopted[0].changed == (
         ("min_fee_b", PP.min_fee_b, 9),
     )
+
+
+def test_mir_certificates():
+    """MIR (move instantaneous rewards): genesis-delegate-proposed
+    transfers from reserves/treasury to reward accounts, applied at the
+    NEXT epoch boundary; later certs override same-(pot, cred) ones;
+    over-allocation and non-delegate proposers are rejected."""
+    gd = b"GD0" + b"\x00" * 25
+    g, led, st = genesis(
+        [(pay(0), cred(0), 10_000)], genesis_delegates=(gd,),
+    )
+    # register the target credential
+    # fee must cover the linear min fee (a=1/byte, b=10)
+    tx = sh.encode_tx(
+        [(bytes(32), 0)],
+        [(pay(0), cred(0), 10_000 - PP.key_deposit - 500)],
+        fee=500, certs=[(0, cred(0))],
+    )
+    st = apply_txs(led, st, 1, tx)
+    assert cred(0) in st.stake_creds
+
+    reserves0 = st.reserves
+    # two MIR certs: the second overrides the first's allocation
+    out = next(k for k in st.utxo)
+    coin = st.utxo[out][1]
+    tx2 = sh.encode_tx(
+        [out], [(pay(0), cred(0), coin - 500)], fee=500,
+        certs=[
+            (6, 0, gd, [[cred(0), 500]]),
+            (6, 0, gd, [[cred(0), 700]]),
+        ],
+    )
+    st = apply_txs(led, st, 2, tx2)
+    assert st.pending_mir == {(0, cred(0)): 700}
+    assert st.rewards[cred(0)] == 0  # nothing moves until the boundary
+
+    # boundary: funds move reserves -> reward account
+    st2 = led.tick(st, EPOCH + 1).state
+    assert st2.rewards[cred(0)] == 700
+    assert st2.pending_mir == {}
+    assert st2.reserves < reserves0
+    assert sh.total_ada(g, st2) == g.max_supply
+
+    # rejections: non-delegate proposer, bad pot, over-allocation
+    out = next(k for k in st2.utxo)
+    coin = st2.utxo[out][1]
+    for bad_cert in (
+        (6, 0, cred(0), [[cred(0), 5]]),       # not a genesis delegate
+        (6, 7, gd, [[cred(0), 5]]),            # bad pot
+        (6, 1, gd, [[cred(0), st2.treasury + 1]]),  # over-allocates
+        (6, 0, gd, [[cred(0), 0]]),            # non-positive
+    ):
+        tx_bad = sh.encode_tx(
+            [out], [(pay(0), cred(0), coin - 500)], fee=500,
+            certs=[bad_cert],
+        )
+        with pytest.raises(sh.ShelleyTxError):
+            apply_txs(led, st2, EPOCH + 2, tx_bad)
+
+
+def test_mir_to_unregistered_cred_stays_in_pot():
+    gd = b"GD0" + b"\x00" * 25
+    g, led, st = genesis(
+        [(pay(0), None, 10_000)], genesis_delegates=(gd,),
+    )
+    out = next(k for k in st.utxo)
+    tx = sh.encode_tx(
+        [out], [(pay(0), None, 10_000 - 500)], fee=500,
+        certs=[(6, 0, gd, [[cred(9), 500]])],  # cred(9) never registered
+    )
+    st = apply_txs(led, st, 1, tx)
+    reserves0 = st.reserves
+    st2 = led.tick(st, EPOCH + 1).state
+    # the allocation lapses: reserves keep the funds (modulo the epoch's
+    # ordinary monetary expansion, which moves rho*reserves elsewhere)
+    assert cred(9) not in st2.rewards
+    assert st2.pending_mir == {}
+    assert sh.total_ada(g, st2) == g.max_supply

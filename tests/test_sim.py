@@ -175,3 +175,77 @@ def test_seeded_schedule_exploration():
     # every interleaving is fair: each worker still ran 3 times
     for o in seeds.values():
         assert sorted(o) == sorted(baseline)
+
+
+def test_recv_timeout_effect():
+    """RecvTimeout: resumes with TIMEOUT when nothing arrives; delivers
+    the message when it does; a stale timer never fires into a LATER
+    park on the same channel."""
+    from ouroboros_consensus_tpu.utils.sim import (
+        TIMEOUT, Channel, Recv, RecvTimeout, Send, Sim, Sleep,
+    )
+
+    log = []
+
+    def consumer(ch):
+        got = yield RecvTimeout(ch, 1.0)
+        log.append(("first", got is TIMEOUT))
+        # second park on the SAME channel: the first timer (still in the
+        # run queue if it lost the race) must not fire into this one
+        got = yield RecvTimeout(ch, 5.0)
+        log.append(("second", got))
+
+    def producer(ch):
+        yield Sleep(2.0)
+        yield Send(ch, "late")
+
+    sim = Sim()
+    ch = Channel()
+    sim.spawn(consumer(ch), "c")
+    sim.spawn(producer(ch), "p")
+    sim.run()
+    assert log == [("first", True), ("second", "late")]
+
+    # timely delivery: no timeout
+    log2 = []
+
+    def consumer2(ch):
+        got = yield RecvTimeout(ch, 5.0)
+        log2.append(got)
+
+    def producer2(ch):
+        yield Sleep(0.5)
+        yield Send(ch, "ontime")
+
+    sim = Sim()
+    ch = Channel()
+    sim.spawn(consumer2(ch), "c")
+    sim.spawn(producer2(ch), "p")
+    sim.run()
+    assert log2 == ["ontime"]
+
+
+def test_keepalive_timeout_disconnects_peer():
+    """A silent keepalive server trips KeepAliveTimeout, classified as
+    a PEER disconnect by peer_guard (RethrowPolicy parity)."""
+    from ouroboros_consensus_tpu.miniprotocol import txsubmission
+    from ouroboros_consensus_tpu.miniprotocol.rethrow import peer_guard
+    from ouroboros_consensus_tpu.utils.sim import Channel, Recv, Sim
+
+    sim = Sim()
+    rx, tx = Channel(), Channel()
+    disconnected = []
+
+    def dead_server():
+        yield Recv(tx)  # swallow the cookie, never answer
+
+    sim.spawn(dead_server(), "dead")
+    sim.spawn(
+        peer_guard(
+            txsubmission.keepalive_client(rx, tx, timeout=3.0),
+            "ka", lambda s: None, lambda: disconnected.append(True),
+        ),
+        "ka",
+    )
+    sim.run(until=20)
+    assert disconnected == [True]
