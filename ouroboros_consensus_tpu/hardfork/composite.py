@@ -80,12 +80,12 @@ class CardanoMockConfig:
     pbft_threshold: Fraction = Fraction(4, 5)
     shelley_initial_nonce: bytes = b"\x0b" * 32
     # LEDGERS IN THE LOOP: era 0 = real Byron-class UTxO+delegation
-    # ledger, era 1 = real Shelley STS, era 2 = Mary-class multi-asset
-    # rules — synthesize forges real value-moving txs and revalidate
-    # folds every block through the era ledgers (the reference's
-    # db-analyser always replays the real ledger; here it is opt-in so
-    # the consensus-only bench path stays unchanged). Requires the
-    # Byron era to end exactly on a Shelley epoch boundary.
+    # ledger, era 1 = real Shelley STS, eras 2+ = Mary-class multi-asset
+    # rules (each with ITS era's epoch length via the era-relative
+    # ShelleyGenesis) — synthesize forges real value-moving txs and
+    # revalidate folds every block through the era ledgers (the
+    # reference's db-analyser always replays the real ledger; opt-in so
+    # the consensus-only bench path stays unchanged).
     with_ledgers: bool = False
 
 
@@ -247,15 +247,7 @@ class CardanoMock:
         )
 
         cfg = self.cfg
-        if cfg.conway_epochs is not None:
-            raise ValueError("with_ledgers covers the 3-era composite")
         shelley_start = self.summary.eras[1].start.slot
-        if shelley_start % cfg.epoch_length != 0:
-            raise ValueError(
-                f"with_ledgers: Byron must end on a Shelley epoch "
-                f"boundary (era start {shelley_start}, epoch_length "
-                f"{cfg.epoch_length})"
-            )
         self.byron_ledger = ByronLedger(ByronGenesis(
             pparams=ByronPParams(
                 min_fee_a=self.LEDGER_BYRON_FEE, min_fee_b=0
@@ -264,13 +256,23 @@ class CardanoMock:
             epoch_length=cfg.byron_epoch_length,
             security_param=cfg.k,
         ))
-        sh_gen = ShelleyGenesis(
-            pparams=ShPParams(min_fee_a=0, min_fee_b=0),
-            epoch_length=cfg.epoch_length,
-            stability_window=3 * cfg.k,
+
+        def era_genesis(era_ix: int, epoch_length: int) -> ShelleyGenesis:
+            # era-relative epoch arithmetic from the HFC Summary bound
+            # (the reference hands the ledger an EpochInfo the same way)
+            bound = self.summary.eras[era_ix].start
+            return ShelleyGenesis(
+                pparams=ShPParams(min_fee_a=0, min_fee_b=0),
+                epoch_length=epoch_length,
+                stability_window=3 * cfg.k,
+                era_start_slot=bound.slot,
+                era_start_epoch=bound.epoch,
+            )
+
+        self.shelley_ledger = ShelleyLedger(
+            era_genesis(1, cfg.epoch_length)
         )
-        self.shelley_ledger = ShelleyLedger(sh_gen)
-        self.mary_ledger = MaryLedger(sh_gen)
+        self.mary_ledger = MaryLedger(era_genesis(2, cfg.epoch_length))
         ledger_eras = [
             replace(self.eras[0], ledger=self.byron_ledger),
             replace(
@@ -292,6 +294,20 @@ class CardanoMock:
                 translate_tx=mary_mod.translate_tx_from_shelley,
             ),
         ]
+        # 4th/5th eras: Mary-class rules under the era's OWN epoch
+        # length (the era-relative genesis makes a mid-chain epoch-length
+        # change sound); the state carries over verbatim — what changes
+        # is the rules' clock, like the reference's later-era steps
+        for ix in range(3, len(self.eras)):
+            ln = (cfg.conway_epoch_length if ix == 3
+                  else cfg.leios_epoch_length)
+            led = MaryLedger(era_genesis(ix, ln))
+            ledger_eras.append(replace(
+                self.eras[ix],
+                ledger=led,
+                translate_ledger_state=lambda st: st,
+                translate_tx=lambda tx: tx,
+            ))
         self.eras = ledger_eras
         self.hf = HardForkProtocol(self.eras, self.summary)
         self.hf_ledger = HardForkLedger(self.eras, self.summary)

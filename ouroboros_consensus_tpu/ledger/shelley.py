@@ -243,6 +243,24 @@ class ShelleyGenesis:
     # reserves/rewards); anything not in the genesis utxo starts in
     # reserves, funding monetary expansion
     max_supply: int = 45_000_000_000_000_000
+    # ERA-RELATIVE epoch arithmetic (the reference ledger receives
+    # EpochInfo from the HFC summary, never computes slot//length
+    # globally): this era starts at `era_start_slot`, which is epoch
+    # number `era_start_epoch` of the chain — a mid-chain era whose
+    # epoch length differs from its predecessors sets both from the
+    # HFC Summary bound. Defaults preserve the standalone (slot 0,
+    # epoch 0) behavior.
+    era_start_slot: int = 0
+    era_start_epoch: int = 0
+
+    def epoch_of_slot(self, slot: int) -> int:
+        return (
+            self.era_start_epoch
+            + (slot - self.era_start_slot) // self.epoch_length
+        )
+
+    def is_epoch_boundary(self, slot: int) -> bool:
+        return (slot - self.era_start_slot) % self.epoch_length == 0
 
 
 @dataclass(frozen=True)
@@ -402,10 +420,11 @@ class ShelleyLedger:
         snapshots seal the carried-over distribution — elections in the
         first Shelley epochs run on it, just as the reference bootstraps
         from sgStaking across the Byron boundary."""
-        if at_slot % self.genesis.epoch_length != 0:
+        if not self.genesis.is_epoch_boundary(at_slot):
             raise ValueError(
                 f"era boundary slot {at_slot} must start a Shelley epoch "
-                f"(epoch_length={self.genesis.epoch_length})"
+                f"(epoch_length={self.genesis.epoch_length}, era start "
+                f"{self.genesis.era_start_slot})"
             )
         stake_fn = stake_of if stake_of is not None else (lambda _a: None)
         st = self.genesis_state(
@@ -422,7 +441,7 @@ class ShelleyLedger:
         st = replace(
             st, utxo=utxo,
             reserves=self.genesis.max_supply - circulating,
-            epoch=at_slot // self.genesis.epoch_length,
+            epoch=self.genesis.epoch_of_slot(at_slot),
             tip_slot_=getattr(prev_state, "tip_slot_", None),
         )
         snap = self._stake_distr(st)
@@ -863,7 +882,7 @@ class ShelleyLedger:
         return self._adopt_pparams(st)
 
     def tick(self, state: ShelleyState, slot: int) -> TickedShelleyState:
-        e_now = slot // self.genesis.epoch_length
+        e_now = self.genesis.epoch_of_slot(slot)
         st = state
         while st.epoch < e_now:
             st = self._new_epoch(st, st.epoch + 1)

@@ -674,7 +674,39 @@ def main(argv=None) -> None:
     p.add_argument("--config", default=None,
                    help="node config.json (defaults to <db>/config/config.json "
                         "when present) instead of --pools/--kes-depth")
+    p.add_argument("--cardano", action="store_true",
+                   help="the DB holds the multi-era composite "
+                        "(DBAnalyser/Block/Cardano.hs dispatch): "
+                        "era-tagged blocks, per-era protocols, optional "
+                        "full ledger replay (--with-ledgers)")
+    p.add_argument("--with-ledgers", action="store_true",
+                   help="with --cardano: fold the real era ledgers too")
     a = p.parse_args(argv)
+    if a.cardano:
+        # block-type dispatch to the composite (the reference's
+        # db-analyser picks the block type from the node config;
+        # the composite's defaults mirror CardanoMockConfig)
+        import json as _json
+
+        from ..hardfork import composite as cardano
+
+        if a.analysis != "only-validation":
+            raise SystemExit("--cardano supports only-validation")
+        if a.config is not None:
+            # an ignored config would revalidate under WRONG parameters
+            # and report spurious errors — refuse loudly instead
+            raise SystemExit(
+                "--cardano reads the composite's built-in config "
+                "(CardanoMockConfig defaults); --config is not supported"
+            )
+        cfg = cardano.CardanoMockConfig(with_ledgers=a.with_ledgers)
+        res = cardano.revalidate(a.db, cfg, backend=a.backend)
+        print(_json.dumps({
+            "blocks": res.n_blocks, "valid": res.n_valid,
+            "per_era": res.per_era,
+            "error": None if res.error is None else repr(res.error),
+        }))
+        return
     if a.analysis == "count-blocks":
         print(count_blocks(a.db))
         return

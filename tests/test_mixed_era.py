@@ -340,3 +340,75 @@ def test_ledger_backed_revalidate_reports_ledger_error(tmp_path):
     res = composite.revalidate(path, LEDGER_CFG, backend="host")
     assert isinstance(res.error, ByronInvalidWitness), repr(res.error)
     assert res.final_ledger_state is not None
+
+
+def test_five_era_ledger_backed_chain(tmp_path):
+    """ALL FIVE eras with real ledgers: Byron UTxO -> Shelley STS ->
+    Mary-class x3, where Conway DOUBLES the epoch length and Leios
+    changes it again — the era-relative ShelleyGenesis (EpochInfo-from-
+    Summary seam) keeps every era's epoch arithmetic sound across two
+    mid-chain epoch-length changes; the era-0 value (and the era-2
+    minted asset) survive FOUR translations."""
+    from ouroboros_consensus_tpu.ledger.mary import MaryValue, policy_id
+    from ouroboros_consensus_tpu.ledger.shelley import ShelleyState
+    from ouroboros_consensus_tpu.ops.host import ed25519 as ed
+
+    cfg = composite.CardanoMockConfig(
+        byron_epochs=1,
+        byron_epoch_length=40,
+        shelley_epochs=1,
+        epoch_length=40,
+        conway_epochs=1,          # babbage runs 1 epoch before conway
+        conway_epoch_length=80,   # DOUBLED mid-chain
+        leios_epochs=1,           # conway runs 1 epoch before leios
+        leios_epoch_length=20,    # changed again
+        n_delegs=2,
+        shelley_d=Fraction(1, 2),
+        k=5,
+        kes_depth=3,
+        with_ledgers=True,
+    )
+    # byron 40 + shelley 40 + babbage 40 + conway 80 + some leios
+    n_slots = 40 + 40 + 40 + 80 + 30
+    path = str(tmp_path / "db")
+    n = composite.synthesize(path, cfg, n_slots)
+    res = composite.revalidate(path, cfg, backend="host")
+    assert res.error is None, repr(res.error)
+    assert res.n_valid == res.n_blocks == n
+    assert set(res.per_era) == {
+        "byron", "shelley", "babbage", "conway", "leios"
+    }
+
+    lst = res.final_ledger_state
+    assert lst.era == 4 and isinstance(lst.inner, ShelleyState)
+    cm = composite.CardanoMock(cfg)
+    # leios's era-relative epoch count: summary start epoch + elapsed
+    leios_gen = cm.eras[4].ledger.genesis
+    assert leios_gen.era_start_slot == 200 and leios_gen.epoch_length == 20
+    assert lst.inner.epoch == leios_gen.epoch_of_slot(n_slots - 1)
+    [(addr, val)] = list(lst.inner.utxo.values())
+    pid = policy_id(ed.secret_to_public(cm.MINT_POLICY_SEED))
+    assert isinstance(val, MaryValue)
+    assert val.asset_map() == {(pid, cm.MINT_ASSET): 1_000}
+    n_byron_txs = sum(
+        1 for s in range(1, 40) if s % cfg.byron_epoch_length != 0
+    )
+    assert int(val) == cm.LEDGER_GENESIS_COIN - n_byron_txs * cm.LEDGER_BYRON_FEE
+
+
+def test_cardano_analyser_cli(tmp_path, capsys):
+    """db_analyser --cardano: the CLI drives the composite revalidation
+    (DBAnalyser/Block/Cardano.hs block dispatch analog)."""
+    import json
+
+    from ouroboros_consensus_tpu.tools import db_analyser
+
+    path = str(tmp_path / "db")
+    cfg = composite.CardanoMockConfig()  # CLI defaults
+    n = composite.synthesize(path, cfg, 2 * 40 + 2 * 60 + 30)
+    db_analyser.main([
+        "--db", path, "--cardano", "--backend", "host",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["error"] is None and out["valid"] == out["blocks"] == n
+    assert set(out["per_era"]) == {"byron", "shelley", "babbage"}
