@@ -442,4 +442,21 @@ class ByronLedger:
         return Forecast(at=at, max_for=at + window, view_fn=lambda _s: view)
 
     def inspect(self, old: ByronState, new: ByronState) -> list:
-        return []
+        """InspectLedger: report delegation-map changes (the operator
+        signal Byron's delegation payloads produce — byron
+        Ledger/Inspect-analog; the reference logs proposal/update
+        events, our Byron scope carries dcerts)."""
+        from .inspect import ByronDelegationChanged
+
+        changed = tuple(sorted(
+            (gk.hex()[:16], old.delegation.get(gk, b"").hex()[:16],
+             dvk.hex()[:16])
+            for gk, dvk in new.delegation.items()
+            if old.delegation.get(gk) != dvk
+        ))
+        if not changed:
+            return []
+        return [ByronDelegationChanged(
+            message=f"delegation map changed for {len(changed)} genesis key(s)",
+            changes=changed,
+        )]

@@ -64,3 +64,12 @@ class ShelleyPParamsAdopted(LedgerUpdate):
     """An epoch boundary adopted new protocol parameters (PPUP NEWPP)."""
 
     changed: tuple = ()  # (field, old, new) triples
+
+
+@dataclass(frozen=True)
+class ByronDelegationChanged(LedgerUpdate):
+    """A Byron delegation certificate moved signing rights (the PBFT
+    ledger view changed) — operators watch this: the wrong forging key
+    after a re-delegation produces only rejected blocks."""
+
+    changes: tuple = ()  # (genesis_key, old_delegate, new_delegate) hex

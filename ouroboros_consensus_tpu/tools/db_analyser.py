@@ -682,6 +682,8 @@ def main(argv=None) -> None:
     p.add_argument("--with-ledgers", action="store_true",
                    help="with --cardano: fold the real era ledgers too")
     a = p.parse_args(argv)
+    if a.with_ledgers and not a.cardano:
+        p.error("--with-ledgers requires --cardano")
     if a.cardano:
         # block-type dispatch to the composite (the reference's
         # db-analyser picks the block type from the node config;
@@ -701,11 +703,20 @@ def main(argv=None) -> None:
             )
         cfg = cardano.CardanoMockConfig(with_ledgers=a.with_ledgers)
         res = cardano.revalidate(a.db, cfg, backend=a.backend)
-        print(_json.dumps({
+        out = {
             "blocks": res.n_blocks, "valid": res.n_valid,
             "per_era": res.per_era,
             "error": None if res.error is None else repr(res.error),
-        }))
+        }
+        if res.error is not None and a.with_ledgers:
+            # a consensus-clean chain failing only the LEDGER replay is
+            # most often a flag mismatch, not corruption
+            out["hint"] = (
+                "ledger replay failed on a consensus-valid chain — was "
+                "the DB synthesized with --with-ledgers? (a consensus-"
+                "only synthesis forges placeholder tx bytes)"
+            )
+        print(_json.dumps(out))
         return
     if a.analysis == "count-blocks":
         print(count_blocks(a.db))

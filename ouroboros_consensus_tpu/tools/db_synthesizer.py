@@ -281,7 +281,26 @@ def main(argv=None) -> None:
     p.add_argument("--config", default=None,
                    help="node config.json (with CredentialsFile) instead "
                         "of --pools/--kes-depth generated credentials")
+    p.add_argument("--cardano", action="store_true",
+                   help="forge the multi-era composite (era-tagged "
+                        "blocks crossing the Byron/Shelley/Babbage "
+                        "boundaries); pairs with db_analyser --cardano")
+    p.add_argument("--with-ledgers", action="store_true",
+                   help="with --cardano: real era ledgers in the loop")
     a = p.parse_args(argv)
+    if a.with_ledgers and not a.cardano:
+        p.error("--with-ledgers requires --cardano")
+    if a.cardano:
+        from ..hardfork import composite as cardano
+
+        if a.config is not None:
+            p.error("--cardano uses the composite's built-in config")
+        if not a.slots:
+            p.error("--cardano forges by --slots")
+        cfg = cardano.CardanoMockConfig(with_ledgers=a.with_ledgers)
+        n = cardano.synthesize(a.out, cfg, a.slots)
+        print(f"forged {n} blocks over {a.slots} slots at {a.out}")
+        return
     if a.config:
         from .config import load_config
 

@@ -317,3 +317,24 @@ def test_byron_to_shelley_translation_carries_real_state():
         t, type("B", (), {"slot": 101, "txs": (sh_tx,)})()
     )
     assert ((carol, None), 700) in sh_st2.utxo.values()
+
+
+def test_byron_inspect_reports_delegation_change():
+    """InspectLedger: a dcert produces a ByronDelegationChanged event;
+    a value-only block produces none."""
+    from ouroboros_consensus_tpu.ledger.inspect import (
+        ByronDelegationChanged, inspect_ledger,
+    )
+
+    led = _ledger()
+    st = _fund(led, (ALICE, 100))
+    dvk = ed.secret_to_public(DELEGATE)
+    st2 = led.apply_block(led.tick(st, 1), _Blk(1, [make_dcert(GK0, dvk, 0)]))
+    events = inspect_ledger(led, st, st2)
+    assert len(events) == 1 and isinstance(events[0], ByronDelegationChanged)
+    assert len(events[0].changes) == 1
+
+    bob_addr = addr_of(ed.secret_to_public(BOB))
+    tx = make_tx([(bytes(32), 0)], [(bob_addr, 90)], [ALICE])
+    st3 = led.apply_block(led.tick(st2, 2), _Blk(2, [tx]))
+    assert inspect_ledger(led, st2, st3) == []

@@ -412,3 +412,25 @@ def test_cardano_analyser_cli(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["error"] is None and out["valid"] == out["blocks"] == n
     assert set(out["per_era"]) == {"byron", "shelley", "babbage"}
+
+
+def test_cardano_cli_pipeline(tmp_path, capsys):
+    """tools-test shape (test/tools-test/Main.hs): db_synthesizer
+    --cardano forges the composite from the CLI, db_analyser --cardano
+    revalidates it — with the real era ledgers in both."""
+    import json
+
+    from ouroboros_consensus_tpu.tools import db_analyser, db_synthesizer
+
+    path = str(tmp_path / "db")
+    db_synthesizer.main([
+        "--out", path, "--cardano", "--with-ledgers", "--slots", "230",
+    ])
+    forged = capsys.readouterr().out
+    assert "forged" in forged
+    db_analyser.main([
+        "--db", path, "--cardano", "--with-ledgers", "--backend", "host",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["error"] is None and out["valid"] == out["blocks"] > 0
+    assert set(out["per_era"]) == {"byron", "shelley", "babbage"}
