@@ -297,4 +297,6 @@ class DualByronLedger:
         return self.reapply_block(self.tick(state, block.slot), block)
 
     def inspect(self, old, new) -> list:
-        return []
+        """Delegate to the impl side (dualLedgerStateMain projection) so
+        ByronDelegationChanged surfaces on DualByron nodes too."""
+        return self.impl.inspect(old.impl, new.impl)

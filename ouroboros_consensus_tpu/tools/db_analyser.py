@@ -708,9 +708,10 @@ def main(argv=None) -> None:
             "per_era": res.per_era,
             "error": None if res.error is None else repr(res.error),
         }
-        if res.error is not None and a.with_ledgers:
-            # a consensus-clean chain failing only the LEDGER replay is
-            # most often a flag mismatch, not corruption
+        if (res.error is not None and a.with_ledgers
+                and res.n_valid == res.n_blocks):
+            # CONSENSUS passed on every block, only the LEDGER replay
+            # failed — most often a flag mismatch, not corruption
             out["hint"] = (
                 "ledger replay failed on a consensus-valid chain — was "
                 "the DB synthesized with --with-ledgers? (a consensus-"

@@ -322,3 +322,45 @@ def test_n2c_wire_totality_and_disconnect(tmp_path):
         await runtime.shutdown()
 
     asyncio.run(run())
+
+
+def test_reconnect_resumes_from_intersection(tmp_path):
+    """A syncer that loses its connection mid-sync reconnects and
+    RESUMES from the intersection of its existing chain (find_intersect
+    with non-genesis points over the wire), not from scratch."""
+
+    async def run():
+        runtime = AsyncRuntime()
+        forger = _mk_node(str(tmp_path), 0, forger=True)
+        syncer = _mk_node(str(tmp_path), 1, forger=False)
+        forger.chain_db.runtime = runtime
+        syncer.chain_db.runtime = runtime
+        server = await transport.serve_node(forger, runtime)
+        port = server.sockets[0].getsockname()[1]
+        runtime.spawn(forger.forging_loop(N_SLOTS), "forge")
+
+        mux = await transport.connect_node(
+            syncer, runtime, "127.0.0.1", port
+        )
+        # let it sync part of the chain, then cut the connection
+        await _converged(syncer, 40, timeout=15)
+        mid = _chain_len(syncer)
+        assert mid >= 40
+        for t in mux.tasks:
+            t.cancel()
+        mux.pump_task.cancel()
+        mux.writer.close()
+
+        # reconnect: the client offers its tip among the intersect
+        # points; the server streams only the suffix
+        await transport.connect_node(syncer, runtime, "127.0.0.1", port)
+        n = await _converged(syncer, N_SLOTS, timeout=20)
+        forged = _chain_len(forger)
+        assert n == forged >= 100, (n, forged)
+        a = [b.hash_ for b in forger.chain_db.stream_all()]
+        b = [b.hash_ for b in syncer.chain_db.stream_all()]
+        assert a == b
+        server.close()
+        await runtime.shutdown()
+
+    asyncio.run(run())
