@@ -386,6 +386,16 @@ class TPraosProtocol:
         ]
         if backend == "native":
             v = pbatch.run_batch_native(params.praos, lview, eta0, hvs, pre)
+        elif backend == "sharded":
+            # multi-chip SPMD, same as the Praos route — a silent
+            # single-device fallback here would fake sharded coverage
+            # for every TPraos (Shelley-era) segment
+            from ..parallel import spmd
+
+            batch = pbatch.stage(
+                params.praos, lview, eta0, hvs, pre.kes_evolution
+            )
+            v, _first_bad, _n_ok = spmd.sharded_run_batch(batch)
         else:
             batch = pbatch.stage(params.praos, lview, eta0, hvs, pre.kes_evolution)
             v = pbatch.run_batch(batch)

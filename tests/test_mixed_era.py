@@ -434,3 +434,19 @@ def test_cardano_cli_pipeline(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["error"] is None and out["valid"] == out["blocks"] > 0
     assert set(out["per_era"]) == {"byron", "shelley", "babbage"}
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("OCT_SLOW_TESTS"),
+    reason="fused-kernel compile on XLA:CPU; set OCT_SLOW_TESTS=1",
+)
+def test_sharded_backend_through_composite(chain):
+    """Config 5 over the multi-chip SPMD backend: the Praos-class era
+    segments shard over the 8-device virtual mesh (the PBFT segment
+    stays a batched Ed25519 verify), agreeing with the host fold."""
+    path, n = chain
+    res = composite.revalidate(path, CFG, backend="sharded")
+    assert res.error is None, repr(res.error)
+    assert res.n_valid == n
+    host = composite.revalidate(path, CFG, backend="host")
+    assert res.final_state == host.final_state
