@@ -63,12 +63,82 @@ def golden_ext_state() -> ExtLedgerState:
     return ExtLedgerState(ls, hs)
 
 
+def golden_byron_payloads() -> bytes:
+    """Deterministic Byron tx + dcert payload bytes (the era-0 wire)."""
+    from ouroboros_consensus_tpu.ledger import byron as byron_led
+
+    seed = b"\x2a" * 32
+    tx = byron_led.make_tx(
+        [(bytes(32), 0)],
+        [(byron_led.addr_of(b"\x0b" * 32), 90)],
+        [seed],
+    )
+    cert = byron_led.make_dcert(seed, b"\x0c" * 32, epoch=1)
+    return cbor.encode([tx, cert])
+
+
+def golden_mary_tx() -> bytes:
+    """Deterministic Mary tx (multi-asset mint + validity interval)."""
+    from ouroboros_consensus_tpu.ledger import mary
+
+    outs = [(b"\x0d" * 28, None,
+             mary.MaryValue(70, {(b"\x0e" * 28, b"tok"): 5}))]
+    wit = mary.make_mint_witness(
+        b"\x2b" * 32, [(bytes(32), 1)], outs, 0, (3, 99), {b"tok": 5}
+    )
+    return mary.encode_tx([(bytes(32), 1)], outs, validity=(3, 99),
+                          mint=[wit])
+
+
+def golden_dual_byron_snapshot() -> bytes:
+    """DualByron ledger-state snapshot payload (tagged codec)."""
+    from ouroboros_consensus_tpu.ledger import byron as byron_led
+    from ouroboros_consensus_tpu.ledger.byron_spec import DualByronLedger
+    from ouroboros_consensus_tpu.ops.host import ed25519 as ed
+
+    gen = byron_led.ByronGenesis(
+        pparams=byron_led.ByronPParams(min_fee_a=10, min_fee_b=0),
+        genesis_keys=(ed.secret_to_public(b"\x2a" * 32),),
+    )
+    st = DualByronLedger(gen).genesis_state(
+        [(byron_led.addr_of(b"\x0b" * 32), 500)]
+    )
+    return cbor.encode(serialize.encode_ledger_state_tagged(st))
+
+
+def golden_mary_shelley_snapshot() -> bytes:
+    """Shelley snapshot whose value column carries a Mary value + a
+    pending MIR allocation (the round-4 codec extensions)."""
+    import dataclasses
+
+    from ouroboros_consensus_tpu.ledger import mary
+    from ouroboros_consensus_tpu.ledger import shelley as sh
+
+    led = sh.ShelleyLedger(sh.ShelleyGenesis(
+        pparams=sh.PParams(), epoch_length=100, stability_window=30,
+    ))
+    st = led.genesis_state([(b"\x0d" * 28, b"\x0f" * 28, 100)])
+    st = dataclasses.replace(
+        st,
+        utxo={**st.utxo, (b"\x10" * 32, 0): (
+            (b"\x0d" * 28, None),
+            mary.MaryValue(7, {(b"\x0e" * 28, b"tok"): 5}),
+        )},
+        pending_mir={(0, b"\x0f" * 28): 55},
+    )
+    return cbor.encode(serialize.encode_ledger_state_tagged(st))
+
+
 CASES = {
     "praos_block.hex": lambda: golden_block().bytes_,
     "ext_ledger_state.hex": lambda: serialize.encode_ext_state(golden_ext_state()),
     "canonical_cbor.hex": lambda: cbor.encode(
         [0, -1, 23, 24, 255, 65536, b"bytes", "text", [1, [2, [3]]], None, True]
     ),
+    "byron_payloads.hex": golden_byron_payloads,
+    "mary_tx.hex": golden_mary_tx,
+    "dual_byron_snapshot.hex": golden_dual_byron_snapshot,
+    "mary_shelley_snapshot.hex": golden_mary_shelley_snapshot,
 }
 
 
@@ -168,7 +238,11 @@ def test_byron_and_mary_snapshot_roundtrip():
             ),
         },
     )
+    s_st = __import__("dataclasses").replace(
+        s_st, pending_mir={(0, b"\x33" * 28): 44, (1, b"\x34" * 28): 9},
+    )
     m_again = rt(s_st)
+    assert dict(m_again.pending_mir) == dict(s_st.pending_mir)
     vals = sorted(
         (int(v), tuple(getattr(v, "assets", ())))
         for _a, v in m_again.utxo.values()
