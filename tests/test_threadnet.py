@@ -428,3 +428,27 @@ def test_three_era_network_mock_shelley_mary(tmp_path):
             ),
             mint_tx,
         )
+
+
+def test_three_era_network_across_schedules(tmp_path):
+    """The 3-era net under permuted task schedules (io-sim seed
+    exploration): both boundaries cross and all nodes converge under
+    every seed."""
+    from ouroboros_consensus_tpu.hardfork.combinator import HardForkBlock
+
+    for seed in (23, 171):
+        cfg = threadnet.ThreadNetConfig(
+            n_nodes=3, n_slots=55, k=40, msg_delay=0.05,
+            active_slot_coeff=Fraction(1),
+            epoch_length=10,
+            forgers=[0],
+            hard_fork_at_epoch=2,
+            hf_shelley_era=True,
+            hf_mary_at_epoch=4,
+            seed=seed,
+        )
+        res = threadnet.run_thread_network(str(tmp_path / f"s{seed}"), cfg)
+        threadnet.check_common_prefix(res, cfg.k)
+        assert res.chain_hashes(1) == res.chain_hashes(0) == res.chain_hashes(2)
+        eras = [b.era for b in res.chains[0] if isinstance(b, HardForkBlock)]
+        assert set(eras) == {0, 1, 2}, f"seed {seed}: eras {set(eras)}"
