@@ -1,0 +1,406 @@
+"""Allegra-class era: the Shelley rules extended with TIMELOCK SCRIPTS,
+VALIDITY INTERVALS and explicit KEY WITNESSES — the first era whose
+outputs can be locked by a *script* rather than a key.
+
+Reference: StandardAllegra (`Shelley/Eras.hs:85-97`) and the
+Shelley→Allegra `CanHardFork` step (`Cardano/CanHardFork.hs:273`); the
+timelock language and its evaluation semantics are re-derived from
+cardano-ledger's Allegra `Timelock` (evalTimelock over the tx validity
+interval + the witnessing key-hash set).
+
+Wire format (era-tagged; shelley.decode_tx CANNOT parse it):
+  tx       = [inputs, outputs, fee, [start|null, end|null],
+              certs, withdrawals, scripts, keywits]
+  output   = [addr, coin]            -- addr as Shelley
+  scripts  = [script_bytes...]       -- witness set: the attached scripts
+  keywit   = [vk/32, sig/64]         -- sig over blake2b_256(body) where
+                                        body = tx with scripts/keywits
+                                        stripped (witness-free prefix)
+  certs / withdrawals exactly as Shelley
+
+Timelock script language (CBOR):
+  [0, keyhash/28]        -- RequireSignature: keyhash must be among the
+                            tx's witnessing key hashes
+  [1, [script...]]       -- RequireAllOf
+  [2, [script...]]       -- RequireAnyOf
+  [3, m, [script...]]    -- RequireMOf
+  [4, slot]              -- RequireTimeStart: the validity interval's
+                            lower bound exists and >= slot
+  [5, slot]              -- RequireTimeExpire: the interval's upper
+                            bound exists and <= slot
+Evaluation reads ONLY the interval and the signatory set (deterministic
+phase-1, like the reference: the current slot never enters script
+evaluation — interval membership is the UTXO rule's job).
+
+A script-locked output's payment credential is
+`SCRIPT_ADDR_PREFIX + blake2b_224(script_bytes)` (29 bytes — key
+credentials here are 28-byte hashes or 32-byte vks, so the tagged form
+cannot collide with either).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from ..ops.host import ed25519 as host_ed25519
+from ..ops.host.hashes import blake2b_224, blake2b_256
+from ..utils import cbor
+from .shelley import (
+    BadInputs,
+    ExpiredTx,
+    FeeTooSmall,
+    MaxTxSizeExceeded,
+    ShelleyLedger,
+    ShelleyState,
+    ShelleyTxError,
+    TxView,
+    ValueNotConserved,
+    tx_id,
+)
+
+SCRIPT_ADDR_PREFIX = b"\xf1"
+
+
+class ScriptError(ShelleyTxError):
+    pass
+
+
+class OutsideValidityInterval(ShelleyTxError):
+    def __init__(self, start, end, slot):
+        super().__init__(f"slot {slot} outside validity [{start}, {end}]")
+        self.start, self.end, self.slot = start, end, slot
+
+
+class MissingWitness(ShelleyTxError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Timelock scripts
+# ---------------------------------------------------------------------------
+
+
+def script_hash(script_bytes: bytes) -> bytes:
+    return blake2b_224(script_bytes)
+
+
+def script_addr(script_bytes: bytes) -> bytes:
+    """Payment credential locking an output with this script."""
+    return SCRIPT_ADDR_PREFIX + script_hash(script_bytes)
+
+
+def is_script_addr(payment: bytes) -> bool:
+    return len(payment) == 29 and payment[:1] == SCRIPT_ADDR_PREFIX
+
+
+def key_hash(vk: bytes) -> bytes:
+    """Witness key hash (the RequireSignature credential)."""
+    return blake2b_224(vk)
+
+
+# sign-side script constructors (what a wallet/test builds)
+def require_signature(vk_or_hash: bytes) -> bytes:
+    kh = vk_or_hash if len(vk_or_hash) == 28 else key_hash(vk_or_hash)
+    return cbor.encode([0, kh])
+
+
+def require_all_of(scripts) -> bytes:
+    return cbor.encode([1, [cbor.decode(s) for s in scripts]])
+
+
+def require_any_of(scripts) -> bytes:
+    return cbor.encode([2, [cbor.decode(s) for s in scripts]])
+
+
+def require_m_of(m: int, scripts) -> bytes:
+    return cbor.encode([3, m, [cbor.decode(s) for s in scripts]])
+
+
+def require_time_start(slot: int) -> bytes:
+    return cbor.encode([4, slot])
+
+
+def require_time_expire(slot: int) -> bytes:
+    return cbor.encode([5, slot])
+
+
+_MAX_SCRIPT_DEPTH = 32
+
+
+def decode_script(script_bytes: bytes):
+    """Decode attacker-supplied script bytes; malformed CBOR is an
+    INVALID TX (ScriptError), never a crash (shelley.py:153 rule)."""
+    try:
+        return cbor.decode(script_bytes)
+    except Exception as e:
+        raise ScriptError(f"undecodable script: {e!r}") from e
+
+
+def eval_timelock(node, signatories: frozenset, start, end,
+                  _depth: int = 0) -> bool:
+    """evalTimelock: node is the DECODED script term."""
+    if _depth > _MAX_SCRIPT_DEPTH:
+        raise ScriptError("timelock nesting too deep")
+    try:
+        tag = int(node[0])
+        if tag == 0:
+            return bytes(node[1]) in signatories
+        if tag == 1:
+            return all(
+                eval_timelock(s, signatories, start, end, _depth + 1)
+                for s in node[1]
+            )
+        if tag == 2:
+            return any(
+                eval_timelock(s, signatories, start, end, _depth + 1)
+                for s in node[1]
+            )
+        if tag == 3:
+            m = int(node[1])
+            return sum(
+                1 for s in node[2]
+                if eval_timelock(s, signatories, start, end, _depth + 1)
+            ) >= m
+        if tag == 4:
+            return start is not None and start >= int(node[1])
+        if tag == 5:
+            return end is not None and end <= int(node[1])
+    except ScriptError:
+        raise
+    except Exception as e:
+        raise ScriptError(f"malformed timelock: {e!r}") from e
+    raise ScriptError(f"unknown timelock tag: {node[0]!r}")
+
+
+# ---------------------------------------------------------------------------
+# Wire format
+# ---------------------------------------------------------------------------
+
+
+def body_fields(ins, outs, fee, validity, certs, withdrawals) -> list:
+    return [
+        [list(i) for i in ins],
+        outs,
+        fee,
+        [validity[0], validity[1]],
+        [list(c) for c in certs],
+        [list(w) for w in withdrawals],
+    ]
+
+
+def body_hash_of(fields: list) -> bytes:
+    """What key witnesses sign: the hash of the witness-free prefix."""
+    return blake2b_256(cbor.encode(fields))
+
+
+def make_key_witness(seed: bytes, body_hash: bytes) -> tuple[bytes, bytes]:
+    vk = host_ed25519.secret_to_public(seed)
+    return (vk, host_ed25519.sign(seed, body_hash))
+
+
+def encode_tx(ins, outs, fee=0, validity=(None, None), certs=(),
+              withdrawals=(), scripts=(), signers=()) -> bytes:
+    """outs: [(payment, stake|None, coin)]; signers: seeds whose key
+    witnesses to attach (the sign-side convenience)."""
+    fields = body_fields(
+        ins, [[[p, s], int(c)] for p, s, c in outs], fee, validity,
+        certs, withdrawals,
+    )
+    bh = body_hash_of(fields)
+    wits = [list(make_key_witness(seed, bh)) for seed in signers]
+    return cbor.encode(fields + [[s for s in scripts], wits])
+
+
+@dataclass(frozen=True)
+class AllegraTx:
+    ins: tuple[tuple[bytes, int], ...]
+    outs: tuple[tuple[tuple[bytes, bytes | None], int], ...]
+    fee: int
+    start: int | None
+    end: int | None
+    certs: tuple[tuple, ...]
+    withdrawals: tuple[tuple[bytes, int], ...]
+    scripts: tuple[bytes, ...]
+    keywits: tuple[tuple[bytes, bytes], ...]
+    body_hash: bytes
+    size: int
+
+
+def decode_tx(tx_bytes: bytes) -> AllegraTx:
+    try:
+        ins, outs, fee, validity, certs, wdrls, scripts, wits = cbor.decode(
+            tx_bytes
+        )
+        start, end = validity
+        bh = body_hash_of(
+            body_fields(ins, outs, fee, (start, end), certs, wdrls)
+        )
+        return AllegraTx(
+            ins=tuple((bytes(i[0]), int(i[1])) for i in ins),
+            outs=tuple(
+                ((bytes(a[0]), None if a[1] is None else bytes(a[1])), int(c))
+                for a, c in outs
+            ),
+            fee=int(fee),
+            start=None if start is None else int(start),
+            end=None if end is None else int(end),
+            certs=tuple(tuple(c) for c in certs),
+            withdrawals=tuple((bytes(w[0]), int(w[1])) for w in wdrls),
+            scripts=tuple(bytes(s) for s in scripts),
+            keywits=tuple((bytes(w[0]), bytes(w[1])) for w in wits),
+            body_hash=bh,
+            size=len(tx_bytes),
+        )
+    except ShelleyTxError:
+        raise
+    except Exception as e:
+        raise ShelleyTxError(f"malformed allegra tx: {e!r}") from e
+
+
+def translate_tx_from_shelley(tx_bytes: bytes) -> bytes:
+    """InjectTxs Shelley→Allegra: ttl becomes [null, ttl]; no scripts,
+    no key witnesses (Shelley-format txs carry none)."""
+    ins, outs, fee, ttl, certs, wdrls = cbor.decode(tx_bytes)
+    return cbor.encode([ins, outs, fee, [None, ttl], certs, wdrls, [], []])
+
+
+# ---------------------------------------------------------------------------
+# The ledger
+# ---------------------------------------------------------------------------
+
+
+class AllegraLedger(ShelleyLedger):
+    """ShelleyLedger + the Allegra deltas: validity interval replaces
+    TTL; script-locked outputs spendable by attached timelock scripts;
+    key witnesses feed RequireSignature. Certificates, snapshots,
+    rewards, POOLREAP and PPUP are INHERITED — like the reference's
+    ShelleyMA eras sharing the Shelley rule family."""
+
+    _decode_tx = staticmethod(decode_tx)
+
+    # -- era translation INTO Allegra --------------------------------------
+
+    def translate_from_shelley(self, prev: ShelleyState) -> ShelleyState:
+        """Shelley→Allegra: state fields are identical (Coin stays Coin;
+        the value type widens only at the Mary step)."""
+        return prev
+
+    # -- shared witness machinery (Mary/Alonzo subclasses reuse) -----------
+
+    @staticmethod
+    def collect_signatories(keywits, body_hash: bytes) -> frozenset:
+        """Verify every key witness; the resulting key-hash set is the
+        RequireSignature context. A bad signature is an invalid tx (the
+        UTXOW rule), not an ignored witness."""
+        sigs = set()
+        for vk, sig in keywits:
+            if not host_ed25519.verify(vk, body_hash, sig):
+                raise MissingWitness(
+                    f"invalid key witness for {key_hash(vk).hex()[:8]}"
+                )
+            sigs.add(key_hash(vk))
+        return frozenset(sigs)
+
+    @staticmethod
+    def script_map(scripts) -> dict[bytes, bytes]:
+        return {script_hash(s): s for s in scripts}
+
+    def check_script_inputs(self, view: TxView, ins, scripts_by_hash,
+                            signatories, start, end) -> None:
+        """For every input locked by a script credential: the script must
+        be attached and must evaluate (UTXOW missing-script +
+        evalTimelock)."""
+        for txin in ins:
+            payment = view.utxo[txin][0][0]
+            if not is_script_addr(payment):
+                continue
+            h = payment[1:]
+            script = scripts_by_hash.get(h)
+            if script is None:
+                raise MissingWitness(
+                    f"missing script witness for {h.hex()[:8]}"
+                )
+            if not eval_timelock(
+                decode_script(script), signatories, start, end
+            ):
+                raise ScriptError(
+                    f"timelock evaluation failed for {h.hex()[:8]}"
+                )
+
+    def check_validity_interval(self, view: TxView, start, end) -> None:
+        if start is not None and view.slot < start:
+            raise OutsideValidityInterval(start, end, view.slot)
+        if end is not None and view.slot > end:
+            raise ExpiredTx(end, view.slot)
+
+    # -- the Allegra UTXOW/UTXO rules --------------------------------------
+
+    def apply_tx(self, view: TxView, tx_bytes: bytes) -> TxView:
+        tx = decode_tx(tx_bytes)
+        pp = view.pparams
+        if not tx.ins:
+            raise ShelleyTxError("empty input set")
+        if len(set(tx.ins)) != len(tx.ins):
+            raise BadInputs(tx.ins[0])
+        self.check_validity_interval(view, tx.start, tx.end)
+        if tx.size > pp.max_tx_size:
+            raise MaxTxSizeExceeded(tx.size, pp.max_tx_size)
+        min_fee = pp.min_fee_a * tx.size + pp.min_fee_b
+        if tx.fee < min_fee:
+            raise FeeTooSmall(tx.fee, min_fee)
+        if any(c < 0 for _a, c in tx.outs):
+            raise ShelleyTxError("negative output")
+
+        consumed = 0
+        for txin in tx.ins:
+            if txin not in view.utxo:
+                raise BadInputs(txin)
+            consumed += int(view.utxo[txin][1])
+
+        signatories = self.collect_signatories(tx.keywits, tx.body_hash)
+        self.check_script_inputs(
+            view, tx.ins, self.script_map(tx.scripts), signatories,
+            tx.start, tx.end,
+        )
+
+        scratch = self._scratch_of(view)
+        withdrawn = 0
+        seen = set()
+        for cred, amt in tx.withdrawals:
+            if cred in seen:
+                raise ShelleyTxError("duplicate withdrawal")
+            seen.add(cred)
+            if cred not in scratch.rewards:
+                raise ShelleyTxError(f"unregistered: {cred.hex()[:8]}")
+            if scratch.rewards[cred] != amt:
+                raise ShelleyTxError(
+                    f"must withdraw full balance {scratch.rewards[cred]}"
+                )
+            scratch.rewards[cred] = 0
+            withdrawn += amt
+        deposits_taken = refunds = 0
+        for cert in tx.certs:
+            try:
+                dep, ref = self._apply_cert(scratch, cert)
+            except ShelleyTxError:
+                raise
+            except Exception as e:
+                raise ShelleyTxError(f"malformed certificate: {e!r}") from e
+            deposits_taken += dep
+            refunds += ref
+
+        produced_out = sum(int(c) for _a, c in tx.outs)
+        if (consumed + withdrawn + refunds
+                != produced_out + tx.fee + deposits_taken):
+            raise ValueNotConserved(
+                consumed + withdrawn + refunds,
+                produced_out + tx.fee + deposits_taken,
+            )
+
+        tid = tx_id(tx_bytes)
+        for txin in tx.ins:
+            del view.utxo[txin]
+        for ix, (addr, coin) in enumerate(tx.outs):
+            view.utxo[(tid, ix)] = (addr, coin)
+        self._commit_scratch(view, scratch, deposits_taken, refunds, tx.fee)
+        return view

@@ -1,0 +1,711 @@
+"""Alonzo-class era: the Mary rules extended with PHASE-2 SCRIPT
+WITNESSES — executable spending/minting scripts with datums, redeemers,
+execution-unit budgets, collateral, and the two-phase IsValid
+validation that makes script failure consume collateral instead of
+invalidating the block.
+
+Reference: StandardAlonzo (`Shelley/Eras.hs:85-97`) and the
+Mary→Alonzo `CanHardFork` step (`Cardano/CanHardFork.hs:273`); the
+two-phase semantics (IsValid flag recomputed by validators, collateral
+consumed on phase-2 failure) re-derived from cardano-ledger's Alonzo
+UTXOS rule. The script language is deliberately simple (the task is
+the *witnessing machinery*, not Plutus): a deterministic, metered
+expression interpreter — see `eval_script`.
+
+Script wire (extends the Allegra timelock tags 0-5):
+  [6, expr]  -- phase-2 script; `expr` is an ouroscript term:
+    [0, const]     literal int/bytes
+    [1]            datum          [2]            redeemer
+    [3, f]         context: f=0 interval start (-1 none), f=1 end,
+                   f=2 signatory count, f=3 current ada fee
+    [4, a, b] eq   [5, a, b] lt   [6, a, b] add  [7, a, b] and
+    [8, a, b] or   [9, a] not     [10, a] blake2b_256
+    [11, a] len    [12, keyhash]  signed-by
+  A script PASSES iff it evaluates to a truthy int without exceeding
+  the step budget. Every node costs 1 step; hashing costs 16.
+
+Tx wire (era-tagged; mary.decode_tx CANNOT parse it):
+  tx  = [ins, outs, fee, [start|null, end|null], certs, withdrawals,
+         mint, collateral, scripts, keywits, datums, redeemers,
+         budget, is_valid]
+  out = [addr, value] | [addr, value, datum_hash/32]
+  collateral = [input...]      -- key-locked, ada-only
+  datums     = [datum_bytes...]
+  redeemers  = [[purpose, index, term]...]  -- purpose 0 = spend (index
+               into the tx's input list), 1 = mint (index into mint)
+  budget     = declared execution units (steps)
+  is_valid   = bool — the forger's phase-2 claim; every validator
+               recomputes it and REJECTS the block on mismatch
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from fractions import Fraction
+from typing import Mapping
+
+from ..ops.host import ed25519 as host_ed25519
+from ..ops.host.hashes import blake2b_256
+from ..utils import cbor
+from .allegra import (
+    MissingWitness,
+    ScriptError,
+    decode_script,
+    eval_timelock,
+    is_script_addr,
+    script_hash,
+)
+from .mary import (
+    MaryLedger,
+    MaryValue,
+    MintError,
+    _decode_value,
+    _encode_value,
+    mint_sig_data,
+    policy_id,
+)
+from .shelley import (
+    BadInputs,
+    FeeTooSmall,
+    MaxTxSizeExceeded,
+    PParams,
+    ShelleyState,
+    ShelleyTxError,
+    TxView,
+    ValueNotConserved,
+    tx_id,
+)
+
+PLUTUS_TAG = 6
+
+
+class Phase2Error(ShelleyTxError):
+    """Raised internally when a phase-2 script fails — callers convert
+    it into the collateral-consuming path, never into block rejection."""
+
+
+class IsValidMismatch(ShelleyTxError):
+    """The forger's IsValid claim disagrees with recomputation — this
+    DOES invalidate the block (Alonzo UTXOS rule)."""
+
+
+class CollateralError(ShelleyTxError):
+    pass
+
+
+@dataclass(frozen=True)
+class AlonzoPParams(PParams):
+    """PParams + the Alonzo script-economics parameters."""
+
+    price_exunit: Fraction = Fraction(1, 100)  # lovelace per step
+    max_tx_exunits: int = 1_000_000
+    collateral_percent: int = 150
+    max_collateral_inputs: int = 3
+
+    UPDATABLE = PParams.UPDATABLE + (
+        "price_exunit", "max_tx_exunits", "collateral_percent",
+        "max_collateral_inputs",
+    )
+
+    @classmethod
+    def from_shelley(cls, pp: PParams, **overrides) -> "AlonzoPParams":
+        base = {
+            f: getattr(pp, f)
+            for f in PParams.__dataclass_fields__  # noqa: SLF001
+        }
+        base.update(overrides)
+        return cls(**base)
+
+
+# ---------------------------------------------------------------------------
+# The ouroscript interpreter (deterministic, metered)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ScriptContext:
+    datum: object  # decoded CBOR term or None
+    redeemer: object
+    start: int | None
+    end: int | None
+    signatories: frozenset
+    fee: int
+
+
+class _Budget:
+    __slots__ = ("left",)
+
+    def __init__(self, steps: int):
+        self.left = steps
+
+    def spend(self, n: int):
+        self.left -= n
+        if self.left < 0:
+            raise Phase2Error("execution budget exceeded")
+
+
+def eval_script(expr, ctx: ScriptContext, budget: _Budget):
+    budget.spend(1)
+    try:
+        tag = int(expr[0])
+    except Exception as e:
+        raise Phase2Error(f"malformed script term: {e!r}") from e
+    if tag == 0:
+        return expr[1]
+    if tag == 1:
+        return ctx.datum
+    if tag == 2:
+        return ctx.redeemer
+    if tag == 3:
+        f = int(expr[1])
+        if f == 0:
+            return -1 if ctx.start is None else ctx.start
+        if f == 1:
+            return -1 if ctx.end is None else ctx.end
+        if f == 2:
+            return len(ctx.signatories)
+        if f == 3:
+            return ctx.fee
+        raise Phase2Error(f"unknown context field {f}")
+    if tag == 4:
+        return int(
+            eval_script(expr[1], ctx, budget)
+            == eval_script(expr[2], ctx, budget)
+        )
+    if tag == 5:
+        a = eval_script(expr[1], ctx, budget)
+        b = eval_script(expr[2], ctx, budget)
+        if not isinstance(a, int) or not isinstance(b, int):
+            raise Phase2Error("lt on non-ints")
+        return int(a < b)
+    if tag == 6:
+        a = eval_script(expr[1], ctx, budget)
+        b = eval_script(expr[2], ctx, budget)
+        if not isinstance(a, int) or not isinstance(b, int):
+            raise Phase2Error("add on non-ints")
+        return a + b
+    if tag == 7:
+        return int(
+            bool(eval_script(expr[1], ctx, budget))
+            and bool(eval_script(expr[2], ctx, budget))
+        )
+    if tag == 8:
+        return int(
+            bool(eval_script(expr[1], ctx, budget))
+            or bool(eval_script(expr[2], ctx, budget))
+        )
+    if tag == 9:
+        return int(not bool(eval_script(expr[1], ctx, budget)))
+    if tag == 10:
+        budget.spend(16)
+        v = eval_script(expr[1], ctx, budget)
+        if not isinstance(v, bytes):
+            raise Phase2Error("hash on non-bytes")
+        return blake2b_256(v)
+    if tag == 11:
+        v = eval_script(expr[1], ctx, budget)
+        if not isinstance(v, bytes):
+            raise Phase2Error("len on non-bytes")
+        return len(v)
+    if tag == 12:
+        return int(bytes(expr[1]) in ctx.signatories)
+    raise Phase2Error(f"unknown script op {tag}")
+
+
+def run_script(script_bytes: bytes, ctx: ScriptContext,
+               budget: _Budget) -> None:
+    """Raise Phase2Error unless the script passes."""
+    try:
+        term = cbor.decode(script_bytes)
+    except Exception as e:
+        raise Phase2Error(f"undecodable script: {e!r}") from e
+    if int(term[0]) != PLUTUS_TAG:
+        raise Phase2Error("not a phase-2 script")
+    result = eval_script(term[1], ctx, budget)
+    if not (isinstance(result, int) and result):
+        raise Phase2Error(f"script evaluated to {result!r}")
+
+
+def plutus_script(expr) -> bytes:
+    """Sign-side constructor: wrap an ouroscript term."""
+    return cbor.encode([PLUTUS_TAG, expr])
+
+
+def is_plutus(script_bytes: bytes) -> bool:
+    try:
+        return int(cbor.decode(script_bytes)[0]) == PLUTUS_TAG
+    except Exception:
+        return False
+
+
+def datum_hash(datum_bytes: bytes) -> bytes:
+    return blake2b_256(datum_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Wire format
+# ---------------------------------------------------------------------------
+
+
+def _encode_out(p, s, v, dh=None):
+    return [[p, s], _encode_value(v)] if dh is None else (
+        [[p, s], _encode_value(v), dh]
+    )
+
+
+def encode_tx(ins, outs, fee=0, validity=(None, None), certs=(),
+              withdrawals=(), mint=(), collateral=(), scripts=(),
+              signers=(), datums=(), redeemers=(), budget=0,
+              is_valid=True) -> bytes:
+    """outs: [(payment, stake|None, value)] or
+    [(payment, stake|None, value, datum_hash)]; redeemers:
+    [(purpose, index, term)]."""
+    outs_wire = [
+        _encode_out(*o) if len(o) == 4 else _encode_out(o[0], o[1], o[2])
+        for o in outs
+    ]
+    fields = [
+        [list(i) for i in ins],
+        outs_wire,
+        fee,
+        [validity[0], validity[1]],
+        [list(c) for c in certs],
+        [list(w) for w in withdrawals],
+        [[vk, sg, [[n, q] for n, q in sorted(dict(am).items())]]
+         for vk, sg, am in mint],
+        [list(i) for i in collateral],
+        [s for s in scripts],
+    ]
+    from .allegra import body_hash_of, make_key_witness
+
+    bh = body_hash_of(fields)
+    wits = [list(make_key_witness(seed, bh)) for seed in signers]
+    return cbor.encode(fields + [
+        wits,
+        [d for d in datums],
+        [[int(p), int(ix), t] for p, ix, t in redeemers],
+        int(budget),
+        bool(is_valid),
+    ])
+
+
+@dataclass(frozen=True)
+class AlonzoTx:
+    ins: tuple[tuple[bytes, int], ...]
+    outs: tuple  # ((payment, stake|None[, datum_hash]), MaryValue)
+    fee: int
+    start: int | None
+    end: int | None
+    certs: tuple[tuple, ...]
+    withdrawals: tuple[tuple[bytes, int], ...]
+    mint: tuple
+    collateral: tuple[tuple[bytes, int], ...]
+    scripts: tuple[bytes, ...]
+    keywits: tuple[tuple[bytes, bytes], ...]
+    datums: tuple[bytes, ...]
+    redeemers: tuple  # ((purpose, index, term)...)
+    budget: int
+    is_valid: bool
+    outs_wire: tuple
+    body_hash: bytes
+    size: int
+
+
+def _decode_out(o):
+    addr, v = o[0], o[1]
+    payment = bytes(addr[0])
+    stake = None if addr[1] is None else bytes(addr[1])
+    if len(o) >= 3 and o[2] is not None:
+        return ((payment, stake, bytes(o[2])), _decode_value(v))
+    return ((payment, stake), _decode_value(v))
+
+
+def decode_tx(tx_bytes: bytes) -> AlonzoTx:
+    try:
+        (ins, outs, fee, validity, certs, wdrls, mint, coll, scripts,
+         wits, datums, redeemers, budget, is_valid) = cbor.decode(tx_bytes)
+        start, end = validity
+        from .allegra import body_hash_of
+
+        if wits:
+            bh = body_hash_of(
+                [ins, outs, fee, validity, certs, wdrls, mint, coll,
+                 scripts]
+            )
+        else:
+            bh = b""
+        return AlonzoTx(
+            ins=tuple((bytes(i[0]), int(i[1])) for i in ins),
+            outs=tuple(_decode_out(o) for o in outs),
+            fee=int(fee),
+            start=None if start is None else int(start),
+            end=None if end is None else int(end),
+            certs=tuple(tuple(c) for c in certs),
+            withdrawals=tuple((bytes(w[0]), int(w[1])) for w in wdrls),
+            mint=tuple(
+                (bytes(vk), None if sg is None else bytes(sg),
+                 tuple((bytes(n), int(q)) for n, q in pairs))
+                for vk, sg, pairs in mint
+            ),
+            collateral=tuple((bytes(i[0]), int(i[1])) for i in coll),
+            scripts=tuple(bytes(s) for s in scripts),
+            keywits=tuple((bytes(w[0]), bytes(w[1])) for w in wits),
+            datums=tuple(bytes(d) for d in datums),
+            redeemers=tuple(
+                (int(r[0]), int(r[1]), r[2]) for r in redeemers
+            ),
+            budget=int(budget),
+            is_valid=bool(is_valid),
+            outs_wire=outs,
+            body_hash=bh,
+            size=len(tx_bytes),
+        )
+    except ShelleyTxError:
+        raise
+    except Exception as e:
+        raise ShelleyTxError(f"malformed alonzo tx: {e!r}") from e
+
+
+def translate_tx_from_mary(tx_bytes: bytes) -> bytes:
+    """InjectTxs Mary→Alonzo: no collateral/scripts/datums/redeemers;
+    classic mint groups carry verbatim; IsValid is trivially true."""
+    decoded = cbor.decode(tx_bytes)
+    if len(decoded) == 7:
+        ins, outs, fee, validity, certs, wdrls, mint = decoded
+        scripts, wits = [], []
+    else:
+        ins, outs, fee, validity, certs, wdrls, mint, scripts, wits = decoded
+    return cbor.encode([
+        ins, outs, fee, validity, certs, wdrls, mint, [], scripts,
+        wits, [], [], 0, True,
+    ])
+
+
+# ---------------------------------------------------------------------------
+# The ledger
+# ---------------------------------------------------------------------------
+
+
+class AlonzoLedger(MaryLedger):
+    """MaryLedger + the Alonzo deltas: phase-2 scripts (datums,
+    redeemers, ExUnits, collateral) under two-phase IsValid validation.
+    Everything below the tx layer is inherited."""
+
+    _decode_tx = staticmethod(decode_tx)
+
+    # -- era translation INTO Alonzo ---------------------------------------
+
+    def translate_from_mary(self, prev: ShelleyState) -> ShelleyState:
+        """Mary→Alonzo: values/snapshots/pots carry verbatim; the
+        pparams widen with the script-economics fields
+        (CanHardFork.hs:273 translateLedgerState MaryToAlonzo)."""
+        pp = prev.pparams
+        if not isinstance(pp, AlonzoPParams):
+            pp = AlonzoPParams.from_shelley(pp)
+        return replace(prev, pparams=pp)
+
+    # -- phase-2 machinery (Babbage overrides the resolution seams) --------
+
+    def _resolve_witnesses(self, view: TxView, tx: AlonzoTx):
+        """(scripts_by_hash, datums_by_hash) from the witness set alone
+        — Babbage widens this with reference inputs."""
+        return (
+            self.script_map(tx.scripts),
+            {datum_hash(d): d for d in tx.datums},
+        )
+
+    def _datum_for(self, addr, datums_by_hash):
+        """Datum term for a script-locked utxo entry (by hash only here;
+        Babbage adds inline datums)."""
+        dh = addr[2] if len(addr) > 2 else None
+        if dh is None:
+            raise ShelleyTxError(
+                "phase-2 script output carries no datum hash"
+            )
+        datum = datums_by_hash.get(dh)
+        if datum is None:
+            raise MissingWitness(f"missing datum witness {dh.hex()[:8]}")
+        try:
+            return cbor.decode(datum)
+        except Exception as e:
+            raise ShelleyTxError(f"undecodable datum: {e!r}") from e
+
+    def _phase2_jobs(self, view: TxView, tx: AlonzoTx, scripts_by_hash,
+                     datums_by_hash):
+        """Collect (script, datum_term, redeemer_term) for every phase-2
+        witness the tx needs. Structural problems (missing script/datum/
+        redeemer, non-script datum outputs) are PHASE-1 errors."""
+        redeemer_of = {(p, ix): term for p, ix, term in tx.redeemers}
+        jobs = []
+        for ix, txin in enumerate(tx.ins):
+            entry = view.utxo[txin]
+            addr = entry[0]
+            payment = addr[0]
+            if not is_script_addr(payment):
+                continue
+            h = payment[1:]
+            script = scripts_by_hash.get(h)
+            if script is None:
+                raise MissingWitness(
+                    f"missing script witness for {h.hex()[:8]}"
+                )
+            if not is_plutus(script):
+                continue  # timelock — phase-1, handled by Allegra check
+            datum = self._datum_for(addr, datums_by_hash)
+            if (0, ix) not in redeemer_of:
+                raise MissingWitness(f"missing redeemer for input {ix}")
+            jobs.append((script, datum, redeemer_of[(0, ix)]))
+        for mx, (vk, sig, _pairs) in enumerate(tx.mint):
+            if sig is None and is_plutus(vk):
+                if (1, mx) not in redeemer_of:
+                    raise MissingWitness(
+                        f"missing redeemer for mint group {mx}"
+                    )
+                jobs.append((vk, None, redeemer_of[(1, mx)]))
+        return jobs
+
+    def _check_collateral(self, view: TxView, tx: AlonzoTx,
+                          need_phase2: bool) -> int:
+        pp = view.pparams
+        if not need_phase2:
+            return 0
+        if not tx.collateral:
+            raise CollateralError("phase-2 scripts but no collateral")
+        if len(tx.collateral) > pp.max_collateral_inputs:
+            raise CollateralError("too many collateral inputs")
+        total = 0
+        for txin in tx.collateral:
+            if txin not in view.utxo:
+                raise BadInputs(txin)
+            addr, val = view.utxo[txin][0], view.utxo[txin][1]
+            if is_script_addr(addr[0]):
+                raise CollateralError("collateral must be key-locked")
+            if isinstance(val, MaryValue) and val.assets:
+                raise CollateralError("collateral must be ada-only")
+            total += int(val)
+        if total * 100 < tx.fee * pp.collateral_percent:
+            raise CollateralError(
+                f"collateral {total} below "
+                f"{pp.collateral_percent}% of fee {tx.fee}"
+            )
+        return total
+
+    def _consume_collateral(self, view: TxView, tx: AlonzoTx) -> None:
+        """Phase-2 failure: ONLY the collateral moves (to the fee pot);
+        the rest of the tx leaves no trace (Alonzo UTXOS scriptsInvalid)."""
+        burned = 0
+        for txin in tx.collateral:
+            burned += int(view.utxo.pop(txin)[1])
+        view.fee_delta += burned
+
+    # -- the Alonzo UTXOW/UTXOS rules --------------------------------------
+
+    def apply_tx(self, view: TxView, tx_bytes: bytes) -> TxView:
+        return self._apply_decoded(view, decode_tx(tx_bytes), tx_bytes)
+
+    def _apply_decoded(self, view: TxView, tx, tx_bytes: bytes) -> TxView:
+        pp = view.pparams
+        if not tx.ins:
+            raise ShelleyTxError("empty input set")
+        if len(set(tx.ins)) != len(tx.ins):
+            raise BadInputs(tx.ins[0])
+        self.check_validity_interval(view, tx.start, tx.end)
+        if tx.size > pp.max_tx_size:
+            raise MaxTxSizeExceeded(tx.size, pp.max_tx_size)
+        if tx.budget > pp.max_tx_exunits:
+            raise ShelleyTxError(
+                f"budget {tx.budget} exceeds era max {pp.max_tx_exunits}"
+            )
+        # fee covers the declared budget at the era's ExUnits price
+        min_fee = (pp.min_fee_a * tx.size + pp.min_fee_b
+                   + int(pp.price_exunit * tx.budget))
+        if tx.fee < min_fee:
+            raise FeeTooSmall(tx.fee, min_fee)
+        if any(int(v) < 0 for _a, v in tx.outs):
+            raise ShelleyTxError("negative output")
+
+        consumed = 0
+        consumed_assets: dict[tuple[bytes, bytes], int] = {}
+        for txin in tx.ins:
+            if txin not in view.utxo:
+                raise BadInputs(txin)
+            val = view.utxo[txin][1]
+            consumed += int(val)
+            if isinstance(val, MaryValue):
+                for k, q in val.assets:
+                    consumed_assets[k] = consumed_assets.get(k, 0) + q
+
+        signatories = self.collect_signatories(tx.keywits, tx.body_hash)
+        scripts_by_hash, datums_by_hash = self._resolve_witnesses(view, tx)
+        # phase-1 script checks: timelocks on inputs (plutus inputs are
+        # checked structurally here, executed in phase 2)
+        for txin in tx.ins:
+            payment = view.utxo[txin][0][0]
+            if not is_script_addr(payment):
+                continue
+            h = payment[1:]
+            script = scripts_by_hash.get(h)
+            if script is None:
+                raise MissingWitness(
+                    f"missing script witness for {h.hex()[:8]}"
+                )
+            if not is_plutus(script):
+                if not eval_timelock(
+                    decode_script(script), signatories, tx.start, tx.end
+                ):
+                    raise ScriptError(
+                        f"timelock evaluation failed for {h.hex()[:8]}"
+                    )
+        jobs = self._phase2_jobs(view, tx, scripts_by_hash, datums_by_hash)
+        self._check_collateral(view, tx, bool(jobs))
+
+        # phase 2: run the scripts; recompute IsValid and demand the
+        # forger agreed (mismatch invalidates the BLOCK)
+        phase2_ok = True
+        budget = _Budget(tx.budget)
+        ctx_base = dict(
+            start=tx.start, end=tx.end, signatories=signatories, fee=tx.fee,
+        )
+        try:
+            for script, datum, redeemer in jobs:
+                run_script(
+                    script,
+                    ScriptContext(datum=datum, redeemer=redeemer, **ctx_base),
+                    budget,
+                )
+        except Phase2Error:
+            phase2_ok = False
+        if phase2_ok != tx.is_valid:
+            raise IsValidMismatch(
+                f"forger claimed IsValid={tx.is_valid}, "
+                f"recomputed {phase2_ok}"
+            )
+        if not phase2_ok:
+            self._consume_collateral(view, tx)
+            return view
+
+        # FORGE: key policies as Mary; plutus policies already ran above;
+        # timelock policies evaluate here
+        minted: dict[tuple[bytes, bytes], int] = {}
+        if tx.mint:
+            sd = mint_sig_data(
+                [list(i) for i in tx.ins], tx.outs_wire, tx.fee,
+                (tx.start, tx.end),
+            )
+            for vk, sig, pairs in tx.mint:
+                if sig is None:
+                    pid = script_hash(vk)
+                    if not is_plutus(vk) and not eval_timelock(
+                        decode_script(vk), signatories, tx.start, tx.end
+                    ):
+                        raise MintError(
+                            f"timelock policy failed for {pid.hex()[:8]}"
+                        )
+                else:
+                    if not host_ed25519.verify(vk, sd, sig):
+                        raise MintError(
+                            f"bad minting-policy signature for "
+                            f"{policy_id(vk).hex()[:8]}"
+                        )
+                    pid = policy_id(vk)
+                for name, qty in pairs:
+                    if qty == 0:
+                        continue
+                    minted[(pid, name)] = minted.get((pid, name), 0) + qty
+
+        scratch = self._scratch_of(view)
+        withdrawn = 0
+        seen = set()
+        for cred, amt in tx.withdrawals:
+            if cred in seen:
+                raise ShelleyTxError("duplicate withdrawal")
+            seen.add(cred)
+            if cred not in scratch.rewards:
+                raise ShelleyTxError(f"unregistered: {cred.hex()[:8]}")
+            if scratch.rewards[cred] != amt:
+                raise ShelleyTxError(
+                    f"must withdraw full balance {scratch.rewards[cred]}"
+                )
+            scratch.rewards[cred] = 0
+            withdrawn += amt
+        deposits_taken = refunds = 0
+        for cert in tx.certs:
+            try:
+                dep, ref = self._apply_cert(scratch, cert)
+            except ShelleyTxError:
+                raise
+            except Exception as e:
+                raise ShelleyTxError(f"malformed certificate: {e!r}") from e
+            deposits_taken += dep
+            refunds += ref
+
+        produced_out = sum(int(v) for _a, v in tx.outs)
+        if (consumed + withdrawn + refunds
+                != produced_out + tx.fee + deposits_taken):
+            raise ValueNotConserved(
+                consumed + withdrawn + refunds,
+                produced_out + tx.fee + deposits_taken,
+            )
+        produced_assets: dict[tuple[bytes, bytes], int] = {}
+        for _a, v in tx.outs:
+            if isinstance(v, MaryValue):
+                for k, q in v.assets:
+                    produced_assets[k] = produced_assets.get(k, 0) + q
+        lhs: dict[tuple[bytes, bytes], int] = dict(consumed_assets)
+        for k, q in minted.items():
+            lhs[k] = lhs.get(k, 0) + q
+        lhs = {k: q for k, q in lhs.items() if q}
+        if lhs != produced_assets:
+            raise ValueNotConserved(
+                sum(consumed_assets.values()) + sum(minted.values()),
+                sum(produced_assets.values()),
+            )
+
+        tid = tx_id(tx_bytes)
+        for txin in tx.ins:
+            del view.utxo[txin]
+        for ix, (addr, val) in enumerate(tx.outs):
+            view.utxo[(tid, ix)] = (addr, val)
+        self._commit_scratch(view, scratch, deposits_taken, refunds, tx.fee)
+        return view
+
+    # -- reapply (trusts the recorded IsValid flag) ------------------------
+
+    def reapply_block(self, ticked, block):
+        st = ticked.state
+        view = self.mempool_view(st, ticked.slot)
+        for tx_bytes in block.txs:
+            tx = self._decode_tx(tx_bytes)
+            if not tx.is_valid:
+                self._consume_collateral(view, tx)
+                continue
+            tid = tx_id(tx_bytes)
+            for txin in tx.ins:
+                view.utxo.pop(txin, None)
+            for ix, (addr, val) in enumerate(tx.outs):
+                view.utxo[(tid, ix)] = (addr, val)
+            for cred, _amt in tx.withdrawals:
+                view.rewards[cred] = 0
+            dep = ref = 0
+            for cert in tx.certs:
+                d, r = self._apply_cert(view, cert)
+                dep += d
+                ref += r
+            view.deposit_delta += dep - ref
+            view.fee_delta += tx.fee
+        st = replace(
+            st,
+            utxo=view.utxo,
+            stake_creds=view.stake_creds,
+            rewards=view.rewards,
+            delegations=view.delegations,
+            pools=view.pools,
+            pool_deposits=view.pool_deposits,
+            retiring=view.retiring,
+            proposals=view.proposals,
+            pending_mir=view.pending_mir,
+            fees=st.fees + view.fee_delta,
+            deposits=st.deposits + view.deposit_delta,
+            tip_slot_=ticked.slot,
+        )
+        return self._count_block(st, block)

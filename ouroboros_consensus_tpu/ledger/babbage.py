@@ -1,0 +1,302 @@
+"""Babbage-class era: the Alonzo rules extended with REFERENCE INPUTS,
+INLINE DATUMS, REFERENCE SCRIPTS and COLLATERAL RETURN — the era that
+lets scripts and datums live on chain instead of in every witness set.
+
+Reference: StandardBabbage (`Shelley/Eras.hs:85-97`) and the
+Alonzo→Babbage `CanHardFork` step (`Cardano/CanHardFork.hs:273`); rule
+deltas re-derived from cardano-ledger's Babbage UTXO/UTXOW rules
+(reference inputs are read-only, inline datums satisfy the datum
+witness, the collateral return output takes index |outs|).
+
+Tx wire (era-tagged; alonzo.decode_tx CANNOT parse it):
+  tx  = [ins, ref_ins, outs, fee, [start|null, end|null], certs,
+         withdrawals, mint, collateral, coll_return|null,
+         total_collateral, scripts, keywits, datums, redeemers,
+         budget, is_valid]
+  out = [addr, value]
+      | [addr, value, datum_field]
+      | [addr, value, datum_field|null, ref_script]
+  datum_field = [0, hash/32]       -- datum by hash (Alonzo-style)
+              | [1, datum_bytes]   -- INLINE datum
+  coll_return = out (ada-only; receives collateral change on phase-2
+                failure; the on-chain output id is (txid, |outs|))
+  total_collateral = the ada amount burned on phase-2 failure
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from ..ops.host.hashes import blake2b_256
+from ..utils import cbor
+from .allegra import MissingWitness, is_script_addr
+from .alonzo import (
+    AlonzoLedger,
+    AlonzoPParams,
+    AlonzoTx,
+    CollateralError,
+    datum_hash,
+    is_plutus,
+)
+from .mary import MaryValue, _decode_value, _encode_value
+from .shelley import (
+    BadInputs,
+    ShelleyState,
+    ShelleyTxError,
+    TxView,
+)
+
+# utxo address-tuple datum slot: either a 32-byte hash (Alonzo form) or
+# ("inline", datum_bytes)
+
+
+def _encode_datum_field(d):
+    if d is None:
+        return None
+    if isinstance(d, bytes) and len(d) == 32:
+        return [0, d]
+    if isinstance(d, tuple) and d[0] == "inline":
+        return [1, d[1]]
+    raise ShelleyTxError(f"bad datum field {d!r}")
+
+
+def _decode_datum_field(w):
+    if w is None:
+        return None
+    tag = int(w[0])
+    if tag == 0:
+        return bytes(w[1])
+    if tag == 1:
+        return ("inline", bytes(w[1]))
+    raise ShelleyTxError(f"bad datum field tag {tag}")
+
+
+def _encode_out(o):
+    p, s, v = o[0], o[1], o[2]
+    d = _encode_datum_field(o[3]) if len(o) > 3 else None
+    r = o[4] if len(o) > 4 else None
+    base = [[p, s], _encode_value(v)]
+    if d is None and r is None:
+        return base
+    if r is None:
+        return base + [d]
+    return base + [d, r]
+
+
+def _decode_out(o):
+    addr, v = o[0], o[1]
+    payment = bytes(addr[0])
+    stake = None if addr[1] is None else bytes(addr[1])
+    d = _decode_datum_field(o[2]) if len(o) > 2 else None
+    r = bytes(o[3]) if len(o) > 3 and o[3] is not None else None
+    parts = [payment, stake]
+    if d is not None or r is not None:
+        parts.append(d)
+    if r is not None:
+        parts.append(r)
+    return (tuple(parts), _decode_value(v))
+
+
+def encode_tx(ins, outs, fee=0, validity=(None, None), certs=(),
+              withdrawals=(), mint=(), ref_ins=(), collateral=(),
+              coll_return=None, total_collateral=0, scripts=(),
+              signers=(), datums=(), redeemers=(), budget=0,
+              is_valid=True) -> bytes:
+    """outs: [(payment, stake|None, value[, datum[, ref_script]])] where
+    datum is a 32-byte hash or ("inline", bytes)."""
+    outs_wire = [_encode_out(o) for o in outs]
+    cr_wire = None if coll_return is None else _encode_out(coll_return)
+    fields = [
+        [list(i) for i in ins],
+        [list(i) for i in ref_ins],
+        outs_wire,
+        fee,
+        [validity[0], validity[1]],
+        [list(c) for c in certs],
+        [list(w) for w in withdrawals],
+        [[vk, sg, [[n, q] for n, q in sorted(dict(am).items())]]
+         for vk, sg, am in mint],
+        [list(i) for i in collateral],
+        cr_wire,
+        int(total_collateral),
+        [s for s in scripts],
+    ]
+    from .allegra import body_hash_of, make_key_witness
+
+    bh = body_hash_of(fields)
+    wits = [list(make_key_witness(seed, bh)) for seed in signers]
+    return cbor.encode(fields + [
+        wits,
+        [d for d in datums],
+        [[int(p), int(ix), t] for p, ix, t in redeemers],
+        int(budget),
+        bool(is_valid),
+    ])
+
+
+@dataclass(frozen=True)
+class BabbageTx(AlonzoTx):
+    ref_ins: tuple[tuple[bytes, int], ...] = ()
+    coll_return: tuple | None = None  # decoded out or None
+    total_collateral: int = 0
+
+
+def decode_tx(tx_bytes: bytes) -> BabbageTx:
+    try:
+        (ins, ref_ins, outs, fee, validity, certs, wdrls, mint, coll,
+         cr, total_coll, scripts, wits, datums, redeemers, budget,
+         is_valid) = cbor.decode(tx_bytes)
+        start, end = validity
+        from .allegra import body_hash_of
+
+        # needed by key-witness checks AND as the collateral-return
+        # output id (_consume_collateral) — skip only when neither can
+        # ever read it
+        if wits or cr is not None:
+            bh = body_hash_of(
+                [ins, ref_ins, outs, fee, validity, certs, wdrls, mint,
+                 coll, cr, total_coll, scripts]
+            )
+        else:
+            bh = b""
+        return BabbageTx(
+            ins=tuple((bytes(i[0]), int(i[1])) for i in ins),
+            outs=tuple(_decode_out(o) for o in outs),
+            fee=int(fee),
+            start=None if start is None else int(start),
+            end=None if end is None else int(end),
+            certs=tuple(tuple(c) for c in certs),
+            withdrawals=tuple((bytes(w[0]), int(w[1])) for w in wdrls),
+            mint=tuple(
+                (bytes(vk), None if sg is None else bytes(sg),
+                 tuple((bytes(n), int(q)) for n, q in pairs))
+                for vk, sg, pairs in mint
+            ),
+            collateral=tuple((bytes(i[0]), int(i[1])) for i in coll),
+            scripts=tuple(bytes(s) for s in scripts),
+            keywits=tuple((bytes(w[0]), bytes(w[1])) for w in wits),
+            datums=tuple(bytes(d) for d in datums),
+            redeemers=tuple(
+                (int(r[0]), int(r[1]), r[2]) for r in redeemers
+            ),
+            budget=int(budget),
+            is_valid=bool(is_valid),
+            outs_wire=outs,
+            body_hash=bh,
+            size=len(tx_bytes),
+            ref_ins=tuple((bytes(i[0]), int(i[1])) for i in ref_ins),
+            coll_return=None if cr is None else _decode_out(cr),
+            total_collateral=int(total_coll),
+        )
+    except ShelleyTxError:
+        raise
+    except Exception as e:
+        raise ShelleyTxError(f"malformed babbage tx: {e!r}") from e
+
+
+def translate_tx_from_alonzo(tx_bytes: bytes) -> bytes:
+    """InjectTxs Alonzo→Babbage: no reference inputs, no collateral
+    return; everything else carries verbatim."""
+    (ins, outs, fee, validity, certs, wdrls, mint, coll, scripts,
+     wits, datums, redeemers, budget, is_valid) = cbor.decode(tx_bytes)
+    return cbor.encode([
+        ins, [], outs, fee, validity, certs, wdrls, mint, coll, None, 0,
+        scripts, wits, datums, redeemers, budget, is_valid,
+    ])
+
+
+class BabbageLedger(AlonzoLedger):
+    """AlonzoLedger + the Babbage deltas. The witness-resolution layer
+    (scripts, datums) now ALSO reads reference inputs; phase-2 failure
+    burns exactly total_collateral and pays the change to the collateral
+    return output."""
+
+    _decode_tx = staticmethod(decode_tx)
+
+    # -- era translation INTO Babbage --------------------------------------
+
+    def translate_from_alonzo(self, prev: ShelleyState) -> ShelleyState:
+        pp = prev.pparams
+        if not isinstance(pp, AlonzoPParams):
+            pp = AlonzoPParams.from_shelley(pp)
+        return replace(prev, pparams=pp)
+
+    # -- witness resolution with reference inputs --------------------------
+
+    def _resolve_witnesses(self, view: TxView, tx: BabbageTx):
+        """Witness-set scripts/datums plus everything the reference
+        inputs carry (Babbage UTXOW: refScripts/refDatums satisfy
+        witnessing)."""
+        from .allegra import script_hash
+
+        scripts_by_hash, datums_by_hash = super()._resolve_witnesses(
+            view, tx
+        )
+        for txin in tx.ref_ins:
+            if txin not in view.utxo:
+                raise BadInputs(txin)
+            addr = view.utxo[txin][0]
+            if len(addr) > 3 and addr[3] is not None:
+                scripts_by_hash.setdefault(script_hash(addr[3]), addr[3])
+            if len(addr) > 2 and isinstance(addr[2], tuple):
+                d = addr[2][1]
+                datums_by_hash.setdefault(datum_hash(d), d)
+        return scripts_by_hash, datums_by_hash
+
+    def _datum_for(self, addr, datums_by_hash):
+        """The datum term for a script-locked utxo entry: inline datum
+        directly, else by hash from the resolved datum set."""
+        d = addr[2] if len(addr) > 2 else None
+        if isinstance(d, tuple):  # ("inline", bytes)
+            try:
+                return cbor.decode(d[1])
+            except Exception as e:
+                raise ShelleyTxError(f"undecodable inline datum: {e!r}") from e
+        return super()._datum_for(addr, datums_by_hash)
+
+    def _check_collateral(self, view: TxView, tx: BabbageTx,
+                          need_phase2: bool) -> int:
+        total = super()._check_collateral(view, tx, need_phase2)
+        if need_phase2 and tx.coll_return is not None:
+            ret_val = int(tx.coll_return[1])
+            if isinstance(tx.coll_return[1], MaryValue) and \
+                    tx.coll_return[1].assets:
+                raise CollateralError("collateral return must be ada-only")
+            if tx.total_collateral != total - ret_val:
+                raise CollateralError(
+                    f"total_collateral {tx.total_collateral} != "
+                    f"collateral {total} - return {ret_val}"
+                )
+        return total
+
+    def _consume_collateral(self, view: TxView, tx: BabbageTx) -> None:
+        """Phase-2 failure: burn total_collateral into fees; the change
+        goes to the collateral return output at index |outs|."""
+        burned = 0
+        for txin in tx.collateral:
+            burned += int(view.utxo.pop(txin)[1])
+        if tx.coll_return is not None:
+            from .shelley import tx_id as _tx_id
+
+            # the decode path kept outs_wire; recompute the txid from
+            # the raw bytes the caller handed us is not available here,
+            # so the return output id uses the body hash — stable and
+            # collision-free within this ledger
+            addr, val = tx.coll_return
+            view.utxo[(tx.body_hash, len(tx.outs))] = (addr, val)
+            burned -= int(val)
+        view.fee_delta += burned
+
+    # the Alonzo _apply_decoded works verbatim on BabbageTx — the deltas
+    # ride the overridden seams (_resolve_witnesses, _datum_for,
+    # _check_collateral, _consume_collateral); only the reference-input
+    # precondition is new
+    def apply_tx(self, view: TxView, tx_bytes: bytes) -> TxView:
+        tx = decode_tx(tx_bytes)
+        # reference inputs must exist and are read-only
+        for txin in tx.ref_ins:
+            if txin not in view.utxo:
+                raise BadInputs(txin)
+            if txin in tx.ins:
+                raise ShelleyTxError("input is both spent and referenced")
+        return self._apply_decoded(view, tx, tx_bytes)

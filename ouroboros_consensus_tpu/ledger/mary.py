@@ -1,7 +1,7 @@
-"""Mary-class era: the Shelley rules extended with MULTI-ASSET values,
-MINTING, and Allegra-style VALIDITY INTERVALS — a post-Shelley era whose
-LEDGER genuinely differs (new tx wire format, new rules, new state
-value type), not just different protocol parameters.
+"""Mary-class era: the Allegra rules extended with MULTI-ASSET values
+and MINTING — a post-Shelley era whose LEDGER genuinely differs (new tx
+wire format, new rules, new state value type), not just different
+protocol parameters.
 
 Reference: the ShelleyMA eras (`Shelley/Eras.hs:82-97` StandardAllegra /
 StandardMary) and their `CanHardFork` translations
@@ -9,10 +9,13 @@ StandardMary) and their `CanHardFork` translations
 the value type widens Coin → MaryValue); rule deltas re-derived from
 cardano-ledger's ShelleyMA UTXO rule (validity interval replaces TTL,
 `consumed + mint == produced` per asset, minting policy witnesses).
+Timelock scripts, key witnesses and validity intervals are INHERITED
+from the Allegra ledger (ledger/allegra.py).
 
 Wire format (era-tagged; decode_tx of shelley.py CANNOT parse it):
   tx       = [inputs, outputs, fee, [start|null, end|null],
-              certs, withdrawals, mint]
+              certs, withdrawals, mint]                     -- classic, or
+             [..., mint, scripts, keywits]                  -- witnessed
   output   = [addr, coin]                     -- ada-only, or
              [addr, [coin, assets]]           -- multi-asset
   assets   = [[policy_id/28, [[name, qty]...]]...]
@@ -20,6 +23,12 @@ Wire format (era-tagged; decode_tx of shelley.py CANNOT parse it):
              -- policy id = blake2b-224(policy_vk); sig over the
                 witness-free body hash (mint_sig_data); qty may be
                 negative (burn)
+           | [[script_bytes, null, [[name, qty]...]]...]
+             -- TIMELOCK policy: policy id = blake2b-224(script);
+                evalTimelock over the tx interval + signatory set
+  scripts / keywits exactly as Allegra (allegra.py docstring); the 7-
+  field classic form (golden-pinned in round 4) decodes unchanged with
+  empty witness sets.
   certs / withdrawals / addr exactly as Shelley (shelley.py docstring)
 """
 
@@ -31,24 +40,27 @@ from typing import Mapping
 from ..ops.host import ed25519 as host_ed25519
 from ..ops.host.hashes import blake2b_224, blake2b_256
 from ..utils import cbor
+from .allegra import (
+    AllegraLedger,
+    OutsideValidityInterval,  # noqa: F401 — era re-export (round-4 API)
+    ScriptError,  # noqa: F401 — era re-export
+    body_hash_of,
+    decode_script,
+    eval_timelock,
+    make_key_witness,
+    script_hash,
+)
 from .shelley import (
     BadInputs,
     ExpiredTx,
     FeeTooSmall,
     MaxTxSizeExceeded,
-    ShelleyLedger,
     ShelleyState,
     ShelleyTxError,
     TxView,
     ValueNotConserved,
     tx_id,
 )
-
-
-class OutsideValidityInterval(ShelleyTxError):
-    def __init__(self, start, end, slot):
-        super().__init__(f"slot {slot} outside validity [{start}, {end}]")
-        self.start, self.end, self.slot = start, end, slot
 
 
 class MintError(ShelleyTxError):
@@ -116,10 +128,13 @@ def _encode_value(v) -> object:
 
 
 def encode_tx(ins, outs, fee=0, validity=(None, None), certs=(),
-              withdrawals=(), mint=()) -> bytes:
+              withdrawals=(), mint=(), scripts=(), signers=()) -> bytes:
     """outs: [(payment, stake|None, value)] where value is an int or a
-    MaryValue; mint: [(policy_vk, sig, {name: qty})]."""
-    return cbor.encode([
+    MaryValue; mint: [(policy_vk, sig, {name: qty})] or
+    [(script_bytes, None, {name: qty})] for timelock policies. Without
+    scripts/signers the classic 7-field (round-4 golden-pinned) form is
+    emitted byte-for-byte."""
+    fields = [
         [list(i) for i in ins],
         [[[p, s], _encode_value(v)] for p, s, v in outs],
         fee,
@@ -128,7 +143,12 @@ def encode_tx(ins, outs, fee=0, validity=(None, None), certs=(),
         [list(w) for w in withdrawals],
         [[vk, sg, [[n, q] for n, q in sorted(dict(am).items())]]
          for vk, sg, am in mint],
-    ])
+    ]
+    if not scripts and not signers:
+        return cbor.encode(fields)
+    bh = body_hash_of(fields)
+    wits = [list(make_key_witness(seed, bh)) for seed in signers]
+    return cbor.encode(fields + [[s for s in scripts], wits])
 
 
 def mint_sig_data(ins, outs_wire, fee, validity) -> bytes:
@@ -163,15 +183,29 @@ class MaryTx:
     end: int | None
     certs: tuple[tuple, ...]
     withdrawals: tuple[tuple[bytes, int], ...]
-    mint: tuple[tuple[bytes, bytes, tuple], ...]  # (vk, sig, ((name, qty)..))
+    mint: tuple[tuple[bytes, bytes | None, tuple], ...]
+    # (vk, sig, ((name, qty)..)) or (script_bytes, None, ((name, qty)..))
     outs_wire: tuple  # as decoded, for mint_sig_data recomputation
     size: int
+    scripts: tuple[bytes, ...] = ()
+    keywits: tuple[tuple[bytes, bytes], ...] = ()
+    body_hash: bytes = b""
 
 
 def decode_tx(tx_bytes: bytes) -> MaryTx:
     try:
-        ins, outs, fee, validity, certs, wdrls, mint = cbor.decode(tx_bytes)
+        decoded = cbor.decode(tx_bytes)
+        if len(decoded) == 7:
+            (ins, outs, fee, validity, certs, wdrls, mint) = decoded
+            scripts, wits = [], []
+        else:
+            (ins, outs, fee, validity, certs, wdrls, mint,
+             scripts, wits) = decoded
         start, end = validity
+        # the body hash only feeds key-witness verification — skip the
+        # re-encode+hash for the witness-free classic form (the entire
+        # round-4 replay hot path)
+        bh = body_hash_of(list(decoded[:7])) if wits else b""
         return MaryTx(
             ins=tuple((bytes(i[0]), int(i[1])) for i in ins),
             outs=tuple(
@@ -185,12 +219,15 @@ def decode_tx(tx_bytes: bytes) -> MaryTx:
             certs=tuple(tuple(c) for c in certs),
             withdrawals=tuple((bytes(w[0]), int(w[1])) for w in wdrls),
             mint=tuple(
-                (bytes(vk), bytes(sg),
+                (bytes(vk), None if sg is None else bytes(sg),
                  tuple((bytes(n), int(q)) for n, q in pairs))
                 for vk, sg, pairs in mint
             ),
             outs_wire=outs,
             size=len(tx_bytes),
+            scripts=tuple(bytes(s) for s in scripts),
+            keywits=tuple((bytes(w[0]), bytes(w[1])) for w in wits),
+            body_hash=bh,
         )
     except ShelleyTxError:
         raise
@@ -206,11 +243,12 @@ def translate_tx_from_shelley(tx_bytes: bytes) -> bytes:
     return cbor.encode([ins, outs, fee, [None, ttl], certs, wdrls, []])
 
 
-class MaryLedger(ShelleyLedger):
-    """ShelleyLedger with the ShelleyMA rule deltas. Certificates,
-    epoch boundaries, snapshots, rewards, pool reap and PPUP adoption
-    are INHERITED — the Mary era changes the value/tx layer only, like
-    the reference's ShelleyMA eras sharing the Shelley rule family."""
+class MaryLedger(AllegraLedger):
+    """AllegraLedger with the Mary rule deltas (multi-asset + FORGE).
+    Timelock scripts, key witnesses and validity intervals come from
+    Allegra; certificates, epoch boundaries, snapshots, rewards, pool
+    reap and PPUP adoption from Shelley — the Mary era changes the
+    value/tx layer only, like the reference's ShelleyMA rule family."""
 
     # the inherited REAPPLY path must parse the Mary wire format
     _decode_tx = staticmethod(decode_tx)
@@ -218,9 +256,10 @@ class MaryLedger(ShelleyLedger):
     # -- era translation INTO Mary ----------------------------------------
 
     def translate_from_shelley(self, prev: ShelleyState) -> ShelleyState:
-        """Shelley→Mary state translation: identical fields; every UTxO
-        value widens Coin → MaryValue (ada-only). Snapshots/pots carry
-        verbatim (CanHardFork.hs:273 Shelley-family steps)."""
+        """Shelley→Mary state translation (also Allegra→Mary — the state
+        shapes are identical): every UTxO value widens Coin → MaryValue
+        (ada-only). Snapshots/pots carry verbatim (CanHardFork.hs:273
+        Shelley-family steps)."""
         return replace(
             prev,
             utxo={
@@ -228,6 +267,8 @@ class MaryLedger(ShelleyLedger):
                 for k, (addr, c) in prev.utxo.items()
             },
         )
+
+    translate_from_allegra = translate_from_shelley
 
     # -- the Mary UTXOW/UTXO rules ----------------------------------------
 
@@ -240,10 +281,7 @@ class MaryLedger(ShelleyLedger):
             raise BadInputs(tx.ins[0])
         # Allegra validity interval (replaces Shelley's TTL): the slot
         # must lie in [start, end]
-        if tx.start is not None and view.slot < tx.start:
-            raise OutsideValidityInterval(tx.start, tx.end, view.slot)
-        if tx.end is not None and view.slot > tx.end:
-            raise ExpiredTx(tx.end, view.slot)
+        self.check_validity_interval(view, tx.start, tx.end)
         if tx.size > pp.max_tx_size:
             raise MaxTxSizeExceeded(tx.size, pp.max_tx_size)
         min_fee = pp.min_fee_a * tx.size + pp.min_fee_b
@@ -263,7 +301,17 @@ class MaryLedger(ShelleyLedger):
                 for k, q in val.assets:
                     consumed_assets[k] = consumed_assets.get(k, 0) + q
 
-        # FORGE (mint) rule: every group witnessed by its policy key
+        # Allegra witness layer: verified key witnesses feed
+        # RequireSignature; script-locked inputs need their timelock
+        signatories = self.collect_signatories(tx.keywits, tx.body_hash)
+        self.check_script_inputs(
+            view, tx.ins, self.script_map(tx.scripts), signatories,
+            tx.start, tx.end,
+        )
+
+        # FORGE (mint) rule: every group witnessed by its policy — a
+        # signing key (sig over mint_sig_data) or a timelock script
+        # (policy id = script hash, evalTimelock in the tx context)
         minted: dict[tuple[bytes, bytes], int] = {}
         if tx.mint:
             sd = mint_sig_data(
@@ -271,12 +319,22 @@ class MaryLedger(ShelleyLedger):
                 (tx.start, tx.end),
             )
             for vk, sig, pairs in tx.mint:
-                if not host_ed25519.verify(vk, sd, sig):
-                    raise MintError(
-                        f"bad minting-policy signature for "
-                        f"{policy_id(vk).hex()[:8]}"
-                    )
-                pid = policy_id(vk)
+                if sig is None:
+                    # timelock policy: vk position carries script bytes
+                    pid = script_hash(vk)
+                    if not eval_timelock(
+                        decode_script(vk), signatories, tx.start, tx.end
+                    ):
+                        raise MintError(
+                            f"timelock policy failed for {pid.hex()[:8]}"
+                        )
+                else:
+                    if not host_ed25519.verify(vk, sd, sig):
+                        raise MintError(
+                            f"bad minting-policy signature for "
+                            f"{policy_id(vk).hex()[:8]}"
+                        )
+                    pid = policy_id(vk)
                 for name, qty in pairs:
                     if qty == 0:
                         continue

@@ -1,0 +1,114 @@
+"""Deviceless AOT executables for the pk stage programs.
+
+`scripts/aot_precompile.py` compiles each per-stage jit (kernels.
+split_stage_fns) against a v5e `TopologyDescription` with NO device
+attached — libtpu's compile-only client runs on the build box — and
+serializes the PJRT executables here.  A live TPU session
+(scripts/tpu_session.sh -> bench.py) then deserializes and RUNS instead
+of compiling, so a flaky-tunnel window goes straight to measurement
+instead of spending its first ~5 minutes in Mosaic.
+
+The reference ships pre-linked native crypto (libsodium `.so`s resolved
+at node start, ouroboros-consensus-cardano/../Praos.hs links against
+cardano-crypto-praos); the tpu-native analog of "crypto compiled before
+the node runs" is PJRT executable serialization
+(jax.experimental.serialize_executable).
+
+Everything here is fail-soft: any load/deserialize/run error disables
+the AOT path for that stage and the caller falls back to the normal
+per-stage jit (persistent compilation cache), which is never worse than
+round 4's behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+_DIR_ENV = "OCT_PK_AOT_DIR"
+_ENABLE_ENV = "OCT_PK_AOT"  # "0" disables AOT dispatch (default: on —
+# a missing/incompatible cache entry falls back to the jit path, so the
+# driver's bench.py run picks the executables up with no env plumbing)
+
+
+def aot_dir() -> str:
+    d = os.environ.get(_DIR_ENV, "")
+    if d:
+        return d
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(here)))
+    return os.path.join(repo, "scripts", "aot_cache")
+
+
+def enabled() -> bool:
+    return os.environ.get(_ENABLE_ENV, "1") != "0"
+
+
+def sig_of(args) -> str:
+    """8-hex-char signature of the argument shapes+dtypes. Executables
+    are shape-exact, and the KES hash-block count varies per batch (it
+    tracks the longest signed header bytes in the batch), so the
+    signature — not just (batch, depth, tile) — keys the cache file."""
+    import hashlib
+
+    parts = [f"{tuple(a.shape)}:{a.dtype}" for a in args]
+    return hashlib.blake2s(
+        "|".join(parts).encode(), digest_size=4
+    ).hexdigest()
+
+
+def stage_path(name: str, b: int, kes_depth: int, tile: int,
+               sig: str) -> str:
+    return os.path.join(
+        aot_dir(), f"{name}_b{b}_d{kes_depth}_t{tile}_{sig}.jaxexec"
+    )
+
+
+def save(name: str, b: int, kes_depth: int, tile: int, sig: str, compiled,
+         meta: dict) -> str:
+    """Serialize a jax.stages.Compiled to the AOT cache (atomic)."""
+    from jax.experimental import serialize_executable as se
+
+    ser, in_tree, out_tree = se.serialize(compiled)
+    path = stage_path(name, b, kes_depth, tile, sig)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    blob = pickle.dumps(
+        {"ser": ser, "in_tree": in_tree, "out_tree": out_tree, "meta": meta}
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+_LOADED: dict = {}
+
+
+def load(name: str, b: int, kes_depth: int, tile: int, sig: str):
+    """Deserialize-and-load a stage executable onto the live backend.
+
+    Returns a callable with the stage fn's signature, or None (missing
+    file, deserialization failure, incompatible runtime). Memoized —
+    including negative results, so a failing stage is probed once."""
+    key = (name, b, kes_depth, tile, sig)
+    if key in _LOADED:
+        return _LOADED[key]
+    result = None
+    path = stage_path(name, b, kes_depth, tile, sig)
+    if os.path.exists(path):
+        try:
+            from jax.experimental import serialize_executable as se
+
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
+            result = se.deserialize_and_load(
+                blob["ser"], blob["in_tree"], blob["out_tree"]
+            )
+        except Exception as e:  # noqa: BLE001 — fail-soft by contract
+            import sys
+
+            print(f"# pk-aot: load {key} failed: {e!r}", file=sys.stderr)
+            result = None
+    _LOADED[key] = result
+    return result

@@ -43,6 +43,13 @@ _BASE8_SHAPE = pc.BASE8_NP.shape  # [32, 80, 256] f32
 
 
 def _interpret() -> bool:
+    # OCT_PK_INTERPRET=0 forces real Mosaic lowering even when the
+    # default backend is CPU — required for deviceless AOT compilation
+    # against a TPU TopologyDescription (scripts/aot_precompile.py);
+    # =1 forces interpret mode (the ≤60s composed smoke test).
+    force = os.environ.get("OCT_PK_INTERPRET", "")
+    if force in ("0", "1"):
+        return force == "1"
     return jax.devices()[0].platform != "tpu"
 
 
@@ -360,6 +367,34 @@ def _jit1(key, fn):
     return _SPLIT_JIT[key]
 
 
+def _stage_call(name, fn, b, kes_depth, *args):
+    """Dispatch one stage: precompiled AOT executable when available
+    (OCT_PK_AOT=1 + a matching scripts/aot_cache entry — see ops/pk/aot),
+    else the per-stage jit. An AOT call that fails at runtime disables
+    that executable and falls back, so AOT can never be worse than the
+    round-4 jit path."""
+    from . import aot
+
+    if aot.enabled():
+        sig = aot.sig_of(args)
+        key = (name, b, kes_depth, TILE, sig)
+        ex = aot.load(name, b, kes_depth, TILE, sig)
+        if ex is not None:
+            try:
+                # block before returning: device-side failures surface
+                # asynchronously, and an error escaping this try at the
+                # caller's materialization point would defeat the
+                # fallback contract
+                return jax.block_until_ready(ex(*args))
+            except Exception as e:  # noqa: BLE001 — fail-soft by contract
+                import sys
+
+                print(f"# pk-aot: run {key} failed, falling back: {e!r}",
+                      file=sys.stderr)
+                aot._LOADED[key] = None
+    return fn(*args)
+
+
 def split_stage_fns(kes_depth: int):
     """The per-stage jitted callables, keyed for cache warm-up:
     [(name, fn), ...] in dependency order. Used by verify_praos_split
@@ -382,9 +417,12 @@ def verify_praos_split(
     beta, thr_lo, thr_hi,
     *, kes_depth: int,
 ):
-    """Same contract as verify_praos_staged, per-stage jits."""
+    """Same contract as verify_praos_staged, per-stage jits (or AOT
+    executables — _stage_call)."""
     stages = dict(split_stage_fns(kes_depth))
-    a = stages["relayout"](
+    b = np.asarray(beta).shape[0]
+    a = _stage_call(
+        "relayout", stages["relayout"], b, kes_depth,
         ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
         kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
         kes_hblocks, kes_hnblocks,
@@ -396,15 +434,20 @@ def verify_praos_split(
      l_kes_hb, l_kes_hnb,
      l_vrf_pk, l_vrf_g, l_vrf_c, l_vrf_s, l_vrf_al,
      l_beta, l_tlo, l_thi) = a
-    ed_ok, ed_pt = stages["ed"](l_ed_pk, l_ed_s, l_ed_hb, l_ed_hnb)
-    kes_ok, kes_pt = stages["kes"](
+    ed_ok, ed_pt = _stage_call(
+        "ed", stages["ed"], b, kes_depth, l_ed_pk, l_ed_s, l_ed_hb, l_ed_hnb
+    )
+    kes_ok, kes_pt = _stage_call(
+        "kes", stages["kes"], b, kes_depth,
         l_kes_vk, l_kes_per, l_kes_s, l_kes_leaf, l_kes_sib,
         l_kes_hb, l_kes_hnb,
     )
-    vrf_ok, vrf_pts = stages["vrf"](
+    vrf_ok, vrf_pts = _stage_call(
+        "vrf", stages["vrf"], b, kes_depth,
         l_vrf_pk, l_vrf_g, l_vrf_c, l_vrf_s, l_vrf_al
     )
-    return stages["finish"](
+    return _stage_call(
+        "finish", stages["finish"], b, kes_depth,
         ed_ok, ed_pt, l_ed_r, kes_ok, kes_pt, l_kes_r, vrf_ok, vrf_pts,
         l_vrf_c, l_beta, l_tlo, l_thi,
     )

@@ -1,9 +1,13 @@
 #!/bin/bash
-# One-shot TPU measurement session for round 3. Run when the axon tunnel
-# is healthy. Stages are separate processes so one wedge loses one stage,
-# not the session; everything lands in the persistent compilation cache
-# (/tmp/ouroboros-jax-cache) so the driver's bench.py run compiles
-# NOTHING. Logs to scripts/tpu_session_logs/.
+# One-shot TPU measurement session (round 5). Run when the axon tunnel
+# is healthy. Stages are separate processes so one wedge loses one
+# stage, not the session. Round-5 order (VERDICT r4 item 1): the
+# deviceless-AOT executables (scripts/aot_cache, compiled by
+# aot_precompile.py with NO device) are deserialized and RUN first —
+# capturing the never-measured vrf/finish stage timings within minutes
+# of the tunnel opening — then the end-to-end bench. On-device
+# compilation (time_pk_kernels) runs LAST, as attribution, because it
+# is the thing that historically wedged the tunnel.
 set -u
 cd "$(dirname "$0")/.."
 export JAX_COMPILATION_CACHE_DIR=/tmp/ouroboros-jax-cache
@@ -20,14 +24,22 @@ stage() {  # stage <name> <timeout-s> <cmd...>
 # 0. probe
 stage probe 120 python -c "import jax, jax.numpy as jnp; assert jax.devices()[0].platform=='tpu'; print((jnp.ones((8,8))+1).sum())" || true
 
-# 1. per-kernel compile attribution + hot timing at production batch
-#    (tile=128). This ALSO populates the cache for every kernel.
-stage time_kernels 3500 python -u scripts/time_pk_kernels.py 8192
+# 1. AOT smoke: deserialize the precompiled v5e stage executables and
+#    time them (vrf/finish first), then the composed dispatch + a
+#    verdict cross-check vs the native verifier. ~0 compile time.
+stage aot_smoke 1200 python -u scripts/aot_smoke.py
 
-# 2. end-to-end bench exactly as the driver runs it (cache now warm)
+# 2. end-to-end bench exactly as the driver runs it (AOT dispatch is
+#    default-on; any stage whose executable fails to load falls back to
+#    jit + the persistent cache)
 stage bench 1800 python -u bench.py
 
 # 3. the BASELINE config suite (configs 2-5 device-side numbers)
 stage bench_suite 3600 python -u scripts/bench_suite.py --scale 0.5
+
+# 4. per-kernel ON-DEVICE compile attribution (the wedge-prone step —
+#    deliberately last; also fills the persistent cache for non-AOT
+#    shapes)
+stage time_kernels 3500 python -u scripts/time_pk_kernels.py 8192
 
 echo "session done $(date -u +%H:%M:%S); logs in $LOGDIR"
