@@ -87,6 +87,15 @@ class CardanoMockConfig:
     # reference's db-analyser always replays the real ledger; opt-in so
     # the consensus-only bench path stays unchanged).
     with_ledgers: bool = False
+    # THE FULL 7-ERA CHAIN (Cardano/Block.hs:96): byron → shelley →
+    # allegra → mary → alonzo → babbage → conway, each Shelley-family
+    # step a genuinely different RULE SET (timelocks / multi-asset /
+    # phase-2 scripts / reference inputs / governance), TPraos through
+    # alonzo and Praos from babbage on (Shelley/Eras.hs:85-97). Each
+    # bounded era lasts `era_epochs`; conway is open-ended. Overrides
+    # the conway_epochs/leios_epochs legacy knobs.
+    seven_era: bool = False
+    era_epochs: int = 2
 
 
 class CardanoMock:
@@ -143,6 +152,9 @@ class CardanoMock:
         )
         self.tpraos_proto = tpraos.TPraosProtocol(self.tpraos_params)
         nonce = cfg.shelley_initial_nonce
+        if cfg.seven_era:
+            self._init_seven_era(nonce)
+            return
         era_params = [
             EraParams(cfg.byron_epoch_length, Fraction(1)),
             EraParams(cfg.epoch_length, Fraction(1)),
@@ -226,6 +238,147 @@ class CardanoMock:
         self.hf_ledger = None
         if cfg.with_ledgers:
             self._init_ledgers()
+
+    def _init_seven_era(self, nonce: bytes) -> None:
+        """The full 7-era composite: era list, HFC summary, decoders,
+        and (with_ledgers) the six real rule sets with their pairwise
+        translations (CanHardFork.hs:273)."""
+        cfg = self.cfg
+        era_params = [EraParams(cfg.byron_epoch_length, Fraction(1))] + [
+            EraParams(cfg.epoch_length, Fraction(1))
+        ] * 6
+        bounds: list = [cfg.byron_epochs]
+        for _ in range(5):
+            bounds.append(bounds[-1] + cfg.era_epochs)
+        bounds.append(None)
+        self.summary = summarize(Fraction(0), era_params, bounds)
+        self.praos_proto = PraosProtocol(self.praos_params)
+        self.eras = [
+            Era("byron", self.pbft, ledger=None),
+            Era(
+                "shelley", self.tpraos_proto, ledger=None,
+                translate_chain_dep=lambda _s: replace(
+                    tpraos.TPraosState(), epoch_nonce=nonce
+                ),
+            ),
+            Era("allegra", self.tpraos_proto, ledger=None,
+                translate_chain_dep=lambda s: s),
+            Era("mary", self.tpraos_proto, ledger=None,
+                translate_chain_dep=lambda s: s),
+            Era("alonzo", self.tpraos_proto, ledger=None,
+                translate_chain_dep=lambda s: s),
+            # the protocol CLASS changes here, like the reference's
+            # Babbage step (TPraos -> Praos)
+            Era("babbage", self.praos_proto, ledger=None,
+                translate_chain_dep=tpraos.translate_state),
+            Era("conway", self.praos_proto, ledger=None,
+                translate_chain_dep=lambda s: s),
+        ]
+        self.decoders = [ByronMockBlock.from_bytes] + [
+            PraosBlock.from_bytes
+        ] * 6
+        self.inner_params = [
+            None,
+            self.tpraos_params, self.tpraos_params, self.tpraos_params,
+            self.tpraos_params,
+            self.praos_params, self.praos_params,
+        ]
+        self.hf = HardForkProtocol(self.eras, self.summary)
+        self.hf_ledger = None
+        if cfg.with_ledgers:
+            self._init_seven_era_ledgers()
+
+    def _init_seven_era_ledgers(self) -> None:
+        from ..ledger import allegra as al
+        from ..ledger import alonzo as az
+        from ..ledger import babbage as bb
+        from ..ledger import conway as cw
+        from ..ledger import mary as mary_mod
+        from ..ledger.allegra import AllegraLedger
+        from ..ledger.alonzo import AlonzoLedger
+        from ..ledger.babbage import BabbageLedger
+        from ..ledger.byron import ByronGenesis, ByronLedger, ByronPParams
+        from ..ledger.conway import ConwayLedger
+        from ..ledger.mary import MaryLedger
+        from ..ledger.shelley import (
+            PParams as ShPParams,
+            ShelleyGenesis,
+            ShelleyLedger,
+        )
+
+        cfg = self.cfg
+        shelley_start = self.summary.eras[1].start.slot
+        self.byron_ledger = ByronLedger(ByronGenesis(
+            pparams=ByronPParams(
+                min_fee_a=self.LEDGER_BYRON_FEE, min_fee_b=0
+            ),
+            genesis_keys=tuple(d.vk_cold for d in self.delegs),
+            epoch_length=cfg.byron_epoch_length,
+            security_param=cfg.k,
+        ))
+
+        def era_genesis(era_ix: int) -> ShelleyGenesis:
+            bound = self.summary.eras[era_ix].start
+            return ShelleyGenesis(
+                pparams=ShPParams(min_fee_a=0, min_fee_b=0),
+                epoch_length=cfg.epoch_length,
+                stability_window=3 * cfg.k,
+                era_start_slot=bound.slot,
+                era_start_epoch=bound.epoch,
+            )
+
+        shelley_led = ShelleyLedger(era_genesis(1))
+        allegra_led = AllegraLedger(era_genesis(2))
+        mary_led = MaryLedger(era_genesis(3))
+        alonzo_led = AlonzoLedger(era_genesis(4))
+        babbage_led = BabbageLedger(era_genesis(5))
+        conway_led = ConwayLedger(era_genesis(6))
+        self.eras = [
+            replace(self.eras[0], ledger=self.byron_ledger),
+            replace(
+                self.eras[1], ledger=shelley_led,
+                translate_ledger_state=(
+                    lambda st: shelley_led.translate_from_utxo_ledger(
+                        st, at_slot=shelley_start
+                    )
+                ),
+            ),
+            replace(
+                self.eras[2], ledger=allegra_led,
+                # Shelley→Allegra: state identical (Coin stays Coin)
+                translate_ledger_state=allegra_led.translate_from_shelley,
+                translate_tx=al.translate_tx_from_shelley,
+            ),
+            replace(
+                self.eras[3], ledger=mary_led,
+                # Allegra→Mary: Coin widens to MaryValue
+                translate_ledger_state=mary_led.translate_from_allegra,
+                translate_tx=mary_mod.translate_tx_from_allegra,
+            ),
+            replace(
+                self.eras[4], ledger=alonzo_led,
+                # Mary→Alonzo: pparams widen with script economics
+                translate_ledger_state=alonzo_led.translate_from_mary,
+                translate_tx=az.translate_tx_from_mary,
+            ),
+            replace(
+                self.eras[5], ledger=babbage_led,
+                translate_ledger_state=babbage_led.translate_from_alonzo,
+                translate_tx=bb.translate_tx_from_alonzo,
+            ),
+            replace(
+                self.eras[6], ledger=conway_led,
+                # Babbage→Conway: ConwayState (gov sub-state), PPUP
+                # proposals dropped
+                translate_ledger_state=conway_led.translate_from_babbage,
+                translate_tx=cw.translate_tx_from_babbage,
+            ),
+        ]
+        self.hf = HardForkProtocol(self.eras, self.summary)
+        self.hf_ledger = HardForkLedger(self.eras, self.summary)
+
+    def is_tpraos_era(self, era: int) -> bool:
+        return isinstance(self.eras[era].protocol, tpraos.TPraosProtocol)
 
     # the well-known spending key of the ledger-backed composite: the
     # whole synthesized value chain rides on it (revalidate re-derives
@@ -325,9 +478,9 @@ class CardanoMock:
         return self.hf_ledger.genesis_state(inner)
 
     def view_for_era(self, era: int):
-        return None if era == 0 else (
-            self.tpraos_view if era == 1 else self.praos_view
-        )
+        if era == 0:
+            return None
+        return self.tpraos_view if self.is_tpraos_era(era) else self.praos_view
 
 
 # ---------------------------------------------------------------------------
@@ -355,28 +508,72 @@ class _LedgerTxChain:
         self.minted = False
 
     def tx_for(self, era: int) -> bytes:
+        """One tx for the next block of `era`, dispatched on the era's
+        LEDGER CLASS (the same builder serves the legacy 3/5-era chain,
+        where the later eras run Mary-class rules, and the 7-era chain,
+        where every era has its own rule set)."""
+        from ..ledger.allegra import AllegraLedger
+        from ..ledger.alonzo import AlonzoLedger
+        from ..ledger.babbage import BabbageLedger
+        from ..ledger.byron import ByronLedger
+        from ..ledger.conway import ConwayLedger
+        from ..ledger.mary import MaryLedger
+        from ..ledger.shelley import ShelleyLedger
+
+        led = self.cm.eras[era].ledger
+        if isinstance(led, ByronLedger):
+            return self._byron_tx()
+        if isinstance(led, ConwayLedger):
+            return self._conway_tx()
+        if isinstance(led, BabbageLedger):
+            return self._babbage_tx()
+        if isinstance(led, AlonzoLedger):
+            return self._alonzo_tx()
+        if isinstance(led, MaryLedger):
+            return self._mary_tx()
+        if isinstance(led, AllegraLedger):
+            return self._allegra_tx()
+        assert isinstance(led, ShelleyLedger), led
+        return self._shelley_tx()
+
+    def _byron_tx(self) -> bytes:
         from ..ledger import byron as byron_led
+
+        fee = self.cm.LEDGER_BYRON_FEE
+        outs = [(self.addr, self.value - fee)]
+        tx = byron_led.make_tx(
+            [self.outpoint], outs, [self.cm.LEDGER_SPEND_SEED]
+        )
+        self.outpoint = (byron_led.tx_id_of([self.outpoint], outs), 0)
+        self.value -= fee
+        return tx
+
+    def _shelley_tx(self) -> bytes:
+        from ..ledger import shelley as shelley_mod
+
+        tx = shelley_mod.encode_tx(
+            [self.outpoint], [(self.addr, None, self.value)],
+            fee=0, ttl=2**62,
+        )
+        self.outpoint = (shelley_mod.tx_id(tx), 0)
+        return tx
+
+    def _allegra_tx(self) -> bytes:
+        from ..ledger import allegra as al
+        from ..ledger import shelley as shelley_mod
+
+        tx = al.encode_tx(
+            [self.outpoint], [(self.addr, None, self.value)], fee=0,
+        )
+        self.outpoint = (shelley_mod.tx_id(tx), 0)
+        return tx
+
+    def _mary_tx(self) -> bytes:
         from ..ledger import mary as mary_mod
         from ..ledger import shelley as shelley_mod
         from ..ops.host import ed25519 as host_ed25519
 
-        if era == 0:
-            fee = self.cm.LEDGER_BYRON_FEE
-            outs = [(self.addr, self.value - fee)]
-            tx = byron_led.make_tx(
-                [self.outpoint], outs, [self.cm.LEDGER_SPEND_SEED]
-            )
-            self.outpoint = (byron_led.tx_id_of([self.outpoint], outs), 0)
-            self.value -= fee
-            return tx
-        if era == 1:
-            tx = shelley_mod.encode_tx(
-                [self.outpoint], [(self.addr, None, self.value)],
-                fee=0, ttl=2**62,
-            )
-            self.outpoint = (shelley_mod.tx_id(tx), 0)
-            return tx
-        # Mary-class era: mint once, then carry the asset along
+        # mint once, then carry the asset along
         pid = mary_mod.policy_id(
             host_ed25519.secret_to_public(self.cm.MINT_POLICY_SEED)
         )
@@ -394,6 +591,124 @@ class _LedgerTxChain:
             outs = [(self.addr, None,
                      mary_mod.MaryValue(self.value, self.assets))]
             tx = mary_mod.encode_tx([self.outpoint], outs)
+        self.outpoint = (shelley_mod.tx_id(tx), 0)
+        return tx
+
+    # phase-2 exercise state (alonzo era): 0 = not started, 1 = locked
+    # (p2/collateral outpoints live), 2 = spent
+    _p2_stage = 0
+    _p2_out = None
+    _coll_out = None
+    _gov_stage = 0
+    _gov_action_tid = None
+
+    def _p2_script(self):
+        from ..ledger import alonzo as az
+        from ..utils import cbor
+
+        script = az.plutus_script([4, [1], [2]])  # redeemer == datum
+        datum = cbor.encode(b"open-sesame")
+        return script, datum
+
+    def _alonzo_tx(self) -> bytes:
+        from ..ledger import allegra as al
+        from ..ledger import alonzo as az
+        from ..ledger import mary as mary_mod
+        from ..ledger import shelley as shelley_mod
+        from ..utils import cbor
+
+        script, datum = self._p2_script()
+        if self._p2_stage == 0:
+            # split: carry + a phase-2 locked output + ada-only collateral
+            saddr = al.script_addr(script)
+            dh = az.datum_hash(datum)
+            outs = [
+                (self.addr, None,
+                 mary_mod.MaryValue(self.value - 10, self.assets)),
+                (saddr, None, 5, dh),
+                (self.addr, None, 5),
+            ]
+            tx = az.encode_tx([self.outpoint], outs)
+            tid = shelley_mod.tx_id(tx)
+            self.outpoint = (tid, 0)
+            self._p2_out = (tid, 1)
+            self._coll_out = (tid, 2)
+            self.value -= 10
+            self._p2_stage = 1
+            return tx
+        if self._p2_stage == 1:
+            # spend the locked output under the script (phase 2 runs
+            # during revalidation, incl. the ledger replay)
+            tx = az.encode_tx(
+                [self._p2_out], [(self.addr, None, 4)],
+                collateral=[self._coll_out],
+                scripts=[script], datums=[datum],
+                redeemers=[(0, 0, cbor.decode(datum))],
+                budget=100, fee=1,
+            )
+            self._p2_stage = 2
+            return tx
+        tx = az.encode_tx(
+            [self.outpoint],
+            [(self.addr, None, mary_mod.MaryValue(self.value, self.assets))],
+        )
+        self.outpoint = (shelley_mod.tx_id(tx), 0)
+        return tx
+
+    def _babbage_tx(self) -> bytes:
+        from ..ledger import babbage as bb
+        from ..ledger import mary as mary_mod
+        from ..ledger import shelley as shelley_mod
+
+        tx = bb.encode_tx(
+            [self.outpoint],
+            [(self.addr, None, mary_mod.MaryValue(self.value, self.assets))],
+        )
+        self.outpoint = (shelley_mod.tx_id(tx), 0)
+        return tx
+
+    DREP_CRED = b"composite-drep-cred-28-bytes"  # 28 bytes
+
+    def _conway_tx(self) -> bytes:
+        from ..ledger import conway as cw
+        from ..ledger import mary as mary_mod
+        from ..ledger import shelley as shelley_mod
+
+        if self._gov_stage == 0:
+            # register a DRep and propose a (harmless) param change —
+            # deposits ride the conservation equation; with no stake
+            # delegated the action expires and refunds to treasury
+            pp = cw.ConwayPParams()
+            dep = pp.drep_deposit + pp.gov_action_deposit
+            tx = cw.encode_tx(
+                [self.outpoint],
+                [(self.addr, None,
+                  mary_mod.MaryValue(self.value - dep, self.assets))],
+                certs=[[7, self.DREP_CRED]],
+                proposals=[(self.DREP_CRED, [0, {b"min_fee_b": 0}])],
+            )
+            tid = shelley_mod.tx_id(tx)
+            self.outpoint = (tid, 0)
+            self.value -= dep
+            self._gov_action_tid = tid
+            self._gov_stage = 1
+            return tx
+        if self._gov_stage == 1:
+            # the registered DRep votes yes (zero stake — exercises the
+            # vote path without ratifying)
+            tx = cw.encode_tx(
+                [self.outpoint],
+                [(self.addr, None,
+                  mary_mod.MaryValue(self.value, self.assets))],
+                votes=[(self.DREP_CRED, self._gov_action_tid, 0, True)],
+            )
+            self.outpoint = (shelley_mod.tx_id(tx), 0)
+            self._gov_stage = 2
+            return tx
+        tx = cw.encode_tx(
+            [self.outpoint],
+            [(self.addr, None, mary_mod.MaryValue(self.value, self.assets))],
+        )
         self.outpoint = (shelley_mod.tx_id(tx), 0)
         return tx
 
@@ -444,7 +759,7 @@ def synthesize(path: str, cfg: CardanoMockConfig, n_slots: int, chunk_size: int 
         else:
             params = cm.inner_params[era]
             eta0 = ticked.inner.state.epoch_nonce
-            if era == 1:
+            if cm.is_tpraos_era(era):
                 a = tpraos.overlay_slot_assignment(
                     cm.tpraos_params, cfg.n_delegs, slot
                 )

@@ -367,17 +367,22 @@ def decode_tx(tx_bytes: bytes) -> AlonzoTx:
 
 
 def translate_tx_from_mary(tx_bytes: bytes) -> bytes:
-    """InjectTxs Mary→Alonzo: no collateral/scripts/datums/redeemers;
-    classic mint groups carry verbatim; IsValid is trivially true."""
+    """InjectTxs Mary→Alonzo: no collateral/datums/redeemers; classic
+    mint groups carry verbatim; IsValid is trivially true. Witnessed
+    txs cannot cross (key witnesses sign the era's body shape — the
+    reference's InjectTxs is partial the same way)."""
     decoded = cbor.decode(tx_bytes)
     if len(decoded) == 7:
         ins, outs, fee, validity, certs, wdrls, mint = decoded
-        scripts, wits = [], []
     else:
         ins, outs, fee, validity, certs, wdrls, mint, scripts, wits = decoded
+        if scripts or wits:
+            raise ShelleyTxError(
+                "witnessed mary tx cannot cross the era boundary"
+            )
     return cbor.encode([
-        ins, outs, fee, validity, certs, wdrls, mint, [], scripts,
-        wits, [], [], 0, True,
+        ins, outs, fee, validity, certs, wdrls, mint, [], [],
+        [], [], [], 0, True,
     ])
 
 
@@ -471,6 +476,8 @@ class AlonzoLedger(MaryLedger):
             return 0
         if not tx.collateral:
             raise CollateralError("phase-2 scripts but no collateral")
+        if len(set(tx.collateral)) != len(tx.collateral):
+            raise CollateralError("duplicate collateral input")
         if len(tx.collateral) > pp.max_collateral_inputs:
             raise CollateralError("too many collateral inputs")
         total = 0
@@ -502,6 +509,11 @@ class AlonzoLedger(MaryLedger):
 
     def apply_tx(self, view: TxView, tx_bytes: bytes) -> TxView:
         return self._apply_decoded(view, decode_tx(tx_bytes), tx_bytes)
+
+    def _apply_era_extras(self, scratch: TxView, tx, tx_bytes: bytes) -> int:
+        """Deposit-taking rule families beyond certificates (none before
+        Conway); returns the deposits taken."""
+        return 0
 
     def _apply_decoded(self, view: TxView, tx, tx_bytes: bytes) -> TxView:
         pp = view.pparams
@@ -638,6 +650,10 @@ class AlonzoLedger(MaryLedger):
                 raise ShelleyTxError(f"malformed certificate: {e!r}") from e
             deposits_taken += dep
             refunds += ref
+        # era-extension hook (Conway governance): extra rule families
+        # that take deposits ride the same conservation equation and
+        # scratch/commit window as certificates
+        deposits_taken += self._apply_era_extras(scratch, tx, tx_bytes)
 
         produced_out = sum(int(v) for _a, v in tx.outs)
         if (consumed + withdrawn + refunds
@@ -693,19 +709,5 @@ class AlonzoLedger(MaryLedger):
                 ref += r
             view.deposit_delta += dep - ref
             view.fee_delta += tx.fee
-        st = replace(
-            st,
-            utxo=view.utxo,
-            stake_creds=view.stake_creds,
-            rewards=view.rewards,
-            delegations=view.delegations,
-            pools=view.pools,
-            pool_deposits=view.pool_deposits,
-            retiring=view.retiring,
-            proposals=view.proposals,
-            pending_mir=view.pending_mir,
-            fees=st.fees + view.fee_delta,
-            deposits=st.deposits + view.deposit_delta,
-            tip_slot_=ticked.slot,
-        )
+        st = self._commit_block_view(st, view, ticked.slot)
         return self._count_block(st, block)

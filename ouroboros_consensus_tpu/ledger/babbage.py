@@ -196,12 +196,18 @@ def decode_tx(tx_bytes: bytes) -> BabbageTx:
 
 def translate_tx_from_alonzo(tx_bytes: bytes) -> bytes:
     """InjectTxs Alonzo→Babbage: no reference inputs, no collateral
-    return; everything else carries verbatim."""
+    return. Witnessed/script-carrying txs cannot cross (witnesses sign
+    the era's body shape — the reference's InjectTxs is partial the
+    same way)."""
     (ins, outs, fee, validity, certs, wdrls, mint, coll, scripts,
      wits, datums, redeemers, budget, is_valid) = cbor.decode(tx_bytes)
+    if scripts or wits or datums or redeemers:
+        raise ShelleyTxError(
+            "witnessed alonzo tx cannot cross the era boundary"
+        )
     return cbor.encode([
         ins, [], outs, fee, validity, certs, wdrls, mint, coll, None, 0,
-        scripts, wits, datums, redeemers, budget, is_valid,
+        [], [], [], [], budget, is_valid,
     ])
 
 
@@ -262,6 +268,11 @@ class BabbageLedger(AlonzoLedger):
             if isinstance(tx.coll_return[1], MaryValue) and \
                     tx.coll_return[1].assets:
                 raise CollateralError("collateral return must be ada-only")
+            if ret_val < 0 or ret_val > total or tx.total_collateral < 0:
+                raise CollateralError(
+                    f"collateral return {ret_val} out of range of "
+                    f"collateral {total}"
+                )
             if tx.total_collateral != total - ret_val:
                 raise CollateralError(
                     f"total_collateral {tx.total_collateral} != "

@@ -1,0 +1,416 @@
+"""Conway-class era: the Babbage rules with ON-CHAIN GOVERNANCE in
+place of the genesis-delegate machinery — DRep registration and vote
+delegation, deposit-backed governance actions, stake-weighted DRep
+voting, and epoch-boundary ratification/enactment. PPUP proposals and
+MIR certificates are REMOVED (a genuine rule *removal*, like the
+reference's Conway dropping the genesis-delegate update system).
+
+Reference: StandardConway (`Shelley/Eras.hs:85-97`) and the
+Babbage→Conway `CanHardFork` step (`Cardano/CanHardFork.hs:273`);
+the governance shapes re-derived from cardano-ledger's Conway GOV/
+RATIFY/ENACT rules, deliberately scoped to two action kinds (parameter
+change, treasury withdrawal) voted by DReps.
+
+New certificates (extending the Shelley tags; tags 5 PPUP and 6 MIR are
+REJECTED in this era):
+  [7, drep_cred]            -- DRep registration (takes drep_deposit)
+  [8, drep_cred]            -- DRep deregistration (refunds)
+  [9, stake_cred, drep_cred]-- vote delegation (stake cred must be
+                               registered; drep must be registered)
+
+Tx wire (babbage fields + two governance fields):
+  tx = [...babbage 17 fields..., proposals, votes]
+  proposal = [return_cred, action]; the proposer pays
+             pparams.gov_action_deposit (into the deposits pot,
+             refunded to return_cred's reward account on enact/expiry)
+  action   = [0, {pparam: value}]          -- parameter change
+           | [1, [[cred, amount]...]]      -- treasury withdrawal
+  vote     = [drep_cred, txid/32, ix, yes]  -- one DRep's vote on an
+             open action (id = (txid, ix) of the proposing tx)
+
+Ratification (at every epoch boundary, NEWEPOCH order — after rewards,
+before pool reap): an action passes when the yes-stake of voting DReps
+exceeds pparams.drep_threshold of ALL drep-delegated stake; actions
+older than pparams.gov_action_lifetime epochs expire. Either way the
+deposit returns to the return credential (treasury if unregistered).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from fractions import Fraction
+from typing import Mapping
+
+from ..utils import cbor
+from .alonzo import AlonzoPParams
+from .babbage import BabbageLedger, BabbageTx
+from .babbage import decode_tx as babbage_decode_fields
+from .shelley import (
+    DelegError,
+    ShelleyState,
+    ShelleyTxError,
+    TxView,
+    tx_id,
+)
+
+
+class GovError(ShelleyTxError):
+    pass
+
+
+@dataclass(frozen=True)
+class ConwayPParams(AlonzoPParams):
+    """AlonzoPParams + the Conway governance parameters."""
+
+    drep_deposit: int = 500
+    gov_action_deposit: int = 1000
+    gov_action_lifetime: int = 2  # epochs an action stays open
+    drep_threshold: Fraction = Fraction(1, 2)
+
+    UPDATABLE = AlonzoPParams.UPDATABLE + (
+        "drep_deposit", "gov_action_deposit", "gov_action_lifetime",
+        "drep_threshold",
+    )
+
+    @classmethod
+    def from_alonzo(cls, pp, **overrides) -> "ConwayPParams":
+        base = {
+            f: getattr(pp, f, None)
+            for f in AlonzoPParams.__dataclass_fields__
+        }
+        base = {k: v for k, v in base.items() if v is not None}
+        base.update(overrides)
+        return cls(**base)
+
+
+@dataclass(frozen=True)
+class GovAction:
+    kind: int  # 0 = pparam change, 1 = treasury withdrawal
+    payload: tuple  # sorted pparam items / ((cred, amount)...)
+    return_cred: bytes
+    deposit: int
+    proposed_epoch: int
+
+
+@dataclass(frozen=True)
+class ConwayState(ShelleyState):
+    """ShelleyState + the governance sub-state. dataclasses.replace in
+    the inherited rules preserves this class, so every Shelley-family
+    boundary step flows through unchanged."""
+
+    dreps: Mapping[bytes, int] = field(default_factory=dict)
+    drep_delegations: Mapping[bytes, bytes] = field(default_factory=dict)
+    gov_actions: Mapping[tuple, GovAction] = field(default_factory=dict)
+    gov_votes: Mapping[tuple, bool] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class ConwayTx(BabbageTx):
+    proposals: tuple = ()  # ((return_cred, kind, payload)...)
+    votes: tuple = ()  # ((drep_cred, txid, ix, yes)...)
+
+
+def encode_tx(*args, proposals=(), votes=(), **kw) -> bytes:
+    """babbage.encode_tx + [proposals, votes]. proposals:
+    [(return_cred, action)] with action = [0, {param: val}] or
+    [1, [[cred, amt]...]]; votes: [(drep_cred, txid, ix, yes)]."""
+    from . import babbage as bb
+
+    inner = bb.encode_tx(*args, **kw)
+    fields = cbor.decode(inner)
+    return cbor.encode(fields + [
+        [[rc, act] for rc, act in proposals],
+        [[d, t, int(ix), bool(y)] for d, t, ix, y in votes],
+    ])
+
+
+def decode_tx(tx_bytes: bytes) -> ConwayTx:
+    try:
+        decoded = cbor.decode(tx_bytes)
+        if len(decoded) != 19:
+            raise ShelleyTxError(
+                f"conway tx must have 19 fields, got {len(decoded)}"
+            )
+        props, votes = decoded[17], decoded[18]
+        inner = babbage_decode_fields(cbor.encode(list(decoded[:17])))
+        fields = {
+            f: getattr(inner, f) for f in type(inner).__dataclass_fields__
+        }
+        # the size the fee/max_tx_size rules read must cover the WHOLE
+        # tx — including the governance fields stripped for the inner
+        # decode
+        fields["size"] = len(tx_bytes)
+        return ConwayTx(
+            **fields,
+            proposals=tuple(
+                (bytes(rc), (int(act[0]), act[1])) for rc, act in props
+            ),
+            votes=tuple(
+                (bytes(d), bytes(t), int(ix), bool(y))
+                for d, t, ix, y in votes
+            ),
+        )
+    except ShelleyTxError:
+        raise
+    except Exception as e:
+        raise ShelleyTxError(f"malformed conway tx: {e!r}") from e
+
+
+def translate_tx_from_babbage(tx_bytes: bytes) -> bytes:
+    """InjectTxs Babbage→Conway: no proposals, no votes."""
+    fields = cbor.decode(tx_bytes)
+    return cbor.encode(list(fields) + [[], []])
+
+
+class ConwayLedger(BabbageLedger):
+    """BabbageLedger + governance; PPUP/MIR certificates rejected."""
+
+    _decode_tx = staticmethod(decode_tx)
+
+    # -- era translation INTO Conway ---------------------------------------
+
+    def translate_from_babbage(self, prev: ShelleyState) -> ConwayState:
+        """Babbage→Conway: pparams widen with governance params; any
+        open PPUP proposals are DROPPED (the update system they belong
+        to no longer exists — the reference's Conway translation does
+        exactly this to the shelley gov state)."""
+        pp = prev.pparams
+        if not isinstance(pp, ConwayPParams):
+            pp = ConwayPParams.from_alonzo(pp)
+        base = {
+            f: getattr(prev, f) for f in ShelleyState.__dataclass_fields__
+        }
+        base.update(pparams=pp, proposals={}, pending_mir={})
+        return ConwayState(**base)
+
+    # -- certificates ------------------------------------------------------
+
+    def _apply_cert(self, v: TxView, cert: tuple) -> tuple[int, int]:
+        tag = cert[0]
+        if tag == 5:
+            raise GovError(
+                "PPUP proposals were removed in Conway; use a "
+                "parameter-change governance action"
+            )
+        if tag == 6:
+            raise GovError("MIR certificates were removed in Conway")
+        if tag == 7:  # DRep registration
+            cred = bytes(cert[1])
+            if cred in v.dreps:
+                raise GovError(f"drep already registered: {cred.hex()[:8]}")
+            dep = v.pparams.drep_deposit
+            v.dreps[cred] = dep
+            return dep, 0
+        if tag == 8:  # DRep deregistration
+            cred = bytes(cert[1])
+            if cred not in v.dreps:
+                raise GovError(f"drep not registered: {cred.hex()[:8]}")
+            refund = v.dreps.pop(cred)
+            v.drep_delegations = {
+                c: d for c, d in v.drep_delegations.items() if d != cred
+            }
+            return 0, refund
+        if tag == 9:  # vote delegation
+            cred, drep = bytes(cert[1]), bytes(cert[2])
+            if cred not in v.stake_creds:
+                raise DelegError(
+                    f"delegator not registered: {cred.hex()[:8]}"
+                )
+            if drep not in v.dreps:
+                raise GovError(f"unknown drep: {drep.hex()[:8]}")
+            v.drep_delegations[cred] = drep
+            return 0, 0
+        return super()._apply_cert(v, cert)
+
+    # -- GOV rule (proposals + votes inside apply) -------------------------
+
+    def _apply_gov(self, scratch: TxView, tx: ConwayTx,
+                   tid: bytes) -> int:
+        """Validate + record this tx's proposals and votes; returns the
+        governance deposits taken."""
+        deposits = 0
+        for ix, (return_cred, (kind, payload)) in enumerate(tx.proposals):
+            if kind == 0:
+                scratch.pparams.with_updates(payload)  # validates
+                norm = tuple(sorted(
+                    (k.decode() if isinstance(k, bytes) else k,
+                     tuple(v) if isinstance(v, (list, tuple)) else v)
+                    for k, v in payload.items()
+                ))
+            elif kind == 1:
+                norm = tuple(
+                    (bytes(c), int(a)) for c, a in payload
+                )
+                if any(a <= 0 for _c, a in norm):
+                    raise GovError("non-positive treasury withdrawal")
+            else:
+                raise GovError(f"unknown governance action kind {kind}")
+            dep = scratch.pparams.gov_action_deposit
+            scratch.gov_actions[(tid, ix)] = GovAction(
+                kind=kind, payload=norm, return_cred=return_cred,
+                deposit=dep, proposed_epoch=scratch.epoch,
+            )
+            deposits += dep
+        for drep, txid, ix, yes in tx.votes:
+            if drep not in scratch.dreps:
+                raise GovError(f"vote from unknown drep {drep.hex()[:8]}")
+            if (txid, ix) not in scratch.gov_actions:
+                raise GovError(
+                    f"vote on unknown action {txid.hex()[:8]}#{ix}"
+                )
+            scratch.gov_votes[((txid, ix), drep)] = yes
+        return deposits
+
+    def apply_tx(self, view: TxView, tx_bytes: bytes) -> TxView:
+        tx = decode_tx(tx_bytes)
+        from .shelley import BadInputs
+
+        for txin in tx.ref_ins:
+            if txin not in view.utxo:
+                raise BadInputs(txin)
+            if txin in tx.ins:
+                raise ShelleyTxError("input is both spent and referenced")
+        return self._apply_decoded(view, tx, tx_bytes)
+
+    def _apply_era_extras(self, scratch: TxView, tx, tx_bytes: bytes) -> int:
+        """Governance rides the certificate scratch/commit window and
+        the same conservation equation (deposits_taken) — alonzo's
+        _apply_decoded hook."""
+        if not isinstance(tx, ConwayTx):
+            return 0
+        return self._apply_gov(scratch, tx, tx_id(tx_bytes))
+
+    # -- state plumbing ----------------------------------------------------
+
+    def mempool_view(self, state: ConwayState, slot: int) -> TxView:
+        view = super().mempool_view(state, slot)
+        view.dreps = dict(state.dreps)
+        view.drep_delegations = dict(state.drep_delegations)
+        view.gov_actions = dict(state.gov_actions)
+        view.gov_votes = dict(state.gov_votes)
+        return view
+
+    def _commit_block_view(self, st: ConwayState, view: TxView,
+                           slot: int) -> ConwayState:
+        st = super()._commit_block_view(st, view, slot)
+        return replace(
+            st,
+            dreps=view.dreps,
+            drep_delegations=view.drep_delegations,
+            gov_actions=view.gov_actions,
+            gov_votes=view.gov_votes,
+        )
+
+    # reapply: the inherited cert loop already replays DRep certs
+    # (tags 7-9 dispatch through Conway's _apply_cert, and the commit
+    # seam carries the gov fields); only proposals/votes live outside
+    # the cert loop and need replaying here
+    def reapply_block(self, ticked, block):
+        st = super().reapply_block(ticked, block)
+        gov_txs = [
+            (tx, tx_id(tx_bytes))
+            for tx_bytes in block.txs
+            for tx in (self._decode_tx(tx_bytes),)
+            if tx.is_valid and (tx.proposals or tx.votes)
+        ]
+        if not gov_txs:
+            return st
+        view = self.mempool_view(st, ticked.slot)
+        dep = 0
+        for tx, tid in gov_txs:
+            dep += self._apply_gov(view, tx, tid)
+        return replace(
+            st,
+            gov_actions=view.gov_actions,
+            gov_votes=view.gov_votes,
+            deposits=st.deposits + dep,
+        )
+
+    # -- RATIFY / ENACT at the epoch boundary ------------------------------
+
+    def _drep_stake(self, st: ConwayState) -> dict[bytes, int]:
+        """Per-DRep voting stake: utxo value + rewards of every stake
+        credential delegated to it (current state, like the reference's
+        DRep distr computed at the boundary)."""
+        per: dict[bytes, int] = {}
+        stake: dict[bytes, int] = {}
+        for (addr, coin) in st.utxo.values():
+            cred = addr[1] if len(addr) > 1 else None
+            if cred is not None and cred in st.drep_delegations:
+                stake[cred] = stake.get(cred, 0) + int(coin)
+        for cred, amt in st.rewards.items():
+            if amt and cred in st.drep_delegations:
+                stake[cred] = stake.get(cred, 0) + amt
+        for cred, amt in stake.items():
+            drep = st.drep_delegations[cred]
+            if drep in st.dreps:
+                per[drep] = per.get(drep, 0) + amt
+        return per
+
+    def _refund_gov_deposit(self, st_fields: dict, action: GovAction):
+        if action.return_cred in st_fields["rewards"]:
+            st_fields["rewards"][action.return_cred] = (
+                st_fields["rewards"].get(action.return_cred, 0)
+                + action.deposit
+            )
+        else:
+            st_fields["treasury"] += action.deposit
+        st_fields["deposits"] -= action.deposit
+
+    def _adopt_pparams(self, st: ConwayState) -> ConwayState:
+        """Replaces the Shelley PPUP adoption step at the boundary with
+        Conway RATIFY/ENACT: stake-weighted DRep voting, expiry after
+        gov_action_lifetime epochs."""
+        if not st.gov_actions:
+            return st
+        drep_stake = self._drep_stake(st)
+        total_stake = sum(drep_stake.values())
+        threshold = st.pparams.drep_threshold
+        fields = dict(
+            rewards=dict(st.rewards), treasury=st.treasury,
+            deposits=st.deposits, reserves=st.reserves,
+        )
+        pparams = st.pparams
+        actions = dict(st.gov_actions)
+        votes = dict(st.gov_votes)
+        for aid in sorted(actions):
+            action = actions[aid]
+            yes = sum(
+                drep_stake.get(drep, 0)
+                for (vid, drep), y in votes.items()
+                if vid == aid and y
+            )
+            ratified = (
+                total_stake > 0 and Fraction(yes, total_stake) > threshold
+            )
+            expired = (
+                st.epoch - action.proposed_epoch
+                > pparams.gov_action_lifetime
+            )
+            if not ratified and not expired:
+                continue
+            if ratified:
+                if action.kind == 0:
+                    pparams = pparams.with_updates(dict(action.payload))
+                else:  # treasury withdrawal
+                    for cred, amt in action.payload:
+                        if (amt <= fields["treasury"]
+                                and cred in st.stake_creds):
+                            fields["treasury"] -= amt
+                            fields["rewards"][cred] = (
+                                fields["rewards"].get(cred, 0) + amt
+                            )
+            self._refund_gov_deposit(fields, action)
+            del actions[aid]
+            votes = {k: v for k, v in votes.items() if k[0] != aid}
+        return replace(
+            st,
+            pparams=pparams,
+            gov_actions=actions,
+            gov_votes=votes,
+            rewards=fields["rewards"],
+            treasury=fields["treasury"],
+            deposits=fields["deposits"],
+            reserves=fields["reserves"],
+            proposals={},
+        )

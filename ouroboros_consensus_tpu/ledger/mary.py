@@ -243,6 +243,20 @@ def translate_tx_from_shelley(tx_bytes: bytes) -> bytes:
     return cbor.encode([ins, outs, fee, [None, ttl], certs, wdrls, []])
 
 
+def translate_tx_from_allegra(tx_bytes: bytes) -> bytes:
+    """InjectTxs Allegra→Mary. Witnessed txs cannot cross: key
+    witnesses sign the era's body shape, and Mary's body includes the
+    mint field — the reference's InjectTxs is partial the same way."""
+    (ins, outs, fee, validity, certs, wdrls, scripts, wits) = cbor.decode(
+        tx_bytes
+    )
+    if scripts or wits:
+        raise ShelleyTxError(
+            "witnessed allegra tx cannot cross the era boundary"
+        )
+    return cbor.encode([ins, outs, fee, validity, certs, wdrls, []])
+
+
 class MaryLedger(AllegraLedger):
     """AllegraLedger with the Mary rule deltas (multi-asset + FORGE).
     Timelock scripts, key witnesses and validity intervals come from

@@ -321,6 +321,13 @@ class TxView:
     # pot balances the MIR rule guards against (read-only in the rules)
     reserves: int = 0
     treasury: int = 0
+    # Conway governance scratch (ledger/conway.py; empty in prior eras —
+    # living on the shared TxView keeps _scratch_of/_commit_scratch the
+    # one copy/commit point for every era)
+    dreps: dict = field(default_factory=dict)  # drep cred -> deposit
+    drep_delegations: dict = field(default_factory=dict)
+    gov_actions: dict = field(default_factory=dict)  # (txid, ix) -> action
+    gov_votes: dict = field(default_factory=dict)  # (action_id, drep) -> bool
 
 
 def total_ada(gen: ShelleyGenesis, st: ShelleyState) -> int:
@@ -574,6 +581,10 @@ class ShelleyLedger:
             pparams=view.pparams, epoch=view.epoch, slot=view.slot,
             pending_mir=dict(view.pending_mir),
             reserves=view.reserves, treasury=view.treasury,
+            dreps=dict(view.dreps),
+            drep_delegations=dict(view.drep_delegations),
+            gov_actions=dict(view.gov_actions),
+            gov_votes=dict(view.gov_votes),
         )
 
     @staticmethod
@@ -587,6 +598,10 @@ class ShelleyLedger:
         view.retiring = scratch.retiring
         view.proposals = scratch.proposals
         view.pending_mir = scratch.pending_mir
+        view.dreps = scratch.dreps
+        view.drep_delegations = scratch.drep_delegations
+        view.gov_actions = scratch.gov_actions
+        view.gov_votes = scratch.gov_votes
         view.deposit_delta += deposits_taken - refunds
         view.fee_delta += fee
 
@@ -909,12 +924,12 @@ class ShelleyLedger:
         blocks[pid] = blocks.get(pid, 0) + 1
         return replace(st, blocks_current=blocks)
 
-    def apply_block(self, ticked: TickedShelleyState, block) -> ShelleyState:
-        st = ticked.state
-        view = self.mempool_view(st, ticked.slot)
-        for tx in block.txs:
-            view = self.apply_tx(view, tx)
-        st = replace(
+    def _commit_block_view(self, st: ShelleyState, view: TxView,
+                           slot: int) -> ShelleyState:
+        """Fold a fully-applied block view back into the state — the one
+        commit point shared by apply_block and reapply_block across all
+        eras (Conway extends it with the governance sub-state)."""
+        return replace(
             st,
             utxo=view.utxo,
             stake_creds=view.stake_creds,
@@ -927,8 +942,15 @@ class ShelleyLedger:
             pending_mir=view.pending_mir,
             fees=st.fees + view.fee_delta,
             deposits=st.deposits + view.deposit_delta,
-            tip_slot_=ticked.slot,
+            tip_slot_=slot,
         )
+
+    def apply_block(self, ticked: TickedShelleyState, block) -> ShelleyState:
+        st = ticked.state
+        view = self.mempool_view(st, ticked.slot)
+        for tx in block.txs:
+            view = self.apply_tx(view, tx)
+        st = self._commit_block_view(st, view, ticked.slot)
         return self._count_block(st, block)
 
     # tx-layer decode seam: era subclasses (Mary) override so the
@@ -958,21 +980,7 @@ class ShelleyLedger:
                 ref += r
             view.deposit_delta += dep - ref
             view.fee_delta += tx.fee
-        st = replace(
-            st,
-            utxo=view.utxo,
-            stake_creds=view.stake_creds,
-            rewards=view.rewards,
-            delegations=view.delegations,
-            pools=view.pools,
-            pool_deposits=view.pool_deposits,
-            retiring=view.retiring,
-            proposals=view.proposals,
-            pending_mir=view.pending_mir,
-            fees=st.fees + view.fee_delta,
-            deposits=st.deposits + view.deposit_delta,
-            tip_slot_=ticked.slot,
-        )
+        st = self._commit_block_view(st, view, ticked.slot)
         return self._count_block(st, block)
 
     # -- protocol interface ------------------------------------------------

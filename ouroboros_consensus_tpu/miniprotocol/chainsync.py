@@ -117,6 +117,7 @@ class Candidate:
 def server(
     chain_db, rx, tx, *, poll_interval: float | None = None,
     include_tentative: bool = True, follower=None,
+    serve_blocks: bool = False,
 ):
     """ChainSync server task (Server.hs): answer find_intersect from the
     current chain, then stream follower updates as roll_forward /
@@ -129,7 +130,22 @@ def server(
 
     `include_tentative` serves diffusion pipelining: headers of blocks
     still being validated stream out early (Impl/Follower.hs tentative
-    followers), retracted by a rollback if validation rejects them."""
+    followers), retracted by a rollback if validation rejects them.
+
+    `serve_blocks` switches the payload to WHOLE SERIALISED BLOCKS —
+    the local (node-to-client) ChainSync wallets consume
+    (Network/NodeToClient.hs:92-121 chainSyncBlocksServer). Tentative
+    headers are never served in this mode: a tentative block's body is
+    still being validated."""
+    if serve_blocks:
+        include_tentative = False
+        if follower is not None and follower.include_tentative:
+            # a pipelining follower never re-announces a confirmed
+            # tentative, so a blocks-mode server on it would silently
+            # SKIP blocks — reject the combination outright
+            raise ValueError(
+                "serve_blocks requires a non-tentative follower"
+            )
     created_follower = follower is None
     if follower is None:
         follower = chain_db.new_follower(include_tentative=include_tentative)
@@ -143,7 +159,7 @@ def server(
     try:
         yield from _server_loop(
             chain_db, rx, tx, follower, pending, tip, decode,
-            poll_interval,
+            poll_interval, serve_blocks,
         )
     finally:
         # a killed/disconnected server must not leak its follower
@@ -151,7 +167,8 @@ def server(
             follower.close()
 
 
-def _server_loop(chain_db, rx, tx, follower, pending, tip, decode, poll_interval):
+def _server_loop(chain_db, rx, tx, follower, pending, tip, decode,
+                 poll_interval, serve_blocks=False):
     # lazy stream of the immutable segment between the intersection and
     # the volatile fragment (never materialized: the immutable part can
     # be the whole database)
@@ -213,8 +230,13 @@ def _server_loop(chain_db, rx, tx, follower, pending, tip, decode, poll_interval
                     imm_stream = None
                 else:
                     _e, raw = nxt
-                    header = decode(raw).header
-                    yield Send(tx, ("roll_forward", header.bytes_, tip()))
+                    if serve_blocks:
+                        yield Send(tx, ("roll_forward", raw, tip()))
+                    else:
+                        header = decode(raw).header
+                        yield Send(
+                            tx, ("roll_forward", header.bytes_, tip())
+                        )
                     continue
             while True:
                 pending.extend(follower.take_updates())
@@ -228,6 +250,8 @@ def _server_loop(chain_db, rx, tx, follower, pending, tip, decode, poll_interval
             if op[0] == "rollback":
                 yield Send(tx, ("roll_backward", op[1], tip()))
             elif op[0] == "tentative":
+                yield Send(tx, ("roll_forward", op[1].bytes_, tip()))
+            elif serve_blocks:
                 yield Send(tx, ("roll_forward", op[1].bytes_, tip()))
             else:
                 yield Send(tx, ("roll_forward", op[1].header.bytes_, tip()))

@@ -34,6 +34,12 @@ NODE_TO_CLIENT_VERSIONS: dict[int, frozenset] = {
     # v3 extends only the QUERY vocabulary (the Shelley ledger queries,
     # localstate.QUERY_MIN_VERSION) — same protocol set as v2
     3: frozenset({"localstatequery", "localtxsubmission", "localtxmonitor"}),
+    # v4 adds the local ChainSync over WHOLE BLOCKS — the wallet
+    # protocol (Network/NodeToClient.hs:92-121 chainSyncBlocksServer)
+    4: frozenset({
+        "localstatequery", "localtxsubmission", "localtxmonitor",
+        "localchainsync",
+    }),
 }
 
 

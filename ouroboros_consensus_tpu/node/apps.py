@@ -183,4 +183,14 @@ def node_to_client_apps(node, version: int, *, msg_delay: float = 0.0) -> Apps:
              localstate.tx_monitor_server(node, req, rsp))
         )
         apps.channels["localtxmonitor"] = (req, rsp)
+    if "localchainsync" in enabled:
+        # local ChainSync over WHOLE BLOCKS (NodeToClient.hs:92-121):
+        # wallets follow the chain — including rollbacks — receiving
+        # serialised blocks, never tentative headers
+        req, rsp = chan("lcs-req"), chan("lcs-rsp")
+        apps.tasks.append(
+            ("server", "localchainsync:server",
+             chainsync.server(node.chain_db, req, rsp, serve_blocks=True))
+        )
+        apps.channels["localchainsync"] = (req, rsp)
     return apps

@@ -359,6 +359,7 @@ def verify_praos_staged(
 # ---------------------------------------------------------------------------
 
 _SPLIT_JIT: dict = {}
+_AOT_WARM: set = set()
 
 
 def _jit1(key, fn):
@@ -381,11 +382,16 @@ def _stage_call(name, fn, b, kes_depth, *args):
         ex = aot.load(name, b, kes_depth, TILE, sig)
         if ex is not None:
             try:
-                # block before returning: device-side failures surface
-                # asynchronously, and an error escaping this try at the
-                # caller's materialization point would defeat the
-                # fallback contract
-                return jax.block_until_ready(ex(*args))
+                out = ex(*args)
+                if key not in _AOT_WARM:
+                    # device-side failures surface asynchronously — the
+                    # FIRST call per executable blocks so an incompatible
+                    # binary falls back here instead of crashing at the
+                    # caller's materialization point; subsequent calls
+                    # stay async (the dispatch pipeline depends on it)
+                    jax.block_until_ready(out)
+                    _AOT_WARM.add(key)
+                return out
             except Exception as e:  # noqa: BLE001 — fail-soft by contract
                 import sys
 

@@ -648,6 +648,32 @@ def validate_batch(
     return _epilogue(params, ticked, hvs, pre, v, collect_states)
 
 
+# Enclose latency brackets (Util/Enclose.hs) around the hot-path
+# phases: stage (host CBOR->SoA), dispatch (device kernel launch),
+# materialize (device wait), epilogue (sequential fold). Settable so
+# the embedding application (bench, node, tests) observes per-phase
+# latency without touching the code path.
+BATCH_TRACER = None  # None = off (zero overhead on the hot path)
+
+
+def set_batch_tracer(tracer) -> None:
+    global BATCH_TRACER
+    BATCH_TRACER = tracer
+
+
+def _enclose(label):
+    from ..utils.trace import Enclose
+
+    class _Null:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    return Enclose(BATCH_TRACER, label) if BATCH_TRACER is not None else _Null()
+
+
 def dispatch_batch(params, lview, eta0, hvs):
     """Stage a within-epoch window and dispatch the fused kernel WITHOUT
     waiting: jax execution is asynchronous, so the caller can stage the
@@ -656,14 +682,18 @@ def dispatch_batch(params, lview, eta0, hvs):
     ChainSel.hs:217-246). Staging depends only on the epoch nonce and
     ledger view — never on the sequential fold — which is what makes
     in-flight windows safe."""
-    pre = host_prechecks(params, lview, hvs)
-    batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
-    b = batch.beta.shape[0]
-    padded = pad_batch_to(batch, bucket_size(b))
-    if _impl() == "pk":
-        return pre, ("pk", _pk_dispatch(padded)), b
-    out = _jitted_verify()(*(jnp.asarray(x) for x in flatten_batch(padded)))
-    return pre, ("xla", out), b
+    with _enclose("stage"):
+        pre = host_prechecks(params, lview, hvs)
+        batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
+        b = batch.beta.shape[0]
+        padded = pad_batch_to(batch, bucket_size(b))
+    with _enclose("dispatch"):
+        if _impl() == "pk":
+            return pre, ("pk", _pk_dispatch(padded)), b
+        out = _jitted_verify()(
+            *(jnp.asarray(x) for x in flatten_batch(padded))
+        )
+        return pre, ("xla", out), b
 
 
 def materialize_verdicts(tagged, b) -> Verdicts:
@@ -838,9 +868,11 @@ def validate_chain(
                 inflight.append((w, hvs[w:j], pre, out, b))
                 w = j
             w0, whvs, pre, out, b = inflight.popleft()
-            v = materialize_verdicts(out, b)
+            with _enclose("materialize"):
+                v = materialize_verdicts(out, b)
             ticked = praos.tick(params, lview, whvs[0].slot, state)
-            res = _epilogue(params, ticked, whvs, pre, v)
+            with _enclose("epilogue"):
+                res = _epilogue(params, ticked, whvs, pre, v)
             state = res.state
             total_valid += res.n_valid
             if res.error is not None:

@@ -223,6 +223,8 @@ class ChainDB:
         trace: Callable[[str], None] = lambda s: None,
         check_in_future=None,  # block.infuture.CheckInFuture | None
         decode_block=None,  # block codec seam; default = Praos Block
+        tracer=None,  # TYPED event tracer (utils.trace ChainDB algebra,
+        # ChainDB/Impl.hs:10-28) — `trace` stays the human-string log
     ):
         self.ext = ext
         self.immutable = immutable
@@ -241,6 +243,10 @@ class ChainDB:
         self.disk_policy: DiskPolicy | None = None
         self._copied_since_snapshot = 0
         self.trace = trace
+        from ..utils import trace as T
+
+        self.tracer = tracer if tracer is not None else T.null_tracer
+        self._T = T
         # CheckInFuture (Fragment/InFuture.hs:45): candidates are cut at
         # their first in-future header before selection; None = dontCheck
         self.check_in_future = check_in_future
@@ -346,6 +352,7 @@ class ChainDB:
     def new_follower(self, include_tentative: bool = False) -> Follower:
         f = Follower(self, include_tentative=include_tentative)
         self.followers.append(f)
+        self.tracer(self._T.NewFollowerEvent(include_tentative))
         return f
 
     def remove_follower(self, f: Follower) -> None:
@@ -541,13 +548,18 @@ class ChainDB:
     def add_block(self, block: Block) -> AddBlockResult:
         """addBlockSync: store, then run chain selection."""
         if block.hash_ in self.invalid:
+            self.tracer(self._T.IgnoreInvalidBlock(block.slot, block.hash_))
             return AddBlockResult(False, self.tip_point(), False)
         # olderThanK (ChainSel.hs:359): blocks at or before the immutable
         # tip slot can never be adopted
         imm = self.immutable.tip()
         if imm is not None and block.slot <= imm.slot:
+            self.tracer(
+                self._T.IgnoreBlockOlderThanK(block.slot, block.hash_)
+            )
             return AddBlockResult(False, self.tip_point(), False)
         self.volatile.put_block(block)
+        self.tracer(self._T.AddedBlockToVolatileDB(block.slot, block.hash_))
         # BlockCache (Impl/BlockCache.hs): the block in hand need not be
         # reread/reparsed from the VolatileDB during this selection
         self._block_cache[block.hash_] = block
@@ -555,6 +567,8 @@ class ChainDB:
             selected = self._chain_selection_for_block(block)
         finally:
             self._block_cache.clear()
+        if not selected:
+            self.tracer(self._T.StoreButDontChange(block.slot, block.hash_))
         return AddBlockResult(True, self.tip_point(), selected)
 
     def _current_select_view(self):
@@ -654,6 +668,9 @@ class ChainDB:
         except InvalidBlock as e:
             self.invalid[e.point.hash_] = e.reason
             self.trace(f"invalid block at {e.point}: {type(e.reason).__name__}")
+            self.tracer(self._T.InvalidBlockEvent(
+                e.point.slot, e.point.hash_, type(e.reason).__name__
+            ))
             # LedgerDB has adopted the valid prefix's states already;
             # roll its extra states back to match a prefix decision
             n_valid = next(
@@ -677,6 +694,10 @@ class ChainDB:
                 restore = self.current_chain[len(self.current_chain) - n_rollback :]
                 self.ledgerdb.push_many(restore, apply=False)
             return "failed"
+        if suffix:
+            self.tracer(
+                self._T.ValidCandidate(len(suffix), suffix[-1].slot)
+            )
         self._install(n_rollback, suffix)
         # InspectLedger (Ledger/Inspect.hs): trace ledger events of the
         # adoption — era transitions, protocol-update warnings
@@ -703,6 +724,13 @@ class ChainDB:
         else:
             rollback_point = None
         self.current_chain.extend(suffix)
+        tip_slot = self.current_chain[-1].slot if self.current_chain else -1
+        if n_rollback:
+            self.tracer(
+                self._T.SwitchedToAFork(n_rollback, len(suffix), tip_slot)
+            )
+        else:
+            self.tracer(self._T.AddedToCurrentChain(len(suffix), tip_slot))
         for f in self.followers:
             f._notify_switch(n_rollback > 0, rollback_point, suffix)
         if self._background_decoupled:
@@ -733,9 +761,13 @@ class ChainDB:
         )
         for b in to_copy:
             self.immutable.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+        self.tracer(
+            self._T.CopiedToImmutableDB(len(to_copy), to_copy[-1].slot)
+        )
         self._copied_since_snapshot += len(to_copy)
         if self.snap_dir is not None and self._should_snapshot():
             self.ledgerdb.take_snapshot(self.snap_dir)
+            self.tracer(self._T.TookSnapshot(self._copied_since_snapshot))
             self._copied_since_snapshot = 0
             if self.disk_policy is not None:
                 self.disk_policy.snapshot_taken(self._policy_now())
@@ -763,6 +795,7 @@ class ChainDB:
         if gc_slot is not None:
             self.volatile.garbage_collect(gc_slot)
             self.ledgerdb.gc_prev_applied(gc_slot)
+            self.tracer(self._T.PerformedGC(gc_slot))
 
     # -- decoupled mode (ChainSel.hs:217-246 + Background.hs:17-38) ----------
 
@@ -789,9 +822,16 @@ class ChainDB:
         # header BEFORE its (possibly slow, batched) validation
         tip = self.tip_point()
         if block.prev_hash == (tip.hash_ if tip else None):
+            if any(f.include_tentative for f in self.followers):
+                self.tracer(
+                    self._T.SetTentativeHeader(block.slot, block.hash_)
+                )
             for f in self.followers:
                 f._notify_tentative(block.header, tip)
         self._blocks_to_add.append(p)
+        self.tracer(self._T.AddedBlockToQueue(
+            block.slot, block.hash_, len(self._blocks_to_add)
+        ))
         if self.runtime is not None:
             self.runtime.fire(self._queue_event)
         return p
@@ -804,8 +844,16 @@ class ChainDB:
             while not self._blocks_to_add:
                 yield Wait(self._queue_event)
             p = self._blocks_to_add.popleft()
+            self.tracer(
+                self._T.PoppedBlockFromQueue(p.block.slot, p.block.hash_)
+            )
             p.result = self.add_block(p.block)
             if not p.result.selected:
+                if any(f._tentative_hash == p.block.hash_
+                       for f in self.followers):
+                    self.tracer(self._T.TrapTentativeHeader(
+                        p.block.slot, p.block.hash_
+                    ))
                 for f in self.followers:
                     f._retract_tentative(p.block.hash_)
             yield Fire(p.processed)
@@ -825,6 +873,8 @@ class ChainDB:
                 gc_slot = self._copy_step()
                 if gc_slot is None:
                     break
+                self.tracer(self._T.ScheduledGC(gc_slot))
                 yield Sleep(gc_delay)
                 self.volatile.garbage_collect(gc_slot)
                 self.ledgerdb.gc_prev_applied(gc_slot)
+                self.tracer(self._T.PerformedGC(gc_slot))

@@ -43,6 +43,7 @@ def open_chaindb(
     check_in_future=None,  # block.infuture.CheckInFuture | None
     decode_block=None,  # block codec seam; default = Praos Block
     check_integrity=None,  # per-block-type integrity hook
+    tracer=None,  # typed ChainDB event tracer (utils.trace algebra)
 ) -> ChainDB:
     if check_integrity is None and validate_all:
         check_integrity = default_check_integrity
@@ -64,4 +65,5 @@ def open_chaindb(
     return ChainDB(
         ext, imm, vol, ldb, k, snap_dir=snap_dir, trace=trace,
         check_in_future=check_in_future, decode_block=decode_block,
+        tracer=tracer,
     )

@@ -1,0 +1,141 @@
+"""Pure reference models for ThreadNet multi-node properties.
+
+The reference cross-checks its ThreadNet runs against a PURE simulator
+of the protocol's deterministic structure
+(`ouroboros-consensus-diffusion/src/diffusion-testlib/Test/ThreadNet/
+Ref/PBFT.hs`, consumed by `General.hs:403,479`): expected chain length
+and fork structure are predicted WITHOUT running nodes, then the real
+net's outcome must match. These are the tpu-repo analogs:
+
+* `pbft_ref_simulate` — Byron/PBFT round-robin with the signing-window
+  threshold rule (PBFT.hs:393-396) simulated purely.
+* `praos_leader_slots` / `expected_mock_net_length` — the Praos lottery
+  IS a deterministic leader schedule given the fixture keys and the
+  epoch nonce; the model recomputes it via the protocol's own
+  `check_is_leader` (no reimplementation) and predicts the adopted
+  chain length exactly: one block per slot with >= 1 up leader.
+
+Model applicability (documented per function): single epoch (the nonce
+does not rotate), full diffusion within a slot (msg_delay * network
+diameter < slot_length), no mid-run restarts. The ThreadNet checker
+falls back to the loose bound outside these conditions.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+from ..protocol import praos
+from . import fixtures
+
+
+def pbft_ref_simulate(
+    n_slots: int,
+    n_keys: int,
+    window: int,
+    threshold: Fraction,
+    join_plan: dict[int, int] | None = None,
+) -> tuple[int, list[int | None]]:
+    """Simulate PBFT round-robin forging purely (Ref/PBFT.hs role).
+
+    Slot s's designated signer is s % n_keys (PBftProtocol.
+    check_is_leader). It forges unless appending its signature to the
+    sliding window of the last `window` signers would push its count
+    above floor(threshold * window) — the exact rule of
+    PBftProtocol.apply_checked_sig. Returns (expected chain length,
+    signer-per-slot list with None for skipped slots)."""
+    tcount = int(threshold * window)
+    signers: list[int] = []
+    outcome: list[int | None] = []
+    for s in range(n_slots):
+        gk = s % n_keys
+        if join_plan and join_plan.get(gk, 0) > s:
+            outcome.append(None)
+            continue
+        new = (signers + [gk])[-window:]
+        if new.count(gk) > tcount:
+            # the designated signer would violate its threshold: the
+            # slot stays empty (the node declines to forge an
+            # unadoptable block)
+            outcome.append(None)
+            continue
+        signers = new
+        outcome.append(gk)
+    return sum(1 for o in outcome if o is not None), outcome
+
+
+def praos_leader_slots(
+    params: praos.PraosParams,
+    pools,
+    lview,
+    epoch_nonce,
+    n_slots: int,
+    forgers,
+    join_plan: dict[int, int] | None = None,
+) -> list[list[int]]:
+    """Per-slot winner sets of the Praos lottery among the UP forgers —
+    computed through the protocol's own check_is_leader. Valid within
+    one epoch (constant nonce and stake distribution)."""
+    join = join_plan or {}
+    out = []
+    for s in range(n_slots):
+        winners = [
+            i for i in forgers
+            if join.get(i, 0) <= s
+            and fixtures.find_leader(params, [pools[i]], lview, s,
+                                     epoch_nonce) is not None
+        ]
+        out.append(winners)
+    return out
+
+
+def expected_praos_length(leader_slots: list[list[int]]) -> int:
+    """Under full within-slot diffusion every slot with >= 1 leader
+    contributes EXACTLY one adopted block (same parent everywhere at
+    slot start; the SelectView tie-break picks one global winner)."""
+    return sum(1 for w in leader_slots if w)
+
+
+def mock_net_model_applies(cfg) -> bool:
+    """The exact model holds for the single-era mock net when: no HFC
+    (nonce evolution at era/epoch boundaries is out of model), the run
+    stays in epoch 0, no restarts (a restart's downtime window depends
+    on sim scheduling), and diffusion completes within a slot."""
+    diameter = 1 if cfg.topology is None else cfg.n_nodes  # loose bound
+    return (
+        cfg.hard_fork_at_epoch is None
+        and cfg.n_slots <= cfg.epoch_length
+        and not cfg.restarts
+        # a late-JOINING forger spends its first slots syncing — its
+        # wins orphan until ChainSync catches up, which the pure model
+        # cannot time
+        and not cfg.join_plan
+        and cfg.msg_delay * diameter < cfg.slot_length
+    )
+
+
+def expected_mock_net_length(cfg) -> int:
+    """Reconstruct the net's pools/params exactly as testing.threadnet
+    does and predict the final chain length. Requires
+    mock_net_model_applies(cfg)."""
+    params = praos.PraosParams(
+        slots_per_kes_period=100,
+        max_kes_evolutions=62,
+        security_param=cfg.k,
+        active_slot_coeff=cfg.active_slot_coeff,
+        epoch_length=cfg.epoch_length,
+        kes_depth=cfg.kes_depth,
+    )
+    pools = [
+        fixtures.make_pool(i, kes_depth=cfg.kes_depth)
+        for i in range(cfg.n_nodes)
+    ]
+    lview = fixtures.make_ledger_view(pools)
+    forgers = (
+        cfg.forgers if cfg.forgers is not None else list(range(cfg.n_nodes))
+    )
+    # the mock net's genesis chain-dep state carries the neutral nonce
+    slots = praos_leader_slots(
+        params, pools, lview, None, cfg.n_slots, forgers, cfg.join_plan
+    )
+    return expected_praos_length(slots)

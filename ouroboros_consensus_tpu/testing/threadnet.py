@@ -91,6 +91,11 @@ class ThreadNetConfig:
     # epoch — a 3-era net crossing two GENUINE rule changes (requires
     # hf_shelley_era)
     hf_mary_at_epoch: int | None = None
+    # fourth era: Mary translates into the ALONZO-class ledger (phase-2
+    # script witnesses, ExUnits, collateral, two-phase IsValid) at this
+    # epoch — the net crosses into the script era LIVE (requires
+    # hf_mary_at_epoch)
+    hf_alonzo_at_epoch: int | None = None
 
 
 @dataclass
@@ -259,6 +264,12 @@ class _Net:
             era_params.append(HEraParams(params_b.epoch_length, F(1)))
             bounds[-1] = cfg.hf_mary_at_epoch
             bounds.append(None)
+        if cfg.hf_alonzo_at_epoch is not None:
+            if cfg.hf_mary_at_epoch is None:
+                raise ValueError("hf_alonzo_at_epoch requires hf_mary_at_epoch")
+            era_params.append(HEraParams(params_b.epoch_length, F(1)))
+            bounds[-1] = cfg.hf_alonzo_at_epoch
+            bounds.append(None)
         summary = summarize(F(0), era_params, bounds)
         if cfg.hf_shelley_era:
             era_b = self._shelley_era_b(params_b)
@@ -292,6 +303,19 @@ class _Net:
                 # (CanHardFork.hs:273 Shelley-family step)
                 translate_ledger_state=mary_ledger.translate_from_shelley,
                 translate_tx=mary_mod.translate_tx_from_shelley,
+            ))
+        if cfg.hf_alonzo_at_epoch is not None:
+            from ..ledger import alonzo as alonzo_mod
+
+            alonzo_ledger = alonzo_mod.AlonzoLedger(era_b.ledger.genesis)
+            eras.append(Era(
+                "alonzoD",
+                PraosProtocol(params_b, use_device_batch=cfg.use_device_batch),
+                ledger=alonzo_ledger,
+                # Mary→Alonzo: pparams widen with script economics; the
+                # net crosses into the phase-2 script era LIVE
+                translate_ledger_state=alonzo_ledger.translate_from_mary,
+                translate_tx=alonzo_mod.translate_tx_from_mary,
             ))
         protocol = HardForkProtocol(eras, summary)
         ledger = HardForkLedger(eras, summary)
@@ -626,11 +650,25 @@ def check_common_prefix(res: ThreadNetResult, k: int) -> None:
 
 
 def check_chain_growth(res: ThreadNetResult, cfg: ThreadNetConfig) -> None:
-    """Chains grow: with n pools at stake 1/n and coeff f, expect ≥ a
-    conservative fraction of active slots to produce adopted blocks."""
+    """Chain growth against the PURE reference model (Ref/PBFT.hs role,
+    General.hs:403): where the model applies (single epoch, full
+    within-slot diffusion, no restarts) the adopted chain length must
+    EQUAL the model's slot-by-slot prediction — a 2x forging regression
+    is caught immediately. Outside the model a conservative fraction of
+    active slots still bounds growth from below (the round-4 ÷4
+    fallback)."""
+    from . import refmodel
+
     min_len = min(len(c) for c in res.chains)
-    # P(some leader in a slot) = 1-(1-f)^1 aggregated ≈ f for 1 pool; be
-    # loose: expect at least n_slots * f / 4 blocks
+    if refmodel.mock_net_model_applies(cfg):
+        expect = refmodel.expected_mock_net_length(cfg)
+        max_len = max(len(c) for c in res.chains)
+        assert min_len == max_len == expect, (
+            f"model mismatch: chains [{min_len}, {max_len}] blocks, "
+            f"model predicts exactly {expect}"
+        )
+        return
+    # fallback: loose lower bound
     expect = int(cfg.n_slots * float(cfg.active_slot_coeff) / 4)
     assert min_len >= expect, f"chain too short: {min_len} < {expect}"
 

@@ -131,6 +131,107 @@ class InvalidBlockEvent:
     reason: str
 
 
+# -- the ChainDB event algebra (ChainDB/Impl.hs:10-28) -----------------------
+# One dataclass per constructor family: the add-block lifecycle,
+# validation verdicts, diffusion pipelining, followers, and the
+# copy/snapshot/GC background — typed and matchable so tests assert
+# event SEQUENCES, not log strings.
+
+
+@dataclass(frozen=True)
+class IgnoreBlockOlderThanK:
+    slot: int
+    hash_: bytes
+
+
+@dataclass(frozen=True)
+class IgnoreInvalidBlock:
+    slot: int
+    hash_: bytes
+
+
+@dataclass(frozen=True)
+class AddedBlockToQueue:
+    slot: int
+    hash_: bytes
+    queue_len: int
+
+
+@dataclass(frozen=True)
+class PoppedBlockFromQueue:
+    slot: int
+    hash_: bytes
+
+
+@dataclass(frozen=True)
+class AddedBlockToVolatileDB:
+    slot: int
+    hash_: bytes
+
+
+@dataclass(frozen=True)
+class StoreButDontChange:
+    slot: int
+    hash_: bytes
+
+
+@dataclass(frozen=True)
+class AddedToCurrentChain:
+    n_blocks: int
+    new_tip_slot: int
+
+
+@dataclass(frozen=True)
+class SwitchedToAFork:
+    n_rollback: int
+    n_blocks: int
+    new_tip_slot: int
+
+
+@dataclass(frozen=True)
+class ValidCandidate:
+    n_blocks: int
+    tip_slot: int
+
+
+@dataclass(frozen=True)
+class SetTentativeHeader:
+    slot: int
+    hash_: bytes
+
+
+@dataclass(frozen=True)
+class TrapTentativeHeader:
+    slot: int
+    hash_: bytes
+
+
+@dataclass(frozen=True)
+class NewFollowerEvent:
+    include_tentative: bool
+
+
+@dataclass(frozen=True)
+class CopiedToImmutableDB:
+    n_blocks: int
+    up_to_slot: int
+
+
+@dataclass(frozen=True)
+class TookSnapshot:
+    n_since_last: int
+
+
+@dataclass(frozen=True)
+class ScheduledGC:
+    slot: int
+
+
+@dataclass(frozen=True)
+class PerformedGC:
+    slot: int
+
+
 @dataclass(frozen=True)
 class ForgedBlock:
     slot: int

@@ -1,0 +1,536 @@
+"""Era rule-set tests: Allegra timelocks, Mary script-policy minting,
+Alonzo phase-2 scripts (two-phase IsValid + collateral), Babbage
+reference inputs / inline datums, Conway governance — and the full
+7-era composite with value crossing every translation.
+
+Reference: Shelley/Eras.hs:85-97 (the era family), Cardano/
+CanHardFork.hs:273 (pairwise translations), cardano-ledger's Allegra
+Timelock / Alonzo UTXOS / Babbage UTXOW / Conway GOV rule families.
+"""
+
+import dataclasses
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu.ledger import allegra, alonzo, babbage, conway, mary
+from ouroboros_consensus_tpu.ledger.shelley import PParams, ShelleyGenesis
+from ouroboros_consensus_tpu.ops.host import ed25519 as hed
+from ouroboros_consensus_tpu.utils import cbor
+
+SEED = b"\x11" * 32
+VK = hed.secret_to_public(SEED)
+GEN = ShelleyGenesis(
+    pparams=PParams(min_fee_a=0, min_fee_b=0), epoch_length=100,
+    stability_window=30,
+)
+
+
+def fresh_view(led, st, src_view=None, slot=5):
+    v = led.mempool_view(st, slot)
+    if src_view is not None:
+        v.utxo = dict(src_view.utxo)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Allegra
+# ---------------------------------------------------------------------------
+
+
+class TestAllegraTimelocks:
+    def _locked(self):
+        led = allegra.AllegraLedger(GEN)
+        st = led.genesis_state([(b"payme", None, 1000)])
+        lock = allegra.require_all_of([
+            allegra.require_signature(VK), allegra.require_time_start(10),
+        ])
+        v = led.mempool_view(st, 5)
+        tx = allegra.encode_tx(
+            [(bytes(32), 0)],
+            [(allegra.script_addr(lock), None, 600), (b"payme", None, 400)],
+        )
+        v = led.apply_tx(v, tx)
+        return led, st, v, lock, allegra.tx_id(tx)
+
+    def test_witnessed_spend_inside_interval(self):
+        led, st, v, lock, tid = self._locked()
+        spend = allegra.encode_tx(
+            [(tid, 0)], [(b"payme", None, 600)], validity=(12, None),
+            scripts=[lock], signers=[SEED],
+        )
+        vv = fresh_view(led, st, v, slot=15)
+        vv = led.apply_tx(vv, spend)
+        assert (allegra.tx_id(spend), 0) in vv.utxo
+
+    def test_unwitnessed_spend_rejected(self):
+        led, st, v, lock, tid = self._locked()
+        spend = allegra.encode_tx(
+            [(tid, 0)], [(b"payme", None, 600)], validity=(12, None),
+            scripts=[lock],
+        )
+        with pytest.raises(allegra.ScriptError):
+            led.apply_tx(fresh_view(led, st, v, slot=15), spend)
+
+    def test_missing_script_witness_rejected(self):
+        led, st, v, lock, tid = self._locked()
+        spend = allegra.encode_tx(
+            [(tid, 0)], [(b"payme", None, 600)], validity=(12, None),
+            signers=[SEED],
+        )
+        with pytest.raises(allegra.MissingWitness):
+            led.apply_tx(fresh_view(led, st, v, slot=15), spend)
+
+    def test_interval_not_proving_time_start_rejected(self):
+        # RequireTimeStart needs the interval's LOWER bound >= lock slot
+        # — an open interval proves nothing (evalTimelock semantics)
+        led, st, v, lock, tid = self._locked()
+        spend = allegra.encode_tx(
+            [(tid, 0)], [(b"payme", None, 600)], validity=(None, None),
+            scripts=[lock], signers=[SEED],
+        )
+        with pytest.raises(allegra.ScriptError):
+            led.apply_tx(fresh_view(led, st, v, slot=15), spend)
+
+    def test_m_of_n_and_time_expire(self):
+        led = allegra.AllegraLedger(GEN)
+        st = led.genesis_state([(b"payme", None, 100)])
+        seeds = [bytes([i]) * 32 for i in (1, 2, 3)]
+        vks = [hed.secret_to_public(s) for s in seeds]
+        lock = allegra.require_m_of(
+            2, [allegra.require_signature(k) for k in vks]
+        )
+        v = led.mempool_view(st, 5)
+        v = led.apply_tx(v, allegra.encode_tx(
+            [(bytes(32), 0)], [(allegra.script_addr(lock), None, 100)],
+        ))
+        tid = allegra.tx_id(allegra.encode_tx(
+            [(bytes(32), 0)], [(allegra.script_addr(lock), None, 100)],
+        ))
+        ok = allegra.encode_tx(
+            [(tid, 0)], [(b"payme", None, 100)],
+            scripts=[lock], signers=seeds[:2],
+        )
+        vv = fresh_view(led, st, v)
+        vv = led.apply_tx(vv, ok)
+        bad = allegra.encode_tx(
+            [(tid, 0)], [(b"payme", None, 100)],
+            scripts=[lock], signers=seeds[:1],
+        )
+        with pytest.raises(allegra.ScriptError):
+            led.apply_tx(fresh_view(led, st, v), bad)
+
+    def test_bad_key_witness_rejected(self):
+        led, st, v, lock, tid = self._locked()
+        good = allegra.encode_tx(
+            [(tid, 0)], [(b"payme", None, 600)], validity=(12, None),
+            scripts=[lock], signers=[SEED],
+        )
+        fields = cbor.decode(good)
+        vk, sig = fields[7][0]
+        fields[7][0] = [vk, sig[:-1] + bytes([sig[-1] ^ 1])]
+        with pytest.raises(allegra.MissingWitness):
+            led.apply_tx(fresh_view(led, st, v, slot=15),
+                         cbor.encode(fields))
+
+    def test_malformed_script_is_invalid_tx(self):
+        led, st, v, lock, tid = self._locked()
+        # a script whose bytes hash to the lock address can't exist;
+        # instead attach garbage for a GARBAGE-locked output
+        garbage = b"\xff\x01"
+        gaddr = allegra.script_addr(garbage)
+        v2 = fresh_view(led, st, v, slot=15)
+        lock_tx = allegra.encode_tx(
+            [(tid, 0)], [(gaddr, None, 600)], validity=(12, None),
+            scripts=[lock], signers=[SEED],
+        )
+        v2 = led.apply_tx(v2, lock_tx)
+        spend = allegra.encode_tx(
+            [(allegra.tx_id(lock_tx), 0)], [(b"payme", None, 600)],
+            scripts=[garbage],
+        )
+        with pytest.raises(allegra.ScriptError):
+            led.apply_tx(fresh_view(led, st, v2, slot=15), spend)
+
+
+# ---------------------------------------------------------------------------
+# Mary (script policies; classic behavior is covered by test_mary.py)
+# ---------------------------------------------------------------------------
+
+
+class TestMaryScriptPolicy:
+    def test_timelock_policy_mint(self):
+        led = mary.MaryLedger(GEN)
+        st = led.translate_from_shelley(
+            led.genesis_state([(b"payme", None, 1000)])
+        )
+        policy = allegra.require_signature(VK)
+        pid = allegra.script_hash(policy)
+        v = led.mempool_view(st, 5)
+        tx = mary.encode_tx(
+            [(bytes(32), 0)],
+            [(b"payme", None, mary.MaryValue(1000, {(pid, b"TOK"): 7}))],
+            mint=[(policy, None, {b"TOK": 7})],
+            scripts=[policy], signers=[SEED],
+        )
+        v = led.apply_tx(v, tx)
+        assert v.utxo[(mary.tx_id(tx), 0)][1].asset_map() == {
+            (pid, b"TOK"): 7
+        }
+
+    def test_timelock_policy_unwitnessed_rejected(self):
+        led = mary.MaryLedger(GEN)
+        st = led.translate_from_shelley(
+            led.genesis_state([(b"payme", None, 1000)])
+        )
+        policy = allegra.require_signature(VK)
+        pid = allegra.script_hash(policy)
+        tx = mary.encode_tx(
+            [(bytes(32), 0)],
+            [(b"payme", None, mary.MaryValue(1000, {(pid, b"TOK"): 7}))],
+            mint=[(policy, None, {b"TOK": 7})],
+            scripts=[policy],  # no signer -> RequireSignature fails
+        )
+        with pytest.raises(mary.MintError):
+            led.apply_tx(led.mempool_view(st, 5), tx)
+
+
+# ---------------------------------------------------------------------------
+# Alonzo
+# ---------------------------------------------------------------------------
+
+
+class TestAlonzoPhase2:
+    SCRIPT = alonzo.plutus_script([4, [1], [2]])  # redeemer == datum
+    DATUM = cbor.encode(b"SECRET")
+
+    def _locked(self):
+        led = alonzo.AlonzoLedger(GEN)
+        st = led.translate_from_mary(
+            led.genesis_state([(b"payme", None, 1000)])
+        )
+        assert isinstance(st.pparams, alonzo.AlonzoPParams)
+        v = led.mempool_view(st, 5)
+        tx = alonzo.encode_tx(
+            [(bytes(32), 0)],
+            [(allegra.script_addr(self.SCRIPT), None, 700,
+              alonzo.datum_hash(self.DATUM)),
+             (b"payme", None, 300)],
+        )
+        v = led.apply_tx(v, tx)
+        return led, st, v, alonzo.tx_id(tx)
+
+    def _spend(self, tid, redeemer, is_valid=True, budget=100, fee=1):
+        return alonzo.encode_tx(
+            [(tid, 0)], [(b"payme", None, 700 - fee)],
+            collateral=[(tid, 1)], scripts=[self.SCRIPT],
+            datums=[self.DATUM], redeemers=[(0, 0, redeemer)],
+            budget=budget, fee=fee, is_valid=is_valid,
+        )
+
+    def test_phase2_success(self):
+        led, st, v, tid = self._locked()
+        vv = fresh_view(led, st, v, slot=6)
+        vv = led.apply_tx(vv, self._spend(tid, cbor.decode(self.DATUM)))
+        assert (tid, 0) not in vv.utxo
+        assert (tid, 1) in vv.utxo  # collateral untouched on success
+
+    def test_phase2_failure_consumes_collateral_only(self):
+        led, st, v, tid = self._locked()
+        vv = fresh_view(led, st, v, slot=6)
+        vv = led.apply_tx(vv, self._spend(tid, b"WRONG", is_valid=False))
+        assert (tid, 0) in vv.utxo  # script utxo survives
+        assert (tid, 1) not in vv.utxo  # collateral consumed
+        assert vv.fee_delta == 300
+
+    def test_isvalid_lie_rejected(self):
+        led, st, v, tid = self._locked()
+        with pytest.raises(alonzo.IsValidMismatch):
+            led.apply_tx(fresh_view(led, st, v, slot=6),
+                         self._spend(tid, b"WRONG", is_valid=True))
+        # the converse lie too: claiming invalid when the script passes
+        with pytest.raises(alonzo.IsValidMismatch):
+            led.apply_tx(
+                fresh_view(led, st, v, slot=6),
+                self._spend(tid, cbor.decode(self.DATUM), is_valid=False),
+            )
+
+    def test_budget_exceeded_is_phase2_failure(self):
+        led, st, v, tid = self._locked()
+        vv = fresh_view(led, st, v, slot=6)
+        # budget 1: the eq node alone costs 3 (eq + two leaves)
+        vv = led.apply_tx(
+            vv,
+            self._spend(tid, cbor.decode(self.DATUM), is_valid=False,
+                        budget=1),
+        )
+        assert (tid, 1) not in vv.utxo
+
+    def test_fee_must_cover_exunits(self):
+        led, st, v, tid = self._locked()
+        from ouroboros_consensus_tpu.ledger.shelley import FeeTooSmall
+
+        with pytest.raises(FeeTooSmall):
+            led.apply_tx(
+                fresh_view(led, st, v, slot=6),
+                self._spend(tid, cbor.decode(self.DATUM), fee=0),
+            )
+
+    def test_missing_datum_and_redeemer_are_phase1_errors(self):
+        led, st, v, tid = self._locked()
+        no_datum = alonzo.encode_tx(
+            [(tid, 0)], [(b"payme", None, 699)], collateral=[(tid, 1)],
+            scripts=[self.SCRIPT],
+            redeemers=[(0, 0, cbor.decode(self.DATUM))], budget=100, fee=1,
+        )
+        with pytest.raises(allegra.MissingWitness):
+            led.apply_tx(fresh_view(led, st, v, slot=6), no_datum)
+        no_redeemer = alonzo.encode_tx(
+            [(tid, 0)], [(b"payme", None, 699)], collateral=[(tid, 1)],
+            scripts=[self.SCRIPT], datums=[self.DATUM], budget=100, fee=1,
+        )
+        with pytest.raises(allegra.MissingWitness):
+            led.apply_tx(fresh_view(led, st, v, slot=6), no_redeemer)
+
+    def test_collateral_required_and_key_locked(self):
+        led, st, v, tid = self._locked()
+        no_coll = alonzo.encode_tx(
+            [(tid, 0)], [(b"payme", None, 699)],
+            scripts=[self.SCRIPT], datums=[self.DATUM],
+            redeemers=[(0, 0, cbor.decode(self.DATUM))], budget=100, fee=1,
+        )
+        with pytest.raises(alonzo.CollateralError):
+            led.apply_tx(fresh_view(led, st, v, slot=6), no_coll)
+        script_coll = alonzo.encode_tx(
+            [(tid, 0)], [(b"payme", None, 699)], collateral=[(tid, 0)],
+            scripts=[self.SCRIPT], datums=[self.DATUM],
+            redeemers=[(0, 0, cbor.decode(self.DATUM))], budget=100, fee=1,
+        )
+        with pytest.raises(alonzo.CollateralError):
+            led.apply_tx(fresh_view(led, st, v, slot=6), script_coll)
+
+    def test_signed_by_context(self):
+        # a script gating on the signatory set: [12, keyhash]
+        led = alonzo.AlonzoLedger(GEN)
+        st = led.translate_from_mary(
+            led.genesis_state([(b"payme", None, 1000)])
+        )
+        script = alonzo.plutus_script([12, allegra.key_hash(VK)])
+        v = led.mempool_view(st, 5)
+        lock = alonzo.encode_tx(
+            [(bytes(32), 0)],
+            [(allegra.script_addr(script), None, 500,
+              alonzo.datum_hash(self.DATUM)), (b"payme", None, 500)],
+        )
+        v = led.apply_tx(v, lock)
+        tid = alonzo.tx_id(lock)
+        spend = alonzo.encode_tx(
+            [(tid, 0)], [(b"payme", None, 499)], collateral=[(tid, 1)],
+            scripts=[script], datums=[self.DATUM],
+            redeemers=[(0, 0, 0)], budget=100, fee=1, signers=[SEED],
+        )
+        vv = fresh_view(led, st, v, slot=6)
+        vv = led.apply_tx(vv, spend)
+        assert (tid, 0) not in vv.utxo
+
+
+# ---------------------------------------------------------------------------
+# Babbage
+# ---------------------------------------------------------------------------
+
+
+class TestBabbage:
+    SCRIPT = alonzo.plutus_script([4, [1], [2]])
+    DATUM = cbor.encode(b"SECRET")
+
+    def _setup(self):
+        led = babbage.BabbageLedger(GEN)
+        st = led.translate_from_alonzo(
+            led.genesis_state([(b"payme", None, 1000)])
+        )
+        v = led.mempool_view(st, 5)
+        lock = babbage.encode_tx(
+            [(bytes(32), 0)],
+            [
+                (allegra.script_addr(self.SCRIPT), None, 500,
+                 ("inline", self.DATUM)),
+                (b"payme", None, 300, None, self.SCRIPT),  # ref script
+                (b"payme", None, 200),
+            ],
+        )
+        v = led.apply_tx(v, lock)
+        return led, st, v, alonzo.tx_id(lock)
+
+    def test_reference_script_and_inline_datum(self):
+        led, st, v, tid = self._setup()
+        spend = babbage.encode_tx(
+            [(tid, 0)], [(b"payme", None, 499)],
+            ref_ins=[(tid, 1)], collateral=[(tid, 2)],
+            redeemers=[(0, 0, cbor.decode(self.DATUM))], budget=100, fee=1,
+        )
+        vv = fresh_view(led, st, v, slot=6)
+        vv = led.apply_tx(vv, spend)
+        assert (alonzo.tx_id(spend), 0) in vv.utxo
+        assert (tid, 1) in vv.utxo  # reference input NOT spent
+
+    def test_input_cannot_be_both_spent_and_referenced(self):
+        led, st, v, tid = self._setup()
+        from ouroboros_consensus_tpu.ledger.shelley import ShelleyTxError
+
+        bad = babbage.encode_tx(
+            [(tid, 2)], [(b"payme", None, 200)], ref_ins=[(tid, 2)],
+        )
+        with pytest.raises(ShelleyTxError):
+            led.apply_tx(fresh_view(led, st, v, slot=6), bad)
+
+    def test_collateral_return(self):
+        led, st, v, tid = self._setup()
+        spend = babbage.encode_tx(
+            [(tid, 0)], [(b"payme", None, 499)],
+            ref_ins=[(tid, 1)], collateral=[(tid, 2)],
+            coll_return=(b"payme", None, 150), total_collateral=50,
+            redeemers=[(0, 0, b"WRONG")], budget=100, fee=1,
+            is_valid=False,
+        )
+        vv = fresh_view(led, st, v, slot=6)
+        vv = led.apply_tx(vv, spend)
+        assert (tid, 2) not in vv.utxo  # collateral consumed
+        assert vv.fee_delta == 50  # only total_collateral burned
+        # change landed at index |outs|
+        ret = [k for k, (a, val) in vv.utxo.items() if int(val) == 150]
+        assert ret and ret[0][1] == 1
+
+
+# ---------------------------------------------------------------------------
+# Conway
+# ---------------------------------------------------------------------------
+
+
+SC = b"stakecred-28-bytes-xxxxxxxxx"
+DREP = b"drep-cred-28-bytes-xxxxxxxxx"
+
+
+class TestConwayGovernance:
+    def _setup(self):
+        led = conway.ConwayLedger(GEN)
+        base = led.genesis_state([(b"payme", SC, 10_000)])
+        base = dataclasses.replace(
+            base, stake_creds={SC: 0}, rewards={SC: 0},
+        )
+        st = led.translate_from_babbage(base)
+        assert isinstance(st, conway.ConwayState)
+        assert isinstance(st.pparams, conway.ConwayPParams)
+        return led, st
+
+    def test_ppup_and_mir_removed(self):
+        led, st = self._setup()
+        v = led.mempool_view(st, 5)
+        with pytest.raises(conway.GovError):
+            led.apply_tx(v, conway.encode_tx(
+                [(bytes(32), 0)], [(b"payme", SC, 10_000)],
+                certs=[[5, b"x" * 32, {b"min_fee_a": 9}]],
+            ))
+        with pytest.raises(conway.GovError):
+            led.apply_tx(v, conway.encode_tx(
+                [(bytes(32), 0)], [(b"payme", SC, 10_000)],
+                certs=[[6, 0, b"x" * 32, [[SC, 5]]]],
+            ))
+
+    def test_full_governance_cycle_ratifies(self):
+        led, st = self._setup()
+        v = led.mempool_view(st, 5)
+        dep = st.pparams.drep_deposit
+        tx1 = conway.encode_tx(
+            [(bytes(32), 0)], [(b"payme", SC, 10_000 - dep)],
+            certs=[[7, DREP], [9, SC, DREP]],
+        )
+        v = led.apply_tx(v, tx1)
+        tid1 = conway.tx_id(tx1)
+        gdep = st.pparams.gov_action_deposit
+        tx2 = conway.encode_tx(
+            [(tid1, 0)], [(b"payme", SC, 10_000 - dep - gdep)],
+            proposals=[(SC, [0, {b"min_fee_a": 7}])],
+        )
+        v = led.apply_tx(v, tx2)
+        tid2 = conway.tx_id(tx2)
+        tx3 = conway.encode_tx(
+            [(tid2, 0)], [(b"payme", SC, 10_000 - dep - gdep)],
+            votes=[(DREP, tid2, 0, True)],
+        )
+        v = led.apply_tx(v, tx3)
+        st2 = led._commit_block_view(st, v, 5)
+        t = led.tick(st2, 105)  # cross the boundary
+        assert t.state.pparams.min_fee_a == 7
+        assert not t.state.gov_actions
+        assert t.state.rewards[SC] >= gdep  # deposit refunded
+
+    def test_unvoted_action_expires_with_refund(self):
+        led, st = self._setup()
+        v = led.mempool_view(st, 5)
+        gdep = st.pparams.gov_action_deposit
+        tx = conway.encode_tx(
+            [(bytes(32), 0)], [(b"payme", SC, 10_000 - gdep)],
+            proposals=[(SC, [0, {b"min_fee_a": 7}])],
+        )
+        v = led.apply_tx(v, tx)
+        st2 = led._commit_block_view(st, v, 5)
+        lifetime = st.pparams.gov_action_lifetime
+        t = led.tick(st2, (lifetime + 2) * 100 + 5)
+        assert t.state.pparams.min_fee_a == 0  # NOT adopted
+        assert not t.state.gov_actions  # expired
+        assert t.state.rewards[SC] >= gdep  # refunded
+
+    def test_vote_from_unregistered_drep_rejected(self):
+        led, st = self._setup()
+        v = led.mempool_view(st, 5)
+        gdep = st.pparams.gov_action_deposit
+        tx = conway.encode_tx(
+            [(bytes(32), 0)], [(b"payme", SC, 10_000 - gdep)],
+            proposals=[(SC, [0, {b"min_fee_a": 7}])],
+        )
+        v = led.apply_tx(v, tx)
+        bad = conway.encode_tx(
+            [(conway.tx_id(tx), 0)], [(b"payme", SC, 10_000 - gdep)],
+            votes=[(DREP, conway.tx_id(tx), 0, True)],
+        )
+        with pytest.raises(conway.GovError):
+            led.apply_tx(v, bad)
+
+
+# ---------------------------------------------------------------------------
+# The 7-era composite
+# ---------------------------------------------------------------------------
+
+
+def test_seven_era_composite(tmp_path):
+    """byron → shelley → allegra → mary → alonzo → babbage → conway:
+    value (and the minted asset) crosses every translation; the alonzo
+    segment runs a live phase-2 script spend; conway registers a DRep
+    and runs a governance action through proposal, vote and expiry."""
+    from ouroboros_consensus_tpu.hardfork import composite as C
+    from ouroboros_consensus_tpu.ledger.conway import ConwayState
+    from ouroboros_consensus_tpu.ledger.mary import MaryValue
+
+    cfg = C.CardanoMockConfig(
+        byron_epochs=2, byron_epoch_length=40, epoch_length=40,
+        seven_era=True, era_epochs=1, with_ledgers=True,
+        shelley_f=Fraction(1), babbage_f=Fraction(1), k=5, kes_depth=3,
+    )
+    path = str(tmp_path / "chain")
+    n = C.synthesize(path, cfg, n_slots=360)
+    assert n == 360
+    res = C.revalidate(path, cfg, backend="host")
+    assert res.error is None
+    assert res.n_valid == res.n_blocks == 360
+    assert set(res.per_era) == {
+        "byron", "shelley", "allegra", "mary", "alonzo", "babbage",
+        "conway",
+    }
+    inner = res.final_ledger_state.inner
+    assert isinstance(inner, ConwayState)
+    assert inner.dreps  # the composite's DRep registration survived
+    carried = [
+        v for _a, v in inner.utxo.values()
+        if isinstance(v, MaryValue) and v.assets
+    ]
+    assert carried, "the minted asset must survive five translations"

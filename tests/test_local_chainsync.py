@@ -1,0 +1,136 @@
+"""Local ChainSync over WHOLE BLOCKS — the wallet protocol.
+
+Reference: `ouroboros-consensus-diffusion/.../Network/NodeToClient.hs:
+92-121` (chainSyncBlocksServer): local clients follow the node's chain
+receiving serialised blocks, including roll-backwards when the node
+switches forks. Negotiated at node-to-client v4 (handshake.py).
+"""
+
+from fractions import Fraction
+
+from ouroboros_consensus_tpu.block import forge_block
+from ouroboros_consensus_tpu.block.praos_block import Block
+from ouroboros_consensus_tpu.node.apps import node_to_client_apps
+from ouroboros_consensus_tpu.utils.sim import Recv, Send, Sim
+
+import tests.test_pipelining as tp
+
+
+def _forge_chain(pool, slots, prev=None, block_no=0, body=b"a"):
+    from ouroboros_consensus_tpu.ledger.mock import encode_tx
+
+    blocks = []
+    for s in slots:
+        # a valid mock-ledger tx per block: zero-value output, no inputs
+        # (conserves value from an empty genesis), distinct per chain so
+        # fork bodies differ
+        tx = encode_tx([], [(b"%s-%d" % (body, s), 0)])
+        b = forge_block(
+            tp.PARAMS, pool, slot=s, block_no=block_no,
+            prev_hash=prev, epoch_nonce=tp.ETA0,
+            txs=(tx,),
+        )
+        blocks.append(b)
+        prev = b.hash_
+        block_no += 1
+    return blocks
+
+
+def test_wallet_follows_chain_with_rollback(tmp_path):
+    node = tp._mk_node(tmp_path, "n")
+    apps = node_to_client_apps(node, 4)
+    assert "localchainsync" in apps.protocols()
+    req, rsp = apps.channels["localchainsync"]
+
+    # chain A: 5 blocks by pool 0; fork B: 6 blocks by pool 1 sharing
+    # the first 3 — adopting B rolls the wallet back 2 blocks
+    chain_a = _forge_chain(tp.POOLS[0], range(1, 6))
+    fork_b = _forge_chain(
+        tp.POOLS[1], range(6, 9),
+        prev=chain_a[2].hash_, block_no=3, body=b"b",
+    )
+    for b in chain_a:
+        node.chain_db.add_block(b)
+
+    wallet_chain: list = []
+    events: list = []
+
+    def wallet():
+        # a fresh wallet intersects at genesis and pulls the chain
+        yield Send(req, ("find_intersect", [None]))
+        msg = yield Recv(rsp)
+        assert msg[0] == "intersect_found"
+        for _ in range(20):
+            yield Send(req, ("request_next",))
+            kind, payload, _tip = yield Recv(rsp)
+            events.append(kind)
+            if kind == "roll_forward":
+                blk = Block.from_bytes(payload)  # WHOLE block, not header
+                assert blk.txs, "wallet must receive block bodies"
+                wallet_chain.append(blk)
+            elif kind == "roll_backward":
+                point = payload
+                while wallet_chain and (
+                    point is None or wallet_chain[-1].point != point
+                ):
+                    wallet_chain.pop()
+            if len(wallet_chain) == 6 and wallet_chain[-1].slot == 8:
+                break
+        yield Send(req, ("done",))
+
+    def switcher():
+        # let the wallet catch chain A first, then adopt fork B
+        from ouroboros_consensus_tpu.utils.sim import Sleep
+
+        yield Sleep(1.0)
+        for b in fork_b:
+            node.chain_db.add_block(b)
+
+    sim = Sim()
+    node.chain_db.runtime = sim
+    for _o, name, gen in apps.tasks:
+        sim.spawn(gen, name)
+    sim.spawn(wallet(), "wallet")
+    sim.spawn(switcher(), "switcher")
+    sim.run(until=30)
+
+    # the wallet followed the fork switch: rolled back to block 3 and
+    # now holds the adopted 6-block chain, bodies included
+    assert "roll_backward" in events
+    assert [b.hash_ for b in wallet_chain] == [
+        b.hash_ for b in (chain_a[:3] + fork_b)
+    ]
+    assert [b.slot for b in wallet_chain] == [1, 2, 3, 6, 7, 8]
+
+
+def test_wallet_resumes_from_intersection(tmp_path):
+    """A wallet that already holds a prefix resumes from its
+    intersection point instead of genesis."""
+    node = tp._mk_node(tmp_path, "n2")
+    chain = _forge_chain(tp.POOLS[0], range(1, 8))
+    for b in chain:
+        node.chain_db.add_block(b)
+    apps = node_to_client_apps(node, 4)
+    req, rsp = apps.channels["localchainsync"]
+
+    got: list = []
+
+    def wallet():
+        # the wallet knows up to slot 4 (index 3)
+        yield Send(req, ("find_intersect", [chain[3].point]))
+        msg = yield Recv(rsp)
+        assert msg[0] == "intersect_found" and msg[1] == chain[3].point
+        for _ in range(3):
+            yield Send(req, ("request_next",))
+            kind, payload, _tip = yield Recv(rsp)
+            assert kind == "roll_forward"
+            got.append(Block.from_bytes(payload))
+        yield Send(req, ("done",))
+
+    sim = Sim()
+    node.chain_db.runtime = sim
+    for _o, name, gen in apps.tasks:
+        sim.spawn(gen, name)
+    sim.spawn(wallet(), "wallet")
+    sim.run(until=10)
+    assert [b.hash_ for b in got] == [b.hash_ for b in chain[4:]]

@@ -452,3 +452,63 @@ def test_three_era_network_across_schedules(tmp_path):
         assert res.chain_hashes(1) == res.chain_hashes(0) == res.chain_hashes(2)
         eras = [b.era for b in res.chains[0] if isinstance(b, HardForkBlock)]
         assert set(eras) == {0, 1, 2}, f"seed {seed}: eras {set(eras)}"
+
+
+def test_four_era_network_crosses_into_script_era(tmp_path):
+    """A 4-era net: mock -> Shelley -> Mary -> ALONZO (epoch 6, slot
+    60). After the third boundary a LIVE phase-2 script flow runs on
+    the network: a lock tx pays into a script output (datum by hash),
+    then a spend tx provides the script + datum + redeemer + collateral
+    and passes phase-2 evaluation — diffused and adopted by every node
+    (VERDICT r4 item 4: a ThreadNet crossing a new capability boundary
+    live)."""
+    from ouroboros_consensus_tpu.hardfork.combinator import HardForkBlock
+    from ouroboros_consensus_tpu.ledger import allegra as al
+    from ouroboros_consensus_tpu.ledger import alonzo as az
+    from ouroboros_consensus_tpu.ledger.alonzo import AlonzoPParams
+    from ouroboros_consensus_tpu.utils import cbor
+
+    script = az.plutus_script([4, [1], [2]])  # redeemer == datum
+    datum = cbor.encode(b"tn-secret")
+    saddr = al.script_addr(script)
+    genesis_in = (bytes(32), 8)  # untouched by TxGen (tx_gen off)
+    lock_tx = az.encode_tx(
+        [genesis_in],
+        [(saddr, None, 60, az.datum_hash(datum)), (b"ada-coll", None, 40)],
+    )
+    lock_tid = az.tx_id(lock_tx)
+    spend_tx = az.encode_tx(
+        [(lock_tid, 0)], [(b"alonzo-paid", None, 59)],
+        collateral=[(lock_tid, 1)],
+        scripts=[script], datums=[datum],
+        redeemers=[(0, 0, cbor.decode(datum))], budget=100, fee=1,
+    )
+
+    cfg = threadnet.ThreadNetConfig(
+        n_nodes=3, n_slots=80, k=60, msg_delay=0.05,
+        active_slot_coeff=Fraction(1),
+        epoch_length=10,
+        forgers=[0],
+        hard_fork_at_epoch=2,   # mock -> Shelley at slot 20
+        hf_shelley_era=True,
+        hf_mary_at_epoch=4,     # Shelley -> Mary at slot 40
+        hf_alonzo_at_epoch=6,   # Mary -> Alonzo at slot 60
+        tx_submission=True,
+        tx_injections=[(65, 0, lock_tx), (70, 0, spend_tx)],
+    )
+    res = threadnet.run_thread_network(str(tmp_path), cfg)
+    threadnet.check_common_prefix(res, cfg.k)
+    assert res.chain_hashes(1) == res.chain_hashes(0) == res.chain_hashes(2)
+    eras = [b.era for b in res.chains[0] if isinstance(b, HardForkBlock)]
+    assert set(eras) == {0, 1, 2, 3}, f"eras seen: {set(eras)}"
+
+    st = res.nodes[0].chain_db.current_ledger().ledger_state
+    assert st.era == 3
+    assert isinstance(st.inner.pparams, AlonzoPParams)
+    # the phase-2 spend executed: locked output consumed, payment landed,
+    # collateral untouched — on EVERY node
+    for n in res.nodes:
+        utxo = n.chain_db.current_ledger().ledger_state.inner.utxo
+        assert (lock_tid, 0) not in utxo
+        assert (lock_tid, 1) in utxo
+        assert any(a[0] == b"alonzo-paid" for a, _v in utxo.values())

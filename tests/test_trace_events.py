@@ -1,0 +1,154 @@
+"""Typed ChainDB trace-event algebra (ChainDB/Impl.hs:10-28 analog):
+tests assert event SEQUENCES — add-block lifecycle, fork switch,
+invalid-block marking, tentative pipelining, background copy/GC — and
+the Enclose latency brackets around the batch hot path."""
+
+from fractions import Fraction
+
+from ouroboros_consensus_tpu.utils import trace as T
+from ouroboros_consensus_tpu.utils.sim import Sim
+
+import tests.test_pipelining as tp
+from tests.test_local_chainsync import _forge_chain
+
+
+def _node_with_tracer(tmp_path, name):
+    node = tp._mk_node(tmp_path, name)
+    tracer = T.ListTracer()
+    node.chain_db.tracer = tracer
+    return node, tracer
+
+
+def _types(events):
+    return [type(e).__name__ for e in events]
+
+
+def test_add_block_lifecycle_sequence(tmp_path):
+    node, tracer = _node_with_tracer(tmp_path, "n")
+    chain = _forge_chain(tp.POOLS[0], range(1, 4))
+    node.chain_db.add_block(chain[0])
+    assert _types(tracer.events) == [
+        "AddedBlockToVolatileDB", "ValidCandidate", "AddedToCurrentChain",
+    ]
+    ev = tracer.events[-1]
+    assert ev.n_blocks == 1 and ev.new_tip_slot == 1
+    # re-adding is ignored as already-selected (store-but-dont-change)
+    tracer.events.clear()
+    node.chain_db.add_block(chain[0])
+    assert _types(tracer.events)[-1] == "StoreButDontChange"
+
+
+def test_fork_switch_and_invalid_events(tmp_path):
+    node, tracer = _node_with_tracer(tmp_path, "n")
+    chain_a = _forge_chain(tp.POOLS[0], range(1, 5))
+    fork_b = _forge_chain(
+        tp.POOLS[1], range(5, 8), prev=chain_a[1].hash_, block_no=2,
+        body=b"b",
+    )
+    for b in chain_a:
+        node.chain_db.add_block(b)
+    tracer.events.clear()
+    for b in fork_b:
+        node.chain_db.add_block(b)
+    kinds = _types(tracer.events)
+    assert "SwitchedToAFork" in kinds
+    sw = next(e for e in tracer.events if isinstance(e, T.SwitchedToAFork))
+    assert sw.n_rollback == 2 and sw.new_tip_slot == 7
+
+    # an invalid block (garbage body hash) emits InvalidBlockEvent
+    from dataclasses import replace as dreplace
+
+    good = _forge_chain(
+        tp.POOLS[0], [9], prev=fork_b[-1].hash_, block_no=5
+    )[0]
+    bad = dreplace(good, txs=(b"\xff\xfe",))  # body no longer matches
+    tracer.events.clear()
+    node.chain_db.add_block(bad)
+    kinds = _types(tracer.events)
+    assert "InvalidBlockEvent" in kinds or "StoreButDontChange" in kinds
+
+
+def test_tentative_pipelining_events(tmp_path):
+    """Decoupled mode: a tip-extending block is announced tentatively
+    before validation; an invalid one is TRAPPED (retracted)."""
+    from dataclasses import replace as dreplace
+
+    node, tracer = _node_with_tracer(tmp_path, "n")
+    sim = Sim()
+    runners = node.chain_db.start_decoupled(sim)
+    for r in runners:
+        sim.spawn(r, "runner")
+    follower = node.chain_db.new_follower(include_tentative=True)
+
+    chain = _forge_chain(tp.POOLS[0], range(1, 3))
+    good, nxt = chain[0], chain[1]
+    node.chain_db.add_block_async(good)
+    sim.run(until=1)
+    bad = dreplace(nxt, txs=(b"\xff\xfe",))
+    node.chain_db.add_block_async(bad)
+    sim.run(until=2)
+    kinds = _types(tracer.events)
+    assert "SetTentativeHeader" in kinds
+    assert "TrapTentativeHeader" in kinds
+    assert "AddedBlockToQueue" in kinds and "PoppedBlockFromQueue" in kinds
+    # the tentative announcement precedes the queue pop that traps it
+    assert kinds.index("SetTentativeHeader") < kinds.index(
+        "TrapTentativeHeader"
+    )
+
+
+def test_background_copy_and_gc_events(tmp_path):
+    node, tracer = _node_with_tracer(tmp_path, "n")
+    k = tp.PARAMS.security_param  # 100
+    chain = _forge_chain(tp.POOLS[0], range(1, k + 5))
+    for b in chain:
+        node.chain_db.add_block(b)
+    kinds = _types(tracer.events)
+    assert "CopiedToImmutableDB" in kinds
+    assert "PerformedGC" in kinds
+    copied = [e for e in tracer.events if isinstance(e, T.CopiedToImmutableDB)]
+    assert sum(e.n_blocks for e in copied) == 4  # k+4 blocks, k stay
+
+
+def test_enclose_brackets_on_batch_path():
+    """The stage/dispatch/materialize/epilogue Enclose brackets fire in
+    order with durations on the end edges."""
+    from ouroboros_consensus_tpu.protocol import batch as pbatch
+    from ouroboros_consensus_tpu.protocol import praos
+    from ouroboros_consensus_tpu.testing import fixtures
+
+    params = praos.PraosParams(
+        slots_per_kes_period=100, max_kes_evolutions=62, security_param=4,
+        active_slot_coeff=Fraction(1), epoch_length=1000, kes_depth=2,
+    )
+    pool = fixtures.make_pool(0, kes_depth=2)
+    lview = fixtures.make_ledger_view([pool])
+    eta = b"\x07" * 32
+    hvs, prev = [], None
+    for s in range(1, 5):
+        hvs.append(fixtures.forge_header_view(
+            params, pool, slot=s, epoch_nonce=eta, prev_hash=prev,
+            body_bytes=b"b%d" % s,
+        ))
+        prev = (b"%032d" % s)[:32]
+    tracer = T.ListTracer()
+    pbatch.set_batch_tracer(tracer)
+    try:
+        import dataclasses
+
+        st = dataclasses.replace(praos.PraosState(), epoch_nonce=eta)
+        res = pbatch.validate_chain(
+            params, lambda _e: lview, st, hvs, backend="device",
+        )
+        assert res.error is None and res.n_valid == 4
+    finally:
+        pbatch.set_batch_tracer(None)
+    labels = [(e.label, e.edge) for e in tracer.events]
+    assert labels == [
+        ("stage", "start"), ("stage", "end"),
+        ("dispatch", "start"), ("dispatch", "end"),
+        ("materialize", "start"), ("materialize", "end"),
+        ("epilogue", "start"), ("epilogue", "end"),
+    ]
+    ends = [e for e in tracer.events if e.edge == "end"]
+    assert all(e.duration is not None and e.duration >= 0 for e in ends)
