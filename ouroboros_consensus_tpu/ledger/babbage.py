@@ -303,7 +303,9 @@ class BabbageLedger(AlonzoLedger):
     # _check_collateral, _consume_collateral); only the reference-input
     # precondition is new
     def apply_tx(self, view: TxView, tx_bytes: bytes) -> TxView:
-        tx = decode_tx(tx_bytes)
+        # self._decode_tx so Conway (and any later era) inherits the
+        # rule against its own tx format without re-stating it
+        tx = self._decode_tx(tx_bytes)
         # reference inputs must exist and are read-only
         for txin in tx.ref_ins:
             if txin not in view.utxo:

@@ -225,13 +225,18 @@ class ConwayLedger(BabbageLedger):
     # -- GOV rule (proposals + votes inside apply) -------------------------
 
     def _apply_gov(self, scratch: TxView, tx: ConwayTx,
-                   tid: bytes) -> int:
+                   tid: bytes, check: bool = True) -> int:
         """Validate + record this tx's proposals and votes; returns the
-        governance deposits taken."""
+        governance deposits taken. `check=False` is the reapply mode:
+        record the same state mutations with NO validation (reapply
+        skips all checks, Extended.hs:159) — in particular a vote must
+        be recorded even if its DRep deregistered in a LATER tx of the
+        same block, which the post-block view can no longer certify."""
         deposits = 0
         for ix, (return_cred, (kind, payload)) in enumerate(tx.proposals):
             if kind == 0:
-                scratch.pparams.with_updates(payload)  # validates
+                if check:
+                    scratch.pparams.with_updates(payload)  # validates
                 norm = tuple(sorted(
                     (k.decode() if isinstance(k, bytes) else k,
                      tuple(v) if isinstance(v, (list, tuple)) else v)
@@ -241,7 +246,7 @@ class ConwayLedger(BabbageLedger):
                 norm = tuple(
                     (bytes(c), int(a)) for c, a in payload
                 )
-                if any(a <= 0 for _c, a in norm):
+                if check and any(a <= 0 for _c, a in norm):
                     raise GovError("non-positive treasury withdrawal")
             else:
                 raise GovError(f"unknown governance action kind {kind}")
@@ -252,25 +257,20 @@ class ConwayLedger(BabbageLedger):
             )
             deposits += dep
         for drep, txid, ix, yes in tx.votes:
-            if drep not in scratch.dreps:
-                raise GovError(f"vote from unknown drep {drep.hex()[:8]}")
-            if (txid, ix) not in scratch.gov_actions:
-                raise GovError(
-                    f"vote on unknown action {txid.hex()[:8]}#{ix}"
-                )
+            if check:
+                if drep not in scratch.dreps:
+                    raise GovError(
+                        f"vote from unknown drep {drep.hex()[:8]}"
+                    )
+                if (txid, ix) not in scratch.gov_actions:
+                    raise GovError(
+                        f"vote on unknown action {txid.hex()[:8]}#{ix}"
+                    )
             scratch.gov_votes[((txid, ix), drep)] = yes
         return deposits
 
-    def apply_tx(self, view: TxView, tx_bytes: bytes) -> TxView:
-        tx = decode_tx(tx_bytes)
-        from .shelley import BadInputs
-
-        for txin in tx.ref_ins:
-            if txin not in view.utxo:
-                raise BadInputs(txin)
-            if txin in tx.ins:
-                raise ShelleyTxError("input is both spent and referenced")
-        return self._apply_decoded(view, tx, tx_bytes)
+    # apply_tx: inherited from Babbage — its ref-ins rule decodes via
+    # self._decode_tx, so it already reads ConwayTx here
 
     def _apply_era_extras(self, scratch: TxView, tx, tx_bytes: bytes) -> int:
         """Governance rides the certificate scratch/commit window and
@@ -318,7 +318,7 @@ class ConwayLedger(BabbageLedger):
         view = self.mempool_view(st, ticked.slot)
         dep = 0
         for tx, tid in gov_txs:
-            dep += self._apply_gov(view, tx, tid)
+            dep += self._apply_gov(view, tx, tid, check=False)
         return replace(
             st,
             gov_actions=view.gov_actions,

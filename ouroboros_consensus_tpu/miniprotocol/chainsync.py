@@ -27,12 +27,11 @@ runtime (utils/sim.py); the same logic drives the asyncio TCP transport.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any
+from dataclasses import dataclass
 
-from ..block.abstract import Point
 from ..block.praos_block import Block, Header
 from ..ledger.abstract import OutsideForecastRange
+from ..ledger.header_history import HeaderStateHistory
 from ..protocol import praos as praos_mod
 from ..utils.sim import Recv, Send, Sleep, Wait
 
@@ -45,73 +44,22 @@ class ChainSyncClientException(Exception):
 
 
 @dataclass
-class Candidate:
-    """Per-peer candidate fragment + protocol states per position.
+class Candidate(HeaderStateHistory):
+    """Per-peer candidate fragment: theirHeaderStateHistory (Client.hs:291).
 
-    Invariant: len(states) == len(headers) + 1 — states[0] is the
-    protocol state at the intersection (anchor), states[i+1] the state
-    after validating headers[i]. This is theirHeaderStateHistory
-    (Client.hs:291) with O(1) rollback.
+    A HeaderStateHistory (ledger/header_history.py) whose entries are the
+    peer's headers and whose states are raw protocol chain-dep states —
+    states[0] is the state at the intersection (anchor), states[i+1] the
+    state after validating headers[i], roll_backward is an O(1)
+    truncation.
+
+    The `settled` gate: only headers already adopted on OUR chain may be
+    trimmed — dropping a not-yet-fetched header would orphan BlockFetch's
+    anchor. The candidate stays bounded anyway: validation cannot outrun
+    the forecast horizon (~3k/f ahead of our tip), which is what bounds
+    the reference's fragment too. Rolling back deeper than k fails — the
+    reference disconnects such peers (Client.hs rollback depth check).
     """
-
-    headers: list = field(default_factory=list)
-    states: list = field(default_factory=list)
-    # trim bound (HeaderStateHistory trims to the security parameter k):
-    # a long sync holds O(k) state; rolling back deeper than k fails —
-    # the reference disconnects such peers. None = unbounded (test-only).
-    k: int | None = None
-    trimmed: bool = False  # anchor advanced past the intersection
-    # `settled(point) -> bool`: is that block already adopted on OUR
-    # chain? Only settled headers may be trimmed — dropping a not-yet-
-    # fetched header would orphan BlockFetch's anchor. The candidate
-    # stays bounded anyway: validation cannot outrun the forecast
-    # horizon (~3k/f ahead of our tip), which is what bounds the
-    # reference's fragment too.
-    settled: Any = None
-
-    def tip_point(self) -> Point | None:
-        return self.headers[-1].point if self.headers else None
-
-    def reset(self, base_state) -> None:
-        self.headers = []
-        self.states = [base_state]
-        self.trimmed = False
-
-    def extend(self, header, state) -> None:
-        self.headers.append(header)
-        self.states.append(state)
-        self.trim()
-
-    def trim(self) -> None:
-        """Advance the anchor while the history exceeds k and its oldest
-        header is settled. Called on extension AND after BlockFetch
-        adopts blocks (settling is what makes trimming safe)."""
-        while self.k is not None and len(self.headers) > self.k:
-            if self.settled is not None and not self.settled(
-                self.headers[0].point
-            ):
-                break
-            del self.headers[0]
-            del self.states[0]
-            self.trimmed = True
-
-    def truncate_to(self, point: Point | None) -> bool:
-        """Roll back the suffix to `point` (None = back to the
-        intersection). False if the point is no longer on the candidate
-        — including an intersection rollback after trimming (deeper
-        than k ⇒ disconnect, Client.hs rollback depth check)."""
-        if point is None:
-            if self.trimmed:
-                return False
-            del self.headers[:]
-            del self.states[1:]
-            return True
-        for i in range(len(self.headers) - 1, -1, -1):
-            if self.headers[i].point == point:
-                del self.headers[i + 1 :]
-                del self.states[i + 2 :]
-                return True
-        return False
 
 
 def server(

@@ -166,11 +166,13 @@ class NodeKernel:
 
     def chain_dep_state_at(self, point: Point | None):
         """Protocol state after `point` on OUR chain (for seeding a
-        peer candidate at the intersection)."""
-        ext = self.chain_db.get_past_ledger(point)
-        if ext is None:
-            raise ValueError(f"{self.name}: no ledger state at {point}")
-        return ext.header_state.chain_dep_state
+        peer candidate at the intersection) — served from the ChainDB's
+        k-deep HeaderStateHistory (HeaderStateHistory.hs), not the full
+        LedgerDB checkpoints."""
+        hs = self.chain_db.header_state_at(point)
+        if hs is None:
+            raise ValueError(f"{self.name}: no header state at {point}")
+        return hs.chain_dep_state
 
     def prefer_candidate(self, cand_headers: list) -> bool:
         """preferAnchoredCandidate (BlockFetch/ClientInterface.hs): is

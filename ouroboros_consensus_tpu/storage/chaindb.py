@@ -46,6 +46,7 @@ from typing import Callable, Iterable, Sequence
 from ..block.abstract import Point
 from ..block.praos_block import Block
 from ..ledger.extended import ExtLedger, ExtLedgerState
+from ..ledger.header_history import HeaderStateHistory
 from ..utils.sim import Event, Fire, Sleep, Wait
 from .immutable import ImmutableDB
 from .ledgerdb import InvalidBlock, LedgerDB
@@ -260,7 +261,14 @@ class ChainDB:
         self._chain_event = Event("chain-changed")
         self._background_decoupled = False
         self.runtime = None  # object with .fire(Event), set by the node
+        # k-deep header-state history of the CURRENT chain
+        # (HeaderStateHistory.hs): answers header_state_at without
+        # touching the LedgerDB's full ExtLedgerStates. Maintained
+        # incrementally by _install, resynced from the LedgerDB (the
+        # authoritative store) when the shapes diverge.
+        self.header_history = HeaderStateHistory(k=k)
         self._init_chain_selection()
+        self._sync_header_history()
 
     # -- initial chain selection (ChainSel.hs:96) ----------------------------
 
@@ -336,6 +344,48 @@ class ChainDB:
 
     def get_past_ledger(self, point: Point | None) -> ExtLedgerState | None:
         return self.ledgerdb.past_state(point)
+
+    def header_state_at(self, point: Point | None):
+        """HeaderState at `point` on the current chain, answered from the
+        k-deep HeaderStateHistory (HeaderStateHistory.hs) — the cheap
+        path for seeding a ChainSync peer candidate at an intersection.
+        Falls back to the LedgerDB for the anchor/genesis. None if the
+        point is not on the recent chain."""
+        if point is not None:
+            hs = self.header_history.state_at(point)
+            if hs is not None:
+                return hs
+        ext = self.ledgerdb.past_state(point)
+        return None if ext is None else ext.header_state
+
+    def _sync_header_history(self) -> None:
+        """Rebuild the header history from the LedgerDB checkpoints.
+
+        The LedgerDB's volatile tail aligns 1:1 with the newest
+        current_chain blocks (both are pruned to k); its header states
+        ARE the history."""
+        states = self.ledgerdb.header_states()
+        n = len(states) - 1
+        hh = self.header_history
+        hh.states = states
+        hh.headers = (
+            [b.header for b in self.current_chain[len(self.current_chain) - n :]]
+            if n > 0
+            else []
+        )
+        hh.trimmed = states[0].tip is not None
+
+    def _update_header_history(self, n_rollback: int, suffix: list[Block]) -> None:
+        """Incremental history maintenance after _install: rollback the
+        replaced suffix, append the new states the LedgerDB just pushed.
+        extend() trims to k as the chain grows."""
+        hh = self.header_history
+        if n_rollback <= len(hh.headers) and len(suffix) <= self.ledgerdb.volatile_length():
+            hh.rollback_n(n_rollback)
+            for b, hs in zip(suffix, self.ledgerdb.last_header_states(len(suffix))):
+                hh.extend(b.header, hs)
+        else:
+            self._sync_header_history()
 
     def get_is_invalid_block(self, hash_: bytes) -> Exception | None:
         return self.invalid.get(hash_)
@@ -724,6 +774,7 @@ class ChainDB:
         else:
             rollback_point = None
         self.current_chain.extend(suffix)
+        self._update_header_history(n_rollback, suffix)
         tip_slot = self.current_chain[-1].slot if self.current_chain else -1
         if n_rollback:
             self.tracer(

@@ -99,6 +99,16 @@ class LedgerDB:
                 return st
         return None
 
+    def header_states(self) -> list[HeaderState]:
+        """Header states of every checkpoint, anchor first — the seed
+        for the ChainDB's HeaderStateHistory (HeaderStateHistory.hs
+        `fromChain` over the in-memory checkpoints)."""
+        return [st.header_state for _, st in self._seq]
+
+    def last_header_states(self, n: int) -> list[HeaderState]:
+        """Header states of the newest n checkpoints, oldest first."""
+        return [st.header_state for _, st in self._seq[len(self._seq) - n :]] if n else []
+
     # -- updates -------------------------------------------------------------
 
     def push(self, block, apply: bool = True) -> ExtLedgerState:

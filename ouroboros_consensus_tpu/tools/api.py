@@ -1,0 +1,316 @@
+"""Cardano.Api shim: typed key roles + operational certificates.
+
+Reference: the key/certificate machinery the reference vendors for its
+tools — `src/tools/Cardano/Api/KeysShelley.hs` (1,221 LoC of key-role
+newtypes: Payment/Stake/StakePool/GenesisDelegate keys, each with
+SigningKey/VerificationKey, raw serialization, key hashes and
+TextEnvelope types), `.../Cardano/Api/KeysPraos.hs` (VRF + KES roles),
+and `.../Cardano/Api/OperationalCertificate.hs` (OperationalCertificate,
+the issue counter, `issueOperationalCertificate`).
+
+TPU-first design note: roles are DATA here (one registry row per role:
+envelope strings + derivation + hash width), not one newtype pile per
+role — the behavior matched is serialization, role type-checking at
+load, key hashing, and the OpCert issue/verify cycle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Callable
+
+from ..ops.host import fast
+from ..ops.host import kes as host_kes
+from ..ops.host.ed25519 import verify as _ed25519_verify
+from ..ops.host.hashes import blake2b_224, blake2b_256
+from ..protocol.views import OCert
+from ..utils import cbor as _cbor
+
+
+# ---------------------------------------------------------------------------
+# Key roles (KeysShelley.hs newtypes -> a role registry)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class KeyRole:
+    """One key role: its envelope type strings, how a verification key
+    is derived from a signing seed, and how it is hashed.
+
+    KeysShelley.hs gives each role `SigningKey`/`VerificationKey`
+    instances plus `verificationKeyHash`; KeysPraos.hs the VRF/KES
+    roles. `vk_hash` is Blake2b-224 for operator/address roles (KeyHash)
+    and Blake2b-256 for VRF (hashVerKeyVRF).
+    """
+
+    name: str
+    signing_type: str  # TextEnvelope "type" for the signing key
+    verification_type: str  # TextEnvelope "type" for the verification key
+    derive_vk: Callable[[bytes], bytes]
+    vk_hash: Callable[[bytes], bytes]
+
+
+def _kes_derive(seed: bytes, depth: int = host_kes.DEFAULT_DEPTH) -> bytes:
+    return host_kes.derive_vk(seed, depth)
+
+
+KEY_ROLES: dict[str, KeyRole] = {
+    r.name: r
+    for r in [
+        # address roles (KeysShelley.hs PaymentKey/StakeKey)
+        KeyRole("payment", "PaymentSigningKey_ed25519",
+                "PaymentVerificationKey_ed25519",
+                fast.ed25519_public, blake2b_224),
+        KeyRole("stake", "StakeSigningKey_ed25519",
+                "StakeVerificationKey_ed25519",
+                fast.ed25519_public, blake2b_224),
+        # operator roles (KeysShelley.hs StakePoolKey/GenesisDelegateKey)
+        KeyRole("stake_pool", "StakePoolSigningKey_ed25519",
+                "StakePoolVerificationKey_ed25519",
+                fast.ed25519_public, blake2b_224),
+        KeyRole("genesis_delegate", "GenesisDelegateSigningKey_ed25519",
+                "GenesisDelegateVerificationKey_ed25519",
+                fast.ed25519_public, blake2b_224),
+        # forging roles (KeysPraos.hs VrfKey/KesKey)
+        KeyRole("vrf", "VrfSigningKey_ecvrf25519",
+                "VrfVerificationKey_ecvrf25519",
+                fast.ed25519_public, blake2b_256),
+        KeyRole("kes", "KesSigningKey_compactsum",
+                "KesVerificationKey_compactsum",
+                _kes_derive, blake2b_224),
+    ]
+}
+
+
+@dataclass(frozen=True)
+class SigningKey:
+    role: KeyRole
+    seed: bytes
+    kes_depth: int | None = None  # KES only: the tree depth
+
+    def verification_key(self) -> "VerificationKey":
+        if self.role.name == "kes":
+            depth = (
+                self.kes_depth if self.kes_depth is not None
+                else host_kes.DEFAULT_DEPTH
+            )
+            return VerificationKey(self.role, _kes_derive(self.seed, depth))
+        return VerificationKey(self.role, self.role.derive_vk(self.seed))
+
+
+@dataclass(frozen=True)
+class VerificationKey:
+    role: KeyRole
+    vk: bytes
+
+    def key_hash(self) -> bytes:
+        """verificationKeyHash (KeysShelley.hs per-role instances)."""
+        return self.role.vk_hash(self.vk)
+
+
+def generate_signing_key(role_name: str, seed: bytes,
+                         kes_depth: int | None = None) -> SigningKey:
+    """deterministicSigningKey analog: role + 32-byte seed."""
+    if len(seed) != 32:
+        raise ValueError(f"signing seed must be 32 bytes, got {len(seed)}")
+    return SigningKey(KEY_ROLES[role_name], seed, kes_depth)
+
+
+# ---------------------------------------------------------------------------
+# TextEnvelope serialization (SerialiseTextEnvelope / SerialiseAsCBOR)
+# ---------------------------------------------------------------------------
+
+
+def write_envelope(path: str, type_: str, description: str, payload: bytes) -> str:
+    env = {"type": type_, "description": description, "cborHex": payload.hex()}
+    with open(path, "w") as f:
+        json.dump(env, f, indent=1)
+    return path
+
+
+def read_envelope(path: str, expected_type: str) -> bytes:
+    """Type string CHECKED on load — the reference fails a mismatch
+    (TextEnvelopeTypeError, SerialiseTextEnvelope)."""
+    with open(path) as f:
+        env = json.load(f)
+    if env.get("type") != expected_type:
+        raise ValueError(
+            f"{path}: envelope type {env.get('type')!r}, "
+            f"expected {expected_type!r}"
+        )
+    return bytes.fromhex(env["cborHex"])
+
+
+def write_signing_key(path: str, sk: SigningKey) -> str:
+    if sk.role.name == "kes":
+        depth = (
+            sk.kes_depth if sk.kes_depth is not None
+            else host_kes.DEFAULT_DEPTH
+        )
+        payload = _cbor.encode([sk.seed, depth])
+    else:
+        payload = _cbor.encode(sk.seed)
+    return write_envelope(
+        path, sk.role.signing_type, f"{sk.role.name} signing key", payload
+    )
+
+
+def read_signing_key(path: str, role_name: str) -> SigningKey:
+    role = KEY_ROLES[role_name]
+    payload = _cbor.decode(read_envelope(path, role.signing_type))
+    if role.name == "kes":
+        seed, depth = payload
+        return SigningKey(role, bytes(seed), int(depth))
+    return SigningKey(role, bytes(payload))
+
+
+def write_verification_key(path: str, vkey: VerificationKey) -> str:
+    return write_envelope(
+        path, vkey.role.verification_type,
+        f"{vkey.role.name} verification key", _cbor.encode(vkey.vk),
+    )
+
+
+def read_verification_key(path: str, role_name: str) -> VerificationKey:
+    role = KEY_ROLES[role_name]
+    return VerificationKey(
+        role, bytes(_cbor.decode(read_envelope(path, role.verification_type)))
+    )
+
+
+# ---------------------------------------------------------------------------
+# Operational certificates (Cardano/Api/OperationalCertificate.hs)
+# ---------------------------------------------------------------------------
+
+OPCERT_TYPE = "NodeOperationalCertificate"
+OPCERT_COUNTER_TYPE = "NodeOperationalCertificateIssueCounter"
+
+
+def encode_ocert(ocert: OCert) -> bytes:
+    """CBOR [kes_vk, counter, kes_period, sigma] — the reference's
+    OperationalCertificate ToCBOR shape."""
+    return _cbor.encode(
+        [ocert.vk_hot, ocert.counter, ocert.kes_period, ocert.sigma]
+    )
+
+
+def decode_ocert(data: bytes) -> OCert:
+    vk_hot, counter, kes_period, sigma = _cbor.decode(data)
+    return OCert(bytes(vk_hot), int(counter), int(kes_period), bytes(sigma))
+
+
+def write_ocert(path: str, ocert: OCert) -> str:
+    return write_envelope(
+        path, OPCERT_TYPE, "", encode_ocert(ocert)
+    )
+
+
+def read_ocert(path: str) -> OCert:
+    return decode_ocert(read_envelope(path, OPCERT_TYPE))
+
+
+@dataclass(frozen=True)
+class OpCertIssueCounter:
+    """The on-disk issue counter (OperationalCertificateIssueCounter):
+    next issue number + the cold verification key it belongs to."""
+
+    next_counter: int
+    cold_vk: bytes
+
+
+def write_counter(path: str, counter: OpCertIssueCounter) -> str:
+    return write_envelope(
+        path, OPCERT_COUNTER_TYPE,
+        f"Next certificate issue number: {counter.next_counter}",
+        _cbor.encode([counter.next_counter, counter.cold_vk]),
+    )
+
+
+def read_counter(path: str) -> OpCertIssueCounter:
+    n, vk = _cbor.decode(read_envelope(path, OPCERT_COUNTER_TYPE))
+    return OpCertIssueCounter(int(n), bytes(vk))
+
+
+class OperationalCertIssueError(Exception):
+    """issueOperationalCertificate errors: counter file for a different
+    cold key (OperationalCertKeyMismatch)."""
+
+
+def issue_operational_certificate(
+    cold_sk: SigningKey,
+    counter: OpCertIssueCounter,
+    kes_vk: bytes,
+    kes_period: int,
+) -> tuple[OCert, OpCertIssueCounter]:
+    """issueOperationalCertificate: sign (kes_vk, counter, period) with
+    the cold key; the caller persists the bumped counter. Fails if the
+    counter file belongs to a different cold key."""
+    cold_vk = fast.ed25519_public(cold_sk.seed)
+    if counter.cold_vk != cold_vk:
+        raise OperationalCertIssueError(
+            "issue counter belongs to a different cold key"
+        )
+    oc = OCert(kes_vk, counter.next_counter, kes_period, b"")
+    sigma = fast.ed25519_sign(cold_sk.seed, oc.signable())
+    return (
+        OCert(kes_vk, counter.next_counter, kes_period, sigma),
+        OpCertIssueCounter(counter.next_counter + 1, cold_vk),
+    )
+
+
+def verify_operational_certificate(ocert: OCert, cold_vk: bytes) -> bool:
+    """The OCERT check's signature leg (Praos.hs:585-606 host twin):
+    does the cold key certify this KES vk/counter/period?"""
+    return _ed25519_verify(cold_vk, ocert.signable(), ocert.sigma)
+
+
+# ---------------------------------------------------------------------------
+# Node credential bundles (the gen-node-keys cycle the reference's
+# tools-test exercises: cold/vrf/kes keys + opcert + counter on disk)
+# ---------------------------------------------------------------------------
+
+
+def generate_node_keys(
+    dir_path: str, seeds: dict[str, bytes], kes_depth: int = host_kes.DEFAULT_DEPTH
+) -> dict[str, str]:
+    """Write a full node credential set: cold(.skey/.vkey/.counter),
+    vrf, kes, and an opcert issued for KES period 0. Returns
+    {artifact: path}."""
+    os.makedirs(dir_path, exist_ok=True)
+    paths = {}
+    cold = generate_signing_key("stake_pool", seeds["cold"])
+    vrf = generate_signing_key("vrf", seeds["vrf"])
+    kes = generate_signing_key("kes", seeds["kes"], kes_depth)
+    for name, sk in [("cold", cold), ("vrf", vrf), ("kes", kes)]:
+        paths[f"{name}.skey"] = write_signing_key(
+            os.path.join(dir_path, f"{name}.skey"), sk
+        )
+        paths[f"{name}.vkey"] = write_verification_key(
+            os.path.join(dir_path, f"{name}.vkey"), sk.verification_key()
+        )
+    counter = OpCertIssueCounter(0, cold.verification_key().vk)
+    ocert, counter = issue_operational_certificate(
+        cold, counter, kes.verification_key().vk, kes_period=0
+    )
+    paths["opcert"] = write_ocert(os.path.join(dir_path, "node.opcert"), ocert)
+    paths["counter"] = write_counter(
+        os.path.join(dir_path, "cold.counter"), counter
+    )
+    return paths
+
+
+def load_node_keys(dir_path: str):
+    """-> (cold SigningKey, vrf SigningKey, kes SigningKey, OCert,
+    OpCertIssueCounter), verifying the opcert against the cold key."""
+    cold = read_signing_key(os.path.join(dir_path, "cold.skey"), "stake_pool")
+    vrf = read_signing_key(os.path.join(dir_path, "vrf.skey"), "vrf")
+    kes = read_signing_key(os.path.join(dir_path, "kes.skey"), "kes")
+    ocert = read_ocert(os.path.join(dir_path, "node.opcert"))
+    counter = read_counter(os.path.join(dir_path, "cold.counter"))
+    if not verify_operational_certificate(
+        ocert, cold.verification_key().vk
+    ):
+        raise OperationalCertIssueError("opcert signature invalid")
+    return cold, vrf, kes, ocert, counter

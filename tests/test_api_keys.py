@@ -1,0 +1,104 @@
+"""Cardano.Api shim: key roles, TextEnvelope round-trips, OpCert cycle.
+
+Reference: `src/tools/Cardano/Api/{KeysShelley,KeysPraos,
+OperationalCertificate}.hs`.
+"""
+
+import pytest
+
+from ouroboros_consensus_tpu.ops.host import fast
+from ouroboros_consensus_tpu.testing import fixtures
+from ouroboros_consensus_tpu.tools import api
+
+SEED_A = bytes(range(32))
+SEED_B = bytes(range(1, 33))
+
+
+def test_role_registry_derivations():
+    for name in ["payment", "stake", "stake_pool", "genesis_delegate"]:
+        sk = api.generate_signing_key(name, SEED_A)
+        vk = sk.verification_key()
+        assert vk.vk == fast.ed25519_public(SEED_A)
+        assert len(vk.key_hash()) == 28  # Blake2b-224 KeyHash
+    vrf = api.generate_signing_key("vrf", SEED_A).verification_key()
+    assert len(vrf.key_hash()) == 32  # hashVerKeyVRF is Blake2b-256
+    kes = api.generate_signing_key("kes", SEED_A, kes_depth=2)
+    assert len(kes.verification_key().vk) == 32
+
+
+def test_signing_key_envelope_roundtrip(tmp_path):
+    for name in ["payment", "stake_pool", "vrf"]:
+        sk = api.generate_signing_key(name, SEED_A)
+        p = api.write_signing_key(str(tmp_path / f"{name}.skey"), sk)
+        back = api.read_signing_key(p, name)
+        assert back.seed == SEED_A and back.role.name == name
+    kes = api.generate_signing_key("kes", SEED_B, kes_depth=3)
+    p = api.write_signing_key(str(tmp_path / "kes.skey"), kes)
+    back = api.read_signing_key(p, "kes")
+    assert back.seed == SEED_B and back.kes_depth == 3
+    # verification keys too
+    vk = kes.verification_key()
+    p = api.write_verification_key(str(tmp_path / "kes.vkey"), vk)
+    assert api.read_verification_key(p, "kes").vk == vk.vk
+
+
+def test_envelope_type_checked(tmp_path):
+    sk = api.generate_signing_key("payment", SEED_A)
+    p = api.write_signing_key(str(tmp_path / "k.skey"), sk)
+    with pytest.raises(ValueError, match="envelope type"):
+        api.read_signing_key(p, "stake_pool")
+
+
+def test_opcert_issue_verify_counter_cycle(tmp_path):
+    cold = api.generate_signing_key("stake_pool", SEED_A)
+    kes = api.generate_signing_key("kes", SEED_B, kes_depth=2)
+    counter = api.OpCertIssueCounter(5, cold.verification_key().vk)
+    ocert, counter2 = api.issue_operational_certificate(
+        cold, counter, kes.verification_key().vk, kes_period=7
+    )
+    assert ocert.counter == 5 and ocert.kes_period == 7
+    assert counter2.next_counter == 6
+    assert api.verify_operational_certificate(
+        ocert, cold.verification_key().vk
+    )
+    # wrong cold key fails verification
+    other = api.generate_signing_key("stake_pool", SEED_B)
+    assert not api.verify_operational_certificate(
+        ocert, other.verification_key().vk
+    )
+    # counter file for a different cold key is a hard error
+    with pytest.raises(api.OperationalCertIssueError):
+        api.issue_operational_certificate(
+            other, counter, kes.verification_key().vk, kes_period=7
+        )
+    # envelope round-trips
+    p = api.write_ocert(str(tmp_path / "node.opcert"), ocert)
+    assert api.read_ocert(p) == ocert
+    p = api.write_counter(str(tmp_path / "cold.counter"), counter2)
+    assert api.read_counter(p) == counter2
+
+
+def test_opcert_matches_fixture_issuance():
+    """api-issued opcerts are byte-compatible with the ThreadNet
+    fixtures' make_ocert (same signable, same cold signature)."""
+    pool = fixtures.make_pool(0, kes_depth=2)
+    fixture_oc = pool.make_ocert(counter=3, kes_period=11)
+    cold = api.generate_signing_key("stake_pool", pool.cold_seed)
+    counter = api.OpCertIssueCounter(3, pool.vk_cold)
+    oc, _ = api.issue_operational_certificate(
+        cold, counter, pool.kes_vk, kes_period=11
+    )
+    assert oc == fixture_oc
+
+
+def test_node_key_bundle_cycle(tmp_path):
+    seeds = {"cold": SEED_A, "vrf": SEED_B, "kes": bytes(32)}
+    paths = api.generate_node_keys(str(tmp_path), seeds, kes_depth=2)
+    assert set(paths) >= {"opcert", "counter", "cold.skey", "kes.vkey"}
+    cold, vrf, kes, ocert, counter = api.load_node_keys(str(tmp_path))
+    assert cold.seed == SEED_A and kes.kes_depth == 2
+    assert counter.next_counter == 1  # bumped past the issued cert
+    assert ocert.counter == 0
+    # a forged node using these credentials signs headers the protocol
+    # accepts: the opcert's KES vk is the derived root
+    assert ocert.vk_hot == kes.verification_key().vk

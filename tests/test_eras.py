@@ -480,6 +480,44 @@ class TestConwayGovernance:
         assert not t.state.gov_actions  # expired
         assert t.state.rewards[SC] >= gdep  # refunded
 
+    def test_reapply_vote_then_dereg_in_same_block(self):
+        """REAPPLY must replay a block where a DRep votes and then
+        deregisters in a LATER tx of the SAME block: the vote replay
+        runs against the post-block state, where the DRep is already
+        gone — reapply skips all checks (Extended.hs:159), so this must
+        reproduce the applied state, not raise (round-5 review
+        finding)."""
+        led, st = self._setup()
+        dep = st.pparams.drep_deposit
+        gdep = st.pparams.gov_action_deposit
+        tx1 = conway.encode_tx(
+            [(bytes(32), 0)], [(b"payme", SC, 10_000 - dep - gdep)],
+            certs=[[7, DREP], [9, SC, DREP]],
+            proposals=[(SC, [0, {b"min_fee_a": 7}])],
+        )
+        tid1 = conway.tx_id(tx1)
+        tx2 = conway.encode_tx(
+            [(tid1, 0)], [(b"payme", SC, 10_000 - dep - gdep)],
+            votes=[(DREP, tid1, 0, True)],
+        )
+        tx3 = conway.encode_tx(
+            [(conway.tx_id(tx2), 0)], [(b"payme", SC, 10_000 - gdep)],
+            certs=[[8, DREP]],  # deregister the voter
+        )
+        class _Blk:
+            slot = 5
+            txs = (tx1, tx2, tx3)
+
+        blk = _Blk()
+        applied = led.apply_block(led.tick(st, 5), blk)
+        assert DREP not in applied.dreps
+        assert applied.gov_votes  # the vote was recorded before dereg
+        reapplied = led.reapply_block(led.tick(st, 5), blk)
+        assert reapplied.gov_votes == applied.gov_votes
+        assert reapplied.gov_actions == applied.gov_actions
+        assert reapplied.deposits == applied.deposits
+        assert dict(reapplied.utxo) == dict(applied.utxo)
+
     def test_vote_from_unregistered_drep_rejected(self):
         led, st = self._setup()
         v = led.mempool_view(st, 5)

@@ -1,0 +1,200 @@
+"""HeaderStateHistory as a first-class component.
+
+Reference: `Ouroboros.Consensus.HeaderStateHistory` (HeaderStateHistory.hs
+current/append/rewind/trim/fromChain) — the k-deep header-state history
+shared by the ChainSync client's candidate (Client.hs:291) and the
+ChainDB's header-state-at-a-recent-point query.
+"""
+
+from dataclasses import replace
+from fractions import Fraction
+
+from ouroboros_consensus_tpu.block import forge_block
+from ouroboros_consensus_tpu.ledger import ExtLedger
+from ouroboros_consensus_tpu.ledger import mock as mock_ledger
+from ouroboros_consensus_tpu.ledger.header_history import HeaderStateHistory
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.protocol.instances import PraosProtocol
+from ouroboros_consensus_tpu.storage.open import open_chaindb
+from ouroboros_consensus_tpu.testing import fixtures
+
+PARAMS = praos.PraosParams(
+    slots_per_kes_period=1000,
+    max_kes_evolutions=62,
+    security_param=5,  # tiny k: trimming + immutable-copy kick in fast
+    active_slot_coeff=Fraction(1),
+    epoch_length=10_000,
+    kes_depth=2,
+)
+POOLS = [fixtures.make_pool(i, kes_depth=2) for i in range(2)]
+LVIEW = fixtures.make_ledger_view(POOLS)
+ETA0 = b"\x33" * 32
+
+
+def _forge_chain(n, start_slot=1, prev=None, block_no=0):
+    blocks = []
+    for i in range(n):
+        b = forge_block(
+            PARAMS, POOLS[i % 2], slot=start_slot + i, block_no=block_no + i,
+            prev_hash=prev, epoch_nonce=ETA0,
+        )
+        blocks.append(b)
+        prev = b.hash_
+    return blocks
+
+
+def _mk_ext():
+    ledger = mock_ledger.MockLedger(
+        mock_ledger.MockConfig(LVIEW, PARAMS.stability_window)
+    )
+    protocol = PraosProtocol(PARAMS, use_device_batch=False)
+    ext = ExtLedger(ledger, protocol)
+    st = ext.genesis(ledger.genesis_state([]))
+    st = replace(
+        st,
+        header_state=replace(
+            st.header_state,
+            chain_dep_state=replace(
+                st.header_state.chain_dep_state, epoch_nonce=ETA0
+            ),
+        ),
+    )
+    return ext, st
+
+
+# -- the pure component ------------------------------------------------------
+
+
+def test_from_chain_matches_sequential_fold():
+    """fromChain recomputes the same states the protocol fold produces."""
+    ext, st = _mk_ext()
+    headers = [b.header for b in _forge_chain(8)]
+    hh = HeaderStateHistory.from_chain(
+        ext.protocol, lambda _s: LVIEW, st.header_state.chain_dep_state, headers
+    )
+    assert len(hh.headers) == 8
+    assert len(hh.states) == 9
+    # sequential fold twin
+    s = st.header_state.chain_dep_state
+    for i, h in enumerate(headers):
+        s = ext.protocol.update(
+            h.to_view(), h.slot, ext.protocol.tick(LVIEW, h.slot, s)
+        )
+        assert hh.states[i + 1] == s
+    assert hh.current() == s
+    assert hh.tip_point() == headers[-1].point
+
+
+def test_rewind_and_rollback_restore_states():
+    ext, st = _mk_ext()
+    headers = [b.header for b in _forge_chain(6)]
+    hh = HeaderStateHistory.from_chain(
+        ext.protocol, lambda _s: LVIEW, st.header_state.chain_dep_state, headers
+    )
+    mid_state = hh.states[4]
+    assert hh.truncate_to(headers[3].point)
+    assert hh.current() == mid_state
+    assert len(hh.headers) == 4
+    # rewind to the anchor
+    assert hh.truncate_to(None)
+    assert hh.current() == st.header_state.chain_dep_state
+    # unknown point fails
+    assert not hh.truncate_to(headers[5].point)
+    # rollback_n symmetry
+    hh2 = HeaderStateHistory.from_chain(
+        ext.protocol, lambda _s: LVIEW, st.header_state.chain_dep_state, headers
+    )
+    assert hh2.rollback_n(2)
+    assert hh2.states == hh2.states[: len(hh2.headers) + 1]
+    assert len(hh2.headers) == 4
+    assert not hh2.rollback_n(99)
+
+
+def test_trim_to_k_and_settled_gate():
+    ext, st = _mk_ext()
+    headers = [b.header for b in _forge_chain(10)]
+    base = st.header_state.chain_dep_state
+
+    hh = HeaderStateHistory.from_chain(
+        ext.protocol, lambda _s: LVIEW, base, headers, k=4
+    )
+    assert len(hh.headers) == 4  # trimmed while extending
+    assert hh.trimmed
+    assert [h.point for h in hh.headers] == [h.point for h in headers[-4:]]
+    # anchor rollback after trimming is a disconnect-class failure
+    assert not hh.truncate_to(None)
+
+    # the settled gate holds trimming back until the owner settles blocks
+    settled: set = set()
+    hh = HeaderStateHistory(k=4, settled=lambda p: p in settled)
+    hh.reset(base)
+    for h in headers:
+        ticked = ext.protocol.tick(LVIEW, h.slot, hh.current())
+        hh.extend(h, ext.protocol.update(h.to_view(), h.slot, ticked))
+    assert len(hh.headers) == 10  # nothing settled: nothing trimmed
+    for h in headers[:8]:
+        settled.add(h.point)
+    hh.trim()
+    assert len(hh.headers) == 4
+    assert hh.trimmed  # the anchor moved past the original base
+
+
+def test_state_at_lookup():
+    ext, st = _mk_ext()
+    headers = [b.header for b in _forge_chain(6)]
+    hh = HeaderStateHistory.from_chain(
+        ext.protocol, lambda _s: LVIEW, st.header_state.chain_dep_state, headers
+    )
+    for i, h in enumerate(headers):
+        assert hh.state_at(h.point) == hh.states[i + 1]
+    missing = _forge_chain(1, start_slot=99, block_no=99)[0]
+    assert hh.state_at(missing.header.point) is None
+
+
+# -- ChainDB integration -----------------------------------------------------
+
+
+def test_chaindb_maintains_header_history(tmp_path):
+    """The ChainDB's history tracks adoption, stays k-bounded through
+    immutable copy, and header_state_at agrees with the LedgerDB."""
+    ext, st = _mk_ext()
+    db = open_chaindb(str(tmp_path / "db"), ext, st, PARAMS.security_param)
+    blocks = _forge_chain(12)
+    for b in blocks:
+        db.add_block(b)
+    hh = db.header_history
+    assert len(hh.headers) <= PARAMS.security_param
+    assert hh.states[-1].tip.hash_ == blocks[-1].hash_
+    # every current_chain point answers, and matches the LedgerDB's view
+    for b in db.current_chain:
+        hs = db.header_state_at(b.point)
+        assert hs is not None
+        ext_state = db.ledgerdb.past_state(b.point)
+        if ext_state is not None:
+            assert hs == ext_state.header_state
+    # a point deeper than k is beyond both the history and the LedgerDB
+    assert db.header_state_at(blocks[0].point) is None
+
+
+def test_chaindb_history_follows_fork_switch(tmp_path):
+    ext, st = _mk_ext()
+    db = open_chaindb(str(tmp_path / "db"), ext, st, PARAMS.security_param)
+    trunk = _forge_chain(4)
+    for b in trunk:
+        db.add_block(b)
+    assert db.header_history.states[-1].tip.hash_ == trunk[-1].hash_
+    # longer fork from trunk[1] (offset slots => distinct hashes)
+    fork = _forge_chain(
+        4, start_slot=trunk[1].slot + 5, prev=trunk[1].hash_, block_no=2
+    )
+    for b in fork:
+        db.add_block(b)
+    hh = db.header_history
+    assert db.current_chain[-1].hash_ == fork[-1].hash_
+    assert hh.states[-1].tip.hash_ == fork[-1].hash_
+    # the replaced suffix is gone from the history
+    assert hh.state_at(trunk[3].point) is None
+    assert hh.state_at(fork[0].point) is not None
+    # history/chain alignment: states[i+1].tip == headers[i]
+    for i, h in enumerate(hh.headers):
+        assert hh.states[i + 1].tip.hash_ == h.hash_
