@@ -31,8 +31,26 @@ import sys
 import time
 from fractions import Fraction
 
-BENCH_HEADERS = int(os.environ.get("BENCH_HEADERS", "100000"))
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
 KES_DEPTH = int(os.environ.get("BENCH_KES_DEPTH", "7"))
+
+
+def _default_headers() -> int:
+    """The north star is the 1M-header chain (BASELINE.json); replay it
+    whenever its synth cache exists. Synthesizing 1M takes ~15 min of
+    native forging — too long inside the bench's wall ceiling — so a
+    cold cache falls back to the 100k chain (which synthesizes in ~2.5
+    min) rather than blowing the budget. scripts/tpu_session.sh and the
+    round's own runs build the 1M cache; once present, every later
+    bench run measures at full scale."""
+    if os.path.exists(
+        os.path.join(CACHE_DIR, f"chain_h1000000_d{KES_DEPTH}", "COMPLETE")
+    ):
+        return 1_000_000
+    return 100_000
+
+
+BENCH_HEADERS = int(os.environ.get("BENCH_HEADERS", "0")) or _default_headers()
 MAX_BATCH = int(os.environ.get("BENCH_MAX_BATCH", "8192"))
 # total wall budget for device probing (fresh-process trivial op)
 PROBE_BUDGET = float(os.environ.get("BENCH_PROBE_BUDGET", "180"))
@@ -49,7 +67,7 @@ _T0 = time.monotonic()
 
 def _remaining() -> float:
     return TOTAL_BUDGET - (time.monotonic() - _T0)
-CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+CACHE = CACHE_DIR
 JAX_CACHE = "/tmp/ouroboros-jax-cache"
 
 
@@ -154,16 +172,28 @@ def emit(n, best, warm):
                    "platform": jax.devices()[0].platform}, f)
     os.replace(tmp, os.environ["OCT_RESULT"])
 
+# Warm up compiles/cache-loads on the SMALL cached chain when the
+# target is the 1M north star: a full-scale warmup replay would eat the
+# wall budget that should go to measured hot replays. Batch shapes are
+# bucketed, so the small chain exercises (nearly) all executables; any
+# residual new shape compiles once inside the first timed replay and
+# the second replay is clean.
+warm_path = path
+if BENCH_HEADERS > 200_000:
+    small = os.path.join(os.path.dirname(path), f"chain_h100000_d{KES_DEPTH}")
+    if os.path.exists(os.path.join(small, "COMPLETE")):
+        warm_path = small
 t0 = time.monotonic()
-r = ana.revalidate(path, params, lview, backend="device", validate_all=True,
-                   max_batch=MAX_BATCH)
+r = ana.revalidate(warm_path, params, lview, backend="device",
+                   validate_all=True, max_batch=MAX_BATCH)
 warm_s = time.monotonic() - t0
 assert r.error is None, repr(r.error)
 assert r.n_valid == r.n_blocks > 0
-# provisional checkpoint: the warmup run IS a full replay, so even if
-# the wall budget kills us mid-rerun the parent still has a number
-# (conservative: includes compile/cache-load time)
-emit(r.n_valid, warm_s, warm_s)
+if warm_path == path:
+    # provisional checkpoint: the warmup run IS a full replay, so even
+    # if the wall budget kills us mid-rerun the parent has a number
+    # (conservative: includes compile/cache-load time)
+    emit(r.n_valid, warm_s, warm_s)
 best = None
 for _ in range(2):
     t0 = time.monotonic()
@@ -201,7 +231,7 @@ def run_device_subprocess() -> dict | None:
                   file=sys.stderr)
             break
         if attempt == 1:
-            budget = min(budget, max(60.0, _remaining() * 0.7))
+            budget = min(budget, max(60.0, _remaining() * 0.85))
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", _DEVICE_CHILD],
@@ -241,14 +271,22 @@ def main() -> None:
 
     from ouroboros_consensus_tpu.tools import db_analyser as ana
 
+    # the native RATE is constant per header; at the 1M scale, measure
+    # it on a 200k prefix of the SAME chain so the wall ceiling converts
+    # into device measurement instead of a second 7-minute native replay
+    native_cap = 200_000 if BENCH_HEADERS > 200_000 else None
     t0 = time.monotonic()
     r = ana.revalidate(path, params, lview, backend="native",
-                       validate_all=True, max_batch=MAX_BATCH)
+                       validate_all=True, max_batch=MAX_BATCH,
+                       max_headers=native_cap)
     nwall = time.monotonic() - t0
     assert r.error is None, f"bench chain must revalidate clean: {r.error!r}"
     assert r.n_valid == r.n_blocks > 0
     baseline = r.n_valid / nwall
-    print(f"# native baseline {baseline:.0f} headers/s ({nwall:.1f}s)",
+    cap_note = (
+        f" (rate over a {r.n_valid}-header prefix)" if native_cap else ""
+    )
+    print(f"# native baseline {baseline:.0f} headers/s ({nwall:.1f}s){cap_note}",
           file=sys.stderr)
 
     if probe_device():
@@ -271,6 +309,8 @@ def main() -> None:
                 f"{device['n']}-header synthetic Praos chain (disk->parse->"
                 "stage->Pallas Ed25519+KES+VRF+leader kernels->nonce fold), "
                 "TPU vs measured single-core C++ (libsodium-class) replay"
+                + (f"; native rate measured over a {r.n_valid}-header "
+                   "prefix of the same chain" if native_cap else "")
             ),
             "value": round(rate, 1),
             "unit": "headers/s",

@@ -211,4 +211,36 @@ int ocx_extract_headers(
     return 0;
 }
 
+// Batched CRC-32 (ISO-HDLC, the zlib.crc32 polynomial) over n spans of
+// buf. Returns the 0-based index of the first span whose CRC differs
+// from expected[], or -1 when all match. This is the ImmutableDB deep
+// validation hot loop (validate_all at open): per-span Python
+// zlib.crc32 calls cost ~25 us of interpreter overhead each, ~2.5 s on
+// a 100k-block chain — one native walk is ~50 ms.
+static uint32_t crc_table[256];
+static bool crc_init_done = [] {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc_table[i] = c;
+    }
+    return true;
+}();
+
+int64_t ocx_crc32_first_bad(const uint8_t* buf, size_t len,
+                            const int64_t* offsets, const int64_t* sizes,
+                            const int64_t* expected, int64_t n) {
+    for (int64_t i = 0; i < n; i++) {
+        int64_t off = offsets[i], sz = sizes[i];
+        if (off < 0 || sz < 0 || (uint64_t)(off + sz) > len) return i;
+        uint32_t c = 0xFFFFFFFFu;
+        const uint8_t* p = buf + off;
+        for (int64_t j = 0; j < sz; j++)
+            c = crc_table[(c ^ p[j]) & 0xFF] ^ (c >> 8);
+        if ((c ^ 0xFFFFFFFFu) != (uint32_t)expected[i]) return i;
+    }
+    return -1;
+}
+
 }  // extern "C"

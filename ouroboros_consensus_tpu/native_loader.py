@@ -54,8 +54,32 @@ def load():
         ctypes.c_void_p, ctypes.c_int,  # offsets, n
         *([ctypes.c_void_p] * 21),
     ]
+    lib.ocx_crc32_first_bad.restype = ctypes.c_int64
+    lib.ocx_crc32_first_bad.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+    ]
     _lib = lib
     return _lib
+
+
+def crc32_first_bad(buf: bytes, offsets, sizes, expected) -> int | None:
+    """0-based index of the first span whose zlib.crc32 mismatches
+    `expected`, -1 if all match; None when the library is unavailable
+    (callers fall back to the per-span Python loop)."""
+    lib = load()
+    if lib is None:
+        return None
+    offs = np.ascontiguousarray(offsets, np.int64)
+    szs = np.ascontiguousarray(sizes, np.int64)
+    exp = np.ascontiguousarray(expected, np.int64)
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    return int(
+        lib.ocx_crc32_first_bad(buf, len(buf), ptr(offs), ptr(szs), ptr(exp), len(offs))
+    )
 
 
 def scan_items(buf: bytes, max_items: int = 1 << 20):
@@ -239,6 +263,15 @@ def native_validate_praos(
     return int(rc), int(kind.value), lv, eta
 
 
+class MalformedBlock(ValueError):
+    """extract_headers hit an unparseable block; `.index` is its
+    position in the offsets array (blocks before it parsed clean)."""
+
+    def __init__(self, index: int):
+        super().__init__(f"malformed block at index {index}")
+        self.index = index
+
+
 @dataclass
 class HeaderColumns:
     """SoA header columns straight from chunk bytes — the zero-object
@@ -304,7 +337,7 @@ def extract_headers(buf: bytes, offsets: np.ndarray) -> HeaderColumns | None:
         ptr(kes_off), ptr(kes_len), ptr(sgn_off), ptr(sgn_len),
     )
     if rc != 0:
-        raise ValueError(f"malformed block at index {rc - 1}")
+        raise MalformedBlock(rc - 1)
     return HeaderColumns(
         n=n,
         ocert_sigma=[buf[sig_off[i] : sig_off[i] + sig_len[i]] for i in range(n)],

@@ -44,14 +44,38 @@ def enabled() -> bool:
     return os.environ.get(_ENABLE_ENV, "1") != "0"
 
 
+_SRC_DIGEST: str | None = None
+
+
+def _src_digest() -> str:
+    """Digest of the kernel source modules. Executables are compiled
+    CODE: a cache entry keyed on shapes alone would silently run stale
+    kernels after an ops/pk change (the persistent jit cache keys on
+    the HLO hash and does not have this hazard)."""
+    global _SRC_DIGEST
+    if _SRC_DIGEST is None:
+        import hashlib
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        h = hashlib.blake2s(digest_size=4)
+        for mod in ("limbs.py", "hashes.py", "curve.py", "verify.py",
+                    "kernels.py"):
+            with open(os.path.join(here, mod), "rb") as f:
+                h.update(f.read())
+        _SRC_DIGEST = h.hexdigest()
+    return _SRC_DIGEST
+
+
 def sig_of(args) -> str:
-    """8-hex-char signature of the argument shapes+dtypes. Executables
-    are shape-exact, and the KES hash-block count varies per batch (it
-    tracks the longest signed header bytes in the batch), so the
-    signature — not just (batch, depth, tile) — keys the cache file."""
+    """8-hex-char signature of the argument shapes+dtypes plus the
+    kernel source digest. Executables are shape-exact, and the KES
+    hash-block count varies per batch (it tracks the longest signed
+    header bytes in the batch), so the signature — not just
+    (batch, depth, tile) — keys the cache file."""
     import hashlib
 
     parts = [f"{tuple(a.shape)}:{a.dtype}" for a in args]
+    parts.append(_src_digest())
     return hashlib.blake2s(
         "|".join(parts).encode(), digest_size=4
     ).hexdigest()

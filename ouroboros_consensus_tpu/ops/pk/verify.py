@@ -90,23 +90,59 @@ def kes_core(vk, period, s, vk_leaf, siblings, hblocks, hnblocks, depth: int):
 
 def elligator2(r):
     """[20, T] field element -> Point (even-x convention, matching
-    ops/host/ecvrf.elligator2)."""
+    ops/host/ecvrf.elligator2).
+
+    Projective formulation: the naive map costs FIVE ~254-squaring
+    exponentiation chains (inv(denom), legendre, sqrt, inv(v),
+    inv(u+1)); this one costs TWO. Write u = U/W over the common
+    denominator W = 1 + 2r² and N(U, W) = U·(U² + A·U·W + W²) (the
+    Montgomery RHS numerator, w = N/W³). Then
+
+      x² = c²·u²/w = c²·U²·W / N      (c = sqrt(-486664))
+
+    and sqrt_ratio(c²U²W, N) yields the even root AND the branch test in
+    one chain: it succeeds iff χ(W·N) = 1 iff w is a square — exactly
+    the host's is_square(w1) branch. One sqrt_ratio per branch, both
+    evaluated (mask lanes), everything else stays projective: the
+    Edwards y rides as (U−W : U+W) and the returned point has Z ≠ 1
+    (every consumer — ladders, cofactor, compress — is projective)."""
     t = r.shape[-1]
     one = fe.ones(t)
-    mont_a = fe.constant(he.MONT_A)
-    denom = fe.add(fe.mul_small(fe.sqr(r), 2), one)
-    denom = fe.select(fe.is_zero(denom), one, denom)
-    u1 = fe.mul(fe.neg(mont_a), fe.inv(denom))
-    w1 = fe.mul(u1, fe.add(fe.mul(fe.add(u1, mont_a), u1), one))
-    is_sq = fe.eq(fe.legendre(w1), one) | fe.is_zero(w1)
-    u2 = fe.sub(fe.neg(u1), mont_a)
-    u = fe.select(is_sq, u1, u2)
-    w = fe.mul(u, fe.add(fe.mul(fe.add(u, mont_a), u), one))
-    _, v = fe.sqrt(w)
-    x = fe.mul(fe.mul(fe.constant(he.SQRT_M486664), u), fe.inv(v))
-    y = fe.mul(fe.sub(u, one), fe.inv(fe.add(u, one)))
-    x = fe.select(fe.parity(x) == 1, fe.neg(x), x)
-    return pc.Point(x, y, one, fe.mul(x, y))
+    zero = fe.zeros(t)
+    A = he.MONT_A % he.P
+    A2 = A * A % he.P
+    c2 = he.SQRT_M486664 * he.SQRT_M486664 % he.P  # = -486664 mod p
+    w_den = fe.add(fe.mul_small(fe.sqr(r), 2), one)
+    W = fe.select(fe.is_zero(w_den), one, w_den)  # host denom=0 guard
+    W2 = fe.sqr(W)
+    # branch 1: U1 = -A (constant numerator)
+    #   N1 = (-A)·(A² - A²·W + W²); num1 = (c²·A²)·W
+    a2w = fe.mul(fe.constant(A2), W)
+    n1 = fe.mul(
+        fe.constant((-A) % he.P),
+        fe.add(fe.sub(fe.constant(A2), a2w), W2),
+    )
+    num1 = fe.mul(fe.constant(c2 * A2 % he.P), W)
+    ok1, x1 = fe.sqrt_ratio(num1, n1)
+    ok1 = ok1 | fe.is_zero(n1)  # w1 = 0 stays on branch 1 (x = 0)
+    # branch 2: U2 = -U1 - A·W = A·(1 - W)
+    u2 = fe.mul(fe.constant(A), fe.sub(one, W))
+    u2_sq = fe.sqr(u2)
+    n2 = fe.mul(
+        u2, fe.add(fe.add(u2_sq, fe.mul(fe.constant(A), fe.mul(u2, W))), W2)
+    )
+    num2 = fe.mul(fe.constant(c2), fe.mul(u2_sq, W))
+    _, x2 = fe.sqrt_ratio(num2, n2)
+    x = fe.select(ok1, x1, x2)
+    u1 = jnp.broadcast_to(fe.constant((-A) % he.P), (fe.NLIMBS, t))
+    un = fe.select(ok1, u1, u2)
+    # y = (u-1)/(u+1) -> (Y : Z) = (U-W : U+W); host pins y=0 at u=-1
+    y_num = fe.sub(un, W)
+    z = fe.add(un, W)
+    z_zero = fe.is_zero(z)
+    y_num = fe.select(z_zero, zero, y_num)
+    z = fe.select(z_zero, one, z)
+    return pc.Point(fe.mul(x, z), y_num, z, fe.mul(x, y_num))
 
 
 def hash_to_curve(pk_bytes, alpha_bytes):

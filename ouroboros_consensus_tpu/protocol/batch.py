@@ -831,6 +831,31 @@ def validate_chain(
     successors are discarded, exactly like queued blocks after a failed
     chain selection in the reference's add-block queue).
     """
+    # one worker thread owns the BLOCKING device reads: the main thread
+    # keeps staging/dispatching while the worker waits, so host staging
+    # hides behind device execution even when the backend only makes
+    # progress under a blocking read (observed through the remote-TPU
+    # tunnel: wall == stage + device with same-thread materialize,
+    # scripts/profile_replay.py r5)
+    pool = None
+    if backend == "device":
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(max_workers=1)
+    try:
+        return _validate_chain_loop(
+            params, ledger_view_for_epoch, state, hvs, max_batch, backend,
+            pipeline_depth, mesh, pool,
+        )
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+
+def _validate_chain_loop(
+    params, ledger_view_for_epoch, state, hvs, max_batch, backend,
+    pipeline_depth, mesh, pool,
+):
     total_valid = 0
     i = 0
     n = len(hvs)
@@ -859,17 +884,19 @@ def validate_chain(
 
         from collections import deque
 
-        inflight: deque = deque()  # (window_start, window_hvs, pre, out, b)
+        inflight: deque = deque()  # (window_start, window_hvs, pre, future)
         w = i
         while w < seg_end or inflight:
             while w < seg_end and len(inflight) < pipeline_depth:
                 j = min(w + max_batch, seg_end)
                 pre, out, b = dispatch_batch(params, lview, eta0, hvs[w:j])
-                inflight.append((w, hvs[w:j], pre, out, b))
+                inflight.append(
+                    (w, hvs[w:j], pre, pool.submit(materialize_verdicts, out, b))
+                )
                 w = j
-            w0, whvs, pre, out, b = inflight.popleft()
+            w0, whvs, pre, fut = inflight.popleft()
             with _enclose("materialize"):
-                v = materialize_verdicts(out, b)
+                v = fut.result()
             ticked = praos.tick(params, lview, whvs[0].slot, state)
             with _enclose("epilogue"):
                 res = _epilogue(params, ticked, whvs, pre, v)

@@ -78,10 +78,13 @@ class ImmutableDB:
         validate_all: bool = False,
         fs=None,  # HasFS seam (utils/fs.py); None = the real filesystem
         decode_block=None,  # block codec for index rebuilds; None = Praos
+        check_integrity_batch=None,  # chunk-wide twin of check_integrity:
+        # (data, entries) -> count of good leading entries | None
     ):
         self.path = path
         self.chunk_size = chunk_size
         self._decode_block = decode_block
+        self._check_integrity_batch = check_integrity_batch
         self.fs = fs if fs is not None else REAL_FS
         self.fs.makedirs(path)
         self._entries: dict[int, list[IndexEntry]] = {}  # chunk -> entries
@@ -152,20 +155,63 @@ class ImmutableDB:
                 data = self.fs.read_bytes(cpath)
             except OSError:
                 return None
-            good = []
-            for e in entries:
-                blob = data[e.offset : e.offset + e.size]
-                if len(blob) != e.size or zlib.crc32(blob) != e.crc32:
+            first_bad = self._deep_check_fast(data, entries, check_integrity)
+            if first_bad is not None:
+                if first_bad < len(entries):
                     self._truncated[n] = True
-                    break
-                if check_integrity is not None and not check_integrity(blob):
-                    self._truncated[n] = True
-                    break
-                good.append(e)
-            entries = good
+                entries = entries[:first_bad]
+            else:
+                # no native library (or a custom per-block hook without a
+                # batched twin): the per-blob reference loop
+                good = []
+                for e in entries:
+                    blob = data[e.offset : e.offset + e.size]
+                    if len(blob) != e.size or zlib.crc32(blob) != e.crc32:
+                        self._truncated[n] = True
+                        break
+                    if check_integrity is not None and not check_integrity(blob):
+                        self._truncated[n] = True
+                        break
+                    good.append(e)
+                entries = good
             if self._truncated.get(n):
                 self._rewrite_chunk(n, data, entries)
         return entries
+
+    def _deep_check_fast(self, data, entries, check_integrity):
+        """Vectorized deep validation: ONE native CRC walk over every
+        indexed span, then the chunk-wide integrity hook (if any). The
+        per-blob Python loop costs ~25 us/block of interpreter overhead
+        plus ~80 us/block for the decode-based integrity hook — the
+        startup-validation bottleneck on large chains (VERDICT r4 item
+        3 profiling). Returns the count of good leading entries, or
+        None when the fast path does not apply (caller falls back)."""
+        if not entries:
+            return None
+        batch_hook = self._check_integrity_batch
+        if check_integrity is not None and batch_hook is None:
+            return None  # custom hook, no batched twin
+        from .. import native_loader
+
+        rc = native_loader.crc32_first_bad(
+            data,
+            [e.offset for e in entries],
+            [e.size for e in entries],
+            [e.crc32 for e in entries],
+        )
+        if rc is None:
+            return None  # no native library
+        good = len(entries) if rc < 0 else rc
+        if check_integrity is None or good == 0:
+            return good
+        # the integrity hook must still vet every entry BEFORE the first
+        # CRC-bad one: a written-corrupt block (consistent CRC, wrong
+        # body hash) earlier in the chunk truncates earlier — order
+        # matches the per-blob reference loop
+        fb = batch_hook(data, entries[:good])
+        if fb is None:
+            return None  # hook unavailable -> slow loop
+        return min(good, fb)
 
     def _reparse_chunk(self, n: int, check_integrity):
         """Walk self-delimiting CBOR blocks in the chunk file, rebuilding

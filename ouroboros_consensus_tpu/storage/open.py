@@ -31,6 +31,48 @@ def default_check_integrity(raw: bytes) -> bool:
         return False
 
 
+def default_check_integrity_batch(data, entries):
+    """Chunk-wide twin of default_check_integrity: native columnar
+    header parse + blake2b over each block's WIRE txs span (the codec
+    writes canonical CBOR, so the span IS cbor.encode(txs); a mismatch
+    is arbitrated by the per-block Python check so a non-canonical but
+    internally consistent block is not wrongly truncated). Returns the
+    index of the first bad block, len(entries) if all pass, or None
+    when the native scanner is unavailable (caller falls back to the
+    per-block loop). The per-block Python hook costs ~80 us/block of
+    decode; this path is ~2 us/block."""
+    import hashlib
+
+    import numpy as np
+
+    from .. import native_loader
+
+    if native_loader.load() is None:
+        return None
+    offsets = np.asarray([e.offset for e in entries], np.int64)
+    limit = len(entries)
+    try:
+        cols = native_loader.extract_headers(data, offsets)
+    except native_loader.MalformedBlock as exc:
+        # blocks before the malformed one parsed clean, but they must
+        # STILL pass the body-hash check — a written-corrupt block
+        # earlier in the chunk truncates earlier (per-blob loop order)
+        limit = exc.index
+        if limit == 0:
+            return 0
+        cols = native_loader.extract_headers(data, offsets[:limit])
+    for i in range(limit):
+        e = entries[i]
+        span = data[int(cols.header_end[i]) : e.offset + e.size]
+        if (
+            hashlib.blake2b(span, digest_size=32).digest()
+            != cols.body_hash[i].tobytes()
+        ):
+            if not default_check_integrity(data[e.offset : e.offset + e.size]):
+                return i
+    return limit
+
+
 def open_chaindb(
     path: str,
     ext: ExtLedger,
@@ -45,8 +87,12 @@ def open_chaindb(
     check_integrity=None,  # per-block-type integrity hook
     tracer=None,  # typed ChainDB event tracer (utils.trace algebra)
 ) -> ChainDB:
+    check_integrity_batch = None
     if check_integrity is None and validate_all:
         check_integrity = default_check_integrity
+        if decode_block is None:
+            # the batched twin only parses the default Praos layout
+            check_integrity_batch = default_check_integrity_batch
     imm = ImmutableDB(
         os.path.join(path, "immutable"),
         chunk_size=chunk_size,
@@ -54,6 +100,7 @@ def open_chaindb(
         validate_all=validate_all,
         fs=fs,
         decode_block=decode_block,
+        check_integrity_batch=check_integrity_batch if validate_all else None,
     )
     vol = VolatileDB(
         os.path.join(path, "volatile"), fs=fs, decode_block=decode_block

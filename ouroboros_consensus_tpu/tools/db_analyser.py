@@ -75,10 +75,15 @@ class SlotDataPoint:
 def open_immutable(db_path: str, validate_all: bool = False) -> ImmutableDB:
     import os
 
+    from ..storage.open import default_check_integrity_batch
+
     return ImmutableDB(
         os.path.join(db_path, "immutable"),
         check_integrity=default_check_integrity if validate_all else None,
         validate_all=validate_all,
+        check_integrity_batch=(
+            default_check_integrity_batch if validate_all else None
+        ),
     )
 
 
@@ -181,6 +186,9 @@ def revalidate(
     backend: str = "device",
     validate_all: bool = True,
     max_batch: int = 8192,
+    max_headers: int | None = None,  # replay only the first N headers
+    # (bench.py measures the native baseline RATE on a prefix of the 1M
+    # chain so the wall budget converts into device measurement)
     trace=lambda s: None,
     ledger=None,  # LEDGER-DERIVED epoch views: replay blocks through
     genesis_state=None,  # this ledger and take the per-epoch pool
@@ -203,6 +211,13 @@ def revalidate(
     res = ValidationResult()
     t0 = time.monotonic()
     imm = open_immutable(db_path, validate_all=validate_all)
+
+    def stream_views(imm, res):
+        if max_headers is None:
+            return _stream_views(imm, res)
+        import itertools
+
+        return itertools.islice(_stream_views(imm, res), max_headers)
 
     st = PraosState()
     if ledger is not None and getattr(ledger, "view_for_epoch", None):
@@ -231,7 +246,12 @@ def revalidate(
             return result, lst
 
         decode = Block.from_bytes
-        for entry, raw in imm.stream_all():
+        block_stream = imm.stream_all()
+        if max_headers is not None:
+            import itertools
+
+            block_stream = itertools.islice(block_stream, max_headers)
+        for entry, raw in block_stream:
             res.n_blocks += 1
             b = decode(raw)
             e = params.epoch_of(b.slot)
@@ -257,7 +277,7 @@ def revalidate(
         return res
     if backend == "host":
         try:
-            for hv in _stream_views(imm, res):
+            for hv in stream_views(imm, res):
                 ticked = praos.tick(params, lview, hv.slot, st)
                 st = praos.update(params, hv, hv.slot, ticked)
                 res.n_valid += 1
@@ -267,7 +287,7 @@ def revalidate(
         # one epoch segment buffered at a time (bounded memory on real
         # chains); validate_chain pipelines staging against device
         # execution within each segment
-        for seg in _epoch_segments(params, _stream_views(imm, res)):
+        for seg in _epoch_segments(params, stream_views(imm, res)):
             ts = time.monotonic()
             result = pbatch.validate_chain(
                 params, lambda _e: lview, st, seg,
@@ -283,6 +303,10 @@ def revalidate(
     else:
         raise ValueError(f"unknown backend {backend!r}")
 
+    if max_headers is not None:
+        # the native columnar stream counts whole chunks into n_blocks;
+        # the cap consumes only the first max_headers of the last one
+        res.n_blocks = min(res.n_blocks, max_headers)
     res.final_state = st
     res.wall_s = time.monotonic() - t0
     return res

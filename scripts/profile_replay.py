@@ -1,0 +1,96 @@
+"""Profiled end-to-end device replay: where does the wall time go?
+
+Runs the SAME replay as bench.py's device child but with the
+protocol/batch Enclose brackets (stage / dispatch / materialize /
+epilogue) collected, plus disk-stream and segmentation timings, and
+prints a budget table. This is the round-5 item-3 instrument: the gap
+between the composed kernel rate (~11.6k lanes/s hot) and the
+end-to-end rate (5.3k headers/s, BENCH r5 first run) has to be
+attributed before it can be closed.
+
+Usage:  python scripts/profile_replay.py [n_headers]  (default 100000)
+"""
+
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/ouroboros-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+
+
+def main():
+    os.environ.setdefault("BENCH_HEADERS", str(N))
+    import bench
+    from ouroboros_consensus_tpu.protocol import batch as pbatch
+    from ouroboros_consensus_tpu.tools import db_analyser as ana
+    from ouroboros_consensus_tpu.utils.trace import EncloseEvent
+
+    path, params, lview = bench.build_or_load_chain()
+    dev = jax.devices()[0]
+    print(f"device: {dev} platform={dev.platform}", flush=True)
+
+    tot = defaultdict(float)
+    cnt = defaultdict(int)
+
+    def tracer(ev):
+        if isinstance(ev, EncloseEvent) and ev.edge == "end":
+            tot[ev.label] += ev.duration
+            cnt[ev.label] += 1
+
+    pbatch.set_batch_tracer(tracer)
+
+    # instrument the view stream (disk read + native parse + HeaderView
+    # build) by timing the generator pulls
+    stream_s = 0.0
+    orig_stream = ana._stream_views
+
+    def timed_stream(imm, res):
+        nonlocal stream_s
+        it = orig_stream(imm, res)
+        while True:
+            t0 = time.monotonic()
+            try:
+                hv = next(it)
+            except StopIteration:
+                stream_s += time.monotonic() - t0
+                return
+            stream_s += time.monotonic() - t0
+            yield hv
+
+    for attempt in ("warm", "hot"):
+        tot.clear(); cnt.clear(); stream_s = 0.0
+        ana._stream_views = lambda imm, res: timed_stream(imm, res)
+        t0 = time.monotonic()
+        r = ana.revalidate(
+            path, params, lview, backend="device", validate_all=True,
+            max_batch=bench.MAX_BATCH,
+        )
+        wall = time.monotonic() - t0
+        ana._stream_views = orig_stream
+        assert r.error is None and r.n_valid == r.n_blocks
+        print(f"\n== {attempt}: {r.n_valid} headers in {wall:.2f}s "
+              f"({r.n_valid/wall:.0f} headers/s)", flush=True)
+        accounted = 0.0
+        for label in ("stage", "dispatch", "materialize", "epilogue"):
+            if cnt[label]:
+                print(f"  {label:12s} {tot[label]:8.2f}s  x{cnt[label]:4d} "
+                      f"({tot[label]/wall*100:5.1f}%)")
+                accounted += tot[label]
+        print(f"  {'view-stream':12s} {stream_s:8.2f}s          "
+              f"({stream_s/wall*100:5.1f}%)")
+        other = wall - accounted - stream_s
+        print(f"  {'other':12s} {other:8.2f}s          "
+              f"({other/wall*100:5.1f}%)")
+    pbatch.set_batch_tracer(None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Default-tier composed pk smoke (VERDICT r4 item 6).
+
+The production TPU composition (ops/pk/verify.verify_praos_core — ed +
+kes + vrf + finish in one graph, unrolled hash cores) runs in the
+DEFAULT suite at a pinned tiny shape and is checked lane-for-lane
+against the native C++ verifier, including one corrupted lane per
+verifier leg. Everything bigger (full depth, tile 128, the Pallas
+kernel wrappers) stays in the OCT_SLOW tier / on-hardware scripts.
+
+Subprocess: OCT_PK_HASH_IMPL=unrolled must be set before the ops
+modules are imported (the TPU code path — the XLA hash modules'
+constant arrays cannot be captured by Pallas, see PERF.md), and this
+process has long since imported them.
+
+Budget: the composed graph compiles in minutes on a COLD XLA:CPU cache,
+seconds on a warm one (the persistent cache at /tmp/ouroboros-jax-cache
+is shared with conftest and survives across runs on this box).
+"""
+
+import os
+import subprocess
+import sys
+
+
+def test_composed_pk_smoke_vs_native():
+    child = os.path.join(os.path.dirname(__file__), "pk_smoke_child.py")
+    proc = subprocess.run(
+        [sys.executable, child],
+        capture_output=True, text=True, timeout=1500,
+    )
+    assert proc.returncode == 0, (
+        f"composed pk smoke failed:\n{proc.stdout}\n{proc.stderr[-2000:]}"
+    )
+    assert "composed pk smoke OK" in proc.stdout

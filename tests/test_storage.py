@@ -563,3 +563,91 @@ def test_chaindb_time_based_snapshots_on_sim_clock(tmp_path):
         db.add_block(b)
     after = LedgerDB.list_snapshots(snap_dir)
     assert after != first
+
+
+def _fix_index_crc(dirpath, chunk_name, index_name, entry_ix):
+    """Recompute the stored CRC of entry `entry_ix` from the (corrupted)
+    chunk bytes, so the CRC walk passes and only deeper checks can
+    catch the corruption."""
+    import zlib
+
+    from ouroboros_consensus_tpu.utils import cbor
+
+    idata = (dirpath / index_name).read_bytes()
+    rows, off = [], 0
+    while off < len(idata):
+        obj, off = cbor.decode_prefix(idata, off)
+        rows.append(list(obj))
+    data = (dirpath / chunk_name).read_bytes()
+    e_off, e_size = rows[entry_ix][3], rows[entry_ix][4]
+    rows[entry_ix][5] = zlib.crc32(data[e_off : e_off + e_size])
+    (dirpath / index_name).write_bytes(
+        b"".join(cbor.encode(r) for r in rows)
+    )
+    return e_off, e_size
+
+
+def test_integrity_bad_before_crc_bad_truncates_earlier(tmp_path):
+    """Deep validation order (round-5 review finding): a written-corrupt
+    block (CRC consistent, body hash wrong) EARLIER in the chunk must
+    truncate before a bit-rotted (CRC-bad) block later — the fast
+    native path must match the per-blob reference loop."""
+    from ouroboros_consensus_tpu.storage.open import (
+        default_check_integrity, default_check_integrity_batch,
+    )
+
+    db = ImmutableDB(str(tmp_path / "imm"), chunk_size=100)
+    blocks = forge_chain(8)
+    for b in blocks:
+        db.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+    chunk = tmp_path / "imm" / "00000.chunk"
+    data = bytearray(chunk.read_bytes())
+    # block 2: flip a byte of the DECLARED body hash, keep CRC consistent
+    e2 = db._entries[0][2]
+    span = bytes(data[e2.offset : e2.offset + e2.size])
+    bh = blocks[2].header.body.body_hash
+    ix = span.index(bh)
+    data[e2.offset + ix] ^= 0xFF
+    # block 5: plain bit-rot (CRC now mismatches)
+    e5 = db._entries[0][5]
+    data[e5.offset + e5.size - 2] ^= 0xFF
+    chunk.write_bytes(bytes(data))
+    _fix_index_crc(tmp_path / "imm", "00000.chunk", "00000.index", 2)
+
+    db2 = ImmutableDB(
+        str(tmp_path / "imm"), chunk_size=100,
+        check_integrity=default_check_integrity, validate_all=True,
+        check_integrity_batch=default_check_integrity_batch,
+    )
+    assert db2.n_blocks() == 2  # truncated at the WRITTEN-corrupt block
+
+
+def test_body_hash_bad_before_malformed_truncates_earlier(tmp_path):
+    """Companion ordering case: body-hash corruption at block 1, an
+    unparseable block at 4 — truncation lands on block 1."""
+    from ouroboros_consensus_tpu.storage.open import (
+        default_check_integrity, default_check_integrity_batch,
+    )
+
+    db = ImmutableDB(str(tmp_path / "imm"), chunk_size=100)
+    blocks = forge_chain(6)
+    for b in blocks:
+        db.append_block(b.slot, b.block_no, b.hash_, b.bytes_)
+    chunk = tmp_path / "imm" / "00000.chunk"
+    data = bytearray(chunk.read_bytes())
+    e1 = db._entries[0][1]
+    span = bytes(data[e1.offset : e1.offset + e1.size])
+    ix = span.index(blocks[1].header.body.body_hash)
+    data[e1.offset + ix] ^= 0xFF
+    e4 = db._entries[0][4]
+    data[e4.offset] = 0xFF  # no longer a CBOR array head: unparseable
+    chunk.write_bytes(bytes(data))
+    _fix_index_crc(tmp_path / "imm", "00000.chunk", "00000.index", 1)
+    _fix_index_crc(tmp_path / "imm", "00000.chunk", "00000.index", 4)
+
+    db2 = ImmutableDB(
+        str(tmp_path / "imm"), chunk_size=100,
+        check_integrity=default_check_integrity, validate_all=True,
+        check_integrity_batch=default_check_integrity_batch,
+    )
+    assert db2.n_blocks() == 1
