@@ -88,24 +88,52 @@ def kes_core(vk, period, s, vk_leaf, siblings, hblocks, hnblocks, depth: int):
 # ---------------------------------------------------------------------------
 
 
+def _sqrt_of(x: int) -> int:
+    """Host-side sqrt mod p (p = 5 mod 8 Shanks); x must be a QR."""
+    p = he.P
+    s = pow(x % p, (p + 3) // 8, p)
+    if (s * s - x) % p != 0:
+        s = s * pow(2, (p - 1) // 4, p) % p  # multiply by sqrt(-1)
+    assert (s * s - x) % p == 0
+    return s
+
+
+# chi(2) = chi(i) = -1 for p = 2^255-19, so both 2i and -2i are QRs;
+# these are the branch-2 fixup constants of the single-chain Elligator2
+_SQRT_2I = _sqrt_of(2 * fe.SQRT_M1_INT)
+_SQRT_M2I = _sqrt_of(-2 * fe.SQRT_M1_INT)
+
+
 def elligator2(r):
     """[20, T] field element -> Point (even-x convention, matching
     ops/host/ecvrf.elligator2).
 
-    Projective formulation: the naive map costs FIVE ~254-squaring
-    exponentiation chains (inv(denom), legendre, sqrt, inv(v),
-    inv(u+1)); this one costs TWO. Write u = U/W over the common
-    denominator W = 1 + 2r² and N(U, W) = U·(U² + A·U·W + W²) (the
-    Montgomery RHS numerator, w = N/W³). Then
+    Projective single-chain formulation: the naive map costs FIVE
+    ~254-squaring exponentiation chains (inv(denom), legendre, sqrt,
+    inv(v), inv(u+1)); this one costs ONE. Write u = U/W over the
+    common denominator W = 1 + 2r² and N(U, W) = U·(U² + A·U·W + W²)
+    (the Montgomery RHS numerator, w = N/W³). Then
 
       x² = c²·u²/w = c²·U²·W / N      (c = sqrt(-486664))
 
-    and sqrt_ratio(c²U²W, N) yields the even root AND the branch test in
-    one chain: it succeeds iff χ(W·N) = 1 iff w is a square — exactly
-    the host's is_square(w1) branch. One sqrt_ratio per branch, both
-    evaluated (mask lanes), everything else stays projective: the
-    Edwards y rides as (U−W : U+W) and the returned point has Z ≠ 1
-    (every consumer — ladders, cofactor, compress — is projective)."""
+    and ONE Shanks exponentiation for branch 1 decides everything. Let
+    ρ = num·n³·(num·n⁷)^((p-5)/8) (the sqrt_ratio candidate for
+    num = c²A²W, n = N1): n·ρ² ∈ {±num, ±i·num}, and which of the four
+    identifies both the branch (χ(W·N1) = 1 ⟺ w1 square — the host's
+    is_square test) and the root:
+
+      n·ρ² = +num   → branch 1, x = ρ
+      n·ρ² = -num   → branch 1, x = i·ρ
+      n·ρ² = ±i·num → branch 2; u2 = 2r²·u1 and Q(u2) = Q(u1) (with
+                      Q(u) = u²+Au+1, since u2 = -u1-A), so
+                      w2 = (u2/u1)·w1 = 2r²·w1 and
+                      x2² = c²u2²/w2 = 2r²·x1²:
+                        n·ρ² = +i·num → x1² = -i·ρ² → x = r·ρ·sqrt(-2i)
+                        n·ρ² = -i·num → x1² = +i·ρ² → x = r·ρ·sqrt(2i)
+
+    Everything stays projective: the Edwards y rides as (U−W : U+W) and
+    the returned point has Z ≠ 1 (every consumer — ladders, cofactor,
+    compress — is projective)."""
     t = r.shape[-1]
     one = fe.ones(t)
     zero = fe.zeros(t)
@@ -123,18 +151,26 @@ def elligator2(r):
         fe.add(fe.sub(fe.constant(A2), a2w), W2),
     )
     num1 = fe.mul(fe.constant(c2 * A2 % he.P), W)
-    ok1, x1 = fe.sqrt_ratio(num1, n1)
-    ok1 = ok1 | fe.is_zero(n1)  # w1 = 0 stays on branch 1 (x = 0)
-    # branch 2: U2 = -U1 - A·W = A·(1 - W)
-    u2 = fe.mul(fe.constant(A), fe.sub(one, W))
-    u2_sq = fe.sqr(u2)
-    n2 = fe.mul(
-        u2, fe.add(fe.add(u2_sq, fe.mul(fe.constant(A), fe.mul(u2, W))), W2)
+    # ONE exponentiation chain: the sqrt_ratio candidate and its check
+    d2 = fe.sqr(n1)
+    d3 = fe.mul(n1, d2)
+    d7 = fe.mul(d3, fe.sqr(d2))
+    rho = fe.mul(fe.mul(num1, d3), fe.pow22523(fe.mul(num1, d7)))
+    chk = fe.mul(n1, fe.sqr(rho))
+    i_num = fe.mul(fe.constant(fe.SQRT_M1_INT), num1)
+    good = fe.eq(chk, num1)
+    good_alt = fe.eq(chk, fe.neg(num1))
+    is_pi = fe.eq(chk, i_num)  # n·ρ² = +i·num
+    ok1 = good | good_alt | fe.is_zero(n1)  # w1 = 0 stays on branch 1
+    x1 = fe.select(good, rho, fe.mul(rho, fe.constant(fe.SQRT_M1_INT)))
+    x2 = fe.mul(
+        fe.mul(r, rho),
+        fe.select(is_pi, fe.constant(_SQRT_M2I), fe.constant(_SQRT_2I)),
     )
-    num2 = fe.mul(fe.constant(c2), fe.mul(u2_sq, W))
-    _, x2 = fe.sqrt_ratio(num2, n2)
     x = fe.select(ok1, x1, x2)
+    x = fe.select(fe.parity(x) == 1, fe.neg(x), x)
     u1 = jnp.broadcast_to(fe.constant((-A) % he.P), (fe.NLIMBS, t))
+    u2 = fe.mul(fe.constant(A), fe.sub(one, W))  # U2 = -U1 - A·W
     un = fe.select(ok1, u1, u2)
     # y = (u-1)/(u+1) -> (Y : Z) = (U-W : U+W); host pins y=0 at u=-1
     y_num = fe.sub(un, W)

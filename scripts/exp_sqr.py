@@ -1,0 +1,174 @@
+"""A/B experiment: dedicated squaring + suffix accumulation for fe.mul.
+
+Candidate formulations over the [20, T] 13-bit-limb representation:
+
+  mul_suffix — pad-accumulate mul, but each term is added only into
+    acc[i:] (rows < i are already final): total add rows drop from
+    19x41=779 to sum(41-i)=589 (-24%).
+  sqr_sym — symmetric squaring: row i contributes (a_i^2, 2a_{i+1}a_i,
+    ..., 2a_19a_i) at offset 2i — 210 limb products instead of 400, and
+    suffix accumulation from row 2i: add rows 399 (-49%). Column sums
+    are IDENTICAL to mul(a,a)'s, so the bound analysis and carry
+    structure are unchanged.
+
+Correctness: differential vs fe.mul on random + edge inputs (CPU).
+Timing: standalone pallas kernels looping K ops (run on TPU).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+from jax import numpy as jnp
+
+from ouroboros_consensus_tpu.ops.pk import limbs as fe
+from ouroboros_consensus_tpu.ops import bigint as bi
+
+NLIMBS, BITS, MASK, FOLD = fe.NLIMBS, fe.BITS, fe.MASK, fe.FOLD
+
+
+def _finish_acc(acc, t):
+    """Shared tail of the pad-accumulate mul: 2 carry passes over 41
+    rows, fold, weak reduce (copied contract from fe.mul)."""
+    for _ in range(2):
+        c = acc >> BITS
+        acc = (acc & MASK) + jnp.concatenate(
+            [jnp.zeros((1, t), jnp.int32), c[:-1]], axis=0
+        )
+    lo, hi, top = acc[:NLIMBS], acc[NLIMBS: 2 * NLIMBS], acc[2 * NLIMBS:]
+    lo = lo + hi * FOLD
+    row0 = lo[:1] + top * (FOLD * FOLD)
+    lo = jnp.concatenate([row0, lo[1:]], axis=0)
+    return fe.weak_reduce(lo, passes=2)
+
+
+def mul_suffix(a, b):
+    t = max(a.shape[-1], b.shape[-1])
+    acc = jnp.broadcast_to(a * b[0:1], (NLIMBS, t))
+    acc = jnp.concatenate([acc, jnp.zeros((21, t), jnp.int32)], axis=0)
+    for i in range(1, NLIMBS):
+        term = a * b[i: i + 1]  # [20, T] at offset i
+        pad = 41 - i - NLIMBS
+        suff = acc[i:] + jnp.concatenate(
+            [term, jnp.zeros((pad, t), jnp.int32)], axis=0
+        )
+        acc = jnp.concatenate([acc[:i], suff], axis=0)
+    return _finish_acc(acc, t)
+
+
+def sqr_sym(a):
+    t = a.shape[-1]
+    a2 = a + a  # < 2^15, products still < 2*B_MAX^2 per term
+    acc = None
+    for i in range(NLIMBS):
+        rows = (a[i: i + 1] if i + 1 >= NLIMBS else
+                jnp.concatenate([a[i: i + 1], a2[i + 1:]], axis=0))
+        term = rows * a[i: i + 1]  # [20-i, T] at offset 2*i
+        if acc is None:
+            acc = jnp.concatenate(
+                [term, jnp.zeros((21, t), jnp.int32)], axis=0
+            )
+            continue
+        pad = 41 - 2 * i - (NLIMBS - i)
+        parts = [term]
+        if pad:
+            parts.append(jnp.zeros((pad, t), jnp.int32))
+        suff = acc[2 * i:] + (
+            parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+        )
+        acc = jnp.concatenate([acc[: 2 * i], suff], axis=0)
+    return _finish_acc(acc, t)
+
+
+def _to_int(col):
+    return bi.limbs_to_int_np(np.asarray(col))
+
+
+def check():
+    rng = np.random.default_rng(7)
+    P = fe.P_INT
+    vals = [0, 1, 2, P - 1, P - 19, (1 << 255) - 20]
+    vals += [int.from_bytes(rng.bytes(32), "little") % P for _ in range(30)]
+    cols_a, cols_b = [], []
+    for i, v in enumerate(vals):
+        cols_a.append(fe.int_to_limbs_np(v) if hasattr(fe, "int_to_limbs_np")
+                      else None)
+    # build [20, T] arrays via the field helpers
+    from ouroboros_consensus_tpu.ops import field as f
+
+    a = np.stack([f.int_to_limbs_np(v) for v in vals], axis=-1)
+    b = np.stack(
+        [f.int_to_limbs_np(int.from_bytes(rng.bytes(32), "little") % P)
+         for _ in vals], axis=-1)
+    a, b = jnp.asarray(a), jnp.asarray(b)
+
+    ref_mul = fe.mul(a, b)
+    got_mul = mul_suffix(a, b)
+    ref_sqr = fe.mul(a, a)
+    got_sqr = sqr_sym(a)
+    for i, v in enumerate(vals):
+        bm = _to_int(np.asarray(b)[:, i])
+        assert _to_int(np.asarray(got_mul)[:, i]) % P == (v * bm) % P, i
+        assert _to_int(np.asarray(got_sqr)[:, i]) % P == (v * v) % P, i
+        assert (_to_int(np.asarray(ref_mul)[:, i]) - _to_int(np.asarray(got_mul)[:, i])) % P == 0
+    print(f"correctness OK over {len(vals)} lanes")
+
+
+def bench_device():
+    import functools
+
+    import jax
+    from jax.experimental import pallas as pl
+
+    from jax import lax
+
+    T, K, CHAINS = 128, 400, 4  # 4 independent chains: the real ladders'
+    # ILP shape (4 point coords in flight); fori_loop keeps module small
+
+    def run(name, op, binary):
+        def kern(x_ref, o_ref):
+            vs = [x_ref[:] + i for i in range(CHAINS)]
+
+            def body(_, ws):
+                if binary:
+                    return tuple(op(w, v) for w, v in zip(ws, vs))
+                return tuple(op(w) for w in ws)
+
+            ws = lax.fori_loop(0, K, body, tuple(vs))
+            acc = ws[0]
+            for w in ws[1:]:
+                acc = acc + w
+            o_ref[:] = acc
+
+        f_ = pl.pallas_call(
+            kern, out_shape=jax.ShapeDtypeStruct((NLIMBS, T), jnp.int32),
+        )
+        x = jnp.asarray(
+            np.random.default_rng(1).integers(0, MASK, (NLIMBS, T), np.int32)
+        )
+        jf = jax.jit(f_)
+        t0 = time.time(); r = jax.block_until_ready(jf(x))
+        print(f"{name}: compile+1 {time.time()-t0:.2f}s", flush=True)
+        best = None
+        for _ in range(5):
+            t0 = time.time()
+            jax.block_until_ready(jf(x))
+            wall = time.time() - t0
+            best = wall if best is None or wall < best else best
+        nops = K * CHAINS
+        print(f"{name}: best {best*1e3:9.2f}ms for {nops} ops "
+              f"({best/nops*1e9:7.1f} ns/op)", flush=True)
+
+    run("mul_cur", fe.mul, True)
+    run("mul_suffix", mul_suffix, True)
+    run("sqr_cur", lambda x: fe.mul(x, x), False)
+    run("sqr_sym", sqr_sym, False)
+
+
+if __name__ == "__main__":
+    check()
+    if "--bench" in sys.argv:
+        bench_device()

@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-5 measurement pipeline. Fresh workspace + live tunnel: rebuild
+# the deviceless artifacts first (native .so, bench chain, AOT
+# executables), then spend the tunnel in strict value-per-minute order:
+# the never-measured vrf/finish stage timings, the 100k end-to-end
+# number, the 1M north-star number, the config suite, and on-device
+# compile attribution LAST (historically the tunnel-wedging step).
+# Everything is serialized: the box has 1 core and host-side pipeline
+# rates are part of the measurement.
+set -u
+cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR=/tmp/ouroboros-jax-cache
+LOGDIR=scripts/tpu_session_logs
+mkdir -p "$LOGDIR"
+
+stage() {  # stage <name> <timeout-s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "== $name (budget ${tmo}s) $(date -u +%H:%M:%S)"
+  timeout "$tmo" env "${STAGE_ENV[@]:-IGNORE=1}" "$@" > "$LOGDIR/$name.log" 2>&1
+  echo "   rc=$? $(tail -1 "$LOGDIR/$name.log" | cut -c1-140)"
+}
+STAGE_ENV=(IGNORE=1)
+
+stage native_build 600 python -c "from ouroboros_consensus_tpu import native_loader as nl; print('scan', nl.load() is not None, 'crypto', nl.load_crypto() is not None)"
+
+# Deviceless: synthesizes the 100k chain (~2.5 min) and compiles the
+# five v5e stage executables (~2 min total per the r5 manifest).
+stage aot_precompile 3600 python -u scripts/aot_precompile.py
+
+stage probe 120 python -c "import jax, jax.numpy as jnp; assert jax.devices()[0].platform=='tpu'; print((jnp.ones((8,8))+1).sum())"
+
+# 1. vrf/finish hot timings within minutes of the window opening.
+stage aot_smoke 1800 python -u scripts/aot_smoke.py
+
+# 2. end-to-end device number at 100k (first since round 1).
+stage bench_100k 1500 python -u bench.py
+
+# 3. the 1M north-star chain (~15 min native forging, no tunnel use).
+STAGE_ENV=(BENCH_HEADERS=1000000)
+stage synth_1m 2400 python -u -c "import bench; bench.build_or_load_chain()"
+
+# 4. cover any batch signatures the 1M replay adds (cached ones skip).
+stage aot_precompile_1m 3600 python -u scripts/aot_precompile.py
+
+# 5. the north-star number: 1M-header replay, wide budget.
+STAGE_ENV=(BENCH_TOTAL_BUDGET=2400 BENCH_DEVICE_BUDGET=2000)
+stage bench_1m 2500 python -u bench.py
+STAGE_ENV=(IGNORE=1)
+
+# 6. BASELINE config suite device-side numbers.
+stage bench_suite 3600 python -u scripts/bench_suite.py --scale 0.5
+
+# 7. on-device per-kernel compile attribution — deliberately last.
+stage time_kernels 3500 python -u scripts/time_pk_kernels.py 8192
+
+echo "measure_r5 done $(date -u +%H:%M:%S); logs in $LOGDIR"
