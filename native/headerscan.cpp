@@ -206,7 +206,12 @@ int ocx_extract_headers(
         signed_off[i] = (int64_t)body_start;
         signed_len[i] = (int64_t)(c.off - body_start);
         if (!read_bytes_span(c, &kes_sig_off[i], &kes_sig_len[i])) return i + 1;
-        if (!c.ok) return i + 1;
+        // structurally walk the txs item too: the batched integrity
+        // check hashes the txs SPAN without decoding it, so a block
+        // whose declared body hash covers garbled (non-CBOR) txs bytes
+        // must still be rejected here, matching the per-block decode
+        // path (Block.from_bytes raises). skip_item is O(#cbor items).
+        if (!skip_item(c) || !c.ok) return i + 1;
     }
     return 0;
 }
@@ -233,7 +238,11 @@ int64_t ocx_crc32_first_bad(const uint8_t* buf, size_t len,
                             const int64_t* expected, int64_t n) {
     for (int64_t i = 0; i < n; i++) {
         int64_t off = offsets[i], sz = sizes[i];
-        if (off < 0 || sz < 0 || (uint64_t)(off + sz) > len) return i;
+        // unsigned bounds math: off + sz as int64 is UB for huge values
+        // from a corrupt index; each side-checked add is overflow-free
+        if (off < 0 || sz < 0 || (uint64_t)off > len ||
+            (uint64_t)sz > len - (uint64_t)off)
+            return i;
         uint32_t c = 0xFFFFFFFFu;
         const uint8_t* p = buf + off;
         for (int64_t j = 0; j < sz; j++)

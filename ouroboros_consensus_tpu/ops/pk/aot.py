@@ -57,10 +57,18 @@ def _src_digest() -> str:
         import hashlib
 
         here = os.path.dirname(os.path.abspath(__file__))
+        ops = os.path.dirname(here)
         h = hashlib.blake2s(digest_size=4)
         for mod in ("limbs.py", "hashes.py", "curve.py", "verify.py",
                     "kernels.py"):
             with open(os.path.join(here, mod), "rb") as f:
+                h.update(f.read())
+        # the pk modules build on these: a hash-core or limb-constant
+        # edit there with unchanged shapes must also invalidate the
+        # serialized executables
+        for mod in ("field.py", "curve.py", "sha512.py", "blake2b.py",
+                    "u64.py", os.path.join("host", "ed25519.py")):
+            with open(os.path.join(ops, mod), "rb") as f:
                 h.update(f.read())
         _SRC_DIGEST = h.hexdigest()
     return _SRC_DIGEST

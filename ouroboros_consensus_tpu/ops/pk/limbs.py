@@ -334,17 +334,28 @@ def geq_limbs(a, b):
 # ---------------------------------------------------------------------------
 
 
-def sqrt_ratio(n, d):
-    """(ok[T], r) with r = sqrt(n/d), even-parity root (ops/field twin)."""
+def sqrt_ratio_ext(n, d):
+    """The Shanks candidate for sqrt(n/d) and its full classification:
+    (rho, good, good_alt, is_pi) where d·rho² equals +n (good), -n
+    (good_alt: the root is i·rho), +i·n (is_pi) or -i·n. n/d is a QR
+    iff good|good_alt; the ±i·n cases identify which non-residue class
+    n/d fell in — the single-exponentiation Elligator2 (pk/verify)
+    derives its branch-2 root from them. One ~254-squaring chain total."""
     d2 = sqr(d)
     d3 = mul(d, d2)
     d7 = mul(d3, sqr(d2))
-    r = mul(mul(n, d3), pow22523(mul(n, d7)))
-    check = mul(d, sqr(r))
-    r_alt = mul(r, constant(SQRT_M1_INT))
+    rho = mul(mul(n, d3), pow22523(mul(n, d7)))
+    check = mul(d, sqr(rho))
     good = eq(check, n)
     good_alt = eq(check, neg(n))
-    r = select(good, r, r_alt)
+    is_pi = eq(check, mul(constant(SQRT_M1_INT), n))
+    return rho, good, good_alt, is_pi
+
+
+def sqrt_ratio(n, d):
+    """(ok[T], r) with r = sqrt(n/d), even-parity root (ops/field twin)."""
+    rho, good, good_alt, _ = sqrt_ratio_ext(n, d)
+    r = select(good, rho, mul(rho, constant(SQRT_M1_INT)))
     ok = good | good_alt
     r = select(parity(r) == 1, neg(r), r)
     return ok, r

@@ -151,16 +151,9 @@ def elligator2(r):
         fe.add(fe.sub(fe.constant(A2), a2w), W2),
     )
     num1 = fe.mul(fe.constant(c2 * A2 % he.P), W)
-    # ONE exponentiation chain: the sqrt_ratio candidate and its check
-    d2 = fe.sqr(n1)
-    d3 = fe.mul(n1, d2)
-    d7 = fe.mul(d3, fe.sqr(d2))
-    rho = fe.mul(fe.mul(num1, d3), fe.pow22523(fe.mul(num1, d7)))
-    chk = fe.mul(n1, fe.sqr(rho))
-    i_num = fe.mul(fe.constant(fe.SQRT_M1_INT), num1)
-    good = fe.eq(chk, num1)
-    good_alt = fe.eq(chk, fe.neg(num1))
-    is_pi = fe.eq(chk, i_num)  # n·ρ² = +i·num
+    # ONE exponentiation chain: the sqrt_ratio candidate and its full
+    # classification (limbs.sqrt_ratio_ext — shared with fe.sqrt_ratio)
+    rho, good, good_alt, is_pi = fe.sqrt_ratio_ext(num1, n1)
     ok1 = good | good_alt | fe.is_zero(n1)  # w1 = 0 stays on branch 1
     x1 = fe.select(good, rho, fe.mul(rho, fe.constant(fe.SQRT_M1_INT)))
     x2 = fe.mul(

@@ -439,11 +439,20 @@ def pad_batch_to(batch: PraosBatch, size: int) -> PraosBatch:
 
 
 def bucket_size(b: int, minimum: int = 8) -> int:
-    """Next power-of-two bucket for a batch of b lanes."""
+    """Shape bucket for a batch of b lanes: next power of two up to
+    2048, then next multiple of 2048. Pure powers of two waste up to
+    half the lanes on the epoch-tail batch (a ~21.6k-block epoch slices
+    to 8192+8192+5216, and 5216 padded to 8192 is 36% dead work —
+    ~14% of ALL device lanes at the 1M bench scale); 2048-granularity
+    buckets cap tail padding at <2048 lanes while keeping the set of
+    compiled shapes small (the remainder is epoch-size-distributed, so
+    in practice one extra shape per chain)."""
     n = minimum
-    while n < b:
+    while n < b and n < 2048:
         n *= 2
-    return n
+    if b <= n:
+        return n
+    return ((b + 2047) // 2048) * 2048
 
 
 def _jitted_verify():
@@ -849,7 +858,11 @@ def validate_chain(
         )
     finally:
         if pool is not None:
-            pool.shutdown(wait=False)
+            # cancel_futures: on an early error return the queued
+            # materialize futures belong to DISCARDED windows — without
+            # it the worker keeps issuing blocking device reads for
+            # results nobody wants and the atexit join stalls exit
+            pool.shutdown(wait=False, cancel_futures=True)
 
 
 def _validate_chain_loop(
@@ -859,16 +872,13 @@ def _validate_chain_loop(
     total_valid = 0
     i = 0
     n = len(hvs)
-    while i < n:
-        epoch = params.epoch_of(hvs[i].slot)
-        seg_end = i
-        while seg_end < n and params.epoch_of(hvs[seg_end].slot) == epoch:
-            seg_end += 1
-        lview = ledger_view_for_epoch(epoch)
-        eta0_state = praos.tick(params, lview, hvs[i].slot, state).state
-        eta0 = eta0_state.epoch_nonce
-
-        if backend != "device":
+    if backend != "device":
+        while i < n:
+            epoch = params.epoch_of(hvs[i].slot)
+            seg_end = i
+            while seg_end < n and params.epoch_of(hvs[seg_end].slot) == epoch:
+                seg_end += 1
+            lview = ledger_view_for_epoch(epoch)
             while i < seg_end:
                 j = min(i + max_batch, seg_end)
                 ticked = praos.tick(params, lview, hvs[i].slot, state)
@@ -880,29 +890,117 @@ def _validate_chain_loop(
                 if res.error is not None:
                     return BatchResult(state, total_valid, res.error)
                 i = j
+        return BatchResult(state, total_valid, None)
+
+    # Device backend: ONE pipeline across epoch boundaries. Staging a
+    # window needs only (epoch nonce, ledger view); the next epoch's
+    # nonce is tick's rotation combine(candidate, last_epoch_block_nonce)
+    # (Praos.hs:407-432), whose inputs are final well before the current
+    # epoch drains: candidate_nonce freezes at the stability window
+    # (last update from a header with slot < first_slot(e+1) - 3k/f,
+    # Praos.hs:497) and last_epoch_block_nonce was latched at the
+    # PREVIOUS boundary. So once the fold retires past the freeze slot,
+    # the next epoch's first windows dispatch while this epoch's tail is
+    # still on device — no drain bubble per boundary (~one batch wall
+    # each, ~46 boundaries on the 1M bench chain). The retire-time tick
+    # asserts the staged nonce byte-for-byte.
+    from collections import deque
+
+    segments: list[tuple[int, int, int]] = []
+    while i < n:
+        epoch = params.epoch_of(hvs[i].slot)
+        j = i
+        while j < n and params.epoch_of(hvs[j].slot) == epoch:
+            j += 1
+        segments.append((epoch, i, j))
+        i = j
+
+    lviews: dict[int, object] = {}
+
+    def lview_for(s: int):
+        if s not in lviews:
+            lviews[s] = ledger_view_for_epoch(segments[s][0])
+        return lviews[s]
+
+    eta_known: dict[int, object] = {}
+    if segments:
+        eta_known[0] = praos.tick(
+            params, lview_for(0), hvs[segments[0][1]].slot, state
+        ).state.epoch_nonce
+
+    inflight: deque = deque()  # (seg_idx, window_hvs, pre, future)
+    s_stage = 0  # segment currently being staged
+    w = segments[0][1] if segments else 0
+    retired = 0  # index of the next header to retire
+
+    while retired < n or inflight:
+        while (
+            s_stage < len(segments)
+            and len(inflight) < pipeline_depth
+            and s_stage in eta_known
+        ):
+            _, _, seg_end = segments[s_stage]
+            j = min(w + max_batch, seg_end)
+            pre, out, b = dispatch_batch(
+                params, lview_for(s_stage), eta_known[s_stage], hvs[w:j]
+            )
+            inflight.append(
+                (s_stage, hvs[w:j], pre,
+                 pool.submit(materialize_verdicts, out, b))
+            )
+            w = j
+            if w >= seg_end:
+                s_stage += 1
+                if s_stage < len(segments):
+                    w = segments[s_stage][1]
+
+        if not inflight:
+            # eta for s_stage not derivable before its predecessor fully
+            # retires (no header past the freeze slot) — the retire path
+            # below will publish it; nothing in flight means we can
+            # compute it right now from the fully-folded state
+            eta_known[s_stage] = praos.tick(
+                params, lview_for(s_stage),
+                hvs[segments[s_stage][1]].slot, state,
+            ).state.epoch_nonce
             continue
 
-        from collections import deque
+        s_b, whvs, pre, fut = inflight.popleft()
+        with _enclose("materialize"):
+            v = fut.result()
+        ticked = praos.tick(params, lview_for(s_b), whvs[0].slot, state)
+        if whvs[0] is hvs[segments[s_b][1]]:
+            # first batch of a segment staged with a LOOKAHEAD nonce:
+            # the real rotation must agree (internal invariant)
+            assert ticked.state.epoch_nonce == eta_known[s_b], (
+                "lookahead epoch nonce mismatch"
+            )
+        with _enclose("epilogue"):
+            res = _epilogue(params, ticked, whvs, pre, v)
+        state = res.state
+        total_valid += res.n_valid
+        if res.error is not None:
+            return BatchResult(state, total_valid, res.error)
+        retired += len(whvs)
 
-        inflight: deque = deque()  # (window_start, window_hvs, pre, future)
-        w = i
-        while w < seg_end or inflight:
-            while w < seg_end and len(inflight) < pipeline_depth:
-                j = min(w + max_batch, seg_end)
-                pre, out, b = dispatch_batch(params, lview, eta0, hvs[w:j])
-                inflight.append(
-                    (w, hvs[w:j], pre, pool.submit(materialize_verdicts, out, b))
+        nxt = s_b + 1
+        if nxt < len(segments) and nxt not in eta_known:
+            epoch, _, seg_end = segments[s_b]
+            if retired >= seg_end:
+                eta_known[nxt] = praos.tick(
+                    params, lview_for(nxt), hvs[segments[nxt][1]].slot,
+                    state,
+                ).state.epoch_nonce
+            else:
+                freeze = (
+                    params.first_slot_of(epoch + 1)
+                    - params.stability_window
                 )
-                w = j
-            w0, whvs, pre, fut = inflight.popleft()
-            with _enclose("materialize"):
-                v = fut.result()
-            ticked = praos.tick(params, lview, whvs[0].slot, state)
-            with _enclose("epilogue"):
-                res = _epilogue(params, ticked, whvs, pre, v)
-            state = res.state
-            total_valid += res.n_valid
-            if res.error is not None:
-                return BatchResult(state, total_valid, res.error)
-        i = seg_end
+                if hvs[retired].slot >= freeze:
+                    # candidate is frozen and the LAB component was
+                    # latched a boundary ago: the rotation is decided
+                    eta_known[nxt] = nonces.combine(
+                        state.candidate_nonce,
+                        state.last_epoch_block_nonce,
+                    )
     return BatchResult(state, total_valid, None)

@@ -80,9 +80,13 @@ class ImmutableDB:
         decode_block=None,  # block codec for index rebuilds; None = Praos
         check_integrity_batch=None,  # chunk-wide twin of check_integrity:
         # (data, entries) -> count of good leading entries | None
+        stream_deep: bool = False,  # validate-all checks owed at READ
+        # time: streaming consumers run deep_check_loaded per chunk as
+        # they read (single-pass validation; db-analyser "stream" mode)
     ):
         self.path = path
         self.chunk_size = chunk_size
+        self.stream_deep = stream_deep
         self._decode_block = decode_block
         self._check_integrity_batch = check_integrity_batch
         self.fs = fs if fs is not None else REAL_FS
@@ -178,7 +182,32 @@ class ImmutableDB:
                 self._rewrite_chunk(n, data, entries)
         return entries
 
-    def _deep_check_fast(self, data, entries, check_integrity):
+    def deep_check_loaded(
+        self, data, entries, check_integrity=None, check_integrity_batch=None
+    ) -> int:
+        """validate-all check of one LOADED chunk without disk mutation:
+        count of good leading entries (CRC + integrity, per-blob order).
+        Streaming consumers (db-analyser single-pass validation) call
+        this per chunk as they read, folding the deep-validation walk
+        into the replay's own read — same checks as open-time
+        validate_all, one disk pass instead of two."""
+        fast = self._deep_check_fast(
+            data, entries, check_integrity, check_integrity_batch
+        )
+        if fast is not None:
+            return fast
+        good = 0
+        for e in entries:
+            blob = data[e.offset : e.offset + e.size]
+            if len(blob) != e.size or zlib.crc32(blob) != e.crc32:
+                break
+            if check_integrity is not None and not check_integrity(blob):
+                break
+            good += 1
+        return good
+
+    def _deep_check_fast(self, data, entries, check_integrity,
+                         batch_hook=None):
         """Vectorized deep validation: ONE native CRC walk over every
         indexed span, then the chunk-wide integrity hook (if any). The
         per-blob Python loop costs ~25 us/block of interpreter overhead
@@ -188,7 +217,8 @@ class ImmutableDB:
         None when the fast path does not apply (caller falls back)."""
         if not entries:
             return None
-        batch_hook = self._check_integrity_batch
+        if batch_hook is None:
+            batch_hook = self._check_integrity_batch
         if check_integrity is not None and batch_hook is None:
             return None  # custom hook, no batched twin
         from .. import native_loader
@@ -324,12 +354,28 @@ class ImmutableDB:
             return None
         entries: list[IndexEntry] = []
         off = 0
+        end = 0
         while off < len(data):
             try:
                 obj, off = cbor.decode_prefix(data, off)
-                entries.append(IndexEntry.from_cbor_obj(obj))
+                e = IndexEntry.from_cbor_obj(obj)
+                # sanity: offsets must tile the chunk contiguously with
+                # plausible sizes — a corrupt entry with a huge
+                # offset/size must surface as "index corrupt -> reparse"
+                # (the reference truncates gracefully), not as an int64
+                # overflow crash in the vectorized deep check
+                bad = (
+                    e.offset != end
+                    or e.size <= 0
+                    or e.size > (1 << 40)
+                    or not isinstance(e.crc32, int)
+                )
             except Exception:
                 break
+            if bad:
+                break
+            end = e.offset + e.size
+            entries.append(e)
         return entries
 
     def _write_index(self, n: int, entries: list[IndexEntry]):

@@ -39,6 +39,7 @@ class ValidationResult:
     n_blocks: int = 0
     n_valid: int = 0
     wall_s: float = 0.0
+    open_s: float = 0.0  # ImmutableDB open (index load + validation)
     stage_s: float = 0.0  # host SoA staging time (device backend)
     device_s: float = 0.0  # kernel execution time (device backend)
     error: Exception | None = None
@@ -72,18 +73,30 @@ class SlotDataPoint:
         )
 
 
-def open_immutable(db_path: str, validate_all: bool = False) -> ImmutableDB:
+def open_immutable(db_path: str, validate_all=False) -> ImmutableDB:
+    """validate_all: False = most-recent-chunk check only; True =
+    ValidateAllChunks at open (two disk passes: validation walk, then
+    the replay's stream); "stream" = the SAME all-chunks checks (CRC +
+    body-hash integrity, per-blob order) folded into the replay's own
+    chunk reads by _stream_views — one disk pass, identical verdicts
+    and truncation points, no on-disk repair (read-only analysis).
+    Reference: --only-validation forces ValidateAllChunks
+    (Tools/DBAnalyser.hs:133-136); the stream mode is how the replay
+    pays for it without reading every chunk twice."""
     import os
 
     from ..storage.open import default_check_integrity_batch
 
+    stream = validate_all == "stream"
+    deep = bool(validate_all) and not stream
     return ImmutableDB(
         os.path.join(db_path, "immutable"),
-        check_integrity=default_check_integrity if validate_all else None,
-        validate_all=validate_all,
+        check_integrity=default_check_integrity if deep else None,
+        validate_all=deep,
         check_integrity_batch=(
-            default_check_integrity_batch if validate_all else None
+            default_check_integrity_batch if deep else None
         ),
+        stream_deep=stream,
     )
 
 
@@ -158,13 +171,31 @@ def _stream_views(imm: ImmutableDB, res: "ValidationResult"):
     from ..storage.immutable import _chunk_name
 
     native_ok = native_loader.load() is not None
+    stream_deep = getattr(imm, "stream_deep", False)
     for n in imm._chunks:
         entries = imm._entries[n]
         if not entries:
             continue
         with open(os.path.join(imm.path, _chunk_name(n)), "rb") as f:
             data = f.read()
-        if native_ok:
+        truncated = False
+        if stream_deep:
+            # single-pass validate-all: the open deferred the deep walk
+            # to this read (open_immutable "stream" mode) — same checks,
+            # same truncation point, no second disk pass
+            from ..storage.open import (
+                default_check_integrity,
+                default_check_integrity_batch,
+            )
+
+            good = imm.deep_check_loaded(
+                data, entries, default_check_integrity,
+                default_check_integrity_batch,
+            )
+            if good < len(entries):
+                entries = entries[:good]
+                truncated = True
+        if native_ok and entries:
             import numpy as np
 
             offsets = np.asarray([e.offset for e in entries], np.int64)
@@ -177,6 +208,8 @@ def _stream_views(imm: ImmutableDB, res: "ValidationResult"):
                 yield Block.from_bytes(
                     data[e.offset : e.offset + e.size]
                 ).header.to_view()
+        if truncated:
+            return  # corruption truncates the chain here
 
 
 def revalidate(
@@ -211,6 +244,7 @@ def revalidate(
     res = ValidationResult()
     t0 = time.monotonic()
     imm = open_immutable(db_path, validate_all=validate_all)
+    res.open_s = time.monotonic() - t0
 
     def stream_views(imm, res):
         if max_headers is None:

@@ -92,10 +92,6 @@ def check():
     P = fe.P_INT
     vals = [0, 1, 2, P - 1, P - 19, (1 << 255) - 20]
     vals += [int.from_bytes(rng.bytes(32), "little") % P for _ in range(30)]
-    cols_a, cols_b = [], []
-    for i, v in enumerate(vals):
-        cols_a.append(fe.int_to_limbs_np(v) if hasattr(fe, "int_to_limbs_np")
-                      else None)
     # build [20, T] arrays via the field helpers
     from ouroboros_consensus_tpu.ops import field as f
 

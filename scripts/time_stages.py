@@ -20,6 +20,10 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/ouroboros-jax-cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 os.environ["OCT_PK_AOT"] = "0"  # jit path only — we are timing edits
+# before the bench import: bench.py resolves BENCH_HEADERS at import
+# time, and stage timing wants the 100k chain even when the 1M cache
+# exists (its open alone is multi-second)
+os.environ.setdefault("BENCH_HEADERS", "100000")
 
 from bench import KES_DEPTH, MAX_BATCH, build_or_load_chain  # noqa: E402
 from ouroboros_consensus_tpu.ops.pk import kernels as K  # noqa: E402
@@ -31,7 +35,6 @@ B = MAX_BATCH
 
 def main():
     which = sys.argv[1:] or ["ed", "vrf"]
-    os.environ.setdefault("BENCH_HEADERS", "100000")
     dev = jax.devices()[0]
     print(f"device: {dev} platform={dev.platform}", flush=True)
     path, params, lview = build_or_load_chain()

@@ -323,3 +323,44 @@ def test_split_dispatch_threads_stages_correctly(monkeypatch):
     eq(g[10], 19); eq(g[11], 20)
     assert g[0].shape == (1, b) and g[1].shape == (80, b)
     assert g[6].shape == (1, b) and g[7].shape == (400, b)
+
+
+def test_validate_chain_cross_epoch_pipelining(pools, lview):
+    # THREE epoch boundaries with several small batches per epoch and
+    # pipeline depth 3: the next epoch's first windows must stage with
+    # the LOOKAHEAD nonce (combine(candidate, last_epoch_block_nonce)
+    # once the fold passes the freeze slot) while the current epoch's
+    # tail is still in flight — the retire-time tick asserts the staged
+    # nonce, and the final state must equal the per-header fold.
+    params = PARAMS
+    hvs = []
+    prev = None
+    st0 = praos.PraosState(epoch_nonce=b"\x07" * 32)
+
+    st = st0
+    slot = 2
+    while len(hvs) < 70:
+        ticked = praos.tick(params, lview, slot, st)
+        pool = fixtures.find_leader(
+            params, pools, lview, slot, ticked.state.epoch_nonce
+        )
+        if pool is None:
+            slot += 1
+            continue
+        hv = fixtures.forge_header_view(
+            params, pool, slot=slot,
+            epoch_nonce=ticked.state.epoch_nonce, prev_hash=prev,
+            body_bytes=b"c%d" % len(hvs),
+        )
+        st = praos.update(params, hv, slot, ticked)
+        hvs.append(hv)
+        prev = (b"%032d" % len(hvs))[:32]
+        slot += 1
+    assert params.epoch_of(hvs[-1].slot) >= 3  # crossed >= 3 boundaries
+
+    res = pbatch.validate_chain(
+        params, lambda epoch: lview, st0, hvs, max_batch=4,
+        pipeline_depth=3,
+    )
+    assert res.error is None and res.n_valid == len(hvs)
+    assert res.state == st
