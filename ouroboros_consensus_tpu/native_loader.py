@@ -59,8 +59,47 @@ def load():
         ctypes.c_char_p, ctypes.c_size_t,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
     ]
+    lib.ocx_parse_index.restype = ctypes.c_int64
+    lib.ocx_parse_index.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64,
+        *([ctypes.c_void_p] * 6),
+    ]
     _lib = lib
     return _lib
+
+
+def parse_index(buf: bytes):
+    """Columnar parse of a concatenated-CBOR ImmutableDB index:
+    (slots, block_nos, hashes[n,32], offsets, sizes, crcs) up to the
+    first torn/malformed entry; None when the library is unavailable
+    (callers fall back to the per-entry Python decode)."""
+    lib = load()
+    if lib is None:
+        return None
+    # true CBOR minimum is 40 bytes/entry (1-byte heads + 34-byte hash
+    # item + four 1-byte uints + 1-5 byte crc); capacity at that bound
+    # can never be hit by a well-formed index
+    cap = max(1, len(buf) // 40 + 1)
+    slots = np.zeros(cap, np.int64)
+    block_nos = np.zeros(cap, np.int64)
+    hashes = np.zeros((cap, 32), np.uint8)
+    offsets = np.zeros(cap, np.int64)
+    sizes = np.zeros(cap, np.int64)
+    crcs = np.zeros(cap, np.int64)
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    n = int(lib.ocx_parse_index(
+        buf, len(buf), cap, ptr(slots), ptr(block_nos), ptr(hashes),
+        ptr(offsets), ptr(sizes), ptr(crcs),
+    ))
+    if n >= cap:
+        # capacity hit (cannot distinguish from a torn entry): let the
+        # Python decode loop decide rather than silently truncating
+        return None
+    return (slots[:n], block_nos[:n], hashes[:n], offsets[:n], sizes[:n],
+            crcs[:n])
 
 
 def crc32_first_bad(buf: bytes, offsets, sizes, expected) -> int | None:

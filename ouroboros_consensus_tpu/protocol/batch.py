@@ -389,6 +389,10 @@ def _pk_dispatch(batch: PraosBatch):
     byte expansion run in XLA (pk_arrays on host cost ~20 us/header)."""
     depth = batch.kes.siblings.shape[-2]
     ed, kes, vrf = batch.ed, batch.kes, batch.vrf
+    # (an explicit async jax.device_put of the columns first was A/B'd
+    # r5: through the remote-TPU tunnel it does NOT overlap with the
+    # prior window's kernels — the same ~130 ms/batch of H2D just moves
+    # from the materialize wait into the dispatch bracket)
     out = _jitted_pk(depth)(
         ed.pk, ed.r, ed.s, ed.hblocks, ed.hnblocks,
         kes.vk, kes.period, kes.r, kes.s, kes.vk_leaf, kes.siblings,
@@ -823,7 +827,10 @@ def validate_chain(
     hvs: Sequence[HeaderView],
     max_batch: int = 8192,
     backend: str = "device",
-    pipeline_depth: int = 2,
+    pipeline_depth: int = 3,  # 2 windows hide staging behind the device;
+    # the third absorbs the shorter epoch-tail batches (6144-lane
+    # buckets) without a bubble. ~14 MB staged + ~26 MB on-device per
+    # window — far under HBM at depth 3.
     mesh=None,  # backend="sharded": the jax.sharding.Mesh (None = all devices)
 ) -> BatchResult:
     """Validate an arbitrary run of headers, segmenting at epoch

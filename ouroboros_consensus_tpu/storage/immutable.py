@@ -352,6 +352,9 @@ class ImmutableDB:
             data = self.fs.read_bytes(ipath)
         except OSError:
             return None
+        fast = self._load_index_native(data)
+        if fast is not None:
+            return fast
         entries: list[IndexEntry] = []
         off = 0
         end = 0
@@ -377,6 +380,37 @@ class ImmutableDB:
             end = e.offset + e.size
             entries.append(e)
         return entries
+
+    def _load_index_native(self, data: bytes) -> list[IndexEntry] | None:
+        """Columnar native index parse + vectorized sanity checks (the
+        open-time bottleneck at the 1M-header scale: ~9 us/entry of
+        Python CBOR decode vs ~20 ns here). None -> Python loop."""
+        from .. import native_loader
+
+        cols = native_loader.parse_index(data)
+        if cols is None:
+            return None
+        slots, block_nos, hashes, offsets, sizes, crcs = cols
+        n = len(slots)
+        if n == 0:
+            return []
+        import numpy as np
+
+        # same contiguous-tiling sanity as the Python loop: offsets must
+        # tile from 0 with plausible sizes; keep the good prefix only
+        starts = np.concatenate(([0], (offsets + sizes)[:-1]))
+        good = (offsets == starts) & (sizes > 0) & (sizes <= (1 << 40))
+        bad = np.flatnonzero(~good)
+        if bad.size:
+            n = int(bad[0])
+        hb = hashes.tobytes()
+        return [
+            IndexEntry(
+                int(slots[i]), int(block_nos[i]), hb[32 * i : 32 * i + 32],
+                int(offsets[i]), int(sizes[i]), int(crcs[i]),
+            )
+            for i in range(n)
+        ]
 
     def _write_index(self, n: int, entries: list[IndexEntry]):
         data = b"".join(cbor.encode(e.to_cbor_obj()) for e in entries)
