@@ -4,10 +4,10 @@ code path through ops/pk/verify), cross-checked lane-for-lane against
 the native verifier. Run in a subprocess so OCT_PK_HASH_IMPL is set
 before any ops module is imported.
 
-The composed core is jitted at this ONE fixed shape and rides the
-persistent compilation cache (/tmp/ouroboros-jax-cache, also used by
-conftest): the first-ever run on a box pays a multi-minute XLA:CPU
-compile once; every later run loads in seconds. Exits 0 on agreement.
+The composed core runs EAGERLY (jax.disable_jit): XLA:CPU's compile of
+the composed graph is pathological on a cold cache (>30 min on a 1-core
+box), while eager dispatch is ~4 min deterministically with no cache
+dependence. Exits 0 on agreement.
 """
 
 import dataclasses
@@ -94,26 +94,49 @@ def main() -> int:
             beta, tlo, thi, kes_depth=1,
         )
 
-    v = jax.tree.map(np.asarray, jax.jit(f)(*arrays))
+    # EAGER, not jitted: the composed graph's XLA:CPU compile is
+    # pathological on a cold cache (>30 min measured on the 1-core CI
+    # box — the algebraic-simplifier blowup, PERF.md r4/r5), while
+    # eager op dispatch of the same graph is ~4 min deterministically,
+    # every run, with no cache dependence. The smoke certifies the
+    # composed SEMANTICS lane-for-lane; compiled-path coverage lives in
+    # the OCT_SLOW tier and the on-hardware scripts.
+    with jax.disable_jit():
+        v = jax.tree.map(np.asarray, f(*arrays))
     fields = ("ok_ocert_sig", "ok_kes_sig", "ok_vrf", "ok_leader")
     mism = []
     for i in range(B):
         # native verifier one lane at a time (it short-circuits at the
         # first failing lane, so batch-level lane-for-lane is invalid)
         pre_i = pbatch.HostChecks(
-            pre.kes_errors[i : i + 1], pre.vrf_errors[i : i + 1],
+            pre.kes_window_errors[i : i + 1],
+            pre.vrf_lookup_errors[i : i + 1],
             pre.kes_evolution[i : i + 1],
         )
         vn = pbatch.run_batch_native(PARAMS, lview, ETA0, hvs[i : i + 1], pre_i)
+        sigs_ok = all(
+            bool(getattr(vn, f)[0])
+            for f in ("ok_ocert_sig", "ok_kes_sig", "ok_vrf")
+        )
         for fname in fields:
+            if fname == "ok_leader" and not sigs_ok:
+                # the native verifier short-circuits: leadership is not
+                # evaluated after a failed signature leg (always False
+                # there), while the batched core computes legs
+                # independently — the composed verdict is identical
+                # because _lane_error applies reference order
+                continue
             got = bool(np.asarray(getattr(v, fname))[..., i].reshape(-1)[0])
             want = bool(getattr(vn, fname)[0])
             if got != want:
                 mism.append((i, fname, got, want))
         if not mism:
-            # eta (nonce contribution) must agree bit-for-bit on lanes
-            # whose proof is valid — it feeds the evolving-nonce fold
-            if bool(vn.ok_vrf[0]):
+            # eta (nonce contribution) must agree bit-for-bit on fully
+            # valid lanes — it feeds the evolving-nonce fold. Gate on
+            # sigs_ok, not ok_vrf alone: the native verifier
+            # short-circuits inside a lane, so ok_vrf/eta are don't-care
+            # once an earlier leg failed
+            if sigs_ok and bool(vn.ok_vrf[0]):
                 dev_eta = np.asarray(v.eta)[..., i].reshape(-1)
                 nat_eta = np.asarray(vn.eta[0]).reshape(-1)
                 if not np.array_equal(dev_eta, nat_eta):

@@ -12,9 +12,10 @@ modules are imported (the TPU code path — the XLA hash modules'
 constant arrays cannot be captured by Pallas, see PERF.md), and this
 process has long since imported them.
 
-Budget: the composed graph compiles in minutes on a COLD XLA:CPU cache,
-seconds on a warm one (the persistent cache at /tmp/ouroboros-jax-cache
-is shared with conftest and survives across runs on this box).
+Budget: the child runs the composed graph EAGERLY — ~4 min of op
+dispatch on the 1-core CI box, deterministic, no compile and no cache
+dependence (a cold-cache XLA:CPU compile of the same graph exceeded
+30 min there).
 """
 
 import os
