@@ -1,0 +1,136 @@
+"""CLI: `python -m ouroboros_consensus_tpu.analysis [options]`.
+
+Default run = both passes over the package + the registered kernel
+graphs, exit 1 on any unsuppressed finding or budget violation.
+
+  --json            machine-readable report on stdout
+  --paths P [P...]  lint these packages/files instead of the package
+  --no-graphs       skip Pass 2 (pure AST run, no jax import)
+  --graphs G [G...] analyze only these registered graphs
+  --all             include suppressed findings in the report
+  --baseline B      subtract baselined finding keys (ratchet mode —
+                    scripts/lint.py drives this)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import astlint, graphs
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="ouroboros_consensus_tpu.analysis")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--paths", nargs="+", default=None)
+    ap.add_argument("--no-graphs", action="store_true")
+    ap.add_argument("--graphs", nargs="+", default=None,
+                    choices=graphs.registered_graphs())
+    ap.add_argument("--all", action="store_true",
+                    help="include suppressed findings")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline.json of grandfathered finding keys")
+    ap.add_argument("--budgets", default=None,
+                    help="alternate budgets.json")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [_package_root()]
+    findings = astlint.lint_paths(paths)
+
+    # default runs also report rule coverage over the purpose-built
+    # fixtures (tests/lint_fixtures) — a self-check that every rule
+    # still fires; fixture findings never affect the exit status
+    fixture_rules: list[str] = []
+    if not args.paths:
+        fdir = os.path.join(
+            os.path.dirname(_package_root()), "tests", "lint_fixtures"
+        )
+        if os.path.isdir(fdir):
+            fixture_rules = sorted({
+                f.rule for f in astlint.lint_paths([fdir])
+            })
+
+    baseline_keys: set[str] = set()
+    if args.baseline:
+        with open(args.baseline, encoding="utf-8") as f:
+            baseline_keys = set(json.load(f).get("findings", []))
+
+    active = [
+        f for f in findings
+        if not f.suppressed and f.key() not in baseline_keys
+    ]
+    shown = findings if args.all else active
+
+    reports: list[graphs.GraphReport] = []
+    violations: list[str] = []
+    if not args.no_graphs:
+        # abstract tracing never needs an accelerator, and this box's
+        # sitecustomize force-registers a TPU plugin whose client init
+        # can hang on a wedged tunnel — pin the platform BEFORE the
+        # first backend touch so the lint gate cannot block on hardware
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # already initialized (e.g. under pytest conftest)
+        reports = graphs.analyze_registered(args.graphs)
+        budgets = graphs.load_budgets(args.budgets)
+        violations = graphs.check_budgets(reports, budgets)
+
+    failed = bool(active or violations)
+
+    if args.json:
+        out = {
+            "findings": [
+                {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "line": f.line,
+                    "col": f.col,
+                    "message": f.message,
+                    "suppressed": f.suppressed,
+                    "key": f.key(),
+                }
+                for f in shown
+            ],
+            "rules_fired": sorted({f.rule for f in shown}),
+            "fixture_rules_fired": fixture_rules,
+            "graphs": [r.to_dict() for r in reports],
+            "budget_violations": violations,
+            "ok": not failed,
+        }
+        print(json.dumps(out, indent=2))
+    else:
+        for f in shown:
+            print(f.format())
+        for r in reports:
+            print(
+                f"graph {r.name}: eqns={r.eqns} muls={r.mul_count} "
+                f"mul_chain_depth={r.mul_chain_depth} "
+                f"fanout={r.op_fanout} remat_width={r.remat_width} "
+                f"computations={r.computations}"
+            )
+        for v in violations:
+            print(f"BUDGET: {v}")
+        n_sup = sum(1 for f in findings if f.suppressed)
+        extra = (
+            f", fixture rules firing: {'/'.join(fixture_rules)}"
+            if fixture_rules else ""
+        )
+        print(
+            f"octlint: {len(active)} finding(s), {n_sup} suppressed, "
+            f"{len(violations)} budget violation(s){extra}"
+        )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,823 @@
+"""Pass 1 — AST jit-safety linter.
+
+Finds the host/device hazards that are statically visible in Python
+source long before XLA (or a TPU runtime) ever sees the program. Rules:
+
+  OCT101 host-sync-in-jit     `.item()` / `.tolist()` /
+                              `.block_until_ready()` / `np.asarray` /
+                              `np.array` / `jax.device_get` — and
+                              `float()`/`int()`/`bool()` applied to a
+                              locally traced value — inside a function
+                              reachable from a `@jax.jit` /
+                              `shard_map` / `pallas_call` root. Each of
+                              these forces a device→host transfer (or a
+                              trace error) in the middle of a traced
+                              graph.
+  OCT102 traced-branch        Python `if`/`while` whose condition
+                              references a traced value inside jit
+                              code: data-dependent Python control flow
+                              either fails to trace or silently bakes
+                              in one branch.
+  OCT103 mutable-global-capture
+                              a jit-reachable function reads a
+                              module-level mutable object (dict/list/
+                              set). jit traces capture the CONTENTS at
+                              trace time; later mutation desyncs the
+                              compiled executable from the Python
+                              state.
+  OCT104 wide-int-literal     an integer literal that does not fit in
+                              int32 inside jit code: jax weak types
+                              promote the lane to 64-bit (or overflow
+                              at lowering on 32-bit TPU lanes) —
+                              the u32-lane widening pitfall.
+  OCT105 await-holding-lock   `await` while holding a RAWLock /
+                              ResourceRegistry resource in async
+                              runtime code: the awaited IO can block
+                              arbitrarily, starving every sim/async
+                              task queued on the lock.
+
+Suppression syntax (documented in analysis/README.md):
+
+  x = thing.item()   # octlint: disable=OCT101  <why it is safe here>
+  # a trailing `# octlint: disable` (no rule list) suppresses all rules
+  # on that line; the def-line of a function suppresses its whole body;
+  # `# octlint: disable-file=OCT103` anywhere suppresses the file.
+
+The linter is best-effort by design: reachability is a static
+over-approximation (name-resolved calls across package modules), so a
+finding is "this pattern is hostile to jit if this code ever traces",
+not a proof of breakage — the suppression comment is the reviewed
+assertion that it does not.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable
+
+RULES = {
+    "OCT101": "host-sync-in-jit",
+    "OCT102": "traced-branch",
+    "OCT103": "mutable-global-capture",
+    "OCT104": "wide-int-literal",
+    "OCT105": "await-holding-lock",
+}
+
+# rule tokens are letters-then-digits (OCT101); matching them strictly
+# keeps a trailing justification ("… disable=OCT101 TPU sync is fine
+# here") out of the captured rule list
+_RULE_LIST = r"[A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*"
+_SUPPRESS_RE = re.compile(
+    rf"#\s*octlint:\s*disable(?:=({_RULE_LIST}))?(?=[\s,]|$)"
+)
+_SUPPRESS_FILE_RE = re.compile(
+    rf"#\s*octlint:\s*disable-file=({_RULE_LIST})"
+)
+
+# host-sync method names (attribute calls on any object)
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# host-sync module functions, resolved through import aliases; only
+# flagged when the argument is locally traced — np.asarray over host
+# constants at trace time is the normal way to build jit constants
+_SYNC_NUMPY_FNS = {"asarray", "array", "copy"}
+_SYNC_JAX_FNS = {"device_get"}
+# builtins that force a concrete value out of a tracer
+_SYNC_BUILTINS = {"float", "int", "bool"}
+
+# attribute reads that are static at trace time: referencing a traced
+# array through these does NOT taint the result
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "weak_type"}
+
+# explicit dtype constructors: a wide literal wrapped in one of these is
+# a deliberate 64/unsigned-width value, not an accidental widening
+_DTYPE_CTORS = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "bfloat16",
+}
+
+_JAXY_MODULES = {"jax", "jax.numpy", "jax.lax", "numpy"}  # numpy NOT traced
+_TRACED_MODULES = {"jax", "jax.numpy", "jax.lax"}
+
+_LOCK_ACQUIRE = {"acquire_read", "acquire_append", "acquire_write", "allocate"}
+_LOCK_RELEASE = {"release_read", "release_append", "release_write", "close"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    # ordinal among same-keyed findings in one lint run (assigned by
+    # lint_paths): a SECOND occurrence of a grandfathered hazard gets a
+    # distinct key, so the baseline ratchet cannot be widened silently
+    seq: int = 0
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"[{RULES[self.rule]}] {self.message}{tag}"
+
+    def key(self) -> str:
+        """Line-number-free identity for baseline matching: findings
+        survive unrelated edits above them."""
+        base = f"{self.rule}::{self.path}::{self.message}"
+        return base if self.seq == 0 else f"{base}::#{self.seq}"
+
+
+# ---------------------------------------------------------------------------
+# Per-module model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _FuncInfo:
+    module: str
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    is_root: bool = False
+    reachable: bool = False
+    calls: set = dataclasses.field(default_factory=set)  # (module, name)
+    callable_args: set = dataclasses.field(default_factory=set)
+    children: list = dataclasses.field(default_factory=list)
+
+
+class _ModuleModel:
+    def __init__(self, modname: str, path: str, tree: ast.Module,
+                 source: str):
+        self.modname = modname
+        self.path = path
+        self.tree = tree
+        self.lines = source.splitlines()
+        # alias -> dotted module name ("np" -> "numpy", "pc" -> pkg mod)
+        self.mod_aliases: dict[str, str] = {}
+        # name -> (module, symbol) for `from m import f`
+        self.sym_imports: dict[str, tuple[str, str]] = {}
+        self.mutable_globals: set[str] = set()
+        self.functions: dict[str, _FuncInfo] = {}
+        self.suppress_file: set[str] = set()
+        self.suppress_line: dict[int, set[str] | None] = {}
+        self._scan_suppressions(source)
+        self._scan()
+
+    # -- suppression comments ------------------------------------------------
+
+    def _scan_suppressions(self, source: str) -> None:
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                self.suppress_file |= {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = m.group(1)
+                if rules is None:
+                    self.suppress_line[i] = None  # all rules
+                else:
+                    self.suppress_line[i] = {
+                        r.strip() for r in rules.split(",") if r.strip()
+                    }
+
+    def is_suppressed(self, rule: str, line: int, def_line: int | None) -> bool:
+        if rule in self.suppress_file:
+            return True
+        for ln in (line, def_line):
+            if ln is None:
+                continue
+            rules = self.suppress_line.get(ln, "missing")
+            if rules is None:
+                return True
+            if rules != "missing" and rule in rules:
+                return True
+        return False
+
+    # -- imports / globals / functions --------------------------------------
+
+    def _resolve_relative(self, node: ast.ImportFrom) -> str:
+        base = self.modname.split(".")
+        if node.level:
+            base = base[: len(base) - node.level]
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    def _scan(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.mod_aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                src = (
+                    self._resolve_relative(node)
+                    if node.level
+                    else (node.module or "")
+                )
+                for a in node.names:
+                    name = a.asname or a.name
+                    # `from jax import numpy as jnp` style: the imported
+                    # symbol may itself be a module
+                    self.mod_aliases[name] = f"{src}.{a.name}"
+                    self.sym_imports[name] = (src, a.name)
+        candidates: set[str] = set()
+        for stmt in self.tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                value = stmt.value
+                if value is not None and _is_mutable_literal(value):
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            candidates.add(t.id)
+        # only globals the module actually MUTATES are a capture hazard;
+        # a module-level constant table that happens to be a list is not
+        self.mutable_globals = candidates & _mutated_names(self.tree)
+        self._collect_functions(self.tree, prefix="")
+
+    def _collect_functions(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                info = _FuncInfo(self.modname, qn, child)
+                self.functions[qn] = info
+                self._collect_functions(child, prefix=f"{qn}.")
+                for sub in self.functions.values():
+                    if sub.qualname.startswith(f"{qn}."):
+                        info.children.append(sub.qualname)
+            elif isinstance(child, ast.ClassDef):
+                # class bodies: collect methods but never treat them as
+                # call-graph targets (attribute dispatch is unresolved)
+                self._collect_functions(child, prefix=f"{prefix}{child.name}.")
+            elif not isinstance(child, (ast.Lambda,)):
+                self._collect_functions(child, prefix=prefix)
+
+    def module_of_alias(self, name: str) -> str | None:
+        return self.mod_aliases.get(name)
+
+
+def _is_mutable_literal(node: ast.expr) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in {"dict", "list", "set"}
+    return False
+
+
+_MUTATOR_METHODS = {
+    "append", "add", "update", "setdefault", "pop", "clear", "extend",
+    "insert", "remove", "popitem", "discard",
+}
+
+
+def _mutated_names(tree: ast.Module) -> set[str]:
+    """Names that are mutated anywhere in the module: `x[...] = v`,
+    `x.append(v)`, `del x[...]`, `x |= ...`, or rebound via `global`."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target] if isinstance(node, ast.AugAssign)
+                else node.targets
+            )
+            for t in targets:
+                if isinstance(t, (ast.Subscript, ast.Attribute)) and \
+                        isinstance(t.value, ast.Name):
+                    out.add(t.value.id)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in _MUTATOR_METHODS and \
+                    isinstance(f.value, ast.Name):
+                out.add(f.value.id)
+        elif isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Jit-root detection + call graph
+# ---------------------------------------------------------------------------
+
+
+def _callable_ref(node: ast.expr) -> str | tuple[str, str] | None:
+    """Reference to a callable expression: a bare local name (str), an
+    `alias.func` pair (tuple), or the same through functools.partial."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        chain = _attr_chain(node)
+        if len(chain) == 2:
+            return (chain[0], chain[1])
+        return None
+    if isinstance(node, ast.Call):
+        f = node.func
+        fname = None
+        if isinstance(f, ast.Name):
+            fname = f.id
+        elif isinstance(f, ast.Attribute):
+            fname = f.attr
+        if fname == "partial" and node.args:
+            return _callable_ref(node.args[0])
+    return None
+
+
+def _attr_chain(node: ast.expr) -> list[str]:
+    """a.b.c -> ["a", "b", "c"]; [] when not a plain chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def _is_jit_wrapper(node: ast.expr) -> bool:
+    """jax.jit / jit / pjit / shard_map / pl.pallas_call expression?"""
+    chain = _attr_chain(node)
+    if not chain:
+        # partial(jax.jit, ...) used as a decorator factory
+        if isinstance(node, ast.Call):
+            cn = _attr_chain(node.func)
+            if cn and cn[-1] == "partial" and node.args:
+                return _is_jit_wrapper(node.args[0])
+        return False
+    return chain[-1] in {"jit", "pjit", "shard_map", "pallas_call"}
+
+
+class _CallCollector(ast.NodeVisitor):
+    """Collects resolvable call targets + jit-wrapped callables inside
+    one function body (without descending into nested defs)."""
+
+    def __init__(self, model: _ModuleModel):
+        self.model = model
+        self.calls: set[tuple[str | None, str]] = set()
+        self.jit_wrapped: set[str] = set()  # local callable names
+        # functions passed by name as arguments (higher-order): if the
+        # enclosing function traces, these are traced too (the Pallas
+        # `_call(kernel, ...)` indirection pattern)
+        self.callable_args: set[tuple[str | None, str]] = set()
+        self._depth = 0
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        if self._depth == 0:
+            self._depth += 1
+            self.generic_visit(node)
+            self._depth -= 1
+        # nested defs handled via _FuncInfo.children
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):  # noqa: N802
+        f = node.func
+        if isinstance(f, ast.Name):
+            self.calls.add((None, f.id))
+        elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            mod = self.model.module_of_alias(f.value.id)
+            if mod is not None:
+                self.calls.add((mod, f.attr))
+        for arg in node.args:
+            ref = _callable_ref(arg)
+            if isinstance(ref, str):
+                self.callable_args.add((None, ref))
+            elif ref is not None:
+                mod = self.model.module_of_alias(ref[0])
+                if mod is not None:
+                    self.callable_args.add((mod, ref[1]))
+        if _is_jit_wrapper(f):
+            for arg in node.args[:1]:
+                ref = _callable_ref(arg)
+                if isinstance(ref, str):
+                    self.jit_wrapped.add(ref)
+                elif ref is not None:
+                    mod = self.model.module_of_alias(ref[0])
+                    if mod is not None:
+                        self.calls.add((mod, ref[1]))
+                        self.jit_wrapped.add(f"{ref[0]}.{ref[1]}")
+        self.generic_visit(node)
+
+
+class Package:
+    """All modules of one package subtree, with the cross-module
+    jit-reachability closure computed."""
+
+    def __init__(self, root: str, package_name: str | None = None,
+                 rel_to: str | None = None):
+        self.root = root
+        self.rel_to = rel_to or os.path.dirname(os.path.abspath(root))
+        self.package_name = package_name or os.path.basename(
+            os.path.abspath(root)
+        )
+        self.modules: dict[str, _ModuleModel] = {}
+        self._load()
+        self._mark_roots()
+        self._close_reachability()
+
+    # -- loading -------------------------------------------------------------
+
+    def _iter_sources(self) -> Iterable[tuple[str, str]]:
+        if os.path.isfile(self.root):
+            modname = os.path.splitext(os.path.basename(self.root))[0]
+            yield modname, self.root
+            return
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, os.path.dirname(self.root))
+                mod = rel[:-3].replace(os.sep, ".")
+                if mod.endswith(".__init__"):
+                    mod = mod[: -len(".__init__")]
+                yield mod, full
+
+    def _load(self) -> None:
+        for modname, path in self._iter_sources():
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError:
+                continue
+            rel = os.path.relpath(path, self.rel_to)
+            self.modules[modname] = _ModuleModel(modname, rel, tree, source)
+
+    # -- roots + reachability ------------------------------------------------
+
+    def _mark_roots(self) -> None:
+        for model in self.modules.values():
+            for info in model.functions.values():
+                for dec in info.node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _is_jit_wrapper(target) or _is_jit_wrapper(dec):
+                        info.is_root = True
+            # call-site wrapping: jax.jit(f), pl.pallas_call(kernel,...)
+            for info in model.functions.values():
+                cc = _CallCollector(model)
+                cc.visit(info.node)
+                info.calls = cc.calls
+                info.callable_args = cc.callable_args
+                for name in cc.jit_wrapped:
+                    self._mark_callable(model, info, name)
+            # module-level wrapping (e.g. FN = jax.jit(fn))
+            cc = _CallCollector(model)
+            for stmt in model.tree.body:
+                if not isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    cc.visit(stmt)
+            for name in cc.jit_wrapped:
+                self._mark_callable(model, None, name)
+
+    def _mark_callable(self, model: _ModuleModel, info: _FuncInfo | None,
+                       name: str) -> None:
+        # local function in the enclosing scope chain?
+        if info is not None:
+            prefix = info.qualname
+            while True:
+                qn = f"{prefix}.{name}" if prefix else name
+                if qn in model.functions:
+                    model.functions[qn].is_root = True
+                    return
+                if "." not in prefix:
+                    break
+                prefix = prefix.rsplit(".", 1)[0]
+        if name in model.functions:
+            model.functions[name].is_root = True
+            return
+        if "." in name:  # "alias.func" recorded by _CallCollector
+            alias, fname = name.split(".", 1)
+            mod = model.module_of_alias(alias)
+            target = self._lookup(mod, fname)
+            if target is not None:
+                target.is_root = True
+            return
+        if name in model.sym_imports:
+            src, sym = model.sym_imports[name]
+            target = self._lookup(src, sym)
+            if target is not None:
+                target.is_root = True
+
+    def _lookup(self, modname: str | None, fname: str) -> _FuncInfo | None:
+        if modname is None:
+            return None
+        model = self.modules.get(modname)
+        if model is None:
+            return None
+        if fname in model.functions:
+            return model.functions[fname]
+        # re-export through the module's own symbol imports
+        if fname in model.sym_imports:
+            src, sym = model.sym_imports[fname]
+            if src != modname:
+                return self._lookup(src, sym)
+        return None
+
+    def _resolve_call(self, model: _ModuleModel, info: _FuncInfo,
+                      call: tuple[str | None, str]) -> _FuncInfo | None:
+        mod, name = call
+        if mod is not None:
+            return self._lookup(mod, name)
+        # bare name: enclosing scopes, then module scope, then imports
+        prefix = info.qualname
+        while "." in prefix:
+            prefix = prefix.rsplit(".", 1)[0]
+            qn = f"{prefix}.{name}"
+            if qn in model.functions:
+                return model.functions[qn]
+        if name in model.functions:
+            return model.functions[name]
+        if name in model.sym_imports:
+            src, sym = model.sym_imports[name]
+            return self._lookup(src, sym)
+        return None
+
+    def _close_reachability(self) -> None:
+        work: list[_FuncInfo] = []
+        for model in self.modules.values():
+            for info in model.functions.values():
+                if info.is_root:
+                    info.reachable = True
+                    work.append(info)
+        while work:
+            info = work.pop()
+            model = self.modules[info.module]
+            nxt: list[_FuncInfo] = []
+            for qn in info.children:
+                nxt.append(model.functions[qn])
+            for call in info.calls | info.callable_args:
+                target = self._resolve_call(model, info, call)
+                if target is not None:
+                    nxt.append(target)
+            for t in nxt:
+                if not t.reachable:
+                    t.reachable = True
+                    work.append(t)
+
+
+# ---------------------------------------------------------------------------
+# Rule visitors
+# ---------------------------------------------------------------------------
+
+
+class _TracedNames(ast.NodeVisitor):
+    """Local flow-insensitive dataflow: names assigned from jax/jnp/lax
+    expressions, or from expressions that reference an already-traced
+    name (iterated to a fixed point). Reads through static attributes
+    (`x.shape`, `x.dtype`, ...) do not taint."""
+
+    def __init__(self, model: _ModuleModel, params_traced: set[str]):
+        self.model = model
+        self.traced: set[str] = set(params_traced)
+        self.changed = False
+
+    def _expr_traced(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if len(chain) >= 2:
+                mod = self.model.module_of_alias(chain[0])
+                if mod in _TRACED_MODULES:
+                    return True
+        return any(
+            self._expr_traced(c)
+            for c in ast.iter_child_nodes(node)
+            if isinstance(c, ast.expr)
+        )
+
+    def visit_Assign(self, node):  # noqa: N802
+        if self._expr_traced(node.value):
+            for t in node.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name) and \
+                            sub.id not in self.traced:
+                        self.traced.add(sub.id)
+                        self.changed = True
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass  # nested defs analyzed on their own
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _check_function(pkg: Package, model: _ModuleModel,
+                    info: _FuncInfo) -> list[Finding]:
+    out: list[Finding] = []
+    node = info.node
+    def_line = node.lineno
+
+    def emit(rule: str, where: ast.AST, message: str) -> None:
+        sup = model.is_suppressed(rule, where.lineno, def_line)
+        out.append(Finding(rule, model.path, where.lineno,
+                           getattr(where, "col_offset", 0), message, sup))
+
+    # in a jit ROOT the parameters are the traced operands; in a merely
+    # reachable helper they may be host values, so only roots taint them
+    params_traced: set[str] = set()
+    if info.is_root and isinstance(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef)
+    ):
+        a = node.args
+        for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+            params_traced.add(p.arg)
+        for va in (a.vararg, a.kwarg):
+            if va is not None:
+                params_traced.add(va.arg)
+    tn = _TracedNames(model, params_traced)
+    for _ in range(4):  # fixed point over chained assignments
+        tn.changed = False
+        for stmt in node.body:
+            tn.visit(stmt)
+        if not tn.changed:
+            break
+
+    def expr_traced(e: ast.expr) -> bool:
+        return tn._expr_traced(e)
+
+    # literals wrapped in an explicit dtype constructor are deliberate
+    dtype_wrapped: set[int] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            chain = _attr_chain(sub.func)
+            if chain and chain[-1] in _DTYPE_CTORS:
+                for arg in sub.args:
+                    if isinstance(arg, ast.Constant):
+                        dtype_wrapped.add(id(arg))
+
+    def own_nodes(n: ast.AST):
+        """Walk this function's own body, excluding nested defs (they
+        are separate _FuncInfos and inherit reachability)."""
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and child is not n:
+                continue
+            yield from own_nodes(child)
+
+    for sub in own_nodes(node):
+        # OCT101 — host syncs
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in _SYNC_METHODS:
+                    emit("OCT101", sub,
+                         f"host-sync `.{f.attr}()` in jit-reachable "
+                         f"`{info.qualname}`")
+                elif isinstance(f.value, ast.Name):
+                    mod = model.module_of_alias(f.value.id)
+                    if mod == "numpy" and f.attr in _SYNC_NUMPY_FNS \
+                            and sub.args and expr_traced(sub.args[0]):
+                        emit("OCT101", sub,
+                             f"`{f.value.id}.{f.attr}` on a traced value "
+                             f"in jit-reachable `{info.qualname}` forces "
+                             "a device->host transfer")
+                    elif mod == "jax" and f.attr in _SYNC_JAX_FNS:
+                        emit("OCT101", sub,
+                             f"`jax.{f.attr}` inside jit-reachable "
+                             f"`{info.qualname}`")
+            elif isinstance(f, ast.Name) and f.id in _SYNC_BUILTINS:
+                if sub.args and expr_traced(sub.args[0]):
+                    emit("OCT101", sub,
+                         f"`{f.id}()` on a traced value in "
+                         f"`{info.qualname}` concretizes the tracer")
+        # OCT102 — Python control flow on traced values. `x is None`
+        # sentinel checks are static at trace time (a tracer is never
+        # None), so identity comparisons are exempt.
+        if isinstance(sub, (ast.If, ast.While)):
+            is_sentinel = isinstance(sub.test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in sub.test.ops
+            )
+            if not is_sentinel and expr_traced(sub.test):
+                kind = "if" if isinstance(sub, ast.If) else "while"
+                emit("OCT102", sub,
+                     f"Python `{kind}` on a traced value in "
+                     f"`{info.qualname}`")
+        # OCT103 — mutable-global reads
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                and sub.id in model.mutable_globals:
+            emit("OCT103", sub,
+                 f"jit-reachable `{info.qualname}` reads mutable "
+                 f"module global `{sub.id}`")
+        # OCT104 — wide int literals
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, int) \
+                and not isinstance(sub.value, bool) \
+                and id(sub) not in dtype_wrapped:
+            if not (-(2 ** 31) <= sub.value < 2 ** 31):
+                emit("OCT104", sub,
+                     f"int literal {sub.value} exceeds int32 in "
+                     f"jit-reachable `{info.qualname}` (widens the lane "
+                     "to 64-bit weak type)")
+    return out
+
+
+def _check_async_locks(model: _ModuleModel, info: _FuncInfo) -> list[Finding]:
+    """OCT105: linear statement-order scan of an `async def` body; a
+    held-lock set is updated on acquire/release calls, and every await
+    with a non-empty set is a finding."""
+    node = info.node
+    if not isinstance(node, ast.AsyncFunctionDef):
+        return []
+    out: list[Finding] = []
+    held: list[str] = []
+
+    def describe(call: ast.Call) -> str | None:
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            return f"{f.value.id}.{f.attr}"
+        return None
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, sub):  # noqa: N802
+            f = sub.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in _LOCK_ACQUIRE:
+                    held.append(describe(sub) or f.attr)
+                elif f.attr in _LOCK_RELEASE and held:
+                    held.pop()
+            self.generic_visit(sub)
+
+        def visit_Await(self, sub):  # noqa: N802
+            # the awaited expression may itself BE the acquire —
+            # process the inner call first, then judge the await
+            inner = sub.value
+            acquiring = (
+                isinstance(inner, ast.Call)
+                and isinstance(inner.func, ast.Attribute)
+                and inner.func.attr in _LOCK_ACQUIRE
+            )
+            if held and not acquiring:
+                sup = model.is_suppressed("OCT105", sub.lineno, node.lineno)
+                out.append(Finding(
+                    "OCT105", model.path, sub.lineno, sub.col_offset,
+                    f"`await` while holding {held[-1]} in "
+                    f"`{info.qualname}` can starve the runtime",
+                    sup,
+                ))
+            self.generic_visit(sub)
+
+        def visit_AsyncFunctionDef(self, sub):  # noqa: N802
+            if sub is node:
+                self.generic_visit(sub)
+
+        def visit_FunctionDef(self, sub):  # noqa: N802
+            pass
+
+    V().visit(node)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_paths(paths: list[str], rel_to: str | None = None) -> list[Finding]:
+    """Lint every package / file in `paths`; returns ALL findings
+    (suppressed ones carry suppressed=True)."""
+    findings: list[Finding] = []
+    for path in paths:
+        pkg = Package(path, rel_to=rel_to)
+        for model in pkg.modules.values():
+            for info in model.functions.values():
+                if info.reachable:
+                    findings.extend(_check_function(pkg, model, info))
+                findings.extend(_check_async_locks(model, info))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    # disambiguate duplicate keys in source order (see Finding.seq)
+    counts: dict[str, int] = {}
+    out: list[Finding] = []
+    for f in findings:
+        base = f"{f.rule}::{f.path}::{f.message}"
+        n = counts.get(base, 0)
+        counts[base] = n + 1
+        out.append(dataclasses.replace(f, seq=n) if n else f)
+    return out
+
+
+def lint_source(source: str, name: str = "<memory>") -> list[Finding]:
+    """Lint a single source string (fixture tests)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, f"{name}.py")
+        with open(p, "w", encoding="utf-8") as f:
+            f.write(source)
+        found = lint_paths([p], rel_to=d)
+    return [dataclasses.replace(f, path=name) for f in found]

@@ -1,0 +1,335 @@
+"""Pass 2 — jaxpr pathology analyzer.
+
+Traces every registered kernel with ABSTRACT inputs (no compile, no
+device) and computes the graph-shape metrics that predict the XLA
+compile-time pathologies this repo has actually hit (the algebraic
+simplifier's circular-simplification loop on the fused
+`verify_praos_core` graph — VERDICT r5 weak #3/#4, the round-5
+eager-only composed smoke):
+
+  mul_chain_depth   longest path of multiply-class primitives
+                    (mul / dot_general) through any SINGLE XLA
+                    computation. Control-flow bodies (while / scan /
+                    cond / pallas_call) are separate computations — the
+                    simplifier rewrites one computation at a time, so a
+                    `fori_loop` FENCES a chain: only the unrolled
+                    segment feeds the rewrite loop. This is the metric
+                    the squaring-chain family trips.
+  op_fanout         max number of consumer equations of one value —
+                    wide fan-out multiplies the simplifier's rewrite
+                    candidates per pass.
+  remat_width       peak number of simultaneously live values over the
+                    jaxpr's own schedule — a proxy for the
+                    rematerialization pressure XLA's scheduler faces.
+  eqns              recursive primitive count (graph size).
+  mul_count         recursive multiply-class primitive count.
+
+`budgets.json` pins a ceiling per registered graph; `check_budgets`
+fails any graph over its ceiling, fencing regressions of the
+simplifier-circular pattern family in CI (tests/test_analysis.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable
+
+# multiply-class primitives: the algebraic simplifier's worst rewrite
+# families (reassociation/distribution) chew on these
+_MUL_PRIMS = {"mul", "dot_general"}
+# call-like primitives whose subjaxprs are separate XLA computations
+_FENCE_PRIMS = {
+    "while", "scan", "cond", "pjit", "closed_call", "core_call",
+    "custom_jvp_call", "custom_vjp_call", "remat", "checkpoint",
+    "pallas_call", "shard_map", "custom_partitioning",
+}
+
+
+@dataclasses.dataclass
+class GraphReport:
+    name: str
+    eqns: int
+    mul_count: int
+    mul_chain_depth: int
+    op_fanout: int
+    remat_width: int
+    computations: int
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        for x in vs:
+            while hasattr(x, "jaxpr"):  # ClosedJaxpr (possibly nested)
+                x = x.jaxpr
+            if hasattr(x, "eqns"):
+                yield x
+
+
+def _analyze(jaxpr, acc: dict) -> int:
+    """One computation: returns its internal max mul-chain depth and
+    folds every metric into `acc`. Recurses into subcomputations, which
+    contribute to the global max but NOT to this computation's chain
+    (they are fences)."""
+    depth: dict[int, int] = {}  # id(var) -> mul-chain depth at that value
+    uses: dict[int, int] = {}
+    last_use: dict[int, int] = {}
+    acc["computations"] += 1
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        acc["eqns"] += 1
+        prim = eqn.primitive.name
+        is_mul = prim in _MUL_PRIMS
+        if is_mul:
+            acc["mul_count"] += 1
+        in_depth = 0
+        for v in eqn.invars:
+            if hasattr(v, "val"):  # Literal
+                continue
+            uses[id(v)] = uses.get(id(v), 0) + 1
+            last_use[id(v)] = i
+            in_depth = max(in_depth, depth.get(id(v), 0))
+        if prim in _FENCE_PRIMS:
+            for sub in _sub_jaxprs(eqn):
+                _analyze(sub, acc)
+            out_depth = 0  # separate computation: the chain is fenced
+        else:
+            out_depth = in_depth + (1 if is_mul else 0)
+        for v in eqn.outvars:
+            depth[id(v)] = out_depth
+        acc["chain"] = max(acc["chain"], out_depth)
+    for v in jaxpr.outvars:
+        if not hasattr(v, "val"):
+            uses[id(v)] = uses.get(id(v), 0) + 1
+            last_use[id(v)] = len(jaxpr.eqns)
+    if uses:
+        acc["fanout"] = max(acc["fanout"], max(uses.values()))
+
+    # remat_width: live-interval sweep over the jaxpr's own order
+    born: dict[int, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            born[id(v)] = i
+    events: list[tuple[int, int]] = []
+    for vid, b in born.items():
+        d = last_use.get(vid, b)
+        events.append((b, 1))
+        events.append((d + 1, -1))
+    live = peak = 0
+    for _, delta in sorted(events):
+        live += delta
+        peak = max(peak, live)
+    acc["width"] = max(acc["width"], peak)
+    return acc["chain"]
+
+
+def analyze_jaxpr(closed_jaxpr, name: str = "graph") -> GraphReport:
+    """Compute the pathology metrics of one traced jaxpr."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    acc = {"eqns": 0, "mul_count": 0, "chain": 0, "fanout": 0,
+           "width": 0, "computations": 0}
+    _analyze(jaxpr, acc)
+    return GraphReport(
+        name=name,
+        eqns=acc["eqns"],
+        mul_count=acc["mul_count"],
+        mul_chain_depth=acc["chain"],
+        op_fanout=acc["fanout"],
+        remat_width=acc["width"],
+        computations=acc["computations"],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kernel registry: every graph the repo dispatches, with the abstract
+# input shapes it is traced at. T (the batch tile) only scales array
+# widths, never graph structure, so a tiny T keeps tracing fast while
+# the metrics match production shapes exactly.
+# ---------------------------------------------------------------------------
+
+_T = 2
+_NB = 2
+_DEPTH = 2
+
+
+def _s(*shape):
+    import jax
+    from jax import numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+
+def _pk_core_args():
+    return (
+        _s(32, _T), _s(32, _T), _s(32, _T), _s(_NB, 128, _T), _s(_T),
+        _s(32, _T), _s(_T), _s(32, _T), _s(32, _T), _s(32, _T),
+        _s(_DEPTH, 32, _T), _s(_NB, 128, _T), _s(_T),
+        _s(32, _T), _s(32, _T), _s(16, _T), _s(32, _T), _s(32, _T),
+        _s(64, _T), _s(32, _T), _s(32, _T),
+    )
+
+
+def _graph_ed_core():
+    from ..ops.pk import verify as pv
+
+    return pv.ed_core, (_s(32, _T), _s(32, _T), _s(_NB, 128, _T), _s(_T))
+
+
+def _graph_kes_core():
+    import functools
+
+    from ..ops.pk import verify as pv
+
+    fn = functools.partial(pv.kes_core, depth=_DEPTH)
+    return fn, (
+        _s(32, _T), _s(_T), _s(32, _T), _s(32, _T), _s(_DEPTH, 32, _T),
+        _s(_NB, 128, _T), _s(_T),
+    )
+
+
+def _graph_vrf_core():
+    from ..ops.pk import verify as pv
+
+    return pv.vrf_core, (
+        _s(32, _T), _s(32, _T), _s(16, _T), _s(32, _T), _s(32, _T)
+    )
+
+
+def _graph_finish_core():
+    from ..ops.pk import verify as pv
+
+    def fn(ed_ok, ed_pt, ed_r, kes_ok, kes_pt, kes_r, vrf_ok, vrf_flat,
+           c, beta, tlo, thi):
+        from ..ops.pk import curve as pc
+
+        def pt(flat):
+            return pc.Point(flat[0:20], flat[20:40], flat[40:60], flat[60:80])
+
+        pts = [pt(vrf_flat[80 * i: 80 * (i + 1)]) for i in range(5)]
+        return pv.finish_core(
+            ed_ok != 0, pt(ed_pt), ed_r, kes_ok != 0, pt(kes_pt), kes_r,
+            vrf_ok != 0, pts, c, beta, tlo, thi,
+        )
+
+    return fn, (
+        _s(_T), _s(80, _T), _s(32, _T), _s(_T), _s(80, _T), _s(32, _T),
+        _s(_T), _s(400, _T), _s(16, _T), _s(64, _T), _s(32, _T), _s(32, _T),
+    )
+
+
+def _graph_verify_praos_core():
+    import functools
+
+    from ..ops.pk import verify as pv
+
+    fn = functools.partial(pv.verify_praos_core, kes_depth=_DEPTH)
+    return fn, _pk_core_args()
+
+
+def _graph_spmd_local():
+    """The per-shard body of parallel/spmd._sharded_verify: the XLA-twin
+    `protocol.batch.verify_praos` plus the verdict collectives, traced
+    under a single-device mesh (collective structure is device-count
+    independent)."""
+    import jax
+    import numpy as np
+    from jax import numpy as jnp
+    from jax.sharding import Mesh
+
+    from ..parallel import spmd
+
+    b = 8
+
+    def u8(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.uint8)
+
+    def u32(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.uint32)
+
+    # flatten_batch order, staged dtypes (protocol/batch.PraosBatch)
+    cols = (
+        u8(b, 32), u8(b, 32), u8(b, 32), u32(b, _NB, 16, 2), _s(b),
+        u8(b, 32), _s(b), u8(b, 32), u8(b, 32), u8(b, 32),
+        u8(b, _DEPTH, 32), u32(b, _NB, 16, 2), _s(b),
+        u8(b, 32), u8(b, 32), u8(b, 16), u8(b, 32), u8(b, 32),
+        u8(b, 64), u8(b, 32), u8(b, 32),
+    )
+    mesh = Mesh(np.asarray(jax.devices("cpu")[:1]), (spmd.BATCH_AXIS,))
+
+    def fn(*cs):
+        return spmd._sharded_verify(mesh, *cs)
+
+    return fn, cols
+
+
+REGISTRY: dict[str, Callable] = {
+    "ed_core": _graph_ed_core,
+    "kes_core": _graph_kes_core,
+    "vrf_core": _graph_vrf_core,
+    "finish_core": _graph_finish_core,
+    "verify_praos_core": _graph_verify_praos_core,
+    "spmd_sharded_verify": _graph_spmd_local,
+}
+
+
+def registered_graphs() -> list[str]:
+    return sorted(REGISTRY)
+
+
+def trace_graph(name: str):
+    import jax
+
+    fn, args = REGISTRY[name]()
+    return jax.make_jaxpr(fn)(*args)
+
+
+def analyze_registered(names: list[str] | None = None) -> list[GraphReport]:
+    reports = []
+    for name in names or registered_graphs():
+        reports.append(analyze_jaxpr(trace_graph(name), name))
+    return reports
+
+
+# ---------------------------------------------------------------------------
+# Budgets
+# ---------------------------------------------------------------------------
+
+_BUDGET_PATH = os.path.join(os.path.dirname(__file__), "budgets.json")
+
+
+def load_budgets(path: str | None = None) -> dict:
+    with open(path or _BUDGET_PATH, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def check_budgets(reports: list[GraphReport],
+                  budgets: dict | None = None) -> list[str]:
+    """-> list of violation strings (empty = all graphs under budget).
+    A graph missing from the budget file is itself a violation: every
+    registered kernel must carry a pinned ceiling."""
+    budgets = budgets if budgets is not None else load_budgets()
+    per_graph = budgets.get("graphs", {})
+    violations = []
+    for r in reports:
+        limits = per_graph.get(r.name)
+        if limits is None:
+            violations.append(
+                f"{r.name}: no budget entry in budgets.json "
+                "(add one to pin this graph)"
+            )
+            continue
+        for metric, ceiling in limits.items():
+            actual = getattr(r, metric, None)
+            if actual is None:
+                violations.append(f"{r.name}: unknown metric {metric!r}")
+            elif actual > ceiling:
+                violations.append(
+                    f"{r.name}: {metric} = {actual} exceeds budget "
+                    f"{ceiling}"
+                )
+    return violations

@@ -249,7 +249,7 @@ def double_scalar_mul_w4(digits_a, pa: Point, digits_b, pb: Point) -> Point:
 _BASE_TABLES: dict[int, np.ndarray] = {}
 
 
-def _base_table(wbits: int) -> np.ndarray:
+def _base_table(wbits: int) -> np.ndarray:  # octlint: disable=OCT103 — append-only host memo of pure table builds; entries never change once written
     if wbits not in _BASE_TABLES:
         windows = 256 // wbits
         tbl = np.zeros((windows, 1 << wbits, 4, fe.NLIMBS), dtype=np.int32)

@@ -24,12 +24,27 @@ from __future__ import annotations
 
 from functools import partial
 
+import inspect
+
 import jax
 import numpy as np
 from jax import numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..protocol import batch as pbatch
+
+# shard_map moved from jax.experimental to the jax top level (and its
+# replication-check kwarg was renamed check_rep -> check_vma) across
+# the jax versions this repo must run under; resolve both at import
+try:
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+_CHECK_KW = (
+    {"check_vma": False}
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else {"check_rep": False}
+)
 
 BATCH_AXIS = "batch"
 
@@ -88,7 +103,7 @@ def _sharded_verify(mesh, *cols):
         return v, ok, first_bad
 
     spec = P(BATCH_AXIS)
-    out = jax.shard_map(
+    out = _shard_map(
         local_step,
         mesh=mesh,
         in_specs=tuple(spec for _ in cols),
@@ -97,7 +112,7 @@ def _sharded_verify(mesh, *cols):
             spec,
             P(),  # first_bad: replicated scalar
         ),
-        check_vma=False,
+        **_CHECK_KW,
     )(*cols)
     return out
 

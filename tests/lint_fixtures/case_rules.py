@@ -1,0 +1,104 @@
+"""octlint fixture: one positive + one suppressed case per AST rule.
+
+NOT a test module (pytest never collects it) and never imported — it
+exists to be linted by tests/test_analysis.py and by
+`python -m ouroboros_consensus_tpu.analysis --paths tests/lint_fixtures`.
+Every unsuppressed line below must fire exactly the rule named in the
+trailing comment; every `# octlint: disable=...` line must not.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+from jax import numpy as jnp
+
+_CACHE: dict = {}
+_CACHE["warm"] = True  # mutated: a real capture hazard
+
+
+@jax.jit
+def oct101_positive(x):
+    y = jnp.sum(x)
+    return float(y)  # fires OCT101 (float() on a traced value)
+
+
+@jax.jit
+def oct101_more(x):
+    host = np.asarray(x)  # fires OCT101 (np.asarray on traced arg)
+    scalar = x.item()  # fires OCT101 (.item() host sync)
+    return host, scalar
+
+
+@jax.jit
+def oct101_suppressed(x):
+    y = jnp.sum(x)
+    return float(y)  # octlint: disable=OCT101 — debug-only path
+
+
+@jax.jit
+def oct102_positive(x):
+    flag = jnp.any(x > 0)
+    if flag:  # fires OCT102 (Python `if` on a traced value)
+        return x + 1
+    return x
+
+
+@jax.jit
+def oct102_suppressed(x):
+    flag = jnp.any(x > 0)
+    if flag:  # octlint: disable=OCT102 — unit-test-only eager helper
+        return x + 1
+    return x
+
+
+@jax.jit
+def oct103_positive(x):
+    return x + len(_CACHE)  # fires OCT103 (mutated module global)
+
+
+@jax.jit
+def oct103_suppressed(x):
+    return x + len(_CACHE)  # octlint: disable=OCT103 — read-only by convention
+
+
+@jax.jit
+def oct104_positive(x):
+    return x & 0xFFFFFFFF  # fires OCT104 (literal wider than int32)
+
+
+@jax.jit
+def oct104_suppressed(x):
+    return x & 0xFFFFFFFF  # octlint: disable=OCT104 — x is int64 here
+
+
+@jax.jit
+def oct104_dtype_wrapped_ok(x):
+    # an explicit dtype constructor documents the width: NOT a finding
+    return x & jnp.uint32(0xFFFFFFFF)
+
+
+class _Lock:
+    def acquire_write(self):
+        return self
+
+    def release_write(self):
+        return None
+
+
+async def oct105_positive(lock: _Lock):
+    lock.acquire_write()
+    await asyncio.sleep(1)  # fires OCT105 (await holding a lock)
+    lock.release_write()
+
+
+async def oct105_suppressed(lock: _Lock):
+    lock.acquire_write()
+    await asyncio.sleep(1)  # octlint: disable=OCT105 — bounded sleep
+    lock.release_write()
+
+
+async def oct105_clean(lock: _Lock):
+    lock.acquire_write()
+    lock.release_write()
+    await asyncio.sleep(1)  # lock released: NOT a finding

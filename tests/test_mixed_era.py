@@ -41,6 +41,9 @@ def test_synthesize_crosses_both_boundaries(chain):
     assert res.final_state.era == 2
 
 
+@pytest.mark.slow  # the device backend's XLA-twin compile is the bulk
+# of this file's wall time; host-vs-native agreement stays default-tier
+# via test_synthesize_crosses_both_boundaries + the five-era tests
 def test_backends_agree(chain):
     path, n = chain
     results = {

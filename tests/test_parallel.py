@@ -67,6 +67,7 @@ def test_mesh_has_8_devices():
     assert len(jax.devices()) == 8
 
 
+@pytest.mark.slow
 def test_sharded_matches_single_device(lview, chain):
     batch = _stage(lview, chain)
     ref = pbatch.run_batch(batch)
@@ -78,6 +79,7 @@ def test_sharded_matches_single_device(lview, chain):
     assert n_ok >= len(chain)  # pad lanes replicate a valid lane
 
 
+@pytest.mark.slow
 def test_sharded_detects_first_failure(lview, chain):
     bad = list(chain)
     # corrupt the KES signature of the header at position 5
@@ -102,6 +104,7 @@ def test_pad_batch_roundtrip(lview, chain):
     np.testing.assert_array_equal(padded.beta[b:], np.repeat(batch.beta[:1], padded.beta.shape[0] - b, axis=0))
 
 
+@pytest.mark.slow
 def test_sharded_backend_through_db_analyser(tmp_path, lview, pools):
     """The PRODUCTION sharded path (VERDICT r2 item 3): synthesize an
     on-disk chain crossing epoch boundaries, then run the real

@@ -22,7 +22,16 @@ import os
 import subprocess
 import sys
 
+import pytest
 
+
+@pytest.mark.slow  # ~4 min of eager op dispatch — the single biggest
+# default-tier cost (VERDICT r5 next #8 wants the tier <480 s). The
+# composed graph keeps default-tier coverage through the octlint golden
+# gate (tests/test_analysis.py pins its chain-depth/structure) and the
+# per-core differentials (test_pk_limbs/test_pk_hashes/test_pk_curve);
+# this lane-for-lane numeric check runs in the slow tier and on TPU
+# sessions.
 def test_composed_pk_smoke_vs_native():
     child = os.path.join(os.path.dirname(__file__), "pk_smoke_child.py")
     proc = subprocess.run(

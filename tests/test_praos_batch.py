@@ -90,6 +90,7 @@ def assert_same(params, ticked, hvs):
     )
 
 
+@pytest.mark.slow
 def test_all_valid(pools, lview):
     hvs = make_chain(8, pools)
     t = ticked_state(lview)
@@ -98,6 +99,7 @@ def test_all_valid(pools, lview):
     assert res.n_valid == 8 and res.error is None
 
 
+@pytest.mark.slow
 def test_bad_kes_sig_midway(pools, lview):
     hvs = make_chain(6, pools)
     bad = hvs[3]
@@ -105,6 +107,7 @@ def test_bad_kes_sig_midway(pools, lview):
     assert_same(PARAMS, ticked_state(lview), hvs)
 
 
+@pytest.mark.slow
 def test_bad_vrf_proof(pools, lview):
     hvs = make_chain(5, pools)
     bad = hvs[2]
@@ -112,6 +115,7 @@ def test_bad_vrf_proof(pools, lview):
     assert_same(PARAMS, ticked_state(lview), hvs)
 
 
+@pytest.mark.slow
 def test_bad_ocert_sigma(pools, lview):
     hvs = make_chain(4, pools)
     bad = hvs[1]
@@ -119,6 +123,7 @@ def test_bad_ocert_sigma(pools, lview):
     assert_same(PARAMS, ticked_state(lview), hvs)
 
 
+@pytest.mark.slow
 def test_unknown_pool(pools, lview):
     stranger = fixtures.make_pool(99, kes_depth=PARAMS.kes_depth)
     hvs = make_chain(3, pools)
@@ -129,6 +134,7 @@ def test_unknown_pool(pools, lview):
     assert_same(PARAMS, ticked_state(lview), hvs)
 
 
+@pytest.mark.slow
 def test_counter_regression(pools, lview):
     # same pool twice: second header reuses a LOWER ocert counter; pick
     # slots the pool actually wins so the counter check is what fires
@@ -150,6 +156,7 @@ def test_counter_regression(pools, lview):
     assert_same(PARAMS, ticked_state(lview), [hv1, hv2])
 
 
+@pytest.mark.slow
 def test_leader_threshold_losers(pools):
     # tiny stake for pool 0 => its VRF values should mostly lose the slot
     lv = fixtures.make_ledger_view(
@@ -160,6 +167,7 @@ def test_leader_threshold_losers(pools):
     assert_same(PARAMS, t, hvs)
 
 
+@pytest.mark.slow
 def test_validate_chain_epoch_segmentation(pools, lview):
     # headers crossing an epoch boundary (epoch_length=50): nonce rotation
     # between segments must match the sequential tick-per-header fold
@@ -325,6 +333,7 @@ def test_split_dispatch_threads_stages_correctly(monkeypatch):
     assert g[6].shape == (1, b) and g[7].shape == (400, b)
 
 
+@pytest.mark.slow
 def test_validate_chain_cross_epoch_pipelining(pools, lview):
     # THREE epoch boundaries with several small batches per epoch and
     # pipeline depth 3: the next epoch's first windows must stage with

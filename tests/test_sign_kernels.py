@@ -20,6 +20,7 @@ def _seeds(n):
     return [rng.integers(0, 256, 32, dtype=np.uint8).tobytes() for _ in range(n)]
 
 
+@pytest.mark.slow
 def test_ed25519_sign_matches_host():
     n = 8
     seeds = _seeds(n)
@@ -30,6 +31,7 @@ def test_ed25519_sign_matches_host():
         assert he.verify(he.secret_to_public(seeds[i]), msgs[i], sigs[i].tobytes())
 
 
+@pytest.mark.slow
 def test_ecvrf_prove_matches_host():
     n = 8
     seeds = _seeds(n)
@@ -41,6 +43,7 @@ def test_ecvrf_prove_matches_host():
         assert betas[i].tobytes() == hv.proof_to_hash(hp)
 
 
+@pytest.mark.slow
 def test_kes_leaf_path_assembles_compact_sum():
     depth = 3
     seeds = _seeds(4)
@@ -80,6 +83,7 @@ def test_scalar_mod_l_ops():
             assert bi.limbs_to_int_np(np.asarray(add[i])) == (x + y) % L
 
 
+@pytest.mark.slow
 def test_synthesizer_device_vrf_span(tmp_path, monkeypatch):
     from ouroboros_consensus_tpu.tools import db_analyser as ana
     from ouroboros_consensus_tpu.tools import db_synthesizer as synth

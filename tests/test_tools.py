@@ -62,6 +62,7 @@ def test_host_revalidation(synth_db, lview):
     assert out.final_state.epoch_nonce == res.final_state.epoch_nonce
 
 
+@pytest.mark.slow
 def test_device_revalidation_matches_host(synth_db, lview):
     path, res = synth_db
     host = db_analyser.revalidate(path, PARAMS, lview, backend="host")

@@ -124,6 +124,7 @@ def _batch_validate(params, lview, nonce, hvs, backend):
     return proto.validate_batch(ticked, hvs, backend=backend)
 
 
+@pytest.mark.slow
 def test_host_device_native_agree(chain):
     params, pool, delegs, lview, nonce, hvs = chain
     assert len(hvs) > 30
@@ -135,6 +136,7 @@ def test_host_device_native_agree(chain):
         assert res.state == host_st, backend
 
 
+@pytest.mark.slow
 def test_wrong_delegate_rejected(chain):
     params, pool, delegs, lview, nonce, hvs = chain
     # find an overlay header and re-forge it with the OTHER delegate

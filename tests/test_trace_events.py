@@ -5,6 +5,8 @@ the Enclose latency brackets around the batch hot path."""
 
 from fractions import Fraction
 
+import pytest
+
 from ouroboros_consensus_tpu.utils import trace as T
 from ouroboros_consensus_tpu.utils.sim import Sim
 
@@ -21,6 +23,7 @@ def _node_with_tracer(tmp_path, name):
 
 def _types(events):
     return [type(e).__name__ for e in events]
+
 
 
 def test_add_block_lifecycle_sequence(tmp_path):
@@ -110,6 +113,7 @@ def test_background_copy_and_gc_events(tmp_path):
     assert sum(e.n_blocks for e in copied) == 4  # k+4 blocks, k stay
 
 
+@pytest.mark.slow
 def test_enclose_brackets_on_batch_path():
     """The stage/dispatch/materialize/epilogue Enclose brackets fire in
     order with durations on the end edges."""
