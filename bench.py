@@ -204,13 +204,30 @@ from bench import BENCH_HEADERS, KES_DEPTH, MAX_BATCH, bench_params, build_or_lo
 from ouroboros_consensus_tpu.tools import db_analyser as ana
 
 path, params, lview = build_or_load_chain()
-def emit(n, best, warm):
+def emit(n, best, warm, attrib=None):
     # write-then-rename so a kill mid-write can't leave torn JSON
     tmp = os.environ["OCT_RESULT"] + ".tmp"
+    row = {"n": n, "best_s": best, "warm_s": warm,
+           "platform": jax.devices()[0].platform}
+    if attrib:
+        row.update(attrib)
     with open(tmp, "w") as f:
-        json.dump({"n": n, "best_s": best, "warm_s": warm,
-                   "platform": jax.devices()[0].platform}, f)
+        json.dump(row, f)
     os.replace(tmp, os.environ["OCT_RESULT"])
+
+def attribution(r):
+    # per-phase wall + device-boundary bytes (collect_phases tracer):
+    # transfer-tax regressions show in the bench trajectory, not only
+    # in ad-hoc profiling
+    if not r.n_windows:
+        return None
+    return {
+        "phases_s": {k: round(v, 2) for k, v in sorted(r.phases.items())},
+        "windows": r.n_windows,
+        "packed_windows": r.packed_windows,
+        "h2d_bytes_per_window": int(r.h2d_bytes / r.n_windows),
+        "d2h_bytes_per_window": int(r.d2h_bytes / r.n_windows),
+    }
 
 # Warm up compiles/cache-loads on the SMALL cached chain when the
 # target is the 1M north star: a full-scale warmup replay would eat the
@@ -240,19 +257,22 @@ best = None
 for _ in range(2):
     t0 = time.monotonic()
     r = ana.revalidate(path, params, lview, backend="device",
-                       validate_all="stream", max_batch=MAX_BATCH)
+                       validate_all="stream", max_batch=MAX_BATCH,
+                       collect_phases=True)
     wall = time.monotonic() - t0
     assert r.error is None and r.n_valid == r.n_blocks
     if best is None or wall < best:
         best = wall
-        emit(r.n_valid, best, warm_s)
+        emit(r.n_valid, best, warm_s, attribution(r))
 """
 
 
-_STALE_CACHE_RE = (
-    "axon format",  # "cached executable is axon format vN, this build is v9"
-    "deserialize failed",
-    "serialized executable is incompatible",
+# the same executable-format rejection patterns the in-process AOT latch
+# keys on (ops/pk/aot.py note_failure — one rejection now disables the
+# remaining aot.load attempts inside the child; this parent-side grep
+# only decides whether to wipe the persistent cache between attempts)
+from ouroboros_consensus_tpu.ops.pk.aot import (  # noqa: E402
+    INCOMPATIBLE_PATTERNS as _STALE_CACHE_RE,
 )
 
 
@@ -428,6 +448,12 @@ def main() -> None:
             "unit": "headers/s",
             "vs_baseline": round(rate / baseline, 2),
         }
+        # per-phase wall + boundary-byte attribution from the child's
+        # best replay (ana.revalidate collect_phases tracer)
+        for k in ("phases_s", "windows", "packed_windows",
+                  "h2d_bytes_per_window", "d2h_bytes_per_window"):
+            if k in device:
+                out[k] = device[k]
     else:
         out = {
             "metric": (

@@ -262,9 +262,65 @@ def _graph_spmd_local():
     mesh = Mesh(np.asarray(jax.devices("cpu")[:1]), (spmd.BATCH_AXIS,))
 
     def fn(*cs):
-        return spmd._sharded_verify(mesh, *cs)
+        return spmd._sharded_verify(mesh, jnp.int32(b), *cs)
 
     return fn, cols
+
+
+def _graph_packed_unpack():
+    """The PRODUCTION packed `unpack` stage
+    (ops/pk/kernels._mk_packed_unpack): protocol/batch.unpack_packed —
+    body-sourced u8 columns -> the 21 staged columns, including the
+    on-device SHA-512 padding, VRF alpha hash and table gathers —
+    CHAINED into staged_to_limb_first, exactly the graph the per-stage
+    jit/AOT executable compiles and dispatches. Traced at a synthetic
+    (non-overlapping-offset) layout — offsets only slide slices, never
+    change graph structure."""
+    import jax
+    from jax import numpy as jnp
+
+    from ..ops.pk import kernels as pk_kernels
+    from ..protocol import batch as pbatch
+
+    b = 4
+    layout = pbatch.PraosPackedLayout(
+        body_len=304, o_issuer=0, o_vrf_vk=32, o_vrf_out=64,
+        o_vrf_proof=128, o_vk_hot=208, o_sigma=240,
+        kes_depth=_DEPTH, slots_per_kes=100, has_nonce=True,
+    )
+
+    def u8(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.uint8)
+
+    args = (
+        u8(b, 304), u8(b, 64), _s(b), u8(8, 32 + 32 * _DEPTH),
+        _s(b), _s(b), _s(b), _s(b), u8(8, 64), u8(32),
+    )
+    return pk_kernels._mk_packed_unpack(layout), args
+
+
+def _graph_verdict_reduce():
+    """The packed D2H reduction (protocol/batch.verdict_reduce,
+    scan=True): verdict-bit packing + the sequential Blake2b nonce scan
+    (ops/blake2b.nonce_fold_scan). The scan body is a separate
+    computation (lax.scan fences the chain)."""
+    import functools
+
+    import jax
+    from jax import numpy as jnp
+
+    from ..protocol import batch as pbatch
+
+    b = 8
+
+    def bl(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.bool_)
+
+    args = (
+        _s(5, b), _s(b, 32), _s(b), _s(),
+        _s(32), bl(), _s(32), bl(),
+    )
+    return functools.partial(pbatch.verdict_reduce, scan=True), args
 
 
 REGISTRY: dict[str, Callable] = {
@@ -274,6 +330,8 @@ REGISTRY: dict[str, Callable] = {
     "finish_core": _graph_finish_core,
     "verify_praos_core": _graph_verify_praos_core,
     "spmd_sharded_verify": _graph_spmd_local,
+    "packed_unpack": _graph_packed_unpack,
+    "verdict_reduce": _graph_verdict_reduce,
 }
 
 

@@ -28,6 +28,19 @@ def limbs_to_int_np(limbs) -> int:
     return sum(int(v) << (BITS * i) for i, v in enumerate(np.asarray(limbs)))
 
 
+def be8_rows(x):
+    """[...] int32 non-negative scalars (< 2^31) -> [..., 8] int32 bytes,
+    the big-endian 8-byte encoding `int.to_bytes(8, "big")` produces.
+    The packed staging contract relies on this matching the host
+    encoders byte-for-byte (OCert signable counters/periods, the VRF
+    alpha slot prefix)."""
+    shifts = jnp.asarray([24, 16, 8, 0], jnp.int32)
+    lo = (x[..., None] >> shifts) & 0xFF
+    return jnp.concatenate(
+        [jnp.zeros((*x.shape, 4), jnp.int32), lo], axis=-1
+    )
+
+
 def bytes_to_limbs(b, n: int):
     """[..., nbytes] little-endian bytes -> [..., n] normalized limbs."""
     b = b.astype(jnp.int32)

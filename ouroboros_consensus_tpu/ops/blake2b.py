@@ -189,6 +189,48 @@ def blake2b_blocks(blocks, nblocks, total_len, digest_size: int = 32):
     return jnp.concatenate(outs, axis=-1)[..., :digest_size]
 
 
+def nonce_fold_scan(etas, within, is_real, ev0, ev0_set, cand0, cand0_set):
+    """Device-side Praos nonce fold: `jax.lax.scan` of the evolving /
+    candidate nonce bookkeeping over a window's per-lane eta values,
+    mirroring protocol/nonces.combine + protocol/praos.reupdate exactly.
+
+    The combine is a NON-associative hash fold (eta' = Blake2b-256(eta ‖
+    v), neutral = identity), so the scan is inherently sequential — but
+    running it on device means `materialize_verdicts` transfers ONE
+    32-byte nonce pair per window instead of the full [B, 32] eta column
+    (protocol/batch.py D2H contract; the host epilogue keeps the exact
+    per-lane fold as the slow path).
+
+      etas     [B, 32] int32 bytes — vrfNonceValue per lane
+      within   [B] bool — slot within the stability window (candidate
+               freezing, Praos.hs:497)
+      is_real  [B] bool — lane < the window's true size (bucket-pad
+               lanes must not fold)
+      ev0, cand0 [32] int32; ev0_set, cand0_set [] bool — the carry-in
+               (set=False encodes the neutral nonce)
+
+    Returns the carry-out (ev, ev_set, cand, cand_set) after folding
+    every real lane in order.
+    """
+
+    def step(carry, x):
+        ev, evs, cand, cands = carry
+        eta_i, w_i, r_i = x
+        h = blake2b_fixed(jnp.concatenate([ev, eta_i], axis=-1), 64, 32)
+        new_ev = jnp.where(evs, h, eta_i)  # combine(neutral, v) = v
+        ev2 = jnp.where(r_i, new_ev, ev)
+        evs2 = evs | r_i
+        upd = r_i & w_i
+        cand2 = jnp.where(upd, ev2, cand)
+        cands2 = cands | upd
+        return (ev2, evs2, cand2, cands2), ()
+
+    carry, _ = lax.scan(
+        step, (ev0, ev0_set, cand0, cand0_set), (etas, within, is_real)
+    )
+    return carry
+
+
 def blake2b_fixed(data_bytes, data_len: int, digest_size: int = 32):
     """Single-block fast path: [..., n] int32 bytes with a STATIC common
     length data_len <= 128 (the KES Merkle-node / nonce-evolution shape).

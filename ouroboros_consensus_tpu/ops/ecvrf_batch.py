@@ -52,6 +52,30 @@ def stage_np(pks: Sequence[bytes], proofs: Sequence[bytes], alphas: Sequence[byt
     return EcvrfBatch(pk, gamma, c, s, alpha)
 
 
+def alpha_from_slots(slot, epoch_nonce):
+    """Device mkInputVRF (Praos/VRF.hs:55-69): Blake2b-256(slot_be8 ‖
+    nonce-bytes), the neutral nonce contributing NO bytes.
+
+    slot: [B] int32 (values < 2^31 — the packed staging gates this);
+    epoch_nonce: [32] byte array, or None for the neutral nonce.
+    Byte-identical to protocol/nonces.mk_input_vrf, so the packed path
+    stages 4 bytes of slot instead of the 32-byte alpha column (and
+    skips one host Blake2b per header)."""
+    from . import bigint as bi
+    from . import blake2b
+
+    b = slot.shape[0]
+    slot_be8 = bi.be8_rows(slot)  # slot < 2^31
+    if epoch_nonce is None:
+        data, n = slot_be8, 8
+    else:
+        nonce_rows = jnp.broadcast_to(
+            jnp.asarray(epoch_nonce).astype(jnp.int32), (b, 32)
+        )
+        data, n = jnp.concatenate([slot_be8, nonce_rows], axis=-1), 40
+    return blake2b.blake2b_fixed(data, n, 32)
+
+
 def elligator2(r):
     """Field element [..., 20] -> Edwards Point. Deterministic map matching
     ops/host/ecvrf.elligator2 exactly (even-x sign convention)."""

@@ -54,6 +54,20 @@ def stage_np(
     return Ed25519Batch(pk, r, s, hblocks, hnblocks)
 
 
+def build_hblocks(r, pk, msg):
+    """Device staging of the challenge-hash input R ‖ A ‖ M for a batch
+    of FIXED-length messages: [..., 32] r/pk byte arrays + [..., M] msg
+    -> (hblocks [..., NB, 16, 2] uint32, hnblocks [...] int32),
+    byte-identical to the blocks `stage_np` pads on host. Used by the
+    packed-staging path (protocol/batch.stage_packed), which ships the
+    raw message columns and moves the SHA padding into the jit."""
+    data = jnp.concatenate(
+        [r.astype(jnp.uint8), pk.astype(jnp.uint8), msg.astype(jnp.uint8)],
+        axis=-1,
+    )
+    return sha512.pad_blocks_fixed(data, 64 + msg.shape[-1])
+
+
 def verify_point(pk, s, hblocks, hnblocks):
     """(ok_pre bool[B], P Point) with P = s·B − h·A.
 

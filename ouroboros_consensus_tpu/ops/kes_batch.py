@@ -65,6 +65,21 @@ def stage_np(
     return KesBatch(vk, period, r, s, vk_leaf, siblings, hblocks, hnblocks)
 
 
+def build_hblocks(r, vk_leaf, body):
+    """Device staging of the KES leaf-signature hash input
+    R ‖ vk_leaf ‖ body for a batch of FIXED-length bodies — the packed
+    H2D contract: the host ships the raw signed header-body column once
+    (no padded block columns, no duplicated R ‖ leaf prefix) and the SHA
+    padding runs inside the jit. Byte-identical to `stage_np`'s blocks
+    on uniform-length bodies."""
+    data = jnp.concatenate(
+        [r.astype(jnp.uint8), vk_leaf.astype(jnp.uint8),
+         body.astype(jnp.uint8)],
+        axis=-1,
+    )
+    return sha512.pad_blocks_fixed(data, 64 + body.shape[-1])
+
+
 def verify(vk, period, r, s, vk_leaf, siblings, hblocks, hnblocks, *, depth: int | None = None):
     """Device kernel -> ok bool[B]. depth defaults to siblings.shape[-2]."""
     ok_pre, p = verify_point(vk, period, s, vk_leaf, siblings, hblocks, hnblocks, depth=depth)

@@ -40,8 +40,41 @@ def aot_dir() -> str:
     return os.path.join(repo, "scripts", "aot_cache")
 
 
+# Error substrings that mean the RUNTIME rejects this cache's executable
+# format wholesale (e.g. "cached executable is axon format vN, this build
+# is v9"). One such rejection predicts the same ~15 s failure for every
+# other entry in the run, so the first one latches a process-wide skip of
+# the AOT load path instead of paying six failed deserializes per bucket
+# (BENCH_r05.json tail; bench.py greps the same patterns in child logs).
+INCOMPATIBLE_PATTERNS = (
+    "axon format",
+    "serialized executable is incompatible",
+    "deserialize failed",
+)
+
+_RUNTIME_REJECTED = False
+
+
+def note_failure(exc: BaseException) -> bool:
+    """Record an AOT load/run failure; latches the process-wide disable
+    when the error says the runtime rejects the executable FORMAT (a
+    per-build property, not a per-entry one). Returns the latch state."""
+    global _RUNTIME_REJECTED
+    msg = str(exc).lower()
+    if not _RUNTIME_REJECTED and any(p in msg for p in INCOMPATIBLE_PATTERNS):
+        import sys
+
+        print(
+            "# pk-aot: runtime rejects this executable format — skipping "
+            "all remaining AOT load attempts this run",
+            file=sys.stderr,
+        )
+        _RUNTIME_REJECTED = True
+    return _RUNTIME_REJECTED
+
+
 def enabled() -> bool:
-    return os.environ.get(_ENABLE_ENV, "1") != "0"
+    return not _RUNTIME_REJECTED and os.environ.get(_ENABLE_ENV, "1") != "0"
 
 
 _SRC_DIGEST: str | None = None
@@ -141,6 +174,7 @@ def load(name: str, b: int, kes_depth: int, tile: int, sig: str):
             import sys
 
             print(f"# pk-aot: load {key} failed: {e!r}", file=sys.stderr)
+            note_failure(e)
             result = None
     _LOADED[key] = result
     return result

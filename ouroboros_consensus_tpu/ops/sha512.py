@@ -117,6 +117,42 @@ def bytes_to_blocks(b):
     return jnp.stack([hi, lo], axis=-1)
 
 
+def pad_blocks_fixed(data, msg_len: int, nb: int | None = None):
+    """Device staging: [..., msg_len] byte array (any int dtype) ->
+    ([..., nb, 16, 2] uint32 words, [...] int32 nblocks).
+
+    Static-length standard SHA-512 padding (0x80, zeros, 128-bit BE bit
+    length) — byte-identical to `pad_messages_np` on a batch of
+    uniform-length messages, but running inside the jit so the host
+    stages the RAW message bytes instead of padded block columns (the
+    packed-staging H2D contract, protocol/batch.stage_packed). The pad
+    tail is a trace-time constant: everything about the layout is static.
+    """
+    assert data.shape[-1] == msg_len
+    k = nblocks_for_len(msg_len)
+    if nb is None:
+        nb = k
+    assert nb >= k
+    batch = data.shape[:-1]
+    pad = np.zeros(nb * BLOCK - msg_len, np.uint8)
+    pad[0] = 0x80
+    tail_end = k * BLOCK - msg_len
+    pad[tail_end - 16 : tail_end] = np.frombuffer(
+        (8 * msg_len).to_bytes(16, "big"), np.uint8
+    )
+    padded = jnp.concatenate(
+        [
+            data.astype(jnp.uint8),
+            jnp.broadcast_to(jnp.asarray(pad), (*batch, pad.shape[0])),
+        ],
+        axis=-1,
+    )
+    words = bytes_to_blocks(
+        padded.reshape(*batch, nb, BLOCK).astype(jnp.int32)
+    )
+    return words, jnp.full(batch, k, jnp.int32)
+
+
 def splice_prefix64(blocks, prefix_bytes):
     """Overwrite the first 64 bytes of block 0 with device-computed data.
 

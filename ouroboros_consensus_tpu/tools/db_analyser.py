@@ -44,6 +44,50 @@ class ValidationResult:
     device_s: float = 0.0  # kernel execution time (device backend)
     error: Exception | None = None
     final_state: PraosState | None = None
+    # filled by collect_phases=True (protocol/batch tracer events):
+    phases: dict | None = None  # per-phase wall s (stage/dispatch/...)
+    h2d_bytes: int = 0  # staged bytes shipped host->device
+    d2h_bytes: int = 0  # verdict/nonce bytes shipped device->host
+    n_windows: int = 0  # dispatched windows
+    packed_windows: int = 0  # windows that staged packed
+
+
+class _PhaseCollector:
+    """Batch tracer aggregating per-phase wall time + boundary bytes
+    (Enclose brackets and TransferEvents from protocol/batch.py).
+    Materialize events arrive from the reader worker thread; the +=
+    updates are GIL-atomic enough for accounting."""
+
+    def __init__(self):
+        from collections import defaultdict
+
+        self.wall = defaultdict(float)
+        self.h2d = 0
+        self.d2h = 0
+        self.windows = 0
+        self.packed = 0
+
+    def __call__(self, ev):
+        from ..utils.trace import EncloseEvent, TransferEvent
+
+        if isinstance(ev, EncloseEvent):
+            if ev.edge == "end":
+                self.wall[ev.label] += ev.duration
+        elif isinstance(ev, TransferEvent):
+            if ev.phase == "dispatch":
+                self.h2d += ev.h2d_bytes
+                self.windows += 1
+                if ev.packed:
+                    self.packed += 1
+            else:
+                self.d2h += ev.d2h_bytes
+
+    def fill(self, res: "ValidationResult") -> None:
+        res.phases = dict(self.wall)
+        res.h2d_bytes = self.h2d
+        res.d2h_bytes = self.d2h
+        res.n_windows = self.windows
+        res.packed_windows = self.packed
 
 
 @dataclass
@@ -228,8 +272,46 @@ def revalidate(
     # distribution from its stake snapshots (view_for_epoch) instead of
     # the constant `lview` — Ledger/SupportsProtocol.hs
     # ledgerViewForecastAt driven from Storage/LedgerDB/Update.hs:115
+    collect_phases: bool = False,  # per-phase wall + H2D/D2H byte
+    # attribution in the result (batch tracer; bench.py json fields)
 ) -> ValidationResult:
     """only-validation analysis: full chain revalidation from genesis.
+
+    collect_phases=True threads a batch tracer through the replay and
+    fills `res.phases` / `res.h2d_bytes` / `res.d2h_bytes` /
+    `res.n_windows` / `res.packed_windows` — the per-phase wall and
+    device-boundary byte attribution the bench json reports.
+    """
+    if collect_phases:
+        coll = _PhaseCollector()
+        prev = pbatch.BATCH_TRACER
+
+        def chained(ev, _prev=prev, _coll=coll):
+            if _prev is not None:
+                _prev(ev)
+            _coll(ev)
+
+        pbatch.set_batch_tracer(chained)
+        try:
+            res = _revalidate_impl(
+                db_path, params, lview, backend, validate_all, max_batch,
+                max_headers, trace, ledger, genesis_state,
+            )
+        finally:
+            pbatch.set_batch_tracer(prev)
+        coll.fill(res)
+        return res
+    return _revalidate_impl(
+        db_path, params, lview, backend, validate_all, max_batch,
+        max_headers, trace, ledger, genesis_state,
+    )
+
+
+def _revalidate_impl(
+    db_path, params, lview, backend, validate_all, max_batch,
+    max_headers, trace, ledger, genesis_state,
+) -> ValidationResult:
+    """The revalidate body (wrapped by `revalidate` for attribution).
 
     backend="device": epoch-segmented batches through the fused kernel
     (further split at max_batch to bound device memory; the jit caches

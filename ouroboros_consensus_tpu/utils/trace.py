@@ -108,6 +108,21 @@ class Enclose:
         return False
 
 
+@dataclass(frozen=True)
+class TransferEvent:
+    """Device-boundary byte accounting for one batch-path phase: H2D
+    staged bytes at dispatch, D2H verdict/nonce bytes at materialize.
+    Emitted through the same batch tracer as the Enclose brackets so
+    bench/profiling runs can report bytes-per-window alongside wall
+    time (protocol/batch.py packed-staging contract)."""
+
+    phase: str  # "dispatch" | "materialize"
+    lanes: int  # padded window size
+    h2d_bytes: int = 0
+    d2h_bytes: int = 0
+    packed: bool = False  # packed staging / packed verdict path
+
+
 # -- the consensus event vocabulary (Tracers' record, condensed) -------------
 
 

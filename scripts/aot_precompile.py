@@ -81,6 +81,36 @@ def staged_sds(params, lview, bucket, rep, sharding):
     ]
 
 
+def packed_sds(params, lview, bucket, rep, sharding):
+    """(layout, unpack-arg SDS list, reduce-arg SDS list) for the PACKED
+    dispatch (the production default), or None when the representative
+    header does not qualify for packed staging."""
+    hvs = [rep] * 8
+    res = pbatch.stage_packed(params, lview, b"\x00" * 32, hvs)
+    if res is None:
+        return None
+    layout, parr = res
+    parr = pbatch.pad_packed_to(parr, bucket)
+
+    def sds(a):
+        a = np.asarray(a)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sharding)
+
+    unpack_in = [sds(c) for c in parr[:10]]  # body .. nonce
+    i32 = np.int32
+    red_in = [
+        jax.ShapeDtypeStruct((5, bucket), i32, sharding=sharding),  # flags
+        jax.ShapeDtypeStruct((32, bucket), i32, sharding=sharding),  # eta
+        sds(parr.within),
+        jax.ShapeDtypeStruct((), i32, sharding=sharding),  # n_real
+        jax.ShapeDtypeStruct((32,), i32, sharding=sharding),  # ev0
+        jax.ShapeDtypeStruct((), np.bool_, sharding=sharding),  # ev0_set
+        jax.ShapeDtypeStruct((32,), i32, sharding=sharding),  # cand0
+        jax.ShapeDtypeStruct((), np.bool_, sharding=sharding),  # cand0_set
+    ]
+    return layout, unpack_in, red_in
+
+
 def compile_stage(name, fn, in_sds, b, manifest):
     sig = aot.sig_of(in_sds)
     path = aot.stage_path(name, b, KES_DEPTH, K.TILE, sig)
@@ -152,6 +182,19 @@ def main():
         compile_stage("finish", K.finish, fin_in, bucket, manifest)
         compile_stage("ed", K.ed_points, ed_in, bucket, manifest)
         compile_stage("kes", kes_fn, kes_in, bucket, manifest)
+        # packed dispatch stages (the production default): unpack
+        # replaces relayout on the packed wire format; reduce packs the
+        # verdict bits and runs the device nonce scan. The crypto stages
+        # above are SHARED between the packed and staged paths.
+        pk = packed_sds(params, lview, bucket, rep, shard)
+        if pk is not None:
+            layout, unpack_in, red_in = pk
+            compile_stage(K.packed_unpack_name(layout),
+                          K._mk_packed_unpack(layout), unpack_in,
+                          bucket, manifest)
+            compile_stage("reduce", K._mk_reduce(True), red_in, bucket,
+                          manifest)
+        # generic-fallback relayout (mixed-layout windows)
         compile_stage("relayout", K.staged_to_limb_first, rel_sds, bucket,
                       manifest)
         with open(manifest_path, "w") as f:

@@ -27,7 +27,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _emit(config: int, metric: str, n: int, device_s: float, baseline_s: float | None):
+def _emit(config: int, metric: str, n: int, device_s: float, baseline_s: float | None,
+          extra: dict | None = None):
     row = {
         "config": config,
         "metric": metric,
@@ -40,6 +41,8 @@ def _emit(config: int, metric: str, n: int, device_s: float, baseline_s: float |
             round(baseline_s / device_s, 2) if device_s and baseline_s else None
         ),
     }
+    if extra:
+        row.update(extra)
     print(json.dumps(row))
     return row
 
@@ -71,14 +74,27 @@ def config1(scale: float, tmp: str):
         path, params, pools, lview, db_synthesizer.ForgeLimit(blocks=n)
     ))
     t0 = time.monotonic()
-    r = db_analyser.revalidate(path, params, lview, backend="device")
+    r = db_analyser.revalidate(path, params, lview, backend="device",
+                               collect_phases=True)
     dev = time.monotonic() - t0
     assert r.error is None and r.n_valid == n
     t0 = time.monotonic()
     rb = db_analyser.revalidate(path, params, lview, backend="native")
     base = time.monotonic() - t0
     assert rb.error is None
-    return _emit(1, "headers revalidated end-to-end", n, dev, base)
+    extra = None
+    if r.n_windows:
+        # per-phase wall attribution + boundary bytes (set_batch_tracer
+        # via collect_phases): the transfer tax is a bench-trajectory
+        # column now, not an ad-hoc profiling artifact
+        extra = {
+            "phases_s": {k: round(v, 2) for k, v in sorted(r.phases.items())},
+            "windows": r.n_windows,
+            "packed_windows": r.packed_windows,
+            "h2d_bytes_per_window": int(r.h2d_bytes / r.n_windows),
+            "d2h_bytes_per_window": int(r.d2h_bytes / r.n_windows),
+        }
+    return _emit(1, "headers revalidated end-to-end", n, dev, base, extra)
 
 
 def _ed25519_inputs(n):

@@ -31,7 +31,7 @@ def main():
     import bench
     from ouroboros_consensus_tpu.protocol import batch as pbatch
     from ouroboros_consensus_tpu.tools import db_analyser as ana
-    from ouroboros_consensus_tpu.utils.trace import EncloseEvent
+    from ouroboros_consensus_tpu.utils.trace import EncloseEvent, TransferEvent
 
     path, params, lview = bench.build_or_load_chain()
     dev = jax.devices()[0]
@@ -39,11 +39,17 @@ def main():
 
     tot = defaultdict(float)
     cnt = defaultdict(int)
+    xfer = defaultdict(int)  # h2d/d2h bytes + packed/generic window counts
 
     def tracer(ev):
         if isinstance(ev, EncloseEvent) and ev.edge == "end":
             tot[ev.label] += ev.duration
             cnt[ev.label] += 1
+        elif isinstance(ev, TransferEvent):
+            xfer["h2d"] += ev.h2d_bytes
+            xfer["d2h"] += ev.d2h_bytes
+            if ev.phase == "dispatch":
+                xfer["packed" if ev.packed else "generic"] += 1
 
     pbatch.set_batch_tracer(tracer)
 
@@ -66,7 +72,7 @@ def main():
             yield hv
 
     for attempt in ("warm", "hot"):
-        tot.clear(); cnt.clear(); stream_s = 0.0
+        tot.clear(); cnt.clear(); xfer.clear(); stream_s = 0.0
         ana._stream_views = lambda imm, res: timed_stream(imm, res)
         t0 = time.monotonic()
         r = ana.revalidate(
@@ -89,6 +95,13 @@ def main():
         other = wall - accounted - stream_s
         print(f"  {'other':12s} {other:8.2f}s          "
               f"({other/wall*100:5.1f}%)")
+        nwin = xfer["packed"] + xfer["generic"]
+        if nwin:
+            print(
+                f"  windows: {nwin} ({xfer['packed']} packed) | "
+                f"H2D {xfer['h2d']/nwin/1e3:.1f} KB/window | "
+                f"D2H {xfer['d2h']/nwin/1e3:.1f} KB/window"
+            )
     pbatch.set_batch_tracer(None)
 
 
