@@ -119,6 +119,25 @@ bool read_bytes_fixed(Cursor& c, uint8_t* dst, size_t want, uint8_t* present) {
     return true;
 }
 
+// bytes of one of two allowed lengths (the 80-byte draft-03 vs 128-byte
+// batch-compatible VRF proof), copied into a want_max-wide zero-padded
+// row; actual length recorded in *len_out
+bool read_bytes_either(Cursor& c, uint8_t* dst, size_t want_a,
+                       size_t want_b, size_t want_max, int64_t* len_out) {
+    int major; uint64_t arg;
+    size_t save = c.off;
+    if (!read_head(c, &major, &arg)) return false;
+    if (major != 2 || (arg != want_a && arg != want_b)) {
+        c.off = save; c.ok = false; return false;
+    }
+    if (!c.need(arg)) return false;
+    memset(dst, 0, want_max);
+    memcpy(dst, c.p + c.off, arg);
+    c.off += arg;
+    *len_out = (int64_t)arg;
+    return true;
+}
+
 // variable-length bytes: record (offset, len), no copy
 bool read_bytes_span(Cursor& c, int64_t* off_out, int64_t* len_out) {
     int major; uint64_t arg;
@@ -168,7 +187,9 @@ int ocx_extract_headers(
     int64_t* block_no, int64_t* slot,
     uint8_t* prev_hash /* n*32 */, uint8_t* has_prev,
     uint8_t* issuer_vk /* n*32 */, uint8_t* vrf_vk /* n*32 */,
-    uint8_t* vrf_output /* n*64 */, uint8_t* vrf_proof /* n*80 */,
+    uint8_t* vrf_output /* n*64 */,
+    uint8_t* vrf_proof /* n*128, zero-padded */,
+    int64_t* vrf_proof_len /* n: 80 (draft-03) or 128 (batch-compat) */,
     int64_t* body_size, uint8_t* body_hash /* n*32 */,
     uint8_t* ocert_vk /* n*32 */, int64_t* ocert_counter,
     int64_t* ocert_kes_period, int64_t* ocert_sigma_off,
@@ -192,7 +213,8 @@ int ocx_extract_headers(
         if (!read_bytes_fixed(c, vrf_vk + 32 * i, 32, nullptr)) return i + 1;
         if (!expect_array(c, &na) || na != 2) return i + 1;
         if (!read_bytes_fixed(c, vrf_output + 64 * i, 64, nullptr)) return i + 1;
-        if (!read_bytes_fixed(c, vrf_proof + 80 * i, 80, nullptr)) return i + 1;
+        if (!read_bytes_either(c, vrf_proof + 128 * i, 80, 128, 128,
+                               &vrf_proof_len[i])) return i + 1;
         if (!read_uint(c, &body_size[i])) return i + 1;
         if (!read_bytes_fixed(c, body_hash + 32 * i, 32, nullptr)) return i + 1;
         if (!expect_array(c, &na) || na != 4) return i + 1;

@@ -856,6 +856,56 @@ extern "C" int oc_ecvrf_verify(const u8 pk[32], const u8 pi[80],
     return 1;
 }
 
+// Batch-compatible ECVRF (PraosBatchCompat shape): pi = Gamma || U || V || s
+// (128 bytes). The challenge is DERIVED from the announced U, V and the two
+// group equations are checked — mirrors ops/host/ecvrf.verify_batch_compat.
+extern "C" int oc_ecvrf_verify_bc(const u8 pk[32], const u8 pi[128],
+                                  const u8* alpha, size_t alen, u8 beta[64]) {
+    init_consts();
+    ge Y, Gamma, U, V;
+    if (!ge_frombytes(&Y, pk)) return 0;
+    if (!ge_frombytes(&Gamma, pi)) return 0;
+    if (!ge_frombytes(&U, pi + 32)) return 0;
+    if (!ge_frombytes(&V, pi + 64)) return 0;
+    const u8* s32 = pi + 96;
+    if (!sc_is_canonical(s32)) return 0;
+    ge H;
+    vrf_hash_to_curve(&H, pk, alpha, alen);
+    u8 henc[32];
+    ge_tobytes(henc, &H);
+    Sha512 ch;
+    ch.init();
+    u8 pre[2] = {VRF_SUITE, 0x02};
+    ch.update(pre, 2);
+    ch.update(henc, 32);
+    ch.update(pi, 96);  // Gamma || U || V announced bytes
+    u8 cd[64];
+    ch.final(cd);
+    u8 c32[32] = {0};
+    memcpy(c32, cd, 16);
+    // s*B - c*Y must equal U; s*H - c*Gamma must equal V
+    ge nY, nG, P;
+    ge_neg(&nY, &Y);
+    ge_double_scalarmult(&P, s32, &GE_B, c32, &nY);
+    if (!ge_eq(&P, &U)) return 0;
+    ge_neg(&nG, &Gamma);
+    ge_double_scalarmult(&P, s32, &H, c32, &nG);
+    if (!ge_eq(&P, &V)) return 0;
+    ge G8;
+    ge_double(&G8, &Gamma);
+    ge_double(&G8, &G8);
+    ge_double(&G8, &G8);
+    u8 g8enc[32];
+    ge_tobytes(g8enc, &G8);
+    Sha512 bh;
+    bh.init();
+    u8 pre3[2] = {VRF_SUITE, 0x03};
+    bh.update(pre3, 2);
+    bh.update(g8enc, 32);
+    bh.final(beta);
+    return 1;
+}
+
 // ===========================================================================
 // CompactSum KES verify — mirrors ops/host/kes.py
 // ===========================================================================
@@ -905,7 +955,9 @@ extern "C" void oc_blake2b(const u8* p, size_t n, u8* out, int outlen) {
 // verify. Emits per-header blake2b("L" ‖ beta) leader values and the
 // vrfNonceValue eta = blake2b(blake2b("N" ‖ beta)) for the nonce fold
 // (Praos/VRF.hs:103,116).
-extern "C" long oc_validate_praos(
+// v2: vrf_proof_len selects the proof format (80 = draft-03, 128 =
+// batch-compatible); oc_validate_praos below keeps the original 80-byte ABI.
+extern "C" long oc_validate_praos2(
     long n,
     const u8* cold_vk,        // n*32
     const u8* ocert_sig,      // n*64
@@ -917,7 +969,8 @@ extern "C" long oc_validate_praos(
     const u8* body,           // flattened signed_bytes
     const long* body_off,     // n+1
     const u8* vrf_vk,         // n*32
-    const u8* vrf_proof,      // n*80
+    const u8* vrf_proof,      // n*vrf_proof_len
+    long vrf_proof_len,       // 80 (draft-03) or 128 (batch-compatible)
     const u8* vrf_alpha,      // n*32
     const u8* vrf_output,     // n*64 (declared beta)
     u8* leader_value,         // out: n*32 blake2b("L" || beta), or NULL
@@ -926,6 +979,10 @@ extern "C" long oc_validate_praos(
 ) {
     size_t kes_siglen = 96 + 32 * (size_t)kes_depth;
     if (fail_kind) *fail_kind = 0;
+    if (vrf_proof_len != 80 && vrf_proof_len != 128) {
+        if (fail_kind) *fail_kind = 3;
+        return n ? 0 : -1;
+    }
     for (long i = 0; i < n; i++) {
         if (!oc_ed25519_verify(cold_vk + 32 * i, ocert_sig + 64 * i,
                                ocert_msg + 48 * i, 48)) {
@@ -940,9 +997,13 @@ extern "C" long oc_validate_praos(
             return i;
         }
         u8 beta[64];
-        if (!oc_ecvrf_verify(vrf_vk + 32 * i, vrf_proof + 80 * i,
-                             vrf_alpha + 32 * i, 32, beta) ||
-            memcmp(beta, vrf_output + 64 * i, 64) != 0) {
+        const u8* pi = vrf_proof + vrf_proof_len * i;
+        int vrf_ok = (vrf_proof_len == 128)
+            ? oc_ecvrf_verify_bc(vrf_vk + 32 * i, pi, vrf_alpha + 32 * i, 32,
+                                 beta)
+            : oc_ecvrf_verify(vrf_vk + 32 * i, pi, vrf_alpha + 32 * i, 32,
+                              beta);
+        if (!vrf_ok || memcmp(beta, vrf_output + 64 * i, 64) != 0) {
             if (fail_kind) *fail_kind = 3;
             return i;
         }
@@ -961,6 +1022,19 @@ extern "C" long oc_validate_praos(
         }
     }
     return -1;
+}
+
+// legacy ABI: fixed 80-byte draft-03 proofs
+extern "C" long oc_validate_praos(
+    long n, const u8* cold_vk, const u8* ocert_sig, const u8* ocert_msg,
+    const u8* kes_vk, const long* kes_t, const u8* kes_sig, long kes_depth,
+    const u8* body, const long* body_off, const u8* vrf_vk,
+    const u8* vrf_proof, const u8* vrf_alpha, const u8* vrf_output,
+    u8* leader_value, u8* eta_out, long* fail_kind) {
+    return oc_validate_praos2(
+        n, cold_vk, ocert_sig, ocert_msg, kes_vk, kes_t, kes_sig, kes_depth,
+        body, body_off, vrf_vk, vrf_proof, 80, vrf_alpha, vrf_output,
+        leader_value, eta_out, fail_kind);
 }
 
 // ===========================================================================
@@ -1171,6 +1245,59 @@ extern "C" void oc_ecvrf_prove(const u8 seed[32], const u8* alpha, size_t alen,
     memcpy(pi, genc, 32);
     memcpy(pi + 32, cd, 16);
     sc_muladd(pi + 48, c32, x, k);
+}
+
+// batch-compatible prove: pi = Gamma || U || V || s (128 bytes); same
+// transcript as oc_ecvrf_prove, announced points instead of the challenge
+extern "C" void oc_ecvrf_prove_bc(const u8 seed[32], const u8* alpha,
+                                  size_t alen, u8 pi[128]) {
+    init_consts();
+    u8 h[64];
+    sha512(seed, 32, h);
+    u8 x[32];
+    memcpy(x, h, 32);
+    clamp_scalar(x);
+    ge A;
+    ge_scalarmult(&A, x, &GE_B);
+    u8 pk[32];
+    ge_tobytes(pk, &A);
+    ge H;
+    vrf_hash_to_curve(&H, pk, alpha, alen);
+    u8 henc[32];
+    ge_tobytes(henc, &H);
+    ge Gamma;
+    ge_scalarmult(&Gamma, x, &H);
+    Sha512 hn;
+    hn.init();
+    hn.update(h + 32, 32);
+    hn.update(henc, 32);
+    u8 nd[64];
+    hn.final(nd);
+    u8 k[32];
+    sc_reduce(k, nd, 64);
+    ge U, V;
+    ge_scalarmult(&U, k, &GE_B);
+    ge_scalarmult(&V, k, &H);
+    u8 genc[32], uenc[32], venc[32];
+    ge_tobytes(genc, &Gamma);
+    ge_tobytes(uenc, &U);
+    ge_tobytes(venc, &V);
+    Sha512 ch;
+    ch.init();
+    u8 pre[2] = {VRF_SUITE, 0x02};
+    ch.update(pre, 2);
+    ch.update(henc, 32);
+    ch.update(genc, 32);
+    ch.update(uenc, 32);
+    ch.update(venc, 32);
+    u8 cd[64];
+    ch.final(cd);
+    u8 c32[32] = {0};
+    memcpy(c32, cd, 16);
+    memcpy(pi, genc, 32);
+    memcpy(pi + 32, uenc, 32);
+    memcpy(pi + 64, venc, 32);
+    sc_muladd(pi + 96, c32, x, k);
 }
 
 extern "C" int oc_ecvrf_proof_to_hash(const u8 pi[80], u8 beta[64]) {

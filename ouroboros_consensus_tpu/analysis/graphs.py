@@ -231,6 +231,72 @@ def _graph_verify_praos_core():
     return fn, _pk_core_args()
 
 
+def _pk_core_args_bc():
+    # batch-compatible composed shapes: vrf_c [16, T] is replaced by the
+    # announced u, v [32, T] columns
+    return (
+        _s(32, _T), _s(32, _T), _s(32, _T), _s(_NB, 128, _T), _s(_T),
+        _s(32, _T), _s(_T), _s(32, _T), _s(32, _T), _s(32, _T),
+        _s(_DEPTH, 32, _T), _s(_NB, 128, _T), _s(_T),
+        _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T),
+        _s(32, _T),
+        _s(64, _T), _s(32, _T), _s(32, _T),
+    )
+
+
+def _graph_vrf_bc_core():
+    from ..ops.pk import verify as pv
+
+    return pv.vrf_core_bc, (
+        _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T),
+        _s(32, _T),
+    )
+
+
+def _graph_verify_praos_core_bc():
+    import functools
+
+    from ..ops.pk import verify as pv
+
+    fn = functools.partial(pv.verify_praos_core_bc, kes_depth=_DEPTH)
+    return fn, _pk_core_args_bc()
+
+
+def _graph_msm():
+    """One Pippenger MSM (ops/pk/msm.py) at a tiny lane count: the
+    fori-fenced scans keep the chain depth flat in N, so tiny shapes pin
+    the same structure the bench-scale aggregate dispatches."""
+    import functools
+
+    from ..ops.pk import curve as pc
+    from ..ops.pk import msm as pk_msm
+
+    n = 4
+
+    def fn(scalars, x, y, z, t):
+        return pk_msm.msm(scalars, pc.Point(x, y, z, t), 256)
+
+    return fn, (_s(20, n), _s(20, n), _s(20, n), _s(20, n), _s(20, n))
+
+
+def _graph_aggregate_core():
+    """The full aggregated window program (ops/pk/aggregate.py): cheap
+    per-lane work + Fiat–Shamir coefficients + the two-group MSM."""
+    import functools
+
+    from ..ops.pk import aggregate as pk_aggregate
+
+    fn = functools.partial(pk_aggregate.aggregate_window, kes_depth=_DEPTH)
+    return fn, (
+        _s(32, _T), _s(32, _T), _s(32, _T), _s(_NB, 128, _T), _s(1, _T),
+        _s(32, _T), _s(1, _T), _s(32, _T), _s(32, _T), _s(32, _T),
+        _s(_DEPTH, 32, _T), _s(_NB, 128, _T), _s(1, _T),
+        _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T),
+        _s(32, _T),
+        _s(64, _T), _s(32, _T), _s(32, _T),
+    )
+
+
 def _graph_spmd_local():
     """The per-shard body of parallel/spmd._sharded_verify: the XLA-twin
     `protocol.batch.verify_praos` plus the verdict collectives, traced
@@ -327,8 +393,12 @@ REGISTRY: dict[str, Callable] = {
     "ed_core": _graph_ed_core,
     "kes_core": _graph_kes_core,
     "vrf_core": _graph_vrf_core,
+    "vrf_bc_core": _graph_vrf_bc_core,
     "finish_core": _graph_finish_core,
     "verify_praos_core": _graph_verify_praos_core,
+    "verify_praos_core_bc": _graph_verify_praos_core_bc,
+    "msm": _graph_msm,
+    "aggregate_core": _graph_aggregate_core,
     "spmd_sharded_verify": _graph_spmd_local,
     "packed_unpack": _graph_packed_unpack,
     "verdict_reduce": _graph_verdict_reduce,

@@ -37,7 +37,7 @@ class HeaderBody:
     issuer_vk: bytes  # 32 — cold key
     vrf_vk: bytes  # 32
     vrf_output: bytes  # 64 — certified output beta
-    vrf_proof: bytes  # 80 — ECVRF proof pi
+    vrf_proof: bytes  # ECVRF proof pi: 80 (draft-03) or 128 (batch-compat)
     body_size: int
     body_hash: bytes  # 32
     ocert: OCert

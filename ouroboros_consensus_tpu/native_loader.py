@@ -52,7 +52,7 @@ def load():
     lib.ocx_extract_headers.argtypes = [
         ctypes.c_char_p, ctypes.c_size_t,  # buf, len
         ctypes.c_void_p, ctypes.c_int,  # offsets, n
-        *([ctypes.c_void_p] * 21),
+        *([ctypes.c_void_p] * 22),
     ]
     lib.ocx_crc32_first_bad.restype = ctypes.c_int64
     lib.ocx_crc32_first_bad.argtypes = [
@@ -185,6 +185,21 @@ def load_crypto():
         [ctypes.c_long] + [ctypes.c_void_p] * 6 + [ctypes.c_long]
         + [ctypes.c_void_p] * 8 + [ctypes.POINTER(ctypes.c_long)]
     )
+    lib.oc_validate_praos2.restype = ctypes.c_long
+    lib.oc_validate_praos2.argtypes = (
+        [ctypes.c_long] + [ctypes.c_void_p] * 6 + [ctypes.c_long]
+        + [ctypes.c_void_p] * 4 + [ctypes.c_long]
+        + [ctypes.c_void_p] * 4 + [ctypes.POINTER(ctypes.c_long)]
+    )
+    lib.oc_ecvrf_verify_bc.restype = ctypes.c_int
+    lib.oc_ecvrf_verify_bc.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p,
+    ]
+    lib.oc_ecvrf_prove_bc.restype = None
+    lib.oc_ecvrf_prove_bc.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+    ]
     lib.oc_ed25519_public.restype = None
     lib.oc_ed25519_public.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     lib.oc_ed25519_sign.restype = None
@@ -229,6 +244,16 @@ def native_ecvrf_prove(seed: bytes, alpha: bytes) -> bytes | None:
     return out.raw
 
 
+def native_ecvrf_prove_bc(seed: bytes, alpha: bytes) -> bytes | None:
+    """128-byte batch-compatible proof (Gamma ‖ U ‖ V ‖ s), or None."""
+    lib = load_crypto()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(128)
+    lib.oc_ecvrf_prove_bc(seed, alpha, len(alpha), out)
+    return out.raw
+
+
 def native_ed25519_verify(pk: bytes, sig: bytes, msg: bytes) -> bool:
     lib = load_crypto()
     assert lib is not None
@@ -236,11 +261,14 @@ def native_ed25519_verify(pk: bytes, sig: bytes, msg: bytes) -> bool:
 
 
 def native_ecvrf_verify(pk: bytes, pi: bytes, alpha: bytes):
-    """beta bytes or None."""
+    """beta bytes or None; proof format discriminated by length."""
     lib = load_crypto()
     assert lib is not None
     beta = ctypes.create_string_buffer(64)
-    ok = lib.oc_ecvrf_verify(pk, pi, alpha, len(alpha), beta)
+    if len(pi) == 128:
+        ok = lib.oc_ecvrf_verify_bc(pk, pi, alpha, len(alpha), beta)
+    else:
+        ok = lib.oc_ecvrf_verify(pk, pi, alpha, len(alpha), beta)
     return beta.raw if ok else None
 
 
@@ -261,13 +289,14 @@ def native_validate_praos(
     body: bytes,            # flattened signed_bytes
     body_off: np.ndarray,   # [n+1] int64
     vrf_vk: np.ndarray,     # [n, 32]
-    vrf_proof: np.ndarray,  # [n, 80]
+    vrf_proof: np.ndarray,  # [n, 80] draft-03 or [n, 128] batch-compatible
     vrf_alpha: np.ndarray,  # [n, 32]
     vrf_output: np.ndarray, # [n, 64]
     want_leader_values: bool = True,
 ):
     """(first_bad_index or -1, fail_kind 0|1:ocert|2:kes|3:vrf,
-    leader_values [n, 32] or None, etas [n, 32] or None)."""
+    leader_values [n, 32] or None, etas [n, 32] or None). The VRF proof
+    format is discriminated by the column width."""
     lib = load_crypto()
     assert lib is not None
     n = len(cold_vk)
@@ -285,18 +314,23 @@ def native_validate_praos(
         np.ascontiguousarray(kes_t, np.int64),
         np.ascontiguousarray(kes_sig, np.uint8),
     ]
+    proof = np.ascontiguousarray(vrf_proof, np.uint8)
+    proof_len = int(proof.shape[-1]) if proof.ndim == 2 else 80
     tail = [
         np.ascontiguousarray(vrf_vk, np.uint8),
-        np.ascontiguousarray(vrf_proof, np.uint8),
+        proof,
+    ]
+    tail2 = [
         np.ascontiguousarray(vrf_alpha, np.uint8),
         np.ascontiguousarray(vrf_output, np.uint8),
     ]
     boff = np.ascontiguousarray(body_off, np.int64)
     body_arr = np.frombuffer(body, np.uint8) if body else np.zeros(1, np.uint8)
     kind = ctypes.c_long(0)
-    rc = lib.oc_validate_praos(
+    rc = lib.oc_validate_praos2(
         n, *[ptr(a) for a in arrs], kes_depth,
-        ptr(body_arr), ptr(boff), *[ptr(a) for a in tail], ptr(lv), ptr(eta),
+        ptr(body_arr), ptr(boff), *[ptr(a) for a in tail], proof_len,
+        *[ptr(a) for a in tail2], ptr(lv), ptr(eta),
         ctypes.byref(kind),
     )
     return int(rc), int(kind.value), lv, eta
@@ -324,7 +358,8 @@ class HeaderColumns:
     issuer_vk: np.ndarray  # [n, 32]
     vrf_vk: np.ndarray  # [n, 32]
     vrf_output: np.ndarray  # [n, 64]
-    vrf_proof: np.ndarray  # [n, 80]
+    vrf_proof: np.ndarray  # [n, 128] zero-padded to the widest format
+    vrf_proof_len: np.ndarray  # [n] int64 — 80 (draft-03) or 128 (bc)
     body_size: np.ndarray  # [n] int64
     body_hash: np.ndarray  # [n, 32]
     ocert_vk: np.ndarray  # [n, 32]
@@ -351,7 +386,8 @@ def extract_headers(buf: bytes, offsets: np.ndarray) -> HeaderColumns | None:
     cols = dict(
         block_no=i64(), slot=i64(), prev_hash=u8(32),
         has_prev=np.zeros(n, np.uint8), issuer_vk=u8(32), vrf_vk=u8(32),
-        vrf_output=u8(64), vrf_proof=u8(80), body_size=i64(),
+        vrf_output=u8(64), vrf_proof=u8(128), vrf_proof_len=i64(),
+        body_size=i64(),
         body_hash=u8(32), ocert_vk=u8(32), ocert_counter=i64(),
         ocert_kes_period=i64(),
     )
@@ -369,6 +405,7 @@ def extract_headers(buf: bytes, offsets: np.ndarray) -> HeaderColumns | None:
         ptr(cols["prev_hash"]), ptr(cols["has_prev"]),
         ptr(cols["issuer_vk"]), ptr(cols["vrf_vk"]),
         ptr(cols["vrf_output"]), ptr(cols["vrf_proof"]),
+        ptr(cols["vrf_proof_len"]),
         ptr(cols["body_size"]), ptr(cols["body_hash"]),
         ptr(cols["ocert_vk"]), ptr(cols["ocert_counter"]),
         ptr(cols["ocert_kes_period"]), ptr(sig_off), ptr(sig_len),

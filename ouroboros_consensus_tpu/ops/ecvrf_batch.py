@@ -37,18 +37,46 @@ class EcvrfBatch(NamedTuple):
     alpha: np.ndarray  # [B, 32] uint8
 
 
-def stage_np(pks: Sequence[bytes], proofs: Sequence[bytes], alphas: Sequence[bytes]) -> EcvrfBatch:
+class EcvrfBcBatch(NamedTuple):
+    """Batch-compatible (128-byte) proof staging: the proof announces
+    U, V instead of the challenge; c is derived ON DEVICE from the
+    announced bytes (derive_c_bc)."""
+
+    pk: np.ndarray  # [B, 32] uint8
+    gamma: np.ndarray  # [B, 32] uint8
+    u: np.ndarray  # [B, 32] uint8 — announced U = k·B
+    v: np.ndarray  # [B, 32] uint8 — announced V = k·H
+    s: np.ndarray  # [B, 32] uint8
+    alpha: np.ndarray  # [B, 32] uint8
+
+
+def stage_np(
+    pks: Sequence[bytes], proofs: Sequence[bytes], alphas: Sequence[bytes]
+) -> EcvrfBatch | EcvrfBcBatch:
+    """Stage a proof column; the format (80 = draft-03 -> EcvrfBatch,
+    128 = batch-compatible -> EcvrfBcBatch) is read off the proof length
+    and must be uniform across the batch."""
     b = len(pks)
     assert len(proofs) == b and len(alphas) == b
     assert all(len(p) == 32 for p in pks)
-    assert all(len(pi) == 80 for pi in proofs)
     assert all(len(al) == 32 for al in alphas)
+    plen = len(proofs[0]) if proofs else 80
+    assert plen in (80, 128)
+    assert all(len(pi) == plen for pi in proofs)
     pk = np.frombuffer(b"".join(pks), np.uint8).reshape(b, 32).copy()
-    pr = np.frombuffer(b"".join(proofs), np.uint8).reshape(b, 80)
+    pr = np.frombuffer(b"".join(proofs), np.uint8).reshape(b, plen)
+    alpha = np.frombuffer(b"".join(alphas), np.uint8).reshape(b, 32).copy()
     gamma = np.ascontiguousarray(pr[:, :32])
+    if plen == 128:
+        return EcvrfBcBatch(
+            pk, gamma,
+            np.ascontiguousarray(pr[:, 32:64]),
+            np.ascontiguousarray(pr[:, 64:96]),
+            np.ascontiguousarray(pr[:, 96:128]),
+            alpha,
+        )
     c = np.ascontiguousarray(pr[:, 32:48])
     s = np.ascontiguousarray(pr[:, 48:80])
-    alpha = np.frombuffer(b"".join(alphas), np.uint8).reshape(b, 32).copy()
     return EcvrfBatch(pk, gamma, c, s, alpha)
 
 
@@ -169,17 +197,81 @@ def verify(pk, gamma, c, s, alpha):
 
 
 # ---------------------------------------------------------------------------
+# Batch-compatible (128-byte) proofs: announced U, V; challenge derived
+# ---------------------------------------------------------------------------
+
+
+def derive_c_bc(pk, gamma, u, v, s, alpha):
+    """Stage A of the batch-compatible check: decode/validate + hash-to-
+    curve + the challenge c = SHA-512(suite ‖ 2 ‖ enc(H) ‖ Γ ‖ U ‖ V)[:16]
+    over the ANNOUNCED proof bytes. Returns (ok_pre, c16 int32, H, Y, Γ).
+
+    The announced U, V enter per-lane verification only as bytes: the
+    ladders recompute U' = s·B − c·Y and V' = s·H − c·Γ and the finish
+    compares H(... enc(U') enc(V')) against this c — equal iff the
+    canonical encodings match the announced bytes, so a non-canonical or
+    off-curve U/V can never verify (same compare-on-bytes argument as
+    ed25519_batch.verify_point)."""
+    pk = jnp.asarray(pk).astype(jnp.int32)
+    gamma = jnp.asarray(gamma).astype(jnp.int32)
+    u = jnp.asarray(u).astype(jnp.int32)
+    v = jnp.asarray(v).astype(jnp.int32)
+    s = jnp.asarray(s).astype(jnp.int32)
+    alpha = jnp.asarray(alpha).astype(jnp.int32)
+
+    ok_y, y_pt = curve.decompress(pk)
+    ok_g, g_pt = curve.decompress(gamma)
+    s_ok = scalar.is_canonical32(s)
+    h_pt = hash_to_curve(pk, alpha)
+    h_enc = curve.compress(h_pt)
+    batch = pk.shape[:-1]
+    p2 = jnp.broadcast_to(jnp.asarray([SUITE, 0x02], jnp.int32), (*batch, 2))
+    cdata = jnp.concatenate([p2, h_enc, gamma, u, v], axis=-1)  # 130 B
+    c16 = sha512.sha512_fixed(cdata)[..., :16]
+    return ok_y & ok_g & s_ok, c16, h_pt, y_pt, g_pt
+
+
+def verify_points_bc(pk, gamma, u, v, s, alpha):
+    """(ok_pre, c16, points) with points = (H, Γ, U', V', 8Γ): the same
+    ladder shapes as `verify_points`, driven by the DERIVED challenge."""
+    ok_pre, c16, h_pt, y_pt, g_pt = derive_c_bc(pk, gamma, u, v, s, alpha)
+    s = jnp.asarray(s).astype(jnp.int32)
+    s_digits = scalar.windows4_from_bits(scalar.bits_from_bytes(s, 256))
+    c_digits = scalar.windows4_from_bits(scalar.bits_from_bytes(c16, 128))
+    sb = curve.base_mul_w8(
+        scalar.windows8_from_bits(scalar.bits_from_bytes(s, 256))
+    )
+    u_pt = curve.add(sb, curve.scalar_mul_w4(c_digits, curve.neg(y_pt)))
+    v_pt = curve.double_scalar_mul_w4(
+        s_digits, h_pt, c_digits, curve.neg(g_pt)
+    )
+    g8 = curve.mul_cofactor(g_pt)
+    return ok_pre, c16, (h_pt, g_pt, u_pt, v_pt, g8)
+
+
+def verify_bc(pk, gamma, u, v, s, alpha):
+    """Device kernel -> (ok bool[B], beta): per-lane batch-compatible
+    verify (the aggregate path's fallback semantics, ops/pk/aggregate)."""
+    ok_pre, c16, points = verify_points_bc(pk, gamma, u, v, s, alpha)
+    encs = curve.compress_many(list(points))
+    return finish(ok_pre, c16, encs)
+
+
+# ---------------------------------------------------------------------------
 # Prove side (forging: checkIsLeader VRF evaluation, Praos.hs:375-397)
 # ---------------------------------------------------------------------------
 
 
 def prove(x, prefix, pk, alpha):
-    """Device kernel -> (gamma_enc, c16, s32, beta) int32 byte arrays.
+    """Device kernel -> (gamma_enc, c16, u_enc, v_enc, s32, beta) int32
+    byte arrays — BOTH serializations of the transcript, so one program
+    serves draft-03 (gamma ‖ c ‖ s) and batch-compatible
+    (gamma ‖ u ‖ v ‖ s) staging.
 
-    draft-03 prove with batched curve work: H = h2c(pk, alpha),
-    Γ = x·H, k = SHA512(prefix ‖ H) mod L, c = hash_points(H, Γ, k·B,
-    k·H), s = k + c·x mod L; beta = SHA512(suite ‖ 3 ‖ 8Γ) emitted for
-    the leader check. Mirrors ops/host/ecvrf.prove."""
+    H = h2c(pk, alpha), Γ = x·H, k = SHA512(prefix ‖ H) mod L,
+    c = hash_points(H, Γ, k·B, k·H), s = k + c·x mod L;
+    beta = SHA512(suite ‖ 3 ‖ 8Γ) emitted for the leader check.
+    Mirrors ops/host/ecvrf._prove_parts."""
     from . import bigint as bi
 
     x = jnp.asarray(x).astype(jnp.int32)
@@ -216,18 +308,23 @@ def prove(x, prefix, pk, alpha):
 
     p3 = jnp.broadcast_to(jnp.asarray([SUITE, 0x03], jnp.int32), (*batch, 2))
     beta = sha512.sha512_fixed(jnp.concatenate([p3, g8_enc], axis=-1))
-    return gamma_enc, c16, scalar.to_bytes32(s), beta
+    return gamma_enc, c16, u_enc, v_enc, scalar.to_bytes32(s), beta
 
 
 _PROVE_JIT = None
 
 
-def prove_batch(seeds, alphas):
-    """Host convenience: -> ([B, 80] uint8 proofs, [B, 64] uint8 betas)."""
+def prove_batch(seeds, alphas, batch_compat: bool | None = None):
+    """Host convenience: -> ([B, 80|128] uint8 proofs, [B, 64] betas).
+    batch_compat=None follows the process default (host.fast
+    vrf_batch_compat / OCT_VRF_BATCH)."""
     import jax
 
     from .host import ed25519 as he
+    from .host import fast
 
+    if batch_compat is None:
+        batch_compat = fast.vrf_batch_compat()
     global _PROVE_JIT
     if _PROVE_JIT is None:
         _PROVE_JIT = jax.jit(prove)
@@ -241,23 +338,30 @@ def prove_batch(seeds, alphas):
         prefix[i] = np.frombuffer(pref, np.uint8)
         pk[i] = np.frombuffer(pk_bytes, np.uint8)
     alpha = np.stack([np.frombuffer(a, np.uint8) for a in alphas])
-    g_enc, c16, s32, beta = _PROVE_JIT(x, prefix, pk, alpha)
+    g_enc, c16, u_enc, v_enc, s32, beta = _PROVE_JIT(x, prefix, pk, alpha)
+    if batch_compat:
+        cols = [g_enc, u_enc, v_enc, s32]
+    else:
+        cols = [g_enc, c16, s32]
     proofs = np.concatenate(
-        [np.asarray(g_enc), np.asarray(c16), np.asarray(s32)], axis=-1
+        [np.asarray(col) for col in cols], axis=-1
     ).astype(np.uint8)
     return proofs, np.asarray(beta).astype(np.uint8)
 
 
-_JIT = None
+_JIT: dict = {}
 
 
 def verify_batch(pks, proofs, alphas):
-    """Host convenience: -> (ok [B] bool, beta [B, 64] uint8)."""
-    global _JIT
-    if _JIT is None:
+    """Host convenience: -> (ok [B] bool, beta [B, 64] uint8). Dispatches
+    the per-lane kernel matching the staged proof format."""
+    batch = stage_np(pks, proofs, alphas)
+    key = type(batch).__name__
+    if key not in _JIT:
         import jax
 
-        _JIT = jax.jit(verify)
-    batch = stage_np(pks, proofs, alphas)
-    ok, beta = _JIT(*(jnp.asarray(x) for x in batch))
+        _JIT[key] = jax.jit(
+            verify_bc if isinstance(batch, EcvrfBcBatch) else verify
+        )
+    ok, beta = _JIT[key](*(jnp.asarray(x) for x in batch))
     return np.asarray(ok), np.asarray(beta).astype(np.uint8)

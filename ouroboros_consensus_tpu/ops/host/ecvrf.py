@@ -6,8 +6,20 @@ election. Reference equivalents: the C libsodium fork vendored by
 ouroboros-consensus-protocol/.../Protocol/Praos.hs:543 (verifyCertified)
 and Praos.hs:397 (evalCertified, forging side).
 
-Proof format (80 bytes): Gamma (32) || c (16) || s (32).
-Output (beta) is 64 bytes.
+Proof formats:
+  * draft-03 (80 bytes): Gamma (32) || c (16) || s (32).
+  * batch-compatible (128 bytes): Gamma (32) || U (32) || V (32) || s (32)
+    — the Badertscher–Gaži–Querejeta-Azurmendi–Russell (ESORICS 2022)
+    scheme behind cardano-base's `PraosBatchCompat` VRF: the proof
+    ANNOUNCES the commitment points U = k·B and V = k·H instead of the
+    challenge, the verifier derives c = H(suite ‖ 2 ‖ H ‖ Γ ‖ U ‖ V)
+    from the announced bytes and checks the two group equations
+    U = s·B − c·Y and V = s·H − c·Γ. For an honest prover the two
+    formats carry the same (Γ, s) and yield the same beta; the
+    announced-points form is what makes window-level random-linear-
+    combination aggregation possible (ops/pk/aggregate.py).
+Output (beta) is 64 bytes for both; the format is discriminated by
+proof length everywhere in the framework.
 
 NOTE on conformance: no libsodium test vectors are available in this
 offline environment; this implementation follows draft-03 semantics
@@ -35,12 +47,14 @@ from .ed25519 import (
     point_add,
     point_compress,
     point_decompress,
+    point_equal,
     point_mul,
     point_neg,
 )
 
 SUITE = b"\x04"
 PROOF_BYTES = 80
+PROOF_BYTES_BATCH = 128
 OUTPUT_BYTES = 64
 
 
@@ -120,8 +134,9 @@ def _hash_points(h, gamma, u, v) -> bytes:
 # ---------------------------------------------------------------------------
 
 
-def prove(seed: bytes, alpha: bytes) -> bytes:
-    """Produce an 80-byte proof pi for message alpha under sk seed."""
+def _prove_parts(seed: bytes, alpha: bytes):
+    """Shared prove core -> (gamma, c_bytes, s, u_enc, v_enc): both proof
+    formats are serializations of the same transcript."""
     h = _sha512(seed[:32])
     x = _clamp(h[:32])
     prefix = h[32:]
@@ -131,10 +146,25 @@ def prove(seed: bytes, alpha: bytes) -> bytes:
     gamma = point_mul(x, H)
     # nonce k = SHA512(prefix || H) mod L   (draft-03 section 5.4.2.2)
     k = int.from_bytes(_sha512(prefix + H_enc), "little") % L
-    c_bytes = _hash_points(H, gamma, point_mul(k, B), point_mul(k, H))
+    u = point_mul(k, B)
+    v = point_mul(k, H)
+    c_bytes = _hash_points(H, gamma, u, v)
     c = int.from_bytes(c_bytes, "little")
     s = (k + c * x) % L
+    return gamma, c_bytes, s, point_compress(u), point_compress(v)
+
+
+def prove(seed: bytes, alpha: bytes) -> bytes:
+    """Produce an 80-byte draft-03 proof pi for alpha under sk seed."""
+    gamma, c_bytes, s, _u, _v = _prove_parts(seed, alpha)
     return point_compress(gamma) + c_bytes + int.to_bytes(s, 32, "little")
+
+
+def prove_batch_compat(seed: bytes, alpha: bytes) -> bytes:
+    """128-byte batch-compatible proof: Gamma ‖ U ‖ V ‖ s (the challenge
+    is re-derived by the verifier from the announced U, V)."""
+    gamma, _c, s, u_enc, v_enc = _prove_parts(seed, alpha)
+    return point_compress(gamma) + u_enc + v_enc + int.to_bytes(s, 32, "little")
 
 
 def decode_proof(pi: bytes):
@@ -152,7 +182,9 @@ def decode_proof(pi: bytes):
 
 
 def verify(pk: bytes, pi: bytes, alpha: bytes) -> bytes | None:
-    """Verify proof; return beta (64-byte VRF output) or None."""
+    """Verify proof (either format, by length); return beta or None."""
+    if len(pi) == PROOF_BYTES_BATCH:
+        return verify_batch_compat(pk, pi, alpha)
     y = point_decompress(pk)
     if y is None:
         return None
@@ -166,6 +198,42 @@ def verify(pk: bytes, pi: bytes, alpha: bytes) -> bytes | None:
     V = point_add(point_mul(s, H), point_neg(point_mul(c, gamma)))
     c_prime = _hash_points(H, gamma, U, V)
     if int.from_bytes(c_prime, "little") != c:
+        return None
+    return proof_to_hash(pi)
+
+
+def verify_batch_compat(pk: bytes, pi: bytes, alpha: bytes) -> bytes | None:
+    """Verify a 128-byte batch-compatible proof; return beta or None.
+
+    The challenge is DERIVED from the announced U, V bytes, then the two
+    group equations U = s·B − c·Y and V = s·H − c·Γ are checked — the
+    per-lane form of the aggregated window check (ops/pk/aggregate.py),
+    and the exact reference the fallback path must reproduce."""
+    if len(pi) != PROOF_BYTES_BATCH:
+        return None
+    y = point_decompress(pk)
+    if y is None:
+        return None
+    gamma = point_decompress(pi[:32])
+    u = point_decompress(pi[32:64])
+    v = point_decompress(pi[64:96])
+    if gamma is None or u is None or v is None:
+        return None
+    s = int.from_bytes(pi[96:128], "little")
+    if s >= L:
+        return None
+    H = hash_to_curve(pk, alpha)
+    c_bytes = _sha512(
+        SUITE + b"\x02" + point_compress(H) + pi[:32] + pi[32:64] + pi[64:96]
+    )[:16]
+    c = int.from_bytes(c_bytes, "little")
+    if not point_equal(
+        point_mul(s, B), point_add(u, point_mul(c, y))
+    ):
+        return None
+    if not point_equal(
+        point_mul(s, H), point_add(v, point_mul(c, gamma))
+    ):
         return None
     return proof_to_hash(pi)
 

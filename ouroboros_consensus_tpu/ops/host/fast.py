@@ -37,7 +37,24 @@ def ed25519_public(seed: bytes) -> bytes:
     return _ed25519.secret_to_public(seed)
 
 
+def vrf_batch_compat() -> bool:
+    """OCT_VRF_BATCH (default 1): forge batch-compatible 128-byte ECVRF
+    proofs (Gamma ‖ U ‖ V ‖ s — the aggregatable PraosBatchCompat shape).
+    =0 restores draft-03 80-byte proofs end to end. Read per call so
+    tests can toggle both formats in one process."""
+    import os
+
+    return os.environ.get("OCT_VRF_BATCH", "1") != "0"
+
+
 def ecvrf_prove(seed: bytes, alpha: bytes) -> bytes:
+    """Proof in the configured format (vrf_batch_compat)."""
+    if vrf_batch_compat():
+        if _lib() is not None:
+            from ... import native_loader
+
+            return native_loader.native_ecvrf_prove_bc(seed, alpha)
+        return _ecvrf.prove_batch_compat(seed, alpha)
     if _lib() is not None:
         from ... import native_loader
 

@@ -1,0 +1,234 @@
+"""Window-level random-linear-combination verification (the aggregate
+fast path of the Praos hot loop).
+
+Per lane the reference checks FOUR group equations (all over the same
+base point B and the per-lane variable points):
+
+  ed    (OCert cold-key, Praos.hs:580):  s_e·B − h_e·A_e − R_e = 0
+  kes   (CompactSum leaf, Praos.hs:582): s_k·B − h_k·A_k − R_k = 0
+  vrf U (batch-compat ECVRF):            s_v·B − c·Y − U = 0
+  vrf V (batch-compat ECVRF):            s_v·H − c·Γ − V = 0
+
+With batch-compatible proofs announcing U and V (ops/host/ecvrf
+prove_batch_compat; Badertscher et al., ESORICS 2022 — the scheme of
+cardano-base's PraosBatchCompat), the right-hand sides are all explicit
+points, so a window verifies with ONE random linear combination
+
+  Σ_i  z1·eq_ed + z2·eq_kes + z3·eq_u + z4·eq_v  =  0
+
+checked by a single Pippenger MSM (ops/pk/msm.py) plus one fixed-base
+mul for the collected B coefficient — replacing every per-lane ladder
+(~320 point-ops/lane/ladder) with ~one bucket add per point per window.
+
+The per-lane coefficients (z1..z4) are derived by Fiat–Shamir from the
+LANE's own transcript (SHA-512 over its wire bytes and challenge-hash
+digests, split into four 128-bit chunks), so replay is bit-reproducible
+and the coefficients are invariant under window segmentation/reordering
+(tests/test_aggregate.py pins this).
+
+Soundness shape: on a clean window the combination is EXACTLY the
+identity (every honest point lies in the prime-order subgroup, so the
+mod-L coefficient arithmetic is exact). Any corrupted lane makes the
+aggregate nonzero except with probability ~2^-128 over the
+coefficients, and a nonzero aggregate only ever causes a FALLBACK to
+the unchanged per-lane stage kernels (protocol/batch), which reproduce
+the exact reference error taxonomy lane by lane.
+
+Small-order caveat (the classical cofactorless-batch residual, made
+worse here by DETERMINISTIC coefficients): a signature point offset by
+an 8-torsion component T contributes z·T to the aggregate. Every z is
+forced ODD (coprime to the cofactor), so z·T = 0 iff T = 0 — a single
+tampered lane can never cancel its own torsion, closing the cheapest
+offline grind (flip R by the order-2 point and regrind until z is
+even). An adversary controlling SEVERAL lanes of one window can still
+solve Σ z_i·T_i = 0 across lanes, because the z_i are computable
+offline — so the aggregate is byte-identical to the reference on every
+honestly-signed chain (the replay/bench workload it accelerates), but
+is NOT a cofactor-exact adversarial verifier; `OCT_VRF_AGG=0` selects
+the exact per-lane path where that distinction matters
+(COVERAGE.md records this).
+
+All cheap per-lane work stays per-lane: decompressions (now including
+R_e, R_k, U, V — ~4 extra Shanks chains/lane), hash-to-curve, the
+challenge + beta hashes, the beta compare, Merkle root walk, leader
+range extensions. Pure jnp over the limb-first layout (XLA path; the
+MSM's sorts have no Mosaic lowering — see ops/pk/msm.py docstring).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from jax import numpy as jnp
+
+from . import curve as pc
+from . import hashes as ph
+from . import limbs as fe
+from . import msm
+from . import verify as pv
+
+# domain-separation prefix of the Fiat–Shamir coefficient hash
+_FS_TAG = tuple(b"octRLC-1")
+
+
+class AggregateVerdicts(NamedTuple):
+    """Outputs of one aggregated window (limb-first device arrays)."""
+
+    flags: jnp.ndarray  # [5, T] int32 — same rows as the finish stage,
+    # with the window-wide aggregate verdict folded into the ok rows
+    eta: jnp.ndarray  # [32, T]
+    leader_value: jnp.ndarray  # [32, T]
+    agg_ok: jnp.ndarray  # [] bool — the RLC aggregate was the identity
+    pre_ok: jnp.ndarray  # [] bool — every lane passed its cheap checks
+
+
+def fs_coefficients(ed_r, ed_s, ed_digest, kes_r, kes_s, kes_digest,
+                    gamma, u, v, vrf_s, vrf_pk, alpha, beta_decl):
+    """Per-lane Fiat–Shamir coefficients: SHA-512 over the lane
+    transcript -> four [16, T] little-endian 128-bit chunks.
+
+    The challenge-hash digests bind the verification keys and messages
+    transitively (ed_digest = SHA-512(R‖A‖M)); everything else that
+    enters an equation is bound directly. A function of the LANE only —
+    window segmentation cannot change a lane's coefficients.
+
+    Each coefficient's low bit is FORCED to 1: an odd z is coprime to
+    the curve cofactor, so z·T ≠ 0 for every nonzero 8-torsion T — a
+    tampered lane cannot cancel its own small-order offset no matter
+    how the transcript is ground (module docstring, small-order
+    caveat)."""
+    t = ed_r.shape[-1]
+    data = jnp.concatenate(
+        [ph.const_rows(_FS_TAG, t),
+         ed_r, ed_s, ed_digest, kes_r, kes_s, kes_digest,
+         gamma, u, v, vrf_s, vrf_pk, alpha, beta_decl],
+        axis=0,
+    ).astype(jnp.int32)
+    z = ph.sha512_fixed(data)  # [64, T]
+    z = z.at[0].set(z[0] | 1).at[16].set(z[16] | 1)
+    z = z.at[32].set(z[32] | 1).at[48].set(z[48] | 1)
+    return z[0:16], z[16:32], z[32:48], z[48:64]
+
+
+def _cat_points(points):
+    return pc.Point(*(
+        jnp.concatenate([getattr(p, f) for p in points], axis=-1)
+        for f in ("x", "y", "z", "t")
+    ))
+
+
+def _cat(arrs):
+    return jnp.concatenate(list(arrs), axis=-1)
+
+
+def aggregate_window(
+    ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
+    kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+    kes_hblocks, kes_hnblocks,
+    vrf_pk, vrf_gamma, vrf_u, vrf_v, vrf_s, vrf_alpha,
+    beta_decl, thr_lo, thr_hi,
+    *, kes_depth: int,
+) -> AggregateVerdicts:
+    """Aggregated verification of one window (argument order mirrors
+    ops/pk/kernels.staged_to_limb_first_bc's outputs)."""
+    t = ed_pk.shape[-1]
+
+    # --- per-lane cheap work (decompressions, hashes, Merkle) ----------
+    ok_a, a_pt = pc.decompress(ed_pk)
+    ok_re, re_pt = pc.decompress(ed_r)
+    ed_digest = ph.sha512_var(ed_hblocks, ed_hnblocks[0])
+    h_ed = fe.reduce512(ed_digest)
+    pre_ed = ok_a & ok_re & fe.is_canonical_scalar(ed_s)
+
+    ok_al, al_pt = pc.decompress(kes_vk_leaf)
+    ok_rk, rk_pt = pc.decompress(kes_r)
+    kes_digest = ph.sha512_var(kes_hblocks, kes_hnblocks[0])
+    h_kes = fe.reduce512(kes_digest)
+    period = kes_period[0]
+    root_ok = pv.kes_merkle_ok(kes_vk, period, kes_vk_leaf, kes_siblings,
+                               kes_depth)
+    period_ok = (period >= 0) & (period < (1 << kes_depth))
+    pre_kes = (ok_al & ok_rk & fe.is_canonical_scalar(kes_s)
+               & root_ok & period_ok)
+
+    ok_y, y_pt = pc.decompress(vrf_pk)
+    ok_g, g_pt = pc.decompress(vrf_gamma)
+    ok_u, u_pt = pc.decompress(vrf_u)
+    ok_v, v_pt = pc.decompress(vrf_v)
+    h_pt = pv.hash_to_curve(vrf_pk, vrf_alpha)
+    g8 = pc.mul_cofactor(g_pt)
+    h_enc, g8_enc = pc.compress_many([h_pt, g8])
+    p2 = ph.const_rows([pv.SUITE, 0x02], t)
+    c16 = ph.sha512_fixed(jnp.concatenate(
+        [p2, h_enc, vrf_gamma.astype(jnp.int32), vrf_u.astype(jnp.int32),
+         vrf_v.astype(jnp.int32)], axis=0,
+    ))[:16]
+    p3 = ph.const_rows([pv.SUITE, 0x03], t)
+    beta = ph.sha512_fixed(jnp.concatenate([p3, g8_enc], axis=0))
+    beta_ok = jnp.all(beta == beta_decl.astype(jnp.int32), axis=0)
+    pre_vrf = (ok_y & ok_g & ok_u & ok_v
+               & fe.is_canonical_scalar(vrf_s) & beta_ok)
+
+    # --- leader / nonce range extensions (identical to finish_core) ---
+    beta_i = beta_decl.astype(jnp.int32)
+    tag_l = ph.const_rows([ord("L")], t)
+    lv = ph.blake2b_fixed(jnp.concatenate([tag_l, beta_i], axis=0), 65, 32)
+    tag_n = ph.const_rows([ord("N")], t)
+    eta1 = ph.blake2b_fixed(jnp.concatenate([tag_n, beta_i], axis=0), 65, 32)
+    eta = ph.blake2b_fixed(eta1, 32, 32)
+    certain_win = pv._lt_be(lv, thr_lo.astype(jnp.int32))
+    certain_loss = ~pv._lt_be(lv, thr_hi.astype(jnp.int32))
+    ambiguous = ~certain_win & ~certain_loss
+
+    # --- Fiat–Shamir coefficients and mod-L scalar products ------------
+    z1b, z2b, z3b, z4b = fs_coefficients(
+        ed_r, ed_s, ed_digest, kes_r, kes_s, kes_digest,
+        vrf_gamma, vrf_u, vrf_v, vrf_s, vrf_pk, vrf_alpha, beta_decl,
+    )
+    z1 = fe.bytes_to_limbs(z1b, fe.NLIMBS)
+    z2 = fe.bytes_to_limbs(z2b, fe.NLIMBS)
+    z3 = fe.bytes_to_limbs(z3b, fe.NLIMBS)
+    z4 = fe.bytes_to_limbs(z4b, fe.NLIMBS)
+    c_l = fe.bytes_to_limbs(c16, fe.NLIMBS)
+    s_e = fe.bytes_to_limbs(ed_s.astype(jnp.int32), fe.NLIMBS)
+    s_k = fe.bytes_to_limbs(kes_s.astype(jnp.int32), fe.NLIMBS)
+    s_v = fe.bytes_to_limbs(vrf_s.astype(jnp.int32), fe.NLIMBS)
+
+    # collected B coefficient: z1·s_e + z2·s_k + z3·s_v (mod L), summed
+    # over the whole window
+    sb_scalar = fe.sum_mod_l([
+        fe.mul_mod_l(z1, s_e), fe.mul_mod_l(z2, s_k), fe.mul_mod_l(z3, s_v),
+    ])
+    sb_pt = pc.base_mul_w8(fe.windows8_from_limbs(sb_scalar, 256))
+
+    # MSM groups: raw 128-bit coefficients on the announced points,
+    # full-width mod-L products on the key/commitment points
+    group_small = (
+        _cat([z1, z2, z3, z4]),
+        _cat_points([pc.neg(re_pt), pc.neg(rk_pt), pc.neg(u_pt),
+                     pc.neg(v_pt)]),
+        128,
+    )
+    group_wide = (
+        _cat([
+            fe.mul_mod_l(z1, h_ed), fe.mul_mod_l(z2, h_kes),
+            fe.mul_mod_l(z3, c_l), fe.mul_mod_l(z4, c_l),
+            fe.mul_mod_l(z4, s_v),
+        ]),
+        _cat_points([pc.neg(a_pt), pc.neg(al_pt), pc.neg(y_pt),
+                     pc.neg(g_pt), h_pt]),
+        256,
+    )
+    total = pc.add(msm.msm_groups([group_small, group_wide]), sb_pt)
+    agg_ok = msm.is_identity(total)[0]
+
+    pre_ok = jnp.all(pre_ed) & jnp.all(pre_kes) & jnp.all(pre_vrf)
+    okb = agg_ok[None]
+    flags = jnp.stack([
+        (pre_ed & okb).astype(jnp.int32),
+        (pre_kes & okb).astype(jnp.int32),
+        (pre_vrf & okb).astype(jnp.int32),
+        certain_win.astype(jnp.int32),
+        ambiguous.astype(jnp.int32),
+    ], axis=0)
+    return AggregateVerdicts(flags, eta, lv, agg_ok, pre_ok)

@@ -45,7 +45,39 @@ def identity(t: int) -> Point:
     return Point(fe.zeros(t), fe.ones(t), fe.ones(t), fe.zeros(t))
 
 
+# ---------------------------------------------------------------------------
+# Point-op accounting (scripts/count_point_ops.py): when enabled, every
+# add/double records (invocations, lane-width product) at TRACE time.
+# Loop-fenced ops (lax.fori_loop bodies) trace once, so counts are exact
+# only for fully unrolled programs — the MSM/aggregate path qualifies
+# (python loops + associative structure); the per-lane ladders do not
+# (fori walks) and are counted analytically by the script instead.
+# octlint: disable=OCT103 — trace-time-only accounting, reset per run
+_OPSTATS: dict = {"on": False, "ops": 0, "lane_ops": 0}
+
+
+def op_counter():
+    """Context manager: zero + enable the trace-time point-op counter."""
+
+    class _Ctx:
+        def __enter__(self):
+            _OPSTATS.update(on=True, ops=0, lane_ops=0)
+            return _OPSTATS
+
+        def __exit__(self, *exc):
+            _OPSTATS["on"] = False
+
+    return _Ctx()
+
+
+def _count(width: int, n: int = 1) -> None:
+    if _OPSTATS["on"]:
+        _OPSTATS["ops"] += n
+        _OPSTATS["lane_ops"] += n * int(width)
+
+
 def add(p: Point, q: Point) -> Point:
+    _count(max(p.x.shape[-1], q.x.shape[-1]))
     a = fe.mul(fe.sub(p.y, p.x), fe.sub(q.y, q.x))
     b = fe.mul(fe.add(p.y, p.x), fe.add(q.y, q.x))
     c = fe.mul(fe.mul_small(fe.mul(p.t, q.t), 2), fe.constant(fe.D_INT))
@@ -58,6 +90,7 @@ def add(p: Point, q: Point) -> Point:
 
 
 def double(p: Point) -> Point:
+    _count(p.x.shape[-1])
     a = fe.sqr(p.x)
     b = fe.sqr(p.y)
     c = fe.mul_small(fe.sqr(p.z), 2)
@@ -69,6 +102,7 @@ def double(p: Point) -> Point:
 
 
 def _double_partial(x, y, z):
+    _count(x.shape[-1])
     a = fe.sqr(x)
     b = fe.sqr(y)
     c = fe.mul_small(fe.sqr(z), 2)
@@ -131,6 +165,7 @@ def _build_table16(p: Point) -> list[Point]:
         return nxt, nxt
 
     _, stacked = lax.scan(step, p, None, length=14)  # entries 2P..15P
+    _count(t, 13)  # scan body traced once; 14 adds happen
     return [identity(t), p] + [
         Point(stacked.x[i], stacked.y[i], stacked.z[i], stacked.t[i])
         for i in range(14)
@@ -171,6 +206,7 @@ def scalar_mul_w4(digits_msb, p: Point) -> Point:
         return q, _rotate_up(d)
 
     q, _ = lax.fori_loop(0, k, body, (identity(t), digits_msb))
+    _count(t, (k - 1) * 5)  # 4 doubles + 1 add per window
     return q
 
 
@@ -198,6 +234,7 @@ def double_scalar_mul_w4(da_msb, pa: Point, db_msb, pb: Point) -> Point:
 
     q, da_rot = lax.fori_loop(0, ka - kb, body_a, (identity(t), da_msb))
     q, _, _ = lax.fori_loop(0, kb, body_ab, (q, da_rot, db_msb))
+    _count(t, (ka - kb - 1) * 5 + (kb - 1) * 6)  # bodies traced once
     return q
 
 
@@ -295,7 +332,9 @@ def base_mul_w8(digits_lsb) -> Point:
         dw = lax.dynamic_index_in_dim(digits_lsb, w, axis=0, keepdims=False)
         return add(q, _onehot_lookup(entry, dw))
 
-    return lax.fori_loop(0, tbl.shape[0], body, identity(t))
+    q = lax.fori_loop(0, tbl.shape[0], body, identity(t))
+    _count(t, tbl.shape[0] - 1)  # one table add per window
+    return q
 
 
 # ---------------------------------------------------------------------------

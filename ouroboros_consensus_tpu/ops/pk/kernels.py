@@ -200,6 +200,47 @@ def _unstack_point(flat):
     return pc.Point(flat[0:20], flat[20:40], flat[40:60], flat[60:80])
 
 
+def _vrf_bc_prep_kernel(pk_ref, g_ref, u_ref, v_ref, s_ref, al_ref,
+                        ok_ref, c_ref, pts_ref):
+    # batch-compatible stage A: decompress + hash-to-curve + DERIVED
+    # challenge from the announced U, V bytes (verify.vrf_core_bc_prep);
+    # one extra inversion (compress H) vs the draft-03 prep, no ladders
+    tile = pk_ref.shape[-1]
+    with fe.kernel_consts(tile):
+        ok, c16, h_pt, y_pt, g_pt = pv.vrf_core_bc_prep(
+            pk_ref[:], g_ref[:], u_ref[:], v_ref[:], s_ref[:], al_ref[:]
+        )
+        ok_ref[:] = ok.astype(jnp.int32)[None, :]
+        c_ref[:] = c16
+        pts_ref[:] = jnp.concatenate(
+            [jnp.concatenate([p.x, p.y, p.z, p.t], axis=0)
+             for p in (h_pt, y_pt, g_pt)],
+            axis=0,
+        )
+
+
+def vrf_points_bc(pk, gamma, u, v, s, alpha):
+    """Batch-compatible vrf stage: prep (derived challenge) chained into
+    the UNCHANGED ladder kernel. -> (ok [1, B], c16 [16, B],
+    points [400, B]); the derived c16 feeds the unchanged finish stage."""
+    b = pk.shape[-1]
+    ok, c16, prep = _call(
+        _vrf_bc_prep_kernel, b,
+        [(32,), (32,), (32,), (32,), (32,), (32,)],
+        [(1,), (16,), (240,)],
+        (pk, gamma, u, v, s, alpha),
+        with_base8=False,
+    )
+    (pts,) = _call(
+        _vrf_ladder_kernel, b,
+        [(16,), (32,), (240,)],
+        [(400,)],
+        (c16, s, prep),
+        with_base8=True,
+    )
+    return ok, c16, pts
+
+
 def _finish_kernel(edok_ref, edpt_ref, edr_ref, kesok_ref,
                    kespt_ref, kesr_ref, vrfok_ref, vrfpts_ref, c_ref,
                    beta_ref, tlo_ref, thi_ref, out_ref, eta_ref, lv_ref):
@@ -326,6 +367,34 @@ def staged_to_limb_first(
     )
 
 
+def staged_to_limb_first_bc(
+    ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
+    kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+    kes_hblocks, kes_hnblocks,
+    vrf_pk, vrf_gamma, vrf_u, vrf_v, vrf_s, vrf_alpha,
+    beta, thr_lo, thr_hi,
+):
+    """Batch-compatible relayout twin: 22 staged columns (u, v announced
+    bytes instead of the 16-byte challenge) -> 22 limb-first arrays."""
+    b = beta.shape[0]
+    return (
+        _bf(ed_pk), _bf(ed_r), _bf(ed_s),
+        _bf_blocks(ed_hblocks),
+        jnp.asarray(ed_hnblocks).astype(jnp.int32).reshape(1, b),
+        _bf(kes_vk),
+        jnp.asarray(kes_period).astype(jnp.int32).reshape(1, b),
+        _bf(kes_r), _bf(kes_s), _bf(kes_vk_leaf),
+        jnp.transpose(
+            jnp.asarray(kes_siblings).astype(jnp.int32), (1, 2, 0)
+        ),
+        _bf_blocks(kes_hblocks),
+        jnp.asarray(kes_hnblocks).astype(jnp.int32).reshape(1, b),
+        _bf(vrf_pk), _bf(vrf_gamma), _bf(vrf_u), _bf(vrf_v), _bf(vrf_s),
+        _bf(vrf_alpha),
+        _bf(beta), _bf(thr_lo), _bf(thr_hi),
+    )
+
+
 def verify_praos_staged(
     ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
     kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
@@ -405,13 +474,17 @@ def _stage_call(name, fn, b, kes_depth, *args):
 def split_stage_fns(kes_depth: int):
     """The per-stage jitted callables, keyed for cache warm-up:
     [(name, fn), ...] in dependency order. Used by verify_praos_split
-    and by the bench/session scripts to warm one stage at a time."""
+    and by the bench/session scripts to warm one stage at a time.
+    `relayout_bc`/`vrf_bc` are the batch-compatible-proof twins; ed, kes
+    and finish are SHARED between the two formats (same executables)."""
     return [
         ("relayout", _jit1("relayout", staged_to_limb_first)),
+        ("relayout_bc", _jit1("relayout_bc", staged_to_limb_first_bc)),
         ("ed", _jit1("ed", ed_points)),
         ("kes", _jit1(("kes", kes_depth),
                       functools.partial(kes_points, depth=kes_depth))),
         ("vrf", _jit1("vrf", vrf_points)),
+        ("vrf_bc", _jit1("vrf_bc", vrf_points_bc)),
         ("finish", _jit1("finish", finish)),
     ]
 
@@ -431,7 +504,11 @@ def _mk_packed_unpack(layout):
             layout, body, kes_rs, kt_idx, kt_tab, slot, counter, c0,
             thr_idx, thr_tab, nonce,
         )
-        return staged_to_limb_first(*staged)
+        relayout = (
+            staged_to_limb_first_bc if len(staged) == 22
+            else staged_to_limb_first
+        )
+        return relayout(*staged)
 
     return unpack_limb
 
@@ -486,11 +563,18 @@ def verify_praos_packed_split(
         body, kes_rs, kt_idx, kt_tab, slot, counter, c0,
         thr_idx, thr_tab, nonce,
     )
-    (l_ed_pk, l_ed_r, l_ed_s, l_ed_hb, l_ed_hnb,
-     l_kes_vk, l_kes_per, l_kes_r, l_kes_s, l_kes_leaf, l_kes_sib,
-     l_kes_hb, l_kes_hnb,
-     l_vrf_pk, l_vrf_g, l_vrf_c, l_vrf_s, l_vrf_al,
-     l_beta, l_tlo, l_thi) = a
+    if len(a) == 22:  # batch-compatible proof layout (announced U, V)
+        (l_ed_pk, l_ed_r, l_ed_s, l_ed_hb, l_ed_hnb,
+         l_kes_vk, l_kes_per, l_kes_r, l_kes_s, l_kes_leaf, l_kes_sib,
+         l_kes_hb, l_kes_hnb,
+         l_vrf_pk, l_vrf_g, l_vrf_u, l_vrf_v, l_vrf_s, l_vrf_al,
+         l_beta, l_tlo, l_thi) = a
+    else:
+        (l_ed_pk, l_ed_r, l_ed_s, l_ed_hb, l_ed_hnb,
+         l_kes_vk, l_kes_per, l_kes_r, l_kes_s, l_kes_leaf, l_kes_sib,
+         l_kes_hb, l_kes_hnb,
+         l_vrf_pk, l_vrf_g, l_vrf_c, l_vrf_s, l_vrf_al,
+         l_beta, l_tlo, l_thi) = a
     ed_ok, ed_pt = _stage_call(
         "ed", stages["ed"], b, kes_depth, l_ed_pk, l_ed_s, l_ed_hb, l_ed_hnb
     )
@@ -499,10 +583,16 @@ def verify_praos_packed_split(
         l_kes_vk, l_kes_per, l_kes_s, l_kes_leaf, l_kes_sib,
         l_kes_hb, l_kes_hnb,
     )
-    vrf_ok, vrf_pts = _stage_call(
-        "vrf", stages["vrf"], b, kes_depth,
-        l_vrf_pk, l_vrf_g, l_vrf_c, l_vrf_s, l_vrf_al
-    )
+    if len(a) == 22:
+        vrf_ok, l_vrf_c, vrf_pts = _stage_call(
+            "vrf_bc", stages["vrf_bc"], b, kes_depth,
+            l_vrf_pk, l_vrf_g, l_vrf_u, l_vrf_v, l_vrf_s, l_vrf_al
+        )
+    else:
+        vrf_ok, vrf_pts = _stage_call(
+            "vrf", stages["vrf"], b, kes_depth,
+            l_vrf_pk, l_vrf_g, l_vrf_c, l_vrf_s, l_vrf_al
+        )
     flags, eta, lv = _stage_call(
         "finish", stages["finish"], b, kes_depth,
         ed_ok, ed_pt, l_ed_r, kes_ok, kes_pt, l_kes_r, vrf_ok, vrf_pts,
@@ -551,6 +641,51 @@ def verify_praos_split(
     vrf_ok, vrf_pts = _stage_call(
         "vrf", stages["vrf"], b, kes_depth,
         l_vrf_pk, l_vrf_g, l_vrf_c, l_vrf_s, l_vrf_al
+    )
+    return _stage_call(
+        "finish", stages["finish"], b, kes_depth,
+        ed_ok, ed_pt, l_ed_r, kes_ok, kes_pt, l_kes_r, vrf_ok, vrf_pts,
+        l_vrf_c, l_beta, l_tlo, l_thi,
+    )
+
+
+def verify_praos_split_bc(
+    ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
+    kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+    kes_hblocks, kes_hnblocks,
+    vrf_pk, vrf_gamma, vrf_u, vrf_v, vrf_s, vrf_alpha,
+    beta, thr_lo, thr_hi,
+    *, kes_depth: int,
+):
+    """verify_praos_split for BATCH-COMPATIBLE staged columns: the vrf
+    stage derives the challenge from the announced U, V; ed/kes/finish
+    dispatch the same per-stage jits/AOT executables as draft-03."""
+    stages = dict(split_stage_fns(kes_depth))
+    b = np.asarray(beta).shape[0]
+    a = _stage_call(
+        "relayout_bc", stages["relayout_bc"], b, kes_depth,
+        ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
+        kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+        kes_hblocks, kes_hnblocks,
+        vrf_pk, vrf_gamma, vrf_u, vrf_v, vrf_s, vrf_alpha,
+        beta, thr_lo, thr_hi,
+    )
+    (l_ed_pk, l_ed_r, l_ed_s, l_ed_hb, l_ed_hnb,
+     l_kes_vk, l_kes_per, l_kes_r, l_kes_s, l_kes_leaf, l_kes_sib,
+     l_kes_hb, l_kes_hnb,
+     l_vrf_pk, l_vrf_g, l_vrf_u, l_vrf_v, l_vrf_s, l_vrf_al,
+     l_beta, l_tlo, l_thi) = a
+    ed_ok, ed_pt = _stage_call(
+        "ed", stages["ed"], b, kes_depth, l_ed_pk, l_ed_s, l_ed_hb, l_ed_hnb
+    )
+    kes_ok, kes_pt = _stage_call(
+        "kes", stages["kes"], b, kes_depth,
+        l_kes_vk, l_kes_per, l_kes_s, l_kes_leaf, l_kes_sib,
+        l_kes_hb, l_kes_hnb,
+    )
+    vrf_ok, l_vrf_c, vrf_pts = _stage_call(
+        "vrf_bc", stages["vrf_bc"], b, kes_depth,
+        l_vrf_pk, l_vrf_g, l_vrf_u, l_vrf_v, l_vrf_s, l_vrf_al
     )
     return _stage_call(
         "finish", stages["finish"], b, kes_depth,

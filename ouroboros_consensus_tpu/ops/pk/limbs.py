@@ -462,6 +462,36 @@ def reduce512(digest_bytes):
     return barrett_reduce40(bytes_to_limbs(digest_bytes, 40))
 
 
+def mul_mod_l(a, b):
+    """[20, T] x [20, T] normalized limb scalars (< 2^253) ->
+    [20, T] limbs of a·b mod L (the per-lane coefficient products of the
+    aggregated verifier, ops/pk/aggregate.py)."""
+    prod = _mul_limbs(a, b)  # [40, T] nearly normalized; a·b < 2^506
+    prod, _ = _seq_carry(prod)  # carry cannot leave row 39 (< 2^520)
+    return barrett_reduce40(prod)
+
+
+def sum_mod_l(terms):
+    """Sum a list of [20, T] limb scalars (< L each) over BOTH the list
+    and the lane axis -> [20, 1] limbs < L. Each term's lane sum stays
+    under int32 on its own (13-bit limbs x T ≤ 2^17 lanes < 2^30,
+    asserted), but an UN-normalized cross-term accumulator does not
+    (3 terms x 87k lanes overflows 2^31) — so every term is
+    carry-normalized back to 13-bit rows before the cross-term add,
+    bounding accumulator rows by 2^13·len(terms)."""
+    acc = None
+    for t in terms:
+        assert t.shape[-1] <= 1 << 17, "limb-wise lane sum would overflow int32"
+        s = jnp.sum(t, axis=-1, keepdims=True)
+        wide = jnp.concatenate(
+            [s, jnp.zeros((40 - NLIMBS, 1), jnp.int32)], axis=0
+        )
+        wide, _ = _seq_carry(wide)  # rows < 2^13; total < 2^260 so no
+        acc = wide if acc is None else acc + wide  # carry leaves row 39
+    acc, _ = _seq_carry(acc)
+    return barrett_reduce40(acc)
+
+
 def is_canonical_scalar(s_bytes):
     """s < L for [32, T] LE byte scalars -> bool[T]."""
     s = bytes_to_limbs(s_bytes, 20)

@@ -1,0 +1,245 @@
+"""Limb-first Pippenger-style multi-scalar multiplication (XLA path).
+
+The window-level aggregated verifier (ops/pk/aggregate.py) reduces every
+per-lane ladder of the Praos hot path to ONE multi-scalar multiplication
+    total = Σ_i  k_i · P_i        (N = points-per-lane × lanes)
+whose cost amortizes the ~320 point-ops/lane/ladder of the per-lane
+path down to ~one bucket add per point per window plus a SHARED doubling
+chain (256 doublings TOTAL instead of 256 per lane).
+
+Structure (classic Pippenger, arranged for batch-uniform XLA):
+
+  * scalars split into W c-bit windows (digits [W, N]);
+  * per window, points are grouped by digit with an argsort and the
+    per-digit bucket sums B_d = Σ_{digit=d} P_i come out of a SEGMENT
+    SUM over the sorted order: a chunked inclusive prefix scan
+    (`lax.fori_loop` over the within-chunk axis — the loop body is a
+    separate XLA computation, so the multiply chain is FENCED exactly
+    like the ladder loops remediated in PR 1) + an unrolled log2(C)
+    combine of the chunk carries + one gather at the D digit-boundary
+    positions;
+  * the window value Σ_d d·B_d is the textbook double-accumulator
+    running sum, run as ONE fori_loop over d with the window axis
+    vectorized (all windows of a width-group weighted simultaneously);
+  * windows combine MSB-first with c doublings per step (the shared
+    doubling chain — `fori`-fenced Horner walk).
+
+Point-op work per window ≈ N bucket adds + C chunk combines + 3D
+boundary ops, so a 9-points/lane aggregate over the Praos equations
+costs ≈ (4·⌈128/c⌉ + 5·⌈253/c⌉)·T lane-point-adds — ~5.8x below the
+per-lane ladders at c=8 (scripts/count_point_ops.py measures both).
+
+Everything is pure jnp over the ops/pk limb-first [20, X] layout and
+runs on the XLA path of ops/pk/{limbs,curve} (argsort/gather have no
+Mosaic lowering, and the MSM is a tiny fraction of the aggregate
+program's work, so it is NOT a Pallas kernel by design).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax import numpy as jnp
+
+from . import curve as pc
+from . import limbs as fe
+
+# default window width: D = 256 buckets keeps the boundary-extraction
+# arrays small while the accumulation work is already within ~15% of the
+# c→log2(N) optimum for bench-scale N (see module docstring economics)
+WINDOW_BITS = 8
+# chunk count for the segment scan: C lanes run in parallel, N/C
+# sequential fori steps; 256 balances sequential depth against the
+# width of each vectorized point add at bench-scale N
+CHUNKS = 256
+
+
+def _coords(p: pc.Point):
+    return (p.x, p.y, p.z, p.t)
+
+
+def _point(coords) -> pc.Point:
+    return pc.Point(*coords)
+
+
+def _take(p: pc.Point, idx) -> pc.Point:
+    return _point(tuple(jnp.take(c, idx, axis=-1) for c in _coords(p)))
+
+
+def is_identity(p: pc.Point):
+    """bool[...]: projective identity test (X = 0 and Y = Z)."""
+    return fe.is_zero(p.x) & fe.eq(p.y, p.z)
+
+
+def _segment_scan(p: pc.Point, n: int, chunks: int):
+    """Inclusive prefix point-sums over the (sorted) lane axis, chunked:
+    -> (local [4 coords, 20, C, M], chunk_offsets Point [20, C]) where
+    global_prefix[j] = chunk_offsets[j // M] + local[j // M, j % M].
+
+    The within-chunk walk is ONE fori_loop (M steps, each a [20, C]-wide
+    point add); the cross-chunk exclusive prefix is an unrolled
+    Hillis–Steele over the C chunk totals (log2(C) adds, ~C·log2(C)
+    lane-work — negligible against the N-work main walk)."""
+    m = n // chunks
+    cs = tuple(c.reshape(20, chunks, m) for c in _coords(p))
+
+    def body(j, carry):
+        acc, outs = carry
+        cur = _point(tuple(
+            lax.dynamic_slice(c, (0, 0, j), (20, chunks, 1))[:, :, 0]
+            for c in cs
+        ))
+        acc = pc.add(acc, cur)
+        outs = tuple(
+            lax.dynamic_update_slice(o, a[:, :, None], (0, 0, j))
+            for o, a in zip(outs, _coords(acc))
+        )
+        return acc, outs
+
+    init_outs = tuple(jnp.zeros((20, chunks, m), jnp.int32) for _ in range(4))
+    acc0 = pc.identity(chunks)
+    totals, outs = lax.fori_loop(0, m, body, (acc0, init_outs))
+    pc._count(chunks, m - 1)  # fori body traced once; m runs happen
+
+    # exclusive prefix of the chunk totals: shift right (identity in
+    # front), then inclusive Hillis–Steele
+    ident = pc.identity(1)
+    ex = _point(tuple(
+        jnp.concatenate([i_c, t_c[:, :-1]], axis=-1)
+        for i_c, t_c in zip(_coords(ident), _coords(totals))
+    ))
+    k = 1
+    while k < chunks:
+        shifted = _point(tuple(
+            jnp.concatenate(
+                [jnp.broadcast_to(i_c, (20, k)), c[:, :-k]], axis=-1
+            )
+            for i_c, c in zip(_coords(ident), _coords(ex))
+        ))
+        ex = pc.add(ex, shifted)
+        k *= 2
+    return outs, ex
+
+
+def _window_buckets(p: pc.Point, digits_w, nbuckets: int, chunks: int):
+    """Bucket sums B_d = Σ_{digit_i = d} P_i for ONE window ->
+    Point with [20, D] coords. digits_w: [N] int32 in [0, D)."""
+    n = digits_w.shape[0]
+    chunks = min(chunks, n)
+    m = -(-n // chunks)
+    pad = chunks * m - n
+    if pad:
+        # digit-0 lanes never enter the weighted sum; pad with identity
+        ident = pc.identity(pad)
+        p = _point(tuple(
+            jnp.concatenate([c, ic], axis=-1)
+            for c, ic in zip(_coords(p), _coords(ident))
+        ))
+        digits_w = jnp.concatenate(
+            [digits_w, jnp.zeros((pad,), digits_w.dtype)]
+        )
+        n = n + pad
+
+    perm = jnp.argsort(digits_w)
+    ds = jnp.take(digits_w, perm)
+    sp = _take(p, perm)
+    local, offsets = _segment_scan(sp, n, chunks)
+
+    counts = jnp.zeros((nbuckets,), jnp.int32).at[ds].add(1)
+    cum = jnp.cumsum(counts)
+    idx = jnp.maximum(cum - 1, 0)
+    m_len = n // chunks
+    chunk_of = idx // m_len
+    m_of = idx % m_len
+    local_pt = _point(tuple(c[:, chunk_of, m_of] for c in local))
+    off_pt = _take(offsets, chunk_of)
+    e = pc.add(off_pt, local_pt)
+    e = pc.select(cum > 0, e, pc.identity(nbuckets))
+    prev = _point(tuple(
+        jnp.concatenate([ic, c[:, :-1]], axis=-1)
+        for ic, c in zip(_coords(pc.identity(1)), _coords(e))
+    ))
+    return pc.add(e, pc.neg(prev))  # B_d = E_d − E_{d−1}
+
+
+def _weighted_sums(bucket_stack: pc.Point, nbuckets: int) -> pc.Point:
+    """Σ_d d·B_d per window, windows vectorized: bucket_stack coords
+    [20, D, W] -> Point [20, W]. Double-accumulator running sum as ONE
+    fori_loop from d = D−1 down to 1 (bucket 0 is unweighted)."""
+    w = bucket_stack.x.shape[-1]
+    cs = _coords(bucket_stack)
+
+    def body(i, carry):
+        run, acc = carry
+        d = nbuckets - 1 - i
+        b = _point(tuple(
+            lax.dynamic_slice(c, (0, d, 0), (20, 1, w))[:, 0, :]
+            for c in cs
+        ))
+        run = pc.add(run, b)
+        acc = pc.add(acc, run)
+        return run, acc
+
+    init = (pc.identity(w), pc.identity(w))
+    _, acc = lax.fori_loop(0, nbuckets - 1, body, init)
+    pc._count(w, 2 * (nbuckets - 2))  # 2 adds/step, body traced once
+    return acc
+
+
+def _horner(window_sums: pc.Point, cbits: int) -> pc.Point:
+    """Combine per-window values MSB-first with the SHARED doubling
+    chain: acc = 2^c·acc + S_w, one fori step per window -> [20, 1]."""
+    w = window_sums.x.shape[-1]
+    cs = _coords(window_sums)
+
+    def body(i, acc):
+        wi = w - 1 - i  # MSB window first
+        s = _point(tuple(
+            lax.dynamic_slice(c, (0, wi), (20, 1)) for c in cs
+        ))
+        acc = pc.doubles(acc, cbits)
+        return pc.add(acc, s)
+
+    out = lax.fori_loop(0, w, body, pc.identity(1))
+    pc._count(1, (w - 1) * (cbits + 1))  # body traced once; w runs
+    return out
+
+
+def msm(scalars, p: pc.Point, nbits: int = 256, *,
+        cbits: int = WINDOW_BITS, chunks: int = CHUNKS) -> pc.Point:
+    """Σ_i scalars_i · P_i over the lane axis -> Point with [20, 1]
+    coords. scalars: [20, N] normalized limbs (< 2^nbits); p: Point with
+    [20, N] coords. nbits bounds the window count (128 for the raw
+    Fiat–Shamir coefficients, 256 for mod-L products).
+
+    The per-window bucket phase is ONE lax.scan over the W digit rows —
+    the window bodies are structurally identical, so the scan keeps the
+    traced graph a single window wide (~30x fewer equations than the
+    unrolled form; compile time, not compute, is what this buys)."""
+    assert cbits == 8, "cbits != 8 needs a digit regrouping"
+    digits = fe.windows8_from_limbs(scalars, -(-nbits // 8) * 8)
+    nwin = digits.shape[0]
+    nbuckets = 1 << cbits
+
+    ops0 = dict(pc._OPSTATS)
+
+    def wbody(_, digits_w):
+        b = _window_buckets(p, digits_w, nbuckets, chunks)
+        return 0, _coords(b)
+
+    _, stacked = lax.scan(wbody, 0, digits)  # coords [W, 20, D]
+    if pc._OPSTATS["on"]:  # scan body traced once; nwin windows run
+        for k in ("ops", "lane_ops"):
+            pc._OPSTATS[k] += (nwin - 1) * (pc._OPSTATS[k] - ops0[k])
+    stack = _point(tuple(jnp.moveaxis(c, 0, -1) for c in stacked))
+    sums = _weighted_sums(stack, nbuckets)
+    return _horner(sums, cbits)
+
+
+def msm_groups(groups) -> pc.Point:
+    """Sum of several MSMs with different scalar widths:
+    groups = [(scalars [20, N_g], Point, nbits), ...] -> [20, 1]."""
+    total = pc.identity(1)
+    for scalars, p, nbits in groups:
+        total = pc.add(total, msm(scalars, p, nbits))
+    return total

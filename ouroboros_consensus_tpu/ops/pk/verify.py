@@ -218,6 +218,40 @@ def vrf_core(pk, gamma, c, s, alpha):
     return ok_pre, vrf_core_ladders(c, s, h_pt, y_pt, g_pt)
 
 
+def vrf_core_bc_prep(pk, gamma, u, v, s, alpha):
+    """Stage A for BATCH-COMPATIBLE (128-byte) proofs: decode/validate +
+    hash-to-curve + the challenge c = SHA-512(suite ‖ 2 ‖ enc(H) ‖ Γ ‖
+    U ‖ V)[:16] derived from the ANNOUNCED bytes (one extra inversion to
+    compress H vs vrf_core_prep). Returns (ok_pre, c16 [16, T], H, Y, Γ).
+
+    The ladders (vrf_core_ladders) and finish_core run UNCHANGED on the
+    derived c: finish's c' == c compare then holds iff the recomputed
+    U' = s·B − c·Y and V' = s·H − c·Γ compress to the announced U, V
+    bytes — the compare-on-bytes form of the two batch-compat group
+    equations (ops/ecvrf_batch.derive_c_bc rationale)."""
+    ok_y, y_pt = pc.decompress(pk)
+    ok_g, g_pt = pc.decompress(gamma)
+    s_ok = fe.is_canonical_scalar(s)
+    h_pt = hash_to_curve(pk, alpha)
+    h_enc = pc.compress(h_pt)
+    t = pk.shape[-1]
+    p2 = ph.const_rows([SUITE, 0x02], t)
+    cdata = jnp.concatenate(
+        [p2, h_enc, gamma.astype(jnp.int32), u.astype(jnp.int32),
+         v.astype(jnp.int32)],
+        axis=0,
+    )  # [130, T]
+    c16 = ph.sha512_fixed(cdata)[:16]
+    return ok_y & ok_g & s_ok, c16, h_pt, y_pt, g_pt
+
+
+def vrf_core_bc(pk, gamma, u, v, s, alpha):
+    """(ok_pre[T], c16, (H, Γ, U', V', 8Γ)) — the batch-compat per-lane
+    twin of vrf_core (same ladder stage, derived challenge)."""
+    ok_pre, c16, h_pt, y_pt, g_pt = vrf_core_bc_prep(pk, gamma, u, v, s, alpha)
+    return ok_pre, c16, vrf_core_ladders(c16, s, h_pt, y_pt, g_pt)
+
+
 # ---------------------------------------------------------------------------
 # Finish: shared compression + challenge/beta + leader checks
 # ---------------------------------------------------------------------------
@@ -301,5 +335,32 @@ def verify_praos_core(
         ok_ed_pre, ed_point, ed_r,
         ok_kes_pre, kes_point, kes_r,
         ok_vrf_pre, vrf_points, vrf_c,
+        beta_decl, thr_lo, thr_hi,
+    )
+
+
+def verify_praos_core_bc(
+    ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
+    kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+    kes_hblocks, kes_hnblocks,
+    vrf_pk, vrf_gamma, vrf_u, vrf_v, vrf_s, vrf_alpha,
+    beta_decl, thr_lo, thr_hi,
+    *, kes_depth: int,
+) -> CoreVerdicts:
+    """The composed hot path over BATCH-COMPATIBLE proofs: identical to
+    verify_praos_core except the vrf challenge is derived on device from
+    the announced U, V (vrf_core_bc); ed/kes/finish are byte-identical."""
+    ok_ed_pre, ed_point = ed_core(ed_pk, ed_s, ed_hblocks, ed_hnblocks)
+    ok_kes_pre, kes_point = kes_core(
+        kes_vk, kes_period, kes_s, kes_vk_leaf, kes_siblings,
+        kes_hblocks, kes_hnblocks, kes_depth,
+    )
+    ok_vrf_pre, c16, vrf_points = vrf_core_bc(
+        vrf_pk, vrf_gamma, vrf_u, vrf_v, vrf_s, vrf_alpha
+    )
+    return finish_core(
+        ok_ed_pre, ed_point, ed_r,
+        ok_kes_pre, kes_point, kes_r,
+        ok_vrf_pre, vrf_points, c16,
         beta_decl, thr_lo, thr_hi,
     )

@@ -97,7 +97,7 @@ def _sharded_verify(mesh, n_real, *cols):
     vocabulary as protocol/batch.verdict_reduce, reduced in place.)"""
 
     def local_step(n_real, *local_cols):
-        v = pbatch.verify_praos(*local_cols)
+        v = pbatch.verify_praos_any(*local_cols)
         ok = v.ok_ocert_sig & v.ok_kes_sig & v.ok_vrf & (
             v.ok_leader | v.leader_ambiguous
         )

@@ -101,7 +101,9 @@ class PraosBatch(NamedTuple):
 
     ed: ed25519_batch.Ed25519Batch  # OCert cold-key signature check
     kes: kes_batch.KesBatch  # header-body KES signature check
-    vrf: ecvrf_batch.EcvrfBatch  # leader VRF proof check
+    # leader VRF proof check; the staged type follows the proof format
+    # (EcvrfBatch = draft-03, EcvrfBcBatch = batch-compatible)
+    vrf: "ecvrf_batch.EcvrfBatch | ecvrf_batch.EcvrfBcBatch"
     beta: np.ndarray  # [B, 64] uint8 — declared certified VRF output
     thr_lo: np.ndarray  # [B, 32] uint8 big-endian leader bound (certain win)
     thr_hi: np.ndarray  # [B, 32] uint8 big-endian leader bound (certain loss)
@@ -222,13 +224,22 @@ def stage(
 
 
 def _lt_be(a, b):
-    """Big-endian lexicographic a < b for [..., 32] int32 byte arrays."""
-    eq = a == b
-    # all_eq_before[i] = all(eq[:i])
-    all_eq_before = jnp.cumprod(
-        jnp.concatenate([jnp.ones_like(eq[..., :1]), eq[..., :-1]], axis=-1),
+    """Big-endian lexicographic a < b for [..., 32] int32 byte arrays.
+
+    all_eq_before via a CUMSUM of mismatch indicators (== 0 while every
+    earlier byte matched), not cumprod: an unrolled 32-long cumprod is a
+    multiply chain in the top-level computation, and two of these (leader
+    lo/hi compares) were the op pattern that still sent XLA's algebraic
+    simplifier into its circular-simplification loop on the composed spmd
+    program (round-7; same family as the PR-1 ladder-chain remediation —
+    cumsum is add-class, which the simplifier's reassociation rewrites
+    leave alone)."""
+    ne = (a != b).astype(jnp.int32)
+    mismatches_before = jnp.cumsum(
+        jnp.concatenate([jnp.zeros_like(ne[..., :1]), ne[..., :-1]], axis=-1),
         axis=-1,
-    ).astype(bool)
+    )
+    all_eq_before = mismatches_before == 0
     return jnp.any(all_eq_before & (a < b), axis=-1)
 
 
@@ -242,6 +253,34 @@ class Verdicts(NamedTuple):
     leader_ambiguous: jnp.ndarray  # [B] host must decide exactly
     eta: jnp.ndarray  # [B, 32] vrfNonceValue(beta) for the nonce fold
     leader_value: jnp.ndarray  # [B, 32] big-endian Blake2b("L" ‖ beta)
+
+
+def _leader_nonce_tail(beta_decl, thr_lo, thr_hi):
+    """Shared tail of the fused verifiers: leader-value + eta range
+    extensions (Praos/VRF.hs:103,116) on the DECLARED beta — ok_vrf
+    guarantees it equals the proof's beta — and the two-threshold
+    leader comparison. (ops/pk/aggregate.py carries the limb-first
+    twin of this block.)"""
+    tag_l = jnp.broadcast_to(
+        jnp.asarray([ord("L")], jnp.int32), (*beta_decl.shape[:-1], 1)
+    )
+    lv = blake2b.blake2b_fixed(
+        jnp.concatenate([tag_l, beta_decl], axis=-1), 65, 32
+    )  # 32 bytes, big-endian natural (hash bytes ARE the BE encoding)
+    tag_n = jnp.broadcast_to(
+        jnp.asarray([ord("N")], jnp.int32), (*beta_decl.shape[:-1], 1)
+    )
+    eta1 = blake2b.blake2b_fixed(
+        jnp.concatenate([tag_n, beta_decl], axis=-1), 65, 32
+    )
+    eta = blake2b.blake2b_fixed(eta1, 32, 32)
+
+    thr_lo = jnp.asarray(thr_lo).astype(jnp.int32)
+    thr_hi = jnp.asarray(thr_hi).astype(jnp.int32)
+    certain_win = _lt_be(lv, thr_lo)
+    certain_loss = ~_lt_be(lv, thr_hi)
+    ambiguous = ~certain_win & ~certain_loss
+    return certain_win, ambiguous, eta, lv
 
 
 def verify_praos(
@@ -281,30 +320,59 @@ def verify_praos(
     beta_decl = jnp.asarray(beta_decl).astype(jnp.int32)
     ok_vrf = ok_proof & jnp.all(beta == beta_decl, axis=-1)
 
-    # range extensions (Praos/VRF.hs:103,116) on the DECLARED beta: the
-    # reference computes them from the certified output, which ok_vrf
-    # guarantees equals the proof's beta
-    tag_l = jnp.broadcast_to(
-        jnp.asarray([ord("L")], jnp.int32), (*beta_decl.shape[:-1], 1)
+    certain_win, ambiguous, eta, lv = _leader_nonce_tail(
+        beta_decl, thr_lo, thr_hi
     )
-    lv = blake2b.blake2b_fixed(
-        jnp.concatenate([tag_l, beta_decl], axis=-1), 65, 32
-    )  # 32 bytes, big-endian natural (hash bytes ARE the BE encoding)
-    tag_n = jnp.broadcast_to(
-        jnp.asarray([ord("N")], jnp.int32), (*beta_decl.shape[:-1], 1)
-    )
-    eta1 = blake2b.blake2b_fixed(
-        jnp.concatenate([tag_n, beta_decl], axis=-1), 65, 32
-    )
-    eta = blake2b.blake2b_fixed(eta1, 32, 32)
+    return Verdicts(ok_ed, ok_kes, ok_vrf, certain_win, ambiguous, eta, lv)
 
-    thr_lo = jnp.asarray(thr_lo).astype(jnp.int32)
-    thr_hi = jnp.asarray(thr_hi).astype(jnp.int32)
-    certain_win = _lt_be(lv, thr_lo)
-    certain_loss = ~_lt_be(lv, thr_hi)
-    ok_leader = certain_win
-    ambiguous = ~certain_win & ~certain_loss
-    return Verdicts(ok_ed, ok_kes, ok_vrf, ok_leader, ambiguous, eta, lv)
+
+def verify_praos_bc(
+    ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
+    kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+    kes_hblocks, kes_hnblocks,
+    vrf_pk, vrf_gamma, vrf_u, vrf_v, vrf_s, vrf_alpha,
+    beta_decl, thr_lo, thr_hi,
+) -> Verdicts:
+    """The fused hot path over BATCH-COMPATIBLE (128-byte) VRF proofs:
+    identical to verify_praos except the challenge is derived on device
+    from the announced U, V (ops/ecvrf_batch.verify_points_bc); the
+    ed/kes subgraphs and the finish hashing are byte-identical."""
+    from ..ops import curve
+
+    ok_ed_pre, ed_point = ed25519_batch.verify_point(
+        ed_pk, ed_s, ed_hblocks, ed_hnblocks
+    )
+    ok_kes_pre, kes_point = kes_batch.verify_point(
+        kes_vk, kes_period, kes_s, kes_vk_leaf, kes_siblings,
+        kes_hblocks, kes_hnblocks,
+    )
+    ok_vrf_pre, c16, vrf_points = ecvrf_batch.verify_points_bc(
+        vrf_pk, vrf_gamma, vrf_u, vrf_v, vrf_s, vrf_alpha
+    )
+    encs = curve.compress_many([ed_point, kes_point, *vrf_points])
+    ok_ed = ok_ed_pre & jnp.all(
+        encs[0] == jnp.asarray(ed_r).astype(jnp.int32), axis=-1
+    )
+    ok_kes = ok_kes_pre & jnp.all(
+        encs[1] == jnp.asarray(kes_r).astype(jnp.int32), axis=-1
+    )
+    ok_proof, beta = ecvrf_batch.finish(ok_vrf_pre, c16, encs[2:])
+    beta_decl = jnp.asarray(beta_decl).astype(jnp.int32)
+    ok_vrf = ok_proof & jnp.all(beta == beta_decl, axis=-1)
+
+    certain_win, ambiguous, eta, lv = _leader_nonce_tail(
+        beta_decl, thr_lo, thr_hi
+    )
+    return Verdicts(ok_ed, ok_kes, ok_vrf, certain_win, ambiguous, eta, lv)
+
+
+def verify_praos_any(*cols) -> Verdicts:
+    """Arity dispatch over the two staged formats: 21 columns = draft-03
+    (verify_praos), 22 = batch-compatible (verify_praos_bc). Used by the
+    spmd local step, whose column list follows the staged batch."""
+    if len(cols) == 22:
+        return verify_praos_bc(*cols)
+    return verify_praos(*cols)
 
 
 _JIT: dict = {}
@@ -323,6 +391,15 @@ DEVICE_IMPL = os.environ.get("OCT_DEVICE_IMPL", "")
 # scan's serial cost ever exceeds the eta transfer it saves.
 PACKED_STAGE = os.environ.get("OCT_PACKED_STAGE", "1") != "0"
 NONCE_SCAN = os.environ.get("OCT_NONCE_SCAN", "1") != "0"
+
+
+def _agg_enabled() -> bool:
+    """OCT_VRF_AGG (default 1): verify packed batch-compatible windows
+    by the random-linear-combination aggregate + MSM
+    (ops/pk/aggregate.py) with per-lane fallback on any anomaly. =0
+    always runs the per-lane stage kernels. Read per call so the
+    differential tests can A/B both paths in one process."""
+    return os.environ.get("OCT_VRF_AGG", "1") != "0"
 
 
 def _impl() -> str:
@@ -354,11 +431,23 @@ def _t(a: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(np.asarray(a).astype(np.int32).T)
 
 
+def batch_is_bc(batch: PraosBatch) -> bool:
+    """True when the staged vrf columns carry batch-compatible proofs."""
+    return isinstance(batch.vrf, ecvrf_batch.EcvrfBcBatch)
+
+
 def pk_arrays(batch: PraosBatch) -> list[np.ndarray]:
     """PraosBatch ([B, ...] staging) -> limb-first arrays in
-    ops/pk/kernels.verify_praos_tiles argument order."""
+    ops/pk/kernels.verify_praos_tiles argument order (the bc-staged
+    format inserts the announced u, v columns in place of c)."""
     ed, kes, vrf = batch.ed, batch.kes, batch.vrf
     b = batch.beta.shape[0]
+    if batch_is_bc(batch):
+        vrf_cols = [_t(vrf.pk), _t(vrf.gamma), _t(vrf.u), _t(vrf.v),
+                    _t(vrf.s), _t(vrf.alpha)]
+    else:
+        vrf_cols = [_t(vrf.pk), _t(vrf.gamma), _t(vrf.c), _t(vrf.s),
+                    _t(vrf.alpha)]
     return [
         _t(ed.pk), _t(ed.r), _t(ed.s),
         _words_to_byte_blocks(ed.hblocks),
@@ -371,7 +460,7 @@ def pk_arrays(batch: PraosBatch) -> list[np.ndarray]:
         ),
         _words_to_byte_blocks(kes.hblocks),
         np.ascontiguousarray(kes.hnblocks.astype(np.int32).reshape(1, b)),
-        _t(vrf.pk), _t(vrf.gamma), _t(vrf.c), _t(vrf.s), _t(vrf.alpha),
+        *vrf_cols,
         _t(batch.beta), _t(batch.thr_lo), _t(batch.thr_hi),
     ]
 
@@ -392,12 +481,13 @@ class PraosPackedLayout(NamedTuple):
     o_issuer: int  # vk_cold (32)
     o_vrf_vk: int  # vrf_vk (32)
     o_vrf_out: int  # declared beta (64)
-    o_vrf_proof: int  # gamma ‖ c ‖ s (80)
+    o_vrf_proof: int  # gamma ‖ c ‖ s (80) or gamma ‖ u ‖ v ‖ s (128)
     o_vk_hot: int  # OCert KES root vk (32)
     o_sigma: int  # OCert cold-key signature R ‖ s (64)
     kes_depth: int
     slots_per_kes: int
     has_nonce: bool  # False = neutral epoch nonce (genesis)
+    vrf_proof_len: int = 80  # 80 = draft-03, 128 = batch-compatible
 
 
 class PraosPacked(NamedTuple):
@@ -471,6 +561,12 @@ def stage_packed(
     if any(len(hv.kes_sig) != sig_len for hv in hvs):
         return None
 
+    plen = len(h0.vrf_proof)
+    if plen not in (80, 128) or any(
+        len(hv.vrf_proof) != plen for hv in hvs
+    ):
+        return None
+
     # lane-0 offset discovery (how the offset is FOUND does not matter —
     # the per-lane verification below is what makes extraction correct)
     fields0 = (
@@ -488,7 +584,7 @@ def stage_packed(
         (offs[0], _col([hv.vk_cold for hv in hvs], 32)),
         (offs[1], _col([hv.vrf_vk for hv in hvs], 32)),
         (offs[2], _col([hv.vrf_output for hv in hvs], 64)),
-        (offs[3], _col([hv.vrf_proof for hv in hvs], 80)),
+        (offs[3], _col([hv.vrf_proof for hv in hvs], plen)),
         (offs[4], _col([hv.ocert.vk_hot for hv in hvs], 32)),
         (offs[5], _col([hv.ocert.sigma for hv in hvs], 64)),
     )
@@ -537,7 +633,8 @@ def stage_packed(
     within = (slot + params.stability_window < first_next).astype(np.uint8)
 
     layout = PraosPackedLayout(
-        lb, *offs, depth, params.slots_per_kes_period, epoch_nonce is not None
+        lb, *offs, depth, params.slots_per_kes_period,
+        epoch_nonce is not None, plen,
     )
     packed = PraosPacked(
         body=body.copy(),
@@ -604,8 +701,14 @@ def unpack_packed(
     issuer = _slice(layout.o_issuer, 32)
     vrf_vk = _slice(layout.o_vrf_vk, 32)
     beta = _slice(layout.o_vrf_out, 64)
-    proof = _slice(layout.o_vrf_proof, 80)
-    gamma, vrf_c, vrf_s = proof[:, :32], proof[:, 32:48], proof[:, 48:]
+    bc = layout.vrf_proof_len == 128
+    proof = _slice(layout.o_vrf_proof, layout.vrf_proof_len)
+    if bc:  # gamma ‖ u ‖ v ‖ s announced-points format
+        gamma, vrf_u, vrf_v, vrf_s = (
+            proof[:, :32], proof[:, 32:64], proof[:, 64:96], proof[:, 96:]
+        )
+    else:
+        gamma, vrf_c, vrf_s = proof[:, :32], proof[:, 32:48], proof[:, 48:]
     vk_hot = _slice(layout.o_vk_hot, 32)
     sigma = _slice(layout.o_sigma, 64)
     ed_r, ed_s = sigma[:, :32], sigma[:, 32:]
@@ -647,6 +750,14 @@ def unpack_packed(
     # in the reference's error order
     period = slot // layout.slots_per_kes - c0
 
+    if bc:
+        return (
+            issuer, ed_r, ed_s, ed_hb, ed_hnb,
+            vk_hot, period, kes_r, kes_s, vk_leaf, siblings, kes_hb,
+            kes_hnb,
+            vrf_vk, gamma, vrf_u, vrf_v, vrf_s, alpha,
+            beta, thr_lo, thr_hi,
+        )
     return (
         issuer, ed_r, ed_s, ed_hb, ed_hnb,
         vk_hot, period, kes_r, kes_s, vk_leaf, siblings, kes_hb, kes_hnb,
@@ -746,7 +857,7 @@ def _jitted_packed_xla(layout: PraosPackedLayout, scan: bool):
                 layout, body, kes_rs, kt_idx, kt_tab, slot, counter, c0,
                 thr_idx, thr_tab, nonce,
             )
-            v = verify_praos(*cols)
+            v = verify_praos_any(*cols)
             flags = jnp.stack(
                 [v.ok_ocert_sig, v.ok_kes_sig, v.ok_vrf, v.ok_leader,
                  v.leader_ambiguous]
@@ -761,17 +872,54 @@ def _jitted_packed_xla(layout: PraosPackedLayout, scan: bool):
     return _JIT[key]
 
 
-def _jitted_pk(kes_depth: int):
+def _jitted_packed_agg(layout: PraosPackedLayout, scan: bool):
+    """The AGGREGATED packed program (batch-compatible layouts only):
+    device unpack -> limb relayout -> ops/pk/aggregate.aggregate_window
+    (cheap per-lane work + Fiat–Shamir coefficients + the RLC MSM) ->
+    verdict_reduce. One jit per (layout, scan); identical output
+    vocabulary to the per-lane packed programs, with the aggregate
+    verdict folded into the ok mask rows — a window that is not clean
+    under aggregation is re-dispatched through the UNCHANGED per-lane
+    stages by materialize_verdicts."""
+    import jax
+
+    key = ("agg-packed", layout, scan)
+    if key not in _JIT:
+        from ..ops.pk import aggregate as pk_aggregate
+        from ..ops.pk import kernels as pk_kernels
+
+        def fn(body, kes_rs, kt_idx, kt_tab, slot, counter, c0,
+               thr_idx, thr_tab, nonce, within, n_real,
+               ev0, ev0_set, cand0, cand0_set):
+            cols = unpack_packed(
+                layout, body, kes_rs, kt_idx, kt_tab, slot, counter, c0,
+                thr_idx, thr_tab, nonce,
+            )
+            limb = pk_kernels.staged_to_limb_first_bc(*cols)
+            av = pk_aggregate.aggregate_window(
+                *limb, kes_depth=layout.kes_depth
+            )
+            red = verdict_reduce(
+                av.flags, jnp.transpose(av.eta), within, n_real,
+                ev0, ev0_set, cand0, cand0_set, scan=scan,
+            )
+            return red, av.flags, av.eta, av.leader_value
+
+        _JIT[key] = jax.jit(fn)
+    return _JIT[key]
+
+
+def _jitted_pk(kes_depth: int, bc: bool = False):
     import functools
     import os
 
     import jax
 
-    key = ("pk", kes_depth)
+    key = ("pk", kes_depth, bc)
     if key not in _JIT:
         from ..ops.pk import kernels as pk_kernels
 
-        if os.environ.get("OCT_PK_FUSED"):
+        if os.environ.get("OCT_PK_FUSED") and not bc:
             # the original single-jit composition (one cache entry for
             # the whole program) — opt-in for A/B measurement
             _JIT[key] = jax.jit(
@@ -783,9 +931,9 @@ def _jitted_pk(kes_depth: int):
             # default: per-stage jits (kernels.verify_praos_split) — a
             # wedged compile costs one stage and the persistent cache
             # accumulates stage entries across retries (VERDICT r3 #2)
-            _JIT[key] = functools.partial(
-                pk_kernels.verify_praos_split, kes_depth=kes_depth
-            )
+            fn = (pk_kernels.verify_praos_split_bc if bc
+                  else pk_kernels.verify_praos_split)
+            _JIT[key] = functools.partial(fn, kes_depth=kes_depth)
     return _JIT[key]
 
 
@@ -799,11 +947,11 @@ def _pk_dispatch(batch: PraosBatch):
     # r5: through the remote-TPU tunnel it does NOT overlap with the
     # prior window's kernels — the same ~130 ms/batch of H2D just moves
     # from the materialize wait into the dispatch bracket)
-    out = _jitted_pk(depth)(
+    out = _jitted_pk(depth, batch_is_bc(batch))(
         ed.pk, ed.r, ed.s, ed.hblocks, ed.hnblocks,
         kes.vk, kes.period, kes.r, kes.s, kes.vk_leaf, kes.siblings,
         kes.hblocks, kes.hnblocks,
-        vrf.pk, vrf.gamma, vrf.c, vrf.s, vrf.alpha,
+        *batch.vrf,
         batch.beta, batch.thr_lo, batch.thr_hi,
     )
     return out
@@ -865,12 +1013,13 @@ def bucket_size(b: int, minimum: int = 8) -> int:
     return ((b + 2047) // 2048) * 2048
 
 
-def _jitted_verify():
+def _jitted_verify(bc: bool = False):
     import jax
 
-    if "fn" not in _JIT:
-        _JIT["fn"] = jax.jit(verify_praos)
-    return _JIT["fn"]
+    key = ("fn", bc)
+    if key not in _JIT:
+        _JIT[key] = jax.jit(verify_praos_bc if bc else verify_praos)
+    return _JIT[key]
 
 
 def run_batch_native(
@@ -947,7 +1096,9 @@ def run_batch(batch: PraosBatch) -> Verdicts:
     padded = pad_batch_to(batch, bucket_size(b))
     if _impl() == "pk":
         return _pk_materialize(_pk_dispatch(padded), b)
-    out = _jitted_verify()(*(jnp.asarray(x) for x in flatten_batch(padded)))
+    out = _jitted_verify(batch_is_bc(padded))(
+        *(jnp.asarray(x) for x in flatten_batch(padded))
+    )
     return Verdicts(*(np.asarray(x)[:b] for x in out))
 
 
@@ -1050,6 +1201,30 @@ def validate_batch(
         return BatchResult(ticked.state, 0, None, [] if collect_states else None)
     lview = ticked.ledger_view
     eta0 = ticked.state.epoch_nonce
+
+    if len({len(hv.vrf_proof) for hv in hvs}) > 1:
+        # a run mixing 80- and 128-byte proofs cannot stage as one
+        # uniform proof column; segment at format boundaries — the
+        # reference fold length-dispatches per header, and segmentation
+        # never changes per-lane verdicts or the first error
+        states = [] if collect_states else None
+        total = 0
+        i = 0
+        while True:
+            plen = len(hvs[i].vrf_proof)
+            j = i + 1
+            while j < len(hvs) and len(hvs[j].vrf_proof) == plen:
+                j += 1
+            res = validate_batch(
+                params, ticked, hvs[i:j], collect_states, backend, mesh
+            )
+            total += res.n_valid
+            if collect_states:
+                states.extend(res.states or [])
+            if res.error is not None or j == len(hvs):
+                return BatchResult(res.state, total, res.error, states)
+            i = j
+            ticked = praos.tick(params, lview, hvs[i].slot, res.state)
 
     pre = host_prechecks(params, lview, hvs)
     if backend == "native":
@@ -1161,7 +1336,7 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
                 disp = _Dispatched("pk", False, False, False,
                                    _pk_dispatch(padded))
             else:
-                out = _jitted_verify()(
+                out = _jitted_verify(batch_is_bc(padded))(
                     *(jnp.asarray(x) for x in flatten_batch(padded))
                 )
                 disp = _Dispatched("xla", False, False, False, out)
@@ -1169,6 +1344,22 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
         scan_mode = NONCE_SCAN and carry is not None
         cargs = carry if scan_mode else _ZERO_CARRY
         n_real = np.int32(b)
+        if layout.vrf_proof_len == 128 and _agg_enabled():
+            # the aggregated fast path: ONE RLC/MSM program instead of
+            # the per-lane ladder stages; the eta/nonce outputs are
+            # identical to the per-lane path by construction, so the
+            # scan carry chain is valid even if this window later falls
+            # back (materialize_verdicts re-dispatches per-lane on any
+            # anomaly — the fallback recomputes the same etas)
+            out = _jitted_packed_agg(layout, scan_mode)(
+                *parr, n_real, *cargs
+            )
+            carry_out = tuple(out[0][1:5]) if scan_mode else None
+            disp = _Dispatched(
+                "agg", True, scan_mode, scan_mode,
+                (layout, parr, n_real, cargs, out),
+            )
+            return pre, disp, b, carry_out
         if _impl() == "pk":
             from ..ops.pk import kernels as pk_kernels
 
@@ -1272,7 +1463,16 @@ def materialize_verdicts(tagged, b):
     packed windows transfer the verdict bitmasks plus either the scanned
     nonce carry (64 B) or the packed eta column — O(bits + one nonce)
     instead of O(lanes x 40 B) — and keep the per-lane arrays
-    device-resident for the slow path."""
+    device-resident for the slow path.
+
+    Aggregated windows ("agg"): when the bitmasks show the window clean
+    (every lane passed its cheap checks AND the RLC aggregate was the
+    identity), the result is used as-is. On ANY anomaly the aggregate's
+    per-lane flags are meaningless (a single bad lane zeroes the ok rows
+    of EVERY lane), so the window is re-dispatched through the unchanged
+    per-lane stage kernels here — exact reference error taxonomy and
+    lane isolation, at the cost of one extra round trip on the rare
+    dirty window."""
     if not tagged.packed:
         out = tagged.out
         d2h = int(sum(x.nbytes for x in out))
@@ -1282,8 +1482,32 @@ def materialize_verdicts(tagged, b):
             v = Verdicts(*(np.asarray(x)[:b] for x in out))
         _emit_transfer("materialize", lanes=b, d2h_bytes=d2h, packed=False)
         return v
-    red, flags, eta, lv = tagged.out
-    if tagged.scan:
+    if tagged.impl == "agg":
+        layout, parr, n_real, cargs, out = tagged.out
+        pv = _materialize_packed(out, b, "pk", tagged.scan, tagged.carried)
+        if pv.clean():
+            return pv
+        if _impl() == "pk":
+            from ..ops.pk import kernels as pk_kernels
+
+            out2 = pk_kernels.verify_praos_packed_split(
+                layout, *parr, n_real, *cargs, scan=tagged.scan
+            )
+            impl2 = "pk"
+        else:
+            out2 = _jitted_packed_xla(layout, tagged.scan)(
+                *parr, n_real, *cargs
+            )
+            impl2 = "xla"
+        return _materialize_packed(out2, b, impl2, tagged.scan,
+                                   tagged.carried)
+    return _materialize_packed(tagged.out, b, tagged.impl, tagged.scan,
+                               tagged.carried)
+
+
+def _materialize_packed(out, b, impl, scan, carried):
+    red, flags, eta, lv = out
+    if scan:
         masks_d, ev, evs, cand, cands = red
         masks = np.asarray(masks_d)
         nonces_out = (
@@ -1301,7 +1525,7 @@ def materialize_verdicts(tagged, b):
         nonces_out = None
         d2h = masks.nbytes + eta_u8.nbytes
     pv = PackedVerdicts(
-        masks, b, tagged.impl, tagged.carried, nonces_out, eta_u8,
+        masks, b, impl, carried, nonces_out, eta_u8,
         (flags, eta, lv),
     )
     _emit_transfer("materialize", lanes=b, d2h_bytes=d2h, packed=True)
@@ -1612,6 +1836,15 @@ def _validate_chain_loop(
         ):
             _, _, seg_end = segments[s_stage]
             j = min(w + max_batch, seg_end)
+            # a window must stage a uniform proof column: break at the
+            # first 80/128-byte format change (the reference fold
+            # length-dispatches per header, so mixed chains stay valid;
+            # segmentation never changes verdicts or the first error)
+            plen = len(hvs[w].vrf_proof)
+            for k in range(w + 1, j):
+                if len(hvs[k].vrf_proof) != plen:
+                    j = k
+                    break
             pre, out, b, carry_out = dispatch_batch(
                 params, lview_for(s_stage), eta_known[s_stage], hvs[w:j],
                 carry=carry if carry_ok else None,

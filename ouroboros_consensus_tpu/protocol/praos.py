@@ -383,7 +383,7 @@ class PraosIsLeader:
     """Proof of leadership: the certified VRF result (Praos.hs:212-216)."""
 
     vrf_output: bytes  # 64
-    vrf_proof: bytes  # 80
+    vrf_proof: bytes  # 80 (draft-03) or 128 (batch-compatible)
 
 
 def check_is_leader(
@@ -394,10 +394,12 @@ def check_is_leader(
 ) -> PraosIsLeader | None:
     """checkIsLeader (Praos.hs:375-397): evaluate the VRF at
     InputVRF(slot, eta0) and test the leader threshold."""
+    from ..ops.host import fast
+
     eta0 = ticked.state.epoch_nonce
     alpha = nonces.mk_input_vrf(slot, eta0)
-    proof = host_ecvrf.prove(can_be_leader.vrf_sign_seed, alpha)
-    output = host_ecvrf.proof_to_hash(proof)
+    proof = fast.ecvrf_prove(can_be_leader.vrf_sign_seed, alpha)
+    output = fast.ecvrf_proof_to_hash(proof)
     hk = hash_key(can_be_leader.vk_cold)
     entry = ticked.ledger_view.pool_distr.get(hk)
     sigma = entry.stake if entry is not None else Fraction(0)

@@ -61,7 +61,7 @@ class HeaderView:
     vk_cold: bytes  # 32 — issuer cold key
     vrf_vk: bytes  # 32
     vrf_output: bytes  # 64 — certified VRF output beta
-    vrf_proof: bytes  # 80 — ECVRF proof pi
+    vrf_proof: bytes  # ECVRF proof pi: 80 (draft-03) or 128 (batch-compat)
     ocert: OCert
     slot: int
     signed_bytes: bytes  # KES-signed representation (header body CBOR)

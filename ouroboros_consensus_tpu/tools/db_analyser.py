@@ -174,12 +174,13 @@ def _views_from_columns(cols):
     issuer_b = cols.issuer_vk.tobytes()
     vrf_vk_b = cols.vrf_vk.tobytes()
     vrf_out_b = cols.vrf_output.tobytes()
-    vrf_prf_b = cols.vrf_proof.tobytes()
+    vrf_prf_b = cols.vrf_proof.tobytes()  # 128-wide zero-padded rows
     ocert_vk_b = cols.ocert_vk.tobytes()
     has_prev = cols.has_prev.tolist()
     counters = cols.ocert_counter.tolist()
     kes_periods = cols.ocert_kes_period.tolist()
     slots = cols.slot.tolist()
+    prf_lens = cols.vrf_proof_len.tolist()
     out = []
     for i in range(n):
         o32 = 32 * i
@@ -189,7 +190,7 @@ def _views_from_columns(cols):
                 vk_cold=issuer_b[o32:o32 + 32],
                 vrf_vk=vrf_vk_b[o32:o32 + 32],
                 vrf_output=vrf_out_b[64 * i:64 * i + 64],
-                vrf_proof=vrf_prf_b[80 * i:80 * i + 80],
+                vrf_proof=vrf_prf_b[128 * i:128 * i + prf_lens[i]],
                 ocert=OCert(
                     ocert_vk_b[o32:o32 + 32],
                     counters[i],

@@ -1,0 +1,78 @@
+"""Point-op accounting: per-lane ladder path vs aggregated RLC/MSM path.
+
+Traces both programs at a configurable lane count with the trace-time
+op counter in ops/pk/curve.py (fori-fenced loop bodies contribute their
+full trip counts via explicit multipliers, so the numbers are exact) and
+prints invocation and lane-op totals plus the reduction factor — the
+CPU-measured evidence PERF.md round 7 records against the ≥5x bar.
+
+Usage: JAX_PLATFORMS=cpu python scripts/count_point_ops.py [T]
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import functools  # noqa: E402
+
+import jax  # noqa: E402
+from jax import numpy as jnp  # noqa: E402
+
+from ouroboros_consensus_tpu.ops.pk import aggregate as agg  # noqa: E402
+from ouroboros_consensus_tpu.ops.pk import curve as pc  # noqa: E402
+from ouroboros_consensus_tpu.ops.pk import verify as pv  # noqa: E402
+
+T = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+NB = 3
+DEPTH = 7
+
+
+def _s(*shape):
+    return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+
+def _args_bc():
+    return (
+        _s(32, T), _s(32, T), _s(32, T), _s(NB, 128, T), _s(1, T),
+        _s(32, T), _s(1, T), _s(32, T), _s(32, T), _s(32, T),
+        _s(DEPTH, 32, T), _s(NB, 128, T), _s(1, T),
+        _s(32, T), _s(32, T), _s(32, T), _s(32, T), _s(32, T), _s(32, T),
+        _s(64, T), _s(32, T), _s(32, T),
+    )
+
+
+def _args_core_bc():
+    a = list(_args_bc())
+    a[4] = _s(T)  # the core takes flat [T] block counts
+    a[6] = _s(T)
+    a[12] = _s(T)
+    return tuple(a)
+
+
+def count(fn, args, label):
+    with pc.op_counter() as stats:
+        jax.make_jaxpr(fn)(*args)
+        ops, lane_ops = stats["ops"], stats["lane_ops"]
+    print(f"{label:28s} point-op invocations {ops:10d}   "
+          f"lane-ops {lane_ops:14d}   ({lane_ops / T:10.1f}/lane)")
+    return lane_ops
+
+
+def main():
+    per_lane = count(
+        functools.partial(pv.verify_praos_core_bc, kes_depth=DEPTH),
+        _args_core_bc(), f"per-lane ladders (T={T})",
+    )
+    aggregated = count(
+        functools.partial(agg.aggregate_window, kes_depth=DEPTH),
+        _args_bc(), f"aggregated RLC/MSM (T={T})",
+    )
+    print(f"point-op reduction: {per_lane / aggregated:.2f}x "
+          f"({per_lane / T:.0f} -> {aggregated / T:.0f} lane-ops/lane)")
+
+
+if __name__ == "__main__":
+    main()

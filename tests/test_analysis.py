@@ -188,7 +188,11 @@ def test_budget_check_flags_over_and_missing():
 # unrolled multiply chain (pre-remediation: >900; ed_core alone was 451
 # before the ops/pk/curve.py fencing). A change in either direction is
 # a deliberate act: update this AND analysis/budgets.json together.
+# Round 7: the BATCH-COMPATIBLE composed core (derived challenge +
+# unchanged ladders/finish) lands on the SAME depth — the extra prep
+# work (compress H + challenge SHA) is all fenced or non-multiplicative.
 GOLDEN_COMPOSED_CHAIN_DEPTH = 114
+GOLDEN_COMPOSED_BC_CHAIN_DEPTH = 114
 
 
 @pytest.fixture(scope="module")
@@ -200,6 +204,14 @@ def composed_report():
 
 def test_golden_composed_chain_depth(composed_report):
     assert composed_report.mul_chain_depth == GOLDEN_COMPOSED_CHAIN_DEPTH
+
+
+@pytest.mark.slow
+def test_golden_composed_bc_chain_depth():
+    r = graphs.analyze_jaxpr(
+        graphs.trace_graph("verify_praos_core_bc"), "verify_praos_core_bc"
+    )
+    assert r.mul_chain_depth == GOLDEN_COMPOSED_BC_CHAIN_DEPTH
 
 
 def test_composed_graph_under_budget(composed_report):

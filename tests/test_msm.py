@@ -1,0 +1,219 @@
+"""MSM + RLC aggregation building blocks (ops/pk/msm.py, aggregate.py).
+
+Fast tier: the Pippenger MSM against the host big-int reference at
+SMALL widths (64-bit scalars: same code path, 1/4 of the windows — the
+full 256-bit differential runs in the slow tier via test_aggregate),
+the mod-L scalar product/sum helpers, and the Fiat–Shamir coefficient
+properties the aggregation relies on (determinism across re-runs and
+window re-ordering). Host/native batch-compatible ECVRF differentials
+are pure host work (no device compile).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+from jax import numpy as jnp
+
+from ouroboros_consensus_tpu.ops import bigint as bi
+from ouroboros_consensus_tpu.ops.host import ecvrf as hv
+from ouroboros_consensus_tpu.ops.host import ed25519 as he
+from ouroboros_consensus_tpu.ops.pk import curve as pc
+from ouroboros_consensus_tpu.ops.pk import limbs as fe
+from ouroboros_consensus_tpu.ops.pk import msm
+
+
+def _limbs_col(ints):
+    return jnp.asarray(
+        np.stack([bi.int_to_limbs_np(k, 20) for k in ints], axis=-1)
+    )
+
+
+def _points_col(pts):
+    enc = np.stack(
+        [np.frombuffer(he.point_compress(p), np.uint8) for p in pts]
+    ).astype(np.int32).T
+    ok, P = pc.decompress(jnp.asarray(enc))
+    assert bool(jnp.all(ok))
+    return P
+
+
+def _host_msm(ks, pts):
+    acc = he.IDENT
+    for k, p in zip(ks, pts):
+        acc = he.point_add(acc, he.point_mul(k, p))
+    return he.point_compress(acc)
+
+
+def _run_msm32(scal, P):
+    # eager + 32-bit scalars (4 windows): the window count is the only
+    # thing nbits changes, and the small graph keeps the COLD-cache
+    # compile cost of the fast tier low (the 256-bit differential runs
+    # in the slow tier, tests/test_aggregate.py); eager op-by-op
+    # compilation shares its pieces between the two tests below
+    return msm.msm(scal, P, 32)
+
+
+@pytest.fixture(scope="module")
+def rng_points():
+    random.seed(20260803)
+    pts = [he.point_mul(random.randrange(1, he.L), he.B) for _ in range(7)]
+    return pts
+
+
+@pytest.mark.slow
+def test_msm_matches_host_32bit(rng_points):
+    """Σ k_i·P_i for 32-bit scalars — exercises sort, chunked segment
+    scan, bucket extraction, weighted sum and the Horner doubling chain
+    (window count is the only thing nbits changes). Slow tier: even the
+    4-window eager trace costs ~2 min against a cold XLA:CPU cache on
+    the 1-core box (the aggregate differentials cover the same code for
+    real in tests/test_aggregate.py)."""
+    ks = [random.randrange(1 << 32) for _ in rng_points]
+    # include collisions + the zero digit bucket: lane 0 scalar 0
+    ks[0] = 0
+    ks[1] = ks[2]
+    got = _run_msm32(_limbs_col(ks), _points_col(rng_points))
+    enc = np.asarray(pc.compress(got))[:, 0].astype(np.uint8).tobytes()
+    assert enc == _host_msm(ks, rng_points)
+
+
+@pytest.mark.slow
+def test_msm_cancellation_is_identity(rng_points):
+    """k·P + k·(−P) = 0 — the exact shape of the aggregate's accept
+    condition (identity-equality, not byte compare). Shares the 64-bit
+    window count with the differential above (one compiled program)."""
+    p = rng_points[0]
+    k = random.randrange(1 << 32)
+    ks = [k, k, 0, 0, 0, 0, 0]
+    P = _points_col([p, he.point_neg(p), *rng_points[2:]])
+    total = _run_msm32(_limbs_col(ks), P)
+    assert bool(msm.is_identity(total)[0])
+
+
+def test_mul_sum_mod_l_match_python():
+    random.seed(11)
+    a = [random.randrange(he.L) for _ in range(5)]
+    b = [random.randrange(he.L) for _ in range(5)]
+    prod = jax.jit(fe.mul_mod_l)(_limbs_col(a), _limbs_col(b))
+    got = np.asarray(prod)
+    for i in range(5):
+        want = bi.int_to_limbs_np(a[i] * b[i] % he.L, 20)
+        assert (got[:, i] == want).all(), i
+    terms = [jnp.asarray(_limbs_col(a)), jnp.asarray(_limbs_col(b))]
+    s = np.asarray(jax.jit(fe.sum_mod_l)(terms))[:, 0]
+    want = bi.int_to_limbs_np((sum(a) + sum(b)) % he.L, 20)
+    assert (s == want).all()
+
+
+def test_sum_mod_l_no_int32_overflow_at_scale():
+    """Regression: an un-normalized cross-term accumulator overflows
+    int32 once lanes x terms x 2^13 clears 2^31 (~87k lane-terms at 3
+    terms). 40 all-(2^252−1) terms of 8192 lanes = 2.7e9 per limb
+    column if summed naively; per-term carry normalization keeps it
+    exact."""
+    t, n_terms = 8192, 40
+    col = jnp.broadcast_to(_limbs_col([(1 << 252) - 1]), (20, t))
+    s = np.asarray(jax.jit(fe.sum_mod_l)([col] * n_terms))[:, 0]
+    want = bi.int_to_limbs_np(n_terms * t * ((1 << 252) - 1) % he.L, 20)
+    assert (s == want).all()
+
+
+# ---------------------------------------------------------------------------
+# Fiat–Shamir coefficients
+# ---------------------------------------------------------------------------
+
+
+def _fs_inputs(t, seed=0):
+    rng = np.random.default_rng(seed)
+
+    def col(n):
+        return jnp.asarray(rng.integers(0, 256, (n, t)).astype(np.int32))
+
+    return (col(32), col(32), col(64), col(32), col(32), col(64),
+            col(32), col(32), col(32), col(32), col(32), col(32), col(64))
+
+
+def test_fs_coefficients_deterministic_and_reorder_invariant():
+    """The coefficients are a function of the LANE transcript only:
+    identical across re-runs, and permuting the lanes of a window
+    permutes the coefficients without changing any lane's value — so
+    window segmentation/reordering cannot change the aggregate inputs."""
+    from ouroboros_consensus_tpu.ops.pk import aggregate as agg
+
+    args = _fs_inputs(6)
+    fn = jax.jit(agg.fs_coefficients)
+    z_a = [np.asarray(z) for z in fn(*args)]
+    z_b = [np.asarray(z) for z in fn(*args)]
+    for a, b in zip(z_a, z_b):
+        assert (a == b).all()
+    perm = np.asarray([3, 0, 5, 1, 4, 2])
+    args_p = tuple(a[:, perm] for a in args)
+    z_p = [np.asarray(z) for z in fn(*args_p)]
+    for a, p in zip(z_a, z_p):
+        assert (a[:, perm] == p).all()
+    # distinct lanes get (overwhelmingly) distinct coefficients
+    flat = np.concatenate([z.T for z in z_a], axis=-1)
+    assert len({r.tobytes() for r in flat}) == flat.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# Host + native batch-compatible ECVRF
+# ---------------------------------------------------------------------------
+
+
+def test_host_prove_bc_verify_roundtrip():
+    seed, alpha = b"\x31" * 32, b"\x17" * 32
+    pk = he.secret_to_public(seed)
+    p80 = hv.prove(seed, alpha)
+    p128 = hv.prove_batch_compat(seed, alpha)
+    assert len(p128) == hv.PROOF_BYTES_BATCH
+    # same transcript, two serializations
+    assert p128[:32] == p80[:32] and p128[96:] == p80[48:]
+    beta = hv.verify(pk, p80, alpha)
+    assert beta is not None
+    assert hv.verify(pk, p128, alpha) == beta
+    assert hv.verify_batch_compat(pk, p128, alpha) == beta
+
+
+@pytest.mark.parametrize("where", ["gamma", "u", "v", "s", "alpha"])
+def test_host_verify_bc_rejects_tampering(where):
+    seed, alpha = b"\x32" * 32, b"\x18" * 32
+    pk = he.secret_to_public(seed)
+    pi = bytearray(hv.prove_batch_compat(seed, alpha))
+    off = {"gamma": 1, "u": 33, "v": 65, "s": 97}.get(where)
+    if where == "alpha":
+        alpha2 = bytes(31) + b"\x01"
+        assert hv.verify(pk, bytes(pi), alpha2) is None
+        return
+    pi[off] ^= 1
+    assert hv.verify(pk, bytes(pi), alpha) is None
+
+
+def test_native_bc_matches_host():
+    from ouroboros_consensus_tpu import native_loader as nl
+
+    if nl.load_crypto() is None:
+        pytest.skip("native toolchain unavailable")
+    seed, alpha = b"\x33" * 32, b"\x19" * 32
+    pk = he.secret_to_public(seed)
+    ref = hv.prove_batch_compat(seed, alpha)
+    assert nl.native_ecvrf_prove_bc(seed, alpha) == ref
+    assert nl.native_ecvrf_verify(pk, ref, alpha) == hv.proof_to_hash(ref)
+    bad = bytearray(ref)
+    bad[40] ^= 1
+    assert nl.native_ecvrf_verify(pk, bytes(bad), alpha) is None
+
+
+def test_fast_prove_format_follows_env(monkeypatch):
+    from ouroboros_consensus_tpu.ops.host import fast
+
+    seed, alpha = b"\x34" * 32, b"\x1a" * 32
+    monkeypatch.setenv("OCT_VRF_BATCH", "0")
+    assert len(fast.ecvrf_prove(seed, alpha)) == 80
+    monkeypatch.setenv("OCT_VRF_BATCH", "1")
+    assert len(fast.ecvrf_prove(seed, alpha)) == 128
+    monkeypatch.delenv("OCT_VRF_BATCH")
+    assert len(fast.ecvrf_prove(seed, alpha)) == 128  # default bc

@@ -80,7 +80,11 @@ def test_extract_headers_matches_python(chunk):
         assert bytes(cols.issuer_vk[i]) == body.issuer_vk
         assert bytes(cols.vrf_vk[i]) == body.vrf_vk
         assert bytes(cols.vrf_output[i]) == body.vrf_output
-        assert bytes(cols.vrf_proof[i]) == body.vrf_proof
+        # the proof column is 128-wide zero-padded; per-row length
+        # discriminates the format (80 draft-03 / 128 batch-compatible)
+        assert cols.vrf_proof_len[i] == len(body.vrf_proof)
+        assert (bytes(cols.vrf_proof[i][: cols.vrf_proof_len[i]])
+                == body.vrf_proof)
         assert bytes(cols.body_hash[i]) == body.body_hash
         assert bytes(cols.ocert_vk[i]) == body.ocert.vk_hot
         assert cols.ocert_counter[i] == body.ocert.counter

@@ -36,13 +36,19 @@ from ouroboros_consensus_tpu.protocol import batch as pbatch
 from ouroboros_consensus_tpu.protocol import nonces, praos
 from ouroboros_consensus_tpu.testing import fixtures
 
-COLS = [
+_COLS_HEAD = [
     "ed.pk", "ed.r", "ed.s", "ed.hblocks", "ed.hnblocks",
     "kes.vk", "kes.period", "kes.r", "kes.s", "kes.vk_leaf",
     "kes.siblings", "kes.hblocks", "kes.hnblocks",
-    "vrf.pk", "vrf.gamma", "vrf.c", "vrf.s", "vrf.alpha",
-    "beta", "thr_lo", "thr_hi",
 ]
+_COLS_TAIL = ["beta", "thr_lo", "thr_hi"]
+
+
+def cols_of(staged):
+    """Column names in flatten_batch order — the vrf block depends on
+    the staged proof format (draft-03: c; batch-compatible: u, v)."""
+    vrf = ["vrf." + f for f in type(staged.vrf)._fields]
+    return _COLS_HEAD + vrf + _COLS_TAIL
 
 
 def make_params(kes_depth=3, epoch_length=100_000):
@@ -115,8 +121,9 @@ def test_packed_unpack_roundtrips_all_families(nonce, depth, first_slot):
     staged = pbatch.stage(params, lv, nonce, hvs, pre.kes_evolution)
     ref = pbatch.flatten_batch(staged)
     got = jax.jit(lambda *a: pbatch.unpack_packed(layout, *a))(*parr[:10])
-    assert len(ref) == len(got) == 21
-    for name, a, b in zip(COLS, ref, got):
+    # batch-compatible proofs (the forge default) stage 22 columns
+    assert len(ref) == len(got) == (22 if layout.vrf_proof_len == 128 else 21)
+    for name, a, b in zip(cols_of(staged), ref, got):
         a, b = np.asarray(a), np.asarray(b)
         assert a.shape == b.shape and a.dtype == b.dtype, name
         assert (a == b).all(), name
@@ -136,7 +143,7 @@ def test_packed_limb_first_matches_pk_arrays(pools, lview):
     staged = pbatch.stage(params, lview, nonce, hvs, pre.kes_evolution)
     ref = pbatch.pk_arrays(staged)
     got = jax.jit(K._mk_packed_unpack(layout))(*parr[:10])
-    assert len(ref) == len(got) == 21
+    assert len(ref) == len(got) == 22  # bc-staged: u, v replace c
     for i, (a, b) in enumerate(zip(ref, got)):
         a, b = np.asarray(a), np.asarray(b)
         assert a.shape == b.shape and a.dtype == b.dtype == np.int32, i
@@ -382,15 +389,15 @@ def test_epilogue_counter_gate_routes_to_slow_path(pools, lview):
 # ---------------------------------------------------------------------------
 
 
-def _stub_verify(ed_pk, ed_r, ed_s, ed_hb, ed_hnb, kes_vk, kes_per, kes_r,
-                 kes_s, kes_leaf, kes_sib, kes_hb, kes_hnb,
-                 vrf_pk, vrf_g, vrf_c, vrf_s, vrf_al,
-                 beta_decl, thr_lo, thr_hi):
+def _stub_verify(*cols):
     """All-valid crypto stub with the REAL eta / leader-value range
     extensions (hash-only: compiles in seconds on XLA:CPU where the
     full curve graphs take minutes). Keeps every non-crypto part of the
     packed pipeline — staging, unpack, masks, nonce scan, carries,
-    epilogue — byte-exact against the reupdate fold."""
+    epilogue — byte-exact against the reupdate fold. Arity-generic
+    (21 draft-03 / 22 batch-compatible columns): beta_decl is always
+    the third-from-last column."""
+    beta_decl = cols[-3]
     bd = jnp.asarray(beta_decl).astype(jnp.int32)
     b = bd.shape[0]
     tag_l = jnp.broadcast_to(jnp.asarray([ord("L")], jnp.int32), (b, 1))
@@ -405,15 +412,22 @@ def _stub_verify(ed_pk, ed_r, ed_s, ed_hb, ed_hnb, kes_vk, kes_per, kes_r,
 
 @pytest.fixture
 def stubbed_crypto(monkeypatch):
-    """Patch the fused verifier with the hash-only stub and fence the
-    jit caches so stub-compiled programs never leak into other tests."""
+    """Patch the fused verifiers (both proof formats) with the hash-only
+    stub, disable the aggregated fast path (its RLC/MSM program is real
+    crypto — covered stubbed by test_aggregate.py and for real in the
+    slow tier), and fence the jit caches so stub-compiled programs never
+    leak into other tests."""
     before = set(pbatch._JIT)
+    monkeypatch.setenv("OCT_VRF_AGG", "0")
     monkeypatch.setattr(pbatch, "verify_praos", _stub_verify)
+    monkeypatch.setattr(pbatch, "verify_praos_bc", _stub_verify)
+    monkeypatch.setattr(pbatch, "verify_praos_any", _stub_verify)
 
-    def patched_jv():
-        if "fn" not in pbatch._JIT:
-            pbatch._JIT["fn"] = jax.jit(_stub_verify)
-        return pbatch._JIT["fn"]
+    def patched_jv(bc=False):
+        key = ("fn-stub", bc)
+        if key not in pbatch._JIT:
+            pbatch._JIT[key] = jax.jit(_stub_verify)
+        return pbatch._JIT[key]
 
     monkeypatch.setattr(pbatch, "_jitted_verify", patched_jv)
     yield

@@ -186,3 +186,59 @@ def test_sharded_replay_at_scale(tmp_path):
         rates[n_dev] = n / dt
     # record the scaling shape for PERF.md (stdout shows under -s)
     print(f"sharded replay scaling: {rates}")
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("OCT_SLOW_TESTS"),
+    reason="≥64k-header sharded replay on XLA:CPU (VERDICT r5 item 5); "
+    "set OCT_SLOW_TESTS=1 (OCT_MULTICHIP_HEADERS scales the size)",
+)
+def test_cross_shard_first_failure_at_scale(tmp_path):
+    """VERDICT r5 item 5: at ≥64k headers, the cross-shard first-failure
+    index (pmin over global lane positions) must equal the sequential
+    first failure — same valid-prefix length, same error class — with
+    the corrupted lane landing mid-chain on a non-zero shard."""
+    import os
+
+    from dataclasses import replace as dreplace
+
+    from ouroboros_consensus_tpu.tools import db_analyser, db_synthesizer
+
+    n = int(os.environ.get("OCT_MULTICHIP_HEADERS", "65536")) or 65536
+    params = praos.PraosParams(
+        slots_per_kes_period=2000,
+        max_kes_evolutions=62,
+        security_param=4,
+        active_slot_coeff=Fraction(1),
+        epoch_length=1_000_000,
+        kes_depth=3,
+    )
+    pools_ = [fixtures.make_pool(0, kes_depth=3)]
+    lview_ = fixtures.make_ledger_view(pools_)
+    fr = db_synthesizer.synthesize(
+        str(tmp_path / "db"), params, pools_, lview_,
+        db_synthesizer.ForgeLimit(blocks=n), chunk_size=8192,
+    )
+    assert fr.n_blocks == n
+    imm = db_analyser.open_immutable(str(tmp_path / "db"))
+    res_acc = db_analyser.ValidationResult()
+    hvs = list(db_analyser._stream_views(imm, res_acc))
+    bad = (3 * n) // 4 + 1  # mid-shard, non-zero shard at every batch size
+    sig = bytearray(hvs[bad].kes_sig)
+    sig[1] ^= 1
+    hvs[bad] = dreplace(hvs[bad], kes_sig=bytes(sig))
+
+    seq = pbatch.validate_chain(
+        params, lambda _e: lview_, praos.PraosState(), hvs,
+        backend="native", max_batch=8192,
+    )
+    assert seq.n_valid == bad
+    assert isinstance(seq.error, praos.InvalidKesSignatureOCERT)
+
+    sharded = pbatch.validate_chain(
+        params, lambda _e: lview_, praos.PraosState(), hvs,
+        backend="sharded", mesh=spmd.make_mesh(), max_batch=8192,
+    )
+    assert sharded.n_valid == seq.n_valid
+    assert type(sharded.error) is type(seq.error)
+    assert vars(sharded.error) == vars(seq.error)

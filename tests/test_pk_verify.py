@@ -87,9 +87,23 @@ def staged():
 
 
 def _core_verdicts(batch):
+    # the forge default is batch-compatible proofs (22 staged columns,
+    # announced u/v): dispatch the matching composed core
     arrays = [jnp.asarray(x) for x in pbatch.pk_arrays(batch)]
+    bc = len(arrays) == 22
 
     def f(*a):
+        if bc:
+            (ed_pk, ed_r, ed_s, ed_hb, ed_hnb, kes_vk, kes_per, kes_r,
+             kes_s, kes_leaf, kes_sib, kes_hb, kes_hnb, vrf_pk, vrf_g,
+             vrf_u, vrf_v, vrf_s, vrf_al, beta, tlo, thi) = a
+            return pv.verify_praos_core_bc(
+                ed_pk, ed_r, ed_s, ed_hb, ed_hnb[0],
+                kes_vk, kes_per[0], kes_r, kes_s, kes_leaf, kes_sib,
+                kes_hb, kes_hnb[0],
+                vrf_pk, vrf_g, vrf_u, vrf_v, vrf_s, vrf_al,
+                beta, tlo, thi, kes_depth=3,
+            )
         (ed_pk, ed_r, ed_s, ed_hb, ed_hnb, kes_vk, kes_per, kes_r, kes_s,
          kes_leaf, kes_sib, kes_hb, kes_hnb, vrf_pk, vrf_g, vrf_c, vrf_s,
          vrf_al, beta, tlo, thi) = a
@@ -108,7 +122,7 @@ def test_core_matches_xla_fused(staged):
     """Lane-for-lane agreement with the original XLA fused verifier on
     every verdict bit plus eta and the leader value."""
     v = _core_verdicts(staged)
-    fn = pbatch._jitted_verify()
+    fn = pbatch._jitted_verify(pbatch.batch_is_bc(staged))
     xla = pbatch.Verdicts(
         *(np.asarray(x) for x in fn(
             *(jnp.asarray(x) for x in pbatch.flatten_batch(staged))

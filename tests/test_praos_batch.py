@@ -99,6 +99,46 @@ def test_all_valid(pools, lview):
     assert res.n_valid == 8 and res.error is None
 
 
+def test_mixed_proof_format_chain_validates(pools, lview, monkeypatch):
+    """A chain mixing 80-byte draft-03 and 128-byte batch-compatible
+    proofs (e.g. synthesized across an OCT_VRF_BATCH flip) validates
+    header-by-header like the reference fold instead of crashing the
+    uniform-proof-column staging: validate_batch segments the run at
+    format boundaries. Native backend — no device compile, fast tier."""
+    eta = b"\x07" * 32
+    hvs, prev, slot = [], None, 1
+    while len(hvs) < 6:
+        pool = fixtures.find_leader(PARAMS, pools, lview, slot, eta)
+        if pool is not None:
+            monkeypatch.setenv("OCT_VRF_BATCH",
+                               "0" if len(hvs) % 2 else "1")
+            hv = fixtures.forge_header_view(
+                PARAMS, pool, slot=slot, epoch_nonce=eta,
+                prev_hash=prev, body_bytes=b"body-%d" % len(hvs),
+            )
+            hvs.append(hv)
+            prev = (b"%032d" % len(hvs))[:32]
+        slot += 1
+    monkeypatch.delenv("OCT_VRF_BATCH", raising=False)
+    assert {len(hv.vrf_proof) for hv in hvs} == {80, 128}
+    t = ticked_state(lview)
+    st_seq, n_seq, err_seq = sequential_fold(PARAMS, t, hvs)
+    assert err_seq is None and n_seq == len(hvs)
+    res = pbatch.validate_batch(PARAMS, t, hvs, backend="native")
+    assert res.error is None and res.n_valid == len(hvs)
+    assert res.state.evolving_nonce == st_seq.evolving_nonce
+    assert dict(res.state.ocert_counters) == dict(st_seq.ocert_counters)
+    # a tampered mixed-format lane still isolates with the exact error
+    bad = hvs[4]
+    hvs[4] = replace(
+        bad,
+        vrf_proof=bad.vrf_proof[:-1] + bytes([bad.vrf_proof[-1] ^ 1]),
+    )
+    res = pbatch.validate_batch(PARAMS, t, hvs, backend="native")
+    assert res.n_valid == 4
+    assert isinstance(res.error, praos.VRFKeyBadProof)
+
+
 @pytest.mark.slow
 def test_bad_kes_sig_midway(pools, lview):
     hvs = make_chain(6, pools)
@@ -225,12 +265,16 @@ def test_staged_relayout_matches_pk_arrays(monkeypatch):
 
     from ouroboros_consensus_tpu.ops.pk import kernels as K
 
+    # this test pins the DRAFT-03 (80-byte proof) staged wiring; the
+    # batch-compatible twin is test_split_dispatch_bc below
+    monkeypatch.setenv("OCT_VRF_BATCH", "0")
     pools = [fixtures.make_pool(i, kes_depth=PARAMS.kes_depth)
              for i in range(3)]
     lview = fixtures.make_ledger_view(pools)
     hvs = make_chain(24, pools, lview=lview)
     pre = pbatch.host_prechecks(PARAMS, lview, hvs)
     staged = pbatch.stage(PARAMS, lview, b"\x07" * 32, hvs, pre.kes_evolution)
+    assert not pbatch.batch_is_bc(staged)
     ref = pbatch.pk_arrays(staged)
 
     captured = {}
@@ -272,12 +316,14 @@ def test_split_dispatch_threads_stages_correctly(monkeypatch):
 
     from ouroboros_consensus_tpu.ops.pk import kernels as K
 
+    monkeypatch.setenv("OCT_VRF_BATCH", "0")  # draft-03 wiring pin
     pools = [fixtures.make_pool(i, kes_depth=PARAMS.kes_depth)
              for i in range(3)]
     lview = fixtures.make_ledger_view(pools)
     hvs = make_chain(8, pools, lview=lview)
     pre = pbatch.host_prechecks(PARAMS, lview, hvs)
     staged = pbatch.stage(PARAMS, lview, b"\x07" * 32, hvs, pre.kes_evolution)
+    assert not pbatch.batch_is_bc(staged)
     ref = [np.asarray(a) for a in pbatch.pk_arrays(staged)]
     b = staged.beta.shape[0]
     depth = PARAMS.kes_depth
@@ -329,6 +375,75 @@ def test_split_dispatch_threads_stages_correctly(monkeypatch):
     #        c, beta, thr_lo, thr_hi)
     eq(g[2], 1); eq(g[5], 7); eq(g[8], 15); eq(g[9], 18)
     eq(g[10], 19); eq(g[11], 20)
+    assert g[0].shape == (1, b) and g[1].shape == (80, b)
+    assert g[6].shape == (1, b) and g[7].shape == (400, b)
+
+
+def test_split_dispatch_bc_threads_stages_correctly(monkeypatch):
+    """The batch-compatible split wiring (relayout_bc -> ed/kes ->
+    vrf_bc -> finish): announced u/v columns reach the vrf_bc stage, and
+    the finish stage receives the DERIVED challenge (the vrf_bc stage's
+    second output), not a staged column."""
+    import numpy as np
+    from jax import numpy as jnp
+
+    from ouroboros_consensus_tpu.ops.pk import kernels as K
+
+    pools = [fixtures.make_pool(i, kes_depth=PARAMS.kes_depth)
+             for i in range(3)]
+    lview = fixtures.make_ledger_view(pools)
+    hvs = make_chain(8, pools, lview=lview)
+    assert len(hvs[0].vrf_proof) == 128  # forge default is bc
+    pre = pbatch.host_prechecks(PARAMS, lview, hvs)
+    staged = pbatch.stage(PARAMS, lview, b"\x07" * 32, hvs, pre.kes_evolution)
+    assert pbatch.batch_is_bc(staged)
+    ref = [np.asarray(a) for a in pbatch.pk_arrays(staged)]
+    b = staged.beta.shape[0]
+    depth = PARAMS.kes_depth
+
+    captured = {}
+
+    def stub(name, outs):
+        def fn(*args):
+            captured[name] = [np.asarray(a) for a in args]
+            return tuple(jnp.zeros((*p, b), jnp.int32) for p in outs)
+        return fn
+
+    monkeypatch.setitem(K._SPLIT_JIT, "ed", stub("ed", [(1,), (80,)]))
+    monkeypatch.setitem(
+        K._SPLIT_JIT, ("kes", depth), stub("kes", [(1,), (80,)])
+    )
+    monkeypatch.setitem(
+        K._SPLIT_JIT, "vrf_bc", stub("vrf_bc", [(1,), (16,), (400,)])
+    )
+    monkeypatch.setitem(
+        K._SPLIT_JIT, "finish", stub("finish", [(5,), (32,), (32,)])
+    )
+
+    ed, kes, vrf = staged.ed, staged.kes, staged.vrf
+    out = K.verify_praos_split_bc(
+        ed.pk, ed.r, ed.s, ed.hblocks, ed.hnblocks,
+        kes.vk, kes.period, kes.r, kes.s, kes.vk_leaf, kes.siblings,
+        kes.hblocks, kes.hnblocks,
+        vrf.pk, vrf.gamma, vrf.u, vrf.v, vrf.s, vrf.alpha,
+        staged.beta, staged.thr_lo, staged.thr_hi,
+        kes_depth=depth,
+    )
+    assert len(out) == 3
+
+    # bc pk_arrays index map: 0-12 as draft-03, then 13 vrf_pk 14 vrf_g
+    # 15 vrf_u 16 vrf_v 17 vrf_s 18 vrf_al 19 beta 20 tlo 21 thi
+    def eq(got, want_ix):
+        assert (got == ref[want_ix]).all(), want_ix
+
+    g = captured["vrf_bc"]
+    eq(g[0], 13); eq(g[1], 14); eq(g[2], 15); eq(g[3], 16); eq(g[4], 17)
+    eq(g[5], 18)
+    g = captured["finish"]
+    eq(g[2], 1); eq(g[5], 7); eq(g[9], 19); eq(g[10], 20); eq(g[11], 21)
+    # the challenge column handed to finish is the vrf_bc stage's c16
+    # output (a stub zero array here), NOT any staged column
+    assert g[8].shape == (16, b) and (g[8] == 0).all()
     assert g[0].shape == (1, b) and g[1].shape == (80, b)
     assert g[6].shape == (1, b) and g[7].shape == (400, b)
 

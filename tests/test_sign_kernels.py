@@ -33,14 +33,19 @@ def test_ed25519_sign_matches_host():
 
 @pytest.mark.slow
 def test_ecvrf_prove_matches_host():
+    # both proof formats share the ONE prove jit (batch_compat only
+    # selects which device columns assemble into the proof bytes), so
+    # covering draft-03 AND batch-compatible costs one compile
     n = 8
     seeds = _seeds(n)
     alphas = _seeds(n)
-    proofs, betas = ecvrf_batch.prove_batch(seeds, alphas)
-    for i in range(n):
-        hp = hv.prove(seeds[i], alphas[i])
-        assert proofs[i].tobytes() == hp
-        assert betas[i].tobytes() == hv.proof_to_hash(hp)
+    for bc, host_prove in ((False, hv.prove), (True, hv.prove_batch_compat)):
+        proofs, betas = ecvrf_batch.prove_batch(seeds, alphas,
+                                                batch_compat=bc)
+        for i in range(n):
+            hp = host_prove(seeds[i], alphas[i])
+            assert proofs[i].tobytes() == hp
+            assert betas[i].tobytes() == hv.proof_to_hash(hp)
 
 
 @pytest.mark.slow
