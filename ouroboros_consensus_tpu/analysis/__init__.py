@@ -8,16 +8,26 @@ and the host/device hazards that silently serialize a jitted hot path.
 
   Pass 1 (astlint)  — walks the package source and flags statically
                       detectable jit hazards with file:line diagnostics
-                      and `# octlint: disable=RULE` suppressions.
+                      and `# octlint: disable=RULE` suppressions (incl.
+                      the OCT106 stale-suppression audit).
   Pass 2 (graphs)   — traces every registered kernel with abstract
                       inputs and computes per-graph pathology metrics
                       (unrolled multiply-chain depth, op fan-out,
-                      rematerialization width), failing any graph that
-                      exceeds the checked-in `budgets.json`.
+                      rematerialization width) plus trace-time per-lane
+                      point-op counts, failing any graph that exceeds
+                      the checked-in `budgets.json`.
+  Pass 3 (absint)   — octrange: abstract interpretation of the same
+                      jaxprs under a per-row interval/overflow domain
+                      (no-overflow proofs at production lane counts,
+                      input specs in `shapes.json`) and a secret-taint
+                      domain (no secret-dependent branches or access
+                      patterns), ratcheted in `certified.json`.
 
-Ships as a CLI (`python -m ouroboros_consensus_tpu.analysis`), a pytest
-gate (`tests/test_analysis.py`, tier-1) and a repo-wide ratchet
-(`scripts/lint.py` against `analysis/baseline.json`).
+Ships as a CLI (`python -m ouroboros_consensus_tpu.analysis`, with
+`range`/`taint`/`pointops` subcommands and distinct exit codes), pytest
+gates (`tests/test_analysis.py`, `tests/test_absint.py`, tier-1) and a
+repo-wide ratchet (`scripts/lint.py` against `analysis/baseline.json`
+and `analysis/certified.json`, with a git-diff `--changed` fast path).
 """
 
 from __future__ import annotations
@@ -30,4 +40,14 @@ from .graphs import (  # noqa: F401
     check_budgets,
     load_budgets,
     registered_graphs,
+)
+
+# octrange (Pass 3) — jax-free at import time; tracing happens lazily
+from .absint import (  # noqa: F401
+    certifiable_graphs,
+    certify_all,
+    certify_graph,
+    check_certified,
+    load_certified,
+    load_shapes,
 )

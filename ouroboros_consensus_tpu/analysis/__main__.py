@@ -1,15 +1,36 @@
-"""CLI: `python -m ouroboros_consensus_tpu.analysis [options]`.
+"""CLI: `python -m ouroboros_consensus_tpu.analysis [subcommand] [options]`.
 
-Default run = both passes over the package + the registered kernel
-graphs, exit 1 on any unsuppressed finding or budget violation.
+Default run = both static passes over the package + the registered
+kernel graphs (AST rules, jaxpr budgets, point-op budgets).
 
-  --json            machine-readable report on stdout
+Subcommands:
+  range      octrange interval/overflow certification (analysis/absint)
+  taint      octrange secret-taint certification
+  pointops   per-lane point-op counts vs their budgets.json ceilings
+
+Shared options:
+  --json            machine-readable report on stdout (keys sorted —
+                    stable for CI diffing)
+  --graphs G [G...] restrict to these graphs
+
+Default-run options:
   --paths P [P...]  lint these packages/files instead of the package
   --no-graphs       skip Pass 2 (pure AST run, no jax import)
-  --graphs G [G...] analyze only these registered graphs
   --all             include suppressed findings in the report
   --baseline B      subtract baselined finding keys (ratchet mode —
                     scripts/lint.py drives this)
+
+range/taint options:
+  --tier {fast,full}  lane-sweep tier from shapes.json (default fast)
+  --no-ratchet        report only; skip the certified.json comparison
+
+Exit codes (distinct so CI can tell WHY the gate failed):
+  0  clean
+  1  unsuppressed AST finding(s)
+  2  usage error (argparse)
+  3  jaxpr-metric or point-op budget violation
+  4  certification failure (range proof lost / taint ratchet violation)
+When several classes fire at once the lowest code wins (1 < 3 < 4).
 """
 
 from __future__ import annotations
@@ -21,26 +42,124 @@ import sys
 
 from . import astlint, graphs
 
+EXIT_OK = 0
+EXIT_FINDINGS = 1
+EXIT_BUDGET = 3
+EXIT_CERT = 4
+
 
 def _package_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def main(argv: list[str] | None = None) -> int:
-    ap = argparse.ArgumentParser(prog="ouroboros_consensus_tpu.analysis")
-    ap.add_argument("--json", action="store_true")
-    ap.add_argument("--paths", nargs="+", default=None)
-    ap.add_argument("--no-graphs", action="store_true")
-    ap.add_argument("--graphs", nargs="+", default=None,
-                    choices=graphs.registered_graphs())
-    ap.add_argument("--all", action="store_true",
-                    help="include suppressed findings")
-    ap.add_argument("--baseline", default=None,
-                    help="baseline.json of grandfathered finding keys")
-    ap.add_argument("--budgets", default=None,
-                    help="alternate budgets.json")
-    args = ap.parse_args(argv)
+def _pin_cpu() -> None:
+    # abstract tracing never needs an accelerator, and this box's
+    # sitecustomize force-registers a TPU plugin whose client init can
+    # hang on a wedged tunnel — pin the platform BEFORE the first
+    # backend touch so the lint gate cannot block on hardware
+    import jax
 
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # already initialized (e.g. under pytest conftest)
+
+
+def _emit(payload: dict, as_json: bool, lines: list[str]) -> None:
+    if as_json:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        for ln in lines:
+            print(ln)
+
+
+def _cmd_certify(args, domain: str) -> int:
+    from . import absint
+
+    _pin_cpu()
+    names = args.graphs or [
+        n for n in absint.certifiable_graphs()
+        if domain in absint._spec_of(n).get("domains", ["range", "taint"])
+    ]
+    shapes = absint.load_shapes()
+    reports = []
+    for name in names:
+        if domain == "range":
+            for lanes in (
+                [args.lanes] if args.lanes is not None
+                else absint.sweep_lanes(name, args.tier, shapes)
+            ):
+                reports.append(absint.certify_range(name, lanes, shapes))
+        else:
+            lanes = (args.lanes if args.lanes is not None
+                     else absint.sweep_lanes(name, args.tier, shapes)[0])
+            reports.append(absint.certify_taint(name, lanes, shapes))
+    violations: list[str] = []
+    if not args.no_ratchet:
+        violations = absint.check_certified(reports)
+    failed = [r for r in reports if not r.ok]
+    lines = []
+    for r in reports:
+        lanes = "default" if r.lanes is None else r.lanes
+        status = "ok" if r.ok else "FAIL"
+        extra = (" lane-universal" if r.domain == "range"
+                 and r.lane_universal else "")
+        lines.append(
+            f"{r.graph}@{lanes} [{r.domain}] {status}: "
+            f"{len(r.findings)} finding(s), {r.eqns} eqns{extra}"
+        )
+        lines.extend(f"  {f.format()}" for f in r.findings)
+    lines.extend(f"RATCHET: {v}" for v in violations)
+    lines.append(
+        f"octrange {domain}: {len(failed)} failing graph-sweep(s), "
+        f"{len(violations)} ratchet violation(s)"
+    )
+    _emit(
+        {
+            "domain": domain,
+            "reports": [r.to_dict() for r in reports],
+            "ratchet_violations": violations,
+            "ok": not (failed or violations),
+        },
+        args.json, lines,
+    )
+    return EXIT_CERT if (failed or violations) else EXIT_OK
+
+
+def _cmd_pointops(args) -> int:
+    _pin_cpu()
+    budgets = graphs.load_budgets(args.budgets)
+    sec = budgets.get("point_ops", {})
+    names = args.graphs or sorted(sec)
+    rows = []
+    for name in names:
+        cfg = sec.get(name)
+        lanes = int(cfg["at_lanes"]) if cfg else None
+        stats = graphs.point_ops(name, lanes)
+        rows.append({
+            "graph": name,
+            "at_lanes": lanes,
+            "ops": stats["ops"],
+            "lane_ops": stats["lane_ops"],
+            "lane_ops_per_lane": (
+                stats["lane_ops"] / lanes if lanes else None
+            ),
+            "budget": cfg["lane_ops_per_lane"] if cfg else None,
+        })
+    violations = graphs.check_point_ops(budgets, names=names)
+    lines = [
+        f"{r['graph']}@{r['at_lanes']}: {r['lane_ops_per_lane']:.1f} "
+        f"lane-ops/lane (budget {r['budget']})"
+        for r in rows
+    ]
+    lines.extend(f"BUDGET: {v}" for v in violations)
+    lines.append(f"pointops: {len(violations)} violation(s)")
+    _emit({"point_ops": rows, "violations": violations,
+           "ok": not violations}, args.json, lines)
+    return EXIT_BUDGET if violations else EXIT_OK
+
+
+def _cmd_default(args) -> int:
     paths = args.paths or [_package_root()]
     findings = astlint.lint_paths(paths)
 
@@ -71,19 +190,11 @@ def main(argv: list[str] | None = None) -> int:
     reports: list[graphs.GraphReport] = []
     violations: list[str] = []
     if not args.no_graphs:
-        # abstract tracing never needs an accelerator, and this box's
-        # sitecustomize force-registers a TPU plugin whose client init
-        # can hang on a wedged tunnel — pin the platform BEFORE the
-        # first backend touch so the lint gate cannot block on hardware
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass  # already initialized (e.g. under pytest conftest)
-        reports = graphs.analyze_registered(args.graphs)
+        _pin_cpu()
         budgets = graphs.load_budgets(args.budgets)
+        reports = graphs.analyze_registered(args.graphs)
         violations = graphs.check_budgets(reports, budgets)
+        violations += graphs.check_point_ops(budgets, names=args.graphs)
 
     failed = bool(active or violations)
 
@@ -107,7 +218,7 @@ def main(argv: list[str] | None = None) -> int:
             "budget_violations": violations,
             "ok": not failed,
         }
-        print(json.dumps(out, indent=2))
+        print(json.dumps(out, indent=2, sort_keys=True))
     else:
         for f in shown:
             print(f.format())
@@ -129,7 +240,55 @@ def main(argv: list[str] | None = None) -> int:
             f"octlint: {len(active)} finding(s), {n_sup} suppressed, "
             f"{len(violations)} budget violation(s){extra}"
         )
-    return 1 if failed else 0
+    if active:
+        return EXIT_FINDINGS
+    return EXIT_BUDGET if violations else EXIT_OK
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="ouroboros_consensus_tpu.analysis")
+    sub = ap.add_subparsers(dest="cmd")
+
+    def common(p, with_choices=True):
+        p.add_argument("--json", action="store_true")
+        p.add_argument(
+            "--graphs", nargs="+", default=None,
+            choices=None if not with_choices else None,
+        )
+        p.add_argument("--budgets", default=None,
+                       help="alternate budgets.json")
+
+    common(ap)
+    ap.add_argument("--paths", nargs="+", default=None)
+    ap.add_argument("--no-graphs", action="store_true")
+    ap.add_argument("--all", action="store_true",
+                    help="include suppressed findings")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline.json of grandfathered finding keys")
+
+    for name in ("range", "taint"):
+        p = sub.add_parser(name)
+        common(p)
+        p.add_argument("--tier", choices=("fast", "full"), default="fast")
+        p.add_argument("--lanes", type=int, default=None,
+                       help="override the swept lane count")
+        p.add_argument("--no-ratchet", action="store_true",
+                       help="skip the certified.json comparison")
+
+    common(sub.add_parser("pointops"))
+
+    args = ap.parse_args(argv)
+    if args.cmd in ("range", "taint"):
+        return _cmd_certify(args, args.cmd)
+    if args.cmd == "pointops":
+        return _cmd_pointops(args)
+    # default-run graph names must be registered (certification targets
+    # include aux graphs; the default run's budget pass does not)
+    if args.graphs:
+        bad = set(args.graphs) - set(graphs.registered_graphs())
+        if bad:
+            ap.error(f"unknown graphs: {sorted(bad)}")
+    return _cmd_default(args)
 
 
 if __name__ == "__main__":

@@ -64,6 +64,11 @@ RULES = {
     "OCT103": "mutable-global-capture",
     "OCT104": "wide-int-literal",
     "OCT105": "await-holding-lock",
+    # a suppression comment that suppresses nothing on the current tree
+    # is itself a finding: as files get rewritten, stale `# octlint:
+    # disable=…` comments would otherwise silently pre-authorize the
+    # next real hazard on that line (suppression rot)
+    "OCT106": "stale-suppression",
 }
 
 # rule tokens are letters-then-digits (OCT101); matching them strictly
@@ -103,6 +108,25 @@ _TRACED_MODULES = {"jax", "jax.numpy", "jax.lax"}
 
 _LOCK_ACQUIRE = {"acquire_read", "acquire_append", "acquire_write", "allocate"}
 _LOCK_RELEASE = {"release_read", "release_append", "release_write", "close"}
+
+
+def _comment_lines(source: str):
+    """(line_no, text) for every REAL comment in the source — tokenized
+    so a suppression example quoted inside a docstring neither
+    suppresses anything nor trips the OCT106 stale audit. Falls back to
+    a plain line scan if the file does not tokenize (the AST parse will
+    report the syntax error through its own path)."""
+    import io
+    import tokenize
+
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return list(enumerate(source.splitlines(), start=1))
+    return [
+        (t.start[0], t.string) for t in toks
+        if t.type == tokenize.COMMENT
+    ]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,41 +186,85 @@ class _ModuleModel:
         self.functions: dict[str, _FuncInfo] = {}
         self.suppress_file: set[str] = set()
         self.suppress_line: dict[int, set[str] | None] = {}
+        # declaration sites, in source order, for the OCT106 stale-
+        # suppression audit: each entry is [line, rules|None, file_level,
+        # used] and `used` flips the first time is_suppressed matches it
+        self.suppress_decls: list[list] = []
         self._scan_suppressions(source)
         self._scan()
 
     # -- suppression comments ------------------------------------------------
 
     def _scan_suppressions(self, source: str) -> None:
-        for i, line in enumerate(source.splitlines(), start=1):
+        for i, line in _comment_lines(source):
             m = _SUPPRESS_FILE_RE.search(line)
             if m:
-                self.suppress_file |= {
+                rules = {
                     r.strip() for r in m.group(1).split(",") if r.strip()
                 }
+                self.suppress_file |= rules
+                self.suppress_decls.append([i, rules, True, False])
                 continue
             m = _SUPPRESS_RE.search(line)
             if m:
                 rules = m.group(1)
                 if rules is None:
                     self.suppress_line[i] = None  # all rules
+                    self.suppress_decls.append([i, None, False, False])
                 else:
-                    self.suppress_line[i] = {
-                        r.strip() for r in rules.split(",") if r.strip()
-                    }
+                    rs = {r.strip() for r in rules.split(",") if r.strip()}
+                    self.suppress_line[i] = rs
+                    self.suppress_decls.append([i, rs, False, False])
+
+    def _mark_used(self, line: int | None, rule: str, file_level: bool):
+        """Credit the FIRST declaration that justified this suppression
+        (a redundant second declaration of the same rule stays unused
+        and the audit flags it)."""
+        for d in self.suppress_decls:
+            if d[2] != file_level:
+                continue
+            if file_level:
+                if d[1] is not None and rule in d[1]:
+                    d[3] = True
+                    return
+            elif d[0] == line and (d[1] is None or rule in d[1]):
+                d[3] = True
+                return
 
     def is_suppressed(self, rule: str, line: int, def_line: int | None) -> bool:
         if rule in self.suppress_file:
+            self._mark_used(None, rule, True)
             return True
         for ln in (line, def_line):
             if ln is None:
                 continue
             rules = self.suppress_line.get(ln, "missing")
-            if rules is None:
-                return True
-            if rules != "missing" and rule in rules:
+            if rules is None or (rules != "missing" and rule in rules):
+                self._mark_used(ln, rule, False)
                 return True
         return False
+
+    def stale_suppressions(self) -> list[Finding]:
+        """OCT106: declarations that suppressed nothing during this
+        lint run. Called AFTER every rule has visited the module. A
+        stale comment that itself lists OCT106 suppresses its own
+        finding (and thereby stops being stale) — the reviewed way to
+        keep a deliberately-pre-emptive suppression."""
+        out = []
+        for d in self.suppress_decls:
+            if d[3]:
+                continue
+            line, rules, file_level, _ = d
+            what = "all rules" if rules is None else ",".join(sorted(rules))
+            kind = "disable-file" if file_level else "disable"
+            sup = self.is_suppressed("OCT106", line, None)
+            out.append(Finding(
+                "OCT106", self.path, line, 0,
+                f"`# octlint: {kind}={what}` suppresses nothing on the "
+                "current tree — remove the stale comment",
+                sup,
+            ))
+        return out
 
     # -- imports / globals / functions --------------------------------------
 
@@ -799,6 +867,9 @@ def lint_paths(paths: list[str], rel_to: str | None = None) -> list[Finding]:
                 if info.reachable:
                     findings.extend(_check_function(pkg, model, info))
                 findings.extend(_check_async_locks(model, info))
+            # OCT106 runs last: it audits which declarations the rules
+            # above actually consumed
+            findings.extend(model.stale_suppressions())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     # disambiguate duplicate keys in source order (see Finding.seq)
     counts: dict[str, int] = {}

@@ -149,7 +149,13 @@ def analyze_jaxpr(closed_jaxpr, name: str = "graph") -> GraphReport:
 # Kernel registry: every graph the repo dispatches, with the abstract
 # input shapes it is traced at. T (the batch tile) only scales array
 # widths, never graph structure, so a tiny T keeps tracing fast while
-# the metrics match production shapes exactly.
+# the metrics match production shapes exactly. Every builder takes an
+# optional lane-count override `t`: the octrange interval certification
+# (analysis/absint.py) re-traces the lane-SENSITIVE graphs (msm,
+# aggregate, verdict_reduce — anything that reduces over the lane axis)
+# at production lane counts, while budgets and the lane-INVARIANT
+# certificates share the default small-tile trace through trace_graph's
+# cache.
 # ---------------------------------------------------------------------------
 
 _T = 2
@@ -164,44 +170,49 @@ def _s(*shape):
     return jax.ShapeDtypeStruct(shape, jnp.int32)
 
 
-def _pk_core_args():
+def _pk_core_args(t):
     return (
-        _s(32, _T), _s(32, _T), _s(32, _T), _s(_NB, 128, _T), _s(_T),
-        _s(32, _T), _s(_T), _s(32, _T), _s(32, _T), _s(32, _T),
-        _s(_DEPTH, 32, _T), _s(_NB, 128, _T), _s(_T),
-        _s(32, _T), _s(32, _T), _s(16, _T), _s(32, _T), _s(32, _T),
-        _s(64, _T), _s(32, _T), _s(32, _T),
+        _s(32, t), _s(32, t), _s(32, t), _s(_NB, 128, t), _s(t),
+        _s(32, t), _s(t), _s(32, t), _s(32, t), _s(32, t),
+        _s(_DEPTH, 32, t), _s(_NB, 128, t), _s(t),
+        _s(32, t), _s(32, t), _s(16, t), _s(32, t), _s(32, t),
+        _s(64, t), _s(32, t), _s(32, t),
     )
 
 
-def _graph_ed_core():
+def _graph_ed_core(t=None):
     from ..ops.pk import verify as pv
 
-    return pv.ed_core, (_s(32, _T), _s(32, _T), _s(_NB, 128, _T), _s(_T))
+    t = t or _T
+    return pv.ed_core, (_s(32, t), _s(32, t), _s(_NB, 128, t), _s(t))
 
 
-def _graph_kes_core():
+def _graph_kes_core(t=None):
     import functools
 
     from ..ops.pk import verify as pv
 
+    t = t or _T
     fn = functools.partial(pv.kes_core, depth=_DEPTH)
     return fn, (
-        _s(32, _T), _s(_T), _s(32, _T), _s(32, _T), _s(_DEPTH, 32, _T),
-        _s(_NB, 128, _T), _s(_T),
+        _s(32, t), _s(t), _s(32, t), _s(32, t), _s(_DEPTH, 32, t),
+        _s(_NB, 128, t), _s(t),
     )
 
 
-def _graph_vrf_core():
+def _graph_vrf_core(t=None):
     from ..ops.pk import verify as pv
 
+    t = t or _T
     return pv.vrf_core, (
-        _s(32, _T), _s(32, _T), _s(16, _T), _s(32, _T), _s(32, _T)
+        _s(32, t), _s(32, t), _s(16, t), _s(32, t), _s(32, t)
     )
 
 
-def _graph_finish_core():
+def _graph_finish_core(t=None):
     from ..ops.pk import verify as pv
+
+    t = t or _T
 
     def fn(ed_ok, ed_pt, ed_r, kes_ok, kes_pt, kes_r, vrf_ok, vrf_flat,
            c, beta, tlo, thi):
@@ -217,61 +228,62 @@ def _graph_finish_core():
         )
 
     return fn, (
-        _s(_T), _s(80, _T), _s(32, _T), _s(_T), _s(80, _T), _s(32, _T),
-        _s(_T), _s(400, _T), _s(16, _T), _s(64, _T), _s(32, _T), _s(32, _T),
+        _s(t), _s(80, t), _s(32, t), _s(t), _s(80, t), _s(32, t),
+        _s(t), _s(400, t), _s(16, t), _s(64, t), _s(32, t), _s(32, t),
     )
 
 
-def _graph_verify_praos_core():
+def _graph_verify_praos_core(t=None):
     import functools
 
     from ..ops.pk import verify as pv
 
     fn = functools.partial(pv.verify_praos_core, kes_depth=_DEPTH)
-    return fn, _pk_core_args()
+    return fn, _pk_core_args(t or _T)
 
 
-def _pk_core_args_bc():
+def _pk_core_args_bc(t):
     # batch-compatible composed shapes: vrf_c [16, T] is replaced by the
     # announced u, v [32, T] columns
     return (
-        _s(32, _T), _s(32, _T), _s(32, _T), _s(_NB, 128, _T), _s(_T),
-        _s(32, _T), _s(_T), _s(32, _T), _s(32, _T), _s(32, _T),
-        _s(_DEPTH, 32, _T), _s(_NB, 128, _T), _s(_T),
-        _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T),
-        _s(32, _T),
-        _s(64, _T), _s(32, _T), _s(32, _T),
+        _s(32, t), _s(32, t), _s(32, t), _s(_NB, 128, t), _s(t),
+        _s(32, t), _s(t), _s(32, t), _s(32, t), _s(32, t),
+        _s(_DEPTH, 32, t), _s(_NB, 128, t), _s(t),
+        _s(32, t), _s(32, t), _s(32, t), _s(32, t), _s(32, t),
+        _s(32, t),
+        _s(64, t), _s(32, t), _s(32, t),
     )
 
 
-def _graph_vrf_bc_core():
+def _graph_vrf_bc_core(t=None):
     from ..ops.pk import verify as pv
 
+    t = t or _T
     return pv.vrf_core_bc, (
-        _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T),
-        _s(32, _T),
+        _s(32, t), _s(32, t), _s(32, t), _s(32, t), _s(32, t),
+        _s(32, t),
     )
 
 
-def _graph_verify_praos_core_bc():
+def _graph_verify_praos_core_bc(t=None):
     import functools
 
     from ..ops.pk import verify as pv
 
     fn = functools.partial(pv.verify_praos_core_bc, kes_depth=_DEPTH)
-    return fn, _pk_core_args_bc()
+    return fn, _pk_core_args_bc(t or _T)
 
 
-def _graph_msm():
+def _graph_msm(t=None):
     """One Pippenger MSM (ops/pk/msm.py) at a tiny lane count: the
     fori-fenced scans keep the chain depth flat in N, so tiny shapes pin
-    the same structure the bench-scale aggregate dispatches."""
-    import functools
-
+    the same structure the bench-scale aggregate dispatches. (The
+    interval certification re-traces at production N — the bucket-count
+    accumulators are the lane-sensitive part.)"""
     from ..ops.pk import curve as pc
     from ..ops.pk import msm as pk_msm
 
-    n = 4
+    n = t or 4
 
     def fn(scalars, x, y, z, t):
         return pk_msm.msm(scalars, pc.Point(x, y, z, t), 256)
@@ -279,25 +291,26 @@ def _graph_msm():
     return fn, (_s(20, n), _s(20, n), _s(20, n), _s(20, n), _s(20, n))
 
 
-def _graph_aggregate_core():
+def _graph_aggregate_core(t=None):
     """The full aggregated window program (ops/pk/aggregate.py): cheap
     per-lane work + Fiat–Shamir coefficients + the two-group MSM."""
     import functools
 
     from ..ops.pk import aggregate as pk_aggregate
 
+    t = t or _T
     fn = functools.partial(pk_aggregate.aggregate_window, kes_depth=_DEPTH)
     return fn, (
-        _s(32, _T), _s(32, _T), _s(32, _T), _s(_NB, 128, _T), _s(1, _T),
-        _s(32, _T), _s(1, _T), _s(32, _T), _s(32, _T), _s(32, _T),
-        _s(_DEPTH, 32, _T), _s(_NB, 128, _T), _s(1, _T),
-        _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T), _s(32, _T),
-        _s(32, _T),
-        _s(64, _T), _s(32, _T), _s(32, _T),
+        _s(32, t), _s(32, t), _s(32, t), _s(_NB, 128, t), _s(1, t),
+        _s(32, t), _s(1, t), _s(32, t), _s(32, t), _s(32, t),
+        _s(_DEPTH, 32, t), _s(_NB, 128, t), _s(1, t),
+        _s(32, t), _s(32, t), _s(32, t), _s(32, t), _s(32, t),
+        _s(32, t),
+        _s(64, t), _s(32, t), _s(32, t),
     )
 
 
-def _graph_spmd_local():
+def _graph_spmd_local(t=None):
     """The per-shard body of parallel/spmd._sharded_verify: the XLA-twin
     `protocol.batch.verify_praos` plus the verdict collectives, traced
     under a single-device mesh (collective structure is device-count
@@ -309,7 +322,7 @@ def _graph_spmd_local():
 
     from ..parallel import spmd
 
-    b = 8
+    b = t or 8
 
     def u8(*shape):
         return jax.ShapeDtypeStruct(shape, jnp.uint8)
@@ -333,7 +346,7 @@ def _graph_spmd_local():
     return fn, cols
 
 
-def _graph_packed_unpack():
+def _graph_packed_unpack(t=None):
     """The PRODUCTION packed `unpack` stage
     (ops/pk/kernels._mk_packed_unpack): protocol/batch.unpack_packed —
     body-sourced u8 columns -> the 21 staged columns, including the
@@ -348,7 +361,7 @@ def _graph_packed_unpack():
     from ..ops.pk import kernels as pk_kernels
     from ..protocol import batch as pbatch
 
-    b = 4
+    b = t or 4
     layout = pbatch.PraosPackedLayout(
         body_len=304, o_issuer=0, o_vrf_vk=32, o_vrf_out=64,
         o_vrf_proof=128, o_vk_hot=208, o_sigma=240,
@@ -365,7 +378,7 @@ def _graph_packed_unpack():
     return pk_kernels._mk_packed_unpack(layout), args
 
 
-def _graph_verdict_reduce():
+def _graph_verdict_reduce(t=None):
     """The packed D2H reduction (protocol/batch.verdict_reduce,
     scan=True): verdict-bit packing + the sequential Blake2b nonce scan
     (ops/blake2b.nonce_fold_scan). The scan body is a separate
@@ -377,7 +390,7 @@ def _graph_verdict_reduce():
 
     from ..protocol import batch as pbatch
 
-    b = 8
+    b = t or 8
 
     def bl(*shape):
         return jax.ShapeDtypeStruct(shape, jnp.bool_)
@@ -405,15 +418,122 @@ REGISTRY: dict[str, Callable] = {
 }
 
 
+# Source modules (repo-relative) each graph's trace actually executes —
+# the `scripts/lint.py --changed` fast path re-analyzes only graphs
+# whose module set intersects the git diff. Shared leaves (limbs, curve,
+# hashes, field) appear in every pk graph by construction.
+_PK_COMMON = [
+    "ouroboros_consensus_tpu/ops/pk/limbs.py",
+    "ouroboros_consensus_tpu/ops/pk/curve.py",
+    "ouroboros_consensus_tpu/ops/pk/hashes.py",
+    "ouroboros_consensus_tpu/ops/pk/verify.py",
+    "ouroboros_consensus_tpu/ops/field.py",
+    "ouroboros_consensus_tpu/ops/bigint.py",
+    "ouroboros_consensus_tpu/ops/sha512.py",
+    "ouroboros_consensus_tpu/ops/blake2b.py",
+    "ouroboros_consensus_tpu/ops/u64.py",
+]
+_XLA_TWIN = [
+    "ouroboros_consensus_tpu/ops/curve.py",
+    "ouroboros_consensus_tpu/ops/scalar.py",
+    "ouroboros_consensus_tpu/ops/ed25519_batch.py",
+    "ouroboros_consensus_tpu/ops/kes_batch.py",
+    "ouroboros_consensus_tpu/ops/ecvrf_batch.py",
+    "ouroboros_consensus_tpu/protocol/batch.py",
+]
+GRAPH_SOURCES: dict[str, list[str]] = {
+    "ed_core": _PK_COMMON,
+    "kes_core": _PK_COMMON,
+    "vrf_core": _PK_COMMON,
+    "vrf_bc_core": _PK_COMMON,
+    "finish_core": _PK_COMMON,
+    "verify_praos_core": _PK_COMMON,
+    "verify_praos_core_bc": _PK_COMMON,
+    "msm": _PK_COMMON + ["ouroboros_consensus_tpu/ops/pk/msm.py"],
+    "aggregate_core": _PK_COMMON + [
+        "ouroboros_consensus_tpu/ops/pk/msm.py",
+        "ouroboros_consensus_tpu/ops/pk/aggregate.py",
+    ],
+    "spmd_sharded_verify": _XLA_TWIN + [
+        "ouroboros_consensus_tpu/parallel/spmd.py",
+        "ouroboros_consensus_tpu/ops/field.py",
+        "ouroboros_consensus_tpu/ops/bigint.py",
+        "ouroboros_consensus_tpu/ops/sha512.py",
+        "ouroboros_consensus_tpu/ops/blake2b.py",
+        "ouroboros_consensus_tpu/ops/u64.py",
+    ],
+    "packed_unpack": _PK_COMMON + [
+        "ouroboros_consensus_tpu/ops/pk/kernels.py",
+        "ouroboros_consensus_tpu/protocol/batch.py",
+    ],
+    "verdict_reduce": [
+        "ouroboros_consensus_tpu/protocol/batch.py",
+        "ouroboros_consensus_tpu/ops/blake2b.py",
+        "ouroboros_consensus_tpu/ops/u64.py",
+    ],
+}
+
+
+# the tile each builder bakes when called with t=None — trace_graph
+# normalizes an explicit t equal to the builder default onto the (name,
+# None) cache key so the budget, point-op and certification passes share
+# one trace per graph
+DEFAULT_TILES: dict[str, int] = {
+    "ed_core": _T, "kes_core": _T, "vrf_core": _T, "vrf_bc_core": _T,
+    "finish_core": _T, "verify_praos_core": _T, "verify_praos_core_bc": _T,
+    "aggregate_core": _T, "msm": 4, "spmd_sharded_verify": 8,
+    "packed_unpack": 4, "verdict_reduce": 8,
+}
+
+
 def registered_graphs() -> list[str]:
     return sorted(REGISTRY)
 
 
-def trace_graph(name: str):
+# trace cache: (name, t) -> ClosedJaxpr. One tier-1 pytest process
+# traces each composed graph ONCE no matter how many passes (budgets,
+# golden pin, interval, taint, point-ops) consume it — the traces are
+# the expensive part (30-60 s each for the composed cores). Capped LRU:
+# a composed jaxpr holds ~200k eqn objects, so an unbounded cache would
+# pin gigabytes across a full slow-tier sweep; consumers that want
+# sharing run their passes per graph before moving on.
+_TRACE_CACHE_MAX = 3
+_TRACE_CACHE: dict[tuple[str, int | None], object] = {}
+# trace-time point-op capture (ops/pk/curve.py op_counter), recorded as
+# a free by-product of every cached trace: (name, t) -> dict (kept for
+# all keys — counts are tiny)
+_POINT_OPS: dict[tuple[str, int | None], dict] = {}
+
+
+def trace_graph(name: str, t: int | None = None):
     import jax
 
-    fn, args = REGISTRY[name]()
-    return jax.make_jaxpr(fn)(*args)
+    if t is not None and t == DEFAULT_TILES.get(name):
+        t = None
+    key = (name, t)
+    if key in _TRACE_CACHE:
+        _TRACE_CACHE[key] = _TRACE_CACHE.pop(key)  # LRU touch
+        return _TRACE_CACHE[key]
+    from ..ops.pk import curve as pc
+
+    fn, args = REGISTRY[name](t)
+    with pc.op_counter() as stats:
+        traced = jax.make_jaxpr(fn)(*args)
+    _POINT_OPS[key] = {"ops": stats["ops"], "lane_ops": stats["lane_ops"]}
+    _TRACE_CACHE[key] = traced
+    while len(_TRACE_CACHE) > _TRACE_CACHE_MAX:
+        _TRACE_CACHE.pop(next(iter(_TRACE_CACHE)))
+    return traced
+
+
+def point_ops(name: str, t: int | None = None) -> dict:
+    """Point-op counts captured while tracing (name, t); traces on
+    first use. Only the ops/pk graphs route through the counted
+    add/double helpers — other graphs report zeros."""
+    if t is not None and t == DEFAULT_TILES.get(name):
+        t = None
+    trace_graph(name, t)
+    return dict(_POINT_OPS[(name, t)])
 
 
 def analyze_registered(names: list[str] | None = None) -> list[GraphReport]:
@@ -433,6 +553,35 @@ _BUDGET_PATH = os.path.join(os.path.dirname(__file__), "budgets.json")
 def load_budgets(path: str | None = None) -> dict:
     with open(path or _BUDGET_PATH, encoding="utf-8") as f:
         return json.load(f)
+
+
+def check_point_ops(budgets: dict | None = None,
+                    names: list[str] | None = None) -> list[str]:
+    """Third ratcheted metric (promoted from scripts/count_point_ops.py):
+    per-lane point-op ceilings per graph, pinned in budgets.json under
+    "point_ops" as {"at_lanes": T, "lane_ops_per_lane": ceiling}.
+    Counts come free with the (name, at_lanes) trace (the op_counter
+    capture in trace_graph), so a gate that already traced the graph for
+    budgets/certification pays nothing extra. A perf regression in the
+    MSM/aggregate path — more adds per bucket pass, a lost shared
+    doubling chain — fails here statically, without a device."""
+    budgets = budgets if budgets is not None else load_budgets()
+    sec = budgets.get("point_ops", {})
+    violations = []
+    for name in sorted(sec):
+        if names is not None and name not in names:
+            continue
+        cfg = sec[name]
+        lanes = int(cfg["at_lanes"])
+        ceiling = float(cfg["lane_ops_per_lane"])
+        stats = point_ops(name, lanes)
+        per_lane = stats["lane_ops"] / lanes
+        if per_lane > ceiling:
+            violations.append(
+                f"{name}: {per_lane:.1f} point lane-ops/lane at "
+                f"{lanes} lanes exceeds budget {ceiling:g}"
+            )
+    return violations
 
 
 def check_budgets(reports: list[GraphReport],

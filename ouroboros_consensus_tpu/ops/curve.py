@@ -272,6 +272,13 @@ def _base_table(wbits: int) -> np.ndarray:  # octlint: disable=OCT103 — append
 
 
 def _base_mul_windows(digits, wbits: int) -> Point:
+    """Fixed-base s·B by table walk. On the SIGN path the digits derive
+    from the secret nonce/scalar, making the window-table `jnp.take`
+    below the repo's one secret-indexed access — pinned as such in
+    analysis/certified.json (octrange taint pass; any second
+    secret-steered site is a ratchet violation). Batch lanes gather the
+    whole [2^wbits, 4, 20] window from device memory with no
+    CPU-cache-line timing channel, but the inventory stays explicit."""
     table = jnp.asarray(_base_table(wbits))  # [windows, 2^wbits, 4, 20]
     windows = table.shape[0]
 

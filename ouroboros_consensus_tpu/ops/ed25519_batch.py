@@ -145,7 +145,15 @@ def sign(a, a_enc, rblocks, rnblocks, hblocks, hnblocks):
     spliced on device), s = r + h·a mod L. Mirrors ops/host/ed25519.sign;
     the reference reaches this via HotKey.sign / forgeBlock
     (ouroboros-consensus-protocol/.../Protocol/Ledger/HotKey.hs:124,
-    shelley Protocol/Praos.hs:102)."""
+    shelley Protocol/Praos.hs:102).
+
+    Secret-flow certificate (octrange): `a` and the nonce-hash blocks
+    carry REAL `secret:` taint marks (analysis/shapes.json
+    `ed25519_sign`); the taint pass proves they reach no branch
+    predicate and exactly ONE access pattern — the fixed-base ladder's
+    window-table gather in ops/curve._base_mul_windows, pinned in
+    analysis/certified.json. The outputs (R, s) are a public signature
+    by construction, so output materialization is declassified there."""
     from . import bigint as bi
 
     r = scalar.reduce512(sha512.sha512(jnp.asarray(rblocks), jnp.asarray(rnblocks)))

@@ -19,6 +19,16 @@ shape-polymorphic over leading batch dimensions.
 Reference equivalent: the C libsodium field arithmetic (fe25519, radix
 2^25.5/2^51) used by `cardano-crypto-class`/`cardano-crypto-praos`; call
 sites in the reference hot path are cited in ops/host/ed25519.py.
+
+Bound certification (octrange, analysis/absint.py): the invariants
+above are machine-checked wherever this module's graphs are registered
+(the XLA-twin spmd path, the ed25519 sign path) — per-row intervals
+along the MINOR [..., 20] limb axis (`LastRows` in analysis/domains.py;
+the transposed twin of ops/pk/limbs.py's axis-0 `Rows`), B_MAX seeding,
+and a widening ladder whose 9500 rung exists precisely so loop-carried
+field elements re-prove the nearly-normalized invariant at the
+scan/fori fixpoint instead of drifting to 2^14 and pushing the next
+mul bound past 2^31.
 """
 
 from __future__ import annotations

@@ -53,6 +53,18 @@ R_e, R_k, U, V — ~4 extra Shanks chains/lane), hash-to-curve, the
 challenge + beta hashes, the beta compare, Merkle root walk, leader
 range extensions. Pure jnp over the limb-first layout (XLA path; the
 MSM's sorts have no Mosaic lowering — see ops/pk/msm.py docstring).
+
+Certification (octrange, analysis/absint.py): the whole window program
+(`aggregate_core`) is interval-proven no-overflow at the production
+8192-lane window — in particular the mod-L coefficient products
+(limbs.mul_mod_l, < 2^506 before Barrett) and the cross-lane
+`sum_mod_l` accumulators, whose per-term carry normalization is the
+PR 3 fix octrange retroactively proves (262k-lane-term boundary shape
+in analysis/shapes.json). The taint pass marks every verifier input
+`wire:` (public), so the Fiat–Shamir z_i — and therefore the MSM's
+argsort keys — provably carry no secret marks; per-lane point-op
+counts (260/lane at 8192, the 5.35× PR 3 win) are ratcheted in
+budgets.json `point_ops`.
 """
 
 from __future__ import annotations

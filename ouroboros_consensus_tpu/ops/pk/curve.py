@@ -52,7 +52,7 @@ def identity(t: int) -> Point:
 # only for fully unrolled programs — the MSM/aggregate path qualifies
 # (python loops + associative structure); the per-lane ladders do not
 # (fori walks) and are counted analytically by the script instead.
-# octlint: disable=OCT103 — trace-time-only accounting, reset per run
+# Trace-time-only accounting, reset per run by op_counter().
 _OPSTATS: dict = {"on": False, "ops": 0, "lane_ops": 0}
 
 

@@ -13,6 +13,21 @@ fully.
 
 Reference equivalent: libsodium fe25519 / sc25519 (see ops/field.py,
 ops/scalar.py docstrings for the reference call sites).
+
+Bound certification (octrange, analysis/absint.py): the carry headroom
+claims in the docstrings below are machine-checked per ROW of the limb
+axis — inputs seeded at the B_MAX = 9500 nearly-normalized bound (or
+8191 for normalized scalars), every int32 intermediate proven inside
+2^31 at the production lane counts (`python -m
+ouroboros_consensus_tpu.analysis range`), pinned in
+analysis/certified.json. Per-row tracking is what makes `mul` provable
+at all: rows 39-40 of the accumulator hold only carry residues, so the
+FOLD^2 fold on row 40 is bounded by ~21·FOLD^2, far under the
+whole-tensor worst case 9500·FOLD^2 > 2^31. `sum_mod_l`'s per-term
+normalization is proven at the 3×87381 = 262,143-lane-term boundary
+(just under the 2^31/8191 = 262,177 threshold an un-normalized
+accumulator trips) and regression-flagged when reverted
+(tests/test_absint.py).
 """
 
 from __future__ import annotations

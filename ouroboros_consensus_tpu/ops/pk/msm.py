@@ -27,7 +27,19 @@ Structure (classic Pippenger, arranged for batch-uniform XLA):
 Point-op work per window ≈ N bucket adds + C chunk combines + 3D
 boundary ops, so a 9-points/lane aggregate over the Praos equations
 costs ≈ (4·⌈128/c⌉ + 5·⌈253/c⌉)·T lane-point-adds — ~5.8x below the
-per-lane ladders at c=8 (scripts/count_point_ops.py measures both).
+per-lane ladders at c=8 (scripts/count_point_ops.py measures both; the
+measured 48 lane-ops/lane at 8192 is RATCHETED in budgets.json
+`point_ops`, so an extra bucket pass fails scripts/lint.py statically).
+
+Certification (octrange, analysis/absint.py): interval no-overflow is
+proven at the production 8192-lane window (the digit/bucket-count
+accumulators are the lane-sensitive part), and the taint pass proves
+the per-window argsort steers on PUBLIC data only — its keys derive
+exclusively from `wire:`-marked header bytes (the Fiat–Shamir
+coefficients of ops/pk/aggregate.py), never from a secret, and every
+steering site (sort/gather/scatter-add below) is inventoried in
+analysis/certified.json so a new data-dependent access is a ratchet
+violation.
 
 Everything is pure jnp over the ops/pk limb-first [20, X] layout and
 runs on the XLA path of ops/pk/{limbs,curve} (argsort/gather have no

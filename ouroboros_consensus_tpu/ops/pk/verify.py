@@ -18,6 +18,15 @@ Layout: batch tile T last everywhere (bytes [n, T] int32, points
 [20, T] limb coordinates). All control flow is batch-uniform; failures
 are mask lanes. Differentially tested against the host verifiers and
 the XLA twins in tests/test_pk_verify.py.
+
+Certification (octrange, analysis/absint.py): each core and both
+composed graphs are interval-proven no-overflow with inputs at the
+byte/limb bound classes of analysis/shapes.json, and the proofs are
+LANE-UNIVERSAL — machine-verified to not depend on the batch tile T
+(every reduction here is over limb/byte axes, never lanes), so the
+registry-tile certificate covers the production 8192-lane window. The
+taint pass confirms batch-uniformity semantically: wire marks reach no
+branch predicate or access pattern. Ratcheted in analysis/certified.json.
 """
 
 from __future__ import annotations

@@ -1,12 +1,23 @@
 """Point-op accounting: per-lane ladder path vs aggregated RLC/MSM path.
 
-Traces both programs at a configurable lane count with the trace-time
-op counter in ops/pk/curve.py (fori-fenced loop bodies contribute their
-full trip counts via explicit multipliers, so the numbers are exact) and
-prints invocation and lane-op totals plus the reduction factor — the
-CPU-measured evidence PERF.md round 7 records against the ≥5x bar.
+The ratchet version of this accounting now lives in the analysis
+package: every `analysis/graphs.py` trace_graph() call captures the
+trace-time op counter (ops/pk/curve.py) for free, and
+`graphs.check_point_ops` fails any graph over its budgets.json
+"point_ops" ceiling — scripts/lint.py and
+`python -m ouroboros_consensus_tpu.analysis pointops` drive it in CI.
 
-Usage: JAX_PLATFORMS=cpu python scripts/count_point_ops.py [T]
+This script keeps the PERF.md evidence mode: it traces the per-lane
+composed core against the aggregated window program at
+production-grade constants (NB=3, KES depth 7 — the registry uses
+reduced tiles) and prints the reduction factor measured against the
+>=5x bar of round 7.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/count_point_ops.py [T]
+    JAX_PLATFORMS=cpu python scripts/count_point_ops.py --check
+        # run the budgets.json point_ops ratchet and exit nonzero on
+        # any violation (same check scripts/lint.py applies)
 """
 
 import os
@@ -25,7 +36,7 @@ from ouroboros_consensus_tpu.ops.pk import aggregate as agg  # noqa: E402
 from ouroboros_consensus_tpu.ops.pk import curve as pc  # noqa: E402
 from ouroboros_consensus_tpu.ops.pk import verify as pv  # noqa: E402
 
-T = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+T = 1024
 NB = 3
 DEPTH = 7
 
@@ -62,6 +73,15 @@ def count(fn, args, label):
 
 
 def main():
+    if "--check" in sys.argv:
+        from ouroboros_consensus_tpu.analysis import graphs
+
+        violations = graphs.check_point_ops()
+        for v in violations:
+            print(f"BUDGET: {v}")
+        print(f"pointops ratchet: {len(violations)} violation(s)")
+        return 1 if violations else 0
+
     per_lane = count(
         functools.partial(pv.verify_praos_core_bc, kes_depth=DEPTH),
         _args_core_bc(), f"per-lane ladders (T={T})",
@@ -72,7 +92,11 @@ def main():
     )
     print(f"point-op reduction: {per_lane / aggregated:.2f}x "
           f"({per_lane / T:.0f} -> {aggregated / T:.0f} lane-ops/lane)")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    if args:
+        T = int(args[0])
+    sys.exit(main())

@@ -1,16 +1,32 @@
 #!/usr/bin/env python
-"""Repo-wide octlint gate: both static-analysis passes, ratcheted.
+"""Repo-wide octlint + octrange gate: all static-analysis passes,
+ratcheted.
 
-    python scripts/lint.py              # AST pass + jaxpr budgets
-    python scripts/lint.py --no-graphs  # AST pass only (no jax import)
-    python scripts/lint.py --update-baseline   # re-grandfather
+    python scripts/lint.py                    # AST + budgets + point-ops
+                                              #   + octrange certification
+    python scripts/lint.py --no-graphs        # AST pass only (no jax)
+    python scripts/lint.py --changed          # re-trace only graphs whose
+                                              #   source modules differ from
+                                              #   git HEAD (fast path)
+    python scripts/lint.py --tier full        # full lane sweeps
+    python scripts/lint.py --update-baseline  # re-grandfather AST keys
+    python scripts/lint.py --update-certified # re-pin certification
 
-Exit 0 = no NEW findings (anything in analysis/baseline.json is
-grandfathered) and every registered kernel graph within its
-analysis/budgets.json ceiling. Exit 1 otherwise. The baseline only ever
-shrinks in normal operation — fixing a grandfathered finding makes its
-key stale, and the gate prints a reminder to re-run --update-baseline
-so the ratchet tightens.
+Exit 0 = no NEW AST findings (anything in analysis/baseline.json is
+grandfathered), every registered kernel graph within its
+analysis/budgets.json ceilings (jaxpr metrics AND per-lane point-ops),
+and every certification pin in analysis/certified.json still holding
+(range proofs intact, no new taint findings). Nonzero exits mirror
+`python -m ouroboros_consensus_tpu.analysis`: 1 = new AST finding(s),
+3 = budget violation(s), 4 = certification ratchet violation(s). The
+ratchet files only ever shrink in normal operation — fixing a
+grandfathered finding makes its key stale, and the gate prints a
+reminder to re-run the matching --update flag so the ratchet tightens.
+
+One trace per graph feeds all three jaxpr passes: the gate traces each
+graph at its fast-sweep lane count (production 8192 for the
+lane-sensitive graphs, the registry tile otherwise) and the budget
+metrics, point-op counts and certification all read that cached trace.
 """
 
 from __future__ import annotations
@@ -18,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -28,12 +45,55 @@ from ouroboros_consensus_tpu.analysis import astlint, graphs  # noqa: E402
 BASELINE = os.path.join(
     REPO, "ouroboros_consensus_tpu", "analysis", "baseline.json"
 )
+# a diff in any of these invalidates every certificate, not just one
+# graph's — force the full sweep
+_MACHINERY_PREFIX = "ouroboros_consensus_tpu/analysis/"
+
+
+def _changed_files() -> set[str]:
+    """Repo-relative paths that differ from HEAD (staged, unstaged and
+    untracked)."""
+    files: set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, cwd=REPO, check=True
+            ).stdout
+        except (subprocess.CalledProcessError, FileNotFoundError):
+            return set()  # not a git checkout: caller falls back to full
+        files |= {ln.strip() for ln in out.splitlines() if ln.strip()}
+    return files
+
+
+def _select_graphs(changed: set[str]) -> list[str] | None:
+    """Graphs whose traced source modules intersect the diff; None =
+    run everything (machinery changed, or git unavailable)."""
+    from ouroboros_consensus_tpu.analysis import absint
+
+    if not changed:
+        return []
+    if any(f.startswith(_MACHINERY_PREFIX) for f in changed):
+        return None
+    sources = dict(graphs.GRAPH_SOURCES)
+    sources.update(absint.AUX_SOURCES)
+    names = [
+        n for n in absint.certifiable_graphs()
+        if changed & set(sources.get(n, []))
+    ]
+    return names
 
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--no-graphs", action="store_true")
+    ap.add_argument("--changed", action="store_true",
+                    help="re-trace only graphs whose sources changed")
+    ap.add_argument("--tier", choices=("fast", "full"), default="fast")
     ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--update-certified", action="store_true")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -67,8 +127,11 @@ def main(argv: list[str] | None = None) -> int:
     current_keys = {f.key() for f in unsuppressed}
     stale = sorted(baseline - current_keys)
 
-    violations: list[str] = []
+    budget_violations: list[str] = []
+    cert_violations: list[str] = []
     reports: list[graphs.GraphReport] = []
+    cert_reports = []
+    names: list[str] | None = None
     if not args.no_graphs:
         # abstract tracing needs no accelerator; pin the platform so a
         # wedged TPU tunnel (this box's sitecustomize force-registers
@@ -79,31 +142,75 @@ def main(argv: list[str] | None = None) -> int:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass  # backend already initialized by the embedding process
-        reports = graphs.analyze_registered()
-        violations = graphs.check_budgets(reports)
+
+        from ouroboros_consensus_tpu.analysis import absint
+
+        if args.changed:
+            names = _select_graphs(_changed_files())
+        todo = names if names is not None else absint.certifiable_graphs()
+        shapes = absint.load_shapes()
+        budgets = graphs.load_budgets()
+        for name in todo:
+            # one trace per graph serves certification, jaxpr budgets
+            # and point-op budgets (trace_graph LRU cache)
+            cert_reports.extend(absint.certify_graph(name, args.tier,
+                                                     shapes))
+            if name in graphs.REGISTRY:
+                lanes0 = absint.sweep_lanes(name, args.tier, shapes)[0]
+                reports.append(graphs.analyze_jaxpr(
+                    graphs.trace_graph(name, lanes0), name
+                ))
+                budget_violations += graphs.check_point_ops(
+                    budgets, names=[name]
+                )
+        budget_violations += graphs.check_budgets(reports, budgets)
+
+        if args.update_certified:
+            if names is not None:
+                print("--update-certified requires the full sweep "
+                      "(drop --changed)")
+                return 2
+            absint.write_certified(cert_reports)
+            print(f"certified.json updated: "
+                  f"{len(absint.load_certified()['graphs'])} graph(s)")
+            return 0
+        cert_violations = absint.check_certified(cert_reports)
 
     if args.json:
         print(json.dumps({
             "new_findings": [f.format() for f in new],
             "stale_baseline": stale,
-            "budget_violations": violations,
+            "budget_violations": budget_violations,
+            "certification_violations": cert_violations,
             "graphs": [r.to_dict() for r in reports],
-            "ok": not (new or violations),
-        }, indent=2))
+            "certified": [r.to_dict() for r in cert_reports],
+            "changed_selection": names,
+            "ok": not (new or budget_violations or cert_violations),
+        }, indent=2, sort_keys=True))
     else:
         for f in new:
             print(f.format())
-        for v in violations:
+        for v in budget_violations:
             print(f"BUDGET: {v}")
+        for v in cert_violations:
+            print(f"CERTIFIED: {v}")
         for k in stale:
             print(f"note: baseline entry no longer fires "
                   f"(run --update-baseline to ratchet): {k}")
+        if names is not None:
+            print(f"--changed: {len(names)} graph(s) selected: "
+                  f"{', '.join(names) or '(none)'}")
         print(
             f"lint: {len(new)} new finding(s), "
-            f"{len(violations)} budget violation(s), "
+            f"{len(budget_violations)} budget violation(s), "
+            f"{len(cert_violations)} certification violation(s), "
             f"{len(stale)} stale baseline entr(y/ies)"
         )
-    return 1 if (new or violations) else 0
+    if new:
+        return 1
+    if budget_violations:
+        return 3
+    return 4 if cert_violations else 0
 
 
 if __name__ == "__main__":

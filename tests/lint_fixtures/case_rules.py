@@ -102,3 +102,17 @@ async def oct105_clean(lock: _Lock):
     lock.acquire_write()
     lock.release_write()
     await asyncio.sleep(1)  # lock released: NOT a finding
+
+
+# -- OCT106: stale suppressions ---------------------------------------------
+
+def oct106_positive():
+    # the disable below suppresses nothing (no OCT104 fires here): the
+    # stale comment itself is the OCT106 finding
+    return 1  # octlint: disable=OCT104
+
+
+def oct106_suppressed():
+    # listing OCT106 alongside the stale rule suppresses the audit —
+    # the reviewed way to keep a deliberately pre-emptive suppression
+    return 2  # octlint: disable=OCT104,OCT106

@@ -1,0 +1,628 @@
+"""octrange tier-1 gate: interval/overflow + secret-taint certification
+(analysis/absint.py, analysis/domains.py).
+
+Layers:
+  1. domain units — interval arithmetic, the widening ladder (the
+     B_MAX=9500 rung is load-bearing), per-row canonicalization, taint
+     joins;
+  2. interpreter units on purpose-built tiny graphs — affine-counter
+     pinning, genuine-overflow detection, truncating converts, per-row
+     precision, scan-fixpoint widening;
+  3. the PR 3 regression — `sum_mod_l` proves clean at the 87k-lane
+     3-term boundary / 40x8192 / epoch shapes, and a fixture with the
+     carry-normalization REVERTED is flagged at the exact accumulator
+     eqn;
+  4. taint fixtures — a seeded secret branch / secret gather index is
+     caught, a select over secrets is clean, the sign path pins exactly
+     its known fixed-base-ladder gather, the MSM argsort steers on
+     PUBLIC wire marks only;
+  5. the registry sweep — every certifiable graph proves at its
+     fast-tier lanes (production 8192 for the lane-sensitive graphs)
+     and matches its analysis/certified.json pin;
+  6. the soundness property — random concrete inputs drawn inside the
+     declared specs stay inside every top-level inferred interval
+     (hypothesis when available, seeded-random fallback);
+  7. CLI exit codes and machine-stable JSON.
+"""
+
+import functools
+import inspect
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ouroboros_consensus_tpu.analysis import absint
+from ouroboros_consensus_tpu.analysis import domains as D
+from ouroboros_consensus_tpu.analysis import graphs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _trace(fn, *args):
+    import jax
+
+    return jax.make_jaxpr(fn)(*args)
+
+
+def _i32(*shape):
+    import jax
+    from jax import numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+
+def _range_findings(fn, args, bounds):
+    interp = absint.IntervalInterp("t")
+    interp.run_closed(_trace(fn, *args), bounds)
+    return absint._dedup(interp.findings), interp
+
+
+def _taint_findings(fn, args, taints):
+    interp = absint.TaintInterp("t")
+    outs = interp.run_closed(_trace(fn, *args), taints)
+    return absint._dedup(interp.findings), outs
+
+
+# ---------------------------------------------------------------------------
+# 1 — domains
+# ---------------------------------------------------------------------------
+
+
+def test_interval_arith():
+    assert D.iv_mul((-3, 2), (4, 5)) == (-15, 10)
+    assert D.iv_sub((0, 1), (2, 3)) == (-3, -1)
+    assert D.iv_rem((-7, 9), (8, 8)) == (-7, 7)
+    assert D.iv_shr((-8, 8), (1, 1)) == (-4, 4)  # arithmetic, like XLA
+    assert D.iv_and((0, 300), (0, 15), (-(2**31), 2**31 - 1)) == (0, 15)
+
+
+def test_widen_ladder_has_the_bmax_rung():
+    # a loop carry observed growing past 8192 must land ON 9500 (the
+    # nearly-normalized limb bound): overshooting to 2^14 would make
+    # the next mul bound 20 * (2^14)^2 > 2^31 and kill the fixpoint
+    assert D.iv_widen((0, 8192), (0, 8500)) == (0, 9500)
+    assert D.iv_widen((0, 9500), (0, 9500)) == (0, 9500)  # stable
+
+
+def test_widening_terminates_at_top():
+    iv = (0, 1)
+    for _ in range(len(D._LADDER) + 2):
+        iv = D.iv_widen(iv, (iv[0], iv[1] * 3 + 1))
+    assert D.iv_is_top(iv)
+
+
+def test_rows_canonicalize_and_join():
+    assert D.rows([(0, 1), (0, 1)]) == (0, 1)  # all-equal -> uniform
+    r = D.rows([(0, 1), (0, 5)])
+    assert isinstance(r, D.Rows)
+    assert D.collapse(r) == (0, 5)
+    # a join whose rows stay distinct keeps the structure…
+    assert D.iv_join_any(r, (2, 3)) == D.Rows(((0, 3), (0, 5)))
+    # …and one whose rows become all-equal re-canonicalizes to uniform
+    assert D.iv_join_any(r, (2, 7)) == (0, 7)
+    assert D.rows([]) == (0, 0)  # zero-extent axis
+
+
+def test_taint_levels():
+    t = D.taint_join(D.taint("wire", "sig"), D.taint("secret", "a"))
+    assert D.taint_secret(t) == {"secret:a"}
+    assert D.taint_wire(t) == {"wire:sig"}
+
+
+# ---------------------------------------------------------------------------
+# 2 — interpreter units
+# ---------------------------------------------------------------------------
+
+
+def test_fori_counter_is_pinned_not_widened():
+    from jax import lax
+
+    def f(x):
+        return lax.fori_loop(0, 1000, lambda i, v: v + i * 0, x)
+
+    findings, interp = _range_findings(f, (_i32(4),), [(0, 10)])
+    assert findings == []
+
+
+def test_genuine_int32_overflow_is_flagged():
+    def f(x):
+        return x * x
+
+    findings, _ = _range_findings(f, (_i32(4),), [(0, 1 << 16)])
+    assert [f_.kind for f_ in findings] == ["overflow"]
+    # and stays quiet when the operand is proven narrow enough
+    clean, _ = _range_findings(f, (_i32(4),), [(0, 46340)])
+    assert clean == []
+
+
+def test_truncating_convert_is_flagged():
+    from jax import numpy as jnp
+
+    def f(x):
+        return x.astype(jnp.int8)
+
+    findings, _ = _range_findings(f, (_i32(4),), [(0, 300)])
+    assert [f_.kind for f_ in findings] == ["truncate"]
+    clean, _ = _range_findings(f, (_i32(4),), [(0, 100)])
+    assert clean == []
+
+
+def test_unsigned_wrap_is_not_a_finding():
+    from jax import numpy as jnp
+
+    def f(x):
+        y = x.astype(jnp.uint32)
+        return y * y  # wraps mod 2^32: defined XLA semantics
+
+    findings, _ = _range_findings(f, (_i32(4),), [(0, 1 << 20)])
+    assert findings == []
+
+
+def test_per_row_precision_certifies_the_fold_idiom():
+    """The limbs.mul safety story in miniature: a FOLD^2-weighted row
+    whose operand row is small. The whole-tensor bound (9500 * FOLD^2 >
+    2^31) cannot certify this; the per-row bound (1 * FOLD^2) can."""
+    fold2 = 369664  # (19 * 2^5)^2
+    col = np.concatenate(
+        [np.full((19, 1), 9500, np.int32), np.ones((1, 1), np.int32)]
+    )
+    wts = np.concatenate(
+        [np.ones((19, 1), np.int32), np.full((1, 1), fold2, np.int32)]
+    )
+
+    def f(x):
+        return (x + col) * wts
+
+    findings, _ = _range_findings(f, (_i32(20, 4),), [(0, 0)])
+    assert findings == []
+    # sanity: the whole-tensor product really is out of range
+    assert 9500 * fold2 > 2**31 - 1
+
+
+def test_scan_fixpoint_widens_to_a_stable_bound():
+    from jax import lax
+    from jax import numpy as jnp
+
+    def f(x):
+        def body(c, _):
+            return jnp.minimum(c + 1, 9000), c
+
+        c, ys = lax.scan(body, x, None, length=100000)
+        return c
+
+    findings, interp = _range_findings(f, (_i32(),), [(0, 1)])
+    assert findings == []
+
+
+def test_unknown_primitive_reports_not_crashes():
+    import jax
+
+    def f(x):
+        return jax.nn.softmax(x.astype("float32"))
+
+    findings, _ = _range_findings(f, (_i32(4),), [(0, 10)])
+    # float ops have no transfers: reported as unknown-prim, never an
+    # exception, and certification stays honest (graph not proven)
+    assert all(f_.kind == "unknown-prim" for f_ in findings)
+
+
+# ---------------------------------------------------------------------------
+# 3 — the PR 3 sum_mod_l regression
+# ---------------------------------------------------------------------------
+
+
+def test_sum_mod_l_proofs_hold():
+    """The shipped kernel (per-term carry normalization before the
+    cross-term add) proves no-overflow at the 87k 3-term boundary, the
+    40x8192 max-term shape and the 1M-headers-equivalent epoch shape."""
+    for name in ("sum_mod_l_3t", "sum_mod_l_40t", "sum_mod_l_epoch"):
+        for r in _certified(name):
+            if r.domain == "range":
+                assert r.ok, (name, [f.format() for f in r.findings])
+
+
+def _reverted_sum_mod_l(terms):
+    """The PR 3 bug, resurrected: lane sums accumulated WITHOUT the
+    per-term carry normalization. 3 x 87381 max-limb terms push the
+    accumulator rows past 2^31."""
+    from jax import numpy as jnp
+
+    from ouroboros_consensus_tpu.ops.pk import limbs as fe
+
+    acc = None
+    for t in terms:
+        s = jnp.sum(t, axis=-1, keepdims=True)
+        wide = jnp.concatenate(
+            [s, jnp.zeros((40 - fe.NLIMBS, 1), jnp.int32)], axis=0
+        )
+        acc = wide if acc is None else acc + wide  # REVERT-MARK
+    acc, _ = fe._seq_carry(acc)
+    return fe.barrett_reduce40(acc)
+
+
+def test_reverted_sum_mod_l_is_flagged_at_the_accumulator_eqn():
+    def f(a, b, c):
+        return _reverted_sum_mod_l([a, b, c])
+
+    # 3 x 87400 = 262,200 lane-terms: just PAST the 2^31/8191 = 262,177
+    # threshold (the shipped kernel's per-term normalization proves
+    # clean at any lane count; the reverted accumulator overflows here —
+    # and is still clean at the 3 x 87381 = 262,143 boundary shape, which
+    # is why the certified sweep pins that shape as the showcase)
+    s = _i32(20, 87400)
+    findings, _ = _range_findings(f, (s, s, s), [(0, 8191)] * 3)
+    overflows = [f_ for f_ in findings if f_.kind == "overflow"]
+    assert overflows, findings
+    # the specific eqn: the un-normalized cross-term add at REVERT-MARK
+    src_lines, first = inspect.getsourcelines(_reverted_sum_mod_l)
+    mark = first + next(
+        i for i, ln in enumerate(src_lines) if "REVERT-MARK" in ln
+    )
+    assert any(
+        f_.prim == "add" and f"tests/test_absint.py:{mark}" in f_.src
+        for f_ in overflows
+    ), [f_.format() for f_ in overflows]
+
+
+# ---------------------------------------------------------------------------
+# 4 — taint fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_secret_branch_is_caught():
+    from jax import lax
+
+    def f(s):
+        return lax.cond(s[0] > 0, lambda: 1, lambda: 0)
+
+    findings, _ = _taint_findings(
+        f, (_i32(4),), [D.taint("secret", "k")]
+    )
+    assert [f_.kind for f_ in findings] == ["taint-branch"]
+
+
+def test_secret_index_is_caught():
+    def f(tab, s):
+        return tab[s[0]]
+
+    findings, _ = _taint_findings(
+        f, (_i32(16), _i32(4)),
+        [D.NO_TAINT, D.taint("secret", "k")],
+    )
+    assert "taint-index" in {f_.kind for f_ in findings}
+
+
+def test_select_over_secret_is_clean():
+    from jax import numpy as jnp
+
+    def f(s, a, b):
+        return jnp.where(s > 0, a, b)
+
+    findings, outs = _taint_findings(
+        f, (_i32(4), _i32(4), _i32(4)),
+        [D.taint("secret", "k"), D.NO_TAINT, D.NO_TAINT],
+    )
+    assert findings == []  # select_n is constant-time: not a branch
+    assert outs[0] == {"secret:k"}  # but the output stays tainted
+
+
+def test_wire_steering_is_recorded_not_flagged():
+    def f(tab, w):
+        return tab[w[0]]
+
+    findings, _ = _taint_findings(
+        f, (_i32(16), _i32(4)), [D.NO_TAINT, D.taint("wire", "hdr")]
+    )
+    assert findings == []  # wire data is public: access is allowed
+
+
+def test_sign_path_pins_exactly_the_ladder_gather():
+    """The ed25519 sign path carries REAL secrets; its one known
+    secret-indexed access (the XLA-twin fixed-base ladder's window
+    table gather in ops/curve.py) is pinned in certified.json — any
+    second access is a ratchet violation."""
+    r = absint.certify_taint("ed25519_sign")
+    kinds = {f.key() for f in r.findings}
+    pinned = set(
+        absint.load_certified()["graphs"]["ed25519_sign"]["taint_findings"]
+    )
+    assert kinds == pinned
+    assert all("ops/curve.py" in f.src for f in r.findings)
+    assert absint.check_certified([r]) == []
+
+
+# ---------------------------------------------------------------------------
+# 5 — the registry sweep (the acceptance gate)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _certified(name):
+    return tuple(absint.certify_graph(name, "fast"))
+
+
+def test_certified_json_covers_every_certifiable_graph():
+    pins = absint.load_certified()["graphs"]
+    assert sorted(pins) == absint.certifiable_graphs()
+
+
+# cheap graphs certify inline in tier-1, as do the acceptance-critical
+# expensive ones: the production-8192 aggregate/msm/spmd sweeps and the
+# composed BC core (the production default since PR 3). The remaining
+# heavy graphs are fully INTERIOR to those — vrf_core/vrf_bc_core trace
+# inside the composed cores, verify_praos_core (draft-03) shares every
+# kernel with the bc twin — so their standalone certificates ride the
+# slow tier (and scripts/lint.py's full sweep) instead of re-paying
+# ~90 s of tier-1 wall for code already proven through the composition.
+_FAST_GRAPHS = [
+    "ed_core", "kes_core", "finish_core", "msm", "packed_unpack",
+    "verdict_reduce", "mul_mod_l", "sum_mod_l_3t", "sum_mod_l_40t",
+    "sum_mod_l_epoch", "ed25519_sign",
+]
+_HEAVY_GRAPHS = [
+    "verify_praos_core_bc", "aggregate_core", "spmd_sharded_verify",
+]
+_INTERIOR_GRAPHS = ["vrf_core", "vrf_bc_core", "verify_praos_core"]
+
+
+def _assert_certified(name):
+    reports = list(_certified(name))
+    for r in reports:
+        if r.domain == "range":
+            assert r.ok, (
+                f"{name}@{r.lanes} [range]: "
+                + "; ".join(f.format() for f in r.findings)
+            )
+    # taint reports may carry PINNED findings (the sign path's ladder
+    # gather); the ratchet — not bare ok — is the acceptance condition
+    assert absint.check_certified(reports) == []
+
+
+@pytest.mark.parametrize("name", _FAST_GRAPHS)
+def test_certified_fast(name):
+    _assert_certified(name)
+
+
+@pytest.mark.parametrize("name", _HEAVY_GRAPHS)
+def test_certified_heavy(name):
+    _assert_certified(name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", _INTERIOR_GRAPHS)
+def test_certified_interior(name):
+    _assert_certified(name)
+
+
+def test_msm_argsort_steers_on_public_wire_only():
+    """The documented argument for the MSM's per-window argsort: its
+    keys are Fiat–Shamir coefficients — deterministic functions of
+    PUBLIC wire bytes — so the data-dependent permutation cannot leak a
+    secret. The certificate records the steering sites; none may carry
+    a secret mark."""
+    taint = [r for r in _certified("msm") if r.domain == "taint"][0]
+    assert taint.ok
+    sort_sites = [w for w in taint.wire_steered if "sort@" in w]
+    assert sort_sites and all("ops/pk/msm.py" in w for w in sort_sites)
+    assert all("secret:" not in w for w in taint.wire_steered)
+
+
+def test_check_certified_ratchet_semantics():
+    mk = lambda **kw: absint.Report(  # noqa: E731
+        graph="g", domain="range", lanes=None, ok=True, findings=[], **kw
+    )
+    f = absint.Finding("overflow", "g", "add", "x.py:1", "boom")
+    pins = {"graphs": {"g": {"range": "proven", "taint": "clean",
+                             "taint_findings": []}}}
+    assert absint.check_certified([mk()], pins) == []
+    # lost proof
+    lost = absint.Report("g", "range", None, False, [f])
+    assert any("LOST" in v for v in absint.check_certified([lost], pins))
+    # new taint finding on a clean pin
+    t = absint.Report("g", "taint", None, False, [f])
+    assert any("pinned clean" in v
+               for v in absint.check_certified([t], pins))
+    # stale pin (finding no longer fires)
+    pins2 = {"graphs": {"g": {"range": "proven", "taint": "pinned",
+                              "taint_findings": [f.key()]}}}
+    t2 = absint.Report("g", "taint", None, True, [])
+    assert any("stale" in v for v in absint.check_certified([t2], pins2))
+    # unpinned graph
+    assert any("no certified.json entry" in v
+               for v in absint.check_certified(
+                   [absint.Report("h", "range", None, True, [])], pins))
+
+
+def test_graph_sources_exist():
+    """--changed selection can only work if the source maps stay
+    truthful: every listed module must exist, every graph must be
+    listed."""
+    srcs = dict(graphs.GRAPH_SOURCES)
+    srcs.update(absint.AUX_SOURCES)
+    assert set(srcs) == set(absint.certifiable_graphs())
+    for name, files in srcs.items():
+        for f in files:
+            assert os.path.exists(os.path.join(REPO, f)), (name, f)
+
+
+def test_changed_selection():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_gate", os.path.join(REPO, "scripts", "lint.py")
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    sel = lint._select_graphs({"ouroboros_consensus_tpu/ops/pk/msm.py"})
+    assert sel == ["aggregate_core", "msm"]
+    assert lint._select_graphs(set()) == []
+    # machinery edits invalidate everything -> full sweep
+    assert lint._select_graphs(
+        {"ouroboros_consensus_tpu/analysis/domains.py"}
+    ) is None
+    # an unrelated file selects nothing
+    assert lint._select_graphs({"README.md"}) == []
+
+
+# ---------------------------------------------------------------------------
+# 6 — soundness property
+# ---------------------------------------------------------------------------
+
+# (name, lanes): traced SMALL — concrete eqn-by-eqn execution under
+# disable_jit pays an eager XLA compile per unique (prim, shape), so
+# production tiles would burn minutes on op compiles alone. The
+# abstract semantics being checked are shape-generic; small lanes lose
+# no property coverage.
+_SOUND_GRAPHS = [("mul_mod_l", 48), ("sum_mod_l_3t", 48),
+                 ("verdict_reduce", None)]
+
+
+def _draw_inputs(closed, bounds, rng):
+    arrays = []
+    for v, (lo, hi) in zip(closed.jaxpr.invars, bounds):
+        aval = v.aval
+        a = rng.integers(lo, hi, size=aval.shape, endpoint=True)
+        arrays.append(np.asarray(a).astype(aval.dtype))
+    return arrays
+
+
+def _check_soundness(seed):
+    """Concrete execution, eqn by eqn, of each sample graph: every
+    TOP-LEVEL intermediate must lie inside the interpreter's inferred
+    interval for that eqn (nested computations are covered through
+    their call-eqn outputs)."""
+    import jax
+
+    rng = np.random.default_rng(seed)
+    shapes = absint.load_shapes()
+    for name, lanes in _SOUND_GRAPHS:
+        closed = absint._trace_any(name, lanes)
+        bounds = absint.input_intervals(name, closed, shapes)
+        interp = absint.IntervalInterp(name)
+        interp.eqn_log = []
+        interp.run_closed(closed, bounds)
+        assert not [f for f in interp.findings], name
+
+        env = {}
+        for v, c in zip(closed.jaxpr.constvars, closed.consts):
+            env[v] = c
+        for v, a in zip(
+            closed.jaxpr.invars, _draw_inputs(closed, bounds, rng)
+        ):
+            env[v] = a
+
+        def read(atom):
+            return atom.val if hasattr(atom, "val") else env[atom]
+
+        log = iter(interp.eqn_log)
+        with jax.disable_jit():
+            for eqn in closed.jaxpr.eqns:
+                subfuns, bind_params = eqn.primitive.get_bind_params(
+                    eqn.params
+                )
+                outs = eqn.primitive.bind(
+                    *subfuns, *[read(a) for a in eqn.invars],
+                    **bind_params,
+                )
+                if not eqn.primitive.multiple_results:
+                    outs = [outs]
+                logged_eqn, abs_outs = next(log)
+                assert logged_eqn is eqn
+                for v, o, a in zip(eqn.outvars, outs, abs_outs):
+                    env[v] = o
+                    arr = np.asarray(o)
+                    if arr.size == 0 or not (
+                        np.issubdtype(arr.dtype, np.integer)
+                        or arr.dtype == np.bool_
+                    ):
+                        continue
+                    for i, (lo, hi) in enumerate(
+                        D.rows_of(a, arr.shape[0])
+                        if isinstance(a, D.Rows) else [D.collapse(a)]
+                    ):
+                        sl = arr[i] if isinstance(a, D.Rows) else arr
+                        assert lo <= int(sl.min()) and int(sl.max()) <= hi, (
+                            name, eqn.primitive.name, i, (lo, hi),
+                            (int(sl.min()), int(sl.max())),
+                        )
+
+
+def test_soundness_property_tier1():
+    """One seeded draw inline in tier-1 (pays the eager-op compile
+    cache warmup once); the multi-seed sweep rides the slow tier."""
+    _check_soundness(0xA5)
+
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    @pytest.mark.slow
+    @given(seed=st.integers(0, 2**32 - 1))
+    @settings(max_examples=5, deadline=None)
+    def test_soundness_property(seed):
+        _check_soundness(seed)
+except ImportError:  # seeded-random fallback, same property
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [0x5A17, 0xC0FFEE, 0xD15EA5E])
+    def test_soundness_property(seed):
+        _check_soundness(seed)
+
+
+# ---------------------------------------------------------------------------
+# 7 — CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_range_json_is_machine_stable(capsys):
+    from ouroboros_consensus_tpu.analysis.__main__ import main
+
+    rc = main(["range", "--graphs", "mul_mod_l", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    parsed = json.loads(out)
+    assert parsed["ok"] is True
+    # sorted keys end to end: re-serialization is byte-identical
+    assert out.strip() == json.dumps(parsed, indent=2, sort_keys=True)
+
+
+def test_cli_certification_failure_exits_4():
+    from ouroboros_consensus_tpu.analysis.__main__ import main
+
+    # 300000 lanes is past the kernel's own t <= 2^17 shape guard: the
+    # graph cannot even trace at that sweep, so certification fails
+    # (trace-error finding) and the exit code must be the distinct
+    # certification value — not a crash, not the usage code
+    rc = main(["range", "--graphs", "sum_mod_l_3t",
+               "--lanes", "300000", "--no-ratchet", "--json"])
+    assert rc == 4
+
+
+def test_cli_budget_violation_exits_3(tmp_path, capsys):
+    from ouroboros_consensus_tpu.analysis.__main__ import main
+
+    tight = {
+        "graphs": {},
+        "point_ops": {
+            "mul_mod_l_like": {"at_lanes": 1, "lane_ops_per_lane": 0},
+        },
+    }
+    # an impossible point-op ceiling on a real graph
+    budgets = json.loads(json.dumps(tight))
+    budgets["point_ops"] = {
+        "msm": {"at_lanes": 4, "lane_ops_per_lane": 0.0},
+    }
+    p = tmp_path / "budgets.json"
+    p.write_text(json.dumps(budgets))
+    rc = main(["pointops", "--budgets", str(p), "--json"])
+    assert rc == 3
+
+
+def test_cli_usage_error_exits_2():
+    from ouroboros_consensus_tpu.analysis.__main__ import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["range", "--tier", "bogus"])
+    assert e.value.code == 2
