@@ -12,6 +12,7 @@ import ctypes
 import os
 import subprocess
 from dataclasses import dataclass
+from functools import cached_property
 
 import numpy as np
 
@@ -345,10 +346,45 @@ class MalformedBlock(ValueError):
         self.index = index
 
 
+def _span_matrix(buf_u8: np.ndarray, off: np.ndarray, ln: np.ndarray):
+    """[n, w] uint8 matrix over the (offset, length) spans of the chunk
+    buffer, or None when the spans are not uniform width (the columnar
+    pipeline requires row-major rectangular columns; callers fall back
+    to the per-row bytes list).
+
+    Uniform-STRIDE spans (the common case: a chunk of equal-size
+    blocks) come back as a ZERO-COPY strided view into the buffer;
+    anything else is one vectorized fancy-index gather (int32 indices —
+    chunk files are far under 2 GiB)."""
+    n = len(off)
+    if n == 0:
+        return np.zeros((0, 0), np.uint8)
+    w = int(ln[0])
+    if not (ln == w).all():
+        return None
+    if n > 1:
+        d = np.diff(off)
+        d0 = int(d[0])
+        if d0 > 0 and (d == d0).all():
+            return np.lib.stride_tricks.as_strided(
+                buf_u8[int(off[0]) :], shape=(n, w), strides=(d0, 1),
+            )
+    idx = off.astype(np.int32)[:, None] + np.arange(w, dtype=np.int32)
+    return buf_u8[idx]
+
+
 @dataclass
 class HeaderColumns:
     """SoA header columns straight from chunk bytes — the zero-object
-    fast path feeding protocol/batch.stage."""
+    fast path feeding protocol/batch.stage.
+
+    The three variable-width fields (`ocert_sigma` / `kes_sig` /
+    `signed_bytes`) are stored as (offset, length) spans into the chunk
+    buffer: the per-row `bytes`-list views are built LAZILY on first
+    access (the per-row slicing loop is exactly the object tax the
+    columnar pipeline avoids), and the `*_mat` properties expose them as
+    row-major uint8 matrices via one vectorized gather when the spans
+    are uniform width (always, on real chains)."""
 
     n: int
     block_no: np.ndarray  # [n] int64
@@ -365,12 +401,51 @@ class HeaderColumns:
     ocert_vk: np.ndarray  # [n, 32]
     ocert_counter: np.ndarray  # [n] int64
     ocert_kes_period: np.ndarray  # [n] int64
-    ocert_sigma: list  # [n] bytes
     pv_major: np.ndarray
     pv_minor: np.ndarray
-    kes_sig: list  # [n] bytes
-    signed_bytes: list  # [n] bytes — the KES-signed body span
     header_end: np.ndarray  # [n] int64 — buf offset just past the header item
+    raw: bytes  # the chunk buffer the spans point into
+    sig_off: np.ndarray  # [n] int64 — OCert sigma span
+    sig_len: np.ndarray  # [n] int64
+    kes_off: np.ndarray  # [n] int64 — KES signature span
+    kes_len: np.ndarray  # [n] int64
+    sgn_off: np.ndarray  # [n] int64 — KES-signed body span
+    sgn_len: np.ndarray  # [n] int64
+
+    def _span_list(self, off, ln) -> list:
+        buf = self.raw
+        return [
+            buf[o : o + l]
+            for o, l in zip(off.tolist(), ln.tolist())
+        ]
+
+    @cached_property
+    def _buf_u8(self) -> np.ndarray:
+        return np.frombuffer(self.raw, np.uint8)
+
+    @cached_property
+    def ocert_sigma(self) -> list:  # [n] bytes
+        return self._span_list(self.sig_off, self.sig_len)
+
+    @cached_property
+    def kes_sig(self) -> list:  # [n] bytes
+        return self._span_list(self.kes_off, self.kes_len)
+
+    @cached_property
+    def signed_bytes(self) -> list:  # [n] bytes — the KES-signed body span
+        return self._span_list(self.sgn_off, self.sgn_len)
+
+    @cached_property
+    def ocert_sigma_mat(self):  # [n, 64] uint8 | None
+        return _span_matrix(self._buf_u8, self.sig_off, self.sig_len)
+
+    @cached_property
+    def kes_sig_mat(self):  # [n, 96 + 32*depth] uint8 | None
+        return _span_matrix(self._buf_u8, self.kes_off, self.kes_len)
+
+    @cached_property
+    def signed_bytes_mat(self):  # [n, body_len] uint8 | None
+        return _span_matrix(self._buf_u8, self.sgn_off, self.sgn_len)
 
 
 def extract_headers(buf: bytes, offsets: np.ndarray) -> HeaderColumns | None:
@@ -416,11 +491,12 @@ def extract_headers(buf: bytes, offsets: np.ndarray) -> HeaderColumns | None:
         raise MalformedBlock(rc - 1)
     return HeaderColumns(
         n=n,
-        ocert_sigma=[buf[sig_off[i] : sig_off[i] + sig_len[i]] for i in range(n)],
         pv_major=pv_major,
         pv_minor=pv_minor,
-        kes_sig=[buf[kes_off[i] : kes_off[i] + kes_len[i]] for i in range(n)],
-        signed_bytes=[buf[sgn_off[i] : sgn_off[i] + sgn_len[i]] for i in range(n)],
         header_end=kes_off + kes_len,
+        raw=buf,
+        sig_off=sig_off, sig_len=sig_len,
+        kes_off=kes_off, kes_len=kes_len,
+        sgn_off=sgn_off, sgn_len=sgn_len,
         **cols,
     )

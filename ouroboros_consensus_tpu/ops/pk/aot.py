@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 
 _DIR_ENV = "OCT_PK_AOT_DIR"
 _ENABLE_ENV = "OCT_PK_AOT"  # "0" disables AOT dispatch (default: on —
@@ -46,6 +47,20 @@ def aot_dir() -> str:
 # other entry in the run, so the first one latches a process-wide skip of
 # the AOT load path instead of paying six failed deserializes per bucket
 # (BENCH_r05.json tail; bench.py greps the same patterns in child logs).
+#
+# Round-8 postmortem of why the r05 tail STILL showed six doomed loads in
+# one attempt despite the latch: (1) `load()` itself never consulted the
+# latch and ran concurrently from two threads — the main dispatch thread
+# and the materialize worker that re-dispatches per-lane stages for dirty
+# aggregate windows — so deserializes already past the caller's
+# `enabled()` check burned their ~15 s anyway; (2) the latch was
+# per-PROCESS, so bench attempt 2 (a fresh child) re-paid the whole
+# cascade. Now: `load()` checks the latch at entry AND under the
+# deserialize lock (no two doomed loads can overlap), and a format
+# rejection writes a per-build REJECTED marker next to the executables so
+# every later process on the same build skips the load path outright
+# (scripts/aot_precompile clears the marker when it writes fresh
+# executables via `save`).
 INCOMPATIBLE_PATTERNS = (
     "axon format",
     "serialized executable is incompatible",
@@ -53,12 +68,75 @@ INCOMPATIBLE_PATTERNS = (
 )
 
 _RUNTIME_REJECTED = False
+_MARKER_CHECKED = False
+_LOAD_LOCK = threading.Lock()
+_BUILD_SLUG: str | None = None
+
+
+def _build_slug() -> str:
+    """Stable slug of the runtime build (PJRT platform_version): the
+    same keying the bench child uses for its per-build jax cache."""
+    global _BUILD_SLUG
+    if _BUILD_SLUG is None:
+        import hashlib
+
+        try:
+            import jax
+
+            bid = jax.devices()[0].client.platform_version
+        except Exception:
+            import jax
+
+            bid = f"jax-{jax.__version__}"
+        _BUILD_SLUG = hashlib.blake2s(
+            str(bid).encode(), digest_size=6
+        ).hexdigest()
+    return _BUILD_SLUG
+
+
+def _reject_marker() -> str:
+    return os.path.join(aot_dir(), f"REJECTED.{_build_slug()}")
+
+
+def _check_marker() -> None:
+    """Pick up a rejection persisted by an earlier PROCESS on the same
+    build (bench attempt 1 -> attempt 2; one driver round -> the next)."""
+    global _RUNTIME_REJECTED, _MARKER_CHECKED
+    if _MARKER_CHECKED:
+        return
+    _MARKER_CHECKED = True
+    try:
+        if os.path.exists(_reject_marker()):
+            import sys
+
+            print(
+                "# pk-aot: executables previously rejected by this build "
+                f"({_reject_marker()}) — skipping the AOT load path",
+                file=sys.stderr,
+            )
+            _RUNTIME_REJECTED = True
+    except Exception:
+        pass
+
+
+def clear_rejection() -> None:
+    """Drop the persisted per-build rejection (fresh executables were
+    written for this build — scripts/aot_precompile via `save`)."""
+    global _RUNTIME_REJECTED, _MARKER_CHECKED
+    try:
+        os.remove(_reject_marker())
+    except OSError:
+        pass
+    _RUNTIME_REJECTED = False
+    _MARKER_CHECKED = True
 
 
 def note_failure(exc: BaseException) -> bool:
     """Record an AOT load/run failure; latches the process-wide disable
     when the error says the runtime rejects the executable FORMAT (a
-    per-build property, not a per-entry one). Returns the latch state."""
+    per-build property, not a per-entry one) and persists a per-build
+    marker so LATER processes skip the doomed loads too. Returns the
+    latch state."""
     global _RUNTIME_REJECTED
     msg = str(exc).lower()
     if not _RUNTIME_REJECTED and any(p in msg for p in INCOMPATIBLE_PATTERNS):
@@ -70,11 +148,21 @@ def note_failure(exc: BaseException) -> bool:
             file=sys.stderr,
         )
         _RUNTIME_REJECTED = True
+        try:
+            os.makedirs(aot_dir(), exist_ok=True)
+            with open(_reject_marker(), "w") as f:
+                f.write(str(exc)[:500])
+        except Exception:
+            pass  # persistence is best-effort; the in-process latch holds
     return _RUNTIME_REJECTED
 
 
 def enabled() -> bool:
-    return not _RUNTIME_REJECTED and os.environ.get(_ENABLE_ENV, "1") != "0"
+    if os.environ.get(_ENABLE_ENV, "1") == "0":
+        return False
+    if not _RUNTIME_REJECTED:
+        _check_marker()
+    return not _RUNTIME_REJECTED
 
 
 _SRC_DIGEST: str | None = None
@@ -137,6 +225,11 @@ def save(name: str, b: int, kes_depth: int, tile: int, sig: str, compiled,
     ser, in_tree, out_tree = se.serialize(compiled)
     path = stage_path(name, b, kes_depth, tile, sig)
     os.makedirs(os.path.dirname(path), exist_ok=True)
+    # NOTE: the persisted REJECTED marker is NOT cleared here — a
+    # partially-regenerated cache (crash mid-precompile, subset of
+    # stages) would reopen the doomed-load window for the stale files
+    # still on disk. scripts/aot_precompile calls clear_rejection()
+    # once, AFTER every stage of a run has been written.
     blob = pickle.dumps(
         {"ser": ser, "in_tree": in_tree, "out_tree": out_tree, "meta": meta}
     )
@@ -154,27 +247,43 @@ def load(name: str, b: int, kes_depth: int, tile: int, sig: str):
     """Deserialize-and-load a stage executable onto the live backend.
 
     Returns a callable with the stage fn's signature, or None (missing
-    file, deserialization failure, incompatible runtime). Memoized —
-    including negative results, so a failing stage is probed once."""
+    file, deserialization failure, incompatible runtime, latched
+    rejection). Memoized — including negative results, so a failing
+    stage is probed once. Deserializes run one-at-a-time under a lock
+    with the latch re-checked inside it: concurrent callers (the main
+    dispatch thread and the materialize worker's aggregate re-dispatch)
+    can never stack a second ~15 s doomed deserialize behind the first
+    one's rejection."""
     key = (name, b, kes_depth, tile, sig)
     if key in _LOADED:
         return _LOADED[key]
+    if not enabled():
+        return None
     result = None
     path = stage_path(name, b, kes_depth, tile, sig)
     if os.path.exists(path):
-        try:
-            from jax.experimental import serialize_executable as se
+        with _LOAD_LOCK:
+            if key in _LOADED:
+                return _LOADED[key]
+            if not enabled():
+                return None
+            try:
+                from jax.experimental import serialize_executable as se
 
-            with open(path, "rb") as f:
-                blob = pickle.load(f)
-            result = se.deserialize_and_load(
-                blob["ser"], blob["in_tree"], blob["out_tree"]
-            )
-        except Exception as e:  # noqa: BLE001 — fail-soft by contract
-            import sys
+                with open(path, "rb") as f:
+                    blob = pickle.load(f)
+                result = se.deserialize_and_load(
+                    blob["ser"], blob["in_tree"], blob["out_tree"]
+                )
+            except Exception as e:  # noqa: BLE001 — fail-soft by contract
+                import sys
 
-            print(f"# pk-aot: load {key} failed: {e!r}", file=sys.stderr)
-            note_failure(e)
-            result = None
+                print(f"# pk-aot: load {key} failed: {e!r}", file=sys.stderr)
+                note_failure(e)
+                result = None
+            # memoize INSIDE the lock: a racing caller must see the
+            # entry the moment the lock frees, not re-deserialize
+            _LOADED[key] = result
+        return result
     _LOADED[key] = result
     return result

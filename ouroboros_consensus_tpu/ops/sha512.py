@@ -99,6 +99,26 @@ def pad_messages_np(msgs: Sequence[bytes], nb: int | None = None):
     return bytes_to_blocks_np(buf.reshape(n, nb, BLOCK)), nblocks
 
 
+def pad_matrix_np(mat: np.ndarray, nb: int | None = None):
+    """`pad_messages_np` for a [B, M] uint8 matrix of uniform-length
+    messages: no per-row bytes objects, no join — the columnar staging
+    path (protocol/batch.stage_columns) hands whole message columns in.
+    Byte-identical to pad_messages_np on the row-wise bytes."""
+    n, ln = mat.shape
+    k = nblocks_for_len(ln)
+    if nb is None:
+        nb = k
+    assert nb >= k, f"nb={nb} < required {k}"
+    buf = np.zeros((n, nb * BLOCK), dtype=np.uint8)
+    buf[:, :ln] = mat
+    buf[:, ln] = 0x80
+    buf[:, k * BLOCK - 16 : k * BLOCK] = np.frombuffer(
+        (8 * ln).to_bytes(16, "big"), np.uint8
+    )
+    nblocks = np.full((n,), k, dtype=np.int32)
+    return bytes_to_blocks_np(buf.reshape(n, nb, BLOCK)), nblocks
+
+
 def bytes_to_blocks_np(b: np.ndarray) -> np.ndarray:
     """[..., 128] uint8 -> [..., 16, 2] uint32 big-endian words."""
     w = b.reshape(*b.shape[:-1], 16, 8).astype(np.uint32)

@@ -130,6 +130,18 @@ def _sharded_verify(mesh, n_real, *cols):
     return out
 
 
+def sharded_stage_run(
+    params, lview, eta0, hvs, pre, mesh: Mesh | None = None
+):
+    """The sharded entry of `protocol.batch.validate_batch`: stage the
+    window — COLUMNAR when a ViewColumns window arrives (stage_columns:
+    whole-matrix slices, one vectorized SHA pad per hash family, no
+    per-header objects), per-view otherwise — then shard and verify over
+    the mesh. Returns `sharded_run_batch`'s (Verdicts, first_bad, n_ok)."""
+    batch = pbatch.stage_any(params, lview, eta0, hvs, pre)
+    return sharded_run_batch(batch, mesh)
+
+
 def sharded_run_batch(batch: pbatch.PraosBatch, mesh: Mesh | None = None):
     """Device-parallel `protocol.batch.run_batch`: shard the staged batch
     over the mesh, verify, reduce verdicts with collectives.

@@ -60,7 +60,9 @@ from ..ops import blake2b, ecvrf_batch, ed25519_batch, kes_batch
 from ..ops.host import kes as host_kes
 from . import leader, nonces, praos
 from .praos import PraosParams, PraosState, TickedPraosState
-from .views import HeaderView, LedgerView, hash_key, hash_vrf_vk
+from .views import (
+    HeaderView, LedgerView, ViewColumns, hash_key, hash_vrf_vk,
+)
 
 # ---------------------------------------------------------------------------
 # Leader-threshold bracketing (host, cached per (sigma, f))
@@ -124,18 +126,46 @@ class HostChecks:
     vrf_lookup_errors: list  # VRFKeyUnknown / WrongVRFKey (Praos.hs:530-540)
     kes_evolution: np.ndarray  # [B] int32 — t = kes_period - c0 (clamped 0)
 
+    def any_errors(self) -> bool:
+        return any(e is not None for e in self.kes_window_errors) or any(
+            e is not None for e in self.vrf_lookup_errors
+        )
+
+
+@dataclass(frozen=True)
+class ColumnChecks(HostChecks):
+    """HostChecks from the columnar precheck pass, carrying the
+    per-window pool dedup so later stages (threshold tables, counter
+    monotonicity, the native leader compare) never repeat the
+    hash_key + pool_distr lookups per lane."""
+
+    uniq_inv: np.ndarray  # [B] int32 — lane -> unique (cold, vrf) pair
+    uniq_hk: tuple  # per-unique KeyHash bytes
+    uniq_entry: tuple  # per-unique IndividualPoolStake | None
+    clean: bool = False  # True = no precheck error in any lane
+
+    def any_errors(self) -> bool:
+        return not self.clean
+
 
 def host_prechecks(
     params: PraosParams,
     ledger_view: LedgerView,
-    hvs: Sequence[HeaderView],
+    hvs: "Sequence[HeaderView] | ViewColumns",
 ) -> HostChecks:
     """The non-crypto parts of validateKESSignature/validateVRFSignature
     (Praos.hs:558-574 window checks, :528-540 pool lookups), batch-wide.
 
     OCert counter monotonicity (Praos.hs:585-590) is NOT here: it depends
     on the evolving counter map and is checked in the sequential epilogue.
+
+    A ViewColumns window takes the vectorized path: whole-column KES
+    window arithmetic, pool lookups deduplicated per unique
+    (cold-key, vrf-key) pair — hash_key and the dict probe run once per
+    pool per window, not once per header.
     """
+    if isinstance(hvs, ViewColumns):
+        return host_prechecks_columns(params, ledger_view, hvs)
     kes_errors: list = [None] * len(hvs)
     vrf_errors: list = [None] * len(hvs)
     evol = np.zeros((len(hvs),), np.int32)
@@ -159,6 +189,97 @@ def host_prechecks(
                     hk, entry.vrf_key_hash, header_vrf_hash
                 )
     return HostChecks(kes_errors, vrf_errors, evol)
+
+
+def _dedup_rows(rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(unique_rows [k, w], inverse [n]) over a [n, w] uint8 matrix —
+    np.unique(axis=0) semantics (sorted-by-something stable grouping +
+    gather indices) WITHOUT its void-dtype argsort, which comparison-
+    sorts w-byte keys (~27 µs/row at w=288: slower than the rest of the
+    columnar stage combined). Rows are grouped by a vectorized 64-bit
+    Horner fingerprint over their u64 words and the grouping is then
+    VERIFIED by one exact gather-compare; a fingerprint collision (only
+    adversarially reachable) falls back to the exact np.unique."""
+    n, w = rows.shape
+    if n == 0:
+        return rows.copy(), np.zeros(0, np.int64)
+    pad = (-w) % 8
+    if pad:
+        padded = np.zeros((n, w + pad), np.uint8)
+        padded[:, :w] = rows
+    else:
+        padded = np.ascontiguousarray(rows)
+    words = padded.view(np.uint64)
+    h = np.zeros(n, np.uint64)
+    mult = np.uint64(0x9E3779B97F4A7C15)
+    with np.errstate(over="ignore"):
+        for c in range(words.shape[1]):
+            h = h * mult + words[:, c]
+    uh, inv = np.unique(h, return_inverse=True)
+    first = np.full(uh.shape[0], -1, np.int64)
+    # first occurrence per group (reverse scatter keeps the lowest index)
+    first[inv[::-1]] = np.arange(n - 1, -1, -1)
+    uniq = rows[first]
+    if not np.array_equal(uniq[inv], rows):
+        return np.unique(rows, axis=0, return_inverse=True)
+    return uniq, inv
+
+
+def host_prechecks_columns(
+    params: PraosParams,
+    ledger_view: LedgerView,
+    vc: ViewColumns,
+) -> ColumnChecks:
+    """Columnar host_prechecks: same verdicts and error objects, zero
+    per-header Python on the clean path."""
+    n = len(vc)
+    c0 = vc.ocert_kes_period
+    kp = vc.slot // params.slots_per_kes_period
+    before = c0 > kp
+    after = ~before & (kp >= c0 + params.max_kes_evolutions)
+    bad_window = before | after
+    evol = np.where(bad_window, 0, kp - c0).astype(np.int32)
+    kes_errors: list = [None] * n
+    if bad_window.any():
+        for i in np.flatnonzero(before).tolist():
+            kes_errors[i] = praos.KESBeforeStartOCERT(int(c0[i]), int(kp[i]))
+        for i in np.flatnonzero(after).tolist():
+            kes_errors[i] = praos.KESAfterEndOCERT(
+                int(kp[i]), int(c0[i]), params.max_kes_evolutions
+            )
+
+    # pool lookups once per unique (cold key, vrf key) pair: real chains
+    # have a handful of issuers per window, so the Blake2b-224 hash_key,
+    # the pool_distr probe and the vrf-key-hash equality run O(pools)
+    # times instead of O(headers)
+    pair = np.concatenate([vc.vk_cold, vc.vrf_vk], axis=1)
+    uniq, inv = _dedup_rows(pair)
+    hks, entries, uerrs = [], [], []
+    for j in range(uniq.shape[0]):
+        vk_cold = uniq[j, :32].tobytes()
+        hk = hash_key(vk_cold)
+        entry = ledger_view.pool_distr.get(hk)
+        hks.append(hk)
+        entries.append(entry)
+        if entry is None:
+            uerrs.append(praos.VRFKeyUnknown(hk))
+        else:
+            header_vrf_hash = hash_vrf_vk(uniq[j, 32:].tobytes())
+            if entry.vrf_key_hash != header_vrf_hash:
+                uerrs.append(praos.VRFKeyWrongVRFKey(
+                    hk, entry.vrf_key_hash, header_vrf_hash
+                ))
+            else:
+                uerrs.append(None)
+    if any(e is not None for e in uerrs):
+        vrf_errors = [uerrs[j] for j in inv.tolist()]
+    else:
+        vrf_errors = [None] * n
+    clean = not bad_window.any() and all(e is None for e in uerrs)
+    return ColumnChecks(
+        kes_errors, vrf_errors, evol,
+        inv.astype(np.int32), tuple(hks), tuple(entries), clean,
+    )
 
 
 @lru_cache(maxsize=4096)
@@ -215,6 +336,119 @@ def stage(
         lo_row, hi_row = _threshold_rows(sigma, f)
         thr_lo[i] = lo_row
         thr_hi[i] = hi_row
+    return PraosBatch(ed, kes, vrf, beta, thr_lo, thr_hi)
+
+
+def _be8_np(a: np.ndarray) -> np.ndarray:
+    """[n] nonnegative int64 -> [n, 8] uint8 big-endian rows (the
+    vectorized int.to_bytes(8, "big"))."""
+    return np.ascontiguousarray(a).astype(">u8").view(np.uint8).reshape(-1, 8)
+
+
+def _uniq_threshold_rows(
+    params: PraosParams, pre: ColumnChecks
+) -> tuple[list[np.ndarray], list[np.ndarray]]:
+    """Per-UNIQUE-pool (lo, hi) threshold byte rows from the precheck
+    dedup — the one place the unknown-pool sigma-0 convention and the
+    clamped bracket encoding live for the columnar paths."""
+    f = Fraction(params.active_slot_coeff)
+    lo_rows, hi_rows = [], []
+    for entry in pre.uniq_entry:
+        sigma = entry.stake if entry is not None else Fraction(0)
+        lo, hi = _threshold_rows(sigma, f)
+        lo_rows.append(lo)
+        hi_rows.append(hi)
+    return lo_rows, hi_rows
+
+
+def _uniq_threshold_tables(
+    params: PraosParams, pre: ColumnChecks
+) -> tuple[np.ndarray, np.ndarray]:
+    """(thr_lo [B, 32], thr_hi [B, 32]): the per-unique rows gathered
+    per lane."""
+    lo_rows, hi_rows = _uniq_threshold_rows(params, pre)
+    inv = pre.uniq_inv
+    return np.stack(lo_rows)[inv], np.stack(hi_rows)[inv]
+
+
+def _alpha_column(vc: ViewColumns, epoch_nonce: nonces.Nonce) -> np.ndarray:
+    """[B, 32] VRF input column (mkInputVRF per slot). The Blake2b per
+    header is inherent (host staging of the generic/native paths); the
+    packed device path skips it entirely via alpha_from_slots."""
+    b = len(vc)
+    out = np.empty((b, 32), np.uint8)
+    slots = vc.slot.tolist()
+    for i in range(b):
+        out[i] = np.frombuffer(
+            nonces.mk_input_vrf(slots[i], epoch_nonce), np.uint8
+        )
+    return out
+
+
+def stage_columns(
+    params: PraosParams,
+    ledger_view: LedgerView,
+    epoch_nonce: nonces.Nonce,
+    vc: ViewColumns,
+    evolution: np.ndarray,
+    pre: ColumnChecks,
+) -> PraosBatch:
+    """Columnar `stage`: the generic SoA batch built straight from the
+    window columns — whole-matrix slices and one vectorized SHA pad per
+    hash family, no per-header bytes. Byte-identical to
+    `stage(..., vc.views(), ...)` (the columnar differential suite)."""
+    from ..ops import sha512
+
+    sigma = vc.ocert_sigma
+    ed_r = np.ascontiguousarray(sigma[:, :32])
+    ed_s = np.ascontiguousarray(sigma[:, 32:])
+    # Ed25519 challenge-hash input R ‖ A ‖ signable(vk_hot ‖ n ‖ c0)
+    ed_msg = np.concatenate(
+        [ed_r, vc.vk_cold, vc.ocert_vk_hot,
+         _be8_np(vc.ocert_counter), _be8_np(vc.ocert_kes_period)], axis=1,
+    )
+    ed_hb, ed_hnb = sha512.pad_matrix_np(ed_msg)
+    ed = ed25519_batch.Ed25519Batch(
+        np.ascontiguousarray(vc.vk_cold), ed_r, ed_s, ed_hb, ed_hnb
+    )
+
+    ks = vc.kes_sig
+    kes_r = np.ascontiguousarray(ks[:, :32])
+    kes_s = np.ascontiguousarray(ks[:, 32:64])
+    vk_leaf = np.ascontiguousarray(ks[:, 64:96])
+    depth = params.kes_depth
+    siblings = np.ascontiguousarray(ks[:, 96:].reshape(len(vc), depth, 32))
+    kes_msg = np.concatenate([kes_r, vk_leaf, vc.signed_bytes], axis=1)
+    kes_hb, kes_hnb = sha512.pad_matrix_np(kes_msg)
+    kes = kes_batch.KesBatch(
+        np.ascontiguousarray(vc.ocert_vk_hot),
+        np.asarray(evolution, np.int32),
+        kes_r, kes_s, vk_leaf, siblings, kes_hb, kes_hnb,
+    )
+
+    plen = int(vc.vrf_proof_len[0])
+    proof = vc.vrf_proof
+    gamma = np.ascontiguousarray(proof[:, :32])
+    alpha = _alpha_column(vc, epoch_nonce)
+    pk = np.ascontiguousarray(vc.vrf_vk)
+    if plen == 128:
+        vrf = ecvrf_batch.EcvrfBcBatch(
+            pk, gamma,
+            np.ascontiguousarray(proof[:, 32:64]),
+            np.ascontiguousarray(proof[:, 64:96]),
+            np.ascontiguousarray(proof[:, 96:128]),
+            alpha,
+        )
+    else:
+        vrf = ecvrf_batch.EcvrfBatch(
+            pk, gamma,
+            np.ascontiguousarray(proof[:, 32:48]),
+            np.ascontiguousarray(proof[:, 48:80]),
+            alpha,
+        )
+
+    thr_lo, thr_hi = _uniq_threshold_tables(params, pre)
+    beta = np.ascontiguousarray(vc.vrf_output)
     return PraosBatch(ed, kes, vrf, beta, thr_lo, thr_hi)
 
 
@@ -652,6 +886,93 @@ def stage_packed(
     return layout, packed
 
 
+def stage_packed_columns(
+    params: PraosParams,
+    ledger_view: LedgerView,
+    epoch_nonce: nonces.Nonce,
+    vc: ViewColumns,
+    pre: ColumnChecks,
+) -> tuple[PraosPackedLayout, PraosPacked] | None:
+    """Columnar `stage_packed`: the packed wire built straight from the
+    window columns. The columns are already row-major uint8, so the
+    body column IS `vc.signed_bytes`, the per-field verification is six
+    whole-matrix compares, the KES-tail dedup is one np.unique, and the
+    threshold table rides the precheck pool dedup — nothing slices
+    per-header bytes. Qualification rules are IDENTICAL to
+    `stage_packed` (same verified offsets, same int32 gates), so the
+    two stagings are interchangeable lane-for-lane; only the dedup
+    table ORDERING may differ (gather indices compensate)."""
+    b = len(vc)
+    if not b:
+        return None
+    body = vc.signed_bytes
+    lb = int(body.shape[1])
+    if epoch_nonce is not None and len(epoch_nonce) != 32:
+        return None
+    depth = params.kes_depth
+    sig_len = 64 + 32 + 32 * depth
+    if vc.kes_sig.shape[1] != sig_len:
+        return None
+    plen = int(vc.vrf_proof_len[0])
+    if plen not in (80, 128) or not (vc.vrf_proof_len == plen).all():
+        return None
+
+    # lane-0 offset discovery, then whole-matrix per-lane verification
+    # (the same contract as stage_packed: HOW the offsets are found does
+    # not matter, the byte-equality below makes extraction correct)
+    body0 = body[0].tobytes()
+    proof_ref = np.ascontiguousarray(vc.vrf_proof[:, :plen])
+    refs = (
+        vc.vk_cold, vc.vrf_vk, vc.vrf_output, proof_ref,
+        vc.ocert_vk_hot, vc.ocert_sigma,
+    )
+    offs = tuple(body0.find(r[0].tobytes()) for r in refs)
+    if min(offs) < 0:
+        return None
+    for o, ref in zip(offs, refs):
+        if not np.array_equal(body[:, o : o + ref.shape[1]], ref):
+            return None
+
+    slot, counter, c0 = vc.slot, vc.ocert_counter, vc.ocert_kes_period
+    for a in (slot, counter, c0):
+        if a.min() < 0 or a.max() >= 2**31:
+            return None
+
+    kes_rs = np.ascontiguousarray(vc.kes_sig[:, :64])
+    kt_rows, kt_idx = _dedup_rows(vc.kes_sig[:, 64:])
+    kt_tab = np.zeros((_table_bucket(kt_rows.shape[0]), sig_len - 64), np.uint8)
+    kt_tab[: kt_rows.shape[0]] = kt_rows
+    kt_tab[kt_rows.shape[0] :] = kt_tab[0]
+
+    lo_rows, hi_rows = _uniq_threshold_rows(params, pre)
+    rows = [np.concatenate([lo, hi]) for lo, hi in zip(lo_rows, hi_rows)]
+    thr_tab = np.zeros((_table_bucket(len(rows)), 64), np.uint8)
+    thr_tab[: len(rows)] = np.stack(rows)
+    thr_tab[len(rows) :] = thr_tab[0]
+
+    first_next = (slot // params.epoch_length + 1) * params.epoch_length
+    within = (slot + params.stability_window < first_next).astype(np.uint8)
+
+    layout = PraosPackedLayout(
+        lb, *offs, depth, params.slots_per_kes_period,
+        epoch_nonce is not None, plen,
+    )
+    packed = PraosPacked(
+        body=np.ascontiguousarray(body),
+        kes_rs=kes_rs,
+        kes_tail_idx=kt_idx.astype(np.int32),
+        kes_tail_tab=kt_tab,
+        slot=slot.astype(np.int32),
+        counter=counter.astype(np.int32),
+        c0=c0.astype(np.int32),
+        thr_idx=pre.uniq_inv.astype(np.int32),
+        thr_tab=thr_tab,
+        nonce=np.frombuffer(epoch_nonce or bytes(32), np.uint8),
+        within=within,
+    )
+    return layout, packed
+
+
 def pad_packed_to(packed: PraosPacked, size: int) -> PraosPacked:
     """Pad the per-lane columns up to `size` by replicating lane 0
     (window tables and the nonce are shared, not padded). Same jit-cache
@@ -1022,11 +1343,21 @@ def _jitted_verify(bc: bool = False):
     return _JIT[key]
 
 
+def _lt_be_rows(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Vectorized big-endian lexicographic a < b per row, [n, 32] uint8
+    (the host numpy twin of the device `_lt_be`)."""
+    ne = a != b
+    any_ne = ne.any(axis=1)
+    first = ne.argmax(axis=1)
+    rows = np.arange(a.shape[0])
+    return any_ne & (a[rows, first] < b[rows, first])
+
+
 def run_batch_native(
     params: PraosParams,
     ledger_view: LedgerView,
     epoch_nonce,
-    hvs: Sequence[HeaderView],
+    hvs: "Sequence[HeaderView] | ViewColumns",
     pre: HostChecks,
 ) -> Verdicts:
     """Native (C++) crypto backend producing the same Verdicts shape as
@@ -1034,29 +1365,61 @@ def run_batch_native(
     fallback when no accelerator is available (native/hostcrypto.cpp
     oc_validate_praos). Short-circuits at the first failing lane; lanes
     past it carry don't-care verdicts, which the sequential epilogue
-    never reads."""
+    never reads.
+
+    A ViewColumns window passes its matrices through untouched (no
+    per-header np.stack) and runs the leader bracket as one vectorized
+    byte compare against the per-pool threshold tables — the same
+    clamped byte rows the device kernel compares against."""
     from .. import native_loader as nl
 
     n = len(hvs)
-    cold_vk = np.stack([np.frombuffer(hv.vk_cold, np.uint8) for hv in hvs])
-    ocert_sig = np.stack([np.frombuffer(hv.ocert.sigma, np.uint8) for hv in hvs])
-    ocert_msg = np.stack(
-        [np.frombuffer(hv.ocert.signable(), np.uint8) for hv in hvs]
-    )
-    kes_vk = np.stack([np.frombuffer(hv.ocert.vk_hot, np.uint8) for hv in hvs])
-    kes_sig = np.stack([np.frombuffer(hv.kes_sig, np.uint8) for hv in hvs])
-    body = b"".join(hv.signed_bytes for hv in hvs)
-    body_off = np.zeros(n + 1, np.int64)
-    np.cumsum([len(hv.signed_bytes) for hv in hvs], out=body_off[1:])
-    vrf_vk = np.stack([np.frombuffer(hv.vrf_vk, np.uint8) for hv in hvs])
-    vrf_proof = np.stack([np.frombuffer(hv.vrf_proof, np.uint8) for hv in hvs])
-    vrf_alpha = np.stack(
-        [
-            np.frombuffer(nonces.mk_input_vrf(hv.slot, epoch_nonce), np.uint8)
-            for hv in hvs
-        ]
-    )
-    vrf_output = np.stack([np.frombuffer(hv.vrf_output, np.uint8) for hv in hvs])
+    if isinstance(hvs, ViewColumns):
+        vc = hvs
+        cold_vk = vc.vk_cold
+        ocert_sig = vc.ocert_sigma
+        ocert_msg = np.concatenate(
+            [vc.ocert_vk_hot, _be8_np(vc.ocert_counter),
+             _be8_np(vc.ocert_kes_period)], axis=1,
+        )
+        kes_vk = vc.ocert_vk_hot
+        kes_sig = vc.kes_sig
+        lb = vc.signed_bytes.shape[1]
+        body = vc.signed_bytes.tobytes()
+        body_off = np.arange(n + 1, dtype=np.int64) * lb
+        vrf_vk = vc.vrf_vk
+        plen = int(vc.vrf_proof_len[0])
+        vrf_proof = np.ascontiguousarray(vc.vrf_proof[:, :plen])
+        vrf_alpha = _alpha_column(vc, epoch_nonce)
+        vrf_output = vc.vrf_output
+    else:
+        cold_vk = np.stack([np.frombuffer(hv.vk_cold, np.uint8) for hv in hvs])
+        ocert_sig = np.stack(
+            [np.frombuffer(hv.ocert.sigma, np.uint8) for hv in hvs]
+        )
+        ocert_msg = np.stack(
+            [np.frombuffer(hv.ocert.signable(), np.uint8) for hv in hvs]
+        )
+        kes_vk = np.stack(
+            [np.frombuffer(hv.ocert.vk_hot, np.uint8) for hv in hvs]
+        )
+        kes_sig = np.stack([np.frombuffer(hv.kes_sig, np.uint8) for hv in hvs])
+        body = b"".join(hv.signed_bytes for hv in hvs)
+        body_off = np.zeros(n + 1, np.int64)
+        np.cumsum([len(hv.signed_bytes) for hv in hvs], out=body_off[1:])
+        vrf_vk = np.stack([np.frombuffer(hv.vrf_vk, np.uint8) for hv in hvs])
+        vrf_proof = np.stack(
+            [np.frombuffer(hv.vrf_proof, np.uint8) for hv in hvs]
+        )
+        vrf_alpha = np.stack(
+            [
+                np.frombuffer(nonces.mk_input_vrf(hv.slot, epoch_nonce), np.uint8)
+                for hv in hvs
+            ]
+        )
+        vrf_output = np.stack(
+            [np.frombuffer(hv.vrf_output, np.uint8) for hv in hvs]
+        )
 
     rc, kind, lv, eta = nl.native_validate_praos(
         cold_vk, ocert_sig, ocert_msg, kes_vk,
@@ -1069,19 +1432,30 @@ def run_batch_native(
     if rc >= 0:
         (ok_ocert if kind == 1 else ok_kes if kind == 2 else ok_vrf)[rc] = False
 
-    # leader threshold: bracket compare exactly as the device kernel
-    f = params.active_slot_coeff
-    ok_leader = np.zeros(n, bool)
-    ambiguous = np.zeros(n, bool)
     stop = n if rc < 0 else rc
-    for i in range(stop):
-        hv = hvs[i]
-        entry = ledger_view.pool_distr.get(hash_key(hv.vk_cold))
-        sigma = entry.stake if entry is not None else Fraction(0)
-        lo, hi = leader_threshold_bracket(Fraction(sigma), Fraction(f))
-        lv_int = int.from_bytes(lv[i].tobytes(), "big")
-        ok_leader[i] = lv_int < lo
-        ambiguous[i] = not ok_leader[i] and lv_int < hi
+    if isinstance(hvs, ViewColumns) and isinstance(pre, ColumnChecks):
+        # bracket compare vectorized against the per-pool byte tables
+        # (Fraction math once per unique pool; ambiguous lanes still go
+        # to the exact host check in _lane_error)
+        thr_lo, thr_hi = _uniq_threshold_tables(params, pre)
+        win = _lt_be_rows(lv, thr_lo)
+        amb = ~win & _lt_be_rows(lv, thr_hi)
+        live = np.arange(n) < stop
+        ok_leader = win & live
+        ambiguous = amb & live
+    else:
+        # leader threshold: bracket compare exactly as the device kernel
+        f = params.active_slot_coeff
+        ok_leader = np.zeros(n, bool)
+        ambiguous = np.zeros(n, bool)
+        for i in range(stop):
+            hv = hvs[i]
+            entry = ledger_view.pool_distr.get(hash_key(hv.vk_cold))
+            sigma = entry.stake if entry is not None else Fraction(0)
+            lo, hi = leader_threshold_bracket(Fraction(sigma), Fraction(f))
+            lv_int = int.from_bytes(lv[i].tobytes(), "big")
+            ok_leader[i] = lv_int < lo
+            ambiguous[i] = not ok_leader[i] and lv_int < hi
     return Verdicts(ok_ocert, ok_kes, ok_vrf, ok_leader, ambiguous, eta, lv)
 
 
@@ -1180,10 +1554,29 @@ def _lane_error(
     return praos.VRFLeaderValueTooBig(lv_val, sigma, params.active_slot_coeff)
 
 
+def _proof_len_uniform(hvs) -> bool:
+    if isinstance(hvs, ViewColumns):
+        pl = hvs.vrf_proof_len
+        return bool((pl == pl[0]).all())
+    return len({len(hv.vrf_proof) for hv in hvs}) <= 1
+
+
+def _proof_len_at(hvs, i: int) -> int:
+    if isinstance(hvs, ViewColumns):
+        return int(hvs.vrf_proof_len[i])
+    return len(hvs[i].vrf_proof)
+
+
+def _slot_at(hvs, i: int) -> int:
+    if isinstance(hvs, ViewColumns):
+        return int(hvs.slot[i])
+    return hvs[i].slot
+
+
 def validate_batch(
     params: PraosParams,
     ticked: TickedPraosState,
-    hvs: Sequence[HeaderView],
+    hvs: "Sequence[HeaderView] | ViewColumns",
     collect_states: bool = False,
     backend: str = "device",
     mesh=None,  # backend="sharded": the jax.sharding.Mesh (None = all devices)
@@ -1196,13 +1589,17 @@ def validate_batch(
     verifier (backend="native"). The epoch nonce must be constant across
     the run (the caller segments at epoch boundaries; `tick` between
     segments).
+
+    `hvs` may be a ViewColumns window: prechecks, staging and the
+    all-clean epilogue then run columnar (no per-header objects);
+    HeaderViews materialize only for anomaly lanes.
     """
-    if not hvs:
+    if not len(hvs):
         return BatchResult(ticked.state, 0, None, [] if collect_states else None)
     lview = ticked.ledger_view
     eta0 = ticked.state.epoch_nonce
 
-    if len({len(hv.vrf_proof) for hv in hvs}) > 1:
+    if not _proof_len_uniform(hvs):
         # a run mixing 80- and 128-byte proofs cannot stage as one
         # uniform proof column; segment at format boundaries — the
         # reference fold length-dispatches per header, and segmentation
@@ -1210,21 +1607,19 @@ def validate_batch(
         states = [] if collect_states else None
         total = 0
         i = 0
+        n = len(hvs)
         while True:
-            plen = len(hvs[i].vrf_proof)
-            j = i + 1
-            while j < len(hvs) and len(hvs[j].vrf_proof) == plen:
-                j += 1
+            j = _proof_break(hvs, i, n)
             res = validate_batch(
                 params, ticked, hvs[i:j], collect_states, backend, mesh
             )
             total += res.n_valid
             if collect_states:
                 states.extend(res.states or [])
-            if res.error is not None or j == len(hvs):
+            if res.error is not None or j == n:
                 return BatchResult(res.state, total, res.error, states)
             i = j
-            ticked = praos.tick(params, lview, hvs[i].slot, res.state)
+            ticked = praos.tick(params, lview, _slot_at(hvs, i), res.state)
 
     pre = host_prechecks(params, lview, hvs)
     if backend == "native":
@@ -1234,12 +1629,33 @@ def validate_batch(
         # verdict collectives (parallel/spmd.py; SURVEY.md §5.8)
         from ..parallel import spmd
 
-        batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
-        v, _first_bad, _n_ok = spmd.sharded_run_batch(batch, mesh)
+        v, _first_bad, _n_ok = spmd.sharded_stage_run(
+            params, lview, eta0, hvs, pre, mesh
+        )
     else:
-        batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
+        batch = stage_any(params, lview, eta0, hvs, pre)
         v = run_batch(batch)
     return _epilogue(params, ticked, hvs, pre, v, collect_states)
+
+
+def stage_any(
+    params: PraosParams,
+    ledger_view: LedgerView,
+    epoch_nonce,
+    hvs: "Sequence[HeaderView] | ViewColumns",
+    pre: HostChecks,
+) -> PraosBatch:
+    """Stage whichever window representation arrives: ViewColumns go
+    through the columnar stage; HeaderView lists through the classic
+    per-view stage (also the lazy fallback for columnar windows that
+    cannot stage columnar, e.g. non-int32 slots)."""
+    if isinstance(hvs, ViewColumns) and isinstance(pre, ColumnChecks):
+        return stage_columns(
+            params, ledger_view, epoch_nonce, hvs, pre.kes_evolution, pre
+        )
+    if isinstance(hvs, ViewColumns):
+        hvs = hvs.views()
+    return stage(params, ledger_view, epoch_nonce, hvs, pre.kes_evolution)
 
 
 # Enclose latency brackets (Util/Enclose.hs) around the hot-path
@@ -1316,9 +1732,15 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
         pre = host_prechecks(params, lview, hvs)
         packed = None
         if PACKED_STAGE and not os.environ.get("OCT_PK_FUSED"):
-            packed = stage_packed(params, lview, eta0, hvs)
+            if isinstance(hvs, ViewColumns):
+                packed = (
+                    stage_packed_columns(params, lview, eta0, hvs, pre)
+                    if isinstance(pre, ColumnChecks) else None
+                )
+            else:
+                packed = stage_packed(params, lview, eta0, hvs)
         if packed is None:
-            batch = stage(params, lview, eta0, hvs, pre.kes_evolution)
+            batch = stage_any(params, lview, eta0, hvs, pre)
             padded = pad_batch_to(batch, bucket_size(b))
             h2d = _nbytes(flatten_batch(padded))
             lanes = padded.beta.shape[0]
@@ -1589,10 +2011,102 @@ def _epilogue_packed_fast(
     return BatchResult(state, len(hvs), None, None)
 
 
+def _verdicts_clean(v, b: int) -> bool:
+    """Every real lane passed every check outright (no ambiguity)."""
+    if isinstance(v, PackedVerdicts):
+        return v.clean()
+    return bool(
+        np.asarray(v.ok_ocert_sig)[:b].all()
+        and np.asarray(v.ok_kes_sig)[:b].all()
+        and np.asarray(v.ok_vrf)[:b].all()
+        and np.asarray(v.ok_leader)[:b].all()
+        and not np.asarray(v.leader_ambiguous)[:b].any()
+    )
+
+
+def _epilogue_columns_fast(
+    params: PraosParams,
+    ticked: TickedPraosState,
+    vc: ViewColumns,
+    pre: HostChecks,
+    v,
+) -> BatchResult | None:
+    """The columnar all-clean epilogue: counter monotonicity checked per
+    unique pool over whole column slices, the candidate-nonce gate
+    computed as one vectorized window compare, and the final state
+    assembled without materializing a single HeaderView. Returns None
+    when ANY gate trips (verdict anomaly, precheck error, counter
+    violation, no pool dedup available) — the caller falls back to the
+    exact per-header reference fold, so failure semantics are untouched.
+
+    The evolving/candidate nonce fold is the device-scanned carry when
+    the window rode the packed nonce scan; otherwise the sequential
+    Blake2b fold over the eta column runs here — a hash chain is
+    inherently per-header (COVERAGE.md §5.11)."""
+    b = len(vc)
+    if not isinstance(pre, ColumnChecks) or pre.any_errors():
+        return None
+    if not _verdicts_clean(v, b):
+        return None
+    st = ticked.state
+    lview = ticked.ledger_view
+    counters = dict(st.ocert_counters)
+    cnt = vc.ocert_counter
+    inv = pre.uniq_inv
+    for j, hk in enumerate(pre.uniq_hk):
+        m = _counter_m(hk, counters, lview.pool_distr)
+        if m is None:
+            return None
+        cs = cnt[inv == j]
+        d = np.diff(cs)
+        if not (
+            m <= cs[0] <= m + 1 and (d >= 0).all() and (d <= 1).all()
+        ):
+            return None
+        counters[hk] = int(cs[-1])
+
+    carried = isinstance(v, PackedVerdicts) and v.carried and v.nonces is not None
+    if carried:
+        ev, evs, cand, cands = v.nonces
+        evolving = ev.tobytes() if evs else None
+        candidate = cand.tobytes() if cands else None
+    else:
+        etas = (
+            v.eta_bytes() if isinstance(v, PackedVerdicts)
+            else np.ascontiguousarray(np.asarray(v.eta).astype(np.uint8))
+        )
+        first_next = (vc.slot // params.epoch_length + 1) * params.epoch_length
+        within = vc.slot + params.stability_window < first_next
+        w_idx = np.flatnonzero(within)
+        k = int(w_idx[-1]) if w_idx.size else -1
+        evolving = st.evolving_nonce
+        candidate = st.candidate_nonce
+        data = etas.tobytes()
+        for i in range(k + 1):
+            evolving = nonces.combine(evolving, data[32 * i : 32 * i + 32])
+        if k >= 0:
+            candidate = evolving
+        for i in range(k + 1, b):
+            evolving = nonces.combine(evolving, data[32 * i : 32 * i + 32])
+
+    last = b - 1
+    prev = vc.prev_hash[last].tobytes() if vc.has_prev[last] else None
+    state = PraosState(
+        last_slot=int(vc.slot[last]),
+        ocert_counters=counters,
+        evolving_nonce=evolving,
+        candidate_nonce=candidate,
+        epoch_nonce=st.epoch_nonce,
+        lab_nonce=nonces.prev_hash_to_nonce(prev),
+        last_epoch_block_nonce=st.last_epoch_block_nonce,
+    )
+    return BatchResult(state, b, None, None)
+
+
 def _epilogue(
     params: PraosParams,
     ticked: TickedPraosState,
-    hvs: Sequence[HeaderView],
+    hvs: "Sequence[HeaderView] | ViewColumns",
     pre: HostChecks,
     v: Verdicts,
     collect_states: bool = False,
@@ -1603,9 +2117,24 @@ def _epilogue(
     `lane_error` defaults to the Praos `_lane_error`; TPraos passes an
     overlay-aware variant (protocol/tpraos.py). A PackedVerdicts `v`
     first tries the bitmask fast path (_epilogue_packed_fast) and only
-    materializes the per-lane columns when a gate trips."""
+    materializes the per-lane columns when a gate trips. A ViewColumns
+    window first tries the fully-columnar fast path; HeaderViews
+    materialize only when a gate trips (anomaly windows — the exact
+    per-header reference fold)."""
+    columns_declined = False
+    if isinstance(hvs, ViewColumns):
+        if lane_error is None and not collect_states and len(hvs):
+            res = _epilogue_columns_fast(params, ticked, hvs, pre, v)
+            if res is not None:
+                return res
+            columns_declined = True
+        hvs = hvs.views()
     if isinstance(v, PackedVerdicts):
-        if lane_error is None and not collect_states and hvs:
+        # a declined columnar fast path already proved a gate trips —
+        # the packed fast path checks the equivalent gates and would
+        # burn O(lanes) re-proving it before the slow path
+        if (lane_error is None and not collect_states and hvs
+                and not columns_declined):
             res = _epilogue_packed_fast(params, ticked, hvs, pre, v)
             if res is not None:
                 return res
@@ -1753,6 +2282,46 @@ def validate_chain(
             pool.shutdown(wait=False, cancel_futures=True)
 
 
+def _epoch_segments_idx(params, hvs) -> list[tuple[int, int, int]]:
+    """[(epoch, start, end)] index segmentation at epoch boundaries —
+    one vectorized pass for ViewColumns, the per-header walk for lists."""
+    n = len(hvs)
+    if n == 0:
+        return []
+    if isinstance(hvs, ViewColumns):
+        epochs = hvs.slot // params.epoch_length
+        cuts = np.flatnonzero(np.diff(epochs)) + 1
+        bounds = [0, *cuts.tolist(), n]
+        return [
+            (int(epochs[bounds[k]]), bounds[k], bounds[k + 1])
+            for k in range(len(bounds) - 1)
+        ]
+    segments = []
+    i = 0
+    while i < n:
+        epoch = params.epoch_of(hvs[i].slot)
+        j = i
+        while j < n and params.epoch_of(hvs[j].slot) == epoch:
+            j += 1
+        segments.append((epoch, i, j))
+        i = j
+    return segments
+
+
+def _proof_break(hvs, w: int, j: int) -> int:
+    """First index in (w, j) where the VRF proof format changes (a
+    window must stage one uniform proof column), else j."""
+    if isinstance(hvs, ViewColumns):
+        pl = hvs.vrf_proof_len
+        diff = np.flatnonzero(pl[w + 1 : j] != pl[w])
+        return w + 1 + int(diff[0]) if diff.size else j
+    plen = len(hvs[w].vrf_proof)
+    for k in range(w + 1, j):
+        if len(hvs[k].vrf_proof) != plen:
+            return k
+    return j
+
+
 def _validate_chain_loop(
     params, ledger_view_for_epoch, state, hvs, max_batch, backend,
     pipeline_depth, mesh, pool,
@@ -1761,15 +2330,11 @@ def _validate_chain_loop(
     i = 0
     n = len(hvs)
     if backend != "device":
-        while i < n:
-            epoch = params.epoch_of(hvs[i].slot)
-            seg_end = i
-            while seg_end < n and params.epoch_of(hvs[seg_end].slot) == epoch:
-                seg_end += 1
+        for epoch, i, seg_end in _epoch_segments_idx(params, hvs):
             lview = ledger_view_for_epoch(epoch)
             while i < seg_end:
                 j = min(i + max_batch, seg_end)
-                ticked = praos.tick(params, lview, hvs[i].slot, state)
+                ticked = praos.tick(params, lview, _slot_at(hvs, i), state)
                 res = validate_batch(
                     params, ticked, hvs[i:j], backend=backend, mesh=mesh
                 )
@@ -1794,14 +2359,7 @@ def _validate_chain_loop(
     # asserts the staged nonce byte-for-byte.
     from collections import deque
 
-    segments: list[tuple[int, int, int]] = []
-    while i < n:
-        epoch = params.epoch_of(hvs[i].slot)
-        j = i
-        while j < n and params.epoch_of(hvs[j].slot) == epoch:
-            j += 1
-        segments.append((epoch, i, j))
-        i = j
+    segments = _epoch_segments_idx(params, hvs)
 
     lviews: dict[int, object] = {}
 
@@ -1813,10 +2371,10 @@ def _validate_chain_loop(
     eta_known: dict[int, object] = {}
     if segments:
         eta_known[0] = praos.tick(
-            params, lview_for(0), hvs[segments[0][1]].slot, state
+            params, lview_for(0), _slot_at(hvs, segments[0][1]), state
         ).state.epoch_nonce
 
-    inflight: deque = deque()  # (seg_idx, window_hvs, pre, future)
+    inflight: deque = deque()  # (seg_idx, window_hvs, window_start, pre, future)
     s_stage = 0  # segment currently being staged
     w = segments[0][1] if segments else 0
     retired = 0  # index of the next header to retire
@@ -1840,13 +2398,10 @@ def _validate_chain_loop(
             # first 80/128-byte format change (the reference fold
             # length-dispatches per header, so mixed chains stay valid;
             # segmentation never changes verdicts or the first error)
-            plen = len(hvs[w].vrf_proof)
-            for k in range(w + 1, j):
-                if len(hvs[k].vrf_proof) != plen:
-                    j = k
-                    break
+            j = _proof_break(hvs, w, j)
+            whvs = hvs[w:j]
             pre, out, b, carry_out = dispatch_batch(
-                params, lview_for(s_stage), eta_known[s_stage], hvs[w:j],
+                params, lview_for(s_stage), eta_known[s_stage], whvs,
                 carry=carry if carry_ok else None,
             )
             if carry_out is None:
@@ -1854,7 +2409,7 @@ def _validate_chain_loop(
             else:
                 carry = carry_out
             inflight.append(
-                (s_stage, hvs[w:j], pre,
+                (s_stage, whvs, w, pre,
                  pool.submit(materialize_verdicts, out, b))
             )
             w = j
@@ -1870,18 +2425,18 @@ def _validate_chain_loop(
             # compute it right now from the fully-folded state
             eta_known[s_stage] = praos.tick(
                 params, lview_for(s_stage),
-                hvs[segments[s_stage][1]].slot, state,
+                _slot_at(hvs, segments[s_stage][1]), state,
             ).state.epoch_nonce
             if not carry_ok:
                 carry = _state_carry(state)
                 carry_ok = True
             continue
 
-        s_b, whvs, pre, fut = inflight.popleft()
+        s_b, whvs, w_start, pre, fut = inflight.popleft()
         with _enclose("materialize"):
             v = fut.result()
-        ticked = praos.tick(params, lview_for(s_b), whvs[0].slot, state)
-        if whvs[0] is hvs[segments[s_b][1]]:
+        ticked = praos.tick(params, lview_for(s_b), _slot_at(whvs, 0), state)
+        if w_start == segments[s_b][1]:
             # first batch of a segment staged with a LOOKAHEAD nonce:
             # the real rotation must agree (internal invariant)
             assert ticked.state.epoch_nonce == eta_known[s_b], (
@@ -1906,7 +2461,7 @@ def _validate_chain_loop(
             epoch, _, seg_end = segments[s_b]
             if retired >= seg_end:
                 eta_known[nxt] = praos.tick(
-                    params, lview_for(nxt), hvs[segments[nxt][1]].slot,
+                    params, lview_for(nxt), _slot_at(hvs, segments[nxt][1]),
                     state,
                 ).state.epoch_nonce
             else:
@@ -1914,7 +2469,7 @@ def _validate_chain_loop(
                     params.first_slot_of(epoch + 1)
                     - params.stability_window
                 )
-                if hvs[retired].slot >= freeze:
+                if _slot_at(hvs, retired) >= freeze:
                     # candidate is frozen and the LAB component was
                     # latched a boundary ago: the rotation is decided
                     eta_known[nxt] = nonces.combine(

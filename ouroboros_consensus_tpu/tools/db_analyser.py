@@ -162,11 +162,26 @@ def _epoch_segments(params: PraosParams, headers):
         yield seg
 
 
-def _views_from_columns(cols):
-    """native_loader.HeaderColumns -> HeaderViews (no Python CBOR).
+def _columnar_enabled() -> bool:
+    """OCT_COLUMNAR (default 1): flow the native chunk scan as
+    ViewColumns windows end-to-end (vectorized prechecks, columnar
+    packed staging, columnar epilogue — the round-8 host pipeline). =0
+    restores the per-HeaderView object stream; read per call so the
+    differential tests can A/B both paths in one process."""
+    import os
 
-    Whole-column tobytes + slicing: per-row numpy bytes() conversions
-    cost ~10 us/header, bytes slicing ~0.1 us."""
+    return os.environ.get("OCT_COLUMNAR", "1") != "0"
+
+
+def _views_from_columns(cols):
+    """native_loader.HeaderColumns -> HeaderViews (no Python CBOR) — the
+    per-object stream (`OCT_COLUMNAR=0` and ragged-chunk fallback)."""
+    from ..protocol.views import ViewColumns
+
+    vc = ViewColumns.from_header_columns(cols)
+    if vc is not None:
+        return vc.views()
+    # ragged spans (no rectangular column): per-row bytes-list path
     from ..protocol.views import HeaderView, OCert
 
     n = cols.n
@@ -205,17 +220,20 @@ def _views_from_columns(cols):
     return out
 
 
-def _stream_views(imm: ImmutableDB, res: "ValidationResult"):
-    """HeaderView stream for revalidation: the native columnar extractor
-    per chunk when available (the C++ data-loader path — SURVEY.md §7.3
-    item 5: CBOR decode is the host bottleneck), else per-block Python
-    parsing."""
+def _stream_windows(imm: ImmutableDB, res: "ValidationResult"):
+    """Per-chunk window stream for revalidation: `ViewColumns` straight
+    from the native columnar extractor when available (the C++
+    data-loader path — SURVEY.md §7.3 item 5: CBOR decode is the host
+    bottleneck — with ZERO per-header Python objects), HeaderView lists
+    otherwise (no native library, OCT_COLUMNAR=0, or ragged chunks)."""
     import os
 
     from .. import native_loader
+    from ..protocol.views import ViewColumns
     from ..storage.immutable import _chunk_name
 
     native_ok = native_loader.load() is not None
+    columnar = _columnar_enabled()
     stream_deep = getattr(imm, "stream_deep", False)
     for n in imm._chunks:
         entries = imm._entries[n]
@@ -246,15 +264,117 @@ def _stream_views(imm: ImmutableDB, res: "ValidationResult"):
             offsets = np.asarray([e.offset for e in entries], np.int64)
             cols = native_loader.extract_headers(data, offsets)
             res.n_blocks += cols.n
-            yield from _views_from_columns(cols)
+            pieces = (
+                ViewColumns.pieces_from_header_columns(cols)
+                if columnar else None
+            )
+            if pieces is None:
+                yield _views_from_columns(cols)
+            else:
+                yield from pieces
         else:
+            win = []
             for e in entries:
                 res.n_blocks += 1
-                yield Block.from_bytes(
+                win.append(Block.from_bytes(
                     data[e.offset : e.offset + e.size]
-                ).header.to_view()
+                ).header.to_view())
+            yield win
         if truncated:
             return  # corruption truncates the chain here
+
+
+def _stream_views(imm: ImmutableDB, res: "ValidationResult"):
+    """Per-header HeaderView stream (the sequential reference fold's
+    input; the batched backends consume `_stream_windows`)."""
+    from ..protocol.views import ViewColumns
+
+    for win in _stream_windows(imm, res):
+        if isinstance(win, ViewColumns):
+            yield from win.views()
+        else:
+            yield from win
+
+
+def _cap_windows(wins, cap: int):
+    """Truncate a window stream to `cap` total headers."""
+    left = cap
+    for win in wins:
+        if left <= 0:
+            return
+        if len(win) > left:
+            yield win[:left]
+            return
+        left -= len(win)
+        yield win
+
+
+def _epoch_window_segments(params: PraosParams, wins):
+    """Cut a stream of chunk windows at epoch boundaries (SURVEY.md
+    §5.7), merging same-epoch pieces: the columnar analog of
+    `_epoch_segments`. Consecutive same-width ViewColumns pieces merge
+    into ONE columnar segment per epoch (one array concat); a row-width
+    change inside an epoch (CBOR integer-width step) yields separate
+    columnar segments rather than falling back to objects —
+    validate_chain threads state across them identically (the
+    within-epoch tick is a no-op rotation)."""
+    from ..protocol.views import ViewColumns
+
+    def pieces():
+        import numpy as np
+
+        for win in wins:
+            if isinstance(win, ViewColumns):
+                epochs = win.slot // params.epoch_length
+                cuts = np.flatnonzero(np.diff(epochs)) + 1
+                bounds = [0, *cuts.tolist(), len(win)]
+                for k in range(len(bounds) - 1):
+                    yield int(epochs[bounds[k]]), win[bounds[k]:bounds[k + 1]]
+            else:
+                seg: list = []
+                e = None
+                for hv in win:
+                    he = params.epoch_of(hv.slot)
+                    if e is None or he == e:
+                        seg.append(hv)
+                        e = he
+                    else:
+                        yield e, seg
+                        seg, e = [hv], he
+                if seg:
+                    yield e, seg
+
+    def flush(parts):
+        group: list = []
+        gw = None
+        for p in parts:
+            if isinstance(p, ViewColumns):
+                wkey = (p.signed_bytes.shape[1], p.kes_sig.shape[1])
+                if group and gw == wkey:
+                    group.append(p)
+                    continue
+                if group:
+                    yield ViewColumns.concat(group)
+                group, gw = [p], wkey
+            else:
+                if group:
+                    yield ViewColumns.concat(group)
+                    group, gw = [], None
+                yield p
+        if group:
+            yield ViewColumns.concat(group)
+
+    acc: list = []
+    epoch = None
+    for e, piece in pieces():
+        if epoch is None or e == epoch:
+            acc.append(piece)
+            epoch = e
+        else:
+            yield from flush(acc)
+            acc, epoch = [piece], e
+    if acc:
+        yield from flush(acc)
 
 
 def revalidate(
@@ -403,8 +523,13 @@ def _revalidate_impl(
     elif backend in ("device", "native", "sharded"):
         # one epoch segment buffered at a time (bounded memory on real
         # chains); validate_chain pipelines staging against device
-        # execution within each segment
-        for seg in _epoch_segments(params, stream_views(imm, res)):
+        # execution within each segment. Segments flow COLUMNAR
+        # (ViewColumns) end-to-end from the native chunk scan; HeaderView
+        # lists appear only without the native library / OCT_COLUMNAR=0
+        wins = _stream_windows(imm, res)
+        if max_headers is not None:
+            wins = _cap_windows(wins, max_headers)
+        for seg in _epoch_window_segments(params, wins):
             ts = time.monotonic()
             result = pbatch.validate_chain(
                 params, lambda _e: lview, st, seg,

@@ -8,7 +8,16 @@ between the composed kernel rate (~11.6k lanes/s hot) and the
 end-to-end rate (5.3k headers/s, BENCH r5 first run) has to be
 attributed before it can be closed.
 
-Usage:  python scripts/profile_replay.py [n_headers]  (default 100000)
+`--host` runs the HOST-PIPELINE-ONLY replay instead: stream the chain,
+segment it, run host_prechecks + packed staging per window — no device
+dispatch at all. This measures the host pipeline CEILING (µs/header of
+view-stream + prechecks + stage; its reciprocal is the best rate any
+device can be fed at) and is CPU-verifiable on a box with no
+accelerator. A/B the columnar window pipeline against the per-object
+one with OCT_COLUMNAR=0 (round-8 acceptance metric).
+
+Usage:  python scripts/profile_replay.py [--host] [n_headers]
+        (default 100000)
 """
 
 import os
@@ -23,7 +32,96 @@ import jax
 jax.config.update("jax_compilation_cache_dir", "/tmp/ouroboros-jax-cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-N = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+ARGS = [a for a in sys.argv[1:] if not a.startswith("--")]
+HOST_ONLY = "--host" in sys.argv[1:]
+N = int(ARGS[0]) if ARGS else 100_000
+
+
+def host_ceiling():
+    """Host-pipeline-only replay: window stream -> epoch segmentation ->
+    host_prechecks -> packed staging (+ bucket pad), timed per phase.
+    No verdicts are produced (no device); the epoch nonce fed to staging
+    comes from a genesis tick — staging cost does not depend on the
+    nonce VALUE, only its presence, so the measured work is identical
+    to the real replay's stage bracket."""
+    os.environ.setdefault("BENCH_HEADERS", str(N))
+    import numpy as np
+
+    import bench
+    from ouroboros_consensus_tpu.protocol import batch as pbatch
+    from ouroboros_consensus_tpu.protocol import praos
+    from ouroboros_consensus_tpu.protocol.views import ViewColumns
+    from ouroboros_consensus_tpu.tools import db_analyser as ana
+
+    path, params, lview = bench.build_or_load_chain()
+    columnar = ana._columnar_enabled()
+    mode = "columnar (ViewColumns)" if columnar else "per-object (HeaderView)"
+    print(f"host pipeline: {mode}", flush=True)
+
+    for attempt in ("warm", "hot"):
+        res = ana.ValidationResult()
+        imm = ana.open_immutable(path, validate_all="stream")
+        t_stream = t_pre = t_stage = 0.0
+        nh = nwin = npacked = 0
+        t0 = time.monotonic()
+
+        def timed_windows():
+            nonlocal t_stream
+            it = ana._stream_windows(imm, res)
+            while True:
+                ts = time.monotonic()
+                try:
+                    win = next(it)
+                except StopIteration:
+                    t_stream += time.monotonic() - ts
+                    return
+                t_stream += time.monotonic() - ts
+                yield win
+
+        wins = ana._cap_windows(timed_windows(), N)
+        state = praos.PraosState()
+        for seg in ana._epoch_window_segments(params, wins):
+            ticked = praos.tick(
+                params, lview, pbatch._slot_at(seg, 0), state
+            )
+            eta0 = ticked.state.epoch_nonce
+            w, seg_n = 0, len(seg)
+            while w < seg_n:
+                j = pbatch._proof_break(seg, w, min(w + bench.MAX_BATCH, seg_n))
+                win = seg[w:j]
+                ts = time.monotonic()
+                pre = pbatch.host_prechecks(params, lview, win)
+                t_pre += time.monotonic() - ts
+                ts = time.monotonic()
+                packed = None
+                if isinstance(win, ViewColumns) and isinstance(
+                    pre, pbatch.ColumnChecks
+                ):
+                    packed = pbatch.stage_packed_columns(
+                        params, lview, eta0, win, pre
+                    )
+                elif not isinstance(win, ViewColumns):
+                    packed = pbatch.stage_packed(params, lview, eta0, win)
+                if packed is None:
+                    pbatch.stage_any(params, lview, eta0, win, pre)
+                else:
+                    pbatch.pad_packed_to(
+                        packed[1], pbatch.bucket_size(len(win))
+                    )
+                    npacked += 1
+                t_stage += time.monotonic() - ts
+                nh += len(win)
+                nwin += 1
+                w = j
+        wall = time.monotonic() - t0
+        host_s = t_stream + t_pre + t_stage
+        print(f"\n== {attempt}: {nh} headers, host pipeline {host_s:.2f}s "
+              f"(ceiling {nh/host_s:.0f} headers/s; wall {wall:.2f}s)",
+              flush=True)
+        for label, secs in (("view-stream", t_stream),
+                            ("prechecks", t_pre), ("stage", t_stage)):
+            print(f"  {label:12s} {secs:8.2f}s  {secs/nh*1e6:7.2f} us/header")
+        print(f"  windows: {nwin} ({npacked} packed)")
 
 
 def main():
@@ -53,10 +151,10 @@ def main():
 
     pbatch.set_batch_tracer(tracer)
 
-    # instrument the view stream (disk read + native parse + HeaderView
+    # instrument the window stream (disk read + native parse + column
     # build) by timing the generator pulls
     stream_s = 0.0
-    orig_stream = ana._stream_views
+    orig_stream = ana._stream_windows
 
     def timed_stream(imm, res):
         nonlocal stream_s
@@ -64,23 +162,23 @@ def main():
         while True:
             t0 = time.monotonic()
             try:
-                hv = next(it)
+                win = next(it)
             except StopIteration:
                 stream_s += time.monotonic() - t0
                 return
             stream_s += time.monotonic() - t0
-            yield hv
+            yield win
 
     for attempt in ("warm", "hot"):
         tot.clear(); cnt.clear(); xfer.clear(); stream_s = 0.0
-        ana._stream_views = lambda imm, res: timed_stream(imm, res)
+        ana._stream_windows = lambda imm, res: timed_stream(imm, res)
         t0 = time.monotonic()
         r = ana.revalidate(
             path, params, lview, backend="device", validate_all=True,
             max_batch=bench.MAX_BATCH,
         )
         wall = time.monotonic() - t0
-        ana._stream_views = orig_stream
+        ana._stream_windows = orig_stream
         assert r.error is None and r.n_valid == r.n_blocks
         print(f"\n== {attempt}: {r.n_valid} headers in {wall:.2f}s "
               f"({r.n_valid/wall:.0f} headers/s)", flush=True)
@@ -106,4 +204,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if HOST_ONLY:
+        host_ceiling()
+    else:
+        main()

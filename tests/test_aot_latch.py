@@ -1,0 +1,136 @@
+"""The pk-aot rejection latch (ops/pk/aot.py).
+
+Round-8 satellite: the BENCH_r05 tail showed six per-stage "axon format
+vN" deserialize failures in ONE attempt — the PR-2 latch was per-process
+and `load()` never consulted it, so concurrent/later loads re-paid the
+~15 s rejection. These tests pin the fixed contract: one format
+rejection disables every later load in-process, persists a per-build
+marker that disables the load path for FRESH processes on the same
+build (bench attempt 2), does not outlive a build change, and is
+cleared when new executables are written."""
+
+import pytest
+
+from ouroboros_consensus_tpu.ops.pk import aot
+
+
+@pytest.fixture
+def fresh_aot(tmp_path, monkeypatch):
+    """Isolated aot module state: private cache dir, known build slug,
+    un-latched globals (and restore after)."""
+    monkeypatch.setenv("OCT_PK_AOT_DIR", str(tmp_path))
+    monkeypatch.delenv("OCT_PK_AOT", raising=False)
+    monkeypatch.setattr(aot, "_BUILD_SLUG", "aaaaaaaaaaaa")
+    monkeypatch.setattr(aot, "_RUNTIME_REJECTED", False)
+    monkeypatch.setattr(aot, "_MARKER_CHECKED", False)
+    monkeypatch.setattr(aot, "_LOADED", {})
+    return tmp_path
+
+
+def _fresh_process(monkeypatch):
+    """Reset the in-memory latch as a new process would start."""
+    monkeypatch.setattr(aot, "_RUNTIME_REJECTED", False)
+    monkeypatch.setattr(aot, "_MARKER_CHECKED", False)
+    monkeypatch.setattr(aot, "_LOADED", {})
+
+
+def test_format_rejection_latches_in_process(fresh_aot):
+    assert aot.enabled()
+    latched = aot.note_failure(RuntimeError(
+        "INVALID_ARGUMENT: PJRT_Executable_DeserializeAndLoad: cached "
+        "executable is axon format v79599086, this build is v9"
+    ))
+    assert latched and not aot.enabled()
+
+
+def test_non_format_failures_do_not_latch(fresh_aot):
+    assert not aot.note_failure(TypeError(
+        "deserialize_and_load() got an unexpected keyword argument"
+    ))
+    assert aot.enabled()
+
+
+def test_load_skips_disk_once_latched(fresh_aot, monkeypatch):
+    """After the latch, load() must return None WITHOUT touching the
+    cache (no stat, no open, no deserialize — the ~15 s tax)."""
+    aot.note_failure(RuntimeError("serialized executable is incompatible"))
+
+    def boom(*a, **k):
+        raise AssertionError("latched load() touched the cache path")
+
+    monkeypatch.setattr(aot, "stage_path", boom)
+    assert aot.load("ed", 8192, 7, 128, "deadbeef") is None
+
+
+def test_rejection_persists_to_next_process_same_build(fresh_aot,
+                                                       monkeypatch):
+    aot.note_failure(RuntimeError("cached executable is axon format v1"))
+    assert (fresh_aot / "REJECTED.aaaaaaaaaaaa").exists()
+    _fresh_process(monkeypatch)
+    assert not aot.enabled()  # marker read: attempt 2 skips instantly
+    # the memoized-marker read happens once
+    assert aot._MARKER_CHECKED
+
+
+def test_rejection_does_not_outlive_build_change(fresh_aot, monkeypatch):
+    aot.note_failure(RuntimeError("cached executable is axon format v1"))
+    _fresh_process(monkeypatch)
+    monkeypatch.setattr(aot, "_BUILD_SLUG", "bbbbbbbbbbbb")
+    assert aot.enabled()  # a new build retries its own executables
+
+
+def test_env_disable_still_wins(fresh_aot, monkeypatch):
+    monkeypatch.setenv("OCT_PK_AOT", "0")
+    assert not aot.enabled()
+
+
+def test_clear_rejection_reenables(fresh_aot, monkeypatch):
+    aot.note_failure(RuntimeError("cached executable is axon format v1"))
+    assert not aot.enabled()
+    aot.clear_rejection()  # what aot_precompile does after a FULL run
+    assert aot.enabled()
+    assert not (fresh_aot / "REJECTED.aaaaaaaaaaaa").exists()
+    _fresh_process(monkeypatch)
+    assert aot.enabled()
+
+
+def test_concurrent_loads_single_rejection(fresh_aot, monkeypatch):
+    """Two threads racing into load() on a poisoned cache: exactly ONE
+    deserialize attempt runs; the loser sees the latch inside the lock
+    and returns None without paying for a second one."""
+    import threading
+
+    attempts = []
+
+    # two distinct poisoned entries, as dispatch would probe ed then kes
+    for name in ("ed", "kes"):
+        p = fresh_aot / f"{name}_b8_d3_t128_cafebabe.jaxexec"
+        p.write_bytes(b"not a pickle")
+
+    real_open = open
+
+    def counting_open(path, *a, **k):
+        if str(path).endswith(".jaxexec"):
+            attempts.append(path)
+            raise RuntimeError("cached executable is axon format v1")
+        return real_open(path, *a, **k)
+
+    import builtins
+
+    monkeypatch.setattr(builtins, "open", counting_open)
+
+    barrier = threading.Barrier(2)
+    results = {}
+
+    def worker(name):
+        barrier.wait()
+        results[name] = aot.load(name, 8, 3, 128, "cafebabe")
+
+    ts = [threading.Thread(target=worker, args=(n,)) for n in ("ed", "kes")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert results == {"ed": None, "kes": None}
+    assert len(attempts) == 1, attempts
+    assert not aot.enabled()

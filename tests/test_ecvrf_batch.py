@@ -3,6 +3,7 @@
 import random
 
 import numpy as np
+import pytest
 
 from ouroboros_consensus_tpu.ops import ecvrf_batch as vb
 from ouroboros_consensus_tpu.ops.host import ecvrf as hv
@@ -10,6 +11,13 @@ from ouroboros_consensus_tpu.ops.host import ed25519 as he
 from ouroboros_consensus_tpu.ops.host import hashes
 
 
+# ~60 s on the 1-core box EVERY run (the limb-wise XLA:CPU graph's
+# EXECUTION, not its compile — the persistent cache cannot help), so
+# this XLA-twin differential rides the slow tier since round 8, like
+# the PR-1 device-twin family. The same curve/hash math stays
+# differentially covered inline by the pk-kernel suites
+# (test_pk_verify / test_sign_kernels) and the native-backend folds.
+@pytest.mark.slow
 def test_ecvrf_batch_mixed():
     rng = random.Random(11)
     pks, proofs, alphas, want = [], [], [], []

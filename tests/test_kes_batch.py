@@ -2,12 +2,17 @@
 
 import random
 
+import pytest
+
 from ouroboros_consensus_tpu.ops import kes_batch as kb
 from ouroboros_consensus_tpu.ops.host import kes as hk
 
 DEPTH = 6
 
 
+# slow tier since round 8 (XLA-twin execution wall; see the note in
+# test_ecvrf_batch.py — the pk twin keeps inline coverage)
+@pytest.mark.slow
 def test_kes_batch_mixed():
     rng = random.Random(13)
     seeds = [bytes(rng.randrange(256) for _ in range(32)) for _ in range(4)]
