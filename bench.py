@@ -76,6 +76,22 @@ CACHE = CACHE_DIR
 # chosen dir here for the parent's between-attempt wipe)
 JAX_CACHE_ROOT = os.path.join(CACHE_DIR, "jax")
 JAX_CACHE_PATH_FILE = os.path.join(CACHE_DIR, "jax_cache_path.txt")
+# the child's compile/warmup flight-recorder file (obs/warmup.py): every
+# stage first-execute / AOT outcome / cache-probe note is flushed here
+# atomically, so a child KILLED mid-warmup still leaves a diagnosis the
+# round JSON banks as `warmup_report` (the r02-r05 failure mode must
+# produce forensics, not silence)
+WARMUP_REPORT_PATH = os.path.join(CACHE_DIR, "warmup_report.json")
+
+
+def _warmup_report_path() -> str:
+    return os.environ.get("OCT_WARMUP_REPORT") or WARMUP_REPORT_PATH
+
+
+def _read_warmup_report(path: str | None = None) -> dict | None:
+    from ouroboros_consensus_tpu.obs import warmup as _wu
+
+    return _wu.read_report(path or _warmup_report_path())
 
 
 def bench_params():
@@ -201,7 +217,7 @@ def _probe_cache_entry():
         if os.path.isfile(os.path.join(cache_dir, e))
     )
     if not entries:
-        return None  # empty cache: nothing to probe, nothing to lose
+        return None, "empty"  # nothing to probe, nothing to lose
     path = os.path.join(cache_dir, entries[0])
     try:
         with open(path, "rb") as fh:
@@ -215,17 +231,27 @@ def _probe_cache_entry():
         except Exception:
             pass
         jax.devices()[0].client.deserialize_executable(blob)
-        return True
+        return True, "ok"
     except (TypeError, AttributeError):
-        return None  # probe API mismatch on this jaxlib: inconclusive
+        return None, "api-mismatch"  # probe API mismatch: inconclusive
     except Exception as e:  # noqa: BLE001 — classification only
         msg = str(e).lower()
         if any(p in msg for p in _STALE_PATTERNS):
-            return False  # positively identified stale-format entry
-        return None  # inconclusive (wrapper format, bad entry): keep
+            return False, str(e)  # positively identified stale entry
+        return None, str(e)  # inconclusive (wrapper format, bad entry)
 
 
-if _probe_cache_entry() is False:
+sys.path.insert(0, os.environ["OCT_REPO"])
+from ouroboros_consensus_tpu import obs as _obs
+from ouroboros_consensus_tpu.obs.warmup import WARMUP as _WARMUP
+
+_t_probe = time.monotonic()
+_probe_ok, _probe_detail = _probe_cache_entry()
+_WARMUP.note_cache_probe(
+    {True: "ok", False: "stale", None: "inconclusive"}[_probe_ok],
+    time.monotonic() - _t_probe, _probe_detail,
+)
+if _probe_ok is False:
     print(f"# startup probe: {cache_dir} entries rejected by this "
           "runtime; wiping cache and skipping AOT load path",
           file=sys.stderr)
@@ -257,9 +283,13 @@ if has_aot and os.environ.get("OCT_PK_AOT", "1") != "0":
         os.environ["OCT_PK_AOT"] = "0"
 jax.config.update("jax_compilation_cache_dir", cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-sys.path.insert(0, os.environ["OCT_REPO"])
 from bench import BENCH_HEADERS, KES_DEPTH, MAX_BATCH, bench_params, build_or_load_chain
 from ouroboros_consensus_tpu.tools import db_analyser as ana
+
+# the flight recorder rides every replay (per-window spans, gate
+# attribution, dispatch->materialize latency histograms) — per-window
+# cost only, and the warmup recorder is flushing to OCT_WARMUP_REPORT
+_rec = _obs.install()
 
 path, params, lview = build_or_load_chain()
 def emit(n, best, warm, attrib=None, warm_estimate=None):
@@ -270,7 +300,10 @@ def emit(n, best, warm, attrib=None, warm_estimate=None):
     tmp = os.environ["OCT_RESULT"] + ".tmp"
     row = {"n": n, "best_s": best, "warm_s": warm,
            "warm_estimate_s": warm_estimate if warm_estimate else warm,
-           "platform": jax.devices()[0].platform}
+           "platform": jax.devices()[0].platform,
+           "warmup_report": _WARMUP.report(),
+           "metrics_summary": _rec.latency_summary(),
+           "metrics": _rec.registry.snapshot()}
     if attrib:
         row.update(attrib)
     with open(tmp, "w") as f:
@@ -302,6 +335,7 @@ if BENCH_HEADERS > 200_000:
     small = os.path.join(os.path.dirname(path), f"chain_h100000_d{KES_DEPTH}")
     if os.path.exists(os.path.join(small, "COMPLETE")):
         warm_path = small
+_WARMUP.note("two-window prefix replay starting")
 t0 = time.monotonic()
 # EARLIEST bank (round-8): a two-window prefix replay first. It pays the
 # production-bucket compiles and banks a real (conservative, compile-
@@ -315,6 +349,7 @@ prefix_s = time.monotonic() - t0
 assert r.error is None, repr(r.error)
 assert r.n_valid == r.n_blocks > 0
 emit(r.n_valid, prefix_s, prefix_s, warm_estimate=prefix_s)
+_WARMUP.note(f"prefix replay banked after {prefix_s:.0f}s; full warmup next")
 r = ana.revalidate(warm_path, params, lview, backend="device",
                    validate_all="stream", max_batch=MAX_BATCH)
 warm_s = time.monotonic() - t0
@@ -426,6 +461,9 @@ def run_device_subprocess() -> dict | None:
     env["OCT_REPO"] = os.path.dirname(os.path.abspath(__file__))
     env["OCT_JAX_CACHE_ROOT"] = JAX_CACHE_ROOT
     env["OCT_JAX_CACHE_PATH_FILE"] = JAX_CACHE_PATH_FILE
+    # crash-safe warmup forensics: flushed per note, read back even
+    # when the child dies on the compile wall with nothing else banked
+    env["OCT_WARMUP_REPORT"] = _warmup_report_path()
     # Two attempts inside the budget: the pk dispatch is per-stage jits
     # (ops/pk/kernels.verify_praos_split), so every stage a killed child
     # DID compile is already in the persistent cache — the retry resumes
@@ -506,6 +544,12 @@ def run_device_subprocess() -> dict | None:
 
 
 def main() -> None:
+    # a warmup report left by a PREVIOUS round must never be banked as
+    # this round's forensics — only the child this run spawns may write
+    try:
+        os.remove(_warmup_report_path())
+    except OSError:
+        pass
     # The native baseline and chain synthesis need no accelerator; run
     # them FIRST so a wedged tunnel can never cost us the whole round.
     path, params, lview = build_or_load_chain()
@@ -565,11 +609,17 @@ def main() -> None:
             "vs_baseline": round(rate / baseline, 2),
         }
         # per-phase wall + boundary-byte attribution from the child's
-        # best replay (ana.revalidate collect_phases tracer)
+        # best replay (ana.revalidate collect_phases tracer), plus the
+        # warmup forensics and the flight recorder's metrics snapshot
         for k in ("phases_s", "windows", "packed_windows",
-                  "h2d_bytes_per_window", "d2h_bytes_per_window"):
+                  "h2d_bytes_per_window", "d2h_bytes_per_window",
+                  "warmup_report", "metrics_summary", "metrics"):
             if k in device:
                 out[k] = device[k]
+        if "warmup_report" not in out:
+            wr = _read_warmup_report()
+            if wr is not None:
+                out["warmup_report"] = wr
     else:
         out = {
             "metric": (
@@ -585,6 +635,12 @@ def main() -> None:
             "vs_baseline": 1.0,
             "device_unavailable": True,
         }
+        # the whole point of the flight recorder: a warmup death still
+        # banks a per-stage diagnosis (which compile/cache path ate the
+        # wall), not just a timeout
+        wr = _read_warmup_report()
+        if wr is not None:
+            out["warmup_report"] = wr
     print(json.dumps(out))
 
 

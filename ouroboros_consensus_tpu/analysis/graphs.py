@@ -584,6 +584,57 @@ def check_point_ops(budgets: dict | None = None,
     return violations
 
 
+def check_instrumentation_purity(budgets: dict | None = None,
+                                 names: list[str] | None = None) -> list[str]:
+    """Observability is HOST-side only: re-trace each graph listed under
+    budgets.json "instrumentation_purity" with the obs flight recorder
+    installed and OCT_TRACE forced on, and fail on ANY equation-count
+    delta against the baseline trace. Telemetry that leaks into a traced
+    program (an io_callback, a debug print, a traced counter) would grow
+    the jaxpr — this differential pins the growth at exactly zero.
+
+    The configured set is the graphs built FROM the instrumented host
+    modules (protocol/batch.py, ops/pk/kernels.py): those are the only
+    programs whose trace even executes telemetry-adjacent code, so the
+    differential is cheap (small tiles) while fencing the real hazard."""
+    budgets = budgets if budgets is not None else load_budgets()
+    cfg = budgets.get("instrumentation_purity", {})
+    todo = [n for n in cfg.get("graphs", [])
+            if names is None or n in names]
+    if not todo:
+        return []
+    import jax
+
+    from .. import obs
+
+    violations = []
+    for name in todo:
+        if name not in REGISTRY:
+            violations.append(
+                f"{name}: instrumentation_purity names an unregistered graph"
+            )
+            continue
+        base = analyze_jaxpr(trace_graph(name), name).eqns
+        old = os.environ.get("OCT_TRACE")
+        os.environ["OCT_TRACE"] = "1"
+        obs.install()
+        try:
+            fn, args = REGISTRY[name](None)
+            with_obs = analyze_jaxpr(jax.make_jaxpr(fn)(*args), name).eqns
+        finally:
+            obs.uninstall()
+            if old is None:
+                os.environ.pop("OCT_TRACE", None)
+            else:
+                os.environ["OCT_TRACE"] = old
+        if with_obs != base:
+            violations.append(
+                f"{name}: {with_obs - base:+d} equation(s) from telemetry "
+                f"({base} -> {with_obs}); observability must stay host-side"
+            )
+    return violations
+
+
 def check_budgets(reports: list[GraphReport],
                   budgets: dict | None = None) -> list[str]:
     """-> list of violation strings (empty = all graphs under budget).

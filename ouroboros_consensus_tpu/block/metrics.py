@@ -5,8 +5,10 @@ Reference: `Ouroboros.Consensus.Block.SupportsMetrics` —
 (the HFC and era instances dispatch per era), consumed by the node's
 metric reporting (NodeKernel peer metrics; cardano-node maps the
 tracers onto EKG/Prometheus). Here: compare the header's issuer key
-against the node's forging credential, and fold per-adoption counts
-into a `NodeMetrics` record the kernel owns.
+against the node's forging credential, fold per-adoption counts into a
+`NodeMetrics` record the kernel owns, and — when `bind` hands it an
+obs metrics registry — mirror every fold into `oct_node_*` Prometheus
+counters (the EKG bridge analog, ouroboros_consensus_tpu/obs).
 """
 
 from __future__ import annotations
@@ -24,9 +26,28 @@ def is_self_issued(header, our_cold_vk: bytes | None) -> bool:
     return issuer_vk_of(header) == our_cold_vk
 
 
+# the counter fields mirrored into the registry as oct_node_<name>_total
+_COUNTER_HELP = {
+    "blocks_forged": "blocks this node forged",
+    "blocks_could_not_forge": "won slots the hot key could not sign",
+    "blocks_adopted_self": "self-forged blocks adopted",
+    "blocks_adopted_peer": "peer blocks adopted",
+    "chain_switches": "fork switches (rollbacks)",
+    "slots_led": "slots this node led",
+    "batches_validated": "device validation batches completed",
+    "headers_validated": "headers that validated in batches",
+    "headers_invalid": "headers that failed batch validation",
+    "batch_device_s": "cumulative device batch seconds",
+}
+
+
 @dataclass
 class NodeMetrics:
-    """The kernel's counters (NodeKernel.hs metric reporting analog)."""
+    """The kernel's counters (NodeKernel.hs metric reporting analog).
+
+    Batch-validation counts (`note_batch`) fold the TPU-specific
+    `ValidatedBatch` events — one fused device batch per event — that
+    previously went nowhere."""
 
     blocks_forged: int = 0
     blocks_could_not_forge: int = 0
@@ -34,10 +55,40 @@ class NodeMetrics:
     blocks_adopted_peer: int = 0
     chain_switches: int = 0
     slots_led: int = 0
+    batches_validated: int = 0
+    headers_validated: int = 0
+    headers_invalid: int = 0
+    batch_device_s: float = 0.0
+    _mirrors: dict | None = field(
+        default=None, repr=False, compare=False
+    )
+
+    def bind(self, registry) -> "NodeMetrics":
+        """Mirror every subsequent fold into `oct_node_*_total` counters
+        of an obs MetricsRegistry (idempotent per registry)."""
+        self._mirrors = {
+            name: registry.counter(f"oct_node_{name}_total", help_)
+            for name, help_ in _COUNTER_HELP.items()
+        }
+        return self
+
+    def inc(self, name: str, amount: float = 1) -> None:
+        """Fold one count: the attribute AND its registry mirror."""
+        setattr(self, name, getattr(self, name) + amount)
+        if self._mirrors is not None:
+            self._mirrors[name].inc(amount)
 
     def note_adopted(self, headers, our_cold_vk: bytes | None) -> None:
         for h in headers:
             if is_self_issued(h, our_cold_vk):
-                self.blocks_adopted_self += 1
+                self.inc("blocks_adopted_self")
             else:
-                self.blocks_adopted_peer += 1
+                self.inc("blocks_adopted_peer")
+
+    def note_batch(self, ev) -> None:
+        """Fold one `ValidatedBatch` event (utils.trace): a fused device
+        batch of `n_headers` lanes of which `n_valid` passed."""
+        self.inc("batches_validated")
+        self.inc("headers_validated", ev.n_valid)
+        self.inc("headers_invalid", ev.n_headers - ev.n_valid)
+        self.inc("batch_device_s", ev.device_s)

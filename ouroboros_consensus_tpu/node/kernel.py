@@ -27,6 +27,7 @@ from ..miniprotocol.chainsync import Candidate
 from ..protocol import praos as praos_mod
 from ..protocol.hotkey import HotKey, KESBeforeStart, KESKeyExpired, issue_ocert
 from ..utils.sim import Sleep
+from ..utils.trace import NodeTracers, ValidatedBatch
 
 
 @dataclass
@@ -64,6 +65,10 @@ class NodeKernel:
         can_be_leader=None,  # protocol-shaped leadership credential
         # (Block/Forging.hs canBeLeader): PBFT nodes pass their genesis
         # key INDEX, Praos nodes default to PraosCanBeLeader from `pool`
+        tracers: NodeTracers | None = None,  # Tracers' record (one per
+        # subsystem); batch_validation receives ValidatedBatch events
+        metrics_registry=None,  # obs.MetricsRegistry: mirror NodeMetrics
+        # into oct_node_* counters (the tracers->EKG/Prometheus bridge)
     ):
         self.name = name
         self.chain_db = chain_db
@@ -84,6 +89,16 @@ class NodeKernel:
         # BlockSupportsMetrics consumer (SupportsMetrics.hs): counts fed
         # from a dedicated follower on every adoption
         self.metrics = NodeMetrics()
+        self.tracers = tracers if tracers is not None else NodeTracers()
+        if metrics_registry is not None:
+            self.metrics.bind(metrics_registry)
+        # batch verdicts: the LedgerDB's batched push emits one
+        # ValidatedBatch per fused device segment — fold it into
+        # NodeMetrics (and on to the registry) and forward it to the
+        # batch_validation tracer
+        ldb = getattr(chain_db, "ledgerdb", None)
+        if ldb is not None:
+            ldb.tracer = self._on_validated_batch
         self._metrics_follower = chain_db.new_follower()
         self.mempool = Mempool(
             ledger,
@@ -201,7 +216,7 @@ class NodeKernel:
             # checkShouldForge's ForgeStateUpdateError shape: the slot
             # is beyond what our (possibly pre-era-boundary) tip can
             # forecast — skip the opportunity, do NOT kill the loop
-            self.metrics.blocks_could_not_forge += 1
+            self.metrics.inc("blocks_could_not_forge")
             self.trace(f"{self.name}: no forecast for slot {slot}: {e}")
             return None
         ticked = self.protocol.tick(lview, slot, ext.header_state.chain_dep_state)
@@ -210,7 +225,7 @@ class NodeKernel:
         )
         if is_leader is None:
             return None
-        self.metrics.slots_led += 1
+        self.metrics.inc("slots_led")
         tip = self.chain_db.tip_point()
         block_no = (self.chain_db.tip_block_no() or 0) + 1 if tip else 0
         snap = self.mempool.get_snapshot_for(
@@ -238,9 +253,16 @@ class NodeKernel:
         except (KESKeyExpired, KESBeforeStart) as e:
             # checkShouldForge's CannotForge outcome (Block/Forging.hs):
             # won the slot but the hot key cannot sign — trace, skip
-            self.metrics.blocks_could_not_forge += 1
+            self.metrics.inc("blocks_could_not_forge")
             self.trace(f"{self.name}: CannotForge at slot {slot}: {e}")
             return None
+
+    def _on_validated_batch(self, ev) -> None:
+        """One fused device batch completed (storage/ledgerdb batched
+        push): fold the verdict counts and forward the typed event."""
+        if isinstance(ev, ValidatedBatch):
+            self.metrics.note_batch(ev)
+        self.tracers.batch_validation(ev)
 
     def _drain_metrics(self) -> None:
         cold = self.pool.vk_cold if self.pool is not None else None
@@ -248,10 +270,10 @@ class NodeKernel:
             if op[0] == "addblock":
                 self.metrics.note_adopted([op[1].header], cold)
             elif op[0] == "rollback":
-                self.metrics.chain_switches += 1
+                self.metrics.inc("chain_switches")
 
     def _post_adoption(self, block, res) -> None:
-        self.metrics.blocks_forged += 1
+        self.metrics.inc("blocks_forged")
         self._drain_metrics()
         if res.selected:
             self.trace(

@@ -1,0 +1,120 @@
+"""obs: pipeline-wide telemetry — the flight recorder.
+
+The reference threads contravariant `Tracer`s through every subsystem
+and maps them onto EKG/Prometheus gauges (SURVEY.md layers 4-5); this
+package is the TPU build's equivalent surface, all host-side:
+
+  * `registry`  — numpy-backed counters / gauges / fixed-bucket
+                  histograms, Prometheus text exposition + JSON snapshot
+  * `recorder`  — the FlightRecorder batch tracer: per-window spans
+                  through validate_chain's pipelined loop, fed into the
+                  registry (see `OCT_TRACE` below)
+  * `warmup`    — compile/warmup forensics: per-stage first-execute
+                  walls, pk-AOT load/reject attribution, the bench
+                  cache probe; crash-safe JSON via $OCT_WARMUP_REPORT
+  * `perfetto`  — Chrome trace-event (chrome://tracing / Perfetto)
+                  export of a replay's event stream
+
+Env levers:
+
+  OCT_TRACE=1          install the flight recorder for replays
+                       (db_analyser.revalidate, profile_replay, bench)
+  OCT_WARMUP_REPORT=f  flush warmup forensics to `f` after every note
+
+Everything stays OFF the hot path unless installed: with OCT_TRACE
+unset, `protocol.batch.BATCH_TRACER` remains None and the only residual
+cost is one module-level assignment per declined packed window."""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .recorder import FlightRecorder
+from .registry import MetricsRegistry, default_registry
+from .warmup import WARMUP
+
+_ENV = "OCT_TRACE"
+
+_LOCK = threading.Lock()
+_RECORDER: FlightRecorder | None = None
+_INSTALL_DEPTH = 0
+_PREV_TRACER = None
+
+
+def enabled() -> bool:
+    """The OCT_TRACE lever (read per call so tests can flip it)."""
+    return os.environ.get(_ENV, "0") not in ("0", "")
+
+
+def recorder() -> FlightRecorder:
+    """The process-wide FlightRecorder (created on first use)."""
+    global _RECORDER
+    with _LOCK:
+        if _RECORDER is None:
+            _RECORDER = FlightRecorder()
+        return _RECORDER
+
+
+def install() -> FlightRecorder:
+    """Chain the flight recorder into protocol.batch.BATCH_TRACER
+    (keeping any tracer an embedding application already set).
+    Re-entrant: nested installs share one chain entry."""
+    global _INSTALL_DEPTH, _PREV_TRACER
+    rec = recorder()
+    with _LOCK:
+        if _INSTALL_DEPTH == 0:
+            from ..protocol import batch as pbatch
+
+            prev = pbatch.BATCH_TRACER
+            _PREV_TRACER = prev
+            if prev is None:
+                pbatch.set_batch_tracer(rec)
+            else:
+                def chained(ev, _prev=prev, _rec=rec):
+                    _prev(ev)
+                    _rec(ev)
+
+                pbatch.set_batch_tracer(chained)
+        _INSTALL_DEPTH += 1
+    return rec
+
+
+def uninstall() -> None:
+    """Undo one `install`; the outermost uninstall restores the
+    previous tracer."""
+    global _INSTALL_DEPTH, _PREV_TRACER
+    with _LOCK:
+        if _INSTALL_DEPTH == 0:
+            return
+        _INSTALL_DEPTH -= 1
+        if _INSTALL_DEPTH == 0:
+            from ..protocol import batch as pbatch
+
+            pbatch.set_batch_tracer(_PREV_TRACER)
+            _PREV_TRACER = None
+
+
+def maybe_install() -> bool:
+    """install() iff OCT_TRACE is set; returns whether it installed
+    (pair with uninstall())."""
+    if enabled():
+        install()
+        return True
+    return False
+
+
+def reset_for_tests() -> None:
+    """Drop the process-wide recorder + registry (test isolation)."""
+    global _RECORDER, _INSTALL_DEPTH, _PREV_TRACER
+    from .registry import reset_default_registry
+
+    with _LOCK:
+        if _INSTALL_DEPTH > 0:
+            from ..protocol import batch as pbatch
+
+            pbatch.set_batch_tracer(_PREV_TRACER)
+        _RECORDER = None
+        _INSTALL_DEPTH = 0
+        _PREV_TRACER = None
+        reset_default_registry()

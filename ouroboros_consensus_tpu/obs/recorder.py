@@ -1,0 +1,129 @@
+"""FlightRecorder: the batch-tracer sink behind the OCT_TRACE lever.
+
+One process-wide recorder chains into `protocol.batch.BATCH_TRACER`
+(preserving whatever tracer an embedding application already set),
+keeps the timed event stream for Perfetto export, and folds every
+event into the metrics registry:
+
+    oct_windows_total{outcome=}            dispatched windows
+    oct_gate_declines_total{gate=}         why packed staging said no
+    oct_headers_validated_total            retired lanes
+    oct_agg_redispatch_total               dirty aggregate windows
+    oct_h2d_bytes_total / oct_d2h_bytes_total
+    oct_window_{stage,dispatch,materialize,epilogue}_seconds   histograms
+    oct_window_device_latency_seconds      dispatch->materialize wall
+
+Per-window granularity only — a 1M-header replay emits a few hundred
+events, so the host feed ceiling is untaxed."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils.trace import (
+    AggRedispatch, EncloseEvent, TransferEvent, WindowSpan, WindowStaged,
+)
+from . import registry as _registry
+
+# bounded event buffer: a pathological run cannot grow without limit
+MAX_EVENTS = 200_000
+
+
+class FlightRecorder:
+    def __init__(self, reg: "_registry.MetricsRegistry | None" = None):
+        self.registry = reg if reg is not None else _registry.default_registry()
+        self._lock = threading.Lock()
+        self.events: list[tuple[float, object]] = []
+        self.dropped = 0
+        r = self.registry
+        self._windows = r.counter(
+            "oct_windows_total", "dispatched device windows", ("outcome",)
+        )
+        self._gates = r.counter(
+            "oct_gate_declines_total",
+            "packed-staging qualification gate declines", ("gate",),
+        )
+        self._headers = r.counter(
+            "oct_headers_validated_total", "lanes retired valid"
+        )
+        self._redisp = r.counter(
+            "oct_agg_redispatch_total",
+            "aggregate windows re-dispatched per-lane",
+        )
+        self._h2d = r.counter("oct_h2d_bytes_total", "bytes staged to device")
+        self._d2h = r.counter("oct_d2h_bytes_total", "bytes returned to host")
+        self._phase_h = {
+            p: r.histogram(
+                f"oct_window_{p}_seconds", f"per-window {p} wall"
+            )
+            for p in ("stage", "dispatch", "materialize", "epilogue")
+        }
+        self._latency = r.histogram(
+            "oct_window_device_latency_seconds",
+            "dispatch->materialize wall per window",
+        )
+
+    # -- the tracer ---------------------------------------------------------
+
+    def __call__(self, ev) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if len(self.events) < MAX_EVENTS:
+                self.events.append((now, ev))
+            else:
+                self.dropped += 1
+        if isinstance(ev, WindowStaged):
+            self._windows.labels(outcome=ev.outcome).inc()
+            if ev.outcome == "generic":
+                self._gates.labels(gate=ev.gate or "packed-off").inc()
+        elif isinstance(ev, WindowSpan):
+            self._headers.inc(ev.n_valid)
+            self._phase_h["stage"].observe(ev.stage_s)
+            self._phase_h["dispatch"].observe(ev.dispatch_s)
+            self._phase_h["materialize"].observe(ev.materialize_s)
+            self._phase_h["epilogue"].observe(ev.epilogue_s)
+            self._latency.observe(
+                max(0.0, ev.t_materialized - ev.t_dispatch)
+            )
+        elif isinstance(ev, AggRedispatch):
+            self._redisp.inc()
+        elif isinstance(ev, TransferEvent):
+            if ev.phase == "dispatch":
+                self._h2d.inc(ev.h2d_bytes)
+            else:
+                self._d2h.inc(ev.d2h_bytes)
+        # EncloseEvent: kept in the event stream (Perfetto slices) only
+
+    # -- reporting ----------------------------------------------------------
+
+    def timed_events(self) -> list[tuple[float, object]]:
+        with self._lock:
+            return list(self.events)
+
+    def chrome_trace(self) -> dict:
+        from . import perfetto
+
+        return perfetto.to_chrome_trace(self.timed_events())
+
+    def write_chrome_trace(self, path: str) -> dict:
+        from . import perfetto
+
+        return perfetto.write(path, self.timed_events())
+
+    def latency_summary(self) -> dict:
+        """p50/p99 of the dispatch->materialize device latency plus the
+        per-phase p50s — the serving-north-star numbers (ROADMAP #3)."""
+        out = {
+            "device_latency_p50_s": self._latency.quantile(0.5),
+            "device_latency_p99_s": self._latency.quantile(0.99),
+            "windows": self._latency.count,
+        }
+        for p, h in self._phase_h.items():
+            out[f"{p}_p50_s"] = h.quantile(0.5)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self.events.clear()
+            self.dropped = 0

@@ -25,6 +25,21 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+import time
+
+
+def _note_aot(stage: str, outcome: str, wall_s: float = 0.0,
+              detail: str = "") -> None:
+    """Warmup-forensics breadcrumb (obs/warmup.py): every load outcome —
+    loaded / missing / failed / rejected / marker_skip — is attributed
+    per stage, so a bench attempt that dies on the wall still shows
+    which cache path ate it. Best-effort by contract."""
+    try:
+        from ...obs.warmup import WARMUP
+
+        WARMUP.note_aot(stage, outcome, wall_s, detail)
+    except Exception:
+        pass
 
 _DIR_ENV = "OCT_PK_AOT_DIR"
 _ENABLE_ENV = "OCT_PK_AOT"  # "0" disables AOT dispatch (default: on —
@@ -115,6 +130,7 @@ def _check_marker() -> None:
                 file=sys.stderr,
             )
             _RUNTIME_REJECTED = True
+            _note_aot("*", "marker_skip", detail=_reject_marker())
     except Exception:
         pass
 
@@ -267,6 +283,7 @@ def load(name: str, b: int, kes_depth: int, tile: int, sig: str):
                 return _LOADED[key]
             if not enabled():
                 return None
+            t0 = time.monotonic()
             try:
                 from jax.experimental import serialize_executable as se
 
@@ -275,15 +292,21 @@ def load(name: str, b: int, kes_depth: int, tile: int, sig: str):
                 result = se.deserialize_and_load(
                     blob["ser"], blob["in_tree"], blob["out_tree"]
                 )
+                _note_aot(name, "loaded", time.monotonic() - t0)
             except Exception as e:  # noqa: BLE001 — fail-soft by contract
                 import sys
 
                 print(f"# pk-aot: load {key} failed: {e!r}", file=sys.stderr)
-                note_failure(e)
+                rejected = note_failure(e)
+                _note_aot(
+                    name, "rejected" if rejected else "failed",
+                    time.monotonic() - t0, repr(e),
+                )
                 result = None
             # memoize INSIDE the lock: a racing caller must see the
             # entry the moment the lock frees, not re-deserialize
             _LOADED[key] = result
         return result
+    _note_aot(name, "missing")
     _LOADED[key] = result
     return result

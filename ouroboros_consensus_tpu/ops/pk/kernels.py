@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 
 import jax
 import numpy as np
@@ -429,6 +430,31 @@ def verify_praos_staged(
 
 _SPLIT_JIT: dict = {}
 _AOT_WARM: set = set()
+# warmup forensics: (stage@bucket) whose first execute is recorded —
+# the compile (or persistent-cache load) happens synchronously inside
+# that call, so its wall IS the per-stage compile attribution the
+# r02-r05 postmortems were missing
+_FIRST_EXEC: set = set()
+
+
+def _note_first_exec(stage: str, wall_s: float, via: str) -> None:
+    if stage in _FIRST_EXEC:
+        return
+    _FIRST_EXEC.add(stage)
+    from ...obs.warmup import WARMUP
+
+    WARMUP.note_stage(stage, wall_s, via=via)
+
+
+def _begin_first_exec(stage: str) -> None:
+    """Breadcrumb BEFORE a stage's first execute: a child killed at the
+    wall mid-compile leaves 'X first execute starting' as the LAST note
+    in the warmup report — exact attribution of which stage ate it."""
+    if stage in _FIRST_EXEC:
+        return
+    from ...obs.warmup import WARMUP
+
+    WARMUP.note(f"{stage} first execute starting")
 
 
 def _jit1(key, fn):
@@ -451,6 +477,9 @@ def _stage_call(name, fn, b, kes_depth, *args):
         ex = aot.load(name, b, kes_depth, TILE, sig)
         if ex is not None:
             try:
+                if key not in _AOT_WARM:
+                    _begin_first_exec(f"{name}@b{b}")
+                t0 = time.monotonic()
                 out = ex(*args)
                 if key not in _AOT_WARM:
                     # device-side failures surface asynchronously — the
@@ -460,6 +489,9 @@ def _stage_call(name, fn, b, kes_depth, *args):
                     # stay async (the dispatch pipeline depends on it)
                     jax.block_until_ready(out)
                     _AOT_WARM.add(key)
+                    _note_first_exec(
+                        f"{name}@b{b}", time.monotonic() - t0, "aot"
+                    )
                 return out
             except Exception as e:  # noqa: BLE001 — fail-soft by contract
                 import sys
@@ -467,8 +499,16 @@ def _stage_call(name, fn, b, kes_depth, *args):
                 print(f"# pk-aot: run {key} failed, falling back: {e!r}",
                       file=sys.stderr)
                 aot.note_failure(e)  # format rejections latch process-wide
+                # the executable LOADED but died on device: without this
+                # the report shows only "loaded" plus an unexplained jit
+                # first-execute — the one aot outcome load() cannot see
+                aot._note_aot(name, "run_failed", detail=repr(e))
                 aot._LOADED[key] = None
-    return fn(*args)
+    _begin_first_exec(f"{name}@b{b}")
+    t0 = time.monotonic()
+    out = fn(*args)
+    _note_first_exec(f"{name}@b{b}", time.monotonic() - t0, "jit")
+    return out
 
 
 def split_stage_fns(kes_depth: int):

@@ -48,6 +48,7 @@ boundaries and threads the tiny PraosState between them.
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 from fractions import Fraction
 from functools import lru_cache
@@ -611,6 +612,34 @@ def verify_praos_any(*cols) -> Verdicts:
 
 _JIT: dict = {}
 
+# warmup forensics: stages whose first execute has been recorded — the
+# wrapper below costs one set lookup per call after that
+_WARM_SEEN: set = set()
+
+
+def _warm_timed(stage: str, fn):
+    """Wrap a jitted program so its FIRST execute (where the compile —
+    or persistent-cache load — happens synchronously) records its wall
+    into the obs warmup flight recorder. The r02-r05 ~410 s compile
+    walls died without attribution; this is the per-stage black box."""
+
+    def wrapper(*a, **k):
+        if stage in _WARM_SEEN:
+            return fn(*a, **k)
+        from ..obs.warmup import WARMUP
+
+        # breadcrumb BEFORE the call: a kill mid-compile still leaves
+        # "<stage> first execute starting" as the report's last note
+        WARMUP.note(f"{stage} first execute starting")
+        t0 = time.monotonic()
+        out = fn(*a, **k)
+        _WARM_SEEN.add(stage)
+        WARMUP.note_stage(stage, time.monotonic() - t0, via="xla-jit")
+        return out
+
+    return wrapper
+
+
 # device implementation: "pk" = Pallas kernels (ops/pk, limb-first,
 # ladders in VMEM — the TPU production path), "xla" = the original jnp
 # graph (the cross-check twin; also the CPU default, where the pk path
@@ -749,6 +778,21 @@ class PraosPacked(NamedTuple):
     within: np.ndarray  # [B] uint8 — stability-window flag (nonce scan)
 
 
+# why the last packed-staging attempt declined (the PR 5 gates were
+# silent about why a window fell back). Written by `_decline` on every
+# early-out in stage_packed/stage_packed_columns — one module-global
+# assignment, so the qualification hot path stays untaxed — and read by
+# dispatch_batch into the WindowStaged/WindowSpan telemetry events.
+_LAST_DECLINE: str | None = None
+
+
+def _decline(reason: str) -> None:
+    """Record WHICH qualification gate said no, then decline (None)."""
+    global _LAST_DECLINE
+    _LAST_DECLINE = reason
+    return None
+
+
 def _table_bucket(k: int, minimum: int = 8) -> int:
     """Power-of-two bucket for a window table's row count (bounds the
     set of compiled shapes, same rationale as bucket_size)."""
@@ -781,25 +825,25 @@ def stage_packed(
     praos_block.py, the synthesizer chains) always qualify; synthetic
     test views whose signed bytes do not embed the fields fall back."""
     if not hvs:
-        return None
+        return _decline("empty-window")
     b = len(hvs)
     h0 = hvs[0]
     body0 = h0.signed_bytes
     lb = len(body0)
     if any(len(hv.signed_bytes) != lb for hv in hvs):
-        return None
+        return _decline("body-width-mixed")
     if epoch_nonce is not None and len(epoch_nonce) != 32:
-        return None
+        return _decline("nonce-len")
     depth = params.kes_depth
     sig_len = 64 + 32 + 32 * depth
     if any(len(hv.kes_sig) != sig_len for hv in hvs):
-        return None
+        return _decline("kes-sig-len")
 
     plen = len(h0.vrf_proof)
     if plen not in (80, 128) or any(
         len(hv.vrf_proof) != plen for hv in hvs
     ):
-        return None
+        return _decline("proof-format")
 
     # lane-0 offset discovery (how the offset is FOUND does not matter —
     # the per-lane verification below is what makes extraction correct)
@@ -809,7 +853,7 @@ def stage_packed(
     )
     offs = tuple(body0.find(f) for f in fields0)
     if min(offs) < 0:
-        return None
+        return _decline("field-offsets")
 
     body = np.frombuffer(
         b"".join(hv.signed_bytes for hv in hvs), np.uint8
@@ -824,14 +868,14 @@ def stage_packed(
     )
     for o, ref in refs:
         if not np.array_equal(body[:, o : o + ref.shape[1]], ref):
-            return None
+            return _decline("field-mismatch")
 
     slot = np.fromiter((hv.slot for hv in hvs), np.int64, b)
     counter = np.fromiter((hv.ocert.counter for hv in hvs), np.int64, b)
     c0 = np.fromiter((hv.ocert.kes_period for hv in hvs), np.int64, b)
     for a in (slot, counter, c0):
         if a.min() < 0 or a.max() >= 2**31:
-            return None
+            return _decline("int32-range")
 
     sigs = np.frombuffer(
         b"".join(hv.kes_sig for hv in hvs), np.uint8
@@ -904,18 +948,18 @@ def stage_packed_columns(
     table ORDERING may differ (gather indices compensate)."""
     b = len(vc)
     if not b:
-        return None
+        return _decline("empty-window")
     body = vc.signed_bytes
     lb = int(body.shape[1])
     if epoch_nonce is not None and len(epoch_nonce) != 32:
-        return None
+        return _decline("nonce-len")
     depth = params.kes_depth
     sig_len = 64 + 32 + 32 * depth
     if vc.kes_sig.shape[1] != sig_len:
-        return None
+        return _decline("kes-sig-len")
     plen = int(vc.vrf_proof_len[0])
     if plen not in (80, 128) or not (vc.vrf_proof_len == plen).all():
-        return None
+        return _decline("proof-format")
 
     # lane-0 offset discovery, then whole-matrix per-lane verification
     # (the same contract as stage_packed: HOW the offsets are found does
@@ -928,15 +972,15 @@ def stage_packed_columns(
     )
     offs = tuple(body0.find(r[0].tobytes()) for r in refs)
     if min(offs) < 0:
-        return None
+        return _decline("field-offsets")
     for o, ref in zip(offs, refs):
         if not np.array_equal(body[:, o : o + ref.shape[1]], ref):
-            return None
+            return _decline("field-mismatch")
 
     slot, counter, c0 = vc.slot, vc.ocert_counter, vc.ocert_kes_period
     for a in (slot, counter, c0):
         if a.min() < 0 or a.max() >= 2**31:
-            return None
+            return _decline("int32-range")
 
     kes_rs = np.ascontiguousarray(vc.kes_sig[:, :64])
     kt_rows, kt_idx = _dedup_rows(vc.kes_sig[:, 64:])
@@ -1189,7 +1233,11 @@ def _jitted_packed_xla(layout: PraosPackedLayout, scan: bool):
             )
             return red, flags, v.eta, v.leader_value
 
-        _JIT[key] = jax.jit(fn)
+        _JIT[key] = _warm_timed(
+            f"xla-packed:{layout.body_len}b:p{layout.vrf_proof_len}:"
+            f"{'scan' if scan else 'noscan'}",
+            jax.jit(fn),
+        )
     return _JIT[key]
 
 
@@ -1226,7 +1274,11 @@ def _jitted_packed_agg(layout: PraosPackedLayout, scan: bool):
             )
             return red, av.flags, av.eta, av.leader_value
 
-        _JIT[key] = jax.jit(fn)
+        _JIT[key] = _warm_timed(
+            f"agg-packed:{layout.body_len}b:"
+            f"{'scan' if scan else 'noscan'}",
+            jax.jit(fn),
+        )
     return _JIT[key]
 
 
@@ -1339,7 +1391,10 @@ def _jitted_verify(bc: bool = False):
 
     key = ("fn", bc)
     if key not in _JIT:
-        _JIT[key] = jax.jit(verify_praos_bc if bc else verify_praos)
+        _JIT[key] = _warm_timed(
+            f"xla-fused{'-bc' if bc else ''}",
+            jax.jit(verify_praos_bc if bc else verify_praos),
+        )
     return _JIT[key]
 
 
@@ -1692,6 +1747,9 @@ class _Dispatched(NamedTuple):
     carried: bool  # device nonce-scan outputs extend the chain carry
     scan: bool
     out: tuple  # impl-specific device handles
+    # telemetry: (index, outcome, gate, stage_s, dispatch_s,
+    # lanes_padded, t_dispatch) — None when tracing is off
+    meta: tuple | None = None
 
 
 def _nbytes(arrays) -> int:
@@ -1703,6 +1761,48 @@ def _emit_transfer(phase: str, **kw) -> None:
         from ..utils.trace import TransferEvent
 
         BATCH_TRACER(TransferEvent(phase=phase, **kw))
+
+
+# process-wide window dispatch sequence (the WindowStaged/WindowSpan
+# `index`); only advanced while a tracer is installed
+_WIN_SEQ = 0
+
+
+def _win_meta(outcome: str, gate: str | None, b: int, lanes: int,
+              t0: float, t1: float) -> tuple | None:
+    """Build the per-window telemetry meta and emit the WindowStaged
+    event. Returns None (zero residual cost) when no tracer is set."""
+    global _WIN_SEQ
+    if BATCH_TRACER is None:
+        return None
+    from ..utils.trace import WindowStaged
+
+    idx = _WIN_SEQ
+    _WIN_SEQ += 1
+    t2 = time.monotonic()
+    BATCH_TRACER(
+        WindowStaged(idx, b, lanes, outcome, gate, t1 - t0, t2 - t1)
+    )
+    return (idx, outcome, gate, t1 - t0, t2 - t1, lanes, t2)
+
+
+def _emit_window_span(meta, lanes: int, n_valid: int, failed: bool,
+                      t_m0: float, t_m1: float, t_e0: float,
+                      t_done: float) -> None:
+    """Emit the retired-window span (dispatch_batch meta + the
+    materialize/epilogue walls measured in the validate_chain loop)."""
+    if BATCH_TRACER is None or meta is None:
+        return
+    from ..utils.trace import WindowSpan
+
+    idx, outcome, gate, stage_s, dispatch_s, _lanes_padded, t_disp = meta
+    BATCH_TRACER(WindowSpan(
+        index=idx, lanes=lanes, outcome=outcome, gate=gate,
+        stage_s=stage_s, dispatch_s=dispatch_s,
+        materialize_s=t_m1 - t_m0, epilogue_s=t_done - t_e0,
+        t_dispatch=t_disp, t_materialized=t_m1, t_done=t_done,
+        n_valid=n_valid, failed=failed,
+    ))
 
 
 def dispatch_batch(params, lview, eta0, hvs, carry=None):
@@ -1728,17 +1828,27 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
     window cannot extend the chain (generic fallback or scan disabled).
     """
     b = len(hvs)
+    t0 = time.monotonic()
     with _enclose("stage"):
         pre = host_prechecks(params, lview, hvs)
         packed = None
+        gate = None
         if PACKED_STAGE and not os.environ.get("OCT_PK_FUSED"):
             if isinstance(hvs, ViewColumns):
-                packed = (
-                    stage_packed_columns(params, lview, eta0, hvs, pre)
-                    if isinstance(pre, ColumnChecks) else None
-                )
+                if isinstance(pre, ColumnChecks):
+                    packed = stage_packed_columns(
+                        params, lview, eta0, hvs, pre
+                    )
+                    if packed is None:
+                        gate = _LAST_DECLINE
+                else:
+                    gate = "no-column-prechecks"
             else:
                 packed = stage_packed(params, lview, eta0, hvs)
+                if packed is None:
+                    gate = _LAST_DECLINE
+        else:
+            gate = "packed-off"
         if packed is None:
             batch = stage_any(params, lview, eta0, hvs, pre)
             padded = pad_batch_to(batch, bucket_size(b))
@@ -1749,19 +1859,22 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
             parr = pad_packed_to(parr, bucket_size(b))
             h2d = _nbytes(parr)
             lanes = parr.body.shape[0]
+    t1 = time.monotonic()
     with _enclose("dispatch"):
         _emit_transfer(
             "dispatch", lanes=lanes, h2d_bytes=h2d, packed=packed is not None
         )
         if packed is None:
             if _impl() == "pk":
-                disp = _Dispatched("pk", False, False, False,
-                                   _pk_dispatch(padded))
+                out = _pk_dispatch(padded)
+                impl = "pk"
             else:
                 out = _jitted_verify(batch_is_bc(padded))(
                     *(jnp.asarray(x) for x in flatten_batch(padded))
                 )
-                disp = _Dispatched("xla", False, False, False, out)
+                impl = "xla"
+            meta = _win_meta("generic", gate, b, lanes, t0, t1)
+            disp = _Dispatched(impl, False, False, False, out, meta)
             return pre, disp, b, None
         scan_mode = NONCE_SCAN and carry is not None
         cargs = carry if scan_mode else _ZERO_CARRY
@@ -1777,9 +1890,10 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
                 *parr, n_real, *cargs
             )
             carry_out = tuple(out[0][1:5]) if scan_mode else None
+            meta = _win_meta("packed-agg", None, b, lanes, t0, t1)
             disp = _Dispatched(
                 "agg", True, scan_mode, scan_mode,
-                (layout, parr, n_real, cargs, out),
+                (layout, parr, n_real, cargs, out), meta,
             )
             return pre, disp, b, carry_out
         if _impl() == "pk":
@@ -1795,7 +1909,8 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
             )
             impl = "xla"
         carry_out = tuple(out[0][1:5]) if scan_mode else None
-        disp = _Dispatched(impl, True, scan_mode, scan_mode, out)
+        meta = _win_meta("packed", None, b, lanes, t0, t1)
+        disp = _Dispatched(impl, True, scan_mode, scan_mode, out, meta)
         return pre, disp, b, carry_out
 
 
@@ -1909,6 +2024,10 @@ def materialize_verdicts(tagged, b):
         pv = _materialize_packed(out, b, "pk", tagged.scan, tagged.carried)
         if pv.clean():
             return pv
+        if BATCH_TRACER is not None:
+            from ..utils.trace import AggRedispatch
+
+            BATCH_TRACER(AggRedispatch(b))
         if _impl() == "pk":
             from ..ops.pk import kernels as pk_kernels
 
@@ -2409,7 +2528,7 @@ def _validate_chain_loop(
             else:
                 carry = carry_out
             inflight.append(
-                (s_stage, whvs, w, pre,
+                (s_stage, whvs, w, pre, out.meta,
                  pool.submit(materialize_verdicts, out, b))
             )
             w = j
@@ -2432,9 +2551,11 @@ def _validate_chain_loop(
                 carry_ok = True
             continue
 
-        s_b, whvs, w_start, pre, fut = inflight.popleft()
+        s_b, whvs, w_start, pre, meta, fut = inflight.popleft()
+        t_m0 = time.monotonic()
         with _enclose("materialize"):
             v = fut.result()
+        t_m1 = time.monotonic()
         ticked = praos.tick(params, lview_for(s_b), _slot_at(whvs, 0), state)
         if w_start == segments[s_b][1]:
             # first batch of a segment staged with a LOOKAHEAD nonce:
@@ -2442,10 +2563,15 @@ def _validate_chain_loop(
             assert ticked.state.epoch_nonce == eta_known[s_b], (
                 "lookahead epoch nonce mismatch"
             )
+        t_e0 = time.monotonic()
         with _enclose("epilogue"):
             res = _epilogue(params, ticked, whvs, pre, v)
         state = res.state
         total_valid += res.n_valid
+        _emit_window_span(
+            meta, len(whvs), res.n_valid, res.error is not None,
+            t_m0, t_m1, t_e0, time.monotonic(),
+        )
         if res.error is not None:
             return BatchResult(state, total_valid, res.error)
         retired += len(whvs)

@@ -77,6 +77,10 @@ class LedgerDB:
         # chooses ReapplyVal (no crypto) instead of ApplyVal
         # (LgrDB.hs:330); GC'd alongside the VolatileDB
         self._prev_applied: dict[bytes, int] = {}
+        # typed event tracer: `_push_many_batched` emits one
+        # ValidatedBatch (utils.trace) per fused device segment — the
+        # NodeKernel wires this to its NodeMetrics/registry fold
+        self.tracer = None
 
     # -- queries -------------------------------------------------------------
 
@@ -212,9 +216,19 @@ class LedgerDB:
                 ticked = proto.tick(
                     view, segment[0].slot, st.header_state.chain_dep_state
                 )
+                import time as _time
+
+                t0 = _time.monotonic()
                 res = proto.validate_batch(
                     ticked, [b.header.to_view() for b in segment], collect_states=True
                 )
+                if self.tracer is not None:
+                    from ..utils.trace import ValidatedBatch
+
+                    self.tracer(ValidatedBatch(
+                        len(segment), res.n_valid,
+                        _time.monotonic() - t0,
+                    ))
                 for idx in range(res.n_valid):
                     b = segment[idx]
                     hs = HeaderState(

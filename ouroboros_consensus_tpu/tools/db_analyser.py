@@ -239,31 +239,38 @@ def _stream_windows(imm: ImmutableDB, res: "ValidationResult"):
         entries = imm._entries[n]
         if not entries:
             continue
-        with open(os.path.join(imm.path, _chunk_name(n)), "rb") as f:
-            data = f.read()
-        truncated = False
-        if stream_deep:
-            # single-pass validate-all: the open deferred the deep walk
-            # to this read (open_immutable "stream" mode) — same checks,
-            # same truncation point, no second disk pass
-            from ..storage.open import (
-                default_check_integrity,
-                default_check_integrity_batch,
-            )
+        # the per-chunk disk read + integrity walk + native column
+        # extraction is the "stream" span of the flight recorder (one
+        # Enclose bracket per CHUNK — per-window granularity, no object
+        # tax); pbatch._enclose is a no-op while no tracer is installed
+        with pbatch._enclose("stream"):
+            with open(os.path.join(imm.path, _chunk_name(n)), "rb") as f:
+                data = f.read()
+            truncated = False
+            if stream_deep:
+                # single-pass validate-all: the open deferred the deep
+                # walk to this read (open_immutable "stream" mode) —
+                # same checks, same truncation point, no second disk pass
+                from ..storage.open import (
+                    default_check_integrity,
+                    default_check_integrity_batch,
+                )
 
-            good = imm.deep_check_loaded(
-                data, entries, default_check_integrity,
-                default_check_integrity_batch,
-            )
-            if good < len(entries):
-                entries = entries[:good]
-                truncated = True
-        if native_ok and entries:
-            import numpy as np
+                good = imm.deep_check_loaded(
+                    data, entries, default_check_integrity,
+                    default_check_integrity_batch,
+                )
+                if good < len(entries):
+                    entries = entries[:good]
+                    truncated = True
+            cols = None
+            if native_ok and entries:
+                import numpy as np
 
-            offsets = np.asarray([e.offset for e in entries], np.int64)
-            cols = native_loader.extract_headers(data, offsets)
-            res.n_blocks += cols.n
+                offsets = np.asarray([e.offset for e in entries], np.int64)
+                cols = native_loader.extract_headers(data, offsets)
+                res.n_blocks += cols.n
+        if cols is not None:
             pieces = (
                 ViewColumns.pieces_from_header_columns(cols)
                 if columnar else None
@@ -402,7 +409,28 @@ def revalidate(
     fills `res.phases` / `res.h2d_bytes` / `res.d2h_bytes` /
     `res.n_windows` / `res.packed_windows` — the per-phase wall and
     device-boundary byte attribution the bench json reports.
+
+    With OCT_TRACE=1 the obs flight recorder additionally rides the
+    replay (per-window spans, gate-decline attribution, Perfetto-
+    exportable event stream — ouroboros_consensus_tpu/obs).
     """
+    from .. import obs
+
+    installed = obs.maybe_install()
+    try:
+        return _revalidate_traced(
+            db_path, params, lview, backend, validate_all, max_batch,
+            max_headers, trace, ledger, genesis_state, collect_phases,
+        )
+    finally:
+        if installed:
+            obs.uninstall()
+
+
+def _revalidate_traced(
+    db_path, params, lview, backend, validate_all, max_batch,
+    max_headers, trace, ledger, genesis_state, collect_phases,
+) -> ValidationResult:
     if collect_phases:
         coll = _PhaseCollector()
         prev = pbatch.BATCH_TRACER

@@ -76,13 +76,81 @@ from ..node.transport import frame as _frame  # noqa: E402
 from ..node.transport import read_frame as _read_frame  # noqa: E402
 
 
+async def serve_metrics(host: str = "127.0.0.1", port: int = 9100,
+                        registry=None):
+    """Prometheus exposition endpoint: a minimal HTTP/1.0 responder
+    (no dependencies) answering
+
+        GET /metrics        text exposition format 0.0.4
+        GET /metrics.json   the registry's JSON snapshot
+
+    over the obs metrics registry — the cardano-node EKG/Prometheus
+    bridge analog (SURVEY.md layer 4-5). Runs beside the block service
+    (`--metrics-port`); `port=0` binds an ephemeral port (tests)."""
+    import asyncio
+    import json as _json
+
+    from ..obs.registry import default_registry
+
+    reg = registry if registry is not None else default_registry()
+    scrapes = reg.counter(
+        "oct_metrics_scrapes_total", "metric-endpoint requests", ("path",)
+    )
+
+    async def handle(reader, writer):
+        try:
+            req = await reader.readline()
+            while True:  # drain headers
+                line = await reader.readline()
+                if line in (b"", b"\n", b"\r\n"):
+                    break
+            parts = req.split()
+            path = parts[1].decode("ascii", "replace") if len(parts) > 1 else "/"
+            if path.startswith("/metrics.json"):
+                scrapes.labels(path="/metrics.json").inc()
+                body = _json.dumps(reg.snapshot()).encode()
+                status, ctype = b"200 OK", b"application/json"
+            elif path.startswith("/metrics"):
+                scrapes.labels(path="/metrics").inc()
+                body = reg.expose_text().encode()
+                status, ctype = b"200 OK", b"text/plain; version=0.0.4"
+            else:
+                body = b"try /metrics or /metrics.json\n"
+                status, ctype = b"404 Not Found", b"text/plain"
+            writer.write(
+                b"HTTP/1.0 " + status + b"\r\nContent-Type: " + ctype
+                + b"\r\nContent-Length: " + str(len(body)).encode()
+                + b"\r\n\r\n" + body
+            )
+            await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    return await asyncio.start_server(handle, host, port)
+
+
 async def serve_tcp(db_path: str, host: str = "127.0.0.1", port: int = 3001,
                     network_magic: int = _NETWORK_MAGIC):
     """One TCP service multiplexing chainsync-style requests: each frame
     is a request tuple; the reply frame(s) follow. Static chain only."""
     import asyncio
 
+    from ..obs.registry import default_registry
+
     view = ImmutableChainView(db_path)
+    requests = default_registry().counter(
+        "oct_immdb_requests_total", "immdb-server request frames", ("kind",)
+    )
+    # label values come off the WIRE: bucket anything outside the known
+    # protocol vocabulary as "other", or a misbehaving peer could grow
+    # one counter child per arbitrary kind string (unbounded registry
+    # memory + exposition bloat)
+    _KNOWN_KINDS = frozenset((
+        "propose_versions", "find_intersect", "request_range",
+        "headers_from", "done",
+    ))
 
     async def handle(reader, writer):
         handshaken = False
@@ -90,6 +158,9 @@ async def serve_tcp(db_path: str, host: str = "127.0.0.1", port: int = 3001,
             while True:
                 msg = await _read_frame(reader)
                 kind = msg[0]
+                requests.labels(
+                    kind=kind if kind in _KNOWN_KINDS else "other"
+                ).inc()
                 if not handshaken and kind != "propose_versions":
                     # the reference handshakes BEFORE serving
                     # (ImmDBServer/Diffusion.hs): an un-negotiated peer
@@ -198,11 +269,18 @@ def main(argv=None) -> None:
     p.add_argument("--network-magic", type=int, default=_NETWORK_MAGIC,
                    help="handshake guard; clients proposing a different "
                         "magic are refused (default: mainnet)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve Prometheus text exposition (/metrics) and "
+                        "the JSON snapshot (/metrics.json) on this port; "
+                        "0 = disabled")
     a = p.parse_args(argv)
 
     async def run():
         server = await serve_tcp(a.db, a.host, a.port, a.network_magic)
         print(f"immdb-server listening on {a.host}:{a.port}")
+        if a.metrics_port:
+            msrv = await serve_metrics(a.host, a.metrics_port)
+            print(f"metrics on http://{a.host}:{a.metrics_port}/metrics")
         async with server:
             await server.serve_forever()
 

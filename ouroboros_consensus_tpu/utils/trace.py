@@ -75,9 +75,11 @@ def stderr_tracer(prefix: str = "") -> Tracer:
     return t
 
 
-@dataclass
+@dataclass(frozen=True)
 class EncloseEvent:
-    """Start/end bracket (Util/Enclose.hs RisingEdge/FallingEdge)."""
+    """Start/end bracket (Util/Enclose.hs RisingEdge/FallingEdge).
+    Frozen like every other event dataclass: the end edge is a NEW
+    event carrying the duration, never a mutated start event."""
 
     label: str
     edge: str  # "start" | "end"
@@ -121,6 +123,57 @@ class TransferEvent:
     h2d_bytes: int = 0
     d2h_bytes: int = 0
     packed: bool = False  # packed staging / packed verdict path
+
+
+# -- per-window pipeline spans (the obs/ flight-recorder vocabulary) ---------
+# Per-WINDOW granularity by design: a 100k-header replay emits ~21 of
+# these, so the 118.7k headers/s host ceiling is untaxed (the round-8
+# object-tax lesson applied to telemetry).
+
+
+@dataclass(frozen=True)
+class WindowStaged:
+    """One window left dispatch_batch: how it staged and, when the
+    packed wire declined, WHICH qualification gate said no (the PR 5
+    columnar/packed gates were silent about why a window fell back)."""
+
+    index: int  # process-wide dispatch sequence number
+    lanes: int  # true window size (pre bucket pad)
+    lanes_padded: int
+    outcome: str  # "packed-agg" | "packed" | "generic"
+    gate: str | None  # decline reason when outcome == "generic"
+    stage_s: float
+    dispatch_s: float
+
+
+@dataclass(frozen=True)
+class AggRedispatch:
+    """An aggregated (RLC/MSM) window came back dirty: its per-lane
+    flags are meaningless, so materialize_verdicts re-dispatched the
+    unchanged per-lane stage kernels (one extra round trip)."""
+
+    lanes: int
+
+
+@dataclass(frozen=True)
+class WindowSpan:
+    """One window fully retired through validate_chain's pipelined
+    loop: the complete per-phase wall plus the dispatch->materialize
+    device latency (t_materialized - t_dispatch)."""
+
+    index: int
+    lanes: int
+    outcome: str  # WindowStaged.outcome
+    gate: str | None
+    stage_s: float
+    dispatch_s: float
+    materialize_s: float  # host wait for the device result
+    epilogue_s: float
+    t_dispatch: float  # monotonic at dispatch return
+    t_materialized: float  # monotonic when the device result landed
+    t_done: float  # monotonic after the epilogue
+    n_valid: int
+    failed: bool  # this window carried the chain's first error
 
 
 # -- the consensus event vocabulary (Tracers' record, condensed) -------------
@@ -278,4 +331,9 @@ class NodeTracers:
 
     @classmethod
     def all_to(cls, tracer: Tracer) -> "NodeTracers":
-        return cls(*([tracer] * 7))
+        # derive the count from the dataclass fields: a hardcoded arity
+        # silently desyncs the moment a tracer field is added (the
+        # subsystem after the cut-off would keep its null default)
+        import dataclasses
+
+        return cls(**{f.name: tracer for f in dataclasses.fields(cls)})

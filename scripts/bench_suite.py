@@ -82,7 +82,7 @@ def config1(scale: float, tmp: str):
     rb = db_analyser.revalidate(path, params, lview, backend="native")
     base = time.monotonic() - t0
     assert rb.error is None
-    extra = None
+    extra = {}
     if r.n_windows:
         # per-phase wall attribution + boundary bytes (set_batch_tracer
         # via collect_phases): the transfer tax is a bench-trajectory
@@ -94,6 +94,16 @@ def config1(scale: float, tmp: str):
             "h2d_bytes_per_window": int(r.h2d_bytes / r.n_windows),
             "d2h_bytes_per_window": int(r.d2h_bytes / r.n_windows),
         }
+    # compile/warmup forensics + (with OCT_TRACE=1) the flight
+    # recorder's metrics snapshot ride into the suite row the same way
+    # bench.py banks them into BENCH_r*.json
+    from ouroboros_consensus_tpu import obs
+    from ouroboros_consensus_tpu.obs.warmup import WARMUP
+
+    extra["warmup_report"] = WARMUP.report()
+    if obs.enabled():
+        extra["metrics_summary"] = obs.recorder().latency_summary()
+        extra["metrics"] = obs.recorder().registry.snapshot()
     return _emit(1, "headers revalidated end-to-end", n, dev, base, extra)
 
 

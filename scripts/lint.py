@@ -15,8 +15,11 @@ ratcheted.
 Exit 0 = no NEW AST findings (anything in analysis/baseline.json is
 grandfathered), every registered kernel graph within its
 analysis/budgets.json ceilings (jaxpr metrics AND per-lane point-ops),
-and every certification pin in analysis/certified.json still holding
-(range proofs intact, no new taint findings). Nonzero exits mirror
+zero equation growth from telemetry on the instrumentation-purity
+graphs (budgets.json "instrumentation_purity": the obs flight recorder
+must stay host-side), and every certification pin in
+analysis/certified.json still holding (range proofs intact, no new
+taint findings). Nonzero exits mirror
 `python -m ouroboros_consensus_tpu.analysis`: 1 = new AST finding(s),
 3 = budget violation(s), 4 = certification ratchet violation(s). The
 ratchet files only ever shrink in normal operation — fixing a
@@ -164,6 +167,13 @@ def main(argv: list[str] | None = None) -> int:
                     budgets, names=[name]
                 )
         budget_violations += graphs.check_budgets(reports, budgets)
+        # instrumentation purity: the registry graphs built from the
+        # telemetry-instrumented host modules must gain ZERO equations
+        # with the obs flight recorder installed (observability is
+        # host-side only — budgets.json "instrumentation_purity")
+        budget_violations += graphs.check_instrumentation_purity(
+            budgets, names=names
+        )
 
         if args.update_certified:
             if names is not None:

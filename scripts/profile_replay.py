@@ -14,10 +14,17 @@ dispatch at all. This measures the host pipeline CEILING (µs/header of
 view-stream + prechecks + stage; its reciprocal is the best rate any
 device can be fed at) and is CPU-verifiable on a box with no
 accelerator. A/B the columnar window pipeline against the per-object
-one with OCT_COLUMNAR=0 (round-8 acceptance metric).
+one with OCT_COLUMNAR=0 (round-8 acceptance metric); OCT_TRACE=1
+installs the obs flight recorder — per-window spans only, so the
+ceiling must stay within 2% of OCT_TRACE=0 (round-9 acceptance).
 
-Usage:  python scripts/profile_replay.py [--host] [n_headers]
-        (default 100000)
+`--trace-out=PATH` (device replay) writes the flight recorder's event
+stream as a Chrome trace-event JSON after the hot replay — load it at
+ui.perfetto.dev or chrome://tracing — and prints the
+dispatch->materialize latency p50/p99.
+
+Usage:  python scripts/profile_replay.py [--host] [--trace-out=f.json]
+        [n_headers]   (default 100000)
 """
 
 import os
@@ -34,6 +41,10 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 ARGS = [a for a in sys.argv[1:] if not a.startswith("--")]
 HOST_ONLY = "--host" in sys.argv[1:]
+TRACE_OUT = next(
+    (a.split("=", 1)[1] for a in sys.argv[1:]
+     if a.startswith("--trace-out=")), None,
+)
 N = int(ARGS[0]) if ARGS else 100_000
 
 
@@ -48,6 +59,7 @@ def host_ceiling():
     import numpy as np
 
     import bench
+    from ouroboros_consensus_tpu import obs
     from ouroboros_consensus_tpu.protocol import batch as pbatch
     from ouroboros_consensus_tpu.protocol import praos
     from ouroboros_consensus_tpu.protocol.views import ViewColumns
@@ -56,7 +68,12 @@ def host_ceiling():
     path, params, lview = bench.build_or_load_chain()
     columnar = ana._columnar_enabled()
     mode = "columnar (ViewColumns)" if columnar else "per-object (HeaderView)"
-    print(f"host pipeline: {mode}", flush=True)
+    # the acceptance A/B: OCT_TRACE=1 must not tax the host ceiling —
+    # the recorder hangs off BATCH_TRACER and sees per-window events
+    # only, none of which this host-only loop emits per header
+    traced = obs.maybe_install()
+    print(f"host pipeline: {mode} (OCT_TRACE={'1' if traced else '0'})",
+          flush=True)
 
     for attempt in ("warm", "hot"):
         res = ana.ValidationResult()
@@ -127,6 +144,7 @@ def host_ceiling():
 def main():
     os.environ.setdefault("BENCH_HEADERS", str(N))
     import bench
+    from ouroboros_consensus_tpu import obs
     from ouroboros_consensus_tpu.protocol import batch as pbatch
     from ouroboros_consensus_tpu.tools import db_analyser as ana
     from ouroboros_consensus_tpu.utils.trace import EncloseEvent, TransferEvent
@@ -150,6 +168,9 @@ def main():
                 xfer["packed" if ev.packed else "generic"] += 1
 
     pbatch.set_batch_tracer(tracer)
+    # the flight recorder chains BEHIND the local tracer (obs.install
+    # preserves it) — spans + histograms + the Perfetto event stream
+    rec = obs.install() if (TRACE_OUT or obs.enabled()) else None
 
     # instrument the window stream (disk read + native parse + column
     # build) by timing the generator pulls
@@ -200,6 +221,24 @@ def main():
                 f"H2D {xfer['h2d']/nwin/1e3:.1f} KB/window | "
                 f"D2H {xfer['d2h']/nwin/1e3:.1f} KB/window"
             )
+    if rec is not None:
+        s = rec.latency_summary()
+        if s["windows"]:
+            p50 = s["device_latency_p50_s"]
+            p99 = s["device_latency_p99_s"]
+            print(
+                f"\ndispatch->materialize latency over {s['windows']} "
+                f"windows: p50 {p50*1e3:.1f} ms | p99 {p99*1e3:.1f} ms"
+            )
+        if TRACE_OUT:
+            from ouroboros_consensus_tpu.obs import perfetto
+
+            doc = rec.write_chrome_trace(TRACE_OUT)
+            errs = perfetto.validate_chrome_trace(doc)
+            print(f"chrome trace: {TRACE_OUT} "
+                  f"({len(doc['traceEvents'])} events"
+                  f"{'' if not errs else f', INVALID: {errs[:3]}'})")
+        obs.uninstall()
     pbatch.set_batch_tracer(None)
 
 
