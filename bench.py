@@ -419,6 +419,51 @@ def _wipe_stale_cache(child_log: str) -> bool:
     return True
 
 
+# the production packed-agg window pipeline's cold-compile set: the
+# aggregate monolith + the packed unpack/reduce stages (the programs a
+# fresh child must compile before its two-window prefix replay can
+# bank anything). Used when no measured warm_estimate_s exists yet —
+# the first round on a fresh build id previously had no gate at all.
+_COLD_WALL_GRAPHS = ("aggregate_core", "packed_unpack", "verdict_reduce")
+# dispatch/staging overhead on top of the compiles (chain open, synth
+# cache read, H2D) — deliberately conservative
+_COLD_WALL_OVERHEAD_S = 60.0
+
+
+def _predicted_cold_wall() -> float | None:
+    """Model-predicted cold warmup estimate for a fresh device child:
+    the octwall pinned predictions (analysis/costmodel.json — dict
+    lookups, no tracing) summed over the production window programs.
+    None when the cost model has no pins for them."""
+    try:
+        from ouroboros_consensus_tpu.analysis import costmodel
+    except Exception:
+        return None
+    walls = [costmodel.predicted_wall(g) for g in _COLD_WALL_GRAPHS]
+    if any(w is None for w in walls):
+        # a partial sum would UNDERSTATE the gate (e.g. the aggregate
+        # pin missing leaves ~4s of unpack/reduce standing in for a
+        # ~750s wall) — no estimate is safer than a wrong-by-100x one
+        return None
+    return sum(walls) + _COLD_WALL_OVERHEAD_S
+
+
+def _attempt2_estimate(est: float | None, budget_1: float) -> float:
+    """Wall a second cold start needs before it can bank anything.
+    Preference order: the MEASURED warm_estimate_s the first attempt
+    banked; else the octwall model-predicted cold wall (first round on
+    a fresh build id has nothing banked yet); else half the first
+    attempt's budget (the pre-model heuristic)."""
+    if est is not None and est > 0:
+        return est
+    pred = _predicted_cold_wall()
+    if pred is not None:
+        print(f"# no banked warm estimate: using model-predicted cold "
+              f"wall {pred:.0f}s as the attempt-2 gate", file=sys.stderr)
+        return pred
+    return budget_1 * 0.5
+
+
 def _run_teed(cmd, env, budget, log_path):
     """Popen with stdout teed to stderr AND `log_path`, killed at
     `budget` seconds -> (proc, timed_out)."""
@@ -489,15 +534,17 @@ def run_device_subprocess() -> dict | None:
                     est = float(json.load(f).get("warm_estimate_s") or 0)
             except (OSError, ValueError, json.JSONDecodeError):
                 pass
-            if est is None or est <= 0:
-                # no checkpoint after attempt 1: even the two-window
-                # prefix replay did not fit — require at least half the
-                # first budget again before paying a second cold start
-                est = budget_1 * 0.5
+            # no checkpoint after attempt 1 means even the two-window
+            # prefix replay did not fit — gate on the model-predicted
+            # cold wall (or the pre-model half-budget heuristic)
+            est = _attempt2_estimate(est, budget_1)
             if budget < est + 60:
+                # est may be MEASURED (banked warm_estimate_s) or the
+                # octwall model PREDICTION — _attempt2_estimate said
+                # which on stderr just above
                 print(
                     f"# skipping device attempt 2: {budget:.0f}s left < "
-                    f"measured warmup estimate {est:.0f}s + 60s margin "
+                    f"warmup estimate {est:.0f}s + 60s margin "
                     "(keeping any banked checkpoint)",
                     file=sys.stderr,
                 )
@@ -507,6 +554,11 @@ def run_device_subprocess() -> dict | None:
         # parent can grep the log for stale-executable rejections
         # between attempts
         child_log_path = os.path.join(CACHE, f"device_child_{attempt}.log")
+        # octwall pre-flight: the child's dispatch gate refuses any COLD
+        # monolith whose predicted compile wall does not fit what is
+        # left of THIS attempt's budget (analysis/costmodel.preflight —
+        # refusals recorded in the warmup report)
+        env["OCT_WALL_DEADLINE"] = str(time.time() + budget)
         proc, timed_out = _run_teed(
             [sys.executable, "-c", _DEVICE_CHILD], env, budget,
             child_log_path,

@@ -7,6 +7,8 @@ Subcommands:
   range      octrange interval/overflow certification (analysis/absint)
   taint      octrange secret-taint certification
   pointops   per-lane point-op counts vs their budgets.json ceilings
+  cost       octwall predicted cold-compile walls vs the budgets.json
+             "compile_wall" ceilings (analysis/costmodel)
 
 Shared options:
   --json            machine-readable report on stdout (keys sorted —
@@ -30,7 +32,9 @@ Exit codes (distinct so CI can tell WHY the gate failed):
   2  usage error (argparse)
   3  jaxpr-metric or point-op budget violation
   4  certification failure (range proof lost / taint ratchet violation)
-When several classes fire at once the lowest code wins (1 < 3 < 4).
+  5  compile-wall ratchet violation (predicted cold-compile wall over
+     its budgets.json "compile_wall" ceiling)
+When several classes fire at once the lowest code wins (1 < 3 < 4 < 5).
 """
 
 from __future__ import annotations
@@ -46,6 +50,7 @@ EXIT_OK = 0
 EXIT_FINDINGS = 1
 EXIT_BUDGET = 3
 EXIT_CERT = 4
+EXIT_COST = 5
 
 
 def _package_root() -> str:
@@ -124,6 +129,57 @@ def _cmd_certify(args, domain: str) -> int:
         args.json, lines,
     )
     return EXIT_CERT if (failed or violations) else EXIT_OK
+
+
+def _cmd_cost(args) -> int:
+    """octwall: per-graph compile-cost features + predicted walls vs
+    the budgets.json compile_wall ceilings (sorted-keys --json is
+    byte-stable for CI diffing)."""
+    from . import absint, costmodel
+
+    _pin_cpu()
+    budgets = graphs.load_budgets(args.budgets)
+    names = args.graphs or graphs.registered_graphs()
+    shapes = absint.load_shapes()
+    # trace at the fast-sweep lane counts — the SAME traces the lint
+    # gate pins against, so the drift note below is meaningful
+    feats = [
+        costmodel.graph_features(
+            n, absint.sweep_lanes(n, "fast", shapes)[0]
+        )
+        for n in names
+    ]
+    rows = []
+    for f in feats:
+        pred = costmodel.predict(f)
+        pin = costmodel.pinned(f.name) or {}
+        rows.append({
+            "graph": f.name,
+            "features": f.to_dict(),
+            "feature_hash": f.hash(),
+            "predicted_s": None if pred is None else round(pred, 1),
+            "pinned_hash": pin.get("feature_hash"),
+            "advisories": costmodel.advisories(f, budgets),
+        })
+    violations = costmodel.check_compile_wall(feats, budgets)
+    lines = []
+    for r in rows:
+        pred = "?" if r["predicted_s"] is None else f"{r['predicted_s']}s"
+        drift = ("" if r["pinned_hash"] in (None, r["feature_hash"])
+                 else " [features drifted from pin]")
+        lines.append(
+            f"{r['graph']}: predicted {pred} "
+            f"(eqns={r['features']['eqns']} "
+            f"max_comp={r['features']['max_comp_eqns']} "
+            f"chain={r['features']['mul_chain_depth']}){drift}"
+        )
+        # advisories stay in the JSON rows; the text report leaves them
+        # to check_compile_wall's COST lines (single source, no dupes)
+    lines.extend(f"COST: {v}" for v in violations)
+    lines.append(f"octwall: {len(violations)} violation(s)")
+    _emit({"cost": rows, "violations": violations,
+           "ok": not violations}, args.json, lines)
+    return EXIT_COST if violations else EXIT_OK
 
 
 def _cmd_pointops(args) -> int:
@@ -276,12 +332,15 @@ def main(argv: list[str] | None = None) -> int:
                        help="skip the certified.json comparison")
 
     common(sub.add_parser("pointops"))
+    common(sub.add_parser("cost"))
 
     args = ap.parse_args(argv)
     if args.cmd in ("range", "taint"):
         return _cmd_certify(args, args.cmd)
     if args.cmd == "pointops":
         return _cmd_pointops(args)
+    if args.cmd == "cost":
+        return _cmd_cost(args)
     # default-run graph names must be registered (certification targets
     # include aux graphs; the default run's budget pass does not)
     if args.graphs:

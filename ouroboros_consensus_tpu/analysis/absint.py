@@ -1818,3 +1818,38 @@ AUX_SOURCES: dict[str, list[str]] = {
 
 def certifiable_graphs() -> list[str]:
     return sorted(set(graphs.REGISTRY) | set(AUX_REGISTRY))
+
+
+def check_registry_drift(shapes: dict | None = None) -> list[str]:
+    """Registry drift gate (scripts/lint.py): every graphs.py REGISTRY
+    entry (and every aux target) must carry a shapes.json input spec
+    and a GRAPH_SOURCES/AUX_SOURCES mapping. A missing spec used to
+    surface only as a KeyError deep inside certification (or, for the
+    --changed source mapping, as a graph silently never re-selected by
+    the fast path) — this makes the drift a loud, named violation."""
+    shapes = shapes or load_shapes()
+    spec_names = set(shapes.get("graphs", {}))
+    violations: list[str] = []
+    for name in sorted(graphs.REGISTRY):
+        if name not in spec_names:
+            violations.append(
+                f"{name}: REGISTRY entry has no shapes.json input spec "
+                "(certification would be skipped)"
+            )
+        if name not in graphs.GRAPH_SOURCES:
+            violations.append(
+                f"{name}: REGISTRY entry has no GRAPH_SOURCES mapping "
+                "(--changed would never re-select it)"
+            )
+    for name in sorted(AUX_REGISTRY):
+        if name not in spec_names:
+            violations.append(
+                f"{name}: aux target has no shapes.json input spec "
+                "(certification would be skipped)"
+            )
+        if name not in AUX_SOURCES:
+            violations.append(
+                f"{name}: aux target has no AUX_SOURCES mapping "
+                "(--changed would never re-select it)"
+            )
+    return violations

@@ -46,24 +46,52 @@ class WarmupRecorder:
         # aot outcome counts + the per-stage detail rows
         self.aot: dict[str, int] = {}
         self.aot_events: list[dict] = []
+        # pre-flight refusals (analysis/costmodel.preflight): dispatches
+        # whose PREDICTED cold-compile wall did not fit the remaining
+        # bench budget — the decision is forensics too
+        self.refusals: list[dict] = []
         self.cache_probe: dict | None = None
         self.notes: list[str] = []
 
     # -- recording ----------------------------------------------------------
 
-    def note_stage(self, stage: str, wall_s: float, via: str = "jit") -> bool:
+    def note_stage(self, stage: str, wall_s: float, via: str = "jit",
+                   feature_hash: str | None = None) -> bool:
         """Record a stage's FIRST execute wall (compile-inclusive).
-        Returns True when this call was the first for `stage`."""
+        Returns True when this call was the first for `stage`.
+        `feature_hash` is the costmodel jaxpr feature digest of the
+        dispatched program, so scripts/fit_costmodel.py can join this
+        measured wall EXACTLY to the static features it belongs to."""
         with self._lock:
             if stage in self.stages:
                 return False
-            self.stages[stage] = {
+            row = {
                 "wall_s": round(wall_s, 3),
                 "via": via,
                 "t": round(time.monotonic() - self.t0, 3),
             }
+            if feature_hash:
+                row["feature_hash"] = feature_hash
+            self.stages[stage] = row
         self._flush()
         return True
+
+    def note_refusal(self, stage: str, predicted_s: float,
+                     remaining_s: float, action: str,
+                     detail: str = "") -> None:
+        """One pre-flight refusal: a cold first-execute whose predicted
+        compile wall exceeded the remaining wall budget (the caller
+        takes `action` — e.g. the per-stage split fallback — instead)."""
+        with self._lock:
+            self.refusals.append({
+                "stage": stage,
+                "predicted_s": round(predicted_s, 1),
+                "remaining_s": round(remaining_s, 1),
+                "action": action,
+                "detail": detail[:200],
+                "t": round(time.monotonic() - self.t0, 3),
+            })
+        self._flush()
 
     def note_aot(self, stage: str, outcome: str, wall_s: float = 0.0,
                  detail: str = "") -> None:
@@ -115,6 +143,7 @@ class WarmupRecorder:
                 "stages": stages,
                 "aot": dict(self.aot),
                 "aot_events": list(self.aot_events),
+                "refusals": [dict(r) for r in self.refusals],
                 "cache_probe": self.cache_probe,
                 "notes": list(self.notes),
             }
@@ -142,6 +171,7 @@ class WarmupRecorder:
             self.stages.clear()
             self.aot.clear()
             self.aot_events.clear()
+            self.refusals.clear()
             self.cache_probe = None
             self.notes.clear()
 

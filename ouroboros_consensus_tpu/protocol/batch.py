@@ -634,7 +634,10 @@ def _warm_timed(stage: str, fn):
         t0 = time.monotonic()
         out = fn(*a, **k)
         _WARM_SEEN.add(stage)
-        WARMUP.note_stage(stage, time.monotonic() - t0, via="xla-jit")
+        from ..analysis import costmodel
+
+        WARMUP.note_stage(stage, time.monotonic() - t0, via="xla-jit",
+                          feature_hash=costmodel.stage_feature_hash(stage))
         return out
 
     return wrapper
@@ -654,6 +657,31 @@ DEVICE_IMPL = os.environ.get("OCT_DEVICE_IMPL", "")
 # scan's serial cost ever exceeds the eta transfer it saves.
 PACKED_STAGE = os.environ.get("OCT_PACKED_STAGE", "1") != "0"
 NONCE_SCAN = os.environ.get("OCT_NONCE_SCAN", "1") != "0"
+
+
+def _compile_gate_admit(stage: str, action: str,
+                        fallback_graph: str | None) -> bool:
+    """octwall pre-flight (analysis/costmodel.preflight): when bench.py
+    has exported a wall deadline ($OCT_WALL_DEADLINE), a COLD monolith
+    program whose PREDICTED cold-compile wall does not fit the
+    remaining budget is refused here — the window rides the fallback
+    path named by `action` instead, and the refusal lands in the warmup
+    report. On the pk impl that fallback is the per-stage split
+    (individually small programs, each banked by the persistent cache
+    across retries); on the xla impl it is the per-lane packed monolith,
+    so `fallback_graph` names its twin and the gate only refuses when
+    that twin is predicted CHEAPER (trading one doomed compile for
+    another helps nobody). No deadline / no model / OCT_COMPILE_GATE=0
+    -> always admit; the gate must never break dispatch."""
+    if os.environ.get("OCT_COMPILE_GATE", "1") == "0":
+        return True
+    try:
+        from ..analysis import costmodel
+
+        return costmodel.preflight(stage, action=action,
+                                   fallback_graph=fallback_graph)
+    except Exception:  # noqa: BLE001 — fail-open by contract
+        return True
 
 
 def _agg_enabled() -> bool:
@@ -1879,7 +1907,27 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
         scan_mode = NONCE_SCAN and carry is not None
         cargs = carry if scan_mode else _ZERO_CARRY
         n_real = np.int32(b)
+        refused_gate = None
+        agg_stage = (f"agg-packed:{layout.body_len}b:"
+                     f"{'scan' if scan_mode else 'noscan'}")
         if layout.vrf_proof_len == 128 and _agg_enabled():
+            # the pk fallback is the per-stage split; the xla fallback
+            # is itself the per-lane packed monolith, so name its twin
+            # and only refuse when that twin is predicted cheaper
+            impl_is_pk = _impl() == "pk"
+            if not _compile_gate_admit(
+                agg_stage,
+                action=("stage-split-fallback" if impl_is_pk
+                        else "xla-packed-fallback"),
+                fallback_graph=(None if impl_is_pk
+                                else "verify_praos_core_bc"),
+            ):
+                # predicted compile wall over budget AND the fallback
+                # path is cheaper: skip the 330k-eqn aggregate monolith
+                # (decision in warmup report)
+                refused_gate = "compile-wall-refused"
+        if (layout.vrf_proof_len == 128 and _agg_enabled()
+                and refused_gate is None):
             # the aggregated fast path: ONE RLC/MSM program instead of
             # the per-lane ladder stages; the eta/nonce outputs are
             # identical to the per-lane path by construction, so the
@@ -1909,7 +1957,7 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
             )
             impl = "xla"
         carry_out = tuple(out[0][1:5]) if scan_mode else None
-        meta = _win_meta("packed", None, b, lanes, t0, t1)
+        meta = _win_meta("packed", refused_gate, b, lanes, t0, t1)
         disp = _Dispatched(impl, True, scan_mode, scan_mode, out, meta)
         return pre, disp, b, carry_out
 

@@ -44,6 +44,22 @@ from ouroboros_consensus_tpu.protocol import batch as pbatch  # noqa: E402
 from ouroboros_consensus_tpu.tools import db_analyser as ana  # noqa: E402
 
 TOPOLOGY = os.environ.get("OCT_AOT_TOPOLOGY", "v5e:2x2")
+# wall budget for THIS precompile run (seconds; 0 = unlimited). Stages
+# whose octwall-predicted compile wall cannot fit the remaining budget
+# are skipped (recorded in the manifest) instead of blowing it.
+AOT_BUDGET = float(os.environ.get("OCT_AOT_BUDGET", "0") or 0)
+_T0 = time.time()
+
+
+def _predicted_wall(stage: str) -> float | None:
+    """octwall pinned prediction for a stage's graph twin (dict lookup,
+    no tracing). The model is calibrated on first-execute walls, which
+    bound the lower+compile bracket here from above — conservative in
+    the safe direction for the budget skip."""
+    from ouroboros_consensus_tpu.analysis import costmodel
+
+    g = costmodel.stage_graph(stage)
+    return costmodel.predicted_wall(g) if g else None
 
 
 def discover_batches(path, params):
@@ -119,6 +135,19 @@ def compile_stage(name, fn, in_sds, b, manifest):
     if os.path.exists(path):
         print(f"  {name:8s} sig={sig} — cached", flush=True)
         return False
+    predicted = _predicted_wall(name)
+    if AOT_BUDGET and predicted is not None:
+        remaining = AOT_BUDGET - (time.time() - _T0)
+        if predicted > remaining:
+            print(f"  {name:8s} sig={sig} — SKIPPED: predicted "
+                  f"{predicted:.0f}s compile > {remaining:.0f}s of "
+                  "OCT_AOT_BUDGET left", flush=True)
+            manifest.append({
+                "stage": name, "b": b, "sig": sig, "skipped": True,
+                "predicted_s": round(predicted, 1),
+                "budget_left_s": round(remaining, 1),
+            })
+            return False
     t0 = time.time()
     lowered = jax.jit(fn).trace(*in_sds).lower(lowering_platforms=("tpu",))
     t_lower = time.time() - t0
@@ -134,9 +163,14 @@ def compile_stage(name, fn, in_sds, b, manifest):
     }
     p = aot.save(name, b, KES_DEPTH, K.TILE, sig, compiled, meta)
     meta["bytes"] = os.path.getsize(p)
+    if predicted is not None:
+        meta["predicted_s"] = round(predicted, 1)
     manifest.append(meta)
+    pred_note = (f" (octwall predicted {predicted:.0f}s)"
+                 if predicted is not None else "")
     print(f"  {name:8s} sig={sig} lower {t_lower:6.1f}s compile "
-          f"{t_compile:6.1f}s -> {meta['bytes']/1e6:.1f} MB", flush=True)
+          f"{t_compile:6.1f}s -> {meta['bytes']/1e6:.1f} MB{pred_note}",
+          flush=True)
     return True
 
 

@@ -4,6 +4,7 @@ ratcheted.
 
     python scripts/lint.py                    # AST + budgets + point-ops
                                               #   + octrange certification
+                                              #   + octwall compile costs
     python scripts/lint.py --no-graphs        # AST pass only (no jax)
     python scripts/lint.py --changed          # re-trace only graphs whose
                                               #   source modules differ from
@@ -11,25 +12,32 @@ ratcheted.
     python scripts/lint.py --tier full        # full lane sweeps
     python scripts/lint.py --update-baseline  # re-grandfather AST keys
     python scripts/lint.py --update-certified # re-pin certification
+    python scripts/lint.py --update-costs     # re-pin compile-cost features
+                                              #   + compile_wall ceilings
 
 Exit 0 = no NEW AST findings (anything in analysis/baseline.json is
 grandfathered), every registered kernel graph within its
 analysis/budgets.json ceilings (jaxpr metrics AND per-lane point-ops),
 zero equation growth from telemetry on the instrumentation-purity
 graphs (budgets.json "instrumentation_purity": the obs flight recorder
-must stay host-side), and every certification pin in
+must stay host-side), every certification pin in
 analysis/certified.json still holding (range proofs intact, no new
-taint findings). Nonzero exits mirror
+taint findings), and every graph's octwall predicted cold-compile wall
+under its budgets.json "compile_wall" ceiling. Nonzero exits mirror
 `python -m ouroboros_consensus_tpu.analysis`: 1 = new AST finding(s),
-3 = budget violation(s), 4 = certification ratchet violation(s). The
-ratchet files only ever shrink in normal operation — fixing a
-grandfathered finding makes its key stale, and the gate prints a
-reminder to re-run the matching --update flag so the ratchet tightens.
+2 = registry drift (a REGISTRY/aux entry without a shapes.json spec or
+source mapping — gate misconfiguration, checked before anything
+traces), 3 = budget violation(s), 4 = certification ratchet
+violation(s), 5 = compile-wall ratchet violation(s). The ratchet files
+only ever shrink in normal operation — fixing a grandfathered finding
+makes its key stale, and the gate prints a reminder to re-run the
+matching --update flag so the ratchet tightens.
 
-One trace per graph feeds all three jaxpr passes: the gate traces each
+One trace per graph feeds all four jaxpr passes: the gate traces each
 graph at its fast-sweep lane count (production 8192 for the
 lane-sensitive graphs, the registry tile otherwise) and the budget
-metrics, point-op counts and certification all read that cached trace.
+metrics, point-op counts, certification AND compile-cost features all
+read that cached trace.
 """
 
 from __future__ import annotations
@@ -49,8 +57,11 @@ BASELINE = os.path.join(
     REPO, "ouroboros_consensus_tpu", "analysis", "baseline.json"
 )
 # a diff in any of these invalidates every certificate, not just one
-# graph's — force the full sweep
+# graph's — force the full sweep. scripts/fit_costmodel.py is costmodel
+# machinery living outside analysis/ (a re-fit changes every predicted
+# wall), so it is mapped into the fast path explicitly.
 _MACHINERY_PREFIX = "ouroboros_consensus_tpu/analysis/"
+_MACHINERY_FILES = {"scripts/fit_costmodel.py"}
 
 
 def _changed_files() -> set[str]:
@@ -78,7 +89,8 @@ def _select_graphs(changed: set[str]) -> list[str] | None:
 
     if not changed:
         return []
-    if any(f.startswith(_MACHINERY_PREFIX) for f in changed):
+    if any(f.startswith(_MACHINERY_PREFIX) or f in _MACHINERY_FILES
+           for f in changed):
         return None
     sources = dict(graphs.GRAPH_SOURCES)
     sources.update(absint.AUX_SOURCES)
@@ -89,6 +101,33 @@ def _select_graphs(changed: set[str]) -> list[str] | None:
     return names
 
 
+def _update_compile_wall_budgets(cost_features) -> None:
+    """--update-costs: re-pin the budgets.json compile_wall ceilings at
+    ~1.3x each graph's current predicted wall (same headroom philosophy
+    as the jaxpr-metric budgets — drift toward the compile-wall
+    pathology fails statically long before a TPU session burns on it).
+    The advisory thresholds are hand-set policy and are preserved."""
+    from ouroboros_consensus_tpu.analysis import costmodel
+
+    path = graphs._BUDGET_PATH
+    with open(path, encoding="utf-8") as f:
+        budgets = json.load(f)
+    sec = budgets.setdefault("compile_wall", {})
+    sec.setdefault("advisory", {})
+    per_graph = {}
+    for feat in cost_features:
+        pred = costmodel.predict(feat)
+        if pred is None:
+            continue
+        per_graph[feat.name] = {
+            "predicted_s_max": round(max(1.0, pred * 1.3), 1)
+        }
+    sec["graphs"] = per_graph
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(budgets, f, indent=2)
+        f.write("\n")
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--no-graphs", action="store_true")
@@ -97,6 +136,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--tier", choices=("fast", "full"), default="fast")
     ap.add_argument("--update-baseline", action="store_true")
     ap.add_argument("--update-certified", action="store_true")
+    ap.add_argument("--update-costs", action="store_true",
+                    help="re-pin costmodel.json graph features and the "
+                         "budgets.json compile_wall ceilings")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -132,8 +174,10 @@ def main(argv: list[str] | None = None) -> int:
 
     budget_violations: list[str] = []
     cert_violations: list[str] = []
+    cost_violations: list[str] = []
     reports: list[graphs.GraphReport] = []
     cert_reports = []
+    cost_features = []
     names: list[str] | None = None
     if not args.no_graphs:
         # abstract tracing needs no accelerator; pin the platform so a
@@ -146,22 +190,46 @@ def main(argv: list[str] | None = None) -> int:
         except Exception:
             pass  # backend already initialized by the embedding process
 
-        from ouroboros_consensus_tpu.analysis import absint
+        from ouroboros_consensus_tpu.analysis import absint, costmodel
+
+        shapes = absint.load_shapes()
+        # registry drift gate: a REGISTRY/aux entry without a
+        # shapes.json spec or a source mapping is a gate
+        # misconfiguration — fail loudly BEFORE anything traces
+        drift = absint.check_registry_drift(shapes)
+        if drift:
+            if args.json:
+                print(json.dumps(
+                    {"drift_violations": drift, "ok": False},
+                    indent=2, sort_keys=True,
+                ))
+            else:
+                for v in drift:
+                    print(f"DRIFT: {v}")
+            return 2
 
         if args.changed:
             names = _select_graphs(_changed_files())
         todo = names if names is not None else absint.certifiable_graphs()
-        shapes = absint.load_shapes()
         budgets = graphs.load_budgets()
         for name in todo:
-            # one trace per graph serves certification, jaxpr budgets
-            # and point-op budgets (trace_graph LRU cache)
+            # one trace per graph serves certification, jaxpr budgets,
+            # point-op budgets and compile-cost features (trace_graph
+            # LRU cache)
             cert_reports.extend(absint.certify_graph(name, args.tier,
                                                      shapes))
             if name in graphs.REGISTRY:
                 lanes0 = absint.sweep_lanes(name, args.tier, shapes)[0]
                 reports.append(graphs.analyze_jaxpr(
                     graphs.trace_graph(name, lanes0), name
+                ))
+                # cost features ALWAYS at the fast-sweep lane count —
+                # the tile the costmodel.json pins are defined at, so
+                # the pin-freshness check compares like with like even
+                # under --tier full
+                cost_lanes = absint.sweep_lanes(name, "fast", shapes)[0]
+                cost_features.append(costmodel.extract_features(
+                    graphs.trace_graph(name, cost_lanes), name
                 ))
                 budget_violations += graphs.check_point_ops(
                     budgets, names=[name]
@@ -184,7 +252,26 @@ def main(argv: list[str] | None = None) -> int:
             print(f"certified.json updated: "
                   f"{len(absint.load_certified()['graphs'])} graph(s)")
             return 0
+        if args.update_costs:
+            if names is not None:
+                print("--update-costs requires the full sweep "
+                      "(drop --changed)")
+                return 2
+            model = (costmodel._cached_cost() or {}).get("model")
+            costmodel.write_cost(
+                graphs_section=costmodel.pin_payload(cost_features, model)
+            )
+            _update_compile_wall_budgets(cost_features)
+            print(f"costmodel.json pins updated: "
+                  f"{len(cost_features)} graph(s)")
+            return 0
         cert_violations = absint.check_certified(cert_reports)
+        cost_violations = costmodel.check_compile_wall(
+            cost_features, budgets
+        )
+        # pin freshness: stale pins would stamp warmup stage notes with
+        # an old structure's hash and mis-join calibration walls
+        cost_violations += costmodel.check_pins(cost_features)
 
     if args.json:
         print(json.dumps({
@@ -192,10 +279,14 @@ def main(argv: list[str] | None = None) -> int:
             "stale_baseline": stale,
             "budget_violations": budget_violations,
             "certification_violations": cert_violations,
+            "cost_violations": cost_violations,
             "graphs": [r.to_dict() for r in reports],
             "certified": [r.to_dict() for r in cert_reports],
+            "cost_features": [f.to_dict() | {"name": f.name}
+                              for f in cost_features],
             "changed_selection": names,
-            "ok": not (new or budget_violations or cert_violations),
+            "ok": not (new or budget_violations or cert_violations
+                       or cost_violations),
         }, indent=2, sort_keys=True))
     else:
         for f in new:
@@ -204,6 +295,8 @@ def main(argv: list[str] | None = None) -> int:
             print(f"BUDGET: {v}")
         for v in cert_violations:
             print(f"CERTIFIED: {v}")
+        for v in cost_violations:
+            print(f"COST: {v}")
         for k in stale:
             print(f"note: baseline entry no longer fires "
                   f"(run --update-baseline to ratchet): {k}")
@@ -214,13 +307,16 @@ def main(argv: list[str] | None = None) -> int:
             f"lint: {len(new)} new finding(s), "
             f"{len(budget_violations)} budget violation(s), "
             f"{len(cert_violations)} certification violation(s), "
+            f"{len(cost_violations)} compile-wall violation(s), "
             f"{len(stale)} stale baseline entr(y/ies)"
         )
     if new:
         return 1
     if budget_violations:
         return 3
-    return 4 if cert_violations else 0
+    if cert_violations:
+        return 4
+    return 5 if cost_violations else 0
 
 
 if __name__ == "__main__":
