@@ -243,6 +243,7 @@ def _probe_cache_entry():
 
 sys.path.insert(0, os.environ["OCT_REPO"])
 from ouroboros_consensus_tpu import obs as _obs
+from ouroboros_consensus_tpu.obs.resources import RESOURCES as _RESOURCES
 from ouroboros_consensus_tpu.obs.warmup import WARMUP as _WARMUP
 
 _t_probe = time.monotonic()
@@ -301,9 +302,13 @@ def emit(n, best, warm, attrib=None, warm_estimate=None):
     row = {"n": n, "best_s": best, "warm_s": warm,
            "warm_estimate_s": warm_estimate if warm_estimate else warm,
            "platform": jax.devices()[0].platform,
+           "build_id": build_id,
            "warmup_report": _WARMUP.report(),
            "metrics_summary": _rec.latency_summary(),
-           "metrics": _rec.registry.snapshot()}
+           "metrics": _rec.registry.snapshot(),
+           # per-stage FLOP/byte/HBM accounting of every program this
+           # child actually dispatched (obs/resources.py)
+           "device_resources": _RESOURCES.report()}
     if attrib:
         row.update(attrib)
     with open(tmp, "w") as f:
@@ -595,6 +600,48 @@ def run_device_subprocess() -> dict | None:
         return None
 
 
+def append_ledger_record(out: dict, baseline: float | None = None,
+                         native_wall_s: float | None = None) -> dict | None:
+    """One provenance-complete run-ledger record per bench run
+    (obs/ledger.py): the final JSON line plus git rev/dirty, the child's
+    PJRT build id, every OCT_*/BENCH_* kill-switch value, the warmup
+    forensics, metrics snapshot and per-stage device resources — so
+    "what changed between r01 and r02" is a ledger query, not
+    BENCH_r0*.json archaeology. Fail-soft: the bench's one JSON line
+    must come out even if the ledger cannot (read-only disk, etc.)."""
+    try:
+        from ouroboros_consensus_tpu.obs import ledger
+
+        big = ("metrics", "metrics_summary", "warmup_report",
+               "device_resources")
+        slim = {k: v for k, v in out.items() if k not in big}
+        extra = None
+        if baseline is not None:
+            extra = {"native_baseline_per_s": round(baseline, 1)}
+            if native_wall_s is not None:
+                extra["native_wall_s"] = round(native_wall_s, 1)
+        return ledger.record_run(
+            "bench",
+            config={
+                "headers": BENCH_HEADERS, "max_batch": MAX_BATCH,
+                "kes_depth": KES_DEPTH,
+                "total_budget_s": TOTAL_BUDGET,
+                "device_budget_s": DEVICE_BUDGET,
+            },
+            result=slim,
+            wall_s=time.monotonic() - _T0,
+            phases_s=out.get("phases_s"),
+            warmup_report=out.get("warmup_report"),
+            metrics=out.get("metrics"),
+            metrics_summary=out.get("metrics_summary"),
+            device_resources=out.get("device_resources"),
+            build_id=out.get("build_id"),
+            extra=extra,
+        )
+    except Exception:  # noqa: BLE001 — the ledger never breaks the bench
+        return None
+
+
 def main() -> None:
     # a warmup report left by a PREVIOUS round must never be banked as
     # this round's forensics — only the child this run spawns may write
@@ -665,7 +712,8 @@ def main() -> None:
         # warmup forensics and the flight recorder's metrics snapshot
         for k in ("phases_s", "windows", "packed_windows",
                   "h2d_bytes_per_window", "d2h_bytes_per_window",
-                  "warmup_report", "metrics_summary", "metrics"):
+                  "warmup_report", "metrics_summary", "metrics",
+                  "device_resources", "build_id"):
             if k in device:
                 out[k] = device[k]
         if "warmup_report" not in out:
@@ -694,6 +742,7 @@ def main() -> None:
         if wr is not None:
             out["warmup_report"] = wr
     print(json.dumps(out))
+    append_ledger_record(out, baseline=baseline, native_wall_s=nwall)
 
 
 if __name__ == "__main__":

@@ -9,6 +9,10 @@ Subcommands:
   pointops   per-lane point-op counts vs their budgets.json ceilings
   cost       octwall predicted cold-compile walls vs the budgets.json
              "compile_wall" ceilings (analysis/costmodel)
+  resources  device-resource pins (FLOPs / bytes accessed / peak HBM,
+             obs/resources.py) vs the budgets.json "device_resources"
+             section: hash-freshness + ceiling compares only — traces
+             for the fresh feature hashes, never compiles
 
 Shared options:
   --json            machine-readable report on stdout (keys sorted —
@@ -34,7 +38,12 @@ Exit codes (distinct so CI can tell WHY the gate failed):
   4  certification failure (range proof lost / taint ratchet violation)
   5  compile-wall ratchet violation (predicted cold-compile wall over
      its budgets.json "compile_wall" ceiling)
-When several classes fire at once the lowest code wins (1 < 3 < 4 < 5).
+  6  device-resource ratchet violation (a registry graph without a
+     "device_resources" pin, a stale-structure pin — feature hash no
+     longer matching the traced graph — or a pinned FLOP/byte/peak-HBM
+     value over its ceiling)
+When several classes fire at once the lowest code wins
+(1 < 3 < 4 < 5 < 6).
 """
 
 from __future__ import annotations
@@ -51,6 +60,7 @@ EXIT_FINDINGS = 1
 EXIT_BUDGET = 3
 EXIT_CERT = 4
 EXIT_COST = 5
+EXIT_RESOURCES = 6
 
 
 def _package_root() -> str:
@@ -180,6 +190,47 @@ def _cmd_cost(args) -> int:
     _emit({"cost": rows, "violations": violations,
            "ok": not violations}, args.json, lines)
     return EXIT_COST if violations else EXIT_OK
+
+
+def _cmd_resources(args) -> int:
+    """Device-resource ratchet status (sorted-keys --json is byte-stable
+    for CI diffing). Traces each graph once for the fresh octwall
+    feature hash — the staleness key — but never lowers or compiles;
+    regeneration is scripts/lint.py --update-resources."""
+    from ..obs import resources as obs_res
+    from . import absint, costmodel
+
+    _pin_cpu()
+    budgets = graphs.load_budgets(args.budgets)
+    names = args.graphs or graphs.registered_graphs()
+    shapes = absint.load_shapes()
+    feats = [
+        costmodel.graph_features(
+            n, absint.sweep_lanes(n, "fast", shapes)[0]
+        )
+        for n in names
+    ]
+    rows = obs_res.resources_payload(names, budgets, feats)
+    violations = obs_res.check_device_resources(feats, budgets)
+    lines = []
+    for name in sorted(rows):
+        r = rows[name]
+        pin = r["pin"]
+        if pin is None:
+            lines.append(f"{name}: NO PIN")
+            continue
+        status = "fresh" if r["fresh"] else "STALE-STRUCTURE"
+        lines.append(
+            f"{name}@{pin.get('at_lanes')}: "
+            f"flops={pin.get('flops')} "
+            f"bytes={pin.get('bytes_accessed')} "
+            f"peak_hbm={pin.get('peak_hbm_bytes')} [{status}]"
+        )
+    lines.extend(f"RESOURCES: {v}" for v in violations)
+    lines.append(f"resources: {len(violations)} violation(s)")
+    _emit({"resources": rows, "violations": violations,
+           "ok": not violations}, args.json, lines)
+    return EXIT_RESOURCES if violations else EXIT_OK
 
 
 def _cmd_pointops(args) -> int:
@@ -333,6 +384,7 @@ def main(argv: list[str] | None = None) -> int:
 
     common(sub.add_parser("pointops"))
     common(sub.add_parser("cost"))
+    common(sub.add_parser("resources"))
 
     args = ap.parse_args(argv)
     if args.cmd in ("range", "taint"):
@@ -341,6 +393,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_pointops(args)
     if args.cmd == "cost":
         return _cmd_cost(args)
+    if args.cmd == "resources":
+        return _cmd_resources(args)
     # default-run graph names must be registered (certification targets
     # include aux graphs; the default run's budget pass does not)
     if args.graphs:

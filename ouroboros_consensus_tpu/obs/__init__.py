@@ -13,13 +13,23 @@ package is the TPU build's equivalent surface, all host-side:
                   walls, pk-AOT load/reject attribution, the bench
                   cache probe; crash-safe JSON via $OCT_WARMUP_REPORT
   * `perfetto`  — Chrome trace-event (chrome://tracing / Perfetto)
-                  export of a replay's event stream
+                  export of a replay's event stream (+ warmup track)
+  * `ledger`    — append-only JSONL run ledger (.oct_ledger/): one
+                  provenance-complete record per bench / suite /
+                  profile run — git rev+dirty, PJRT build id, every
+                  OCT_* kill-switch, metrics, warmup, banked result
+  * `resources` — device resource accounting: FLOPs / bytes / HBM per
+                  dispatched stage program (oct_stage_* gauges, the
+                  budgets.json "device_resources" ratchet)
 
 Env levers:
 
   OCT_TRACE=1          install the flight recorder for replays
                        (db_analyser.revalidate, profile_replay, bench)
   OCT_WARMUP_REPORT=f  flush warmup forensics to `f` after every note
+  OCT_LEDGER=d|0       run-ledger directory override / kill-switch
+  OCT_STAGE_RESOURCES  =0 kills per-stage resource capture; =1 forces
+                       it; unset follows the installed recorder
 
 Everything stays OFF the hot path unless installed: with OCT_TRACE
 unset, `protocol.batch.BATCH_TRACER` remains None and the only residual
@@ -45,6 +55,15 @@ _PREV_TRACER = None
 def enabled() -> bool:
     """The OCT_TRACE lever (read per call so tests can flip it)."""
     return os.environ.get(_ENV, "0") not in ("0", "")
+
+
+def installed() -> bool:
+    """True while at least one install() is outstanding — the default
+    gate for the per-stage resource capture (obs/resources.py): replays
+    that installed the recorder account device resources, bare unit
+    runs pay nothing."""
+    with _LOCK:
+        return _INSTALL_DEPTH > 0
 
 
 def recorder() -> FlightRecorder:

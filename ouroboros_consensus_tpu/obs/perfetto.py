@@ -11,8 +11,16 @@ chrome://tracing load directly:
 Layout: one process ("oct replay"), one thread row per phase label
 (stage / dispatch / materialize / epilogue / stream), a "windows" row
 holding one complete ("X") slice per retired window whose args carry
-lanes / outcome / gate / n_valid, and counter ("C") tracks for the H2D
-and D2H bytes per window.
+lanes / outcome / gate / n_valid, counter ("C") tracks for the H2D
+and D2H bytes per window, and a "warmup" row rebuilt from the warmup
+recorder (obs/warmup.py): one slice per stage FIRST execute (the
+compile wall that dominates cold runs — previously invisible in the
+very tool meant to visualize walls) plus instants for every pk-AOT
+load outcome and octwall pre-flight refusal. The warmup rows need the
+recorder's own monotonic t0 to share the event stream's timeline, so
+they appear when exporting from a live process (FlightRecorder
+.chrome_trace / scripts/profile_replay.py --trace-out), not when
+rendering a report file from another process.
 
 `validate_chrome_trace` is the schema gate the tier-1 test runs over a
 replay export: structural validation of the JSON object model per the
@@ -32,7 +40,7 @@ PID = 1
 # stable thread ids per track; unknown phase labels allocate past these
 _TIDS = {
     "windows": 1, "stage": 2, "dispatch": 3, "materialize": 4,
-    "epilogue": 5, "stream": 6,
+    "epilogue": 5, "stream": 6, "warmup": 7,
 }
 
 _ALLOWED_PH = {"X", "B", "E", "i", "C", "M"}
@@ -53,14 +61,21 @@ def _meta(name: str, tid: int | None = None) -> dict:
     return ev
 
 
-def to_chrome_trace(timed_events: Iterable[tuple[float, object]]) -> dict:
+def to_chrome_trace(timed_events: Iterable[tuple[float, object]],
+                    warmup_report: dict | None = None,
+                    warmup_t0: float | None = None) -> dict:
     """[(t_monotonic_received, event)] -> Trace Event Format document.
 
     `EncloseEvent` end edges become complete "X" slices on their label's
     track (their own t/duration stamps, not the receive time);
     `WindowSpan`s become "X" slices on the windows track; dirty-window
     re-dispatches and other events ride as instants on track 0;
-    `TransferEvent`s become per-window byte counters."""
+    `TransferEvent`s become per-window byte counters.
+
+    `warmup_report` (with `warmup_t0`, the recorder's monotonic epoch —
+    report timestamps are relative to it) adds the warmup track:
+    per-stage first-execute slices with aot/jit attribution, pk-AOT
+    load-outcome instants, and octwall pre-flight refusal instants."""
     timed = list(timed_events)
     tids = dict(_TIDS)
 
@@ -70,13 +85,25 @@ def to_chrome_trace(timed_events: Iterable[tuple[float, object]]) -> dict:
             t = tids[label] = max(tids.values()) + 1
         return t
 
-    # normalize all timestamps against the earliest one observed
+    wu = warmup_report if (warmup_report and warmup_t0 is not None) else None
+
+    # normalize all timestamps against the earliest one observed — the
+    # warmup slices usually start BEFORE the first window event (the
+    # compile precedes the replay), so they join the minimum
     t_zero = None
     for t_recv, ev in timed:
         cand = t_recv
         if isinstance(ev, EncloseEvent):
             cand = ev.t - (ev.duration or 0.0)
         t_zero = cand if t_zero is None else min(t_zero, cand)
+    if wu:
+        for row in wu.get("stages", {}).values():
+            cand = warmup_t0 + float(row.get("t", 0.0)) - float(
+                row.get("wall_s", 0.0))
+            t_zero = cand if t_zero is None else min(t_zero, cand)
+        for ev_row in wu.get("aot_events", []) + wu.get("refusals", []):
+            cand = warmup_t0 + float(ev_row.get("t", 0.0))
+            t_zero = cand if t_zero is None else min(t_zero, cand)
     if t_zero is None:
         t_zero = 0.0
 
@@ -132,11 +159,45 @@ def to_chrome_trace(timed_events: Iterable[tuple[float, object]]) -> dict:
                     "cat": "gate", "ph": "i", "s": "t",
                     "ts": us(t_recv), "pid": PID, "tid": _TIDS["windows"],
                 })
+
+    if wu:
+        wtid = _TIDS["warmup"]
+        for stage, row in sorted(wu.get("stages", {}).items()):
+            wall = float(row.get("wall_s", 0.0))
+            end = warmup_t0 + float(row.get("t", 0.0))
+            args = {"via": row.get("via", "jit"),
+                    "wall_s": wall}
+            if row.get("feature_hash"):
+                args["feature_hash"] = row["feature_hash"]
+            events.append({
+                "name": f"{stage} first-execute [{row.get('via', 'jit')}]",
+                "cat": "warmup", "ph": "X",
+                "ts": us(end - wall), "dur": max(0.0, wall * 1e6),
+                "pid": PID, "tid": wtid, "args": args,
+            })
+        for ev_row in wu.get("aot_events", []):
+            events.append({
+                "name": (f"aot {ev_row.get('stage', '?')}: "
+                         f"{ev_row.get('outcome', '?')}"),
+                "cat": "warmup", "ph": "i", "s": "t",
+                "ts": us(warmup_t0 + float(ev_row.get("t", 0.0))),
+                "pid": PID, "tid": wtid,
+            })
+        for ref in wu.get("refusals", []):
+            events.append({
+                "name": (f"compile-wall refused: {ref.get('stage', '?')} "
+                         f"(predicted {ref.get('predicted_s', '?')}s > "
+                         f"remaining {ref.get('remaining_s', '?')}s)"),
+                "cat": "warmup", "ph": "i", "s": "t",
+                "ts": us(warmup_t0 + float(ref.get("t", 0.0))),
+                "pid": PID, "tid": wtid,
+            })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
-def write(path: str, timed_events) -> dict:
-    doc = to_chrome_trace(timed_events)
+def write(path: str, timed_events, warmup_report: dict | None = None,
+          warmup_t0: float | None = None) -> dict:
+    doc = to_chrome_trace(timed_events, warmup_report, warmup_t0)
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f)
     return doc

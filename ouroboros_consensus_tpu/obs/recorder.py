@@ -77,6 +77,13 @@ class FlightRecorder:
             self._windows.labels(outcome=ev.outcome).inc()
             if ev.outcome == "generic":
                 self._gates.labels(gate=ev.gate or "packed-off").inc()
+            elif ev.gate:
+                # a non-generic outcome can still carry a gate: the
+                # octwall pre-flight refusal ("compile-wall-refused")
+                # rides a PACKED window that fell back off the
+                # aggregate path — it must be countable, not only
+                # visible to someone reading raw event streams
+                self._gates.labels(gate=ev.gate).inc()
         elif isinstance(ev, WindowSpan):
             self._headers.inc(ev.n_valid)
             self._phase_h["stage"].observe(ev.stage_s)
@@ -101,15 +108,25 @@ class FlightRecorder:
         with self._lock:
             return list(self.events)
 
+    def _warmup_state(self) -> tuple[dict, float]:
+        """This process's warmup forensics + the recorder's monotonic
+        epoch: the Perfetto export places stage first-execute slices
+        (the compile walls) on the same timeline as the window spans."""
+        from .warmup import WARMUP
+
+        return WARMUP.report(), WARMUP.t0
+
     def chrome_trace(self) -> dict:
         from . import perfetto
 
-        return perfetto.to_chrome_trace(self.timed_events())
+        report, t0 = self._warmup_state()
+        return perfetto.to_chrome_trace(self.timed_events(), report, t0)
 
     def write_chrome_trace(self, path: str) -> dict:
         from . import perfetto
 
-        return perfetto.write(path, self.timed_events())
+        report, t0 = self._warmup_state()
+        return perfetto.write(path, self.timed_events(), report, t0)
 
     def latency_summary(self) -> dict:
         """p50/p99 of the dispatch->materialize device latency plus the

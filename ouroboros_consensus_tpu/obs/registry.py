@@ -98,31 +98,50 @@ class Histogram:
         self.uppers = ups
         self.counts = np.zeros(ups.size + 1, np.int64)
         self.sum = 0.0
+        self.dropped_nonfinite = 0
 
     @property
     def count(self) -> int:
         return int(self.counts.sum())
 
     def observe(self, value: float) -> None:
+        v = float(value)
+        if not np.isfinite(v):
+            # a NaN/inf observation (a timing bug, a poisoned column)
+            # would poison `sum` forever and leak NaN into every JSON
+            # snapshot — including the bench round file, which must stay
+            # strict-JSON. Drop it, but keep the drop countable.
+            with self._lock:
+                self.dropped_nonfinite += 1
+            return
         with self._lock:
-            self.counts[int(np.searchsorted(self.uppers, value))] += 1
-            self.sum += float(value)
+            self.counts[int(np.searchsorted(self.uppers, v))] += 1
+            self.sum += v
 
     def observe_many(self, values) -> None:
         """Vectorized observe of a whole column (one searchsorted + one
-        bincount — no per-value Python)."""
+        bincount — no per-value Python). Non-finite entries are dropped
+        (and counted) like `observe` does."""
         a = np.asarray(values, np.float64).ravel()
         if a.size == 0:
             return
+        finite = np.isfinite(a)
+        n_bad = int(a.size - finite.sum())
+        if n_bad:
+            a = a[finite]
         idx = np.searchsorted(self.uppers, a)
         with self._lock:
-            self.counts += np.bincount(idx, minlength=self.counts.size)
-            self.sum += float(a.sum())
+            self.dropped_nonfinite += n_bad
+            if a.size:
+                self.counts += np.bincount(idx, minlength=self.counts.size)
+                self.sum += float(a.sum())
 
     def quantile(self, q: float) -> float | None:
         """Bucket-interpolated quantile (the Prometheus histogram_quantile
-        estimate). None when empty; the +Inf bucket clamps to the last
-        finite bound."""
+        estimate). None when empty — NEVER NaN: a NaN here would ride
+        the p50/p99 fields of `snapshot()` into the bench round JSON
+        and break strict-JSON consumers. The +Inf bucket clamps to the
+        last finite bound."""
         total = self.count
         if total == 0:
             return None
@@ -137,7 +156,8 @@ class Histogram:
         in_bucket = int(self.counts[i])
         if in_bucket == 0:
             return hi
-        return lo + (hi - lo) * (rank - below) / in_bucket
+        v = lo + (hi - lo) * (rank - below) / in_bucket
+        return v if np.isfinite(v) else None
 
 
 _TYPES = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
@@ -285,6 +305,8 @@ class MetricsRegistry:
                         "labels": labels,
                         "count": child.count,
                         "sum": child.sum,
+                        **({"dropped_nonfinite": child.dropped_nonfinite}
+                           if child.dropped_nonfinite else {}),
                         "buckets": {
                             _fmt(float(u)): int(c)
                             for u, c in zip(child.uppers, child.counts)

@@ -462,6 +462,21 @@ def _begin_first_exec(stage: str) -> None:
     WARMUP.note(f"{stage} first execute starting")
 
 
+def _capture_resources(stage, fn, args, b, kes_depth, via) -> None:
+    """Per-stage device resource accounting (obs/resources.py): the AOT
+    executable's analyses are free; the jit path pays one re-lower
+    (a trace, no XLA compile) — and only while capture is enabled
+    (OCT_STAGE_RESOURCES / an installed flight recorder). Callers gate
+    this on the stage's FIRST execute and call it AFTER the warmup
+    note, so a kill mid-capture can never eat the compile-wall
+    forensics (the note is already flushed)."""
+    from ...obs import resources as obs_resources
+
+    obs_resources.capture_stage(
+        stage, fn, args, lanes=b, depth=kes_depth, via=via
+    )
+
+
 def _jit1(key, fn):
     if key not in _SPLIT_JIT:
         _SPLIT_JIT[key] = jax.jit(fn)
@@ -494,9 +509,13 @@ def _stage_call(name, fn, b, kes_depth, *args):
                     # stay async (the dispatch pipeline depends on it)
                     jax.block_until_ready(out)
                     _AOT_WARM.add(key)
-                    _note_first_exec(
-                        f"{name}@b{b}", time.monotonic() - t0, "aot"
-                    )
+                    wall = time.monotonic() - t0
+                    first = f"{name}@b{b}" not in _FIRST_EXEC
+                    _note_first_exec(f"{name}@b{b}", wall, "aot")
+                    if first:
+                        _capture_resources(
+                            f"{name}@b{b}", ex, args, b, kes_depth, "aot"
+                        )
                 return out
             except Exception as e:  # noqa: BLE001 — fail-soft by contract
                 import sys
@@ -509,10 +528,14 @@ def _stage_call(name, fn, b, kes_depth, *args):
                 # first-execute — the one aot outcome load() cannot see
                 aot._note_aot(name, "run_failed", detail=repr(e))
                 aot._LOADED[key] = None
-    _begin_first_exec(f"{name}@b{b}")
+    stage = f"{name}@b{b}"
+    first = stage not in _FIRST_EXEC
+    _begin_first_exec(stage)
     t0 = time.monotonic()
     out = fn(*args)
-    _note_first_exec(f"{name}@b{b}", time.monotonic() - t0, "jit")
+    _note_first_exec(stage, time.monotonic() - t0, "jit")
+    if first:
+        _capture_resources(stage, fn, args, b, kes_depth, "jit")
     return out
 
 

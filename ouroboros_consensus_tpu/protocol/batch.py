@@ -633,11 +633,26 @@ def _warm_timed(stage: str, fn):
         WARMUP.note(f"{stage} first execute starting")
         t0 = time.monotonic()
         out = fn(*a, **k)
+        wall = time.monotonic() - t0
         _WARM_SEEN.add(stage)
         from ..analysis import costmodel
 
-        WARMUP.note_stage(stage, time.monotonic() - t0, via="xla-jit",
+        WARMUP.note_stage(stage, wall, via="xla-jit",
                           feature_hash=costmodel.stage_feature_hash(stage))
+        # device resource accounting rides the same first-execute gate:
+        # one re-lower (trace only, no XLA compile) while capture is
+        # enabled — lanes read off the leading batch axis. AFTER the
+        # warmup note by design: a kill mid-capture must not eat the
+        # already-flushed compile-wall forensics.
+        from ..obs import resources as obs_resources
+
+        lanes = next(
+            (int(x.shape[0]) for x in a
+             if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1),
+            None,
+        )
+        obs_resources.capture_stage(stage, fn, a, lanes=lanes,
+                                    via="xla-jit")
         return out
 
     return wrapper

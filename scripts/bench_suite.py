@@ -44,6 +44,22 @@ def _emit(config: int, metric: str, n: int, device_s: float, baseline_s: float |
     if extra:
         row.update(extra)
     print(json.dumps(row))
+    # one run-ledger record per config run (obs/ledger.py): the row plus
+    # git/build/env provenance, with the heavyweight obs blocks split
+    # into their dedicated record sections. record_replay folds in the
+    # warmup + per-stage device-resource ledgers the row doesn't carry.
+    try:
+        from ouroboros_consensus_tpu.obs import ledger
+
+        big = ("warmup_report", "metrics", "metrics_summary")
+        ledger.record_replay(
+            "bench_suite",
+            config={"config": config, "n": n},
+            result={k: v for k, v in row.items() if k not in big},
+            **{k: row[k] for k in big if k in row},
+        )
+    except Exception:  # noqa: BLE001 — the ledger never breaks the suite
+        pass
     return row
 
 

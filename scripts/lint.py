@@ -14,6 +14,10 @@ ratcheted.
     python scripts/lint.py --update-certified # re-pin certification
     python scripts/lint.py --update-costs     # re-pin compile-cost features
                                               #   + compile_wall ceilings
+    python scripts/lint.py --update-resources # re-measure + re-pin the
+                                              #   device_resources section
+                                              #   (lowers AND COMPILES every
+                                              #   registry graph — slow)
 
 Exit 0 = no NEW AST findings (anything in analysis/baseline.json is
 grandfathered), every registered kernel graph within its
@@ -28,10 +32,15 @@ under its budgets.json "compile_wall" ceiling. Nonzero exits mirror
 2 = registry drift (a REGISTRY/aux entry without a shapes.json spec or
 source mapping — gate misconfiguration, checked before anything
 traces), 3 = budget violation(s), 4 = certification ratchet
-violation(s), 5 = compile-wall ratchet violation(s). The ratchet files
-only ever shrink in normal operation — fixing a grandfathered finding
-makes its key stale, and the gate prints a reminder to re-run the
-matching --update flag so the ratchet tightens.
+violation(s), 5 = compile-wall ratchet violation(s), 6 = device-resource
+ratchet violation(s) (budgets.json "device_resources": a registry graph
+without a pin, a pin whose octwall feature hash no longer matches the
+traced structure, or a pinned FLOP/byte/peak-HBM value over its
+ceiling — obs/resources.check_device_resources; the check is dict
+compares only, the compiles run solely under --update-resources). The
+ratchet files only ever shrink in normal operation — fixing a
+grandfathered finding makes its key stale, and the gate prints a
+reminder to re-run the matching --update flag so the ratchet tightens.
 
 One trace per graph feeds all four jaxpr passes: the gate traces each
 graph at its fast-sweep lane count (production 8192 for the
@@ -62,6 +71,13 @@ BASELINE = os.path.join(
 # wall), so it is mapped into the fast path explicitly.
 _MACHINERY_PREFIX = "ouroboros_consensus_tpu/analysis/"
 _MACHINERY_FILES = {"scripts/fit_costmodel.py"}
+# observability sources: an obs/ (or trajectory-report) edit cannot
+# change any crypto graph, but it CAN leak telemetry into the traced
+# programs — map these into the instrumentation-purity re-trace so an
+# obs diff re-runs the zero-eqn differential instead of skipping every
+# graph pass
+_OBS_PREFIX = "ouroboros_consensus_tpu/obs/"
+_OBS_FILES = {"scripts/perf_report.py"}
 
 
 def _changed_files() -> set[str]:
@@ -98,6 +114,11 @@ def _select_graphs(changed: set[str]) -> list[str] | None:
         n for n in absint.certifiable_graphs()
         if changed & set(sources.get(n, []))
     ]
+    if any(f.startswith(_OBS_PREFIX) or f in _OBS_FILES for f in changed):
+        purity = graphs.load_budgets().get(
+            "instrumentation_purity", {}
+        ).get("graphs", [])
+        names.extend(n for n in purity if n not in names)
     return names
 
 
@@ -139,6 +160,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--update-costs", action="store_true",
                     help="re-pin costmodel.json graph features and the "
                          "budgets.json compile_wall ceilings")
+    ap.add_argument("--update-resources", action="store_true",
+                    help="re-measure (lower + COMPILE every registry "
+                         "graph — slow) and re-pin the budgets.json "
+                         "device_resources section; missing ceilings "
+                         "are created, existing ones preserved")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -175,6 +201,7 @@ def main(argv: list[str] | None = None) -> int:
     budget_violations: list[str] = []
     cert_violations: list[str] = []
     cost_violations: list[str] = []
+    resource_violations: list[str] = []
     reports: list[graphs.GraphReport] = []
     cert_reports = []
     cost_features = []
@@ -265,6 +292,36 @@ def main(argv: list[str] | None = None) -> int:
             print(f"costmodel.json pins updated: "
                   f"{len(cost_features)} graph(s)")
             return 0
+        if args.update_resources:
+            if names is not None:
+                print("--update-resources requires the full sweep "
+                      "(drop --changed)")
+                return 2
+            from ouroboros_consensus_tpu.obs import resources as obs_res
+
+            measurements = {}
+            hashes = {f.name: f.hash() for f in cost_features}
+            for f in cost_features:
+                lanes = absint.sweep_lanes(f.name, "fast", shapes)[0]
+                print(f"# measuring {f.name}"
+                      f"@{lanes if lanes is not None else 'tile'} "
+                      "(lower + compile)...", flush=True)
+                measurements[f.name] = obs_res.measure_graph(
+                    f.name, lanes, compile=True
+                )
+            path = graphs._BUDGET_PATH
+            with open(path, encoding="utf-8") as fh:
+                budgets_doc = json.load(fh)
+            obs_res.update_budgets_section(
+                budgets_doc, measurements, hashes,
+                measured_at=obs_res.measured_at_string(),
+            )
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(budgets_doc, fh, indent=2)
+                fh.write("\n")
+            print(f"device_resources pins updated: "
+                  f"{len(measurements)} graph(s)")
+            return 0
         cert_violations = absint.check_certified(cert_reports)
         cost_violations = costmodel.check_compile_wall(
             cost_features, budgets
@@ -272,6 +329,13 @@ def main(argv: list[str] | None = None) -> int:
         # pin freshness: stale pins would stamp warmup stage notes with
         # an old structure's hash and mis-join calibration walls
         cost_violations += costmodel.check_pins(cost_features)
+        # sixth ratchet: device-resource pins (hash-freshness + ceiling
+        # compares only — no lowering, no compiling)
+        from ouroboros_consensus_tpu.obs import resources as obs_res
+
+        resource_violations = obs_res.check_device_resources(
+            cost_features, budgets
+        )
 
     if args.json:
         print(json.dumps({
@@ -280,13 +344,14 @@ def main(argv: list[str] | None = None) -> int:
             "budget_violations": budget_violations,
             "certification_violations": cert_violations,
             "cost_violations": cost_violations,
+            "resource_violations": resource_violations,
             "graphs": [r.to_dict() for r in reports],
             "certified": [r.to_dict() for r in cert_reports],
             "cost_features": [f.to_dict() | {"name": f.name}
                               for f in cost_features],
             "changed_selection": names,
             "ok": not (new or budget_violations or cert_violations
-                       or cost_violations),
+                       or cost_violations or resource_violations),
         }, indent=2, sort_keys=True))
     else:
         for f in new:
@@ -297,6 +362,8 @@ def main(argv: list[str] | None = None) -> int:
             print(f"CERTIFIED: {v}")
         for v in cost_violations:
             print(f"COST: {v}")
+        for v in resource_violations:
+            print(f"RESOURCES: {v}")
         for k in stale:
             print(f"note: baseline entry no longer fires "
                   f"(run --update-baseline to ratchet): {k}")
@@ -308,6 +375,7 @@ def main(argv: list[str] | None = None) -> int:
             f"{len(budget_violations)} budget violation(s), "
             f"{len(cert_violations)} certification violation(s), "
             f"{len(cost_violations)} compile-wall violation(s), "
+            f"{len(resource_violations)} device-resource violation(s), "
             f"{len(stale)} stale baseline entr(y/ies)"
         )
     if new:
@@ -316,7 +384,9 @@ def main(argv: list[str] | None = None) -> int:
         return 3
     if cert_violations:
         return 4
-    return 5 if cost_violations else 0
+    if cost_violations:
+        return 5
+    return 6 if resource_violations else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,437 @@
+#!/usr/bin/env python
+"""Cross-round trajectory report: fold the run ledger plus every
+BENCH_r*.json / MULTICHIP_r*.json into one markdown + JSON document
+with explicit regression verdicts.
+
+The single biggest fact about five rounds of benchmarking — r01 banked
+3,986 headers/s on device, r02–r05 banked nothing — lived only in the
+heads of people who hand-diffed the round files. This tool makes the
+trajectory a build artifact: which rounds banked a device number, what
+each dead round died of (classified from its own recorded output — the
+probe timeouts, axon-format AOT rejections and compile walls are all
+IN the tails), what the host/native ceilings did, how much warmup wall
+each round burned, how many packed-qualification gate declines and
+octwall pre-flight refusals the telemetry counted, and what env/build
+facts changed at each transition (from the obs/ledger records when a
+ledger exists).
+
+Regression verdicts are configurable and exit non-zero so a CI perf
+gate can consume this directly:
+
+    python scripts/perf_report.py                      # report, exit 0
+    python scripts/perf_report.py --threshold 0.8      # newest round
+        # must be >= 0.8x the best previous round's headers/s: exit 1
+    python scripts/perf_report.py --require-device     # newest round
+        # must have banked a DEVICE number: exit 1 otherwise
+    python scripts/perf_report.py --json out.json --out report.md
+
+Round-file schema is deliberately treated as hostile: the five
+checked-in rounds span three generations of bench.py output (r01 has
+no warmup forensics, r05 has no metrics snapshot), so every field is
+optional and classification falls back to the recorded tail text."""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# failure-mode classifiers, matched (all of them) against a dead
+# round's recorded output — order is presentation priority, the FIRST
+# match is the primary attribution
+_FAILURE_PATTERNS = (
+    ("aot-cache-rejected",
+     re.compile(r"axon format|serialized executable is incompatible",
+                re.IGNORECASE),
+     "stale AOT/persistent-cache executables rejected by the runtime"),
+    ("warmup-exceeded-wall",
+     re.compile(r"exceeded\s+\d+s?\s*budget|warmup exceed",
+                re.IGNORECASE),
+     "device attempt ran past its wall budget (compile/warmup wall)"),
+    ("backend-probe-timeout",
+     re.compile(r"probe (?:timed out|failed)", re.IGNORECASE),
+     "TPU backend probe timed out (tunnel unreachable / init hung)"),
+    ("compile-wall-refused",
+     re.compile(r"compile-wall-refused", re.IGNORECASE),
+     "octwall pre-flight refused a cold compile against the deadline"),
+)
+
+
+def _round_of(path: str, doc: dict) -> int:
+    m = re.search(r"_r(\d+)\.json$", path)
+    if m:
+        return int(m.group(1))
+    return int(doc.get("n", 0))
+
+
+def _first_float(pattern: str, text: str) -> float | None:
+    m = re.search(pattern, text)
+    return float(m.group(1)) if m else None
+
+
+def _classify_failures(text: str, rc) -> list[dict]:
+    out = []
+    for key, rx, desc in _FAILURE_PATTERNS:
+        if rx.search(text):
+            out.append({"mode": key, "detail": desc})
+    if rc not in (0, None):
+        out.append({
+            "mode": f"driver-timeout (rc={rc})",
+            "detail": "the driver killed the run before the JSON line",
+        })
+    if not out:
+        out.append({"mode": "unknown",
+                    "detail": "no recognizable failure pattern in the "
+                              "recorded output"})
+    return out
+
+
+def _gate_counts(metrics: dict | None) -> dict:
+    """{gate: count} out of a banked metrics snapshot (or {})."""
+    if not isinstance(metrics, dict):
+        return {}
+    fam = metrics.get("oct_gate_declines_total") or {}
+    out = {}
+    for s in fam.get("samples", []):
+        gate = (s.get("labels") or {}).get("gate", "?")
+        out[gate] = out.get(gate, 0) + int(s.get("value", 0))
+    return out
+
+
+def analyze_bench_round(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) else None
+    tail = str(doc.get("tail", "") or "")
+    rc = doc.get("rc")
+    metric_text = (parsed or {}).get("metric", "")
+    headers = None
+    m = re.search(r"(\d[\d_,]*)-header", metric_text)
+    if m:
+        headers = int(m.group(1).replace(",", "").replace("_", ""))
+    device_banked = bool(
+        parsed
+        and not parsed.get("device_unavailable")
+        and parsed.get("value")
+    )
+    wr = (parsed or {}).get("warmup_report")
+    warmup = None
+    if isinstance(wr, dict):
+        warmup = {
+            "compile_total_s": wr.get("compile_total_s"),
+            "n_stages": wr.get("n_stages"),
+            "aot": wr.get("aot"),
+            "refusals": len(wr.get("refusals", [])),
+            "cache_probe": (wr.get("cache_probe") or {}).get("outcome"),
+        }
+    row = {
+        "round": _round_of(path, doc),
+        "file": os.path.basename(path),
+        "rc": rc,
+        "headers": headers,
+        "device_banked": device_banked,
+        "value_per_s": (parsed or {}).get("value"),
+        "vs_baseline": (parsed or {}).get("vs_baseline"),
+        "native_baseline_per_s": _first_float(
+            r"# native baseline (\d+(?:\.\d+)?) headers/s", tail)
+            or ((parsed or {}).get("value")
+                if parsed and parsed.get("device_unavailable") else None),
+        "warmup_wall_s": _first_float(r"warmup=(\d+(?:\.\d+)?)s", tail),
+        "warmup": warmup,
+        "gate_declines": _gate_counts((parsed or {}).get("metrics")),
+        "failures": ([] if device_banked
+                     else _classify_failures(tail, rc)),
+    }
+    return row
+
+
+def analyze_multichip_round(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    tail = str(doc.get("tail", "") or "")
+    rate = _first_float(r"\((\d+(?:\.\d+)?) headers/s", tail)
+    return {
+        "round": _round_of(path, doc),
+        "file": os.path.basename(path),
+        "ok": bool(doc.get("ok")),
+        "skipped": bool(doc.get("skipped")),
+        "n_devices": doc.get("n_devices"),
+        "rate_per_s": rate,
+        "failures": ([] if doc.get("ok")
+                     else _classify_failures(tail, doc.get("rc"))),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Ledger fold: what actually changed between runs
+# ---------------------------------------------------------------------------
+
+
+def _env_diff(prev: dict, cur: dict) -> dict:
+    """{key: [old, new]} over the banked OCT_*/BENCH_* env snapshots."""
+    keys = set(prev) | set(cur)
+    return {
+        k: [prev.get(k), cur.get(k)]
+        for k in sorted(keys) if prev.get(k) != cur.get(k)
+    }
+
+
+def ledger_section(ledger_dir: str | None) -> dict | None:
+    from ouroboros_consensus_tpu.obs import ledger
+
+    runs = ledger.read_runs(ledger_dir, kind=None)
+    if not runs:
+        return None
+    bench_runs = [r for r in runs if r.get("kind") == "bench"]
+    transitions = []
+    for prev, cur in zip(bench_runs, bench_runs[1:]):
+        delta: dict = {}
+        if (prev.get("git") or {}).get("rev") != (cur.get("git") or {}).get("rev"):
+            delta["git_rev"] = [(prev.get("git") or {}).get("rev"),
+                                (cur.get("git") or {}).get("rev")]
+        if prev.get("build_id") != cur.get("build_id"):
+            delta["build_id"] = [prev.get("build_id"), cur.get("build_id")]
+        env = _env_diff(prev.get("env") or {}, cur.get("env") or {})
+        if env:
+            delta["env"] = env
+        transitions.append({
+            "from_ts": prev.get("ts_iso"), "to_ts": cur.get("ts_iso"),
+            "changed": delta,
+        })
+    kinds: dict = {}
+    for r in runs:
+        kinds[r.get("kind", "?")] = kinds.get(r.get("kind", "?"), 0) + 1
+    return {
+        "runs": len(runs),
+        "by_kind": kinds,
+        "bench_transitions": transitions,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Verdicts
+# ---------------------------------------------------------------------------
+
+
+def regression_verdicts(rounds: list[dict], threshold: float | None,
+                        require_device: bool) -> list[dict]:
+    """Explicit, configurable verdicts; any verdict with ok=False makes
+    the process exit non-zero (the future CI perf gate)."""
+    verdicts: list[dict] = []
+    if not rounds:
+        return [{"rule": "rounds-present", "ok": False,
+                 "detail": "no BENCH_r*.json found"}]
+    latest = rounds[-1]
+    prev = rounds[:-1]
+    if threshold is not None:
+        best_prev = max(
+            (r["value_per_s"] for r in prev if r.get("value_per_s")),
+            default=None,
+        )
+        val = latest.get("value_per_s")
+        if best_prev is None:
+            # nothing to compare against — say so EXPLICITLY instead of
+            # silently appending no verdict (a CI gate that goes green
+            # without evaluating anything is the failure shape this
+            # tool exists to kill). Not a regression: there is no prior
+            # bar to fall below; pair with --require-device to gate on
+            # banking itself.
+            verdicts.append({
+                "rule": f"latest >= {threshold:g} x best-previous",
+                "ok": True,
+                "detail": (
+                    "no previous round banked a measurable headers/s — "
+                    "threshold rule has nothing to compare (pair with "
+                    "--require-device to gate on banking)"
+                ),
+            })
+        elif val:
+            ratio = val / best_prev
+            verdicts.append({
+                "rule": f"latest >= {threshold:g} x best-previous",
+                "ok": ratio >= threshold,
+                "detail": (
+                    f"r{latest['round']:02d} banked {val:g} headers/s vs "
+                    f"best previous {best_prev:g} (ratio {ratio:.2f})"
+                ),
+            })
+        else:
+            # the worst regression of all: the newest round produced NO
+            # measurable number (driver kill before the JSON line). A
+            # threshold gate that silently passes here would wave the
+            # r02 failure shape through CI.
+            verdicts.append({
+                "rule": f"latest >= {threshold:g} x best-previous",
+                "ok": False,
+                "detail": (
+                    f"r{latest['round']:02d} banked no measurable "
+                    f"headers/s at all (best previous {best_prev:g}): "
+                    + ", ".join(f["mode"]
+                                for f in latest.get("failures", []))
+                ),
+            })
+    if require_device:
+        verdicts.append({
+            "rule": "latest-round-banks-device",
+            "ok": bool(latest.get("device_banked")),
+            "detail": (
+                f"r{latest['round']:02d} "
+                + ("banked a device result" if latest.get("device_banked")
+                   else "banked NO device result: "
+                   + ", ".join(f["mode"] for f in latest.get("failures", [])))
+            ),
+        })
+    return verdicts
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def _md_escape(v) -> str:
+    return str(v).replace("|", "\\|")
+
+
+def render_markdown(report: dict) -> str:
+    out = ["# Benchmark trajectory", ""]
+    rounds = report["bench_rounds"]
+    device_rounds = [r for r in rounds if r["device_banked"]]
+    out.append(
+        f"{len(rounds)} bench round(s); "
+        f"{len(device_rounds)} banked a device result"
+        + (" (" + ", ".join(f"r{r['round']:02d}" for r in device_rounds)
+           + ")" if device_rounds else "")
+        + "."
+    )
+    out += ["", "## Rounds", ""]
+    out.append("| round | headers | device | headers/s | vs native | "
+               "native/s | warmup s | declines | failure modes |")
+    out.append("|---|---|---|---|---|---|---|---|---|")
+    for r in rounds:
+        declines = sum(r["gate_declines"].values()) or ""
+        warm = r.get("warmup_wall_s")
+        if warm is None and r.get("warmup"):
+            warm = r["warmup"].get("compile_total_s")
+        out.append("| r{:02d} | {} | {} | {} | {} | {} | {} | {} | {} |".format(
+            r["round"],
+            r["headers"] or "?",
+            "YES" if r["device_banked"] else "no",
+            r["value_per_s"] if r["device_banked"] else "—",
+            r["vs_baseline"] if r["device_banked"] else "—",
+            r["native_baseline_per_s"] or "?",
+            warm if warm is not None else "?",
+            declines,
+            _md_escape(", ".join(f["mode"] for f in r["failures"]) or "—"),
+        ))
+    dead = [r for r in rounds if not r["device_banked"]]
+    if dead:
+        out += ["", "## Failure attribution", ""]
+        for r in dead:
+            modes = "; ".join(
+                f"**{f['mode']}** ({f['detail']})" for f in r["failures"]
+            )
+            out.append(f"* r{r['round']:02d}: {modes}")
+    mc = report.get("multichip_rounds") or []
+    if mc:
+        out += ["", "## Multichip", ""]
+        out.append("| round | devices | ok | headers/s | failure |")
+        out.append("|---|---|---|---|---|")
+        for r in mc:
+            out.append("| r{:02d} | {} | {} | {} | {} |".format(
+                r["round"], r.get("n_devices", "?"),
+                "ok" if r["ok"] else ("skipped" if r["skipped"] else "FAIL"),
+                r.get("rate_per_s") or "—",
+                _md_escape(", ".join(f["mode"] for f in r["failures"]) or "—"),
+            ))
+    led = report.get("ledger")
+    if led:
+        out += ["", "## Run ledger", ""]
+        out.append(f"{led['runs']} ledger run(s): " + ", ".join(
+            f"{k}={v}" for k, v in sorted(led["by_kind"].items())))
+        for t in led["bench_transitions"]:
+            if t["changed"]:
+                out.append(
+                    f"* {t['from_ts']} → {t['to_ts']}: "
+                    + "; ".join(f"{k} {v}" for k, v in t["changed"].items())
+                )
+    out += ["", "## Verdicts", ""]
+    if not report["verdicts"]:
+        out.append("(no regression rules configured — report only)")
+    for v in report["verdicts"]:
+        out.append(f"* {'OK ' if v['ok'] else 'REGRESSION'} "
+                   f"[{v['rule']}]: {v['detail']}")
+    return "\n".join(out) + "\n"
+
+
+def build_report(dir_: str, threshold: float | None,
+                 require_device: bool, ledger_dir: str | None) -> dict:
+    bench_rounds = sorted(
+        (analyze_bench_round(p)
+         for p in glob.glob(os.path.join(dir_, "BENCH_r*.json"))),
+        key=lambda r: r["round"],
+    )
+    multichip = sorted(
+        (analyze_multichip_round(p)
+         for p in glob.glob(os.path.join(dir_, "MULTICHIP_r*.json"))),
+        key=lambda r: r["round"],
+    )
+    led = None
+    if ledger_dir != "0":
+        try:
+            led = ledger_section(ledger_dir)
+        except Exception:  # noqa: BLE001 — a broken ledger never kills the report
+            led = None
+    verdicts = regression_verdicts(bench_rounds, threshold, require_device)
+    return {
+        "bench_rounds": bench_rounds,
+        "multichip_rounds": multichip,
+        "ledger": led,
+        "verdicts": verdicts,
+        "ok": all(v["ok"] for v in verdicts),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=REPO,
+                    help="where the BENCH_r*.json round files live")
+    ap.add_argument("--ledger", default=None,
+                    help="run-ledger dir (default: the repo ledger; "
+                         "pass 0 to skip)")
+    ap.add_argument("--out", default=None, help="write markdown here "
+                    "(default: stdout)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the JSON report here")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="regression rule: newest round's headers/s "
+                         "must be >= THRESHOLD x the best previous "
+                         "round's (exit 1 otherwise)")
+    ap.add_argument("--require-device", action="store_true",
+                    help="regression rule: newest round must have "
+                         "banked a device result")
+    args = ap.parse_args(argv)
+
+    report = build_report(args.dir, args.threshold, args.require_device,
+                          args.ledger)
+    md = render_markdown(report)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(md)
+    else:
+        sys.stdout.write(md)
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

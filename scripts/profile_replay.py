@@ -139,6 +139,25 @@ def host_ceiling():
                             ("prechecks", t_pre), ("stage", t_stage)):
             print(f"  {label:12s} {secs:8.2f}s  {secs/nh*1e6:7.2f} us/header")
         print(f"  windows: {nwin} ({npacked} packed)")
+    # one run-ledger record per invocation (obs/ledger.py): the hot
+    # attempt's ceiling + phase walls, with full env/git provenance
+    from ouroboros_consensus_tpu.obs import ledger
+
+    ledger.record_replay(
+        "profile_replay",
+        recorder=obs.recorder() if traced else None,
+        config={"n": N, "mode": "host", "columnar": columnar,
+                "traced": traced},
+        result={
+            "headers": nh, "host_s": round(host_s, 3),
+            "ceiling_per_s": round(nh / host_s, 1),
+            "windows": nwin, "packed_windows": npacked,
+        },
+        wall_s=wall,
+        phases_s={"view-stream": round(t_stream, 3),
+                  "prechecks": round(t_pre, 3),
+                  "stage": round(t_stage, 3)},
+    )
 
 
 def main():
@@ -240,6 +259,24 @@ def main():
                   f"{'' if not errs else f', INVALID: {errs[:3]}'})")
         obs.uninstall()
     pbatch.set_batch_tracer(None)
+    # one run-ledger record per invocation: the hot replay's rate, phase
+    # walls and boundary bytes, plus the warmup/resource ledgers
+    from ouroboros_consensus_tpu.obs import ledger
+
+    nwin = xfer["packed"] + xfer["generic"]
+    ledger.record_replay(
+        "profile_replay",
+        recorder=rec,
+        config={"n": N, "mode": "device", "platform": dev.platform},
+        result={
+            "headers": r.n_valid, "wall_s": round(wall, 3),
+            "rate_per_s": round(r.n_valid / wall, 1),
+            "windows": nwin, "packed_windows": xfer["packed"],
+            "h2d_bytes": int(xfer["h2d"]), "d2h_bytes": int(xfer["d2h"]),
+        },
+        wall_s=wall,
+        phases_s={k: round(v, 3) for k, v in sorted(tot.items())},
+    )
 
 
 if __name__ == "__main__":

@@ -649,3 +649,350 @@ def test_metrics_http_endpoint():
         await server.wait_closed()
 
     asyncio.run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# histogram hardening: non-finite observations must never leak NaN into
+# JSON snapshots (and through them the bench round file)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_nonfinite_observations_dropped_and_counted():
+    reg = MetricsRegistry()
+    h = reg.histogram("oct_nan_seconds", "hardening", buckets=(1.0, 10.0))
+    # empty histogram: None, never NaN (regression for the quantile
+    # contract the bench round file depends on)
+    assert h.quantile(0.5) is None
+    assert h.quantile(0.99) is None
+    h.observe(float("nan"))
+    h.observe(float("inf"))
+    h.observe_many([1.0, float("nan"), 2.0, float("-inf")])
+    assert h.count == 2
+    assert h.dropped_nonfinite == 4
+    assert h.sum == pytest.approx(3.0)  # NaN never poisoned the sum
+    snap = reg.snapshot()
+    # the whole snapshot stays STRICT json — json.dumps(allow_nan=False)
+    # is exactly what obs/ledger.append enforces
+    json.dumps(snap, allow_nan=False)
+    row = snap["oct_nan_seconds"]["samples"][0]
+    assert row["dropped_nonfinite"] == 4
+    assert row["p50"] is not None and row["p99"] is not None
+    # exposition still renders (finite values only)
+    assert "oct_nan_seconds_count 2" in reg.expose_text()
+
+
+def test_latency_summary_none_not_nan_on_empty_recorder():
+    rec = obs.recorder()
+    s = rec.latency_summary()
+    assert s["windows"] == 0
+    assert s["device_latency_p50_s"] is None
+    assert s["device_latency_p99_s"] is None
+    json.dumps(s, allow_nan=False)
+
+
+# ---------------------------------------------------------------------------
+# metric-name drift gate: obs/README.md vs the registrations, both ways
+# ---------------------------------------------------------------------------
+
+
+def _readme_metric_names():
+    import re
+
+    readme = os.path.join(
+        os.path.dirname(os.path.abspath(obs.__file__)), "README.md"
+    )
+    with open(readme, encoding="utf-8") as f:
+        text = f.read()
+    concrete, wildcards = set(), set()
+    # tokens like oct_windows_total, oct_window_{a,b}_seconds{label=},
+    # oct_node_*_total; the lookbehind keeps ".oct_ledger" (a path, not
+    # a metric) out
+    for tok in re.findall(r"(?<![.\w])oct_[a-z0-9_]+(?:\{[^}\s]*\})?"
+                          r"[a-z0-9_*]*", text):
+        # strip a trailing label annotation: {kind=} / {stage=,kind=}
+        tok = re.sub(r"\{[^}]*=[^}]*\}", "", tok)
+        m = re.match(r"^([a-z0-9_]*)\{([a-z0-9_,]+)\}([a-z0-9_]*)$", tok)
+        if m:  # brace EXPANSION: oct_window_{stage,dispatch}_seconds
+            for alt in m.group(2).split(","):
+                concrete.add(m.group(1) + alt + m.group(3))
+        elif "*" in tok:
+            wildcards.add(tok)
+        elif re.fullmatch(r"oct_[a-z0-9_]+", tok):
+            concrete.add(tok)
+    return concrete, wildcards
+
+
+def _registered_metric_names():
+    import re
+
+    from ouroboros_consensus_tpu.obs import resources as obs_resources
+    from ouroboros_consensus_tpu.obs.recorder import FlightRecorder
+    from ouroboros_consensus_tpu.tools import immdb_server
+
+    reg = MetricsRegistry()
+    FlightRecorder(reg)
+    NodeMetrics().bind(reg)
+    obs_resources.register_families(reg)
+    names = set(reg._families)
+    # the immdb server registers its families at serve time: hold it to
+    # the same contract via its registration literals
+    with open(immdb_server.__file__, encoding="utf-8") as f:
+        names |= set(re.findall(r'"(oct_[a-z0-9_]+)"', f.read()))
+    return names
+
+
+def test_readme_metric_names_match_registrations():
+    """Both directions: the README's metric table cannot rot as families
+    are added (this PR adds oct_stage_*), and no documented family may
+    silently disappear from the code."""
+    import fnmatch
+
+    concrete, wildcards = _readme_metric_names()
+    actual = _registered_metric_names()
+    assert concrete, "README metric table parsed empty — parser broken?"
+
+    documented_missing = {
+        n for n in concrete if n not in actual
+    } | {
+        w for w in wildcards
+        if not any(fnmatch.fnmatch(a, w) for a in actual)
+    }
+    assert not documented_missing, (
+        f"obs/README.md documents families the code never registers: "
+        f"{sorted(documented_missing)}"
+    )
+    undocumented = {
+        a for a in actual
+        if a not in concrete
+        and not any(fnmatch.fnmatch(a, w) for w in wildcards)
+    }
+    assert not undocumented, (
+        f"registered families missing from obs/README.md: "
+        f"{sorted(undocumented)}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the PR 8 compile-wall-refused telemetry path, end to end
+# ---------------------------------------------------------------------------
+
+
+def test_compile_wall_refusal_is_visible_telemetry(monkeypatch):
+    """A real dispatch_batch window whose aggregate program is refused
+    by the octwall pre-flight (stubbed clock via OCT_WALL_DEADLINE):
+    the refusal must be VISIBLE — a packed WindowStaged carrying
+    gate="compile-wall-refused", an
+    oct_gate_declines_total{gate="compile-wall-refused"} increment, and
+    an entry in the warmup report's refusals list."""
+    import time as _time
+
+    from ouroboros_consensus_tpu.analysis import costmodel
+    from ouroboros_consensus_tpu.obs.warmup import WARMUP
+    from ouroboros_consensus_tpu.testing import fixtures as _fx
+
+    from tests.test_aggregate import (
+        _stub_verdicts, make_params as agg_params, real_chain,
+    )
+
+    pools2 = [_fx.make_pool(50 + i, kes_depth=3) for i in range(2)]
+    lview2 = fixtures.make_ledger_view(pools2)
+    params = agg_params()
+    nonce, hvs = real_chain(params, pools2, lview2, 8)
+    assert len(hvs[0].vrf_proof) == 128  # batch-compatible window
+
+    WARMUP.reset()
+    monkeypatch.delenv("OCT_VRF_AGG", raising=False)
+    # stubbed clock: 40 s of wall left vs a 500 s predicted aggregate
+    # compile, with the per-lane fallback predicted 10x cheaper
+    monkeypatch.setenv("OCT_WALL_DEADLINE", str(_time.time() + 40.0))
+    monkeypatch.setattr(
+        costmodel, "predicted_wall",
+        lambda g: 500.0 if g == "aggregate_core" else 50.0,
+    )
+    monkeypatch.setattr(pbatch, "verify_praos_any",
+                        lambda *cols: _stub_verdicts(cols))
+    monkeypatch.setattr(
+        pbatch, "_jitted_packed_agg",
+        lambda layout, scan: pytest.fail(
+            "refused aggregate program was still dispatched"),
+    )
+    before = set(pbatch._JIT)
+    rec = obs.install()
+    try:
+        _pre, disp, b, _carry = pbatch.dispatch_batch(
+            params, lview2, nonce, hvs
+        )
+    finally:
+        obs.uninstall()
+        for k in set(pbatch._JIT) - before:
+            del pbatch._JIT[k]
+    assert b == len(hvs) and disp.impl != "agg"
+
+    staged = _of([e for _t, e in rec.timed_events()], T.WindowStaged)
+    assert staged, "dispatch_batch must emit WindowStaged"
+    assert staged[-1].outcome == "packed"  # still packed — off-agg path
+    assert staged[-1].gate == "compile-wall-refused"
+
+    snap = rec.registry.snapshot()
+    gates = {
+        s["labels"]["gate"]: s["value"]
+        for s in snap["oct_gate_declines_total"]["samples"]
+    }
+    assert gates.get("compile-wall-refused") == 1
+    outcomes = {
+        s["labels"]["outcome"]: s["value"]
+        for s in snap["oct_windows_total"]["samples"]
+    }
+    assert outcomes.get("packed") == 1
+
+    refs = WARMUP.report()["refusals"]
+    assert len(refs) == 1
+    assert refs[0]["stage"].startswith("agg-packed:")
+    assert refs[0]["predicted_s"] == pytest.approx(500.0)
+    WARMUP.reset()
+
+
+# ---------------------------------------------------------------------------
+# Perfetto warmup track (compile walls visible in the wall visualizer)
+# ---------------------------------------------------------------------------
+
+
+def test_perfetto_warmup_track_slices_and_instants():
+    from ouroboros_consensus_tpu.obs.warmup import WARMUP
+
+    WARMUP.reset()
+    WARMUP.note_stage("agg-packed:410b:scan", 12.5, via="xla-jit",
+                      feature_hash="216e9c5e109f6aa6")
+    WARMUP.note_aot("ed", "rejected", 1.0, "axon format v5")
+    WARMUP.note_refusal("xla-packed:410b:p128:scan", 410.0, 90.0,
+                        "stage-split-fallback")
+    rec = obs.recorder()
+    doc = rec.chrome_trace()
+    assert perfetto.validate_chrome_trace(doc) == []
+    evs = doc["traceEvents"]
+    names = [e["name"] for e in evs]
+    # thread metadata names the warmup row
+    threads = {e["args"]["name"] for e in evs if e["ph"] == "M"
+               and e["name"] == "thread_name"}
+    assert "warmup" in threads
+    (slice_ev,) = [e for e in evs if "first-execute" in e["name"]]
+    assert slice_ev["ph"] == "X"
+    assert slice_ev["dur"] == pytest.approx(12.5e6, rel=1e-6)
+    assert slice_ev["tid"] == perfetto._TIDS["warmup"]
+    assert slice_ev["args"]["via"] == "xla-jit"
+    assert slice_ev["args"]["feature_hash"] == "216e9c5e109f6aa6"
+    assert any(n == "aot ed: rejected" for n in names)
+    assert any(n.startswith("compile-wall refused:") for n in names)
+    # a report WITHOUT its t0 (cross-process file) adds no warmup rows
+    doc2 = perfetto.to_chrome_trace([], warmup_report=WARMUP.report(),
+                                    warmup_t0=None)
+    assert not any("first-execute" in e["name"]
+                   for e in doc2["traceEvents"])
+    WARMUP.reset()
+
+
+def test_trace_out_replay_includes_warmup_track(pools, lview, stubbed,
+                                                monkeypatch):
+    """The --trace-out shape: a (stubbed) replay export carries BOTH
+    window spans and the warmup first-execute slices in one document."""
+    from ouroboros_consensus_tpu.obs.warmup import WARMUP
+
+    WARMUP.reset()
+    # earlier tests in this process may have consumed the stub jits'
+    # first executes — clear the once-only gate so THIS replay notes them
+    pbatch._WARM_SEEN.clear()
+    params = make_params()
+    _, hvs = _forge_chain(params, pools, lview, 16)
+    st0 = praos.PraosState(epoch_nonce=b"\x07" * 32)
+    monkeypatch.setenv("OCT_TRACE", "1")
+    rec = obs.install()
+    try:
+        res = pbatch.validate_chain(
+            params, lambda _e: lview, st0, hvs, max_batch=8
+        )
+    finally:
+        obs.uninstall()
+    assert res.error is None
+    doc = rec.chrome_trace()
+    assert perfetto.validate_chrome_trace(doc) == []
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert any(n.startswith("window ") for n in names)
+    # the stubbed jits ARE first executes: their compile slices show up
+    assert any("first-execute" in n for n in names)
+    WARMUP.reset()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a stubbed-crypto replay appends ONE well-formed ledger
+# record carrying the recorder's state
+# ---------------------------------------------------------------------------
+
+
+def test_stubbed_replay_appends_one_ledger_record(pools, lview, stubbed,
+                                                  monkeypatch, tmp_path):
+    from ouroboros_consensus_tpu.obs import ledger
+
+    led = str(tmp_path / "ledger")
+    monkeypatch.setenv("OCT_LEDGER", led)
+    monkeypatch.setenv("OCT_TRACE", "1")
+    params = make_params()
+    _, hvs = _forge_chain(params, pools, lview, 24)
+    st0 = praos.PraosState(epoch_nonce=b"\x07" * 32)
+    rec = obs.install()
+    try:
+        res = pbatch.validate_chain(
+            params, lambda _e: lview, st0, hvs, max_batch=8
+        )
+    finally:
+        obs.uninstall()
+    assert res.error is None and res.n_valid == 24
+    out = ledger.record_replay(
+        "replay", recorder=rec,
+        config={"n": 24, "max_batch": 8},
+        result={"headers": res.n_valid},
+    )
+    assert out is not None
+    runs = ledger.read_runs(led)
+    assert len(runs) == 1, "exactly one record per run"
+    rec_d = runs[0]
+    assert ledger.validate_record(rec_d) == []
+    assert rec_d["kind"] == "replay"
+    # the recorder's state rode in: metrics snapshot + latency summary
+    assert rec_d["metrics"]["oct_headers_validated_total"][
+        "samples"][0]["value"] == 24
+    assert rec_d["metrics_summary"]["windows"] >= 3
+    assert rec_d["warmup_report"] is not None
+    assert rec_d["env"].get("OCT_TRACE") == "1"
+
+
+# ---------------------------------------------------------------------------
+# lint --changed: obs edits re-run the instrumentation-purity re-trace
+# ---------------------------------------------------------------------------
+
+
+def test_lint_changed_maps_obs_sources_to_purity_graphs():
+    """An obs/ (or perf_report) edit cannot change a crypto graph, but
+    it CAN leak telemetry into a traced program — the --changed fast
+    path must select the instrumentation-purity graphs instead of
+    skipping every graph pass."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "lint_gate", os.path.join(repo, "scripts", "lint.py")
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    purity = {"packed_unpack", "verdict_reduce"}
+    assert set(lint._select_graphs(
+        {"ouroboros_consensus_tpu/obs/recorder.py"}
+    )) == purity
+    assert set(lint._select_graphs({"scripts/perf_report.py"})) == purity
+    # composes with ordinary graph-source selection
+    sel = lint._select_graphs({
+        "ouroboros_consensus_tpu/obs/ledger.py",
+        "ouroboros_consensus_tpu/ops/pk/msm.py",
+    })
+    assert set(sel) == purity | {"aggregate_core", "msm"}
+    # and still selects nothing for unrelated files
+    assert lint._select_graphs({"README.md"}) == []

@@ -1,0 +1,175 @@
+"""scripts/perf_report.py over the five CHECKED-IN bench rounds: the
+trajectory report must identify r01 as the only device-banking round,
+attribute r02–r05 to their recorded failure modes, render valid
+markdown + JSON, fold a run ledger when one exists, and exit non-zero
+under a configurable regression threshold (the future CI perf gate)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "perf_report", os.path.join(REPO, "scripts", "perf_report.py")
+)
+perf_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(perf_report)
+
+
+@pytest.fixture(scope="module")
+def report():
+    return perf_report.build_report(REPO, threshold=None,
+                                    require_device=False, ledger_dir="0")
+
+
+def test_r01_is_the_only_device_banking_round(report):
+    rounds = report["bench_rounds"]
+    assert [r["round"] for r in rounds] == [1, 2, 3, 4, 5]
+    banked = [r["round"] for r in rounds if r["device_banked"]]
+    assert banked == [1]
+    r01 = rounds[0]
+    assert r01["value_per_s"] == pytest.approx(3985.7)
+    assert r01["vs_baseline"] == pytest.approx(2.93)
+    assert r01["failures"] == []
+
+
+def test_dead_rounds_attributed_to_recorded_failure_modes(report):
+    by_round = {r["round"]: r for r in report["bench_rounds"]}
+    modes = {
+        n: {f["mode"] for f in by_round[n]["failures"]} for n in (2, 3, 4, 5)
+    }
+    # r02 died at the driver wall while the backend probe hung
+    assert "backend-probe-timeout" in modes[2]
+    assert any(m.startswith("driver-timeout") for m in modes[2])
+    # r03/r04: probe timeouts, clean fallback to the native number
+    assert modes[3] == {"backend-probe-timeout"}
+    assert modes[4] == {"backend-probe-timeout"}
+    assert by_round[3]["native_baseline_per_s"] == pytest.approx(2007.0)
+    # r05: axon-format AOT rejections + the attempt exceeding its wall
+    assert "aot-cache-rejected" in modes[5]
+    assert "warmup-exceeded-wall" in modes[5]
+    assert by_round[5]["headers"] == 1_000_000
+
+
+def test_markdown_and_json_render(report, tmp_path):
+    md = perf_report.render_markdown(report)
+    assert "r01" in md and "YES" in md
+    assert "backend-probe-timeout" in md
+    assert "aot-cache-rejected" in md
+    # JSON round-trips strictly
+    json.loads(json.dumps(report, allow_nan=False))
+    assert report["multichip_rounds"], "MULTICHIP files must fold in"
+
+
+def test_threshold_regression_verdict(report):
+    verdicts = perf_report.regression_verdicts(
+        report["bench_rounds"], threshold=0.8, require_device=False
+    )
+    (v,) = verdicts
+    assert not v["ok"]  # r05's 2484 native vs r01's 3985.7 device
+    assert "r05" in v["detail"]
+    ok = perf_report.regression_verdicts(
+        report["bench_rounds"], threshold=0.5, require_device=False
+    )
+    assert ok[0]["ok"]
+    dv = perf_report.regression_verdicts(
+        report["bench_rounds"], threshold=None, require_device=True
+    )
+    assert not dv[0]["ok"]
+    assert "banked NO device result" in dv[0]["detail"]
+
+
+def test_threshold_fails_a_round_with_no_value_at_all(report):
+    """The worst regression: the newest round produced NO measurable
+    number (the r02 shape — driver kill before the JSON line). The
+    threshold gate must fail it, not silently pass for lack of a
+    number to compare."""
+    rounds = [dict(r) for r in report["bench_rounds"]]
+    rounds.append({
+        "round": 6, "device_banked": False, "value_per_s": None,
+        "failures": [{"mode": "driver-timeout (rc=137)",
+                      "detail": "killed"}],
+    })
+    (v,) = perf_report.regression_verdicts(rounds, threshold=0.5,
+                                           require_device=False)
+    assert not v["ok"]
+    assert "no measurable" in v["detail"]
+    assert "driver-timeout" in v["detail"]
+
+
+def test_threshold_with_no_prior_value_is_explicit_not_silent():
+    """A configured threshold must always produce a verdict: with no
+    previous round banking a value (or a single round), the rule says
+    so explicitly instead of letting `all([])` go green unevaluated."""
+    dead = {"round": 1, "device_banked": False, "value_per_s": None,
+            "failures": []}
+    live = {"round": 2, "device_banked": True, "value_per_s": 100.0,
+            "failures": []}
+    for rounds in ([live], [dead, dict(live, round=2)],
+                   [dead, dict(dead, round=2)]):
+        verdicts = perf_report.regression_verdicts(
+            rounds, threshold=0.8, require_device=False
+        )
+        assert len(verdicts) == 1, rounds
+        assert verdicts[0]["ok"]
+        assert "nothing to compare" in verdicts[0]["detail"]
+
+
+def test_cli_exit_codes_and_outputs(tmp_path):
+    """The CI-gate contract: report-only exits 0; a tripped threshold
+    exits 1; --json writes a parseable document."""
+    jout = str(tmp_path / "report.json")
+    mout = str(tmp_path / "report.md")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_report.py"),
+         "--dir", REPO, "--ledger", "0", "--json", jout, "--out", mout],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert p.returncode == 0, p.stderr
+    with open(jout, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["ok"] and len(doc["bench_rounds"]) == 5
+    assert os.path.getsize(mout) > 200
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_report.py"),
+         "--dir", REPO, "--ledger", "0", "--threshold", "0.8"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert p.returncode == 1, "a tripped threshold must exit non-zero"
+    assert "REGRESSION" in p.stdout
+
+
+def test_ledger_fold_reports_env_and_build_transitions(tmp_path,
+                                                      monkeypatch):
+    """The r01→r02 question answered by the ledger: consecutive bench
+    records with different env/build facts surface as transitions."""
+    from ouroboros_consensus_tpu.obs import ledger
+
+    led = str(tmp_path / "led")
+    monkeypatch.setenv("OCT_LEDGER", led)
+    monkeypatch.setenv("OCT_VRF_AGG", "1")
+    ledger.record_run("bench", result={"value": 3985.7},
+                      build_id="pjrt-v8")
+    monkeypatch.setenv("OCT_VRF_AGG", "0")
+    ledger.record_run("bench", result={"value": 2007.0,
+                                       "device_unavailable": True},
+                      build_id="pjrt-v9")
+    sec = perf_report.ledger_section(led)
+    assert sec["runs"] == 2 and sec["by_kind"] == {"bench": 2}
+    (tr,) = sec["bench_transitions"]
+    assert tr["changed"]["build_id"] == ["pjrt-v8", "pjrt-v9"]
+    assert tr["changed"]["env"]["OCT_VRF_AGG"] == ["1", "0"]
+    # and the full report folds it
+    rep = perf_report.build_report(REPO, None, False, led)
+    assert rep["ledger"]["runs"] == 2
+    md = perf_report.render_markdown(rep)
+    assert "OCT_VRF_AGG" in md
