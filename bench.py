@@ -139,19 +139,43 @@ def build_or_load_chain():
     return path, params, lview
 
 
-def probe_device() -> bool:
-    """Fresh-subprocess probes with an OVERALL deadline (round-2 lesson:
-    per-attempt timeouts without a total budget ate the driver's run)."""
+# one backoff'd RETRY of a failed backend probe, under its own small
+# budget carved out of PROBE_BUDGET: r02-r04 each died on a single probe
+# timeout — one retry catches the transient-tunnel case without letting
+# a dead tunnel eat the measurement wall (round-10 hardening)
+PROBE_RETRY_BUDGET = float(os.environ.get("BENCH_PROBE_RETRY_BUDGET", "75"))
+PROBE_RETRY_BACKOFF_S = 15.0
+
+
+def probe_device() -> tuple[bool, dict]:
+    """Fresh-subprocess backend probe -> (ok, verdict). At most TWO
+    attempts: the first under min(PROBE_BUDGET, remaining wall); on
+    failure, one backoff'd retry under the separate PROBE_RETRY_BUDGET.
+    The verdict dict distinguishes probe-timeout (backend init hung)
+    from probe-error (backend up, wrong answer) per attempt — it is
+    banked into the round JSON and the run ledger so a dead round's
+    tail says WHICH way the probe died, not just that it did."""
+    verdict: dict = {"ok": False, "attempts": []}
     # keep at least ~2 min of ceiling for the measurement itself
     budget = min(PROBE_BUDGET, _remaining() - 120)
     if budget <= 5:
         print("# no wall budget left for device probing", file=sys.stderr)
-        return False
+        verdict["outcome"] = "no-budget"
+        return False, verdict
     deadline = time.monotonic() + budget
-    attempt = 0
-    while time.monotonic() < deadline:
-        attempt += 1
-        left = max(5.0, deadline - time.monotonic())
+    for attempt in (1, 2):
+        if attempt == 2:
+            # separate small retry budget, after a backoff: a transient
+            # tunnel blip recovers; a dead tunnel costs 75 s, not the
+            # measurement wall
+            left = min(PROBE_RETRY_BUDGET, _remaining() - 120)
+            if left <= 5:
+                break
+            time.sleep(min(PROBE_RETRY_BACKOFF_S, max(0.0, left - 5)))
+            left -= PROBE_RETRY_BACKOFF_S
+        else:
+            left = max(5.0, deadline - time.monotonic())
+        t0 = time.monotonic()
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
@@ -159,22 +183,38 @@ def probe_device() -> bool:
                  "assert jax.devices()[0].platform == 'tpu';"
                  "print(int((jnp.ones((8,8))+1).sum()))"],
                 capture_output=True, text=True,
-                timeout=min(90.0, left),
+                timeout=max(5.0, min(90.0, left)),
             )
             if probe.returncode == 0 and probe.stdout.strip() == "128":
-                print(f"# device probe ok (attempt {attempt})", file=sys.stderr)
-                return True
+                print(f"# device probe ok (attempt {attempt})",
+                      file=sys.stderr)
+                verdict["ok"] = True
+                verdict["outcome"] = "ok"
+                verdict["attempts"].append({
+                    "outcome": "ok",
+                    "wall_s": round(time.monotonic() - t0, 1),
+                })
+                return True, verdict
             err = (probe.stderr or "?").strip().splitlines()
             err = err[-1] if err else "?"
+            outcome = "probe-error"
         except subprocess.TimeoutExpired:
             err = "probe timed out (backend init hung)"
+            outcome = "probe-timeout"
+        verdict["attempts"].append({
+            "outcome": outcome, "wall_s": round(time.monotonic() - t0, 1),
+            "detail": str(err)[:200],
+        })
         print(f"# device probe failed (attempt {attempt}): {err}",
               file=sys.stderr)
-        if time.monotonic() + 30 < deadline:
-            time.sleep(30)
-        else:
-            break
-    return False
+    # the banked classification: every attempt timed out vs at least one
+    # answered wrongly (a reachable-but-broken backend is a different
+    # bug than a wedged tunnel)
+    outcomes = {a["outcome"] for a in verdict["attempts"]}
+    verdict["outcome"] = ("backend-probe-timeout"
+                          if outcomes == {"probe-timeout"}
+                          else "backend-probe-error")
+    return False, verdict
 
 
 _DEVICE_CHILD = r"""
@@ -260,28 +300,21 @@ if _probe_ok is False:
     os.makedirs(cache_dir, exist_ok=True)
     os.environ["OCT_PK_AOT"] = "0"
 
-# The AOT executable cache (scripts/aot_cache) is NOT keyed per build
-# the way the jax cache above is — compare its BUILD_ID marker (written
-# by scripts/aot_precompile.py) against this runtime so a build change
-# skips the doomed load attempts up front; executables of unknown
-# provenance (entries but no marker) are treated the same way.
-aot_dir = os.environ.get("OCT_PK_AOT_DIR") or os.path.join(
-    os.environ["OCT_REPO"], "scripts", "aot_cache")
-try:
-    has_aot = any(e.endswith(".jaxexec") for e in os.listdir(aot_dir))
-except OSError:
-    has_aot = False
-if has_aot and os.environ.get("OCT_PK_AOT", "1") != "0":
-    try:
-        with open(os.path.join(aot_dir, "BUILD_ID")) as f:
-            aot_build = f.read().strip()
-    except OSError:
-        aot_build = None
-    if aot_build != build_id:
-        print(f"# aot executables were compiled for {aot_build!r}; "
-              f"runtime is {build_id!r}: skipping AOT load path",
-              file=sys.stderr)
-        os.environ["OCT_PK_AOT"] = "0"
+# The AOT artifact store (ops/pk/aot.py) is build-pinned: one query
+# replaces the old BUILD_ID-marker heuristics — entries from another
+# build are zero-cost wrong_build skips at load time, never doomed
+# deserializes, so nothing needs disabling. Write-back is enabled so
+# every stage THIS child compiles is re-serialized for this build:
+# attempt 2 (and the next round) loads warm instead of recompiling.
+from ouroboros_consensus_tpu.ops.pk import aot as _pk_aot
+
+os.environ.setdefault("OCT_PK_AOT_WRITEBACK", "1")
+_st = _pk_aot.store_status()
+print(f"# aot store: {_st['matching']}/{_st['entries']} artifact(s) "
+      f"match this build ({_st['stale_src']} stale-src)", file=sys.stderr)
+_WARMUP.note(
+    f"aot store: {_st['matching']}/{_st['entries']} artifacts match build"
+)
 jax.config.update("jax_compilation_cache_dir", cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 from bench import BENCH_HEADERS, KES_DEPTH, MAX_BATCH, bench_params, build_or_load_chain
@@ -389,27 +422,31 @@ from ouroboros_consensus_tpu.ops.pk.aot import (  # noqa: E402
 )
 
 
-def _wipe_stale_cache(child_log: str) -> bool:
+def _wipe_stale_cache(child_log: str) -> None:
     """Belt-and-braces for the child's startup probe: if the child's
     log still shows executable-format rejections (entries the probe
-    could not classify), wipe the resolved per-build cache dir so the
-    retry compiles clean instead of burning ~15 s per stale entry, and
-    skip the AOT load path for the same reason. Rejections the pk-aot
-    loader itself reported (lines prefixed '# pk-aot:') implicate only
-    scripts/aot_cache, NOT the per-build jax cache — wiping the jax
-    cache for those would discard the stage compiles the attempt just
-    banked, so they only disable AOT for the retry."""
+    could not classify), wipe the resolved per-build JAX cache dir so
+    the retry compiles clean instead of burning ~15 s per stale entry.
+    Rejections the pk-aot loader itself reported (lines prefixed
+    '# pk-aot:') implicate only the build-pinned artifact store, which
+    SELF-HEALS via write-back — nothing to wipe, nothing to disable
+    (pre-round-10 this returned a flag that switched AOT off for the
+    retry; that trap is gone on purpose, hence no return value)."""
     flagged = [
         ln for ln in child_log.lower().splitlines()
         if any(pat in ln for pat in _STALE_CACHE_RE)
     ]
     if not flagged:
-        return False
+        return
     if all(ln.lstrip().startswith("# pk-aot:") for ln in flagged):
+        # the build-pinned store SELF-HEALS: the rejected entry is
+        # condemned by its marker and the write-back re-serialized a
+        # fresh executable for this build, so the retry keeps AOT on
+        # and loads warm (pre-round-10 this disabled AOT wholesale)
         print("# stale-executable rejections all came from the pk-aot "
-              "load path: disabling AOT for the retry (jax cache kept)",
-              file=sys.stderr)
-        return True
+              "store (self-healing via write-back): jax cache and AOT "
+              "both kept for the retry", file=sys.stderr)
+        return
     import shutil
 
     target = JAX_CACHE_ROOT
@@ -419,9 +456,8 @@ def _wipe_stale_cache(child_log: str) -> bool:
     except OSError:
         pass
     print(f"# stale-executable rejection in child log: wiping {target} "
-          "and disabling AOT for the retry", file=sys.stderr)
+          "for the retry", file=sys.stderr)
     shutil.rmtree(target, ignore_errors=True)
-    return True
 
 
 # the production packed-agg window pipeline's cold-compile set: the
@@ -573,8 +609,10 @@ def run_device_subprocess() -> dict | None:
                 child_log = f.read()
         except OSError:
             child_log = ""
-        if _wipe_stale_cache(child_log):
-            env["OCT_PK_AOT"] = "0"
+        # a jax-cache wipe is all a stale-executable rejection costs now:
+        # the pk-aot store is build-pinned + self-healing, so the retry
+        # keeps the AOT load path (it will find the written-back entries)
+        _wipe_stale_cache(child_log)
         if timed_out:
             # a timeout after the warmup replay still yields a real
             # end-to-end number — read the provisional checkpoint; if
@@ -601,7 +639,8 @@ def run_device_subprocess() -> dict | None:
 
 
 def append_ledger_record(out: dict, baseline: float | None = None,
-                         native_wall_s: float | None = None) -> dict | None:
+                         native_wall_s: float | None = None,
+                         probe: dict | None = None) -> dict | None:
     """One provenance-complete run-ledger record per bench run
     (obs/ledger.py): the final JSON line plus git rev/dirty, the child's
     PJRT build id, every OCT_*/BENCH_* kill-switch value, the warmup
@@ -615,11 +654,16 @@ def append_ledger_record(out: dict, baseline: float | None = None,
         big = ("metrics", "metrics_summary", "warmup_report",
                "device_resources")
         slim = {k: v for k, v in out.items() if k not in big}
-        extra = None
+        extra = {}
         if baseline is not None:
-            extra = {"native_baseline_per_s": round(baseline, 1)}
+            extra["native_baseline_per_s"] = round(baseline, 1)
             if native_wall_s is not None:
                 extra["native_wall_s"] = round(native_wall_s, 1)
+        if probe is not None:
+            # the probe verdict rides the ledger so a dead round's
+            # attribution (probe-timeout vs driver-timeout) is a query
+            extra["probe"] = probe
+        extra = extra or None
         return ledger.record_run(
             "bench",
             config={
@@ -680,12 +724,20 @@ def main() -> None:
     print(f"# native baseline {baseline:.0f} headers/s ({nwall:.1f}s){cap_note}",
           file=sys.stderr)
 
-    if probe_device():
+    probe_ok, probe_verdict = probe_device()
+    if probe_ok:
         device = run_device_subprocess()
+        # the probe SUCCEEDED, so a missing device result is a run/wall
+        # death — classified distinctly from a probe death in the
+        # banked tail (perf_report tells them apart structurally now)
         why_no_device = "device run failed or ran out of wall budget"
+        no_device_reason = "device-run-failed-or-wall"
     else:
         device = None
-        why_no_device = "TPU unreachable or no wall budget to probe it"
+        why_no_device = (
+            f"backend probe failed ({probe_verdict.get('outcome')})"
+        )
+        no_device_reason = probe_verdict.get("outcome", "backend-probe")
 
     if device is not None:
         rate = device["n"] / device["best_s"]
@@ -720,6 +772,12 @@ def main() -> None:
             wr = _read_warmup_report()
             if wr is not None:
                 out["warmup_report"] = wr
+        out["probe"] = probe_verdict
+        # a round that banked THROUGH the warm ladder is its own class
+        # of round (perf_report renders it), not a warmup death
+        ladder_evs = (out.get("warmup_report") or {}).get("ladder") or []
+        if ladder_evs:
+            out["laddered"] = True
     else:
         out = {
             "metric": (
@@ -735,6 +793,8 @@ def main() -> None:
             "vs_baseline": 1.0,
             "device_unavailable": True,
         }
+        out["no_device_reason"] = no_device_reason
+        out["probe"] = probe_verdict
         # the whole point of the flight recorder: a warmup death still
         # banks a per-stage diagnosis (which compile/cache path ate the
         # wall), not just a timeout
@@ -742,7 +802,8 @@ def main() -> None:
         if wr is not None:
             out["warmup_report"] = wr
     print(json.dumps(out))
-    append_ledger_record(out, baseline=baseline, native_wall_s=nwall)
+    append_ledger_record(out, baseline=baseline, native_wall_s=nwall,
+                         probe=probe_verdict)
 
 
 if __name__ == "__main__":

@@ -448,10 +448,10 @@ STAGE_GRAPHS: dict[str, str] = {
 
 def stage_graph(stage: str) -> str | None:
     """Registered-graph twin of a warmup stage label (strips the
-    `@b<bucket>` and `:<layout>` qualifiers). The xla-packed label
-    embeds the staged proof length (`:p80` draft-03 / `:p128`
-    batch-compatible — protocol/batch._jitted_packed_xla), which
-    selects between the two composed twins."""
+    `@b<bucket>`, `:<lanes>l` and `:<layout>` qualifiers). The
+    xla-packed label embeds the staged proof length (`:p80` draft-03 /
+    `:p128` batch-compatible — protocol/batch._jitted_packed_xla),
+    which selects between the two composed twins."""
     base = stage.split("@", 1)[0].split(":", 1)[0]
     if base.startswith("unpack_"):
         base = "unpack"
@@ -459,6 +459,88 @@ def stage_graph(stage: str) -> str | None:
         return ("verify_praos_core" if ":p80" in stage
                 else "verify_praos_core_bc")
     return STAGE_GRAPHS.get(base)
+
+
+# ---------------------------------------------------------------------------
+# Warm-while-serving compile ladder (protocol/batch.WarmLadder)
+# ---------------------------------------------------------------------------
+
+# the lane rungs the ladder may start a cold replay at while the
+# production-bucket programs compile in a background thread. Every rung
+# program is PINNED in costmodel.json (`<graph>@<rung>` entries, written
+# by scripts/lint.py --update-costs) so lint exit 5 fences each one: on
+# the current kernels the composed graphs are lane-INVARIANT (the
+# fenced MSM chunk scans keep eqn counts flat in N — verified by the
+# identical feature hashes), which means a rung compile costs what the
+# production compile costs and the ladder's win is OVERLAP (replay
+# serves on the small, individually-cheap split-stage programs while
+# the monolith compiles in the background), not a cheaper rung compile.
+# If a future kernel change makes the structure lane-sensitive, these
+# pins are where it shows up — and choose_rung starts discriminating.
+LADDER_RUNGS = (1024, 2048)
+LADDER_GRAPHS = ("aggregate_core", "verify_praos_core_bc")
+
+
+def ladder_pin_name(graph: str, lanes: int) -> str:
+    return f"{graph}@{lanes}"
+
+
+def ladder_pins() -> list[tuple[str, str, int]]:
+    """[(pin_name, base_graph, lanes)] for every rung program the
+    ladder may compile — the lint cost pass extracts features for each
+    and ratchets them exactly like the registry graphs (compile_wall
+    ceilings + pin freshness; they carry no device_resources pins)."""
+    return [
+        (ladder_pin_name(g, r), g, r)
+        for g in LADDER_GRAPHS for r in LADDER_RUNGS
+    ]
+
+
+def stage_pin_graph(stage: str, lanes: int | None = None) -> str | None:
+    """Like stage_graph, but resolves to the rung pin when the dispatch
+    runs at a ladder rung lane count and that rung is pinned — so the
+    pre-flight gate prices a rung window by its own pin instead of the
+    production graph's."""
+    g = stage_graph(stage)
+    if g is None or lanes is None:
+        return g
+    pin = ladder_pin_name(g, lanes)
+    return pin if pinned(pin) is not None else g
+
+
+def choose_rung(graph: str, *, now: float | None = None,
+                margin_s: float | None = None,
+                rungs: tuple = None) -> int | None:
+    """Starting rung for a cold replay, chosen against the exported
+    $OCT_WALL_DEADLINE: the LARGEST pinned rung whose predicted compile
+    wall fits the remaining budget with margin, else the smallest rung
+    (serve on the smallest windows and let the background compile eat
+    the wall). No deadline -> the largest rung (no pressure, minimize
+    re-tiling overhead). None when no rungs are configured."""
+    rungs = LADDER_RUNGS if rungs is None else rungs
+    if not rungs:
+        return None
+    deadline = wall_deadline()
+    if deadline is None:
+        return max(rungs)
+    now = time.time() if now is None else now
+    margin = PREFLIGHT_MARGIN_S if margin_s is None else margin_s
+    remaining = deadline - now
+    best = None
+    for r in sorted(rungs):
+        pred = predicted_wall(ladder_pin_name(graph, r))
+        if pred is None:
+            # an UNPINNED rung never outranks a pinned one under a
+            # deadline: its wall is unknown, and choosing it risks
+            # exactly the blow-through the ladder exists to avoid
+            continue
+        if pred + margin <= remaining:
+            best = r
+    if best is not None:
+        return best
+    # no pinned rung fits (or none are pinned at all): serve on the
+    # smallest windows and let the background compile eat the wall
+    return min(rungs)
 
 
 def stage_feature_hash(stage: str) -> str | None:
@@ -598,7 +680,8 @@ def preflight(stage: str, graph: str | None = None, *,
               now: float | None = None,
               margin_s: float | None = None,
               action: str = "stage-split-fallback",
-              fallback_graph: str | None = None) -> bool:
+              fallback_graph: str | None = None,
+              lanes: int | None = None) -> bool:
     """Admission gate for a COLD program's first execute: True = go.
 
     Refuses when a wall deadline is set, the stage has not yet recorded
@@ -625,7 +708,7 @@ def preflight(stage: str, graph: str | None = None, *,
 
     if stage in WARMUP.stages:
         return True  # already compiled this process: warm dispatch
-    g = graph if graph is not None else stage_graph(stage)
+    g = graph if graph is not None else stage_pin_graph(stage, lanes)
     pred = predicted_wall(g) if g else None
     if pred is None:
         return True

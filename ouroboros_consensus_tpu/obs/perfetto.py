@@ -101,7 +101,8 @@ def to_chrome_trace(timed_events: Iterable[tuple[float, object]],
             cand = warmup_t0 + float(row.get("t", 0.0)) - float(
                 row.get("wall_s", 0.0))
             t_zero = cand if t_zero is None else min(t_zero, cand)
-        for ev_row in wu.get("aot_events", []) + wu.get("refusals", []):
+        for ev_row in (wu.get("aot_events", []) + wu.get("refusals", [])
+                       + wu.get("ladder", [])):
             cand = warmup_t0 + float(ev_row.get("t", 0.0))
             t_zero = cand if t_zero is None else min(t_zero, cand)
     if t_zero is None:
@@ -191,6 +192,36 @@ def to_chrome_trace(timed_events: Iterable[tuple[float, object]],
                 "cat": "warmup", "ph": "i", "s": "t",
                 "ts": us(warmup_t0 + float(ref.get("t", 0.0))),
                 "pid": PID, "tid": wtid,
+            })
+        # the warm-ladder trajectory: the background production compile
+        # renders as a SLICE (bg-compile-started -> bg-compile-done, the
+        # wall the ladder hides behind served windows), every other
+        # event as an instant carrying its rung/hash args
+        bg_start = None
+        for lad in wu.get("ladder", []):
+            kind = lad.get("kind", "?")
+            t_abs = warmup_t0 + float(lad.get("t", 0.0))
+            if kind == "bg-compile-started":
+                bg_start = t_abs
+            if kind in ("bg-compile-done", "bg-compile-failed") and \
+                    bg_start is not None:
+                events.append({
+                    "name": f"ladder background compile [{kind[11:]}]",
+                    "cat": "warmup", "ph": "X",
+                    "ts": us(bg_start),
+                    "dur": max(0.0, (t_abs - bg_start) * 1e6),
+                    "pid": PID, "tid": wtid,
+                    "args": {k: v for k, v in lad.items() if k != "t"},
+                })
+                bg_start = None
+                continue
+            events.append({
+                "name": f"ladder: {kind}"
+                        + (f" rung={lad['rung']}" if lad.get("rung") else "")
+                        + (f" -> {lad['target']}"
+                           if kind == "swap" and lad.get("target") else ""),
+                "cat": "warmup", "ph": "i", "s": "t",
+                "ts": us(t_abs), "pid": PID, "tid": wtid,
             })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 

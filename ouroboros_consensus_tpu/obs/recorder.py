@@ -22,7 +22,8 @@ import threading
 import time
 
 from ..utils.trace import (
-    AggRedispatch, EncloseEvent, TransferEvent, WindowSpan, WindowStaged,
+    AggRedispatch, EncloseEvent, LadderEvent, TransferEvent, WindowSpan,
+    WindowStaged,
 )
 from . import registry as _registry
 
@@ -50,6 +51,10 @@ class FlightRecorder:
         self._redisp = r.counter(
             "oct_agg_redispatch_total",
             "aggregate windows re-dispatched per-lane",
+        )
+        self._ladder = r.counter(
+            "oct_ladder_events_total",
+            "warm-ladder transitions (engaged/bg-compile/swap)", ("kind",),
         )
         self._h2d = r.counter("oct_h2d_bytes_total", "bytes staged to device")
         self._d2h = r.counter("oct_d2h_bytes_total", "bytes returned to host")
@@ -95,6 +100,8 @@ class FlightRecorder:
             )
         elif isinstance(ev, AggRedispatch):
             self._redisp.inc()
+        elif isinstance(ev, LadderEvent):
+            self._ladder.labels(kind=ev.kind).inc()
         elif isinstance(ev, TransferEvent):
             if ev.phase == "dispatch":
                 self._h2d.inc(ev.h2d_bytes)

@@ -50,6 +50,10 @@ class WarmupRecorder:
         # whose PREDICTED cold-compile wall did not fit the remaining
         # bench budget — the decision is forensics too
         self.refusals: list[dict] = []
+        # warm-while-serving compile ladder (protocol/batch.WarmLadder):
+        # engagement, background-compile start/land and every rung swap,
+        # each with the octwall feature hash of the program involved
+        self.ladder: list[dict] = []
         self.cache_probe: dict | None = None
         self.notes: list[str] = []
 
@@ -93,10 +97,26 @@ class WarmupRecorder:
             })
         self._flush()
 
+    def note_ladder(self, kind: str, **fields) -> None:
+        """One warm-ladder event, first-class in the report: kind is
+        engaged | bg-compile-started | bg-compile-done | bg-compile-failed
+        | swap. Fields carry the rung/target lane counts, the production
+        stage label and the octwall feature_hash of the program the
+        event is about, so a ladder trajectory joins the cost pins the
+        same way stage first-executes do."""
+        row = {"kind": kind,
+               "t": round(time.monotonic() - self.t0, 3)}
+        for k, v in fields.items():
+            if v is not None:
+                row[k] = round(v, 3) if isinstance(v, float) else v
+        with self._lock:
+            self.ladder.append(row)
+        self._flush()
+
     def note_aot(self, stage: str, outcome: str, wall_s: float = 0.0,
                  detail: str = "") -> None:
-        """One pk-AOT load outcome: loaded | missing | failed | rejected
-        | marker_skip | run_failed."""
+        """One pk-AOT load outcome: loaded | missing | wrong_build |
+        failed | rejected | marker_skip | run_failed | saved."""
         with self._lock:
             self.aot[outcome] = self.aot.get(outcome, 0) + 1
             self.aot_events.append({
@@ -144,6 +164,7 @@ class WarmupRecorder:
                 "aot": dict(self.aot),
                 "aot_events": list(self.aot_events),
                 "refusals": [dict(r) for r in self.refusals],
+                "ladder": [dict(r) for r in self.ladder],
                 "cache_probe": self.cache_probe,
                 "notes": list(self.notes),
             }
@@ -172,6 +193,7 @@ class WarmupRecorder:
             self.aot.clear()
             self.aot_events.clear()
             self.refusals.clear()
+            self.ladder.clear()
             self.cache_probe = None
             self.notes.clear()
 

@@ -532,10 +532,22 @@ def _stage_call(name, fn, b, kes_depth, *args):
     first = stage not in _FIRST_EXEC
     _begin_first_exec(stage)
     t0 = time.monotonic()
-    out = fn(*args)
+    ex = None
+    if first and aot.writeback_enabled():
+        # the write-back path: compile EXPLICITLY (same wall the jit
+        # would have paid) so the executable can be re-serialized into
+        # the build-pinned store — the next attempt/round on this build
+        # loads warm instead of recompiling, which is what heals the
+        # store after a format rejection (ops/pk/aot.compile_and_store)
+        ex = aot.compile_and_store(name, b, kes_depth, TILE, fn, args)
+    out = ex(*args) if ex is not None else fn(*args)
     _note_first_exec(stage, time.monotonic() - t0, "jit")
     if first:
-        _capture_resources(stage, fn, args, b, kes_depth, "jit")
+        _capture_resources(stage, ex if ex is not None else fn, args,
+                           b, kes_depth, "jit")
+        if ex is not None:
+            # later dispatches take the (memoized) store branch async
+            _AOT_WARM.add((name, b, kes_depth, TILE, aot.sig_of(args)))
     return out
 
 

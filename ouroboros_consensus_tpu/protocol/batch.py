@@ -48,6 +48,7 @@ boundaries and threads the tiny PraosState between them.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from dataclasses import dataclass
 from fractions import Fraction
@@ -612,33 +613,102 @@ def verify_praos_any(*cols) -> Verdicts:
 
 _JIT: dict = {}
 
-# warmup forensics: stages whose first execute has been recorded — the
-# wrapper below costs one set lookup per call after that
+# warmup forensics: (stage:lanes) labels whose first execute has been
+# recorded — the wrapper below costs one set lookup per call after that
 _WARM_SEEN: set = set()
+
+
+def _arg_lanes(a) -> int | None:
+    """Leading batch axis of the first array argument."""
+    return next(
+        (int(x.shape[0]) for x in a
+         if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1),
+        None,
+    )
+
+
+def _store_name(label: str) -> str:
+    """AOT-store stage name of an XLA-twin warmup label (the label's
+    lane qualifier is carried by the store key's `b`, not the name)."""
+    import re
+
+    return re.sub(r"[^A-Za-z0-9_]+", "_", label)
 
 
 def _warm_timed(stage: str, fn):
     """Wrap a jitted program so its FIRST execute (where the compile —
-    or persistent-cache load — happens synchronously) records its wall
-    into the obs warmup flight recorder. The r02-r05 ~410 s compile
-    walls died without attribution; this is the per-stage black box."""
+    or cache/store load — happens synchronously) records its wall into
+    the obs warmup flight recorder. The r02-r05 ~410 s compile walls
+    died without attribution; this is the per-stage black box.
+
+    The first-execute label is qualified by the padded LANE count
+    (`<stage>:<lanes>l`): the warm ladder dispatches the same program
+    family at rung and production lane counts, and the compile gate /
+    warmup report must attribute each shape's first execute separately
+    (a 1024-lane first execute does not make the 8192-lane program
+    warm). The first execute also consults the build-pinned AOT store
+    (ops/pk/aot): a stored executable loads instead of compiling, and
+    with OCT_PK_AOT_WRITEBACK=1 a fresh compile is re-serialized into
+    the store so the next process on this build loads warm. The
+    load/write-back executable memo is CLOSURE-local (per wrapped fn,
+    sig-checked — a Compiled is shape-exact and the generic staged
+    program's KES hash-block count varies per batch): the explicit
+    compile path does not populate the jit's own cache, but a memo
+    keyed by label alone would keep serving a stale program after the
+    jit behind the label is rebuilt."""
+    warm_exec: dict = {}
 
     def wrapper(*a, **k):
-        if stage in _WARM_SEEN:
+        from ..ops.pk import aot as pk_aot
+
+        lanes = _arg_lanes(a)
+        label = f"{stage}:{lanes}l" if lanes is not None else stage
+        if label in _WARM_SEEN:
+            stored = warm_exec.get(label)
+            if stored is not None and stored[0] == pk_aot.sig_of(a):
+                return stored[1](*a)
             return fn(*a, **k)
         from ..obs.warmup import WARMUP
 
         # breadcrumb BEFORE the call: a kill mid-compile still leaves
-        # "<stage> first execute starting" as the report's last note
-        WARMUP.note(f"{stage} first execute starting")
+        # "<label> first execute starting" as the report's last note
+        WARMUP.note(f"{label} first execute starting")
         t0 = time.monotonic()
-        out = fn(*a, **k)
+        ex = None
+        via = "xla-jit"
+        name = _store_name(stage)
+        if pk_aot.enabled():
+            try:
+                sig = pk_aot.sig_of(a)
+                ex = pk_aot.load(name, lanes or 0, 0, 0, sig)
+                if ex is not None:
+                    via = "xla-aot"
+            except Exception:  # noqa: BLE001 — fail-soft by contract
+                ex = None
+        if ex is None and pk_aot.writeback_enabled():
+            ex = pk_aot.compile_and_store(name, lanes or 0, 0, 0, fn, a)
+        try:
+            out = ex(*a, **k) if ex is not None else fn(*a, **k)
+        except Exception as e:
+            if ex is None:
+                raise
+            # a stored executable that dies on device falls back to the
+            # jit path — never worse than the pre-store behavior
+            pk_aot.note_failure(e)
+            pk_aot._note_aot(name, "run_failed", detail=repr(e))
+            ex, via = None, "xla-jit"
+            out = fn(*a, **k)
+        if ex is not None:
+            import jax
+
+            jax.block_until_ready(out)
+            warm_exec[label] = (pk_aot.sig_of(a), ex)
         wall = time.monotonic() - t0
-        _WARM_SEEN.add(stage)
+        _WARM_SEEN.add(label)
         from ..analysis import costmodel
 
-        WARMUP.note_stage(stage, wall, via="xla-jit",
-                          feature_hash=costmodel.stage_feature_hash(stage))
+        WARMUP.note_stage(label, wall, via=via,
+                          feature_hash=costmodel.stage_feature_hash(label))
         # device resource accounting rides the same first-execute gate:
         # one re-lower (trace only, no XLA compile) while capture is
         # enabled — lanes read off the leading batch axis. AFTER the
@@ -646,13 +716,8 @@ def _warm_timed(stage: str, fn):
         # already-flushed compile-wall forensics.
         from ..obs import resources as obs_resources
 
-        lanes = next(
-            (int(x.shape[0]) for x in a
-             if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1),
-            None,
-        )
-        obs_resources.capture_stage(stage, fn, a, lanes=lanes,
-                                    via="xla-jit")
+        obs_resources.capture_stage(label, ex if ex is not None else fn,
+                                    a, lanes=lanes, via=via)
         return out
 
     return wrapper
@@ -674,8 +739,19 @@ PACKED_STAGE = os.environ.get("OCT_PACKED_STAGE", "1") != "0"
 NONCE_SCAN = os.environ.get("OCT_NONCE_SCAN", "1") != "0"
 
 
+def _stage_thread_enabled() -> bool:
+    """OCT_STAGE_THREAD (default 1): run prechecks + packed staging on
+    a producer thread ahead of dispatch in validate_chain's device
+    loop, double-buffering H2D staging against device compute with
+    backpressure at pipeline_depth. =0 restores the inline (round-9)
+    staging — the differential kill-switch; read per call so tests can
+    A/B both paths in one process."""
+    return os.environ.get("OCT_STAGE_THREAD", "1") != "0"
+
+
 def _compile_gate_admit(stage: str, action: str,
-                        fallback_graph: str | None) -> bool:
+                        fallback_graph: str | None,
+                        lanes: int | None = None) -> bool:
     """octwall pre-flight (analysis/costmodel.preflight): when bench.py
     has exported a wall deadline ($OCT_WALL_DEADLINE), a COLD monolith
     program whose PREDICTED cold-compile wall does not fit the
@@ -694,7 +770,8 @@ def _compile_gate_admit(stage: str, action: str,
         from ..analysis import costmodel
 
         return costmodel.preflight(stage, action=action,
-                                   fallback_graph=fallback_graph)
+                                   fallback_graph=fallback_graph,
+                                   lanes=lanes)
     except Exception:  # noqa: BLE001 — fail-open by contract
         return True
 
@@ -1848,28 +1925,31 @@ def _emit_window_span(meta, lanes: int, n_valid: int, failed: bool,
     ))
 
 
-def dispatch_batch(params, lview, eta0, hvs, carry=None):
-    """Stage a within-epoch window and dispatch the fused kernel WITHOUT
-    waiting: jax execution is asynchronous, so the caller can stage the
-    next window while this one runs on device (the §7.3.6 host/device
-    overlap; the reference's analog is the decoupled add-block queue,
-    ChainSel.hs:217-246). Staging depends only on the epoch nonce and
-    ledger view — never on the sequential fold — which is what makes
-    in-flight windows safe.
+class _StagedWindow(NamedTuple):
+    """Output of `prepare_window` — everything `dispatch_prepared`
+    needs, so staging can run on a producer thread ahead of dispatch
+    (the round-10 threaded staging pipeline; the split is also what
+    keeps the kill-switched path byte-identical: dispatch_batch is the
+    two halves composed inline)."""
 
-    Windows stage PACKED (stage_packed: body-sourced u8 columns, device
-    unpack) whenever the window qualifies, falling back to the generic
-    staged path otherwise. `carry` is the previous window's device
-    nonce-scan carry (or a host `_state_carry`); when given and the
-    window stages packed, the on-device nonce fold chains through this
-    window and the new carry is returned — the non-associative fold
-    never leaves the device while the pipeline is intact (praos.tick
-    only rotates the epoch nonce, so the chain crosses epoch boundaries
-    untouched).
+    pre: "HostChecks"
+    packed: "tuple | None"  # (layout, padded PraosPacked) when packed
+    padded: "PraosBatch | None"  # generic fallback, padded
+    b: int
+    lanes: int
+    h2d: int
+    gate: str | None
+    t0: float
+    t1: float
 
-    Returns (pre, dispatched, b, carry_out); carry_out is None when this
-    window cannot extend the chain (generic fallback or scan disabled).
-    """
+
+def prepare_window(params, lview, eta0, hvs) -> _StagedWindow:
+    """The HOST half of dispatch_batch: prechecks + packed/generic
+    staging + bucket padding. Pure with respect to the sequential fold
+    (depends only on the epoch nonce and ledger view), so a producer
+    thread may run it arbitrarily far ahead of dispatch — the round-10
+    staging thread overlaps this wall with device compute and the
+    retire-side epilogue work on the main thread."""
     b = len(hvs)
     t0 = time.monotonic()
     with _enclose("stage"):
@@ -1897,17 +1977,51 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
             padded = pad_batch_to(batch, bucket_size(b))
             h2d = _nbytes(flatten_batch(padded))
             lanes = padded.beta.shape[0]
-        else:
-            layout, parr = packed
-            parr = pad_packed_to(parr, bucket_size(b))
-            h2d = _nbytes(parr)
-            lanes = parr.body.shape[0]
-    t1 = time.monotonic()
+            return _StagedWindow(pre, None, padded, b, lanes, h2d, gate,
+                                 t0, time.monotonic())
+        layout, parr = packed
+        parr = pad_packed_to(parr, bucket_size(b))
+        h2d = _nbytes(parr)
+        lanes = parr.body.shape[0]
+    return _StagedWindow(pre, (layout, parr), None, b, lanes, h2d, gate,
+                         t0, time.monotonic())
+
+
+def _agg_label(layout, lanes: int, scan: bool) -> str:
+    """The aggregate monolith's warmup/first-execute label at one
+    padded lane count (must match what `_warm_timed` derives from the
+    dispatched arguments — the compile gate and the warm ladder key
+    their cold/warm decisions on it)."""
+    return (f"agg-packed:{layout.body_len}b:"
+            f"{'scan' if scan else 'noscan'}:{lanes}l")
+
+
+def dispatch_prepared(sw: _StagedWindow, carry=None, ladder=None):
+    """The DEVICE half of dispatch_batch: launch the fused kernel for a
+    prepared window WITHOUT waiting (jax dispatch is asynchronous).
+    Must run in window order on one thread — the device nonce-scan
+    carry chains dispatch-to-dispatch.
+
+    `carry` is the previous window's device nonce-scan carry (or a host
+    `_state_carry`); when given and the window staged packed, the
+    on-device nonce fold chains through this window and the new carry
+    is returned — the non-associative fold never leaves the device
+    while the pipeline is intact (praos.tick only rotates the epoch
+    nonce, so the chain crosses epoch boundaries untouched).
+
+    Returns (pre, dispatched, b, carry_out); carry_out is None when this
+    window cannot extend the chain (generic fallback or scan disabled).
+    """
+    pre, b, lanes, h2d, gate, t0, t1 = (
+        sw.pre, sw.b, sw.lanes, sw.h2d, sw.gate, sw.t0, sw.t1
+    )
     with _enclose("dispatch"):
         _emit_transfer(
-            "dispatch", lanes=lanes, h2d_bytes=h2d, packed=packed is not None
+            "dispatch", lanes=lanes, h2d_bytes=h2d,
+            packed=sw.packed is not None,
         )
-        if packed is None:
+        if sw.packed is None:
+            padded = sw.padded
             if _impl() == "pk":
                 out = _pk_dispatch(padded)
                 impl = "pk"
@@ -1919,13 +2033,20 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
             meta = _win_meta("generic", gate, b, lanes, t0, t1)
             disp = _Dispatched(impl, False, False, False, out, meta)
             return pre, disp, b, None
+        layout, parr = sw.packed
         scan_mode = NONCE_SCAN and carry is not None
         cargs = carry if scan_mode else _ZERO_CARRY
         n_real = np.int32(b)
         refused_gate = None
-        agg_stage = (f"agg-packed:{layout.body_len}b:"
-                     f"{'scan' if scan_mode else 'noscan'}")
-        if layout.vrf_proof_len == 128 and _agg_enabled():
+        agg_stage = _agg_label(layout, lanes, scan_mode)
+        agg_path = layout.vrf_proof_len == 128 and _agg_enabled()
+        if agg_path and ladder is not None:
+            # the warm ladder owns the production-bucket compile: hand
+            # it the first packed window so the background thread can
+            # start warming the target-lane program while the replay
+            # serves rung-sized windows
+            ladder.observe(layout, parr, scan_mode)
+        if agg_path:
             # the pk fallback is the per-stage split; the xla fallback
             # is itself the per-lane packed monolith, so name its twin
             # and only refuse when that twin is predicted cheaper
@@ -1936,13 +2057,13 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
                         else "xla-packed-fallback"),
                 fallback_graph=(None if impl_is_pk
                                 else "verify_praos_core_bc"),
+                lanes=lanes,
             ):
                 # predicted compile wall over budget AND the fallback
                 # path is cheaper: skip the 330k-eqn aggregate monolith
                 # (decision in warmup report)
                 refused_gate = "compile-wall-refused"
-        if (layout.vrf_proof_len == 128 and _agg_enabled()
-                and refused_gate is None):
+        if agg_path and refused_gate is None:
             # the aggregated fast path: ONE RLC/MSM program instead of
             # the per-lane ladder stages; the eta/nonce outputs are
             # identical to the per-lane path by construction, so the
@@ -1975,6 +2096,224 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None):
         meta = _win_meta("packed", refused_gate, b, lanes, t0, t1)
         disp = _Dispatched(impl, True, scan_mode, scan_mode, out, meta)
         return pre, disp, b, carry_out
+
+
+def dispatch_batch(params, lview, eta0, hvs, carry=None, ladder=None):
+    """Stage a within-epoch window and dispatch the fused kernel WITHOUT
+    waiting (the §7.3.6 host/device overlap; the reference's analog is
+    the decoupled add-block queue, ChainSel.hs:217-246) — the inline
+    composition of `prepare_window` + `dispatch_prepared`; the
+    pipelined validate_chain loop calls the halves separately so a
+    producer thread can stage ahead of dispatch."""
+    return dispatch_prepared(
+        prepare_window(params, lview, eta0, hvs), carry, ladder
+    )
+
+
+# ---------------------------------------------------------------------------
+# Warm-while-serving compile ladder
+# ---------------------------------------------------------------------------
+
+# OCT_WARM_LADDER: "0" = off (windows always slice at max_batch and the
+# production program compiles synchronously at first dispatch — the
+# pre-round-10 behavior, verdict-identical by construction since window
+# re-tiling never changes verdicts); "1"/unset = auto (engage only when
+# a wall deadline is exported and the production aggregate monolith is
+# predicted not to fit it); "force" = engage whenever the production
+# program is cold (tests, profiling).
+
+
+class WarmLadder:
+    """Warm-while-serving compile ladder (round 10 tentpole).
+
+    When the production-bucket aggregate monolith is cold and predicted
+    over the remaining wall (octwall), the replay does NOT gamble the
+    budget on one synchronous compile: the validate_chain loop slices
+    windows at a small RUNG lane count — chosen by
+    analysis/costmodel.choose_rung against $OCT_WALL_DEADLINE — and a
+    background thread compiles the production-lane program off the
+    first window's packed columns. The moment it lands, the loop
+    re-tiles onto the production bucket (`swap`). Replay progress and
+    the monolith compile overlap instead of serializing, so the bench
+    child banks a provisional device checkpoint while the big program
+    is still in XLA.
+
+    Verdict-identical by construction: the rung only changes WINDOW
+    SLICING, and validate_batch is segmentation-invariant (same
+    verdicts, same first error, same nonce carry — the differential
+    suite drives all four ladder x staging-thread combinations).
+
+    Every transition is first-class warmup forensics
+    (obs/warmup.note_ladder + LadderEvent through the batch tracer):
+    engaged / bg-compile-started / bg-compile-done / bg-compile-failed
+    / swap, each carrying the octwall feature hash of the program
+    involved."""
+
+    def __init__(self, target: int, rung: int, graph: str,
+                 predicted_s: float | None):
+        self.target = target
+        self.rung = rung
+        self.graph = graph
+        self.predicted_s = predicted_s
+        self._engaged = False
+        self._done = threading.Event()
+        self._bg: threading.Thread | None = None
+        self._swapped = False
+        self.failed = False
+
+    # -- loop-facing ---------------------------------------------------------
+
+    def cap(self) -> int | None:
+        """Lane cap for the next window slice (None = production)."""
+        if self._swapped or self._done.is_set():
+            return None
+        return self.rung
+
+    def note_engaged_once(self) -> None:
+        """Record engagement the first time a slice is actually capped
+        (a chain shorter than the rung never engages — no noise)."""
+        if self._engaged:
+            return
+        self._engaged = True
+        from ..analysis import costmodel
+        from ..obs.warmup import WARMUP
+
+        rung_pin = costmodel.pinned(
+            costmodel.ladder_pin_name(self.graph, self.rung)
+        )
+        WARMUP.note_ladder(
+            "engaged", rung=self.rung, target=self.target,
+            graph=self.graph, predicted_s=self.predicted_s,
+            feature_hash=(rung_pin or {}).get("feature_hash"),
+        )
+        self._emit("engaged", self.rung)
+
+    def poll_swap(self) -> bool:
+        """True exactly once, when the background compile has landed
+        and the loop should re-tile onto the production bucket."""
+        if self._swapped or not self._engaged or not self._done.is_set():
+            return False
+        self._swapped = True
+        from ..obs.warmup import WARMUP
+
+        WARMUP.note_ladder("swap", rung=self.rung, target=self.target,
+                           failed=self.failed or None)
+        self._emit("swap", None)
+        return True
+
+    # -- dispatch-facing -----------------------------------------------------
+
+    def observe(self, layout, parr, scan: bool) -> None:
+        """First packed window seen: start the background production
+        compile (or finish immediately when the production label is
+        already warm in this process)."""
+        if self._bg is not None or self._done.is_set():
+            return
+        label = _agg_label(layout, self.target, scan)
+        from ..obs.warmup import WARMUP
+
+        if label in WARMUP.stages:
+            self._done.set()
+            return
+        from ..analysis import costmodel
+
+        WARMUP.note_ladder(
+            "bg-compile-started", rung=self.rung, target=self.target,
+            stage=label,
+            feature_hash=costmodel.stage_feature_hash(label),
+        )
+        self._emit("bg-compile-started", self.rung)
+        self._bg = threading.Thread(
+            target=self._warm, args=(layout, parr, scan),
+            daemon=True, name="oct-warm-ladder",
+        )
+        self._bg.start()
+
+    def _warm(self, layout, parr, scan: bool) -> None:
+        """Background thread body: pad the observed window's packed
+        columns to the production bucket and run the production program
+        once, blocking until the compile (and one execute) lands. XLA
+        compiles outside the GIL, so the replay keeps serving rung
+        windows meanwhile; the execute itself is one window of device
+        time. Bypasses the compile gate by design — eating this wall in
+        the background is the ladder's whole purpose."""
+        import jax
+
+        t0 = time.monotonic()
+        try:
+            parr_t = pad_packed_to(parr, self.target)
+            n_real = np.int32(parr.body.shape[0])
+            out = _jitted_packed_agg(layout, scan)(
+                *parr_t, n_real, *_ZERO_CARRY
+            )
+            jax.block_until_ready(out)
+        except Exception as e:  # noqa: BLE001 — fail-open: the loop
+            # simply dispatches the production program synchronously
+            self.failed = True
+            from ..obs.warmup import WARMUP
+
+            WARMUP.note_ladder("bg-compile-failed", rung=self.rung,
+                               target=self.target, detail=repr(e)[:200])
+            self._emit("bg-compile-failed", self.rung)
+        else:
+            from ..obs.warmup import WARMUP
+
+            WARMUP.note_ladder(
+                "bg-compile-done", rung=self.rung, target=self.target,
+                wall_s=time.monotonic() - t0,
+            )
+            self._emit("bg-compile-done", self.rung)
+        finally:
+            self._done.set()
+
+    def _emit(self, kind: str, rung: int | None) -> None:
+        if BATCH_TRACER is not None:
+            from ..utils.trace import LadderEvent
+
+            BATCH_TRACER(LadderEvent(kind, rung, self.target))
+
+
+_LADDER: WarmLadder | None = None
+
+
+def reset_warm_ladder() -> None:
+    """Test isolation: forget the process-wide ladder."""
+    global _LADDER
+    _LADDER = None
+
+
+def _maybe_ladder(max_batch: int) -> WarmLadder | None:
+    """Create (once per process) or return the warm ladder for a device
+    replay. Engages only when the production path is the aggregate
+    monolith (OCT_VRF_AGG on, bc windows — on every other path the cold
+    programs are the individually-small split stages and re-tiling buys
+    nothing) and, in auto mode, only when an exported wall deadline
+    says the monolith's predicted compile does not fit."""
+    global _LADDER
+    mode = os.environ.get("OCT_WARM_LADDER", "1")
+    if mode == "0":
+        return None
+    if _LADDER is not None:
+        return _LADDER
+    if not _agg_enabled():
+        return None
+    from ..analysis import costmodel
+
+    target = bucket_size(max_batch)
+    rungs = tuple(r for r in costmodel.LADDER_RUNGS if r < target)
+    if not rungs:
+        return None
+    graph = "aggregate_core"
+    pred = costmodel.predicted_wall(graph)
+    if mode != "force":
+        deadline = costmodel.wall_deadline()
+        if deadline is None or pred is None:
+            return None
+        if pred + costmodel.PREFLIGHT_MARGIN_S <= deadline - time.time():
+            return None  # the monolith fits: compile it up front
+    rung = costmodel.choose_rung(graph, rungs=rungs)
+    _LADDER = WarmLadder(target, rung, graph, pred)
+    return _LADDER
 
 
 class PackedVerdicts:
@@ -2557,6 +2896,9 @@ def _validate_chain_loop(
         ).state.epoch_nonce
 
     inflight: deque = deque()  # (seg_idx, window_hvs, window_start, pre, future)
+    # windows staged (possibly on the producer thread) but not yet
+    # dispatched: (seg_idx, window_hvs, window_start, staged-or-future)
+    staged: deque = deque()
     s_stage = 0  # segment currently being staged
     w = segments[0][1] if segments else 0
     retired = 0  # index of the next header to retire
@@ -2567,44 +2909,136 @@ def _validate_chain_loop(
     # host-folded state once the pipeline drains.
     carry = _state_carry(state)
     carry_ok = True
+    # warm-while-serving compile ladder: while the production-bucket
+    # aggregate monolith compiles on a background thread, windows slice
+    # at the rung lane cap; the loop re-tiles the moment it lands
+    # (poll_swap after each retire). Window re-tiling never changes
+    # verdicts — validate_batch is segmentation-invariant.
+    ladder = _maybe_ladder(max_batch)
+    # producer thread: prechecks + packed staging + padding run ahead
+    # of dispatch (prepare_window is fold-independent), overlapping the
+    # staging wall with device compute and the retire-side epilogue.
+    # Backpressure at pipeline_depth on EACH side of the double buffer:
+    # up to pipeline_depth windows staged-but-undispatched AND up to
+    # pipeline_depth dispatched-but-unretired (without the thread the
+    # staged deque never exceeds one window, so the memory bound is the
+    # round-9 one; with it, at most 2 x pipeline_depth windows are
+    # alive — ~8 MB packed each at 8192 lanes, still far under HBM).
+    stage_pool = None
+    if _stage_thread_enabled():
+        from concurrent.futures import ThreadPoolExecutor
 
-    while retired < n or inflight:
+        stage_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="oct-stage"
+        )
+    try:
+        return _device_loop(
+            params, hvs, max_batch, pipeline_depth, pool, stage_pool,
+            segments, lview_for, eta_known, inflight, staged, s_stage, w,
+            retired, carry, carry_ok, ladder, state, total_valid, n,
+        )
+    finally:
+        if stage_pool is not None:
+            # discarded staging futures belong to windows nobody will
+            # dispatch (early error return) — never block exit on them
+            stage_pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _device_loop(
+    params, hvs, max_batch, pipeline_depth, pool, stage_pool,
+    segments, lview_for, eta_known, inflight, staged, s_stage, w,
+    retired, carry, carry_ok, ladder, state, total_valid, n,
+):
+    def enqueue_staging():
+        nonlocal s_stage, w
+        cap = ladder.cap() if ladder is not None else None
         while (
             s_stage < len(segments)
-            and len(inflight) < pipeline_depth
+            and (
+                # producer thread: stage ahead up to pipeline_depth
+                # regardless of the in-flight side (double buffer)
+                len(staged) < pipeline_depth
+                if stage_pool is not None
+                # inline (OCT_STAGE_THREAD=0): stage only what can
+                # dispatch immediately — the round-9 loop exactly
+                else not staged and len(inflight) < pipeline_depth
+            )
             and s_stage in eta_known
         ):
             _, _, seg_end = segments[s_stage]
-            j = min(w + max_batch, seg_end)
+            j_full = min(w + max_batch, seg_end)
+            j = j_full
+            if cap is not None and j - w > cap:
+                j = w + cap
+                ladder.note_engaged_once()
             # a window must stage a uniform proof column: break at the
             # first 80/128-byte format change (the reference fold
             # length-dispatches per header, so mixed chains stay valid;
             # segmentation never changes verdicts or the first error)
             j = _proof_break(hvs, w, j)
             whvs = hvs[w:j]
-            pre, out, b, carry_out = dispatch_batch(
-                params, lview_for(s_stage), eta_known[s_stage], whvs,
-                carry=carry if carry_ok else None,
-            )
-            if carry_out is None:
-                carry_ok = False
+            if stage_pool is not None:
+                item = stage_pool.submit(
+                    prepare_window, params, lview_for(s_stage),
+                    eta_known[s_stage], whvs,
+                )
             else:
-                carry = carry_out
-            inflight.append(
-                (s_stage, whvs, w, pre, out.meta,
-                 pool.submit(materialize_verdicts, out, b))
-            )
+                item = prepare_window(
+                    params, lview_for(s_stage), eta_known[s_stage], whvs
+                )
+            staged.append((s_stage, whvs, w, item))
             w = j
             if w >= seg_end:
                 s_stage += 1
                 if s_stage < len(segments):
                     w = segments[s_stage][1]
 
+    def drain_dispatch():
+        # dispatch staged windows IN ORDER (the device carry chains
+        # dispatch-to-dispatch) while the in-flight side of the double
+        # buffer has room: drain every ready one; when nothing is in
+        # flight, block on the staging head — otherwise let a
+        # materialize retire while the producer keeps staging
+        nonlocal carry, carry_ok
+        while staged and len(inflight) < pipeline_depth:
+            s_w, whvs_w, w_start_w, item = staged[0]
+            if stage_pool is not None and hasattr(item, "result"):
+                if not item.done() and inflight:
+                    break
+                item = item.result()
+            staged.popleft()
+            pre, out, b, carry_out = dispatch_prepared(
+                item, carry if carry_ok else None, ladder
+            )
+            if carry_out is None:
+                carry_ok = False
+            else:
+                carry = carry_out
+            inflight.append(
+                (s_w, whvs_w, w_start_w, pre, out.meta,
+                 pool.submit(materialize_verdicts, out, b))
+            )
+
+    while retired < n or inflight or staged:
+        # alternate stage/dispatch to a FIXPOINT: the inline
+        # (OCT_STAGE_THREAD=0) mode stages one window at a time and
+        # dispatches it immediately, so the in-flight side still fills
+        # to pipeline_depth exactly as the round-9 loop did (staging a
+        # single window per outer iteration would cap the pipeline at
+        # ONE window in flight); the threaded mode reaches the same
+        # fixpoint in one or two rounds
+        while True:
+            before = (len(staged), len(inflight), w, s_stage)
+            enqueue_staging()
+            drain_dispatch()
+            if (len(staged), len(inflight), w, s_stage) == before:
+                break
+
         if not inflight:
             # eta for s_stage not derivable before its predecessor fully
             # retires (no header past the freeze slot) — the retire path
-            # below will publish it; nothing in flight means we can
-            # compute it right now from the fully-folded state
+            # below will publish it; nothing staged or in flight means we
+            # can compute it right now from the fully-folded state
             eta_known[s_stage] = praos.tick(
                 params, lview_for(s_stage),
                 _slot_at(hvs, segments[s_stage][1]), state,
@@ -2613,6 +3047,12 @@ def _validate_chain_loop(
                 carry = _state_carry(state)
                 carry_ok = True
             continue
+
+        # refill the staging side BEFORE blocking on the retire below:
+        # dispatching just freed buffer room, and the producer must be
+        # working through the device wait — without this the staging
+        # thread idled during every retire block (the whole overlap)
+        enqueue_staging()
 
         s_b, whvs, w_start, pre, meta, fut = inflight.popleft()
         t_m0 = time.monotonic()
@@ -2638,6 +3078,10 @@ def _validate_chain_loop(
         if res.error is not None:
             return BatchResult(state, total_valid, res.error)
         retired += len(whvs)
+        if ladder is not None:
+            # the background production compile landed: record the swap
+            # — the NEXT slices re-tile onto the production bucket
+            ladder.poll_swap()
         if not carry_ok and not inflight:
             # the generic window that broke the chain has retired and
             # nothing dispatched after it is in flight: re-seed the

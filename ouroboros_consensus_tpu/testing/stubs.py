@@ -1,0 +1,159 @@
+"""Hash-only crypto stubs for pipeline tests and the profiling twin.
+
+The full curve graphs take minutes to compile on XLA:CPU; these stubs
+keep every NON-crypto part of the batched pipeline byte-exact — packed
+staging, device unpack, verdict bitmasks, the chained nonce scan,
+carries, epilogue — while replacing the three verifier subgraphs with
+an all-valid verdict plus the REAL eta / leader-value range extensions
+(the Blake2b tail the nonce fold and leader compare consume). The
+differential suites (tests/test_packed_batch.py, test_columnar.py,
+test_warm_ladder.py) and the `scripts/profile_replay.py --overlap-ab`
+stubbed-crypto device twin share this one implementation.
+
+`stub_agg_program` additionally stands in for the aggregated
+(RLC/MSM) window program with the SAME output contract as
+protocol/batch._jitted_packed_agg — limb-first eta/leader-value
+handles, verdict_reduce outputs — wrapped in `_warm_timed` so the
+warm-ladder machinery (first-execute labels, background compile,
+swap) exercises its real code path. An optional per-lane-count delay
+simulates a compile wall (the slow-compile stub of the ladder tests
+and the cold-cache harness)."""
+
+from __future__ import annotations
+
+import time
+
+from jax import numpy as jnp
+
+from ..ops import blake2b
+
+
+def stub_verify(*cols):
+    """All-valid crypto stub with the real eta / leader-value range
+    extensions. Arity-generic (21 draft-03 / 22 batch-compatible
+    columns): beta_decl is always the third-from-last column."""
+    from ..protocol import batch as pbatch
+
+    beta_decl = cols[-3]
+    bd = jnp.asarray(beta_decl).astype(jnp.int32)
+    b = bd.shape[0]
+    tag_l = jnp.broadcast_to(jnp.asarray([ord("L")], jnp.int32), (b, 1))
+    lv = blake2b.blake2b_fixed(jnp.concatenate([tag_l, bd], axis=-1), 65, 32)
+    tag_n = jnp.broadcast_to(jnp.asarray([ord("N")], jnp.int32), (b, 1))
+    eta1 = blake2b.blake2b_fixed(jnp.concatenate([tag_n, bd], axis=-1), 65, 32)
+    eta = blake2b.blake2b_fixed(eta1, 32, 32)
+    ones = jnp.ones((b,), bool)
+    return pbatch.Verdicts(ones, ones, ones, ones, jnp.zeros((b,), bool),
+                           eta, lv)
+
+
+def _first_exec_delay(delay_s, seen: set):
+    """Host-side sleep on the FIRST call per argument lane count — the
+    simulated compile wall (sleep releases the GIL, so a background
+    'compile' overlaps the foreground replay exactly like XLA does)."""
+
+    def maybe_sleep(lanes: int) -> None:
+        if not delay_s:
+            return
+        if lanes in seen:
+            return
+        seen.add(lanes)
+        d = delay_s(lanes) if callable(delay_s) else float(delay_s)
+        if d > 0:
+            time.sleep(d)
+
+    return maybe_sleep
+
+
+def stub_agg_program_builder(delay_s=None):
+    """A drop-in for protocol/batch._jitted_packed_agg: same output
+    contract (verdict_reduce outputs + limb-first flags/eta/lv
+    handles), crypto stubbed, `_warm_timed`-wrapped so first-execute
+    labels, the compile gate and the warm ladder see the real
+    machinery. `delay_s` (float or callable(lanes)->float) injects a
+    simulated compile wall on the first execute per lane count."""
+    import jax
+
+    from ..protocol import batch as pbatch
+
+    seen: set = set()
+    sleep = _first_exec_delay(delay_s, seen)
+
+    def builder(layout, scan):
+        key = ("stub-agg", layout, scan, bool(delay_s))
+        if key not in pbatch._JIT:
+
+            def fn(body, kes_rs, kt_idx, kt_tab, slot, counter, c0,
+                   thr_idx, thr_tab, nonce, within, n_real,
+                   ev0, ev0_set, cand0, cand0_set):
+                cols = pbatch.unpack_packed(
+                    layout, body, kes_rs, kt_idx, kt_tab, slot, counter,
+                    c0, thr_idx, thr_tab, nonce,
+                )
+                v = stub_verify(*cols)
+                flags = jnp.stack(
+                    [v.ok_ocert_sig, v.ok_kes_sig, v.ok_vrf, v.ok_leader,
+                     v.leader_ambiguous]
+                ).astype(jnp.int32)
+                red = pbatch.verdict_reduce(
+                    flags, v.eta, within, n_real, ev0, ev0_set, cand0,
+                    cand0_set, scan=scan,
+                )
+                return (red, flags, jnp.transpose(v.eta),
+                        jnp.transpose(v.leader_value))
+
+            jitted = jax.jit(fn)
+
+            class _SlowJit:
+                """Delegates to the jit but sleeps on the first touch
+                per lane count — through EITHER the call path or the
+                write-back's explicit trace/lower/compile path, so the
+                simulated wall lands wherever the real compile would."""
+
+                def __call__(self, *a):
+                    sleep(int(a[0].shape[0]))
+                    return jitted(*a)
+
+                def trace(self, *a):
+                    sleep(int(a[0].shape[0]))
+                    return jitted.trace(*a)
+
+            pbatch._JIT[key] = pbatch._warm_timed(
+                f"agg-packed:{layout.body_len}b:"
+                f"{'scan' if scan else 'noscan'}",
+                _SlowJit(),
+            )
+        return pbatch._JIT[key]
+
+    return builder
+
+
+def install_stub_crypto(monkeypatch=None, agg_delay_s=None):
+    """Patch the crypto entry points of protocol/batch with the stubs.
+    With a pytest `monkeypatch` the patches auto-revert; without one
+    (profile_replay — a one-shot script process) they are applied
+    directly. Covers the generic fused path, the packed xla path and
+    the aggregated path; the pk split path routes through
+    verify_praos_any inside the packed xla program."""
+    import jax
+
+    from ..protocol import batch as pbatch
+
+    def setattr_(name, value):
+        if monkeypatch is not None:
+            monkeypatch.setattr(pbatch, name, value)
+        else:
+            setattr(pbatch, name, value)
+
+    setattr_("verify_praos", stub_verify)
+    setattr_("verify_praos_bc", stub_verify)
+    setattr_("verify_praos_any", stub_verify)
+
+    def patched_jv(bc=False):
+        key = ("fn-stub", bc)
+        if key not in pbatch._JIT:
+            pbatch._JIT[key] = jax.jit(stub_verify)
+        return pbatch._JIT[key]
+
+    setattr_("_jitted_verify", patched_jv)
+    setattr_("_jitted_packed_agg", stub_agg_program_builder(agg_delay_s))

@@ -384,6 +384,53 @@ def _epoch_window_segments(params: PraosParams, wins):
         yield from flush(acc)
 
 
+def _prefetch_iter(gen, depth: int = 2):
+    """Pull a generator on a background thread through a bounded queue:
+    the view-stream (disk read + integrity walk + native column
+    extraction) of segment k+1 runs while segment k validates on
+    device — part of the round-10 threaded staging pipeline
+    (OCT_STAGE_THREAD=0 restores the inline pull). Exceptions from the
+    stream are forwarded to the consumer; an early consumer exit
+    (first-failure truncation) stops the pump without blocking."""
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+    end = object()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def pump():
+        try:
+            for item in gen:
+                if not _put(item):
+                    return
+            _put(end)
+        except BaseException as e:  # noqa: BLE001 — forwarded, re-raised
+            _put(e)
+
+    t = threading.Thread(target=pump, daemon=True, name="oct-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is end:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
 def revalidate(
     db_path: str,
     params: PraosParams,
@@ -557,7 +604,13 @@ def _revalidate_impl(
         wins = _stream_windows(imm, res)
         if max_headers is not None:
             wins = _cap_windows(wins, max_headers)
-        for seg in _epoch_window_segments(params, wins):
+        segs = _epoch_window_segments(params, wins)
+        if backend == "device" and pbatch._stage_thread_enabled():
+            # prefetch the NEXT epoch segment's disk/parse/column work
+            # while this one validates — the device loop's staging
+            # thread then overlaps prechecks+staging within the segment
+            segs = _prefetch_iter(segs, depth=2)
+        for seg in segs:
             ts = time.monotonic()
             result = pbatch.validate_chain(
                 params, lambda _e: lview, st, seg,

@@ -147,6 +147,19 @@ class WindowStaged:
 
 
 @dataclass(frozen=True)
+class LadderEvent:
+    """One warm-while-serving compile-ladder transition
+    (protocol/batch.WarmLadder): the replay engaged a rung, the
+    background production-bucket compile started/landed, or the loop
+    re-tiled windows onto the production executables (`swap`)."""
+
+    kind: str  # "engaged" | "bg-compile-started" | "bg-compile-done"
+    # | "bg-compile-failed" | "swap"
+    rung: int | None  # active rung lane cap (None = production)
+    target: int  # production bucket lane count
+
+
+@dataclass(frozen=True)
 class AggRedispatch:
     """An aggregated (RLC/MSM) window came back dirty: its per-lane
     flags are meaningless, so materialize_verdicts re-dispatched the

@@ -1,12 +1,21 @@
-"""Deviceless AOT precompile of the pk stage programs for v5e.
+"""Deviceless AOT artifact BUILDER for the v5e stage programs.
 
 Compiles every per-stage jit of the production pk dispatch
 (ops/pk/kernels.verify_praos_split) against a v5e TopologyDescription
-using libtpu's compile-only client — NO tunnel, no device — and
-serializes the PJRT executables into scripts/aot_cache/.  A live TPU
-session (OCT_PK_AOT=1) then deserializes and runs them instead of
-compiling, so a flaky-tunnel window spends ~0 s in Mosaic and goes
-straight to measurement (VERDICT r4 item 1b).
+using libtpu's compile-only client — NO tunnel, no device — and saves
+the PJRT executables into the build-pinned artifact store
+(ops/pk/aot.py: scripts/aot_cache/<build-slug>/ + manifest).  A live
+TPU session (OCT_PK_AOT=1) then loads instead of compiling, so a
+flaky-tunnel window spends ~0 s in Mosaic and goes straight to
+measurement (VERDICT r4 item 1b).
+
+The store is keyed by RUNTIME BUILD: export
+``OCT_AOT_BUILD_ID='<platform_version>'`` (take it from a previous
+round's banked ``build_id``) so the artifacts are filed under the
+runtime that will load them — without it they land under this box's
+own build and the TPU child skips them as ``wrong_build`` (a zero-cost
+skip, not a ~15 s rejected deserialize; the child's write-back then
+populates the store itself).
 
 Shape discovery replays the EXACT batching the bench replay performs
 (epoch segments -> max_batch slices -> power-of-two padding) over the
@@ -14,8 +23,12 @@ cached bench chain, so every executable matches a real batch signature
 — including the per-batch KES hash-block count, which tracks the
 longest signed header bytes in each batch.
 
-Usage: python scripts/aot_precompile.py [--headers N]
-Env: BENCH_HEADERS/BENCH_KES_DEPTH/BENCH_MAX_BATCH as bench.py.
+Usage: python scripts/aot_precompile.py [--check]
+  --check: compile nothing — verify every manifest entry of the
+           CURRENT build's store deserializes under this runtime
+           (exit 1 on any problem).
+Env: BENCH_HEADERS/BENCH_KES_DEPTH/BENCH_MAX_BATCH as bench.py;
+     OCT_AOT_BUILD_ID pins artifact provenance (see above).
 """
 
 import functools
@@ -132,7 +145,12 @@ def compile_stage(name, fn, in_sds, b, manifest):
     was written (False = an on-disk entry was reused)."""
     sig = aot.sig_of(in_sds)
     path = aot.stage_path(name, b, KES_DEPTH, K.TILE, sig)
-    if os.path.exists(path):
+    key = aot.entry_key(name, b, KES_DEPTH, K.TILE, sig)
+    # cached means artifact AND manifest row: a crash between the
+    # artifact write and the manifest update (or a corrupt manifest)
+    # orphans the file — load() gates on the manifest, so an orphan is
+    # permanently "missing" unless the builder heals the row here
+    if os.path.exists(path) and key in aot.read_manifest():
         print(f"  {name:8s} sig={sig} — cached", flush=True)
         return False
     predicted = _predicted_wall(name)
@@ -174,6 +192,18 @@ def compile_stage(name, fn, in_sds, b, manifest):
     return True
 
 
+def check() -> int:
+    """--check: every manifest entry of the current build's store must
+    deserialize under THIS runtime (the store's loadability contract —
+    run it on the target box before a bench session)."""
+    ok, problems = aot.check_store()
+    print(f"store {aot.store_dir()} (build {aot.build_id()!r}): "
+          f"{ok} entr(y/ies) deserialize clean")
+    for p in problems:
+        print(f"  PROBLEM: {p}")
+    return 1 if problems else 0
+
+
 def main():
     t0 = time.time()
     path, params, lview = build_or_load_chain()
@@ -183,23 +213,19 @@ def main():
     print(f"discovered {len(combos)} distinct batch signature(s) in "
           f"{time.time()-t0:.1f}s: "
           f"{[(b, len(r.signed_bytes)) for b, r in combos]}", flush=True)
+    print(f"store: {aot.store_dir()} (build {aot.build_id()!r})", flush=True)
+    if not os.environ.get("OCT_AOT_BUILD_ID"):
+        print("# note: OCT_AOT_BUILD_ID unset — artifacts are pinned to "
+              "THIS box's runtime; a TPU child on another build will "
+              "skip them as wrong_build", flush=True)
 
+    # compile-run log (predicted vs actual walls per stage) beside the
+    # store's own provenance manifest
     manifest = []
-    manifest_path = os.path.join(aot.aot_dir(), "MANIFEST.json")
+    manifest_path = os.path.join(aot.aot_dir(), "COMPILE_LOG.json")
     if os.path.exists(manifest_path):
         with open(manifest_path) as f:
             manifest = json.load(f)
-    # provenance marker: the serialized executables are only loadable by
-    # the runtime build that compiled them (bench.py's child compares
-    # this against its own platform_version and skips the AOT load path
-    # on mismatch instead of paying a ~15 s rejected deserialize)
-    os.makedirs(aot.aot_dir(), exist_ok=True)
-    try:
-        aot_build = jax.devices()[0].client.platform_version
-    except Exception:
-        aot_build = f"jax-{jax.__version__}"
-    with open(os.path.join(aot.aot_dir(), "BUILD_ID"), "w") as f:
-        f.write(aot_build)
     fresh: list = []
     for bucket, rep in combos:
         print(f"batch bucket={bucket} kes_msg={len(rep.signed_bytes)}B",
@@ -263,12 +289,17 @@ def main():
             json.dump(manifest, f, indent=1)
     # clear a persisted per-build rejection ONLY when this run wrote
     # EVERY entry itself: a cached early-return may be reusing exactly
-    # the stale executables the REJECTED marker records
+    # the stale executables the REJECTED marker records (fresh saves
+    # post-date the marker anyway — ops/pk/aot.load trusts those — but
+    # an all-fresh store deserves a clean slate)
     if fresh and all(fresh):
         aot.clear_rejection()
-    print(f"done in {time.time()-t0:.0f}s; manifest: {manifest_path}",
+    print(f"done in {time.time()-t0:.0f}s; store manifest: "
+          f"{aot.manifest_path()}; compile log: {manifest_path}",
           flush=True)
 
 
 if __name__ == "__main__":
+    if "--check" in sys.argv[1:]:
+        sys.exit(check())
     main()

@@ -239,6 +239,17 @@ def main(argv: list[str] | None = None) -> int:
             names = _select_graphs(_changed_files())
         todo = names if names is not None else absint.certifiable_graphs()
         budgets = graphs.load_budgets()
+        # warm-ladder rung pins (costmodel.ladder_pins): every rung
+        # program the ladder may compile gets its own cost features,
+        # ratcheted by the SAME compile_wall + pin-freshness passes as
+        # the registry graphs (they carry no device_resources pins —
+        # structurally they are the base graphs at rung lane counts).
+        # --changed selects them through their base graph, so an edit
+        # to the aggregate/msm sources re-fences every rung; the ladder
+        # ORCHESTRATION lives in protocol/batch.py, which already maps
+        # onto packed_unpack/verdict_reduce (cost re-extract) and the
+        # instrumentation-purity differential.
+        ladder_features = []
         for name in todo:
             # one trace per graph serves certification, jaxpr budgets,
             # point-op budgets and compile-cost features (trace_graph
@@ -261,6 +272,11 @@ def main(argv: list[str] | None = None) -> int:
                 budget_violations += graphs.check_point_ops(
                     budgets, names=[name]
                 )
+        for pin_name, base, lanes in costmodel.ladder_pins():
+            if base in todo:
+                ladder_features.append(costmodel.extract_features(
+                    graphs.trace_graph(base, lanes), pin_name
+                ))
         budget_violations += graphs.check_budgets(reports, budgets)
         # instrumentation purity: the registry graphs built from the
         # telemetry-instrumented host modules must gain ZERO equations
@@ -286,11 +302,14 @@ def main(argv: list[str] | None = None) -> int:
                 return 2
             model = (costmodel._cached_cost() or {}).get("model")
             costmodel.write_cost(
-                graphs_section=costmodel.pin_payload(cost_features, model)
+                graphs_section=costmodel.pin_payload(
+                    cost_features + ladder_features, model
+                )
             )
-            _update_compile_wall_budgets(cost_features)
+            _update_compile_wall_budgets(cost_features + ladder_features)
             print(f"costmodel.json pins updated: "
-                  f"{len(cost_features)} graph(s)")
+                  f"{len(cost_features)} graph(s) + "
+                  f"{len(ladder_features)} ladder rung pin(s)")
             return 0
         if args.update_resources:
             if names is not None:
@@ -324,11 +343,14 @@ def main(argv: list[str] | None = None) -> int:
             return 0
         cert_violations = absint.check_certified(cert_reports)
         cost_violations = costmodel.check_compile_wall(
-            cost_features, budgets
+            cost_features + ladder_features, budgets
         )
         # pin freshness: stale pins would stamp warmup stage notes with
-        # an old structure's hash and mis-join calibration walls
-        cost_violations += costmodel.check_pins(cost_features)
+        # an old structure's hash and mis-join calibration walls (the
+        # ladder rung pins are held to the same freshness)
+        cost_violations += costmodel.check_pins(
+            cost_features + ladder_features
+        )
         # sixth ratchet: device-resource pins (hash-freshness + ceiling
         # compares only — no lowering, no compiling)
         from ouroboros_consensus_tpu.obs import resources as obs_res

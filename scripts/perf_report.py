@@ -75,10 +75,26 @@ def _first_float(pattern: str, text: str) -> float | None:
     return float(m.group(1)) if m else None
 
 
-def _classify_failures(text: str, rc) -> list[dict]:
+def _classify_failures(text: str, rc, parsed: dict | None = None) -> list[dict]:
     out = []
+    # STRUCTURED classification first (round 10): bench.py banks the
+    # backend-probe verdict and a no_device_reason, so probe-timeout vs
+    # driver-timeout vs run-death no longer rides regex archaeology
+    probe = (parsed or {}).get("probe")
+    if isinstance(probe, dict) and not probe.get("ok"):
+        mode = probe.get("outcome") or "backend-probe"
+        attempts = probe.get("attempts") or []
+        out.append({
+            "mode": mode,
+            "detail": (f"backend probe verdict ({len(attempts)} "
+                       "attempt(s), banked by bench.py)"),
+        })
+    reason = (parsed or {}).get("no_device_reason")
+    if reason and not any(f["mode"] == reason for f in out):
+        out.append({"mode": reason,
+                    "detail": "bench.py's banked no-device reason"})
     for key, rx, desc in _FAILURE_PATTERNS:
-        if rx.search(text):
+        if rx.search(text) and not any(f["mode"] == key for f in out):
             out.append({"mode": key, "detail": desc})
     if rc not in (0, None):
         out.append({
@@ -122,12 +138,15 @@ def analyze_bench_round(path: str) -> dict:
     )
     wr = (parsed or {}).get("warmup_report")
     warmup = None
+    ladder_events: list = []
     if isinstance(wr, dict):
+        ladder_events = wr.get("ladder") or []
         warmup = {
             "compile_total_s": wr.get("compile_total_s"),
             "n_stages": wr.get("n_stages"),
             "aot": wr.get("aot"),
             "refusals": len(wr.get("refusals", [])),
+            "ladder": len(ladder_events),
             "cache_probe": (wr.get("cache_probe") or {}).get("outcome"),
         }
     row = {
@@ -144,9 +163,17 @@ def analyze_bench_round(path: str) -> dict:
                 if parsed and parsed.get("device_unavailable") else None),
         "warmup_wall_s": _first_float(r"warmup=(\d+(?:\.\d+)?)s", tail),
         "warmup": warmup,
+        # a LADDERED round banked its device number while the
+        # production monolith compiled in the background — its own
+        # class of round, not a warmup death (and for a dead round,
+        # evidence the ladder engaged before the wall)
+        "laddered": bool(ladder_events
+                         or (parsed or {}).get("laddered")),
+        "ladder_swapped": any(e.get("kind") == "swap"
+                              for e in ladder_events),
         "gate_declines": _gate_counts((parsed or {}).get("metrics")),
         "failures": ([] if device_banked
-                     else _classify_failures(tail, rc)),
+                     else _classify_failures(tail, rc, parsed)),
     }
     return row
 
@@ -328,7 +355,12 @@ def render_markdown(report: dict) -> str:
             r["native_baseline_per_s"] or "?",
             warm if warm is not None else "?",
             declines,
-            _md_escape(", ".join(f["mode"] for f in r["failures"]) or "—"),
+            _md_escape(
+                ", ".join(f["mode"] for f in r["failures"])
+                or ("laddered" + (" (swapped)" if r.get("ladder_swapped")
+                                  else "")
+                    if r.get("laddered") else "—")
+            ),
         ))
     dead = [r for r in rounds if not r["device_banked"]]
     if dead:
@@ -337,7 +369,19 @@ def render_markdown(report: dict) -> str:
             modes = "; ".join(
                 f"**{f['mode']}** ({f['detail']})" for f in r["failures"]
             )
+            if r.get("laddered"):
+                modes += " — warm ladder HAD engaged before the death"
             out.append(f"* r{r['round']:02d}: {modes}")
+    laddered = [r for r in rounds if r["device_banked"] and r.get("laddered")]
+    if laddered:
+        out += ["", "## Laddered rounds", ""]
+        for r in laddered:
+            out.append(
+                f"* r{r['round']:02d}: banked {r['value_per_s']} headers/s "
+                "while the production monolith compiled in the background"
+                + (" (swapped to production mid-replay)"
+                   if r.get("ladder_swapped") else " (no swap before end)")
+            )
     mc = report.get("multichip_rounds") or []
     if mc:
         out += ["", "## Multichip", ""]

@@ -1,0 +1,89 @@
+"""bench.py backend-probe hardening (round-10 satellite): r02-r04 each
+died on a single probe timeout. The probe now makes at most TWO
+attempts — one under the main probe budget, one backoff'd retry under
+its own small budget — and banks a structured verdict distinguishing
+probe-timeout (backend init hung) from probe-error (backend answered
+wrongly), which perf_report classifies without tail archaeology."""
+
+import subprocess
+
+import pytest
+
+import bench
+
+
+class _Done:
+    returncode = 0
+    stdout = "128\n"
+    stderr = ""
+
+
+class _Wrong:
+    returncode = 1
+    stdout = ""
+    stderr = "RuntimeError: device says no\n"
+
+
+@pytest.fixture
+def fast_clock(monkeypatch):
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+
+def test_probe_ok_first_attempt(monkeypatch, fast_clock):
+    calls = []
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda cmd, **kw: calls.append(kw) or _Done())
+    ok, verdict = bench.probe_device()
+    assert ok and len(calls) == 1
+    assert verdict["outcome"] == "ok"
+    assert verdict["attempts"][0]["outcome"] == "ok"
+
+
+def test_probe_timeout_retries_exactly_once(monkeypatch, fast_clock):
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(kw)
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    ok, verdict = bench.probe_device()
+    assert not ok
+    assert len(calls) == 2  # one retry, never a loop
+    assert verdict["outcome"] == "backend-probe-timeout"
+    assert [a["outcome"] for a in verdict["attempts"]] == \
+        ["probe-timeout", "probe-timeout"]
+    # the retry runs under its own small budget, not the main one
+    assert calls[1]["timeout"] <= bench.PROBE_RETRY_BUDGET
+
+
+def test_probe_recovers_on_retry(monkeypatch, fast_clock):
+    seq = [subprocess.TimeoutExpired("x", 1), _Done()]
+
+    def fake_run(cmd, **kw):
+        item = seq.pop(0)
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    ok, verdict = bench.probe_device()
+    assert ok and verdict["outcome"] == "ok"
+    assert [a["outcome"] for a in verdict["attempts"]] == \
+        ["probe-timeout", "ok"]
+
+
+def test_probe_error_classified_distinctly(monkeypatch, fast_clock):
+    monkeypatch.setattr(bench.subprocess, "run", lambda cmd, **kw: _Wrong())
+    ok, verdict = bench.probe_device()
+    assert not ok
+    assert verdict["outcome"] == "backend-probe-error"
+    assert all(a["outcome"] == "probe-error" for a in verdict["attempts"])
+    assert "device says no" in verdict["attempts"][0]["detail"]
+
+
+def test_probe_no_budget(monkeypatch):
+    monkeypatch.setattr(bench, "_remaining", lambda: 100.0)
+    ok, verdict = bench.probe_device()
+    assert not ok and verdict["outcome"] == "no-budget"
+    assert verdict["attempts"] == []

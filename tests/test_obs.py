@@ -996,3 +996,81 @@ def test_lint_changed_maps_obs_sources_to_purity_graphs():
     assert set(sel) == purity | {"aggregate_core", "msm"}
     # and still selects nothing for unrelated files
     assert lint._select_graphs({"README.md"}) == []
+
+
+# ---------------------------------------------------------------------------
+# round 10: warm-ladder events — counter family + Perfetto warmup track
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_events_counter_and_report():
+    from ouroboros_consensus_tpu.utils.trace import LadderEvent
+
+    reg = MetricsRegistry()
+    from ouroboros_consensus_tpu.obs.recorder import FlightRecorder
+
+    rec = FlightRecorder(reg)
+    for kind in ("engaged", "bg-compile-started", "bg-compile-done",
+                 "swap"):
+        rec(LadderEvent(kind, 1024, 8192))
+    snap = reg.snapshot()
+    kinds = {
+        s["labels"]["kind"]: s["value"]
+        for s in snap["oct_ladder_events_total"]["samples"]
+    }
+    assert kinds == {"engaged": 1, "bg-compile-started": 1,
+                     "bg-compile-done": 1, "swap": 1}
+
+
+def test_perfetto_ladder_track_renders_bg_compile_slice():
+    """The warmup track renders the background production compile as a
+    SLICE (started -> done) and every other ladder transition as an
+    instant — the compile the ladder hides is finally visible in the
+    wall visualizer."""
+    from ouroboros_consensus_tpu.obs.warmup import WARMUP
+
+    WARMUP.reset()
+    WARMUP.note_ladder("engaged", rung=1024, target=8192,
+                       graph="aggregate_core", predicted_s=757.9,
+                       feature_hash="216e9c5e109f6aa6")
+    WARMUP.note_ladder("bg-compile-started", rung=1024, target=8192,
+                       stage="agg-packed:410b:scan:8192l")
+    import time as _time
+
+    _time.sleep(0.02)
+    WARMUP.note_ladder("bg-compile-done", rung=1024, target=8192,
+                       wall_s=0.02)
+    WARMUP.note_ladder("swap", rung=1024, target=8192)
+    rec = obs.recorder()
+    doc = rec.chrome_trace()
+    assert perfetto.validate_chrome_trace(doc) == []
+    evs = doc["traceEvents"]
+    names = [e["name"] for e in evs]
+    (bg,) = [e for e in evs if e["name"].startswith(
+        "ladder background compile")]
+    assert bg["ph"] == "X" and bg["dur"] > 0
+    assert bg["tid"] == perfetto._TIDS["warmup"]
+    assert any(n.startswith("ladder: engaged") for n in names)
+    assert any(n.startswith("ladder: swap") for n in names)
+    # a FAILED background compile renders as a slice too (kind in args)
+    WARMUP.reset()
+    WARMUP.note_ladder("bg-compile-started", rung=1024, target=8192)
+    WARMUP.note_ladder("bg-compile-failed", rung=1024, target=8192,
+                       detail="RuntimeError('boom')")
+    doc2 = obs.recorder().chrome_trace()
+    assert perfetto.validate_chrome_trace(doc2) == []
+    assert any(e["name"] == "ladder background compile [failed]"
+               for e in doc2["traceEvents"])
+    WARMUP.reset()
+
+
+def test_warmup_ladder_notes_flush_and_reset(tmp_path, monkeypatch):
+    monkeypatch.setenv("OCT_WARMUP_REPORT", str(tmp_path / "wr.json"))
+    w = WarmupRecorder()
+    w.note_ladder("engaged", rung=1024, target=8192, predicted_s=757.9)
+    rep = json.load(open(tmp_path / "wr.json"))
+    (row,) = rep["ladder"]
+    assert row["kind"] == "engaged" and row["rung"] == 1024
+    assert row["predicted_s"] == 757.9 and "t" in row
+    w.reset()
+    assert w.report()["ladder"] == []

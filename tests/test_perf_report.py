@@ -173,3 +173,106 @@ def test_ledger_fold_reports_env_and_build_transitions(tmp_path,
     assert rep["ledger"]["runs"] == 2
     md = perf_report.render_markdown(rep)
     assert "OCT_VRF_AGG" in md
+
+
+# ---------------------------------------------------------------------------
+# round 10: structured probe classification + laddered rounds
+# ---------------------------------------------------------------------------
+
+
+def _write_round(tmp_path, n, parsed, tail="", rc=0):
+    doc = {"rc": rc, "tail": tail, "parsed": parsed}
+    p = os.path.join(tmp_path, f"BENCH_r{n:02d}.json")
+    with open(p, "w") as f:
+        json.dump(doc, f)
+    return p
+
+
+def test_structured_probe_verdict_classifies_distinctly(tmp_path):
+    """bench.py now BANKS the probe verdict: probe-timeout vs
+    driver-timeout vs run-death are separated structurally, no regex
+    archaeology on the tail."""
+    p = _write_round(
+        tmp_path, 6,
+        {"value": 2100.0, "device_unavailable": True,
+         "no_device_reason": "backend-probe-timeout",
+         "probe": {"ok": False, "outcome": "backend-probe-timeout",
+                   "attempts": [
+                       {"outcome": "probe-timeout", "wall_s": 90.0},
+                       {"outcome": "probe-timeout", "wall_s": 60.0},
+                   ]}},
+        tail="# device probe failed (attempt 2): probe timed out",
+    )
+    row = perf_report.analyze_bench_round(p)
+    assert not row["device_banked"]
+    modes = [f["mode"] for f in row["failures"]]
+    assert modes[0] == "backend-probe-timeout"
+    assert modes.count("backend-probe-timeout") == 1  # deduped vs regex
+    # a probe that ANSWERED WRONGLY is a different failure class
+    p2 = _write_round(
+        tmp_path, 7,
+        {"value": 2100.0, "device_unavailable": True,
+         "no_device_reason": "backend-probe-error",
+         "probe": {"ok": False, "outcome": "backend-probe-error",
+                   "attempts": [{"outcome": "probe-error",
+                                 "wall_s": 3.0, "detail": "boom"}]}},
+    )
+    row2 = perf_report.analyze_bench_round(p2)
+    assert [f["mode"] for f in row2["failures"]][0] == "backend-probe-error"
+    # run-death after a GOOD probe classifies as the banked reason
+    p3 = _write_round(
+        tmp_path, 8,
+        {"value": 2100.0, "device_unavailable": True,
+         "no_device_reason": "device-run-failed-or-wall",
+         "probe": {"ok": True, "outcome": "ok", "attempts": []}},
+    )
+    row3 = perf_report.analyze_bench_round(p3)
+    modes3 = [f["mode"] for f in row3["failures"]]
+    assert "device-run-failed-or-wall" in modes3
+    assert not any(m.startswith("backend-probe") for m in modes3)
+
+
+def test_laddered_round_is_its_own_class(tmp_path):
+    """A round that banked THROUGH the warm ladder renders as
+    'laddered', not lumped with warmup deaths; a dead round with ladder
+    events keeps its failure modes but notes the engagement."""
+    ladder = [
+        {"kind": "engaged", "rung": 1024, "target": 8192, "t": 1.0},
+        {"kind": "bg-compile-started", "rung": 1024, "target": 8192,
+         "t": 1.1},
+        {"kind": "bg-compile-done", "rung": 1024, "target": 8192,
+         "wall_s": 410.0, "t": 411.1},
+        {"kind": "swap", "rung": 1024, "target": 8192, "t": 411.2},
+    ]
+    p = _write_round(
+        tmp_path, 6,
+        {"value": 4100.0, "vs_baseline": 2.1, "laddered": True,
+         "metric": "end-to-end db-analyser revalidation of a "
+                   "1000000-header synthetic Praos chain",
+         "warmup_report": {"ladder": ladder, "stages": {},
+                           "aot": {}, "refusals": []}},
+    )
+    row = perf_report.analyze_bench_round(p)
+    assert row["device_banked"] and row["laddered"] and row["ladder_swapped"]
+    assert row["failures"] == []
+    assert row["warmup"]["ladder"] == 4
+    report = {"bench_rounds": [row], "multichip_rounds": [],
+              "ledger": None, "verdicts": [], "ok": True}
+    md = perf_report.render_markdown(report)
+    assert "laddered (swapped)" in md
+    assert "## Laddered rounds" in md
+    # dead-but-laddered: failure modes survive, engagement noted
+    p2 = _write_round(
+        tmp_path, 7,
+        {"value": 2100.0, "device_unavailable": True,
+         "no_device_reason": "device-run-failed-or-wall",
+         "warmup_report": {"ladder": ladder[:2], "stages": {},
+                           "aot": {}, "refusals": []}},
+        rc=124,
+    )
+    row2 = perf_report.analyze_bench_round(p2)
+    assert not row2["device_banked"] and row2["laddered"]
+    md2 = perf_report.render_markdown(
+        {"bench_rounds": [row2], "multichip_rounds": [], "ledger": None,
+         "verdicts": [], "ok": False})
+    assert "warm ladder HAD engaged" in md2

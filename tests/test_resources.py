@@ -150,24 +150,32 @@ def test_stage_call_captures_on_first_execute(monkeypatch):
 def test_warm_timed_captures_xla_twin(monkeypatch):
     """The protocol/batch XLA-twin hook: _warm_timed wraps the jit, the
     first call records both the warmup wall AND the resources, with
-    lanes read off the leading batch axis."""
+    lanes read off the leading batch axis. Since round 10 the
+    first-execute label is LANE-QUALIFIED (`<stage>:<lanes>l`) — the
+    warm ladder runs the same program family at rung and production
+    lane counts, and each shape's compile attributes separately."""
     from ouroboros_consensus_tpu.obs.warmup import WARMUP
     from ouroboros_consensus_tpu.protocol import batch as pbatch
 
     monkeypatch.setenv("OCT_STAGE_RESOURCES", "1")
     WARMUP.reset()
-    pbatch._WARM_SEEN.discard("restest-twin")
+    pbatch._WARM_SEEN.discard("restest-twin:6l")
     try:
         wrapped = pbatch._warm_timed("restest-twin",
                                      jax.jit(lambda x: x.sum(axis=1)))
         wrapped(np.ones((6, 3), np.float32))
         wrapped(np.ones((6, 3), np.float32))
         rep = R.RESOURCES.report()
-        assert "restest-twin|6|None" in rep
-        assert rep["restest-twin|6|None"]["via"] == "xla-jit"
-        assert "restest-twin" in WARMUP.report()["stages"]
+        assert "restest-twin:6l|6|None" in rep
+        assert rep["restest-twin:6l|6|None"]["via"] == "xla-jit"
+        assert "restest-twin:6l" in WARMUP.report()["stages"]
+        # a DIFFERENT lane count is a separate first execute
+        wrapped(np.ones((4, 3), np.float32))
+        assert "restest-twin:4l" in WARMUP.report()["stages"]
     finally:
-        pbatch._WARM_SEEN.discard("restest-twin")
+        pbatch._WARM_SEEN.discard("restest-twin:6l")
+        pbatch._WARM_SEEN.discard("restest-twin:4l")
+        WARMUP.reset()
 
 
 def test_capture_never_raises(monkeypatch):
