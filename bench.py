@@ -82,10 +82,36 @@ JAX_CACHE_PATH_FILE = os.path.join(CACHE_DIR, "jax_cache_path.txt")
 # round JSON banks as `warmup_report` (the r02-r05 failure mode must
 # produce forensics, not silence)
 WARMUP_REPORT_PATH = os.path.join(CACHE_DIR, "warmup_report.json")
+# the child's live heartbeat (obs/live.py): atomically rewritten every
+# ~2 s so the parent (and scripts/tpu_watchdog.sh) can tell compiling /
+# staging / running / stalled / dead apart WHILE the child runs — the
+# r02-r05 rounds were black boxes until the wall killed them
+HEARTBEAT_PATH = os.path.join(CACHE_DIR, "heartbeat.json")
+# stall-watchdog no-progress budget for the child (seconds); generous
+# against real compile walls — the warmup recorder notes every first
+# execute, which COUNTS as progress, so only a genuine wedge trips it
+STALL_BUDGET_S = os.environ.get("OCT_STALL_BUDGET_S", "240")
 
 
 def _warmup_report_path() -> str:
     return os.environ.get("OCT_WARMUP_REPORT") or WARMUP_REPORT_PATH
+
+
+def _heartbeat_path() -> str:
+    return os.environ.get("OCT_HEARTBEAT") or HEARTBEAT_PATH
+
+
+def _stall_dump_path() -> str:
+    # obs/live.stall_dump_path derives "next to the warmup report" in
+    # the CHILD; mirror the resolution here so the parent reads the
+    # same file the child writes
+    explicit = os.environ.get("OCT_STALL_DUMP")
+    if explicit:
+        return explicit
+    return os.path.join(
+        os.path.dirname(os.path.abspath(_warmup_report_path())),
+        "stall_dump.json",
+    )
 
 
 def _read_warmup_report(path: str | None = None) -> dict | None:
@@ -218,7 +244,14 @@ def probe_device() -> tuple[bool, dict]:
 
 
 _DEVICE_CHILD = r"""
-import hashlib, json, os, shutil, sys, time
+import faulthandler, hashlib, json, os, shutil, signal, sys, time
+
+# a driver-timeout SIGTERM must leave a stack trace in the banked tail
+# instead of an empty truncation: register BEFORE anything slow (jax
+# import included) so even an import-time kill names where it was.
+# stderr is teed into the parent's child log -> the round JSON tail.
+faulthandler.register(signal.SIGTERM, all_threads=True, chain=True)
+
 import jax
 
 # --- persistent-cache keying + startup probe (VERDICT r6 item 1) -----------
@@ -324,6 +357,14 @@ from ouroboros_consensus_tpu.tools import db_analyser as ana
 # attribution, dispatch->materialize latency histograms) — per-window
 # cost only, and the warmup recorder is flushing to OCT_WARMUP_REPORT
 _rec = _obs.install()
+# the LIVE plane for the child's whole life (not just inside each
+# revalidate): heartbeat file every ~2 s + stall watchdog + optional
+# in-run HTTP endpoint — the parent tails the heartbeat to classify
+# this child in real time (obs/live.py; armed iff the levers are set,
+# which the parent guarantees)
+from ouroboros_consensus_tpu.obs import live as _live
+
+_live.maybe_arm(_rec)
 
 path, params, lview = build_or_load_chain()
 def emit(n, best, warm, attrib=None, warm_estimate=None):
@@ -505,6 +546,98 @@ def _attempt2_estimate(est: float | None, budget_1: float) -> float:
     return budget_1 * 0.5
 
 
+class _HeartbeatTail:
+    """Parent-side tail of the child's heartbeat file: poll every few
+    seconds, classify (obs/live.classify: compiling / staging / running
+    / stalled / dead / no-heartbeat), and record a STRUCTURED timeline
+    entry at every classification change — the live story of the round,
+    banked into the round JSON + ledger as `live_timeline` so a dead
+    round's last entry says what it LOOKED like when it died."""
+
+    POLL_S = 3.0
+
+    def __init__(self, path: str, timeline: list, attempt: int):
+        import threading
+
+        from ouroboros_consensus_tpu.obs import live as _live
+
+        self._live = _live
+        self.path = path
+        self.timeline = timeline
+        self.attempt = attempt
+        self._t0 = time.monotonic()
+        self._state = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="bench-hb-tail", daemon=True
+        )
+        self._thread.start()
+
+    def _poll(self) -> None:
+        doc = self._live.read_heartbeat(self.path)
+        state = self._live.classify(doc)
+        if state == self._state:
+            return
+        self._state = state
+        entry = {
+            "t": round(time.monotonic() - self._t0, 1),
+            "attempt": self.attempt,
+            "state": state,
+        }
+        if isinstance(doc, dict):
+            entry["phase"] = doc.get("phase")
+            entry["headers"] = doc.get("headers")
+            entry["age_s"] = doc.get("age_s")
+            if doc.get("headers_per_s") is not None:
+                entry["headers_per_s"] = doc["headers_per_s"]
+        self.timeline.append(entry)
+        print(f"# live: {state}"
+              + (f" (phase={entry.get('phase')}, "
+                 f"headers={entry.get('headers')})"
+                 if "phase" in entry else ""),
+              file=sys.stderr)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.POLL_S):
+            try:
+                self._poll()
+            except Exception:  # noqa: BLE001 — tailing never kills bench
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=self.POLL_S + 5)
+        try:
+            self._poll()  # final classification (usually dead/finished)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _read_stall_dump(path: str | None = None) -> dict | None:
+    """Read + slim the child's stall forensics (obs/live.StallWatchdog):
+    keep the classification and the trimmed per-thread stack tails —
+    enough to name the wedged stage in the round JSON without banking
+    hundreds of full frames."""
+    path = path or _stall_dump_path()
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+    slim = {k: doc.get(k) for k in
+            ("ts_unix", "phase", "age_s", "budget_s", "pid")}
+    threads = doc.get("threads") or {}
+    slim["threads"] = {
+        name: frames[-6:] for name, frames in threads.items()
+    }
+    hb = doc.get("heartbeat")
+    if isinstance(hb, dict):
+        slim["heartbeat"] = {
+            k: hb.get(k) for k in ("phase", "headers", "age_s", "seq")
+        }
+    return slim
+
+
 def _run_teed(cmd, env, budget, log_path):
     """Popen with stdout teed to stderr AND `log_path`, killed at
     `budget` seconds -> (proc, timed_out)."""
@@ -535,8 +668,10 @@ def _run_teed(cmd, env, budget, log_path):
     return proc, timed_out
 
 
-def run_device_subprocess() -> dict | None:
-    """Run the device-side replay in a child with a hard wall budget."""
+def run_device_subprocess() -> tuple[dict | None, list]:
+    """Run the device-side replay in a child with a hard wall budget.
+    Returns (banked result or None, the live-classification timeline
+    the parent tailed off the child's heartbeat)."""
     result_path = os.path.join(CACHE, "device_result.json")
     try:
         os.remove(result_path)
@@ -550,6 +685,12 @@ def run_device_subprocess() -> dict | None:
     # crash-safe warmup forensics: flushed per note, read back even
     # when the child dies on the compile wall with nothing else banked
     env["OCT_WARMUP_REPORT"] = _warmup_report_path()
+    # the live plane: the child beats a heartbeat file every ~2 s and
+    # arms the stall watchdog; the parent tails the file into a
+    # structured timeline (setdefault: the operator's own levers win)
+    env.setdefault("OCT_HEARTBEAT", _heartbeat_path())
+    env.setdefault("OCT_STALL_BUDGET_S", STALL_BUDGET_S)
+    timeline: list = []
     # Two attempts inside the budget: the pk dispatch is per-stage jits
     # (ops/pk/kernels.verify_praos_split), so every stage a killed child
     # DID compile is already in the persistent cache — the retry resumes
@@ -600,10 +741,22 @@ def run_device_subprocess() -> dict | None:
         # left of THIS attempt's budget (analysis/costmodel.preflight —
         # refusals recorded in the warmup report)
         env["OCT_WALL_DEADLINE"] = str(time.time() + budget)
-        proc, timed_out = _run_teed(
-            [sys.executable, "-c", _DEVICE_CHILD], env, budget,
-            child_log_path,
-        )
+        # stale beats must never be read as THIS attempt's story: the
+        # parent's own native-baseline replay (armed when the watchdog
+        # script exports OCT_HEARTBEAT) and attempt 1 both wrote to
+        # this path — the tail classifies only what this child beats
+        try:
+            os.remove(env["OCT_HEARTBEAT"])
+        except OSError:
+            pass
+        tail = _HeartbeatTail(env["OCT_HEARTBEAT"], timeline, attempt)
+        try:
+            proc, timed_out = _run_teed(
+                [sys.executable, "-c", _DEVICE_CHILD], env, budget,
+                child_log_path,
+            )
+        finally:
+            tail.stop()
         try:
             with open(child_log_path) as f:
                 child_log = f.read()
@@ -629,13 +782,13 @@ def run_device_subprocess() -> dict | None:
             # produced WRONG results — never report its checkpoint
             print(f"# device measurement failed rc={proc.returncode}",
                   file=sys.stderr)
-            return None
+            return None, timeline
         break
     try:
         with open(result_path) as f:
-            return json.load(f)
+            return json.load(f), timeline
     except (OSError, json.JSONDecodeError):
-        return None
+        return None, timeline
 
 
 def append_ledger_record(out: dict, baseline: float | None = None,
@@ -652,9 +805,13 @@ def append_ledger_record(out: dict, baseline: float | None = None,
         from ouroboros_consensus_tpu.obs import ledger
 
         big = ("metrics", "metrics_summary", "warmup_report",
-               "device_resources")
+               "device_resources", "live_timeline", "stall_dump")
         slim = {k: v for k, v in out.items() if k not in big}
         extra = {}
+        if out.get("live_timeline"):
+            extra["live_timeline"] = out["live_timeline"]
+        if out.get("stall_dump"):
+            extra["stall_dump"] = out["stall_dump"]
         if baseline is not None:
             extra["native_baseline_per_s"] = round(baseline, 1)
             if native_wall_s is not None:
@@ -687,12 +844,14 @@ def append_ledger_record(out: dict, baseline: float | None = None,
 
 
 def main() -> None:
-    # a warmup report left by a PREVIOUS round must never be banked as
-    # this round's forensics — only the child this run spawns may write
-    try:
-        os.remove(_warmup_report_path())
-    except OSError:
-        pass
+    # forensics left by a PREVIOUS round must never be banked as this
+    # round's — only the child this run spawns may write them
+    for stale in (_warmup_report_path(), _heartbeat_path(),
+                  _stall_dump_path()):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
     # The native baseline and chain synthesis need no accelerator; run
     # them FIRST so a wedged tunnel can never cost us the whole round.
     path, params, lview = build_or_load_chain()
@@ -725,8 +884,9 @@ def main() -> None:
           file=sys.stderr)
 
     probe_ok, probe_verdict = probe_device()
+    live_timeline: list = []
     if probe_ok:
-        device = run_device_subprocess()
+        device, live_timeline = run_device_subprocess()
         # the probe SUCCEEDED, so a missing device result is a run/wall
         # death — classified distinctly from a probe death in the
         # banked tail (perf_report tells them apart structurally now)
@@ -801,6 +961,15 @@ def main() -> None:
         wr = _read_warmup_report()
         if wr is not None:
             out["warmup_report"] = wr
+    # the live story of the round: the parent-tailed heartbeat timeline
+    # plus any stall forensics the child's watchdog dumped — banked for
+    # banked AND dead rounds (a dead round's last timeline entry is its
+    # cause-of-death evidence; perf_report classifies stalled@<phase>)
+    if live_timeline:
+        out["live_timeline"] = live_timeline
+    stall_dump = _read_stall_dump()
+    if stall_dump is not None:
+        out["stall_dump"] = stall_dump
     print(json.dumps(out))
     append_ledger_record(out, baseline=baseline, native_wall_s=nwall,
                          probe=probe_verdict)

@@ -21,6 +21,14 @@ package is the TPU build's equivalent surface, all host-side:
   * `resources` — device resource accounting: FLOPs / bytes / HBM per
                   dispatched stage program (oct_stage_* gauges, the
                   budgets.json "device_resources" ratchet)
+  * `live`      — the LIVE run plane: in-run heartbeat snapshots
+                  (OCT_HEARTBEAT), the stall watchdog with all-thread
+                  stack forensics (OCT_STALL_BUDGET_S), armed by
+                  db_analyser.revalidate / bench / profile_replay
+  * `server`    — the one HTTP exposition implementation (/metrics,
+                  /metrics.json, /healthz, /progress): asyncio for
+                  immdb_server, thread-hosted for replays
+                  (OCT_METRICS_PORT)
 
 Env levers:
 
@@ -30,6 +38,13 @@ Env levers:
   OCT_LEDGER=d|0       run-ledger directory override / kill-switch
   OCT_STAGE_RESOURCES  =0 kills per-stage resource capture; =1 forces
                        it; unset follows the installed recorder
+  OCT_HEARTBEAT=f      rewrite a live JSON heartbeat to `f` every ~2 s
+  OCT_STALL_BUDGET_S=n stall watchdog: no-progress budget before an
+                       all-thread stack dump (+ oct_stalls_total)
+  OCT_STALL_DUMP=f     stall forensics file override (default: next to
+                       the warmup report)
+  OCT_METRICS_PORT=p   serve /metrics /metrics.json /healthz /progress
+                       from inside the replay on port p
 
 Everything stays OFF the hot path unless installed: with OCT_TRACE
 unset, `protocol.batch.BATCH_TRACER` remains None and the only residual
@@ -128,6 +143,10 @@ def reset_for_tests() -> None:
     global _RECORDER, _INSTALL_DEPTH, _PREV_TRACER
     from .registry import reset_default_registry
 
+    # an armed live plane holds a recorder reference — drop it first
+    from . import live as _live
+
+    _live.reset_for_tests()
     with _LOCK:
         if _INSTALL_DEPTH > 0:
             from ..protocol import batch as pbatch

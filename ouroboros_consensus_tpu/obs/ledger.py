@@ -308,3 +308,105 @@ def read_runs(dir_: str | None = None, kind: str | None = None) -> list[dict]:
     """All (optionally kind-filtered) records, append order."""
     return [rec for rec, _p, _i in iter_runs(dir_)
             if kind is None or rec.get("kind") == kind]
+
+
+# ---------------------------------------------------------------------------
+# CLI: `python -m ouroboros_consensus_tpu.obs.ledger tail --last N`
+# ---------------------------------------------------------------------------
+
+
+def _result_blurb(rec: dict) -> str:
+    """One human line out of a record's banked result — "what did this
+    run do" without hand-parsing JSONL."""
+    res = rec.get("result") or {}
+    parts = []
+    if res.get("value") is not None:
+        unit = res.get("unit", "")
+        parts.append(f"{res['value']} {unit}".strip())
+    elif res.get("rate_per_s") is not None:
+        parts.append(f"{res['rate_per_s']} headers/s")
+    elif res.get("ceiling_per_s") is not None:
+        parts.append(f"ceiling {res['ceiling_per_s']} headers/s")
+    if res.get("device_unavailable"):
+        parts.append("NO-DEVICE"
+                     + (f" ({res['no_device_reason']})"
+                        if res.get("no_device_reason") else ""))
+    if res.get("headers") is not None:
+        parts.append(f"{res['headers']} headers")
+    ms = rec.get("metrics_summary") or {}
+    if ms.get("windows"):
+        parts.append(f"{ms['windows']} windows")
+    metrics = rec.get("metrics") or {}
+    stalls = sum(
+        int(s.get("value", 0))
+        for s in (metrics.get("oct_stalls_total") or {}).get("samples", [])
+    )
+    if stalls:
+        parts.append(f"{stalls} STALL(s)")
+    shard_fams = [k for k in metrics if k.startswith("oct_shard_")]
+    if shard_fams:
+        shards = {
+            (s.get("labels") or {}).get("shard")
+            for k in shard_fams
+            for s in (metrics.get(k) or {}).get("samples", [])
+        }
+        parts.append(f"per-shard telemetry x{len(shards - {None})}")
+    return ", ".join(parts) or "(no result banked)"
+
+
+def format_run(rec: dict) -> str:
+    build = rec.get("build_id") or "-"
+    if len(build) > 24:
+        build = build[:21] + "..."
+    wall = rec.get("wall_s")
+    wall_s = f"{wall:.0f}s" if isinstance(wall, (int, float)) else "?"
+    return (
+        f"{rec.get('ts_iso', '?'):20s} {rec.get('kind', '?'):14s} "
+        f"build={build:24s} wall={wall_s:6s} " + _result_blurb(rec)
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    """`tail --last N [--kind K] [--build-id SUBSTR] [--json]`: the
+    "what did the last live session do" one-liner over read_runs."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m ouroboros_consensus_tpu.obs.ledger",
+        description="query the append-only run ledger",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    tail = sub.add_parser(
+        "tail", help="newest runs, one line each (newest last)"
+    )
+    tail.add_argument("--last", type=int, default=10, metavar="N",
+                      help="show the newest N runs (default 10)")
+    tail.add_argument("--kind", default=None,
+                      help="filter by record kind (bench / multichip / "
+                           "profile_replay / ...)")
+    tail.add_argument("--build-id", default=None, dest="build_id",
+                      help="substring filter over the PJRT build id")
+    tail.add_argument("--dir", default=None,
+                      help="ledger directory (default: the repo ledger / "
+                           "OCT_LEDGER)")
+    tail.add_argument("--json", action="store_true",
+                      help="print the full records as JSONL instead")
+    args = ap.parse_args(argv)
+
+    runs = read_runs(args.dir, kind=args.kind)
+    if args.build_id is not None:
+        runs = [r for r in runs if args.build_id in (r.get("build_id") or "")]
+    runs = runs[-args.last:] if args.last > 0 else []
+    if not runs:
+        print("(no matching ledger records)")
+        return 1
+    for rec in runs:
+        if args.json:
+            print(json.dumps(rec, sort_keys=True))
+        else:
+            print(format_run(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,9 @@ event into the metrics registry:
     oct_h2d_bytes_total / oct_d2h_bytes_total
     oct_window_{stage,dispatch,materialize,epilogue}_seconds   histograms
     oct_window_device_latency_seconds      dispatch->materialize wall
+    oct_stalls_total{phase=}               stall-watchdog trips (obs/live)
+    oct_shard_{windows,lanes,ok_lanes,pad_lanes}_total{shard=}
+                                           per-shard SPMD telemetry
 
 Per-window granularity only — a 1M-header replay emits a few hundred
 events, so the host feed ceiling is untaxed."""
@@ -22,8 +25,8 @@ import threading
 import time
 
 from ..utils.trace import (
-    AggRedispatch, EncloseEvent, LadderEvent, TransferEvent, WindowSpan,
-    WindowStaged,
+    AggRedispatch, EncloseEvent, LadderEvent, ShardSpan, StallEvent,
+    TransferEvent, WindowSpan, WindowStaged,
 )
 from . import registry as _registry
 
@@ -68,12 +71,41 @@ class FlightRecorder:
             "oct_window_device_latency_seconds",
             "dispatch->materialize wall per window",
         )
+        # live plane (obs/live.py): stall-watchdog trips by the phase
+        # the run was wedged in at trip time
+        self._stalls = r.counter(
+            "oct_stalls_total", "stall-watchdog trips", ("phase",)
+        )
+        # per-shard SPMD telemetry (parallel/spmd.py ShardSpan events):
+        # label cardinality is the mesh size — bounded by hardware
+        self._shard_windows = r.counter(
+            "oct_shard_windows_total",
+            "sharded windows dispatched per mesh position", ("shard",),
+        )
+        self._shard_lanes = r.counter(
+            "oct_shard_lanes_total",
+            "real (non-pad) lanes dispatched per shard", ("shard",),
+        )
+        self._shard_ok = r.counter(
+            "oct_shard_ok_lanes_total",
+            "lanes retired valid per shard (psum popcount vocabulary)",
+            ("shard",),
+        )
+        self._shard_pad = r.counter(
+            "oct_shard_pad_lanes_total",
+            "bucket-pad waste lanes per shard", ("shard",),
+        )
+        # heartbeat source: the most recent event (kept even after the
+        # bounded buffer fills) + the latest retired window index
+        self._last: "tuple[float, object] | None" = None
+        self._last_span_index = -1
 
     # -- the tracer ---------------------------------------------------------
 
     def __call__(self, ev) -> None:
         now = time.monotonic()
         with self._lock:
+            self._last = (now, ev)
             if len(self.events) < MAX_EVENTS:
                 self.events.append((now, ev))
             else:
@@ -90,6 +122,9 @@ class FlightRecorder:
                 # visible to someone reading raw event streams
                 self._gates.labels(gate=ev.gate).inc()
         elif isinstance(ev, WindowSpan):
+            with self._lock:
+                if ev.index > self._last_span_index:
+                    self._last_span_index = ev.index
             self._headers.inc(ev.n_valid)
             self._phase_h["stage"].observe(ev.stage_s)
             self._phase_h["dispatch"].observe(ev.dispatch_s)
@@ -107,7 +142,44 @@ class FlightRecorder:
                 self._h2d.inc(ev.h2d_bytes)
             else:
                 self._d2h.inc(ev.d2h_bytes)
+        elif isinstance(ev, StallEvent):
+            self._stalls.labels(phase=ev.phase).inc()
+        elif isinstance(ev, ShardSpan):
+            s = str(ev.shard)
+            self._shard_windows.labels(shard=s).inc()
+            self._shard_lanes.labels(shard=s).inc(ev.lanes_real)
+            self._shard_ok.labels(shard=s).inc(ev.n_ok)
+            self._shard_pad.labels(shard=s).inc(ev.pad_lanes)
+            # shards also count as headers retired on the sharded path
+            # ONLY through their WindowSpan-carrying replay loop — the
+            # per-shard families never double-fold into oct_headers_*
         # EncloseEvent: kept in the event stream (Perfetto slices) only
+
+    # -- live plane (obs/live.py heartbeat source) --------------------------
+
+    def last_event(self) -> "tuple[float, object] | None":
+        """(monotonic t, event) of the newest event seen — kept fresh
+        even once the bounded buffer is full, so a week-long run's
+        heartbeat never reads a stale phase."""
+        with self._lock:
+            return self._last
+
+    def progress_fingerprint(self) -> tuple:
+        """A cheap value that changes whenever the replay makes ANY
+        observable progress (the stall watchdog's no-progress test):
+        headers retired, last retired window index, and the timestamp
+        of the newest event."""
+        with self._lock:
+            last_t = self._last[0] if self._last is not None else 0.0
+            n = len(self.events) + self.dropped
+        return (self._headers.value, self._last_span_index, last_t, n)
+
+    def headers_retired(self) -> int:
+        return int(self._headers.value)
+
+    def last_window_index(self) -> int:
+        with self._lock:
+            return self._last_span_index
 
     # -- reporting ----------------------------------------------------------
 
@@ -151,3 +223,5 @@ class FlightRecorder:
         with self._lock:
             self.events.clear()
             self.dropped = 0
+            self._last = None
+            self._last_span_index = -1

@@ -1,0 +1,214 @@
+"""The live metrics/health HTTP endpoint — ONE implementation.
+
+Factored out of `tools/immdb_server.serve_metrics` so every long-lived
+process serves the same surface: the immdb block service mounts the
+asyncio coroutine (`serve_metrics`), while replays (bench's device
+child, `profile_replay.py`, `db_analyser.revalidate`) mount the
+thread-hosted twin via `OCT_METRICS_PORT` (`start_in_thread` /
+`obs.live.maybe_arm`). This is the SLO surface ROADMAP item 3's
+serving tier will scrape.
+
+Routes (minimal HTTP/1.0, no dependencies):
+
+    GET /metrics        Prometheus text exposition format 0.0.4
+    GET /metrics.json   the registry's JSON snapshot
+    GET /healthz        the live heartbeat document (obs/live.py)
+    GET /progress       compact progress twin: phase / headers /
+                        headers_per_s / age_s / window_index
+
+Every request increments `oct_metrics_scrapes_total{path=}` (label
+values are the FIXED route names, never wire input)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+_PORT_ENV = "OCT_METRICS_PORT"
+
+_PROGRESS_KEYS = (
+    "phase", "headers", "headers_per_s", "age_s", "window_index",
+    "stalls", "ts_unix", "seq",
+)
+
+
+def metrics_port() -> int | None:
+    v = os.environ.get(_PORT_ENV)
+    if not v:
+        return None
+    try:
+        port = int(v)
+    except ValueError:
+        return None
+    # 0 would be a valid ephemeral bind, but as an env lever it means
+    # "disabled" (the immdb --metrics-port convention)
+    return port if port > 0 else None
+
+
+def _live_doc(live_doc) -> dict:
+    if live_doc is not None:
+        return live_doc()
+    from . import live
+
+    return live.live_snapshot()
+
+
+def handle_path(path: str, registry=None, live_doc=None):
+    """Route one GET -> (status: bytes, content-type: bytes, body:
+    bytes). Shared by the asyncio and threaded servers so the two can
+    never drift."""
+    from .registry import default_registry
+
+    reg = registry if registry is not None else default_registry()
+    scrapes = reg.counter(
+        "oct_metrics_scrapes_total", "metric-endpoint requests", ("path",)
+    )
+    if path.startswith("/metrics.json"):
+        scrapes.labels(path="/metrics.json").inc()
+        return (b"200 OK", b"application/json",
+                json.dumps(reg.snapshot()).encode())
+    if path.startswith("/metrics"):
+        scrapes.labels(path="/metrics").inc()
+        return (b"200 OK", b"text/plain; version=0.0.4",
+                reg.expose_text().encode())
+    if path.startswith("/healthz"):
+        scrapes.labels(path="/healthz").inc()
+        return (b"200 OK", b"application/json",
+                json.dumps(_live_doc(live_doc)).encode())
+    if path.startswith("/progress"):
+        scrapes.labels(path="/progress").inc()
+        doc = _live_doc(live_doc)
+        slim = {k: doc.get(k) for k in _PROGRESS_KEYS if k in doc}
+        return (b"200 OK", b"application/json", json.dumps(slim).encode())
+    return (b"404 Not Found", b"text/plain",
+            b"try /metrics /metrics.json /healthz /progress\n")
+
+
+def _render(status: bytes, ctype: bytes, body: bytes) -> bytes:
+    return (b"HTTP/1.0 " + status + b"\r\nContent-Type: " + ctype
+            + b"\r\nContent-Length: " + str(len(body)).encode()
+            + b"\r\n\r\n" + body)
+
+
+# ---------------------------------------------------------------------------
+# asyncio server (mounted by tools/immdb_server beside the block service)
+# ---------------------------------------------------------------------------
+
+
+async def serve_metrics(host: str = "127.0.0.1", port: int = 9100,
+                        registry=None, live_doc=None):
+    """Minimal HTTP/1.0 responder over asyncio — the cardano-node
+    EKG/Prometheus bridge analog. `port=0` binds ephemeral (tests)."""
+    import asyncio
+
+    async def handle(reader, writer):
+        try:
+            req = await reader.readline()
+            while True:  # drain headers
+                line = await reader.readline()
+                if line in (b"", b"\n", b"\r\n"):
+                    break
+            parts = req.split()
+            path = (parts[1].decode("ascii", "replace")
+                    if len(parts) > 1 else "/")
+            writer.write(_render(*handle_path(path, registry, live_doc)))
+            await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    return await asyncio.start_server(handle, host, port)
+
+
+# ---------------------------------------------------------------------------
+# thread-hosted server (replays: synchronous callers, OCT_METRICS_PORT)
+# ---------------------------------------------------------------------------
+
+
+class MetricsServer:
+    """The same responder on a daemon thread with its own socket loop,
+    for synchronous hosts (a replay has no event loop to mount on).
+    `port=0` binds ephemeral; `.port` reports the bound port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry=None, live_doc=None):
+        import socket
+
+        self.registry = registry
+        self.live_doc = live_doc
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(8)
+        self._sock.settimeout(0.5)  # close() latency bound
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="oct-metrics-http", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        import socket
+
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # socket closed under us
+            try:
+                conn.settimeout(5.0)
+                data = b""
+                while b"\r\n\r\n" not in data and b"\n\n" not in data:
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        break
+                    data += chunk
+                parts = data.split(None, 2)
+                path = (parts[1].decode("ascii", "replace")
+                        if len(parts) > 1 else "/")
+                conn.sendall(_render(*handle_path(
+                    path, self.registry, self.live_doc
+                )))
+            except OSError:
+                pass  # a broken scrape never breaks the replay
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5)
+
+
+def start_in_thread(port: int | None = None, host: str = "127.0.0.1",
+                    registry=None, live_doc=None) -> MetricsServer | None:
+    """Mount the thread-hosted endpoint on `port` (default: the
+    OCT_METRICS_PORT lever; None/unset -> no server). Fail-soft: a
+    port already in use logs to stderr and returns None rather than
+    killing the replay it was meant to observe."""
+    import sys
+
+    port = metrics_port() if port is None else port
+    if port is None:
+        return None
+    try:
+        srv = MetricsServer(host=host, port=port, registry=registry,
+                            live_doc=live_doc)
+    except OSError as e:
+        print(f"# obs/server: cannot bind metrics port {port}: {e}",
+              file=sys.stderr)
+        return None
+    print(f"# obs/server: live metrics on http://{srv.host}:{srv.port}"
+          "/metrics (/metrics.json /healthz /progress)", file=sys.stderr)
+    return srv

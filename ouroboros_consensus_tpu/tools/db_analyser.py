@@ -460,16 +460,26 @@ def revalidate(
     With OCT_TRACE=1 the obs flight recorder additionally rides the
     replay (per-window spans, gate-decline attribution, Perfetto-
     exportable event stream — ouroboros_consensus_tpu/obs).
+
+    With any live-plane lever set (OCT_HEARTBEAT / OCT_STALL_BUDGET_S /
+    OCT_METRICS_PORT) the replay also arms obs/live.py: an atomically
+    rewritten heartbeat file, the no-progress stall watchdog, and the
+    in-run /metrics /healthz HTTP endpoint — the run stops being a
+    black box WHILE it runs.
     """
     from .. import obs
+    from ..obs import live as _live
 
     installed = obs.maybe_install()
+    plane = _live.maybe_arm()
     try:
         return _revalidate_traced(
             db_path, params, lview, backend, validate_all, max_batch,
             max_headers, trace, ledger, genesis_state, collect_phases,
         )
     finally:
+        if plane is not None:
+            plane.disarm()
         if installed:
             obs.uninstall()
 

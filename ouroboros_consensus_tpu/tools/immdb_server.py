@@ -78,57 +78,18 @@ from ..node.transport import read_frame as _read_frame  # noqa: E402
 
 async def serve_metrics(host: str = "127.0.0.1", port: int = 9100,
                         registry=None):
-    """Prometheus exposition endpoint: a minimal HTTP/1.0 responder
-    (no dependencies) answering
+    """Prometheus exposition endpoint beside the block service
+    (`--metrics-port`; `port=0` binds ephemeral for tests).
 
-        GET /metrics        text exposition format 0.0.4
-        GET /metrics.json   the registry's JSON snapshot
+    Rebased onto `obs/server.py` — ONE HTTP implementation for the
+    whole repo: /metrics and /metrics.json behave exactly as before
+    (scrape/request counters included), and the live-plane routes
+    /healthz and /progress come along for free (SURVEY.md layer 4-5:
+    the cardano-node EKG/Prometheus bridge analog, now also the serving
+    tier's SLO surface)."""
+    from ..obs import server as obs_server
 
-    over the obs metrics registry — the cardano-node EKG/Prometheus
-    bridge analog (SURVEY.md layer 4-5). Runs beside the block service
-    (`--metrics-port`); `port=0` binds an ephemeral port (tests)."""
-    import asyncio
-    import json as _json
-
-    from ..obs.registry import default_registry
-
-    reg = registry if registry is not None else default_registry()
-    scrapes = reg.counter(
-        "oct_metrics_scrapes_total", "metric-endpoint requests", ("path",)
-    )
-
-    async def handle(reader, writer):
-        try:
-            req = await reader.readline()
-            while True:  # drain headers
-                line = await reader.readline()
-                if line in (b"", b"\n", b"\r\n"):
-                    break
-            parts = req.split()
-            path = parts[1].decode("ascii", "replace") if len(parts) > 1 else "/"
-            if path.startswith("/metrics.json"):
-                scrapes.labels(path="/metrics.json").inc()
-                body = _json.dumps(reg.snapshot()).encode()
-                status, ctype = b"200 OK", b"application/json"
-            elif path.startswith("/metrics"):
-                scrapes.labels(path="/metrics").inc()
-                body = reg.expose_text().encode()
-                status, ctype = b"200 OK", b"text/plain; version=0.0.4"
-            else:
-                body = b"try /metrics or /metrics.json\n"
-                status, ctype = b"404 Not Found", b"text/plain"
-            writer.write(
-                b"HTTP/1.0 " + status + b"\r\nContent-Type: " + ctype
-                + b"\r\nContent-Length: " + str(len(body)).encode()
-                + b"\r\n\r\n" + body
-            )
-            await writer.drain()
-        except (asyncio.IncompleteReadError, ConnectionResetError):
-            pass
-        finally:
-            writer.close()
-
-    return await asyncio.start_server(handle, host, port)
+    return await obs_server.serve_metrics(host, port, registry=registry)
 
 
 async def serve_tcp(db_path: str, host: str = "127.0.0.1", port: int = 3001,

@@ -160,6 +160,39 @@ class LadderEvent:
 
 
 @dataclass(frozen=True)
+class StallEvent:
+    """The live-plane stall watchdog (obs/live.py) tripped: no
+    recorder/warmup progress for `age_s` seconds against the
+    OCT_STALL_BUDGET_S budget. `phase` is the live classification at
+    trip time (what the run LOOKED like while it hung); `dump_path`
+    names the all-thread stack forensics file written. Escalation is
+    the parent's job — this event is evidence, never a kill."""
+
+    phase: str
+    age_s: float
+    budget_s: float
+    dump_path: str | None
+
+
+@dataclass(frozen=True)
+class ShardSpan:
+    """Per-shard WindowSpan analogue for one sharded SPMD dispatch
+    (parallel/spmd.sharded_run_batch): how one mesh position fared.
+    Emitted host-side after the psum/pmin collectives land — one event
+    per shard per window, so a pod-scale replay stays per-window cheap.
+    `wall_s` is the whole sharded dispatch wall (identical across the
+    window's shards: SPMD lockstep)."""
+
+    index: int  # process-wide sharded-dispatch sequence number
+    shard: int  # mesh position
+    lanes: int  # shard-local padded lane count
+    lanes_real: int  # non-pad lanes this shard carried
+    n_ok: int  # popcount of ok verdicts over the real lanes
+    pad_lanes: int  # bucket-pad waste in this shard
+    wall_s: float
+
+
+@dataclass(frozen=True)
 class AggRedispatch:
     """An aggregated (RLC/MSM) window came back dirty: its per-lane
     flags are meaningless, so materialize_verdicts re-dispatched the

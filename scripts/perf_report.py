@@ -28,7 +28,13 @@ gate can consume this directly:
 Round-file schema is deliberately treated as hostile: the five
 checked-in rounds span three generations of bench.py output (r01 has
 no warmup forensics, r05 has no metrics snapshot), so every field is
-optional and classification falls back to the recorded tail text."""
+optional and classification falls back to the recorded tail text.
+
+Since round 11 bench also banks the LIVE plane's evidence: a
+`live_timeline` (the parent-tailed heartbeat classifications) and any
+`stall_dump` the child's watchdog wrote. A dead round whose last
+heartbeat says `phase=dispatch, age=600s` classifies as
+`stalled@dispatch` — distinct from probe-timeout and compile-wall."""
 
 from __future__ import annotations
 
@@ -77,7 +83,36 @@ def _first_float(pattern: str, text: str) -> float | None:
 
 def _classify_failures(text: str, rc, parsed: dict | None = None) -> list[dict]:
     out = []
-    # STRUCTURED classification first (round 10): bench.py banks the
+    # LIVE-PLANE classification first (round 11): a banked stall dump
+    # or a heartbeat timeline whose last word is stalled/dead names the
+    # wedged phase — a round whose last heartbeat said phase=dispatch,
+    # age=600s is "stalled@dispatch", structurally distinct from a
+    # probe timeout or a compile wall
+    stall = (parsed or {}).get("stall_dump")
+    if isinstance(stall, dict):
+        out.append({
+            "mode": f"stalled@{stall.get('phase') or '?'}",
+            "detail": (
+                f"stall watchdog tripped after {stall.get('age_s', '?')}s "
+                f"without progress (budget {stall.get('budget_s', '?')}s; "
+                "all-thread stacks in the banked stall_dump)"
+            ),
+        })
+    timeline = (parsed or {}).get("live_timeline") or []
+    last_live = timeline[-1] if timeline else None
+    if (isinstance(last_live, dict)
+            and last_live.get("state") in ("stalled", "dead") and not out):
+        phase = last_live.get("phase") or "?"
+        out.append({
+            "mode": f"stalled@{phase}",
+            "detail": (
+                f"last heartbeat: state={last_live['state']}, "
+                f"phase={phase}, headers={last_live.get('headers')}, "
+                f"age={last_live.get('age_s', '?')}s (banked "
+                "live_timeline)"
+            ),
+        })
+    # STRUCTURED classification next (round 10): bench.py banks the
     # backend-probe verdict and a no_device_reason, so probe-timeout vs
     # driver-timeout vs run-death no longer rides regex archaeology
     probe = (parsed or {}).get("probe")
@@ -171,6 +206,16 @@ def analyze_bench_round(path: str) -> dict:
                          or (parsed or {}).get("laddered")),
         "ladder_swapped": any(e.get("kind") == "swap"
                               for e in ladder_events),
+        # the live plane's banked story (round 11): timeline length +
+        # last state, and whether a stall dump named a wedged phase
+        "live_states": [e.get("state") for e in
+                        ((parsed or {}).get("live_timeline") or [])
+                        if isinstance(e, dict)],
+        "stalled_phase": (
+            ((parsed or {}).get("stall_dump") or {}).get("phase")
+            if isinstance((parsed or {}).get("stall_dump"), dict)
+            else None
+        ),
         "gate_declines": _gate_counts((parsed or {}).get("metrics")),
         "failures": ([] if device_banked
                      else _classify_failures(tail, rc, parsed)),

@@ -91,8 +91,15 @@ def host_ceiling():
     # the recorder hangs off BATCH_TRACER and sees per-window events
     # only, none of which this host-only loop emits per header
     traced = obs.maybe_install()
-    print(f"host pipeline: {mode} (OCT_TRACE={'1' if traced else '0'})",
-          flush=True)
+    # the live plane rides the same bound: with OCT_HEARTBEAT + the
+    # stall watchdog armed the hot ceiling must stay within 2% of
+    # OCT_TRACE=0 (one atomic file rewrite per ~2 s — nothing per
+    # header; round-11 acceptance)
+    from ouroboros_consensus_tpu.obs import live as _live
+
+    plane = _live.maybe_arm()
+    print(f"host pipeline: {mode} (OCT_TRACE={'1' if traced else '0'}, "
+          f"live={'armed' if plane else 'off'})", flush=True)
 
     for attempt in ("warm", "hot"):
         res = ana.ValidationResult()
@@ -177,6 +184,8 @@ def host_ceiling():
                   "prechecks": round(t_pre, 3),
                   "stage": round(t_stage, 3)},
     )
+    if plane is not None:
+        plane.disarm()
 
 
 def main():

@@ -6,12 +6,43 @@
 # to try — this keeps trying all day. Single-flight: only ONE process
 # ever touches the tunnel at a time (round-3 postmortem: concurrent
 # compiles + a SIGTERM mid-compile wedged the relay for hours).
+#
+# Round 11: while the session runs, the watchdog TAILS the live
+# heartbeat (obs/live.py, $OCT_HEARTBEAT) and logs the classification —
+# compiling / staging / running / stalled / dead — every ~30 s, so the
+# log tells a wedged session from a compiling one in real time instead
+# of only after the wall.
 set -u
 cd "$(dirname "$0")/.."
 export JAX_COMPILATION_CACHE_DIR=/tmp/ouroboros-jax-cache
 LOG=scripts/tpu_watchdog.log
 DONE=scripts/tpu_session_logs/SESSION_DONE
 DEADLINE=$(( $(date +%s) + ${WATCHDOG_HOURS:-11} * 3600 ))
+
+# the live plane levers for the session's bench children (inherit any
+# operator override)
+export OCT_HEARTBEAT="${OCT_HEARTBEAT:-$PWD/.bench_cache/heartbeat.json}"
+export OCT_STALL_BUDGET_S="${OCT_STALL_BUDGET_S:-240}"
+
+live_status() {
+  # one line of live classification off the heartbeat file; silent when
+  # the file does not exist yet (session still synthesizing/probing).
+  # JAX_PLATFORMS=cpu: reading a JSON file must never touch the tunnel.
+  [ -e "$OCT_HEARTBEAT" ] || return 0
+  JAX_PLATFORMS=cpu python - "$OCT_HEARTBEAT" <<'PYEOF' 2>/dev/null
+import sys
+from ouroboros_consensus_tpu.obs import live
+doc = live.read_heartbeat(sys.argv[1])
+state = live.classify(doc)
+if doc:
+    print(f"live: {state} phase={doc.get('phase')} "
+          f"headers={doc.get('headers')} "
+          f"rate={doc.get('headers_per_s')} age={doc.get('age_s')}s "
+          f"stalls={doc.get('stalls')}")
+else:
+    print(f"live: {state}")
+PYEOF
+}
 
 echo "watchdog start $(date -u +%F.%H:%M:%S)" >> "$LOG"
 while [ "$(date +%s)" -lt "$DEADLINE" ] && [ ! -e "$DONE" ]; do
@@ -23,7 +54,17 @@ assert d.platform in ('tpu', 'axon'), d.platform
 print('probe ok:', d, float((jnp.ones((8, 8)) + 1).sum()))
 " >> "$LOG" 2>&1; then
     echo "tunnel UP $(date -u +%H:%M:%S) — running session" >> "$LOG"
-    bash scripts/tpu_session.sh >> "$LOG" 2>&1
+    # session in the background so the watchdog can tail the heartbeat;
+    # still single-flight — exactly one session, and the loop below
+    # blocks until it exits
+    bash scripts/tpu_session.sh >> "$LOG" 2>&1 &
+    SESSION_PID=$!
+    while kill -0 "$SESSION_PID" 2>/dev/null; do
+      sleep 30
+      status=$(live_status)
+      [ -n "$status" ] && echo "$(date -u +%H:%M:%S) $status" >> "$LOG"
+    done
+    wait "$SESSION_PID"
     touch "$DONE"
     echo "session done $(date -u +%H:%M:%S)" >> "$LOG"
     break

@@ -194,3 +194,103 @@ def test_bench_suite_emit_appends_record(tmp_ledger, capsys):
     # the obs block moved to its dedicated section, out of the result
     assert "warmup_report" not in rec["result"]
     assert rec["warmup_report"] == {"stages": {}}
+
+
+# ---------------------------------------------------------------------------
+# the round-11 CLI: python -m ouroboros_consensus_tpu.obs.ledger tail
+# ---------------------------------------------------------------------------
+
+
+def test_cli_tail_last_and_build_id_filters(tmp_ledger, capsys):
+    for i in range(5):
+        ledger.record_run(
+            "bench" if i % 2 == 0 else "profile_replay",
+            config={"i": i},
+            result={"value": 1000.0 + i, "unit": "headers/s"},
+            wall_s=10.0 + i,
+            build_id=f"axon-v{i % 2}",
+        )
+    # tail --last 2: the two NEWEST records, one line each
+    rc = ledger.main(["tail", "--last", "2", "--dir", tmp_ledger])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0 and len(out) == 2
+    assert "1003" in out[0] and "1004" in out[1]
+    assert "headers/s" in out[1] and "bench" in out[1]
+    # --build-id substring filter
+    rc = ledger.main(
+        ["tail", "--last", "10", "--build-id", "axon-v1",
+         "--dir", tmp_ledger]
+    )
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0 and len(out) == 2  # i in {1, 3}
+    # --kind filter composes
+    rc = ledger.main(
+        ["tail", "--last", "10", "--kind", "bench", "--dir", tmp_ledger]
+    )
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0 and len(out) == 3  # i in {0, 2, 4}
+    # --json emits the full records as JSONL
+    rc = ledger.main(
+        ["tail", "--last", "1", "--json", "--dir", tmp_ledger]
+    )
+    out = capsys.readouterr().out.strip()
+    rec = json.loads(out)
+    assert ledger.validate_record(rec) == []
+    assert rec["result"]["value"] == 1004.0
+    # empty result set: non-zero exit, no traceback
+    rc = ledger.main(
+        ["tail", "--build-id", "nope", "--dir", tmp_ledger]
+    )
+    capsys.readouterr()
+    assert rc == 1
+    # --last 0 means NONE, not "the whole ledger" (runs[-0:] trap)
+    rc = ledger.main(["tail", "--last", "0", "--dir", tmp_ledger])
+    out = capsys.readouterr().out
+    assert rc == 1 and "no matching" in out
+
+
+def test_cli_blurb_surfaces_no_device_stalls_and_shards(tmp_ledger, capsys):
+    """The one-liner answers "what did the last live session do": a
+    no-device round shows its reason, stall trips and per-shard
+    telemetry are called out."""
+    ledger.record_run(
+        "bench",
+        result={"value": 2100.0, "unit": "headers/s",
+                "device_unavailable": True,
+                "no_device_reason": "backend-probe-timeout"},
+        metrics={
+            "oct_stalls_total": {"samples": [
+                {"labels": {"phase": "dispatch"}, "value": 1},
+            ]},
+            "oct_shard_lanes_total": {"samples": [
+                {"labels": {"shard": str(i)}, "value": 8} for i in range(8)
+            ]},
+        },
+        wall_s=100.0,
+    )
+    rc = ledger.main(["tail", "--last", "1", "--dir", tmp_ledger])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "NO-DEVICE (backend-probe-timeout)" in out
+    assert "1 STALL(s)" in out
+    assert "per-shard telemetry x8" in out
+
+
+def test_cli_module_entrypoint_runs(tmp_ledger):
+    """python -m ouroboros_consensus_tpu.obs.ledger actually executes
+    (the __main__ guard)."""
+    import subprocess
+    import sys
+
+    ledger.record_run("unit", result={"value": 1.0, "unit": "x"})
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "ouroboros_consensus_tpu.obs.ledger",
+         "tail", "--last", "1", "--dir", tmp_ledger],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "unit" in proc.stdout

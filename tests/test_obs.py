@@ -726,6 +726,7 @@ def _registered_metric_names():
     import re
 
     from ouroboros_consensus_tpu.obs import resources as obs_resources
+    from ouroboros_consensus_tpu.obs import server as obs_server
     from ouroboros_consensus_tpu.obs.recorder import FlightRecorder
     from ouroboros_consensus_tpu.tools import immdb_server
 
@@ -734,10 +735,12 @@ def _registered_metric_names():
     NodeMetrics().bind(reg)
     obs_resources.register_families(reg)
     names = set(reg._families)
-    # the immdb server registers its families at serve time: hold it to
-    # the same contract via its registration literals
-    with open(immdb_server.__file__, encoding="utf-8") as f:
-        names |= set(re.findall(r'"(oct_[a-z0-9_]+)"', f.read()))
+    # the immdb server and the (factored-out) HTTP endpoint register
+    # their families at serve time: hold them to the same contract via
+    # their registration literals
+    for mod in (immdb_server, obs_server):
+        with open(mod.__file__, encoding="utf-8") as f:
+            names |= set(re.findall(r'"(oct_[a-z0-9_]+)"', f.read()))
     return names
 
 
@@ -983,11 +986,24 @@ def test_lint_changed_maps_obs_sources_to_purity_graphs():
     )
     lint = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(lint)
-    purity = {"packed_unpack", "verdict_reduce"}
+    purity = {"packed_unpack", "verdict_reduce", "spmd_sharded_verify"}
     assert set(lint._select_graphs(
         {"ouroboros_consensus_tpu/obs/recorder.py"}
     )) == purity
     assert set(lint._select_graphs({"scripts/perf_report.py"})) == purity
+    # the round-11 live-plane modules ride the obs/ prefix
+    assert set(lint._select_graphs(
+        {"ouroboros_consensus_tpu/obs/live.py"}
+    )) == purity
+    assert set(lint._select_graphs(
+        {"ouroboros_consensus_tpu/obs/server.py"}
+    )) == purity
+    # parallel/spmd.py emits ShardSpan telemetry beside the shard_map
+    # program: an spmd edit re-runs the purity differential ON TOP of
+    # its own graph selection
+    assert purity <= set(lint._select_graphs(
+        {"ouroboros_consensus_tpu/parallel/spmd.py"}
+    ))
     # composes with ordinary graph-source selection
     sel = lint._select_graphs({
         "ouroboros_consensus_tpu/obs/ledger.py",

@@ -276,3 +276,71 @@ def test_laddered_round_is_its_own_class(tmp_path):
         {"bench_rounds": [row2], "multichip_rounds": [], "ledger": None,
          "verdicts": [], "ok": False})
     assert "warm ladder HAD engaged" in md2
+
+
+def test_stalled_round_classifies_by_live_plane(tmp_path):
+    """Round 11: a dead round with a banked stall dump (or whose
+    heartbeat timeline's last word is stalled/dead) classifies as
+    stalled@<phase> — distinct from probe-timeout and compile-wall."""
+    p = _write_round(
+        tmp_path, 6,
+        {"value": 2100.0, "device_unavailable": True,
+         "no_device_reason": "device-run-failed-or-wall",
+         "probe": {"ok": True, "outcome": "ok", "attempts": []},
+         "stall_dump": {
+             "phase": "dispatch", "age_s": 600.0, "budget_s": 240.0,
+             "threads": {"MainThread-1": ["  File ...dispatch_batch"]},
+         },
+         "live_timeline": [
+             {"t": 0.0, "attempt": 1, "state": "compiling"},
+             {"t": 120.0, "attempt": 1, "state": "running",
+              "phase": "dispatch", "headers": 81920, "age_s": 1.0},
+             {"t": 700.0, "attempt": 1, "state": "stalled",
+              "phase": "dispatch", "headers": 81920, "age_s": 600.0},
+         ]},
+        rc=124,
+    )
+    row = perf_report.analyze_bench_round(p)
+    assert not row["device_banked"]
+    modes = [f["mode"] for f in row["failures"]]
+    assert modes[0] == "stalled@dispatch"
+    assert row["stalled_phase"] == "dispatch"
+    assert row["live_states"] == ["compiling", "running", "stalled"]
+    assert not any(m.startswith("backend-probe") for m in modes)
+    md = perf_report.render_markdown(
+        {"bench_rounds": [row], "multichip_rounds": [], "ledger": None,
+         "verdicts": [], "ok": False})
+    assert "stalled@dispatch" in md
+
+    # no dump, but the tailed timeline's last heartbeat says DEAD at
+    # phase=materialize: still stalled@materialize, from the timeline
+    p2 = _write_round(
+        tmp_path, 7,
+        {"value": 2100.0, "device_unavailable": True,
+         "no_device_reason": "device-run-failed-or-wall",
+         "live_timeline": [
+             {"t": 0.0, "attempt": 1, "state": "running",
+              "phase": "dispatch", "headers": 1000},
+             {"t": 650.0, "attempt": 1, "state": "dead",
+              "phase": "materialize", "headers": 81920, "age_s": 610.0},
+         ]},
+        rc=124,
+    )
+    row2 = perf_report.analyze_bench_round(p2)
+    modes2 = [f["mode"] for f in row2["failures"]]
+    assert modes2[0] == "stalled@materialize"
+    # a HEALTHY banked round with a timeline gains no failure modes
+    p3 = _write_round(
+        tmp_path, 8,
+        {"value": 4100.0, "vs_baseline": 2.1,
+         "metric": "end-to-end db-analyser revalidation of a "
+                   "1000000-header synthetic Praos chain",
+         "live_timeline": [
+             {"t": 0.0, "attempt": 1, "state": "compiling"},
+             {"t": 400.0, "attempt": 1, "state": "running",
+              "phase": "retired", "headers": 1000000},
+         ]},
+    )
+    row3 = perf_report.analyze_bench_round(p3)
+    assert row3["device_banked"] and row3["failures"] == []
+    assert row3["live_states"] == ["compiling", "running"]
