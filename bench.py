@@ -165,22 +165,42 @@ def build_or_load_chain():
     return path, params, lview
 
 
-# one backoff'd RETRY of a failed backend probe, under its own small
+# backoff'd RETRIES of a failed backend probe, under their own small
 # budget carved out of PROBE_BUDGET: r02-r04 each died on a single probe
-# timeout — one retry catches the transient-tunnel case without letting
-# a dead tunnel eat the measurement wall (round-10 hardening)
+# timeout — retries catch the transient-tunnel case without letting a
+# dead tunnel eat the measurement wall. Round 12: the fixed 15 s retry
+# backoff became JITTERED EXPONENTIAL (15 s, 30 s, 60 s base, x1.0-1.5
+# jitter; seeded by OCT_CHAOS_SEED when chaos is armed so recovery
+# timing is reproducible), and every attempt's wait is banked in the
+# structured verdict — perf_report can tell "backed off and recovered"
+# from "retried instantly and died".
 PROBE_RETRY_BUDGET = float(os.environ.get("BENCH_PROBE_RETRY_BUDGET", "75"))
-PROBE_RETRY_BACKOFF_S = 15.0
+PROBE_RETRY_BACKOFF_S = 15.0  # base of the exponential ladder
+PROBE_MAX_ATTEMPTS = 4
+
+
+def _probe_backoff_s(attempt: int) -> float:
+    """Jittered exponential wait before retry `attempt` (attempt >= 2):
+    base * 2^(attempt-2) * chaos.jitter() — the ONE shared jitter
+    policy (uniform [1.0, 1.5); rides the seeded chaos RNG when armed,
+    same as the recovery ladder's backoff)."""
+    from ouroboros_consensus_tpu.testing import chaos
+
+    return PROBE_RETRY_BACKOFF_S * (2 ** (attempt - 2)) * chaos.jitter()
 
 
 def probe_device() -> tuple[bool, dict]:
-    """Fresh-subprocess backend probe -> (ok, verdict). At most TWO
-    attempts: the first under min(PROBE_BUDGET, remaining wall); on
-    failure, one backoff'd retry under the separate PROBE_RETRY_BUDGET.
-    The verdict dict distinguishes probe-timeout (backend init hung)
-    from probe-error (backend up, wrong answer) per attempt — it is
-    banked into the round JSON and the run ledger so a dead round's
-    tail says WHICH way the probe died, not just that it did."""
+    """Fresh-subprocess backend probe -> (ok, verdict). Attempt 1 runs
+    under min(PROBE_BUDGET, remaining wall); failures retry with
+    jittered exponential backoff under the separate (shared)
+    BENCH_PROBE_RETRY_BUDGET, up to PROBE_MAX_ATTEMPTS total. The
+    verdict dict distinguishes probe-timeout (backend init hung) from
+    probe-error (backend up, wrong answer) per attempt and records the
+    wait that preceded it — it is banked into the round JSON and the
+    run ledger so a dead round's tail says WHICH way the probe died
+    (and whether backing off ever helped), not just that it did."""
+    from ouroboros_consensus_tpu.testing import chaos
+
     verdict: dict = {"ok": False, "attempts": []}
     # keep at least ~2 min of ceiling for the measurement itself
     budget = min(PROBE_BUDGET, _remaining() - 120)
@@ -189,19 +209,41 @@ def probe_device() -> tuple[bool, dict]:
         verdict["outcome"] = "no-budget"
         return False, verdict
     deadline = time.monotonic() + budget
-    for attempt in (1, 2):
-        if attempt == 2:
-            # separate small retry budget, after a backoff: a transient
-            # tunnel blip recovers; a dead tunnel costs 75 s, not the
-            # measurement wall
-            left = min(PROBE_RETRY_BUDGET, _remaining() - 120)
-            if left <= 5:
+    retry_deadline = None  # armed by the first failure
+    for attempt in range(1, PROBE_MAX_ATTEMPTS + 1):
+        waited = 0.0
+        if attempt > 1:
+            # the shared retry budget spans ALL retries: a dead tunnel
+            # costs BENCH_PROBE_RETRY_BUDGET total, never the wall
+            if retry_deadline is None:
+                retry_deadline = time.monotonic() + min(
+                    PROBE_RETRY_BUDGET, _remaining() - 120
+                )
+            left = retry_deadline - time.monotonic()
+            waited = _probe_backoff_s(attempt)
+            if waited > left - 5:
+                # the backoff would eat the attempt's own probe window:
+                # stop BEFORE sleeping — burning wall on a wait whose
+                # attempt can never run helps nobody
                 break
-            time.sleep(min(PROBE_RETRY_BACKOFF_S, max(0.0, left - 5)))
-            left -= PROBE_RETRY_BACKOFF_S
+            time.sleep(waited)
+            left = retry_deadline - time.monotonic()
         else:
             left = max(5.0, deadline - time.monotonic())
         t0 = time.monotonic()
+        if chaos.probe_timeout_pending():
+            # the injected r02 death shape: this attempt hangs past its
+            # timeout (no subprocess spawned — the verdict records the
+            # same outcome the real hang would)
+            err = "probe timed out (backend init hung; chaos-injected)"
+            outcome = "probe-timeout"
+            verdict["attempts"].append({
+                "outcome": outcome, "wall_s": 0.0,
+                "backoff_s": round(waited, 1), "detail": err,
+            })
+            print(f"# device probe failed (attempt {attempt}): {err}",
+                  file=sys.stderr)
+            continue
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
@@ -219,6 +261,7 @@ def probe_device() -> tuple[bool, dict]:
                 verdict["attempts"].append({
                     "outcome": "ok",
                     "wall_s": round(time.monotonic() - t0, 1),
+                    "backoff_s": round(waited, 1),
                 })
                 return True, verdict
             err = (probe.stderr or "?").strip().splitlines()
@@ -229,7 +272,7 @@ def probe_device() -> tuple[bool, dict]:
             outcome = "probe-timeout"
         verdict["attempts"].append({
             "outcome": outcome, "wall_s": round(time.monotonic() - t0, 1),
-            "detail": str(err)[:200],
+            "backoff_s": round(waited, 1), "detail": str(err)[:200],
         })
         print(f"# device probe failed (attempt {attempt}): {err}",
               file=sys.stderr)
@@ -367,14 +410,18 @@ from ouroboros_consensus_tpu.obs import live as _live
 _live.maybe_arm(_rec)
 
 path, params, lview = build_or_load_chain()
-def emit(n, best, warm, attrib=None, warm_estimate=None):
+def emit(n, best, warm, attrib=None, warm_estimate=None, resumed=0):
     # write-then-rename so a kill mid-write can't leave torn JSON.
     # warm_estimate_s: the parent's attempt-2 budget gate — how much wall
     # a fresh child needs before it can bank anything (measured, not
-    # guessed; a prefix bank reports its own elapsed as a lower bound)
+    # guessed; a prefix bank reports its own elapsed as a lower bound).
+    # resumed_headers: headers a checkpoint resume skipped — the parent
+    # rates the banked replay over its FRESH headers only, so a resumed
+    # attempt can never inflate the device number.
     tmp = os.environ["OCT_RESULT"] + ".tmp"
     row = {"n": n, "best_s": best, "warm_s": warm,
            "warm_estimate_s": warm_estimate if warm_estimate else warm,
+           "resumed_headers": int(resumed),
            "platform": jax.devices()[0].platform,
            "build_id": build_id,
            "warmup_report": _WARMUP.report(),
@@ -414,6 +461,13 @@ if BENCH_HEADERS > 200_000:
     small = os.path.join(os.path.dirname(path), f"chain_h100000_d{KES_DEPTH}")
     if os.path.exists(os.path.join(small, "COMPLETE")):
         warm_path = small
+# the checkpoint plane (obs/recovery.py) belongs to the FULL-chain
+# timed replays only: the prefix/warmup replays — usually on the small
+# warm chain — must neither clobber the record a killed attempt left
+# for the 1M chain nor mark it complete, so the levers are fenced off
+# until the timed loop
+_ckpt_lever = os.environ.pop("OCT_CHECKPOINT", None)
+_resume_lever = os.environ.pop("OCT_RESUME", None)
 _WARMUP.note("two-window prefix replay starting")
 t0 = time.monotonic()
 # EARLIEST bank (round-8): a two-window prefix replay first. It pays the
@@ -440,17 +494,31 @@ assert r.n_valid == r.n_blocks > 0
 # real, conservative device number (includes compile/cache-load time);
 # every later full-chain replay overwrites it with a better one.
 emit(r.n_valid, warm_s, warm_s)
-best = None
+if _ckpt_lever is not None:
+    os.environ["OCT_CHECKPOINT"] = _ckpt_lever
+if _resume_lever is not None:
+    os.environ["OCT_RESUME"] = _resume_lever
+best_rate = None
 for _ in range(2):
     t0 = time.monotonic()
     r = ana.revalidate(path, params, lview, backend="device",
                        validate_all="stream", max_batch=MAX_BATCH,
                        collect_phases=True)
     wall = time.monotonic() - t0
+    # only the FIRST timed replay may resume a killed attempt's record;
+    # the second is always a clean full replay (its own record was
+    # marked complete, but the lever must not linger either)
+    os.environ.pop("OCT_RESUME", None)
     assert r.error is None and r.n_valid == r.n_blocks
-    if best is None or wall < best:
-        best = wall
-        emit(r.n_valid, best, warm_s, attribution(r))
+    fresh = r.n_valid - r.resumed_headers
+    rate = fresh / wall if wall > 0 else 0.0
+    # compare replays by FRESH-header rate: a resumed replay's shorter
+    # wall covers fewer headers, so wall-compares would be apples to
+    # oranges (and banking it raw would inflate the device number)
+    if fresh > 0 and (best_rate is None or rate > best_rate):
+        best_rate = rate
+        emit(r.n_valid, wall, warm_s, attribution(r),
+             resumed=r.resumed_headers)
 """
 
 
@@ -638,10 +706,20 @@ def _read_stall_dump(path: str | None = None) -> dict | None:
     return slim
 
 
-def _run_teed(cmd, env, budget, log_path):
+def _run_teed(cmd, env, budget, log_path, watch=None):
     """Popen with stdout teed to stderr AND `log_path`, killed at
-    `budget` seconds -> (proc, timed_out)."""
+    `budget` seconds -> (proc, timed_out, policy_killed).
+
+    `watch` (optional) is polled every few seconds while the child
+    runs; when it returns "kill" the child is SIGTERM'd for forensics
+    (its registered faulthandler banks all-thread stacks into the teed
+    log), then killed — the bench parent's side of the recovery policy
+    (obs/recovery.ParentPolicy): a child whose heartbeat says stalled/
+    dead past its grace is relaunched with resume instead of burning
+    the remaining wall."""
     import threading
+
+    from ouroboros_consensus_tpu.obs import recovery as _recovery
 
     proc = subprocess.Popen(
         cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -658,14 +736,24 @@ def _run_teed(cmd, env, budget, log_path):
     t = threading.Thread(target=pump, daemon=True)
     t.start()
     timed_out = False
-    try:
-        proc.wait(timeout=budget)
-    except subprocess.TimeoutExpired:
-        timed_out = True
-        proc.kill()
-        proc.wait()
+    policy_killed = False
+    deadline = time.monotonic() + budget
+    while True:
+        try:
+            proc.wait(timeout=3.0)
+            break
+        except subprocess.TimeoutExpired:
+            if time.monotonic() >= deadline:
+                timed_out = True
+                proc.kill()
+                proc.wait()
+                break
+            if watch is not None and watch() == "kill":
+                policy_killed = True
+                _recovery.terminate_for_forensics(proc)
+                break
     t.join(timeout=10)
-    return proc, timed_out
+    return proc, timed_out, policy_killed
 
 
 def run_device_subprocess() -> tuple[dict | None, list]:
@@ -690,6 +778,11 @@ def run_device_subprocess() -> tuple[dict | None, list]:
     # structured timeline (setdefault: the operator's own levers win)
     env.setdefault("OCT_HEARTBEAT", _heartbeat_path())
     env.setdefault("OCT_STALL_BUDGET_S", STALL_BUDGET_S)
+    # crash-consistent checkpointing (obs/recovery.py): the child's
+    # full-chain replays persist a progress record per retired window,
+    # so a killed/stalled attempt RESUMES from the last retired window
+    # instead of restarting from header zero (the r02-r05 shape)
+    env.setdefault("OCT_CHECKPOINT", os.path.join(CACHE, "checkpoint.json"))
     timeline: list = []
     # Two attempts inside the budget: the pk dispatch is per-stage jits
     # (ops/pk/kernels.verify_praos_split), so every stage a killed child
@@ -750,10 +843,24 @@ def run_device_subprocess() -> tuple[dict | None, list]:
         except OSError:
             pass
         tail = _HeartbeatTail(env["OCT_HEARTBEAT"], timeline, attempt)
+        # the parent's escalation policy (obs/recovery.ParentPolicy):
+        # a child continuously stalled (its own watchdog tripped) or
+        # dead (heartbeat stopped) past its grace is SIGTERM'd for
+        # forensics and relaunched with resume — the retry pays only
+        # the un-banked suffix of the replay
+        from ouroboros_consensus_tpu.obs import live as _live
+        from ouroboros_consensus_tpu.obs import recovery as _recovery
+
+        policy = _recovery.ParentPolicy()
+
+        def _watch(_hb=env["OCT_HEARTBEAT"], _policy=policy):
+            doc = _live.read_heartbeat(_hb)
+            return _policy.observe(_live.classify(doc))
+
         try:
-            proc, timed_out = _run_teed(
+            proc, timed_out, policy_killed = _run_teed(
                 [sys.executable, "-c", _DEVICE_CHILD], env, budget,
-                child_log_path,
+                child_log_path, watch=_watch,
             )
         finally:
             tail.stop()
@@ -766,15 +873,28 @@ def run_device_subprocess() -> tuple[dict | None, list]:
         # the pk-aot store is build-pinned + self-healing, so the retry
         # keeps the AOT load path (it will find the written-back entries)
         _wipe_stale_cache(child_log)
+        if policy_killed:
+            # relaunch-with-resume: the child's checkpoint holds the
+            # last retired window; OCT_RESUME makes the retry's
+            # full-chain replay skip the banked prefix
+            print(
+                f"# device attempt {attempt} killed by the stall policy "
+                "(SIGTERM'd for forensics; relaunching with resume)",
+                file=sys.stderr,
+            )
+            env["OCT_RESUME"] = "1"
+            continue
         if timed_out:
             # a timeout after the warmup replay still yields a real
             # end-to-end number — read the provisional checkpoint; if
-            # there is none, the retry rides the now-warmer cache
+            # there is none, the retry rides the now-warmer cache (and
+            # resumes the replay from the progress record)
             print(
                 f"# device attempt {attempt} exceeded {budget:.0f}s "
                 "budget (keeping any provisional checkpoint)",
                 file=sys.stderr,
             )
+            env["OCT_RESUME"] = "1"
             if not os.path.exists(result_path):
                 continue
         elif proc.returncode != 0:
@@ -900,10 +1020,16 @@ def main() -> None:
         no_device_reason = probe_verdict.get("outcome", "backend-probe")
 
     if device is not None:
-        rate = device["n"] / device["best_s"]
+        # rate over the FRESH headers of the banked replay: a resumed
+        # attempt validated only the un-banked suffix in best_s, so the
+        # resumed prefix must not inflate the number
+        resumed = int(device.get("resumed_headers") or 0)
+        rate = (device["n"] - resumed) / device["best_s"]
         print(
             f"# platform={device['platform']} headers={device['n']} "
-            f"warmup={device['warm_s']:.1f}s best={device['best_s']:.2f}s",
+            f"warmup={device['warm_s']:.1f}s best={device['best_s']:.2f}s"
+            + (f" (resumed past {resumed} banked headers)" if resumed
+               else ""),
             file=sys.stderr,
         )
         out = {
@@ -925,7 +1051,7 @@ def main() -> None:
         for k in ("phases_s", "windows", "packed_windows",
                   "h2d_bytes_per_window", "d2h_bytes_per_window",
                   "warmup_report", "metrics_summary", "metrics",
-                  "device_resources", "build_id"):
+                  "device_resources", "build_id", "resumed_headers"):
             if k in device:
                 out[k] = device[k]
         if "warmup_report" not in out:

@@ -107,6 +107,10 @@ def phase_of(ev) -> str:
         return "ladder"
     if isinstance(ev, T.AggRedispatch):
         return "agg-redispatch"
+    if isinstance(ev, T.RecoveryEvent):
+        return "recovery"
+    if isinstance(ev, T.CheckpointEvent):
+        return "retired"  # a checkpoint write trails a retired window
     if isinstance(ev, T.StallEvent):
         return "stalled"
     return type(ev).__name__
@@ -227,7 +231,7 @@ def classify(doc: dict | None, now_unix: float | None = None,
     if phase in ("stage", "stream", "prechecks"):
         return "staging"
     if phase in ("dispatch", "materialize", "epilogue", "retired",
-                 "ladder", "agg-redispatch"):
+                 "ladder", "agg-redispatch", "recovery"):
         return "running"
     if phase == "stalled":
         return "stalled"
@@ -267,7 +271,12 @@ class StallWatchdog:
 
         with WARMUP._lock:
             wu = (len(WARMUP.stages), len(WARMUP.notes),
-                  len(WARMUP.ladder), len(WARMUP.aot_events))
+                  len(WARMUP.ladder), len(WARMUP.aot_events),
+                  # recovery-ladder transitions ARE progress: a window
+                  # being walked down the degradation ladder must not
+                  # read as a wedge (and a stall episode re-arms the
+                  # moment recovery starts moving)
+                  len(WARMUP.recovery))
         return self.rec.progress_fingerprint() + wu
 
     def check(self, now: float | None = None) -> dict | None:
@@ -506,18 +515,37 @@ def maybe_arm(rec=None) -> LivePlane | None:
         from .. import obs
 
         # install() is re-entrant and ALWAYS paired by _disarm's
-        # uninstall — phase events flow even when OCT_TRACE is unset
-        installed = obs.install()
-        rec = rec if rec is not None else installed
-        wd = StallWatchdog(budget, rec=rec) if budget is not None else None
-        hb = Heartbeat(hb_path, rec=rec, watchdog=wd).start()
+        # uninstall — phase events flow even when OCT_TRACE is unset.
+        # Arming is exception-SAFE end to end: a failure ANYWHERE past
+        # the depth bump (install itself included) must unwind
+        # everything it did — a leaked ref-count would pin the recorder
+        # (and every later-armed plane) forever, and a bound-but-
+        # unowned socket is an orphan listener on OCT_METRICS_PORT no
+        # later disarm can ever reach.
+        installed = None
+        hb = None
         srv = None
-        if port is not None:
-            srv = obs_server.start_in_thread(
-                port=port, registry=rec.registry,
-                live_doc=lambda: live_snapshot(rec),
-            )
-        _PLANE = LivePlane(hb, srv)
+        try:
+            installed = obs.install()
+            rec = rec if rec is not None else installed
+            wd = (StallWatchdog(budget, rec=rec)
+                  if budget is not None else None)
+            hb = Heartbeat(hb_path, rec=rec, watchdog=wd).start()
+            if port is not None:
+                srv = obs_server.start_in_thread(
+                    port=port, registry=rec.registry,
+                    live_doc=lambda: live_snapshot(rec),
+                )
+            _PLANE = LivePlane(hb, srv)
+        except BaseException:
+            if srv is not None:
+                srv.close()
+            if hb is not None:
+                hb.stop()
+            if installed is not None:
+                obs.uninstall()
+            _DEPTH -= 1
+            raise
         return _PLANE
 
 

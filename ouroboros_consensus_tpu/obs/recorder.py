@@ -13,6 +13,9 @@ event into the metrics registry:
     oct_window_{stage,dispatch,materialize,epilogue}_seconds   histograms
     oct_window_device_latency_seconds      dispatch->materialize wall
     oct_stalls_total{phase=}               stall-watchdog trips (obs/live)
+    oct_recovery_total{action=}            recovery-ladder transitions
+    oct_checkpoint_events_total{kind=}     progress-record movement
+                                           (obs/recovery)
     oct_shard_{windows,lanes,ok_lanes,pad_lanes}_total{shard=}
                                            per-shard SPMD telemetry
 
@@ -25,8 +28,9 @@ import threading
 import time
 
 from ..utils.trace import (
-    AggRedispatch, EncloseEvent, LadderEvent, ShardSpan, StallEvent,
-    TransferEvent, WindowSpan, WindowStaged,
+    AggRedispatch, CheckpointEvent, EncloseEvent, LadderEvent,
+    RecoveryEvent, ShardSpan, StallEvent, TransferEvent, WindowSpan,
+    WindowStaged,
 )
 from . import registry as _registry
 
@@ -75,6 +79,16 @@ class FlightRecorder:
         # the run was wedged in at trip time
         self._stalls = r.counter(
             "oct_stalls_total", "stall-watchdog trips", ("phase",)
+        )
+        # recovery plane (obs/recovery.py): ladder transitions per
+        # action, and checkpoint record movement (write/resume/complete)
+        self._recovery = r.counter(
+            "oct_recovery_total",
+            "recovery-supervisor ladder transitions", ("action",),
+        )
+        self._checkpoints = r.counter(
+            "oct_checkpoint_events_total",
+            "progress-record writes/resumes/completions", ("kind",),
         )
         # per-shard SPMD telemetry (parallel/spmd.py ShardSpan events):
         # label cardinality is the mesh size — bounded by hardware
@@ -144,6 +158,10 @@ class FlightRecorder:
                 self._d2h.inc(ev.d2h_bytes)
         elif isinstance(ev, StallEvent):
             self._stalls.labels(phase=ev.phase).inc()
+        elif isinstance(ev, RecoveryEvent):
+            self._recovery.labels(action=ev.action).inc()
+        elif isinstance(ev, CheckpointEvent):
+            self._checkpoints.labels(kind=ev.kind).inc()
         elif isinstance(ev, ShardSpan):
             s = str(ev.shard)
             self._shard_windows.labels(shard=s).inc()

@@ -56,6 +56,11 @@ class WarmupRecorder:
         self.ladder: list[dict] = []
         self.cache_probe: dict | None = None
         self.notes: list[str] = []
+        # recovery-supervisor episodes (obs/recovery.py): every ladder
+        # transition for a failing window — banked with the rest of the
+        # forensics so the round JSON and ledger carry the recovery
+        # story (perf_report classifies recovered rounds from this)
+        self.recovery: list[dict] = []
 
     # -- recording ----------------------------------------------------------
 
@@ -140,6 +145,26 @@ class WarmupRecorder:
             }
         self._flush()
 
+    def note_recovery(self, action: str, window: int, attempt: int,
+                      fault: str, detail: str = "",
+                      ok: bool | None = None) -> None:
+        """One recovery-ladder transition (obs/recovery.py): action is
+        retry | restage | stage-split | xla-twin | host-reference |
+        chunk-reread | recovered | exhausted."""
+        row = {
+            "action": action,
+            "window": window,
+            "attempt": attempt,
+            "fault": fault,
+            "detail": detail[:200],
+            "t": round(time.monotonic() - self.t0, 3),
+        }
+        if ok is not None:
+            row["ok"] = ok
+        with self._lock:
+            self.recovery.append(row)
+        self._flush()
+
     def note(self, msg: str) -> None:
         """Free-form forensic breadcrumb (e.g. 'warmup replay started')."""
         with self._lock:
@@ -166,6 +191,7 @@ class WarmupRecorder:
                 "refusals": [dict(r) for r in self.refusals],
                 "ladder": [dict(r) for r in self.ladder],
                 "cache_probe": self.cache_probe,
+                "recovery": [dict(r) for r in self.recovery],
                 "notes": list(self.notes),
             }
 
@@ -195,6 +221,7 @@ class WarmupRecorder:
             self.refusals.clear()
             self.ladder.clear()
             self.cache_probe = None
+            self.recovery.clear()
             self.notes.clear()
 
 

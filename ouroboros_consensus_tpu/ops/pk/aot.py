@@ -350,7 +350,13 @@ def save(name: str, b: int, kes_depth: int, tile: int, sig: str, compiled,
     build (atomic artifact write + locked manifest update). The
     manifest row carries the provenance every later `load` checks
     BEFORE deserializing: build_id, src_digest, saved_at."""
+    from ...testing import chaos
     from jax.experimental import serialize_executable as se
+
+    # chaos seam (aot-reject@stage:<name> against the STORE side): the
+    # write-back caller's fail-soft contract absorbs it — a failed save
+    # costs the artifact, never the replay
+    chaos.fire("aot", stage=name)
 
     ser, in_tree, out_tree = se.serialize(compiled)
     path = stage_path(name, b, kes_depth, tile, sig)
@@ -394,6 +400,20 @@ def load(name: str, b: int, kes_depth: int, tile: int, sig: str):
         return _LOADED[key]
     if not enabled():
         return None
+    from ...testing import chaos
+
+    if chaos.armed():
+        try:
+            chaos.fire("aot", stage=name)
+        except chaos.AotRejectChaos as e:
+            # the injected message matches INCOMPATIBLE_PATTERNS, so
+            # this is the r04 failure shape end to end — but the
+            # process-wide latch/marker stay untouched: chaos faults
+            # are transient by contract, a persisted marker would
+            # outlive the injection and condemn real entries
+            _note_aot(name, "rejected", detail=repr(e))
+            _LOADED[key] = None
+            return None
     meta = _cached_manifest().get(entry_key(name, b, kes_depth, tile, sig))
     if meta is None:
         _note_aot(name, "missing")

@@ -489,7 +489,13 @@ def _stage_call(name, fn, b, kes_depth, *args):
     else the per-stage jit. An AOT call that fails at runtime disables
     that executable and falls back, so AOT can never be worse than the
     round-4 jit path."""
+    from ...testing import chaos
     from . import aot
+
+    # chaos seam (device-error@stage:<name> / compile-stall@stage:<name>):
+    # a per-stage failure at the exact host point a real per-stage
+    # device error surfaces; disarmed it is one module bool test
+    chaos.fire("stage-call", stage=name)
 
     if aot.enabled():
         sig = aot.sig_of(args)

@@ -190,6 +190,13 @@ def sharded_run_batch(batch: pbatch.PraosBatch, mesh: Mesh | None = None):
     through the same recorder/ledger machinery as bench."""
     import time
 
+    from ..testing import chaos
+
+    # chaos seam (device-error@shard:N): a shard-level device failure
+    # at the N-th sharded dispatch — the supervisor's "sharded" ladder
+    # (retry -> xla-twin -> host reference) absorbs it in tier-1
+    chaos.fire("shard")
+
     if mesh is None:
         mesh = make_mesh()
     n_dev = mesh.devices.size

@@ -782,15 +782,46 @@ def _agg_enabled() -> bool:
     (ops/pk/aggregate.py) with per-lane fallback on any anomaly. =0
     always runs the per-lane stage kernels. Read per call so the
     differential tests can A/B both paths in one process."""
+    ov = getattr(_RECOVERY_OVERRIDES, "vals", None)
+    if ov is not None and ov.get("agg") is not None:
+        return bool(ov["agg"])
     return os.environ.get("OCT_VRF_AGG", "1") != "0"
 
 
 def _impl() -> str:
+    ov = getattr(_RECOVERY_OVERRIDES, "vals", None)
+    if ov is not None and ov.get("impl"):
+        return ov["impl"]
     if DEVICE_IMPL:
         return DEVICE_IMPL
     import jax
 
     return "pk" if jax.devices()[0].platform == "tpu" else "xla"
+
+
+# per-thread path overrides for the recovery ladder (obs/recovery.py):
+# a rung re-validates ONE failing window with the aggregate fast path
+# forced off (stage-split — the materialize_verdicts taxonomy path) or
+# the implementation pinned to the XLA twin, without touching the env
+# the rest of the process (and the staging thread) keeps reading.
+_RECOVERY_OVERRIDES = threading.local()
+
+
+class recovery_overrides:
+    """Context manager: pin `_agg_enabled()` / `_impl()` for THIS
+    thread while a recovery rung re-validates a window."""
+
+    def __init__(self, agg=None, impl=None):
+        self._vals = {"agg": agg, "impl": impl}
+
+    def __enter__(self):
+        self._prev = getattr(_RECOVERY_OVERRIDES, "vals", None)
+        _RECOVERY_OVERRIDES.vals = self._vals
+        return self
+
+    def __exit__(self, *exc):
+        _RECOVERY_OVERRIDES.vals = self._prev
+        return False
 
 
 def flatten_batch(batch: PraosBatch) -> list:
@@ -1859,6 +1890,22 @@ def _enclose(label):
     return Enclose(BATCH_TRACER, label) if BATCH_TRACER is not None else _Null()
 
 
+class _FailedDispatch:
+    """In-flight placeholder for a window whose staging or dispatch
+    raised a RECOVERABLE error (obs/recovery): the exception is
+    re-raised at the window's retire slot, where the supervisor has the
+    exact fold state (`ticked`) a re-validation needs — so recovery
+    happens in retire order and the pipeline's windows never reorder."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+    def result(self):
+        raise self.exc
+
+
 class _Dispatched(NamedTuple):
     """Opaque handle between dispatch_batch and materialize_verdicts."""
 
@@ -1950,6 +1997,13 @@ def prepare_window(params, lview, eta0, hvs) -> _StagedWindow:
     thread may run it arbitrarily far ahead of dispatch — the round-10
     staging thread overlaps this wall with device compute and the
     retire-side epilogue work on the main thread."""
+    from ..testing import chaos
+
+    # the staging seam (chaos: staging-thread-death@window:N) — when the
+    # producer thread runs this, the raise kills THAT thread's future
+    # exactly like a real mid-prepare death; disarmed it is one module
+    # bool test
+    chaos.fire("stage")
     b = len(hvs)
     t0 = time.monotonic()
     with _enclose("stage"):
@@ -2012,6 +2066,12 @@ def dispatch_prepared(sw: _StagedWindow, carry=None, ladder=None):
     Returns (pre, dispatched, b, carry_out); carry_out is None when this
     window cannot extend the chain (generic fallback or scan disabled).
     """
+    from ..testing import chaos
+
+    # the dispatch seam (chaos: device-error@dispatch:N — a fake
+    # XlaRuntimeError-class failure at window launch — and
+    # compile-stall@window:N, a simulated compile wall)
+    chaos.fire("dispatch")
     pre, b, lanes, h2d, gate, t0, t1 = (
         sw.pre, sw.b, sw.lanes, sw.h2d, sw.gate, sw.t0, sw.t1
     )
@@ -2847,22 +2907,41 @@ def _validate_chain_loop(
     params, ledger_view_for_epoch, state, hvs, max_batch, backend,
     pipeline_depth, mesh, pool,
 ):
+    from ..obs import recovery as _recovery
+    from ..testing import chaos as _chaos
+
     total_valid = 0
     i = 0
     n = len(hvs)
+    win_idx = 0  # retire-order window index (RecoveryEvent / checkpoints)
     if backend != "device":
         for epoch, i, seg_end in _epoch_segments_idx(params, hvs):
             lview = ledger_view_for_epoch(epoch)
             while i < seg_end:
                 j = min(i + max_batch, seg_end)
                 ticked = praos.tick(params, lview, _slot_at(hvs, i), state)
-                res = validate_batch(
-                    params, ticked, hvs[i:j], backend=backend, mesh=mesh
-                )
+                try:
+                    res = validate_batch(
+                        params, ticked, hvs[i:j], backend=backend, mesh=mesh
+                    )
+                except Exception as e:  # noqa: BLE001 — supervisor gates
+                    # the degradation ladder (obs/recovery.py): re-raises
+                    # unrecoverable classes / OCT_RECOVERY=0 unchanged
+                    res = _recovery.supervisor().recover_window(
+                        params, ticked, hvs[i:j], e, backend=backend,
+                        mesh=mesh, window=win_idx,
+                    )
                 state = res.state
                 total_valid += res.n_valid
                 if res.error is not None:
                     return BatchResult(state, total_valid, res.error)
+                # crash-consistent progress record per retired window
+                # (one None check when OCT_CHECKPOINT is unset), THEN
+                # the sigkill seam — a chaos kill lands AFTER the
+                # checkpoint, the exactly-once window boundary
+                _recovery.note_window(state, res.n_valid)
+                _chaos.fire("retire")
+                win_idx += 1
                 i = j
         return BatchResult(state, total_valid, None)
 
@@ -2993,6 +3072,16 @@ def _device_loop(
                 if s_stage < len(segments):
                     w = segments[s_stage][1]
 
+    from ..obs import recovery as _recovery
+    from ..testing import chaos as _chaos
+
+    def _queue_failure(exc: BaseException) -> bool:
+        """True when the supervisor may absorb `exc`: the window rides
+        the pipeline as a _FailedDispatch and recovers at its retire
+        slot. False (disabled / unrecoverable class) -> raise-through,
+        the pre-PR-12 behavior."""
+        return _recovery.enabled() and _recovery.recoverable(exc)
+
     def drain_dispatch():
         # dispatch staged windows IN ORDER (the device carry chains
         # dispatch-to-dispatch) while the in-flight side of the double
@@ -3005,11 +3094,33 @@ def _device_loop(
             if stage_pool is not None and hasattr(item, "result"):
                 if not item.done() and inflight:
                     break
-                item = item.result()
+                try:
+                    item = item.result()
+                except Exception as e:  # noqa: BLE001 — gated below
+                    # the staging producer died mid-prepare: the window
+                    # recovers at its retire slot (full re-validation)
+                    staged.popleft()
+                    if not _queue_failure(e):
+                        raise
+                    carry_ok = False
+                    inflight.append(
+                        (s_w, whvs_w, w_start_w, None, None,
+                         _FailedDispatch(e))
+                    )
+                    continue
             staged.popleft()
-            pre, out, b, carry_out = dispatch_prepared(
-                item, carry if carry_ok else None, ladder
-            )
+            try:
+                pre, out, b, carry_out = dispatch_prepared(
+                    item, carry if carry_ok else None, ladder
+                )
+            except Exception as e:  # noqa: BLE001 — gated below
+                if not _queue_failure(e):
+                    raise
+                carry_ok = False
+                inflight.append(
+                    (s_w, whvs_w, w_start_w, None, None, _FailedDispatch(e))
+                )
+                continue
             if carry_out is None:
                 carry_ok = False
             else:
@@ -3019,6 +3130,7 @@ def _device_loop(
                  pool.submit(materialize_verdicts, out, b))
             )
 
+    win_retired = 0  # retire-order window index (recovery/checkpoints)
     while retired < n or inflight or staged:
         # alternate stage/dispatch to a FIXPOINT: the inline
         # (OCT_STAGE_THREAD=0) mode stages one window at a time and
@@ -3056,8 +3168,15 @@ def _device_loop(
 
         s_b, whvs, w_start, pre, meta, fut = inflight.popleft()
         t_m0 = time.monotonic()
-        with _enclose("materialize"):
-            v = fut.result()
+        fail: BaseException | None = None
+        v = None
+        try:
+            with _enclose("materialize"):
+                v = fut.result()
+        except Exception as e:  # noqa: BLE001 — gated by _queue_failure
+            if not _queue_failure(e):
+                raise
+            fail = e
         t_m1 = time.monotonic()
         ticked = praos.tick(params, lview_for(s_b), _slot_at(whvs, 0), state)
         if w_start == segments[s_b][1]:
@@ -3067,8 +3186,26 @@ def _device_loop(
                 "lookahead epoch nonce mismatch"
             )
         t_e0 = time.monotonic()
-        with _enclose("epilogue"):
-            res = _epilogue(params, ticked, whvs, pre, v)
+        if fail is None:
+            try:
+                with _enclose("epilogue"):
+                    res = _epilogue(params, ticked, whvs, pre, v)
+            except Exception as e:  # noqa: BLE001 — gated below
+                if not _queue_failure(e):
+                    raise
+                fail = e
+        if fail is not None:
+            # the supervisor re-validates JUST this window down the
+            # degradation ladder (retry -> stage-split -> xla-twin ->
+            # host reference); any rung's result IS the window's
+            # verdict. The device carry chain may have threaded through
+            # the failed computation, so it re-seeds from the host fold
+            # once the pipeline drains (carry_ok gate below).
+            carry_ok = False
+            res = _recovery.supervisor().recover_window(
+                params, ticked, whvs, fail, backend="device",
+                window=win_retired,
+            )
         state = res.state
         total_valid += res.n_valid
         _emit_window_span(
@@ -3078,6 +3215,12 @@ def _device_loop(
         if res.error is not None:
             return BatchResult(state, total_valid, res.error)
         retired += len(whvs)
+        # progress record BEFORE the sigkill seam: a chaos (or real)
+        # kill after this point loses nothing — the resume re-seeds
+        # from exactly this retired window (obs/recovery.py)
+        _recovery.note_window(state, res.n_valid)
+        _chaos.fire("retire")
+        win_retired += 1
         if ladder is not None:
             # the background production compile landed: record the swap
             # — the NEXT slices re-tile onto the production bucket

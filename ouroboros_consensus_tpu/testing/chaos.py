@@ -1,0 +1,364 @@
+"""Deterministic fault injection: every death mode, reproducible on CPU.
+
+Rounds r02-r05 each died a DIFFERENT death — probe timeout, ~410 s
+compile wall, AOT format rejection, driver kill — and every one was
+only ever observed on a live tunnel, where it cost a session. This
+module makes each of those modes an injectable, seeded, deterministic
+event so the recovery plane (obs/recovery.py) is proven against them
+in tier-1, on CPU, in milliseconds.
+
+Armed by ``OCT_CHAOS=<spec>``; the spec is a comma-separated list of
+injections, each ``<fault>@<trigger>:<arg>`` (the trigger clause is
+optional for fault kinds that need none):
+
+    compile-stall@window:3        sleep OCT_CHAOS_STALL_S at the 3rd
+                                  dispatched window (a simulated wall)
+    compile-stall@stage:ed        ...at stage 'ed's dispatch (pk path)
+    device-error@dispatch:2       raise DeviceChaosError at the 2nd
+                                  window dispatch (fake XlaRuntimeError)
+    device-error@stage:finish     ...inside _stage_call for 'finish'
+    device-error@shard:0          ...at the 0th sharded dispatch
+    staging-thread-death@window:5 raise inside prepare_window for the
+                                  5th staged window (producer thread)
+    sigkill@window:7              SIGKILL self when the 7th window
+                                  retires (AFTER its checkpoint lands)
+    chunk-corrupt@epoch:1         raise ChunkChaosError on the 2nd
+                                  chunk read (index 1; chunk index
+                                  stands in for the epoch on the
+                                  synthesized chains, one chunk/epoch)
+    aot-reject@stage:aggregate    ops/pk/aot.load reports the entry
+                                  rejected ("incompatible" class) for
+                                  any stage whose name contains the arg
+    probe-timeout                 bench's device probe hangs past its
+                                  timeout (one attempt per injection;
+                                  list it twice to kill two attempts)
+
+Triggers are matched against per-seam sequence counters (each seam
+counts its own firings from 0 in dispatch order) or, for ``stage:``,
+by substring against the stage label. Each injection fires EXACTLY
+once (append ``xN`` to the arg for N firings: ``device-error@dispatch:
+2x3``), so a retried operation succeeds — chaos faults are transient
+by construction, which is precisely the contract the recovery ladder
+is allowed to assume (COVERAGE.md §5.16 for what that excludes).
+
+Determinism: the spec and the per-seam counters fully determine WHERE
+every fault lands; ``OCT_CHAOS_SEED`` seeds the one RNG exposed here
+(`rng()`), used for backoff jitter by consumers that want reproducible
+recovery timing, never for fault placement.
+
+Zero overhead disarmed: every seam is ``chaos.fire(site, ...)`` whose
+first instruction checks a module bool refreshed from the env once per
+process (and by `reset()` in tests); with OCT_CHAOS unset the call is
+one attribute load + a falsy test, entirely host-side — the
+instrumentation-purity ratchet proves the seams add no equations to
+any traced program (tests/test_chaos.py)."""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+_ENV = "OCT_CHAOS"
+_SEED_ENV = "OCT_CHAOS_SEED"
+_STALL_ENV = "OCT_CHAOS_STALL_S"
+
+FAULT_KINDS = (
+    "compile-stall",
+    "device-error",
+    "staging-thread-death",
+    "sigkill",
+    "chunk-corrupt",
+    "aot-reject",
+    "probe-timeout",
+)
+
+# which seam(s) each fault kind is checked at — fire(site) only
+# consults injections mapped to that site, so a spec can never detonate
+# at a seam its fault kind does not model
+_KIND_SITES = {
+    "compile-stall": ("dispatch", "stage-call"),
+    "device-error": ("dispatch", "stage-call", "shard"),
+    "staging-thread-death": ("stage",),
+    "sigkill": ("retire",),
+    "chunk-corrupt": ("chunk",),
+    "aot-reject": ("aot",),
+    "probe-timeout": ("probe",),
+}
+
+
+class ChaosError(RuntimeError):
+    """Base of the injected-fault taxonomy. Transient by contract:
+    the injection that raised it is spent, so a retry succeeds."""
+
+
+class DeviceChaosError(ChaosError):
+    """Stands in for a runtime device error (XlaRuntimeError class)."""
+
+
+class StagingChaosError(ChaosError):
+    """The staging producer thread died mid-prepare."""
+
+
+class ChunkChaosError(ChaosError):
+    """A chunk read/extract came back corrupted (transient I/O)."""
+
+
+class AotRejectChaos(ChaosError):
+    """An AOT store entry is rejected as format-incompatible. The
+    message deliberately matches ops/pk/aot.INCOMPATIBLE_PATTERNS so
+    the real classification machinery sees the real failure shape."""
+
+    def __init__(self, stage: str):
+        super().__init__(
+            f"serialized executable is incompatible (chaos-injected "
+            f"rejection for stage {stage})"
+        )
+
+
+class _Injection:
+    __slots__ = ("kind", "trigger", "arg", "count", "fired")
+
+    def __init__(self, kind: str, trigger: str | None, arg, count: int):
+        self.kind = kind
+        self.trigger = trigger  # "window"|"dispatch"|"stage"|"epoch"|
+        # "shard"|None — the ctx key the seam matches against
+        self.arg = arg  # int seq / str stage-substring / None
+        self.count = count  # firings remaining
+        self.fired = 0
+
+    def matches(self, ctx: dict) -> bool:
+        if self.count <= 0:
+            return False
+        if self.trigger is None:
+            return True
+        if self.trigger not in ctx:
+            return False
+        v = ctx[self.trigger]
+        if isinstance(self.arg, str):
+            return self.arg in str(v)
+        return v == self.arg
+
+    def spend(self) -> None:
+        self.count -= 1
+        self.fired += 1
+
+    def describe(self) -> str:
+        t = f"@{self.trigger}:{self.arg}" if self.trigger is not None else ""
+        return f"{self.kind}{t}"
+
+
+class ChaosPlan:
+    """Parsed OCT_CHAOS spec + the per-seam sequence counters."""
+
+    def __init__(self, injections: list[_Injection], seed: int):
+        self.injections = injections
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._by_site: dict[str, list[_Injection]] = {}
+        for inj in injections:
+            for site in _KIND_SITES[inj.kind]:
+                self._by_site.setdefault(site, []).append(inj)
+
+    def next_seq(self, site: str) -> int:
+        with self._lock:
+            n = self._counters.get(site, 0)
+            self._counters[site] = n + 1
+            return n
+
+    def for_site(self, site: str) -> list[_Injection]:
+        return self._by_site.get(site, ())
+
+    def fired(self) -> list[str]:
+        return [i.describe() for i in self.injections if i.fired]
+
+
+def parse_spec(spec: str) -> list[_Injection]:
+    """Parse the OCT_CHAOS grammar; raises ValueError on a malformed
+    spec — an unparseable chaos plan must fail LOUDLY, a typo'd fault
+    that silently never fires would fake a green chaos matrix."""
+    out: list[_Injection] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, tail = part.partition("@")
+        kind = kind.strip()
+        if kind not in _KIND_SITES:
+            raise ValueError(
+                f"OCT_CHAOS: unknown fault kind {kind!r} "
+                f"(know {', '.join(FAULT_KINDS)})"
+            )
+        trigger: str | None = None
+        arg = None
+        count = 1
+        if tail and kind == "probe-timeout":
+            # a trigger clause here would be SILENTLY unhonored
+            # (probe_timeout_pending spends injections in list order) —
+            # reject it loudly instead of misplacing the fault
+            raise ValueError(
+                "OCT_CHAOS: probe-timeout takes no @trigger clause "
+                "(list it N times to kill N attempts)"
+            )
+        if tail:
+            trigger, _, argtxt = tail.partition(":")
+            trigger = trigger.strip()
+            argtxt = argtxt.strip()
+            if "x" in argtxt and argtxt.rsplit("x", 1)[1].isdigit():
+                argtxt, _, n = argtxt.rpartition("x")
+                count = int(n)
+            if not trigger or not argtxt:
+                # an empty arg would parse as the match-ANYTHING ''
+                # substring — a silently mis-placed fault, exactly what
+                # the fail-loud rule exists to prevent
+                raise ValueError(
+                    f"OCT_CHAOS: {part!r} has an empty trigger or arg "
+                    "(want <fault>@<trigger>:<arg>)"
+                )
+            arg = int(argtxt) if argtxt.lstrip("-").isdigit() else argtxt
+            if trigger == "epoch":  # chunk index stands in for epoch
+                trigger = "chunk"
+        elif kind == "probe-timeout":
+            trigger, arg, count = "attempt", None, 1
+        else:
+            raise ValueError(
+                f"OCT_CHAOS: fault {kind!r} needs a @trigger:arg clause"
+            )
+        out.append(_Injection(kind, trigger if arg is not None else None,
+                              arg, count))
+    return out
+
+
+_ARMED = False
+_PLAN: ChaosPlan | None = None
+_RNG: random.Random | None = None
+
+
+def _load() -> None:
+    global _ARMED, _PLAN, _RNG
+    spec = os.environ.get(_ENV, "")
+    seed = int(os.environ.get(_SEED_ENV, "0") or 0)
+    _RNG = random.Random(seed)
+    if not spec:
+        _ARMED, _PLAN = False, None
+        return
+    _PLAN = ChaosPlan(parse_spec(spec), seed)
+    _ARMED = True
+
+
+_load()
+
+
+def reset() -> None:
+    """Re-read OCT_CHAOS / OCT_CHAOS_SEED and zero every counter
+    (tests arm/disarm per case; production reads the env once)."""
+    _load()
+
+
+def armed() -> bool:
+    return _ARMED
+
+
+def plan() -> ChaosPlan | None:
+    return _PLAN
+
+
+def rng() -> random.Random:
+    """The seeded RNG — backoff jitter determinism for consumers
+    (obs/recovery.py, bench probe), never fault placement."""
+    assert _RNG is not None
+    return _RNG
+
+
+def jitter() -> float:
+    """The one backoff-jitter policy every recovery consumer shares
+    (obs/recovery.RecoverySupervisor, bench's probe retries): a
+    multiplicative factor in [1.0, 1.5), drawn from the seeded chaos
+    RNG when armed — reproducible recovery timing under a seeded fault
+    plan — and the process RNG otherwise."""
+    r = rng() if _ARMED else random
+    return 1.0 + 0.5 * r.random()
+
+
+def stall_s() -> float:
+    try:
+        return float(os.environ.get(_STALL_ENV, "0.2"))
+    except ValueError:
+        return 0.2
+
+
+def _execute(inj: _Injection, site: str, ctx: dict) -> None:
+    inj.spend()
+    where = f"{site} {ctx}" if ctx else site
+    if inj.kind == "compile-stall":
+        time.sleep(stall_s())
+        return
+    if inj.kind == "device-error":
+        raise DeviceChaosError(f"chaos: injected device error at {where}")
+    if inj.kind == "staging-thread-death":
+        raise StagingChaosError(f"chaos: staging producer died at {where}")
+    if inj.kind == "chunk-corrupt":
+        raise ChunkChaosError(f"chaos: chunk read corrupted at {where}")
+    if inj.kind == "aot-reject":
+        raise AotRejectChaos(str(ctx.get("stage", "?")))
+    if inj.kind == "sigkill":
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+    # probe-timeout is consumed by bench.probe_device via
+    # probe_timeout_pending(), never raised at a seam
+
+
+# which trigger keys each seam's OWN sequence counter may answer for:
+# a seam only ever defaults its canonical aliases, so an injection
+# whose trigger names ANOTHER seam (device-error@dispatch:N vs the
+# stage-call seam both sites of the same fault kind) can never match
+# off this seam's counter — the spec and the per-seam counters fully
+# determine WHERE every fault lands, which is the module's contract
+_SITE_SEQ_KEYS = {
+    "dispatch": ("window", "dispatch"),  # one dispatch per window
+    "stage": ("window",),  # prepare_window: one staging per window
+    "retire": ("window",),  # one retire per window
+    "shard": ("shard",),
+    "chunk": ("chunk",),
+    # "stage-call" / "aot" match only on the explicit stage= ctx;
+    # "probe" is consumed via probe_timeout_pending()
+}
+
+
+def fire(site: str, **ctx) -> None:
+    """The one seam entry point. Cheap no-op disarmed (module bool);
+    armed, it advances this seam's sequence counter, exposes it as the
+    seam's OWN canonical trigger keys (_SITE_SEQ_KEYS), and executes
+    the first matching un-spent injection (raise / sleep / kill per
+    its fault kind)."""
+    if not _ARMED:
+        return
+    p = _PLAN
+    if p is None:
+        return
+    injections = p.for_site(site)
+    if not injections:
+        return
+    seq = p.next_seq(site)
+    full = dict(ctx)
+    for k in _SITE_SEQ_KEYS.get(site, ()):
+        full.setdefault(k, seq)
+    for inj in injections:
+        if inj.matches(full):
+            _execute(inj, site, ctx or {"seq": seq})
+            return
+
+
+def probe_timeout_pending() -> bool:
+    """bench.probe_device's seam: True (and one injection consumed)
+    when the next probe attempt should hang past its timeout."""
+    if not _ARMED or _PLAN is None:
+        return False
+    for inj in _PLAN.for_site("probe"):
+        if inj.count > 0:
+            inj.spend()
+            return True
+    return False

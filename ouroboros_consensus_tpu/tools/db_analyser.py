@@ -44,6 +44,9 @@ class ValidationResult:
     device_s: float = 0.0  # kernel execution time (device backend)
     error: Exception | None = None
     final_state: PraosState | None = None
+    resumed_headers: int = 0  # headers skipped by a checkpoint resume
+    # (counted INTO n_valid: the record vouches for them — the resumed
+    # total equals the uninterrupted run's by the differential suite)
     # filled by collect_phases=True (protocol/batch tracer events):
     phases: dict | None = None  # per-phase wall s (stage/dispatch/...)
     h2d_bytes: int = 0  # staged bytes shipped host->device
@@ -220,6 +223,31 @@ def _views_from_columns(cols):
     return out
 
 
+def _read_chunk(path: str, chunk_idx: int) -> bytes:
+    """One chunk read behind the chaos seam (`chunk-corrupt@epoch:N` —
+    the chunk index stands in for the epoch on the synthesized chains,
+    one chunk per epoch) with ONE recovery reread: transient I/O (and
+    the chaos taxonomy, transient by contract) recovers in place as a
+    first-class `chunk-reread` RecoveryEvent; a second failure
+    propagates — persistent corruption must truncate loudly, not loop."""
+    from ..obs import recovery as _recovery
+    from ..testing import chaos
+
+    try:
+        chaos.fire("chunk", chunk=chunk_idx)
+        with open(path, "rb") as f:
+            return f.read()
+    except (chaos.ChaosError, OSError) as e:
+        if not (_recovery.enabled() and _recovery.recoverable(e)):
+            raise
+        _recovery.note_recovery_event("chunk-reread", chunk_idx, 0, 1, e)
+        with open(path, "rb") as f:
+            data = f.read()
+        _recovery.note_recovery_event("recovered", chunk_idx, 0, 1, e,
+                                      ok=True)
+        return data
+
+
 def _stream_windows(imm: ImmutableDB, res: "ValidationResult"):
     """Per-chunk window stream for revalidation: `ViewColumns` straight
     from the native columnar extractor when available (the C++
@@ -235,7 +263,7 @@ def _stream_windows(imm: ImmutableDB, res: "ValidationResult"):
     native_ok = native_loader.load() is not None
     columnar = _columnar_enabled()
     stream_deep = getattr(imm, "stream_deep", False)
-    for n in imm._chunks:
+    for chunk_idx, n in enumerate(imm._chunks):
         entries = imm._entries[n]
         if not entries:
             continue
@@ -244,8 +272,9 @@ def _stream_windows(imm: ImmutableDB, res: "ValidationResult"):
         # Enclose bracket per CHUNK — per-window granularity, no object
         # tax); pbatch._enclose is a no-op while no tracer is installed
         with pbatch._enclose("stream"):
-            with open(os.path.join(imm.path, _chunk_name(n)), "rb") as f:
-                data = f.read()
+            data = _read_chunk(
+                os.path.join(imm.path, _chunk_name(n)), chunk_idx
+            )
             truncated = False
             if stream_deep:
                 # single-pass validate-all: the open deferred the deep
@@ -314,6 +343,22 @@ def _cap_windows(wins, cap: int):
             return
         left -= len(win)
         yield win
+
+
+def _skip_headers(wins, n: int):
+    """Drop the first `n` headers of a window stream (checkpoint
+    resume: the retired prefix is already banked and the fold is
+    re-seeded from the host progress record). ViewColumns windows slice
+    in place, so the stream stays columnar across the resume point."""
+    left = n
+    for win in wins:
+        if left <= 0:
+            yield win
+        elif len(win) <= left:
+            left -= len(win)
+        else:
+            yield win[left:]
+            left = 0
 
 
 def _epoch_window_segments(params: PraosParams, wins):
@@ -449,8 +494,15 @@ def revalidate(
     # ledgerViewForecastAt driven from Storage/LedgerDB/Update.hs:115
     collect_phases: bool = False,  # per-phase wall + H2D/D2H byte
     # attribution in the result (batch tracer; bench.py json fields)
+    resume: bool | None = None,  # resume from the OCT_CHECKPOINT
+    # progress record when one matches this chain (None = follow the
+    # OCT_RESUME env lever) — obs/recovery.py; batched backends only
 ) -> ValidationResult:
-    """only-validation analysis: full chain revalidation from genesis.
+    """only-validation analysis: full chain revalidation from genesis
+    — or, with `OCT_CHECKPOINT` set and a resume requested, from the
+    last retired window of a killed attempt (crash-consistent progress
+    record, obs/recovery.py; proven verdict-identical to the
+    uninterrupted replay by tests/test_selfheal.py).
 
     collect_phases=True threads a batch tracer through the replay and
     fills `res.phases` / `res.h2d_bytes` / `res.d2h_bytes` /
@@ -470,12 +522,23 @@ def revalidate(
     from .. import obs
     from ..obs import live as _live
 
+    # arming is exception-safe END TO END: whatever escapes the replay
+    # (a validation error, an exhausted recovery ladder, a failure in
+    # maybe_arm itself) must release the live plane's ref-count and
+    # stop the OCT_METRICS_PORT server thread — a failed replay may
+    # never leave an orphan listener behind (tests/test_live.py)
     installed = obs.maybe_install()
-    plane = _live.maybe_arm()
+    try:
+        plane = _live.maybe_arm()
+    except BaseException:
+        if installed:
+            obs.uninstall()
+        raise
     try:
         return _revalidate_traced(
             db_path, params, lview, backend, validate_all, max_batch,
             max_headers, trace, ledger, genesis_state, collect_phases,
+            resume,
         )
     finally:
         if plane is not None:
@@ -486,7 +549,7 @@ def revalidate(
 
 def _revalidate_traced(
     db_path, params, lview, backend, validate_all, max_batch,
-    max_headers, trace, ledger, genesis_state, collect_phases,
+    max_headers, trace, ledger, genesis_state, collect_phases, resume,
 ) -> ValidationResult:
     if collect_phases:
         coll = _PhaseCollector()
@@ -501,7 +564,7 @@ def _revalidate_traced(
         try:
             res = _revalidate_impl(
                 db_path, params, lview, backend, validate_all, max_batch,
-                max_headers, trace, ledger, genesis_state,
+                max_headers, trace, ledger, genesis_state, resume,
             )
         finally:
             pbatch.set_batch_tracer(prev)
@@ -509,13 +572,13 @@ def _revalidate_traced(
         return res
     return _revalidate_impl(
         db_path, params, lview, backend, validate_all, max_batch,
-        max_headers, trace, ledger, genesis_state,
+        max_headers, trace, ledger, genesis_state, resume,
     )
 
 
 def _revalidate_impl(
     db_path, params, lview, backend, validate_all, max_batch,
-    max_headers, trace, ledger, genesis_state,
+    max_headers, trace, ledger, genesis_state, resume=None,
 ) -> ValidationResult:
     """The revalidate body (wrapped by `revalidate` for attribution).
 
@@ -606,33 +669,67 @@ def _revalidate_impl(
         except praos.PraosValidationError as e:
             res.error = e
     elif backend in ("device", "native", "sharded"):
-        # one epoch segment buffered at a time (bounded memory on real
-        # chains); validate_chain pipelines staging against device
-        # execution within each segment. Segments flow COLUMNAR
-        # (ViewColumns) end-to-end from the native chunk scan; HeaderView
-        # lists appear only without the native library / OCT_COLUMNAR=0
-        wins = _stream_windows(imm, res)
-        if max_headers is not None:
-            wins = _cap_windows(wins, max_headers)
-        segs = _epoch_window_segments(params, wins)
-        if backend == "device" and pbatch._stage_thread_enabled():
-            # prefetch the NEXT epoch segment's disk/parse/column work
-            # while this one validates — the device loop's staging
-            # thread then overlaps prechecks+staging within the segment
-            segs = _prefetch_iter(segs, depth=2)
-        for seg in segs:
-            ts = time.monotonic()
-            result = pbatch.validate_chain(
-                params, lambda _e: lview, st, seg,
-                max_batch=max_batch, backend=backend,
-            )
-            res.device_s += time.monotonic() - ts
-            st = result.state
-            res.n_valid += result.n_valid
-            if result.error is not None:
-                res.error = result.error
-                break
-            trace(f"validated {res.n_valid} headers")
+        # crash-consistent checkpoint/resume (obs/recovery.py): when
+        # OCT_CHECKPOINT is set, validate_chain's retire path persists
+        # a progress record per retired window under this chain's tag;
+        # a requested resume re-seeds the fold from the record and
+        # skips the already-banked prefix of the window stream.
+        from ..obs import recovery as _recovery
+
+        tag = _recovery.chain_tag(db_path, params)
+        want_resume = (_recovery.resume_requested()
+                       if resume is None else resume)
+        rec_doc = _recovery.resume_record(tag) if want_resume else None
+        _recovery.arm_writer(
+            tag,
+            resumed_headers=int(rec_doc["headers"]) if rec_doc else 0,
+            resumed_windows=int(rec_doc["windows"]) if rec_doc else 0,
+        )
+        try:
+            if rec_doc is not None:
+                st = _recovery.decode_state(rec_doc["state"])
+                res.n_valid = int(rec_doc["headers"])
+                res.resumed_headers = int(rec_doc["headers"])
+                _recovery.note_resume(rec_doc)
+            # one epoch segment buffered at a time (bounded memory on
+            # real chains); validate_chain pipelines staging against
+            # device execution within each segment. Segments flow
+            # COLUMNAR (ViewColumns) end-to-end from the native chunk
+            # scan; HeaderView lists appear only without the native
+            # library / OCT_COLUMNAR=0
+            wins = _stream_windows(imm, res)
+            if max_headers is not None:
+                wins = _cap_windows(wins, max_headers)
+            if res.resumed_headers:
+                wins = _skip_headers(wins, res.resumed_headers)
+            segs = _epoch_window_segments(params, wins)
+            if backend == "device" and pbatch._stage_thread_enabled():
+                # prefetch the NEXT epoch segment's disk/parse/column
+                # work while this one validates — the device loop's
+                # staging thread then overlaps prechecks+staging within
+                # the segment
+                segs = _prefetch_iter(segs, depth=2)
+            for seg in segs:
+                ts = time.monotonic()
+                result = pbatch.validate_chain(
+                    params, lambda _e: lview, st, seg,
+                    max_batch=max_batch, backend=backend,
+                )
+                res.device_s += time.monotonic() - ts
+                st = result.state
+                res.n_valid += result.n_valid
+                if result.error is not None:
+                    res.error = result.error
+                    break
+                trace(f"validated {res.n_valid} headers")
+            w = _recovery._WRITER
+            if w is not None:
+                # mark the record COMPLETE (cleanly or at a validation
+                # error): a later resume never skips a fresh run's work
+                # based on a finished one's position
+                w.finalize(st, res.error)
+        finally:
+            _recovery.disarm_writer()
     else:
         raise ValueError(f"unknown backend {backend!r}")
 
@@ -1027,6 +1124,10 @@ def main(argv=None) -> None:
         default="only-validation",
     )
     p.add_argument("--backend", choices=["device", "native", "sharded", "host"], default="device")
+    p.add_argument("--resume", action="store_true",
+                   help="resume only-validation from the OCT_CHECKPOINT "
+                        "progress record when one matches this chain "
+                        "(default: follow the OCT_RESUME env lever)")
     p.add_argument("--out-csv", default=None)
     p.add_argument("--config", default=None,
                    help="node config.json (defaults to <db>/config/config.json "
@@ -1122,7 +1223,8 @@ def main(argv=None) -> None:
             f"; CSV at {a.out_csv}" if a.out_csv else ""))
         return
     res = revalidate(a.db, params, lview, backend=a.backend,
-                     trace=lambda s: print(s))
+                     trace=lambda s: print(s),
+                     resume=True if a.resume else None)
     status = "OK" if res.error is None else f"INVALID at {res.n_valid}: {res.error!r}"
     print(
         f"validated {res.n_valid}/{res.n_blocks} headers in {res.wall_s:.1f}s "

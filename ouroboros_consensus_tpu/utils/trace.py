@@ -175,6 +175,37 @@ class StallEvent:
 
 
 @dataclass(frozen=True)
+class RecoveryEvent:
+    """The recovery supervisor (obs/recovery.py) took an action for a
+    failing window: one event per LADDER TRANSITION, so the trajectory
+    of an episode (retry -> stage-split -> ... -> recovered/exhausted)
+    is a readable event sequence and a countable metric
+    (oct_recovery_total{action=}). `fault` is the failure class being
+    recovered (the exception type, e.g. DeviceChaosError,
+    XlaRuntimeError); `ok` is set on the terminal event of the episode."""
+
+    action: str  # "retry" | "restage" | "stage-split" | "xla-twin"
+    # | "host-reference" | "chunk-reread" | "recovered" | "exhausted"
+    window: int  # retire-order window index (or -1 when unknown)
+    lanes: int
+    attempt: int  # 1-based position in the episode's ladder
+    fault: str  # exception class name of the original failure
+    detail: str  # repr of the triggering exception, trimmed
+    ok: bool | None = None  # terminal events: did the episode recover?
+
+
+@dataclass(frozen=True)
+class CheckpointEvent:
+    """The crash-consistent progress record (obs/recovery.py) moved:
+    a per-retired-window atomic write, or a resume that seeded a replay
+    from a record instead of genesis."""
+
+    kind: str  # "write" | "resume" | "complete"
+    headers: int  # cumulative retired headers at this point
+    windows: int  # cumulative retired windows
+
+
+@dataclass(frozen=True)
 class ShardSpan:
     """Per-shard WindowSpan analogue for one sharded SPMD dispatch
     (parallel/spmd.sharded_run_batch): how one mesh position fared.

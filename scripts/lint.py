@@ -75,13 +75,19 @@ _MACHINERY_FILES = {"scripts/fit_costmodel.py"}
 # change any crypto graph, but it CAN leak telemetry into the traced
 # programs — map these into the instrumentation-purity re-trace so an
 # obs diff re-runs the zero-eqn differential instead of skipping every
-# graph pass. The live-plane modules (obs/live.py, obs/server.py) ride
-# the prefix; parallel/spmd.py is mapped explicitly since round 11 —
-# it emits per-shard ShardSpan telemetry beside the shard_map program,
-# exactly the host/device boundary the purity differential fences.
+# graph pass. The live-plane modules (obs/live.py, obs/server.py) and
+# the recovery plane (obs/recovery.py) ride the prefix;
+# parallel/spmd.py is mapped explicitly since round 11 — it emits
+# per-shard ShardSpan telemetry beside the shard_map program, exactly
+# the host/device boundary the purity differential fences — and
+# testing/chaos.py since round 12: its injection seams sit beside the
+# packed_unpack/verdict_reduce dispatch paths, so a chaos edit re-runs
+# the zero-eqn differential proving the seams add no equations to the
+# production jaxprs when disarmed.
 _OBS_PREFIX = "ouroboros_consensus_tpu/obs/"
 _OBS_FILES = {"scripts/perf_report.py",
-              "ouroboros_consensus_tpu/parallel/spmd.py"}
+              "ouroboros_consensus_tpu/parallel/spmd.py",
+              "ouroboros_consensus_tpu/testing/chaos.py"}
 
 
 def _changed_files() -> set[str]:

@@ -34,7 +34,15 @@ Since round 11 bench also banks the LIVE plane's evidence: a
 `live_timeline` (the parent-tailed heartbeat classifications) and any
 `stall_dump` the child's watchdog wrote. A dead round whose last
 heartbeat says `phase=dispatch, age=600s` classifies as
-`stalled@dispatch` — distinct from probe-timeout and compile-wall."""
+`stalled@dispatch` — distinct from probe-timeout and compile-wall.
+
+Since round 12 the RECOVERY plane's evidence rides too: the warmup
+report's `recovery` rows (obs/recovery.py — every degradation-ladder
+transition of every episode). A round that banked its device number
+only because the supervisor walked failing windows down the ladder is
+its own class, `recovered@<fault>` — priority-wise between `stalled@`
+(it did not die) and clean (it did not run clean either) — rendered
+with its per-action transition counts."""
 
 from __future__ import annotations
 
@@ -143,6 +151,24 @@ def _classify_failures(text: str, rc, parsed: dict | None = None) -> list[dict]:
     return out
 
 
+def _recovery_counts(wr: dict | None) -> tuple[dict, str | None]:
+    """({action: count}, fault-of-the-first-recovered-episode) out of a
+    banked warmup report's `recovery` rows (obs/recovery.py). The fault
+    is the exception class the supervisor recovered FROM — what
+    `recovered@<fault>` names."""
+    rows = (wr or {}).get("recovery") or []
+    counts: dict = {}
+    fault = None
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        a = row.get("action", "?")
+        counts[a] = counts.get(a, 0) + 1
+        if fault is None and a == "recovered":
+            fault = row.get("fault") or "?"
+    return counts, fault
+
+
 def _gate_counts(metrics: dict | None) -> dict:
     """{gate: count} out of a banked metrics snapshot (or {})."""
     if not isinstance(metrics, dict):
@@ -184,6 +210,9 @@ def analyze_bench_round(path: str) -> dict:
             "ladder": len(ladder_events),
             "cache_probe": (wr.get("cache_probe") or {}).get("outcome"),
         }
+    recovery_actions, recovered_fault = _recovery_counts(
+        wr if isinstance(wr, dict) else None
+    )
     row = {
         "round": _round_of(path, doc),
         "file": os.path.basename(path),
@@ -206,6 +235,12 @@ def analyze_bench_round(path: str) -> dict:
                          or (parsed or {}).get("laddered")),
         "ladder_swapped": any(e.get("kind") == "swap"
                               for e in ladder_events),
+        # the recovery plane's banked story (round 12): ladder-
+        # transition counts per action, and — for a round that FINISHED
+        # via recovery — the fault class it recovered from
+        "recovery_actions": recovery_actions,
+        "recovered_fault": recovered_fault,
+        "resumed_headers": (parsed or {}).get("resumed_headers") or 0,
         # the live plane's banked story (round 11): timeline length +
         # last state, and whether a stall dump named a wedged phase
         "live_states": [e.get("state") for e in
@@ -402,9 +437,17 @@ def render_markdown(report: dict) -> str:
             declines,
             _md_escape(
                 ", ".join(f["mode"] for f in r["failures"])
-                or ("laddered" + (" (swapped)" if r.get("ladder_swapped")
-                                  else "")
-                    if r.get("laddered") else "—")
+                or ", ".join(filter(None, [
+                    # a banked round that finished VIA recovery is its
+                    # own class — priority between stalled@ (it did not
+                    # die) and clean (it did not run clean either)
+                    (f"recovered@{r['recovered_fault']}"
+                     if r.get("recovered_fault") else None),
+                    ("laddered" + (" (swapped)" if r.get("ladder_swapped")
+                                   else "")
+                     if r.get("laddered") else None),
+                ]))
+                or "—"
             ),
         ))
     dead = [r for r in rounds if not r["device_banked"]]
@@ -416,7 +459,27 @@ def render_markdown(report: dict) -> str:
             )
             if r.get("laddered"):
                 modes += " — warm ladder HAD engaged before the death"
+            if r.get("recovery_actions"):
+                acts = ", ".join(f"{k}={v}" for k, v in
+                                 sorted(r["recovery_actions"].items()))
+                modes += (" — recovery ladder HAD engaged before the "
+                          f"death ({acts})")
             out.append(f"* r{r['round']:02d}: {modes}")
+    recovered = [r for r in rounds
+                 if r["device_banked"] and r.get("recovery_actions")]
+    if recovered:
+        out += ["", "## Recovered rounds", ""]
+        for r in recovered:
+            acts = ", ".join(f"{k}={v}" for k, v in
+                             sorted(r["recovery_actions"].items()))
+            resumed = (f"; resumed past {r['resumed_headers']} banked "
+                       "headers" if r.get("resumed_headers") else "")
+            out.append(
+                f"* r{r['round']:02d}: recovered@"
+                f"{r.get('recovered_fault') or '?'} — the supervisor "
+                f"walked failing windows down the ladder ({acts})"
+                f"{resumed}; the banked number is a RECOVERED replay's"
+            )
     laddered = [r for r in rounds if r["device_banked"] and r.get("laddered")]
     if laddered:
         out += ["", "## Laddered rounds", ""]

@@ -1,15 +1,27 @@
-"""bench.py backend-probe hardening (round-10 satellite): r02-r04 each
-died on a single probe timeout. The probe now makes at most TWO
-attempts — one under the main probe budget, one backoff'd retry under
-its own small budget — and banks a structured verdict distinguishing
+"""bench.py backend-probe hardening (round-10 satellite; round-12
+backoff): r02-r04 each died on a single probe timeout. The probe makes
+one attempt under the main probe budget, then retries with JITTERED
+EXPONENTIAL backoff under the shared BENCH_PROBE_RETRY_BUDGET (bounded
+by PROBE_MAX_ATTEMPTS), and banks a structured verdict distinguishing
 probe-timeout (backend init hung) from probe-error (backend answered
-wrongly), which perf_report classifies without tail archaeology."""
+wrongly) — with every attempt's preceding wait recorded, so perf_report
+can tell "backed off and recovered" from "retried instantly and
+died"."""
 
 import subprocess
 
 import pytest
 
 import bench
+from ouroboros_consensus_tpu.testing import chaos
+
+
+@pytest.fixture(autouse=True)
+def _chaos_reset(monkeypatch):
+    monkeypatch.delenv("OCT_CHAOS", raising=False)
+    chaos.reset()
+    yield
+    chaos.reset()
 
 
 class _Done:
@@ -39,8 +51,14 @@ def test_probe_ok_first_attempt(monkeypatch, fast_clock):
     assert verdict["attempts"][0]["outcome"] == "ok"
 
 
-def test_probe_timeout_retries_exactly_once(monkeypatch, fast_clock):
+def test_probe_timeout_backs_off_exponentially(monkeypatch):
     calls = []
+    waits = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: waits.append(s))
+    # a roomy retry budget so the FULL ladder runs (the default 75 s
+    # budget stops the ladder once a backoff would eat the attempt's
+    # own probe window — covered separately below)
+    monkeypatch.setattr(bench, "PROBE_RETRY_BUDGET", 10_000.0)
 
     def fake_run(cmd, **kw):
         calls.append(kw)
@@ -49,12 +67,61 @@ def test_probe_timeout_retries_exactly_once(monkeypatch, fast_clock):
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
     ok, verdict = bench.probe_device()
     assert not ok
-    assert len(calls) == 2  # one retry, never a loop
+    assert len(calls) == bench.PROBE_MAX_ATTEMPTS  # bounded, never a loop
     assert verdict["outcome"] == "backend-probe-timeout"
+    assert all(a["outcome"] == "probe-timeout"
+               for a in verdict["attempts"])
+    # jittered exponential ladder: each wait in [base*2^k, 1.5*base*2^k]
+    assert len(waits) == bench.PROBE_MAX_ATTEMPTS - 1
+    for k, w in enumerate(waits):
+        base = bench.PROBE_RETRY_BACKOFF_S * (2 ** k)
+        assert base - 1e-6 <= w <= 1.5 * base + 1e-6
+    assert waits == sorted(waits)  # strictly growing ladder
+    # the structured verdict records every attempt's preceding wait:
+    # "backed off and died" is distinguishable from "retried instantly"
+    assert verdict["attempts"][0]["backoff_s"] == 0.0
+    assert all(a["backoff_s"] > 0 for a in verdict["attempts"][1:])
+
+
+def test_probe_backoff_never_burns_wall_it_cannot_use(monkeypatch):
+    """A backoff that would eat the attempt's own probe window stops
+    the ladder BEFORE sleeping: the retry budget bounds total wall, and
+    no terminal sleep is spent on an attempt that can never run."""
+    calls = []
+    waits = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: waits.append(s))
+    # budget fits attempt 2's ~15-22.5 s backoff but not attempt 3's
+    monkeypatch.setattr(bench, "PROBE_RETRY_BUDGET", 30.0)
+
+    def fake_run(cmd, **kw):
+        calls.append(kw)
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    ok, verdict = bench.probe_device()
+    assert not ok
+    assert len(calls) == 2  # attempt 1 + the one retry the budget fits
+    assert len(waits) == 1  # and NO sleep for the attempt that never ran
+    assert len(verdict["attempts"]) == len(calls)
+    # retries run under the retry budget's timeout, not the main one
+    assert calls[1]["timeout"] <= 30.0
+
+
+def test_probe_chaos_timeout_then_recovery(monkeypatch):
+    """OCT_CHAOS=probe-timeout: the injected r02 death shape eats one
+    attempt; the backoff'd retry recovers — and the banked verdict
+    shows exactly that trajectory (wait recorded on the recovery)."""
+    monkeypatch.setenv("OCT_CHAOS", "probe-timeout")
+    chaos.reset()
+    waits = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: waits.append(s))
+    monkeypatch.setattr(bench.subprocess, "run", lambda cmd, **kw: _Done())
+    ok, verdict = bench.probe_device()
+    assert ok and verdict["outcome"] == "ok"
     assert [a["outcome"] for a in verdict["attempts"]] == \
-        ["probe-timeout", "probe-timeout"]
-    # the retry runs under its own small budget, not the main one
-    assert calls[1]["timeout"] <= bench.PROBE_RETRY_BUDGET
+        ["probe-timeout", "ok"]
+    assert verdict["attempts"][0]["backoff_s"] == 0.0
+    assert verdict["attempts"][1]["backoff_s"] > 0  # backed off, recovered
 
 
 def test_probe_recovers_on_retry(monkeypatch, fast_clock):

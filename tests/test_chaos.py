@@ -1,0 +1,244 @@
+"""testing/chaos.py units: the OCT_CHAOS spec grammar (malformed specs
+fail LOUDLY — a typo'd fault that silently never fires would fake a
+green chaos matrix), per-seam sequence/trigger matching, exactly-once
+(and xN) firing semantics, seeded-RNG determinism, and the
+zero-overhead-disarmed contract every hot-path seam relies on."""
+
+from __future__ import annotations
+
+import pytest
+
+from ouroboros_consensus_tpu.testing import chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarmed(monkeypatch):
+    """Every test starts (and leaves the process) disarmed."""
+    monkeypatch.delenv("OCT_CHAOS", raising=False)
+    monkeypatch.delenv("OCT_CHAOS_SEED", raising=False)
+    chaos.reset()
+    yield
+    monkeypatch.delenv("OCT_CHAOS", raising=False)
+    chaos.reset()
+
+
+def _arm(monkeypatch, spec: str, seed: int | None = None):
+    monkeypatch.setenv("OCT_CHAOS", spec)
+    if seed is not None:
+        monkeypatch.setenv("OCT_CHAOS_SEED", str(seed))
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_every_documented_fault_kind():
+    injs = chaos.parse_spec(
+        "compile-stall@window:3, device-error@dispatch:2,"
+        "staging-thread-death@window:5, sigkill@window:7,"
+        "chunk-corrupt@epoch:1, aot-reject@stage:aggregate,"
+        "probe-timeout"
+    )
+    assert [i.kind for i in injs] == [
+        "compile-stall", "device-error", "staging-thread-death",
+        "sigkill", "chunk-corrupt", "aot-reject", "probe-timeout",
+    ]
+    # every parsed kind is a documented one, and the registry maps each
+    # to at least one seam site
+    for i in injs:
+        assert i.kind in chaos.FAULT_KINDS
+        assert chaos._KIND_SITES[i.kind]
+    # the epoch trigger aliases onto the chunk seam key
+    assert injs[4].trigger == "chunk" and injs[4].arg == 1
+    # stage triggers carry the substring, not an int
+    assert injs[5].trigger == "stage" and injs[5].arg == "aggregate"
+
+
+def test_parse_multiplicity_suffix():
+    (inj,) = chaos.parse_spec("device-error@dispatch:2x3")
+    assert inj.arg == 2 and inj.count == 3
+
+
+def test_probe_timeout_rejects_trigger_clause():
+    """probe_timeout_pending spends injections in list order, so a
+    trigger clause would be silently unhonored — the parser refuses it
+    (list the fault N times to kill N attempts instead)."""
+    with pytest.raises(ValueError, match="probe-timeout takes no"):
+        chaos.parse_spec("probe-timeout@attempt:2")
+
+
+def test_malformed_specs_fail_loudly():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        chaos.parse_spec("device-eror@dispatch:2")
+    with pytest.raises(ValueError, match="needs a @trigger"):
+        chaos.parse_spec("device-error")
+    # an empty arg would parse as the match-ANYTHING '' substring — a
+    # silently MIS-PLACED fault, rejected at arm time instead
+    with pytest.raises(ValueError, match="empty trigger or arg"):
+        chaos.parse_spec("device-error@dispatch")
+    with pytest.raises(ValueError, match="empty trigger or arg"):
+        chaos.parse_spec("device-error@dispatch:")
+    with pytest.raises(ValueError, match="empty trigger or arg"):
+        chaos.parse_spec("device-error@:2")
+    # and an armed process refuses to start with a broken plan
+    import os
+
+    os.environ["OCT_CHAOS"] = "nope@x:1"
+    try:
+        with pytest.raises(ValueError):
+            chaos.reset()
+    finally:
+        del os.environ["OCT_CHAOS"]
+        chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# firing semantics
+# ---------------------------------------------------------------------------
+
+
+def test_fire_matches_sequence_and_spends_exactly_once(monkeypatch):
+    _arm(monkeypatch, "device-error@dispatch:2")
+    chaos.fire("dispatch")  # seq 0
+    chaos.fire("dispatch")  # seq 1
+    with pytest.raises(chaos.DeviceChaosError):
+        chaos.fire("dispatch")  # seq 2 -> fires
+    # spent: the retried operation succeeds (transient by contract)
+    chaos.fire("dispatch")
+    assert chaos.plan().fired() == ["device-error@dispatch:2"]
+
+
+def test_fire_xn_fires_n_times(monkeypatch):
+    _arm(monkeypatch, "device-error@dispatch:0x2")
+    with pytest.raises(chaos.DeviceChaosError):
+        chaos.fire("dispatch")
+    # the x2 injection matches the EXPLICIT dispatch key again
+    with pytest.raises(chaos.DeviceChaosError):
+        chaos.fire("dispatch", dispatch=0)
+    chaos.fire("dispatch", dispatch=0)  # both firings spent
+
+
+def test_stage_substring_trigger(monkeypatch):
+    _arm(monkeypatch, "device-error@stage:finish")
+    chaos.fire("stage-call", stage="ed")
+    chaos.fire("stage-call", stage="kes")
+    with pytest.raises(chaos.DeviceChaosError):
+        chaos.fire("stage-call", stage="finish")
+
+
+def test_trigger_key_never_answers_for_another_seams_counter(monkeypatch):
+    """device-error is registered at the dispatch, stage-call AND shard
+    seams, but a @dispatch trigger must only ever match the dispatch
+    seam's OWN counter — on the TPU pk path the stage-call seam fires
+    several times per window, and pre-fix it would detonate the fault
+    at the wrong seam and spend it (_SITE_SEQ_KEYS regression)."""
+    _arm(monkeypatch, "device-error@dispatch:2")
+    for _ in range(5):
+        chaos.fire("stage-call", stage="ed")  # must NOT detonate
+        chaos.fire("shard")  # nor here: @dispatch is not @shard
+    chaos.fire("dispatch")  # seq 0
+    chaos.fire("dispatch")  # seq 1
+    with pytest.raises(chaos.DeviceChaosError):
+        chaos.fire("dispatch")  # seq 2: the intended placement
+    # and the window alias binds to the dispatch/stage/retire seams
+    # only: compile-stall@window:N can never land inside a stage call
+    _arm(monkeypatch, "compile-stall@window:0")
+    chaos.fire("stage-call", stage="ed")
+    assert not chaos.plan().fired()
+
+
+def test_sites_are_fenced_per_fault_kind(monkeypatch):
+    """A spec can never detonate at a seam its fault kind does not
+    model: a chunk-corrupt injection is invisible to the dispatch
+    seam even at the matching sequence number."""
+    _arm(monkeypatch, "chunk-corrupt@epoch:0")
+    chaos.fire("dispatch")
+    chaos.fire("stage")
+    chaos.fire("retire")
+    with pytest.raises(chaos.ChunkChaosError):
+        chaos.fire("chunk", chunk=0)
+
+
+def test_explicit_ctx_overrides_seam_sequence(monkeypatch):
+    """Seams that know their own index (db_analyser passes chunk=) pin
+    the trigger to it — rereads of earlier chunks can't misalign the
+    placement."""
+    _arm(monkeypatch, "chunk-corrupt@epoch:2")
+    chaos.fire("chunk", chunk=0)
+    chaos.fire("chunk", chunk=0)  # a reread does not advance toward 2
+    chaos.fire("chunk", chunk=1)
+    with pytest.raises(chaos.ChunkChaosError):
+        chaos.fire("chunk", chunk=2)
+
+
+def test_compile_stall_sleeps_not_raises(monkeypatch):
+    import time
+
+    _arm(monkeypatch, "compile-stall@window:0")
+    monkeypatch.setenv("OCT_CHAOS_STALL_S", "0.01")
+    t0 = time.monotonic()
+    chaos.fire("dispatch")  # sleeps, returns
+    assert time.monotonic() - t0 >= 0.01
+    assert chaos.plan().fired() == ["compile-stall@window:0"]
+
+
+def test_aot_reject_message_matches_real_classification(monkeypatch):
+    from ouroboros_consensus_tpu.ops.pk import aot
+
+    _arm(monkeypatch, "aot-reject@stage:aggregate")
+    with pytest.raises(chaos.AotRejectChaos) as ei:
+        chaos.fire("aot", stage="aggregate_core")
+    # the injected message IS the r04 failure shape: the real
+    # incompatible-executable patterns match it
+    assert any(p in str(ei.value).lower()
+               for p in aot.INCOMPATIBLE_PATTERNS)
+
+
+def test_probe_timeout_pending_consumes_one(monkeypatch):
+    _arm(monkeypatch, "probe-timeout,probe-timeout")
+    assert chaos.probe_timeout_pending()
+    assert chaos.probe_timeout_pending()
+    assert not chaos.probe_timeout_pending()
+
+
+# ---------------------------------------------------------------------------
+# determinism + disarmed overhead
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_rng_is_deterministic(monkeypatch):
+    _arm(monkeypatch, "device-error@dispatch:0", seed=42)
+    a = [chaos.rng().random() for _ in range(3)]
+    _arm(monkeypatch, "device-error@dispatch:0", seed=42)
+    b = [chaos.rng().random() for _ in range(3)]
+    assert a == b
+    _arm(monkeypatch, "device-error@dispatch:0", seed=43)
+    assert [chaos.rng().random() for _ in range(3)] != a
+
+
+def test_disarmed_fire_is_a_noop_and_counts_nothing():
+    assert not chaos.armed() and chaos.plan() is None
+    for _ in range(1000):
+        chaos.fire("dispatch")
+        chaos.fire("stage", stage="ed")
+        chaos.fire("retire")
+    assert chaos.plan() is None  # no counters, no plan, no state
+
+
+def test_seams_add_zero_equations_to_production_jaxprs(monkeypatch):
+    """The acceptance wording, directly: with the seams in place and
+    chaos DISARMED, the seam-adjacent production graphs trace to
+    exactly the same equation count as the instrumentation-purity
+    baseline (the ratchet in scripts/lint.py re-checks this whenever
+    chaos.py/recovery.py change; this is the tier-1 pin)."""
+    from ouroboros_consensus_tpu.analysis import graphs
+
+    budgets = graphs.load_budgets()
+    names = budgets["instrumentation_purity"]["graphs"]
+    assert {"packed_unpack", "verdict_reduce"} <= set(names)
+    violations = graphs.check_instrumentation_purity(
+        budgets, names=["packed_unpack", "verdict_reduce"]
+    )
+    assert violations == []

@@ -537,3 +537,127 @@ def test_bench_heartbeat_tail_and_stall_dump_slim(tmp_path, monkeypatch):
         len(frames) <= 6 for frames in slim["threads"].values()
     )
     json.dumps(slim, allow_nan=False)
+
+
+# ---------------------------------------------------------------------------
+# round 12 satellites: classify() edge states feeding the supervisor,
+# watchdog episodes across a recovery, failed-replay plane lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_classify_clock_skewed_future_beat():
+    """A beat timestamp IN THE FUTURE (writer/reader clock skew) must
+    classify by its live phase — never as dead (staleness is 'too far
+    in the past', a skewed-forward clock is not evidence of death)."""
+    now = time.time()
+    doc = {"ts_unix": now + 3600, "phase": "dispatch", "warmup": {}}
+    assert live.classify(doc, now) == "running"
+    doc = {"ts_unix": now + 3600, "phase": "stage", "warmup": {}}
+    assert live.classify(doc, now) == "staging"
+    doc = {"ts_unix": now + 3600, "phase": "idle", "warmup": {},
+           "stalled_now": True}
+    assert live.classify(doc, now) == "stalled"
+
+
+def test_classify_zero_window_replay(tmp_path):
+    """A replay that never retires a window (empty chain / all work
+    ahead of it): armed and fresh it reads idle — not stalled, not
+    dead — and the rolling rate stays None, never NaN."""
+    from ouroboros_consensus_tpu.obs.warmup import WARMUP
+
+    WARMUP.reset()
+    try:
+        rec = obs.recorder()
+        clk = [100.0]
+        hb = live.Heartbeat(str(tmp_path / "hb.json"), rec=rec,
+                            clock=lambda: clk[0])
+        doc = hb.beat()
+        assert doc["headers"] == 0 and doc["phase"] == "idle"
+        assert doc["headers_per_s"] is None
+        assert live.classify(doc, now_unix=doc["ts_unix"]) == "idle"
+        clk[0] = 130.0
+        doc = hb.beat()
+        assert doc["headers_per_s"] == pytest.approx(0.0)
+        assert live.classify(doc, now_unix=doc["ts_unix"]) == "idle"
+        json.dumps(doc, allow_nan=False)
+    finally:
+        WARMUP.reset()
+
+
+def test_watchdog_one_dump_per_episode_across_recovery(tmp_path):
+    """The episode contract across a RECOVERY: a wedge trips once; the
+    supervisor's ladder transitions count as progress (re-arming the
+    watchdog mid-recovery); a NEW wedge after the recovered episode is
+    a new episode with its own dump — one dump per episode, not per
+    process."""
+    from ouroboros_consensus_tpu.obs.warmup import WARMUP
+
+    WARMUP.reset()
+    try:
+        rec = obs.recorder()
+        rec(_span(0))
+        clk = [0.0]
+        wd = live.StallWatchdog(budget_s=10.0, rec=rec,
+                                dump_path=str(tmp_path / "d.json"),
+                                clock=lambda: clk[0])
+        clk[0] = 11.0
+        assert wd.check() is not None  # episode 1 trips: one dump
+        clk[0] = 25.0
+        assert wd.check() is None  # SAME episode: no re-dump
+        assert wd.dumps == 1
+        # the supervisor starts walking the wedged window down the
+        # ladder — recovery transitions ARE progress
+        WARMUP.note_recovery("retry", window=3, attempt=1,
+                             fault="DeviceChaosError")
+        clk[0] = 26.0
+        assert wd.check() is None and not wd.tripped  # re-armed
+        WARMUP.note_recovery("recovered", window=3, attempt=1,
+                             fault="DeviceChaosError", ok=True)
+        clk[0] = 27.0
+        assert wd.check() is None
+        clk[0] = 45.0
+        assert wd.check() is not None  # a NEW wedge = a new episode
+        assert wd.dumps == 2
+        snap = rec.registry.snapshot()
+        assert sum(s["value"] for s in
+                   snap["oct_stalls_total"]["samples"]) == 2
+    finally:
+        WARMUP.reset()
+
+
+def test_failed_replay_leaves_no_orphan_listener(monkeypatch, tmp_path):
+    """The round-12 lifecycle satellite: an exception escaping the
+    replay mid-run must still release maybe_arm()'s ref-count and stop
+    the OCT_METRICS_PORT server thread — the port answers mid-replay
+    and is CLOSED after the failure, with the recorder uninstalled."""
+    from ouroboros_consensus_tpu.tools import db_analyser as ana
+    from ouroboros_consensus_tpu.tools import db_synthesizer as synth
+
+    port = _free_port()
+    monkeypatch.setenv("OCT_METRICS_PORT", str(port))
+    params = make_params()
+    pools_ = [fixtures.make_pool(1, kes_depth=3)]
+    lview_ = fixtures.make_ledger_view(pools_)
+    path = str(tmp_path / "db")
+    res = synth.synthesize(
+        path, params, pools_, lview_, synth.ForgeLimit(blocks=4),
+    )
+    assert res.n_blocks == 4
+    calls = []
+    orig_update = ana.praos.update
+
+    def boom(params_, hv, slot, ticked):
+        if calls:
+            raise RuntimeError("device fell over mid-replay")
+        calls.append(1)
+        return orig_update(params_, hv, slot, ticked)
+
+    monkeypatch.setattr(ana.praos, "update", boom)
+    with pytest.raises(RuntimeError, match="fell over"):
+        ana.revalidate(path, params, lview_, backend="host")
+    assert calls, "the replay must have started before failing"
+    # the plane unwound: recorder released, no orphan listener
+    assert not obs.installed()
+    with pytest.raises(Exception):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=2)

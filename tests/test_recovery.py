@@ -1,0 +1,448 @@
+"""obs/recovery.py units: the crash-consistent progress record
+(PraosState round-trip, digest fail-closed integrity, resume
+eligibility), the RecoverySupervisor's ladder semantics (event
+trajectory, unrecoverable passthrough, exhaustion), the host-reference
+floor's differential equality, and the bench ParentPolicy's
+grace-window escalation."""
+
+from __future__ import annotations
+
+import json
+import os
+from fractions import Fraction
+
+import pytest
+
+import jax  # noqa: F401 — backend pinned by conftest
+
+from ouroboros_consensus_tpu import obs
+from ouroboros_consensus_tpu.obs import recovery
+from ouroboros_consensus_tpu.protocol import batch as pbatch
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.testing import chaos, fixtures
+from ouroboros_consensus_tpu.utils import trace as T
+
+from tests.test_obs import _forge_chain, make_params
+from tests.test_packed_batch import _stub_verify
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    from ouroboros_consensus_tpu.obs.warmup import WARMUP
+
+    WARMUP.reset()
+    obs.reset_for_tests()
+    recovery.reset_for_tests()
+    monkeypatch.delenv("OCT_CHAOS", raising=False)
+    monkeypatch.delenv("OCT_CHECKPOINT", raising=False)
+    monkeypatch.delenv("OCT_RESUME", raising=False)
+    monkeypatch.delenv("OCT_RECOVERY", raising=False)
+    chaos.reset()
+    yield
+    obs.reset_for_tests()
+    recovery.reset_for_tests()
+    chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def pools():
+    return [fixtures.make_pool(90 + i, kes_depth=3) for i in range(2)]
+
+
+@pytest.fixture(scope="module")
+def lview(pools):
+    return fixtures.make_ledger_view(pools)
+
+
+@pytest.fixture
+def stubbed(monkeypatch):
+    before = set(pbatch._JIT)
+    monkeypatch.setenv("OCT_VRF_AGG", "0")
+    monkeypatch.setattr(pbatch, "verify_praos", _stub_verify)
+    monkeypatch.setattr(pbatch, "verify_praos_bc", _stub_verify)
+    monkeypatch.setattr(pbatch, "verify_praos_any", _stub_verify)
+
+    def patched_jv(bc=False):
+        key = ("fn-stub-recovery", bc)
+        if key not in pbatch._JIT:
+            pbatch._JIT[key] = jax.jit(_stub_verify)
+        return pbatch._JIT[key]
+
+    monkeypatch.setattr(pbatch, "_jitted_verify", patched_jv)
+    yield
+    for k in set(pbatch._JIT) - before:
+        del pbatch._JIT[k]
+
+
+# ---------------------------------------------------------------------------
+# PraosState <-> record round-trip + integrity
+# ---------------------------------------------------------------------------
+
+
+def _some_state() -> praos.PraosState:
+    return praos.PraosState(
+        last_slot=1234,
+        ocert_counters={b"\x01" * 28: 7, b"\x02" * 28: 0},
+        evolving_nonce=b"\xaa" * 32,
+        candidate_nonce=b"\xbb" * 32,
+        epoch_nonce=b"\xcc" * 32,
+        lab_nonce=b"\xdd" * 32,
+        last_epoch_block_nonce=None,
+    )
+
+
+def test_state_encode_decode_roundtrip():
+    st = _some_state()
+    assert recovery.decode_state(recovery.encode_state(st)) == st
+    # None nonces and an empty counter map survive too (genesis shape)
+    empty = praos.PraosState()
+    assert recovery.decode_state(recovery.encode_state(empty)) == empty
+
+
+def test_progress_writer_and_read_checkpoint(tmp_path):
+    path = str(tmp_path / "ckpt.json")
+    w = recovery.ProgressWriter(path, "tag1")
+    st = _some_state()
+    w.note(st, 100)
+    w.note(st, 28)
+    doc = recovery.read_checkpoint(path)
+    assert doc is not None
+    assert doc["headers"] == 128 and doc["windows"] == 2
+    assert not doc["complete"]
+    assert recovery.decode_state(doc["state"]) == st
+    # eligible for resume under its own tag, nobody else's
+    assert recovery.resume_record("tag1", path) is not None
+    assert recovery.resume_record("other", path) is None
+    # a COMPLETED record never seeds a resume
+    w.finalize(st)
+    done = recovery.read_checkpoint(path)
+    assert done["complete"]
+    assert recovery.resume_record("tag1", path) is None
+
+
+def test_checkpoint_fails_closed_on_tamper_and_torn(tmp_path):
+    path = str(tmp_path / "ckpt.json")
+    w = recovery.ProgressWriter(path, "tag1")
+    w.note(_some_state(), 64)
+    doc = json.load(open(path))
+    # hand-edit the position: the digest no longer covers it
+    doc["headers"] = 9999
+    json.dump(doc, open(path, "w"))
+    assert recovery.read_checkpoint(path) is None
+    # torn JSON reads as no checkpoint, never an exception
+    with open(path, "w") as f:
+        f.write('{"kind": "oct-checkpoint", "head')
+    assert recovery.read_checkpoint(path) is None
+    assert recovery.read_checkpoint(str(tmp_path / "absent.json")) is None
+
+
+def test_checkpoint_events_flow_to_recorder(tmp_path, monkeypatch):
+    monkeypatch.setenv("OCT_CHECKPOINT", str(tmp_path / "c.json"))
+    rec = obs.install()
+    try:
+        w = recovery.arm_writer("tagX")
+        pbatch.set_batch_tracer(rec)
+        w.note(_some_state(), 8)
+        w.finalize(_some_state())
+        snap = rec.registry.snapshot()
+        rows = {s["labels"]["kind"]: s["value"]
+                for s in snap["oct_checkpoint_events_total"]["samples"]}
+        assert rows == {"write": 1, "complete": 1}
+    finally:
+        pbatch.set_batch_tracer(None)
+        obs.uninstall()
+
+
+def test_chain_tag_keys_on_path_and_params():
+    params = make_params()
+    t1 = recovery.chain_tag("/db/a", params)
+    assert t1 == recovery.chain_tag("/db/a", params)
+    assert t1 != recovery.chain_tag("/db/b", params)
+    assert t1 != recovery.chain_tag("/db/a", make_params(epoch_length=60))
+
+
+def test_note_window_is_noop_without_writer():
+    recovery.disarm_writer()
+    recovery.note_window(_some_state(), 8)  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# recoverable() gate
+# ---------------------------------------------------------------------------
+
+
+def test_recoverable_classes():
+    assert recovery.recoverable(chaos.DeviceChaosError("x"))
+    assert recovery.recoverable(chaos.StagingChaosError("x"))
+    assert recovery.recoverable(OSError("io"))
+    assert recovery.recoverable(RuntimeError("pjrt says no"))
+
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert recovery.recoverable(XlaRuntimeError("fake jaxlib"))
+    # programming bugs propagate: recovery never masks a wrong program
+    assert not recovery.recoverable(TypeError("bug"))
+    assert not recovery.recoverable(AssertionError("bug"))
+
+
+# ---------------------------------------------------------------------------
+# the supervisor ladder
+# ---------------------------------------------------------------------------
+
+
+def _window(params, pools, lview, n=8):
+    st0 = praos.PraosState(epoch_nonce=b"\x07" * 32)
+    _, hvs = _forge_chain(params, pools, lview, n)
+    ticked = praos.tick(params, lview, hvs[0].slot, st0)
+    return ticked, hvs
+
+
+def _always_leader_params():
+    """f=1 params: every forged header is genuinely leader-valid, so
+    the REAL-crypto host-reference floor accepts the whole window (the
+    stubbed device paths force ok_leader; the reference fold does not)."""
+    return praos.PraosParams(
+        slots_per_kes_period=100,
+        max_kes_evolutions=62,
+        security_param=4,
+        active_slot_coeff=Fraction(1, 1),
+        epoch_length=100_000,
+        kes_depth=3,
+    )
+
+
+def test_recover_window_retry_rung_matches_direct(pools, lview, stubbed):
+    params = make_params()
+    ticked, hvs = _window(params, pools, lview)
+    direct = pbatch.validate_batch(params, ticked, hvs)
+    sup = recovery.RecoverySupervisor(backoff_s=0.0)
+    lt = T.ListTracer()
+    pbatch.set_batch_tracer(lt)
+    try:
+        res = sup.recover_window(params, ticked, hvs,
+                                 chaos.DeviceChaosError("injected"),
+                                 backend="device", window=3)
+    finally:
+        pbatch.set_batch_tracer(None)
+    assert res.n_valid == direct.n_valid == len(hvs)
+    assert res.error is None and res.state == direct.state
+    evs = [e for e in lt.events if isinstance(e, T.RecoveryEvent)]
+    assert [(e.action, e.attempt) for e in evs] == [
+        ("retry", 1), ("recovered", 1)
+    ]
+    assert evs[0].window == 3 and evs[0].fault == "DeviceChaosError"
+    assert evs[-1].ok is True
+    assert sup.episodes == 1 and sup.recovered == 1
+
+
+def test_recover_window_escalates_to_host_reference(pools, lview,
+                                                    stubbed, monkeypatch):
+    """Every device-path rung dies -> the exact host fold is the floor
+    (it cannot fail for device reasons), and the trajectory is the
+    full ladder with the terminal `recovered` event."""
+    params = _always_leader_params()
+    ticked, hvs = _window(params, pools, lview)
+    expected = recovery.host_reference_fold(params, ticked, hvs)
+
+    def boom(*a, **k):
+        raise RuntimeError("device still broken")
+
+    monkeypatch.setattr(pbatch, "validate_batch", boom)
+    sup = recovery.RecoverySupervisor(backoff_s=0.0)
+    lt = T.ListTracer()
+    pbatch.set_batch_tracer(lt)
+    try:
+        res = sup.recover_window(params, ticked, hvs,
+                                 RuntimeError("first failure"),
+                                 backend="device")
+    finally:
+        pbatch.set_batch_tracer(None)
+    assert res.error is None and res.n_valid == len(hvs)
+    assert res.state == expected.state
+    evs = [e for e in lt.events if isinstance(e, T.RecoveryEvent)]
+    assert [e.action for e in evs] == [
+        "retry", "stage-split", "xla-twin", "host-reference", "recovered",
+    ]
+    # the banked warmup rows carry the same trajectory for the ledger
+    from ouroboros_consensus_tpu.obs.warmup import WARMUP
+
+    assert [r["action"] for r in WARMUP.report()["recovery"]] == \
+        [e.action for e in evs]
+
+
+def test_recover_window_unrecoverable_and_disabled_raise(pools, lview,
+                                                         stubbed,
+                                                         monkeypatch):
+    params = make_params()
+    ticked, hvs = _window(params, pools, lview)
+    sup = recovery.RecoverySupervisor(backoff_s=0.0)
+    with pytest.raises(TypeError):  # programming bug: straight through
+        sup.recover_window(params, ticked, hvs, TypeError("bug"))
+    monkeypatch.setenv("OCT_RECOVERY", "0")
+    with pytest.raises(chaos.DeviceChaosError):  # lever: raise-through
+        sup.recover_window(params, ticked, hvs,
+                           chaos.DeviceChaosError("x"))
+    assert sup.episodes == 0
+
+
+def test_recover_window_exhausted_reraises_with_forensics(
+    pools, lview, stubbed, monkeypatch
+):
+    params = make_params()
+    ticked, hvs = _window(params, pools, lview)
+
+    def boom(*a, **k):
+        raise RuntimeError("rung died")
+
+    monkeypatch.setattr(pbatch, "validate_batch", boom)
+    monkeypatch.setattr(recovery, "host_reference_fold", boom)
+    sup = recovery.RecoverySupervisor(backoff_s=0.0)
+    lt = T.ListTracer()
+    pbatch.set_batch_tracer(lt)
+    try:
+        with pytest.raises(RuntimeError, match="rung died"):
+            sup.recover_window(params, ticked, hvs,
+                               RuntimeError("original"))
+    finally:
+        pbatch.set_batch_tracer(None)
+    evs = [e for e in lt.events if isinstance(e, T.RecoveryEvent)]
+    assert evs[-1].action == "exhausted" and evs[-1].ok is False
+    assert sup.recovered == 0
+
+
+def test_host_reference_fold_equals_sequential_reference(pools, lview):
+    """The floor rung IS the reference: real host crypto, equal to the
+    praos.update fold header by header."""
+    params = _always_leader_params()
+    ticked, hvs = _window(params, pools, lview, n=4)
+    res = recovery.host_reference_fold(params, ticked, hvs)
+    st, t = ticked.state, ticked
+    for i, hv in enumerate(hvs):
+        if i:
+            t = praos.tick(params, ticked.ledger_view, hv.slot, st)
+        st = praos.update(params, hv, hv.slot, t)
+    assert res.error is None and res.n_valid == len(hvs)
+    assert res.state == st
+
+
+def test_retry_backoff_is_jittered_and_chaos_seeded(pools, lview, stubbed,
+                                                    monkeypatch):
+    params = make_params()
+    ticked, hvs = _window(params, pools, lview)
+    monkeypatch.setenv("OCT_CHAOS", "device-error@dispatch:999")
+    monkeypatch.setenv("OCT_CHAOS_SEED", "7")
+    chaos.reset()
+    waits: list = []
+    sup = recovery.RecoverySupervisor(backoff_s=0.5,
+                                      sleep=lambda s: waits.append(s))
+    sup.recover_window(params, ticked, hvs, chaos.DeviceChaosError("x"))
+    chaos.reset()
+    waits2: list = []
+    sup2 = recovery.RecoverySupervisor(backoff_s=0.5,
+                                       sleep=lambda s: waits2.append(s))
+    sup2.recover_window(params, ticked, hvs, chaos.DeviceChaosError("x"))
+    assert waits == waits2  # seeded chaos RNG -> reproducible timing
+    assert all(0.5 <= w <= 0.75 for w in waits)  # base * [1.0, 1.5)
+
+
+# ---------------------------------------------------------------------------
+# ParentPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_parent_policy_grace_windows():
+    clk = [0.0]
+    p = recovery.ParentPolicy(stall_grace_s=60.0, dead_grace_s=30.0,
+                              clock=lambda: clk[0])
+    assert p.observe("running") == "keep"
+    assert p.observe("stalled") == "keep"  # fuse starts
+    clk[0] = 59.0
+    assert p.observe("stalled") == "keep"
+    clk[0] = 61.0
+    assert p.observe("stalled") == "kill"
+    # progress of ANY kind resets the fuse
+    p2 = recovery.ParentPolicy(stall_grace_s=60.0, clock=lambda: clk[0])
+    p2.observe("stalled")
+    clk[0] += 30
+    assert p2.observe("compiling") == "keep"
+    clk[0] += 40
+    assert p2.observe("stalled") == "keep"  # a NEW fuse, not the old one
+    # dead has its own (shorter) grace, and a state CHANGE re-arms
+    clk[0] = 0.0
+    p3 = recovery.ParentPolicy(stall_grace_s=60.0, dead_grace_s=30.0,
+                               clock=lambda: clk[0])
+    p3.observe("stalled")
+    clk[0] = 20.0
+    assert p3.observe("dead") == "keep"  # stalled->dead restarts the fuse
+    clk[0] = 49.0
+    assert p3.observe("dead") == "keep"
+    clk[0] = 51.0
+    assert p3.observe("dead") == "kill"
+
+
+# ---------------------------------------------------------------------------
+# satellite: perf_report chaos-seeded fixture (recovered@<fault>)
+# ---------------------------------------------------------------------------
+
+
+def test_perf_report_recovered_round_classification(tmp_path):
+    import importlib.util
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "perf_report", os.path.join(REPO, "scripts", "perf_report.py")
+    )
+    perf_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(perf_report)
+
+    # the warmup rows a chaos-seeded recovered round banks
+    # (OCT_CHAOS=device-error@dispatch:2 walked one window down the
+    # ladder, the round still banked its device number)
+    recovery_rows = [
+        {"action": "retry", "window": 2, "attempt": 1,
+         "fault": "DeviceChaosError", "t": 10.0},
+        {"action": "recovered", "window": 2, "attempt": 1,
+         "fault": "DeviceChaosError", "ok": True, "t": 10.5},
+    ]
+    p = os.path.join(tmp_path, "BENCH_r06.json")
+    with open(p, "w") as f:
+        json.dump({"rc": 0, "tail": "", "parsed": {
+            "value": 4000.0, "vs_baseline": 2.0,
+            "resumed_headers": 81920,
+            "metric": "end-to-end db-analyser revalidation of a "
+                      "1000000-header synthetic Praos chain",
+            "warmup_report": {"recovery": recovery_rows, "stages": {},
+                              "ladder": [], "aot": {}, "refusals": []},
+        }}, f)
+    row = perf_report.analyze_bench_round(p)
+    assert row["device_banked"] and row["failures"] == []
+    assert row["recovered_fault"] == "DeviceChaosError"
+    assert row["recovery_actions"] == {"retry": 1, "recovered": 1}
+    assert row["resumed_headers"] == 81920
+    md = perf_report.render_markdown(
+        {"bench_rounds": [row], "multichip_rounds": [], "ledger": None,
+         "verdicts": [], "ok": True})
+    assert "recovered@DeviceChaosError" in md
+    assert "## Recovered rounds" in md
+    assert "retry=1" in md and "resumed past 81920" in md
+
+    # a DEAD round with recovery evidence keeps its failure modes but
+    # the attribution notes the ladder engaged (stalled@ wins priority)
+    p2 = os.path.join(tmp_path, "BENCH_r07.json")
+    with open(p2, "w") as f:
+        json.dump({"rc": 124, "tail": "", "parsed": {
+            "value": 2100.0, "device_unavailable": True,
+            "no_device_reason": "device-run-failed-or-wall",
+            "stall_dump": {"phase": "dispatch", "age_s": 600.0,
+                           "budget_s": 240.0, "threads": {}},
+            "warmup_report": {"recovery": recovery_rows[:1],
+                              "stages": {}, "ladder": [], "aot": {},
+                              "refusals": []},
+        }}, f)
+    row2 = perf_report.analyze_bench_round(p2)
+    assert [f["mode"] for f in row2["failures"]][0] == "stalled@dispatch"
+    md2 = perf_report.render_markdown(
+        {"bench_rounds": [row2], "multichip_rounds": [], "ledger": None,
+         "verdicts": [], "ok": False})
+    assert "recovery ladder HAD engaged" in md2
