@@ -442,13 +442,22 @@ def attribution(r):
     # in ad-hoc profiling
     if not r.n_windows:
         return None
-    return {
+    out = {
         "phases_s": {k: round(v, 2) for k, v in sorted(r.phases.items())},
         "windows": r.n_windows,
         "packed_windows": r.packed_windows,
         "h2d_bytes_per_window": int(r.h2d_bytes / r.n_windows),
         "d2h_bytes_per_window": int(r.d2h_bytes / r.n_windows),
     }
+    # the store crash protocol (storage/guard.py): a replay that found
+    # the store dirty (killed previous writer) deep-validated and
+    # repaired it — bank the fact so perf_report can classify the
+    # round repaired@<action> (detailed rows ride the warmup report)
+    if r.opened_dirty:
+        out["opened_dirty"] = True
+    if r.repairs:
+        out["repairs"] = dict(r.repairs)
+    return out
 
 # Warm up compiles/cache-loads on the SMALL cached chain when the
 # target is the 1M north star: a full-scale warmup replay would eat the

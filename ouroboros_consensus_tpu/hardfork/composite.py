@@ -886,7 +886,10 @@ def revalidate(path: str, cfg: CardanoMockConfig, backend: str = "device") -> Mi
     each era segment with its protocol — Praos-class eras through the
     batched backend."""
     cm = CardanoMock(cfg)
-    imm = ImmutableDB(os.path.join(path, "immutable"))
+    # repair=False: this analysis holds no DB lock (direct embedder —
+    # COVERAGE.md §5.17 honest gap), so it must never mutate the store;
+    # a lagging index is reparsed in memory only
+    imm = ImmutableDB(os.path.join(path, "immutable"), repair=False)
     res = MixedResult(per_era={})
 
     blocks = [decode_block(raw, cm.decoders) for _e, raw in imm.stream_all()]

@@ -1,0 +1,94 @@
+"""Exception triage: exit reasons + the repair-vs-refuse-vs-recover map.
+
+Reference: `Node/Exit.hs:63` (`ExitReason` / `toExitReason` — process
+exit codes per exception class) and `Node/RethrowPolicy.hs`
+(`consensusRethrowPolicy` — the per-exception shutdown-vs-disconnect
+policy). The TPU build's analog classifies every failure the durable
+store and the replay pipeline can raise into a DISPOSITION that the
+recovery machinery consults:
+
+    REFUSE     loud, classified, immediate: another process holds the
+               DB lock, the DB belongs to a different chain (marker
+               mismatch). Retrying or degrading would be WRONG — the
+               operator asked for something the store must not do.
+    REPAIR     the durable store is corrupt in a way the open-with-
+               repair scan owns (truncate-and-quarantine, index
+               rebuild): bubbles to the store layer, never absorbed by
+               the per-window recovery ladder.
+    RECOVER    transient device/runtime/I-O faults (and the chaos
+               taxonomy, transient by contract): the
+               RecoverySupervisor's degradation ladder may absorb it.
+    PROPAGATE  a programming bug (TypeError class): recovery must
+               never mask a wrong program as a flaky device.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+
+class ExitReason(Enum):
+    """Node/Exit.hs:63 ExitReason — process exit triage."""
+
+    SUCCESS = 0
+    GENERIC = 1
+    CONFIG_ERROR = 2
+    DB_CORRUPTION = 3
+    NETWORK_ERROR = 4
+
+
+class Disposition(Enum):
+    """What the failure-handling machinery may DO about an exception
+    (the consensusRethrowPolicy analog for the batched pipeline)."""
+
+    REFUSE = "refuse"
+    REPAIR = "repair"
+    RECOVER = "recover"
+    PROPAGATE = "propagate"
+
+
+def to_exit_reason(exc: BaseException) -> ExitReason:
+    """toExitReason (Node/Exit.hs:100)."""
+    from ..storage.guard import DbLocked, DbMarkerMismatch
+    from ..storage.immutable import ImmutableDBError
+    from ..storage.repair import QuarantineError
+
+    if isinstance(exc, (DbLocked, DbMarkerMismatch, QuarantineError)):
+        return ExitReason.CONFIG_ERROR
+    if isinstance(exc, ImmutableDBError):
+        return ExitReason.DB_CORRUPTION
+    if isinstance(exc, (ConnectionError, OSError)):
+        return ExitReason.NETWORK_ERROR
+    return ExitReason.GENERIC
+
+
+def triage(exc: BaseException) -> Disposition:
+    """The per-class repair-vs-refuse-vs-recover policy. The recovery
+    supervisor (obs/recovery.recoverable) absorbs ONLY `RECOVER`;
+    `REFUSE` and `REPAIR` classes propagate to the layer that owns
+    them (the caller / the open-with-repair scan), and `PROPAGATE`
+    bugs always surface raw."""
+    from ..storage.guard import DbLocked, DbMarkerMismatch
+    from ..storage.immutable import ImmutableDBError
+    from ..storage.repair import QuarantineError
+    from ..testing import chaos
+
+    if isinstance(exc, (DbLocked, DbMarkerMismatch, QuarantineError)):
+        # QuarantineError: the environment cannot honor quarantine-
+        # never-delete (ENOSPC, unwritable dir) — repairing anyway
+        # would destroy the bytes the repair promised to keep
+        return Disposition.REFUSE
+    if isinstance(exc, ImmutableDBError):
+        # on-disk corruption: truncate-and-repair territory — the
+        # window ladder re-dispatching the same corrupt bytes would
+        # loop, and masking it would be silence
+        return Disposition.REPAIR
+    if isinstance(exc, chaos.ChaosError):
+        return Disposition.RECOVER  # transient by construction
+    if isinstance(exc, (OSError, MemoryError)):
+        return Disposition.RECOVER
+    # jaxlib's XlaRuntimeError (module path varies across jax versions)
+    # and the RuntimeError family PJRT surfaces through
+    if isinstance(exc, RuntimeError) or "XlaRuntimeError" in type(exc).__name__:
+        return Disposition.RECOVER
+    return Disposition.PROPAGATE

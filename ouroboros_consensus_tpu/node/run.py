@@ -11,147 +11,52 @@ modules `Node/{DbLock,DbMarker,Recovery,Exit}.hs`:
     the previous run crashed ⇒ open with full validation
     (Recovery.hs:24-59).
   * exit triage — map exceptions to exit reasons (Exit.hs:63).
+
+The lock/marker/clean-shutdown primitives live in `storage/guard.py`
+(re-exported here) so the tools plane — `db_analyser.revalidate`,
+`db_synthesizer`, the bench children — speaks the SAME crash protocol
+as node startup; the exit triage (and the repair-vs-refuse-vs-recover
+disposition map the RecoverySupervisor consults) lives in
+`node/exit.py`.
 """
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
-from enum import Enum
 from typing import Callable
 
 from ..ledger.extended import ExtLedger, ExtLedgerState
+from ..storage.guard import (  # noqa: F401 — the node-facing re-exports
+    CLEAN_SHUTDOWN, DB_LOCK, DB_MARKER, DEFAULT_MAGIC, DbLocked,
+    DbLockFile, DbMarkerMismatch, StoreGuard, check_db_marker,
+    was_clean_shutdown, write_clean_marker,
+)
 from ..storage.open import open_chaindb
-from ..utils.fs import REAL_FS
+from .exit import ExitReason, to_exit_reason  # noqa: F401 — re-export
 from .kernel import NodeKernel, SlotClock
-
-DB_LOCK = "lock"
-DB_MARKER = "protocolMagicId"
-CLEAN_SHUTDOWN = "clean"  # reference: absence of the marker = crashed
-
-
-class DbLocked(Exception):
-    """Another process holds the DB (DbLock.hs DbLocked)."""
-
-
-class DbMarkerMismatch(Exception):
-    """DB belongs to a different network (DbMarker.hs)."""
-
-
-class ExitReason(Enum):
-    """Node/Exit.hs:63 ExitReason — process exit triage."""
-
-    SUCCESS = 0
-    GENERIC = 1
-    CONFIG_ERROR = 2
-    DB_CORRUPTION = 3
-    NETWORK_ERROR = 4
-
-
-def to_exit_reason(exc: BaseException) -> ExitReason:
-    """toExitReason (Node/Exit.hs:100)."""
-    from ..storage.immutable import ImmutableDBError
-
-    if isinstance(exc, (DbLocked, DbMarkerMismatch)):
-        return ExitReason.CONFIG_ERROR
-    if isinstance(exc, ImmutableDBError):
-        return ExitReason.DB_CORRUPTION
-    if isinstance(exc, (ConnectionError, OSError)):
-        return ExitReason.NETWORK_ERROR
-    return ExitReason.GENERIC
-
-
-class DbLockFile:
-    """Single-process guard (DbLock.hs, 2s timeout): flock on the real
-    filesystem; on a mock FS, the MockFS advisory-lock registry — which
-    MockFS.crash clears, mirroring flock's release-on-process-death."""
-
-    def __init__(self, db_path: str, fs=None):
-        self.path = os.path.join(db_path, DB_LOCK)
-        self.fs = fs  # None = real FS (flock)
-        self._fd: int | None = None
-        self._held = False
-
-    def acquire(self) -> None:
-        if self.fs is not None:
-            if self.path in self.fs.advisory_locks:
-                raise DbLocked(self.path)
-            self.fs.advisory_locks.add(self.path)
-            self._held = True
-            return
-        import fcntl
-
-        os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        fd = os.open(self.path, os.O_CREAT | os.O_RDWR)
-        try:
-            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-        except OSError as e:
-            os.close(fd)
-            raise DbLocked(self.path) from e
-        self._fd = fd
-        self._held = True
-
-    def release(self) -> None:
-        if not self._held:
-            return  # never release a lock another instance holds
-        self._held = False
-        if self.fs is not None:
-            self.fs.advisory_locks.discard(self.path)
-            return
-        if self._fd is not None:
-            import fcntl
-
-            fcntl.flock(self._fd, fcntl.LOCK_UN)
-            os.close(self._fd)
-            self._fd = None
-
-    def __enter__(self):
-        self.acquire()
-        return self
-
-    def __exit__(self, *exc):
-        self.release()
-        return False
-
-
-def check_db_marker(db_path: str, network_magic: int, fs=None) -> None:
-    """checkDbMarker (DbMarker.hs): create on first open, verify after."""
-    fs = fs if fs is not None else REAL_FS
-    p = os.path.join(db_path, DB_MARKER)
-    if fs.exists(p):
-        found = int(fs.read_bytes(p).decode().strip())
-        if found != network_magic:
-            raise DbMarkerMismatch(f"DB is for magic {found}, node runs {network_magic}")
-    else:
-        fs.makedirs(db_path)
-        # durable: the marker must survive a crash (write_atomic fsyncs)
-        fs.write_atomic(p, str(network_magic).encode())
-
-
-def was_clean_shutdown(db_path: str, fs=None) -> bool:
-    """Recovery.hs:24: the clean marker is REMOVED while running and
-    written back on orderly shutdown; missing at start (after a first
-    run) ⇒ crash ⇒ revalidate everything."""
-    fs = fs if fs is not None else REAL_FS
-    return fs.exists(os.path.join(db_path, CLEAN_SHUTDOWN))
 
 
 @dataclass
 class RunningNode:
     kernel: NodeKernel
     db_path: str
-    lock: DbLockFile
+    guard: StoreGuard
     crashed_last_run: bool
     fs: object = None
 
+    @property
+    def lock(self) -> DbLockFile:
+        return self.guard.lock
+
     def shutdown(self) -> None:
-        """Orderly stop: final snapshot, clean marker, release lock."""
-        fs = self.fs if self.fs is not None else REAL_FS
+        """Orderly stop: final snapshot, then the guard's close
+        protocol — clean marker (through the chaos ``marker`` seam; a
+        partial-rename fault leaves the store dirty, exactly the crash
+        shape), lock released even if the marker write dies, a second
+        shutdown a no-op. ONE implementation (StoreGuard.close) shared
+        with the tools plane."""
         self.kernel.chain_db.close()
-        fs.write_atomic(
-            os.path.join(self.db_path, CLEAN_SHUTDOWN), b"clean\n"
-        )
-        self.lock.release()
+        self.guard.close(clean=True)
 
 
 def start_node(
@@ -161,7 +66,7 @@ def start_node(
     genesis: ExtLedgerState,
     k: int,
     *,
-    network_magic: int = 764824073,
+    network_magic: int = DEFAULT_MAGIC,
     pool=None,
     clock: SlotClock | None = None,
     chunk_size: int = 21600,
@@ -174,16 +79,14 @@ def start_node(
     The caller wires mini-protocol tasks and the forging loop into a
     sim/asyncio runtime (testing/threadnet.py is the reference user).
     """
-    vfs = fs if fs is not None else REAL_FS
-    lock = DbLockFile(db_path, fs=fs)
-    lock.acquire()
+    # the bundled protocol (storage/guard.py): lock → marker → dirty
+    # check → clear clean marker (writer mode) — ONE implementation
+    # shared with the tools plane, so a protocol fix lands everywhere
+    guard = StoreGuard(db_path, network_magic=network_magic, fs=fs,
+                       writer=True)
+    guard.open()
     try:
-        check_db_marker(db_path, network_magic, fs=fs)
-        first_run = not vfs.exists(os.path.join(db_path, "immutable"))
-        crashed = not first_run and not was_clean_shutdown(db_path, fs=fs)
-        clean_marker = os.path.join(db_path, CLEAN_SHUTDOWN)
-        if vfs.exists(clean_marker):
-            vfs.remove(clean_marker)  # running now: a crash leaves no marker
+        crashed = guard.opened_dirty
         if crashed:
             trace(f"{name}: unclean shutdown detected -> full revalidation")
         db = open_chaindb(
@@ -196,7 +99,7 @@ def start_node(
         kernel = NodeKernel(
             name, db, ext.protocol, ext.ledger, pool=pool, clock=clock, trace=trace
         )
-        return RunningNode(kernel, db_path, lock, crashed, fs=fs)
+        return RunningNode(kernel, db_path, guard, crashed, fs=fs)
     except BaseException:
-        lock.release()
+        guard.close(clean=False)  # crash shape: store stays dirty
         raise

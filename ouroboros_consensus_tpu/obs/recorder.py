@@ -16,6 +16,8 @@ event into the metrics registry:
     oct_recovery_total{action=}            recovery-ladder transitions
     oct_checkpoint_events_total{kind=}     progress-record movement
                                            (obs/recovery)
+    oct_repair_total{action=}              on-disk store repairs applied
+                                           (storage/repair)
     oct_shard_{windows,lanes,ok_lanes,pad_lanes}_total{shard=}
                                            per-shard SPMD telemetry
 
@@ -29,8 +31,8 @@ import time
 
 from ..utils.trace import (
     AggRedispatch, CheckpointEvent, EncloseEvent, LadderEvent,
-    RecoveryEvent, ShardSpan, StallEvent, TransferEvent, WindowSpan,
-    WindowStaged,
+    RecoveryEvent, RepairEvent, ShardSpan, StallEvent, TransferEvent,
+    WindowSpan, WindowStaged,
 )
 from . import registry as _registry
 
@@ -89,6 +91,15 @@ class FlightRecorder:
         self._checkpoints = r.counter(
             "oct_checkpoint_events_total",
             "progress-record writes/resumes/completions", ("kind",),
+        )
+        # durable-store repair plane (storage/repair.py): on-disk
+        # repairs the open-with-repair scan applied (truncated tails,
+        # rebuilt indices, dropped chunks, dirty-open escalations) —
+        # dry-run/would-repair events are NOT counted here, they only
+        # ride the warmup report's `repairs` rows
+        self._repairs = r.counter(
+            "oct_repair_total",
+            "on-disk store repair actions applied", ("action",),
         )
         # per-shard SPMD telemetry (parallel/spmd.py ShardSpan events):
         # label cardinality is the mesh size — bounded by hardware
@@ -162,6 +173,9 @@ class FlightRecorder:
             self._recovery.labels(action=ev.action).inc()
         elif isinstance(ev, CheckpointEvent):
             self._checkpoints.labels(kind=ev.kind).inc()
+        elif isinstance(ev, RepairEvent):
+            if ev.applied:
+                self._repairs.labels(action=ev.action).inc()
         elif isinstance(ev, ShardSpan):
             s = str(ev.shard)
             self._shard_windows.labels(shard=s).inc()

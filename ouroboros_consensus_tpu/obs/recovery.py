@@ -332,20 +332,17 @@ def resume_record(chain_tag_: str, path: str | None = None) -> dict | None:
 
 
 def recoverable(exc: BaseException) -> bool:
-    """Failure classes the ladder may absorb. Deliberately narrow:
-    device/runtime errors, I/O and the chaos taxonomy recover; a
-    TypeError (programming bug) propagates — recovery must never mask
-    a wrong program as a flaky device."""
-    from ..testing import chaos
+    """Failure classes the ladder may absorb. Deliberately narrow —
+    the per-class policy lives in `node/exit.triage` (the
+    consensusRethrowPolicy analog): only `RECOVER`-class faults
+    (device/runtime errors, I/O, the chaos taxonomy) ride the ladder.
+    `REFUSE` (DB locked, wrong chain magic), `REPAIR` (on-disk
+    corruption — the open-with-repair scan owns it) and `PROPAGATE`
+    (TypeError-class programming bugs) all surface raw: recovery must
+    never mask a wrong program OR launder a refusal."""
+    from ..node import exit as node_exit
 
-    if isinstance(exc, chaos.ChaosError):
-        return True
-    if isinstance(exc, (OSError, MemoryError)):
-        return True
-    name = type(exc).__name__
-    # jaxlib's XlaRuntimeError (module path varies across jax versions)
-    # and the RuntimeError family PJRT surfaces through
-    return isinstance(exc, RuntimeError) or "XlaRuntimeError" in name
+    return node_exit.triage(exc) is node_exit.Disposition.RECOVER
 
 
 def note_recovery_event(action: str, window: int, lanes: int,

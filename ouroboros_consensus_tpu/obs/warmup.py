@@ -61,6 +61,12 @@ class WarmupRecorder:
         # forensics so the round JSON and ledger carry the recovery
         # story (perf_report classifies recovered rounds from this)
         self.recovery: list[dict] = []
+        # durable-store repair plane (storage/repair.py): every
+        # on-disk repair (or dry-run would-repair) the open-with-repair
+        # scan took — truncated chunk tails, rebuilt indices, dropped
+        # chunks, dirty-open escalations — banked with the forensics so
+        # perf_report can classify a round `repaired@<action>`
+        self.repairs: list[dict] = []
 
     # -- recording ----------------------------------------------------------
 
@@ -165,6 +171,26 @@ class WarmupRecorder:
             self.recovery.append(row)
         self._flush()
 
+    def note_repair(self, action: str, chunk: int = -1, kept: int = 0,
+                    dropped: int = 0, bytes_quarantined: int = 0,
+                    applied: bool = True, detail: str = "") -> None:
+        """One durable-store repair action (storage/repair.py): action
+        is truncate-chunk | rebuild-index | drop-chunk |
+        sweep-orphan-index | dirty-open-escalated; `applied=False`
+        marks a dry-run scan that only computed the action."""
+        with self._lock:
+            self.repairs.append({
+                "action": action,
+                "chunk": chunk,
+                "kept": kept,
+                "dropped": dropped,
+                "bytes_quarantined": bytes_quarantined,
+                "applied": applied,
+                "detail": detail[:200],
+                "t": round(time.monotonic() - self.t0, 3),
+            })
+        self._flush()
+
     def note(self, msg: str) -> None:
         """Free-form forensic breadcrumb (e.g. 'warmup replay started')."""
         with self._lock:
@@ -192,6 +218,7 @@ class WarmupRecorder:
                 "ladder": [dict(r) for r in self.ladder],
                 "cache_probe": self.cache_probe,
                 "recovery": [dict(r) for r in self.recovery],
+                "repairs": [dict(r) for r in self.repairs],
                 "notes": list(self.notes),
             }
 
@@ -222,6 +249,7 @@ class WarmupRecorder:
             self.ladder.clear()
             self.cache_probe = None
             self.recovery.clear()
+            self.repairs.clear()
             self.notes.clear()
 
 

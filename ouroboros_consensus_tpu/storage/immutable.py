@@ -13,7 +13,14 @@ on-disk shape with one combined index file per chunk:
 Startup validation (Impl/Validation.hs:67) reparses the last chunk (or all
 chunks under `validate_all`), checks CRCs and hashes, optionally runs the
 `check_integrity` hook (body hash + KES — batched on device by the
-caller), and TRUNCATES the corrupted tail rather than failing.
+caller), and TRUNCATES the corrupted tail rather than failing. Every
+on-disk repair the validation takes — truncated tails, rebuilt indices,
+dropped chunks, swept orphan indices — QUARANTINES the snipped bytes
+under ``quarantine/`` (never deletes) and is banked as a first-class
+repair action (storage/repair.py: warmup forensics +
+``oct_repair_total{action=}``). ``repair=False`` opens read-only: the
+same scan computes every action in memory (``applied=False`` rows, the
+db-truncater ``--dry-run`` report) and the disk is never touched.
 
 Iterators stream blocks in slot order across chunk boundaries
 (Impl/Iterator.hs). Appends go through an in-memory tail buffer flushed
@@ -28,8 +35,10 @@ from dataclasses import dataclass
 from typing import Callable, Iterator
 
 from ..block.abstract import Point
+from ..testing import chaos
 from ..utils import cbor
 from ..utils.fs import REAL_FS
+from . import repair as repair_mod
 
 
 class ImmutableDBError(Exception):
@@ -83,23 +92,53 @@ class ImmutableDB:
         stream_deep: bool = False,  # validate-all checks owed at READ
         # time: streaming consumers run deep_check_loaded per chunk as
         # they read (single-pass validation; db-analyser "stream" mode)
+        repair: bool = True,  # may validation MUTATE the disk? False =
+        # read-only scan: truncations computed in memory only, every
+        # would-be action recorded with applied=False (--dry-run)
+        quarantine_dir: str | None = None,  # where snipped bytes go
+        # (default <path>/quarantine); never deleted, always moved
+        stream_repair: bool = False,  # stream-mode consumers may call
+        # repair_to() to write back the truncation their deep read
+        # computed (db_analyser.revalidate --repair)
     ):
         self.path = path
         self.chunk_size = chunk_size
         self.stream_deep = stream_deep
+        self.stream_repair = stream_repair
         self._decode_block = decode_block
         self._check_integrity_batch = check_integrity_batch
         self.fs = fs if fs is not None else REAL_FS
-        self.fs.makedirs(path)
+        if repair:
+            # only a store that may WRITE creates its directory; a
+            # read-only scan (--dry-run, stream analysis) of a virgin
+            # or typo'd path must leave no side effect — a dir created
+            # here would make the NEXT open see a marker-less non-first
+            # run and misclassify the untouched store as dirty
+            self.fs.makedirs(path)
+        self._repair = repair
+        self._quarantine = repair_mod.Quarantine(
+            path, self.fs, quarantine_dir
+        )
+        self.repairs: list[dict] = []  # repair rows of THIS open
         self._entries: dict[int, list[IndexEntry]] = {}  # chunk -> entries
         self._chunks: list[int] = []
         self._truncated: dict[int, bool] = {}
         self._validate(check_integrity, validate_all)
 
+    def prepare_write(self) -> None:
+        """A read-only probe being adopted as the writer store (the
+        synthesizer's fresh-forge path, after its refusal checks
+        passed): create the directory the read-only open deliberately
+        left uncreated, and allow mutations from here on."""
+        self.fs.makedirs(self.path)
+        self._repair = True
+
     # -- startup validation --------------------------------------------------
 
     def _chunk_numbers(self) -> list[int]:
         ns = []
+        if not self.fs.isdir(self.path):  # read-only open, virgin path
+            return ns
         for f in self.fs.listdir(self.path):
             if f.endswith(".chunk"):
                 ns.append(int(f.split(".")[0]))
@@ -114,7 +153,11 @@ class ImmutableDB:
             entries = self._load_chunk(n, deep, check_integrity)
             if entries is None:  # wholly corrupt chunk: drop it and the rest
                 for m in chunks[i:]:
-                    self._remove_chunk(m)
+                    self._repair_drop_chunk(
+                        m,
+                        detail=("wholly corrupt chunk" if m == n
+                                else "stranded past a dropped chunk"),
+                    )
                 break
             self._entries[n] = entries
             self._chunks.append(n)
@@ -123,16 +166,117 @@ class ImmutableDB:
                 # a stale/missing index): later chunks would leave a gap
                 # in the chain — drop them (truncate-corrupted-tail)
                 for m in chunks[i + 1 :]:
-                    self._remove_chunk(m)
+                    self._repair_drop_chunk(
+                        m, detail="stranded past a truncated chunk"
+                    )
                 break
         # sweep ORPHANED index files: an index written atomically (hence
         # durable) whose chunk file's creation was never synced survives a
         # crash alone; a later append to that chunk would extend the stale
         # index and duplicate entries (ImmutableModel finding)
         live = set(self._chunks)
-        for f in self.fs.listdir(self.path):
+        names = self.fs.listdir(self.path) if self.fs.isdir(self.path) else ()
+        for f in names:
             if f.endswith(".index") and int(f.split(".")[0]) not in live:
-                self.fs.remove(os.path.join(self.path, f))
+                q = 0
+                if self._repair:
+                    q = self._quarantine_file(f)  # moved, not copied
+                self._note_repair(
+                    "sweep-orphan-index", int(f.split(".")[0]), qbytes=q,
+                    detail="index file without a chunk",
+                )
+
+    # -- the repair plane ----------------------------------------------------
+
+    def _quarantine_file(self, name: str) -> int:
+        """MOVE a live file into quarantine — atomic rename, no bytes
+        through memory (a production chunk is hundreds of MB). A move
+        that cannot happen refuses (`QuarantineError`) BEFORE anything
+        is destroyed: a drop that cannot bank its bytes must not run."""
+        return self._quarantine.store_file(
+            name, os.path.join(self.path, name)
+        )
+
+    def _note_repair(self, action: str, chunk: int, kept: int = 0,
+                     dropped: int = 0, qbytes: int = 0,
+                     detail: str = "") -> None:
+        """Bank one validation repair (storage/repair.note_repair:
+        warmup forensics + RepairEvent → oct_repair_total) and keep the
+        row on this open's `repairs` report. applied reflects whether
+        the disk actually changed (read-only scans compute only)."""
+        self.repairs.append(repair_mod.note_repair(
+            action, chunk=chunk, kept=kept, dropped=dropped,
+            bytes_quarantined=qbytes, applied=self._repair, detail=detail,
+        ))
+
+    def _repair_truncate(self, n: int, data: bytes,
+                         entries: list[IndexEntry], dropped: int = 0,
+                         detail: str = "") -> None:
+        """Cut chunk n's corrupted on-disk tail to `entries`:
+        quarantine the snipped bytes, rewrite chunk + index — or,
+        read-only, record the would-be action."""
+        end = entries[-1].offset + entries[-1].size if entries else 0
+        snip = max(0, len(data) - end)
+        q = snip
+        if self._repair:
+            q = self._quarantine.store(_chunk_name(n) + ".tail", data[end:])
+            self._rewrite_chunk(n, data, entries)
+        self._note_repair("truncate-chunk", n, kept=len(entries),
+                          dropped=dropped, qbytes=q, detail=detail)
+
+    def _repair_drop_chunk(self, n: int, detail: str = "") -> None:
+        """Remove chunk n's files (quarantining both) — a wholly
+        corrupt chunk, or one stranded past a truncation gap."""
+        dropped = len(self._entries.get(n, ()))
+        if n not in self._entries:
+            # dropped before its entries were ever loaded (_validate
+            # breaks at the first bad chunk): best-effort count from
+            # the on-disk index so the repair row reports the real
+            # data loss instead of 0 (unreadable index -> 0, honest)
+            idx = self._load_index(
+                os.path.join(self.path, _index_name(n))
+            )
+            dropped = len(idx) if idx else 0
+        q = 0
+        if self._repair:
+            for name in (_chunk_name(n), _index_name(n)):
+                if self.fs.exists(os.path.join(self.path, name)):
+                    q += self._quarantine_file(name)  # moved, not copied
+        self._note_repair("drop-chunk", n, kept=0, dropped=dropped,
+                          qbytes=q, detail=detail)
+
+    def repair_to(self, n: int, good: int,
+                  detail: str = "stream deep-validation write-back",
+                  data: bytes | None = None) -> None:
+        """Stream-mode write-back (db_analyser --repair): truncate
+        chunk `n` on disk at entry count `good` — the truncation point
+        the deep READ computed — and drop every chunk past it, exactly
+        the repair the deep open would have taken. Quarantine + events
+        like any other repair; in-memory state mirrors the disk so
+        subsequent queries see the repaired store. Pass `data` when the
+        chunk bytes are already in hand (the stream reader just loaded
+        them) — re-reading a production chunk is hundreds of MB of I/O
+        on the exact path where the disk is already suspect."""
+        entries = self._entries.get(n, [])
+        if data is None:
+            try:
+                data = self.fs.read_bytes(
+                    os.path.join(self.path, _chunk_name(n))
+                )
+            except OSError:
+                data = b""
+        kept = entries[:good]
+        self._truncated[n] = True
+        self._repair_truncate(n, data, kept,
+                              dropped=len(entries) - len(kept),
+                              detail=detail)
+        self._entries[n] = kept
+        for m in [m for m in self._chunks if m > n]:
+            self._repair_drop_chunk(
+                m, detail="stranded past stream truncation"
+            )
+            self._entries.pop(m, None)
+            self._chunks.remove(m)
 
     def _load_chunk(self, n: int, deep: bool, check_integrity):
         ipath = os.path.join(self.path, _index_name(n))
@@ -141,7 +285,9 @@ class ImmutableDB:
         if entries is None:
             # index missing/corrupt (e.g. crash before flush): rebuild it
             # from the chunk data — blocks are self-delimiting CBOR
-            entries = self._reparse_chunk(n, check_integrity)
+            entries = self._reparse_chunk(
+                n, check_integrity, why="index missing or corrupt"
+            )
             return entries
         # deferred index writes mean the on-disk index can LAG the chunk
         # data after a crash: reparse any bytes past the indexed end
@@ -151,7 +297,10 @@ class ImmutableDB:
         except OSError:
             return None
         if fsize > end:
-            entries = self._reparse_chunk(n, check_integrity)
+            entries = self._reparse_chunk(
+                n, check_integrity,
+                why=f"index lags chunk data ({fsize} > {end})",
+            )
             return entries
         if deep:
             # reparse against the index, truncating at the first corruption
@@ -159,6 +308,7 @@ class ImmutableDB:
                 data = self.fs.read_bytes(cpath)
             except OSError:
                 return None
+            n_indexed = len(entries)
             first_bad = self._deep_check_fast(data, entries, check_integrity)
             if first_bad is not None:
                 if first_bad < len(entries):
@@ -179,7 +329,11 @@ class ImmutableDB:
                     good.append(e)
                 entries = good
             if self._truncated.get(n):
-                self._rewrite_chunk(n, data, entries)
+                self._repair_truncate(
+                    n, data, entries, dropped=n_indexed - len(entries),
+                    detail="deep validation (CRC + integrity) found a "
+                           "corrupt tail",
+                )
         return entries
 
     def deep_check_loaded(
@@ -243,7 +397,7 @@ class ImmutableDB:
             return None  # hook unavailable -> slow loop
         return min(good, fb)
 
-    def _reparse_chunk(self, n: int, check_integrity):
+    def _reparse_chunk(self, n: int, check_integrity, why: str = ""):
         """Walk self-delimiting CBOR blocks in the chunk file, rebuilding
         index entries; truncate at the first unparseable/bad block.
 
@@ -267,7 +421,7 @@ class ImmutableDB:
         if check_integrity is None and self._decode_block is None:
             fast = self._reparse_chunk_native(n, data)
             if fast is not None:
-                return fast
+                return self._finish_reparse(n, data, fast, why)
 
         entries: list[IndexEntry] = []
         off = 0
@@ -288,9 +442,22 @@ class ImmutableDB:
                 )
             )
             off = end
+        return self._finish_reparse(n, data, entries, why)
+
+    def _finish_reparse(self, n: int, data: bytes,
+                        entries: list[IndexEntry], why: str):
+        """Bank the rebuild and write it back (repair permitting): the
+        index is reconstructed from chunk bytes; a torn chunk tail
+        found on the way is truncated + quarantined too."""
+        self._note_repair("rebuild-index", n, kept=len(entries),
+                          detail=why)
         if self._truncated.get(n):
-            self._rewrite_chunk(n, data, entries)
-        else:
+            self._repair_truncate(
+                n, data, entries,
+                detail=f"unparseable/bad chunk tail ({why})" if why
+                       else "unparseable/bad chunk tail",
+            )
+        elif self._repair:
             self._write_index(n, entries)
         return entries
 
@@ -329,10 +496,7 @@ class ImmutableDB:
                 )
             )
         if end < len(data):
-            self._truncated[n] = True
-            self._rewrite_chunk(n, data, entries)
-        else:
-            self._write_index(n, entries)
+            self._truncated[n] = True  # _finish_reparse writes back
         return entries
 
     def _rewrite_chunk(self, n: int, data: bytes, entries: list[IndexEntry]):
@@ -448,12 +612,49 @@ class ImmutableDB:
             self._chunks.sort()
         cpath = os.path.join(self.path, _chunk_name(n))
         offset = self.fs.getsize(cpath) if self.fs.exists(cpath) else 0
-        self.fs.append(cpath, raw)
+        # the write-path chaos seam (testing/chaos.write_fault): the
+        # torn-write/bit-rot fault matrix detonates HERE, where the
+        # bytes meet the disk — one bool check disarmed
+        fault = chaos.write_fault(chunk=n)
+        if fault == "torn-write":
+            # crash mid-append: a PREFIX of the block lands in the
+            # chunk, no index entry, and the writer dies — startup
+            # reparse finds the unparseable tail and truncates it
+            self.fs.append(cpath, raw[: max(1, len(raw) // 2)])
+            raise chaos.TornWriteChaos(
+                f"chaos: append torn at chunk {n} slot {slot}"
+            )
+        data = raw
+        if fault == "bitflip":
+            # silent bit rot: the write "succeeds" with one byte flipped
+            # on disk; the index entry records the TRUE crc, so only a
+            # deep (all-chunks / stream) walk can catch it later
+            buf = bytearray(raw)
+            buf[len(buf) // 2] ^= 0x01
+            data = bytes(buf)
+        self.fs.append(cpath, data)
+        if fault == "sigkill":
+            import signal
+
+            # a REAL kill between the chunk append and the index
+            # append: the reopened store finds the index lagging
+            os.kill(os.getpid(), signal.SIGKILL)
         e = IndexEntry(slot, block_no, hash_, offset, len(raw), zlib.crc32(raw))
         self._entries[n].append(e)
         # O(1) append-only index write (no fsync: startup validation
         # recovers from torn tails); CRC lives in the entry
-        self.fs.append(os.path.join(self.path, _index_name(n)), cbor.encode(e.to_cbor_obj()))
+        enc = cbor.encode(e.to_cbor_obj())
+        ipath = os.path.join(self.path, _index_name(n))
+        self.fs.append(ipath, enc)
+        if fault == "index-truncate":
+            # the index file is torn mid-entry and the writer dies —
+            # the reopened store sees the index lag the chunk and
+            # rebuilds it from chunk bytes
+            size = self.fs.getsize(ipath)
+            self.fs.truncate(ipath, max(0, size - max(1, len(enc) // 2)))
+            raise chaos.IndexTornChaos(
+                f"chaos: index torn at chunk {n} slot {slot}"
+            )
 
     def flush(self) -> None:
         """fsync chunk + index data of the newest chunk (clean shutdown)."""

@@ -20,6 +20,49 @@ from .immutable import ImmutableDB
 from .ledgerdb import LedgerDB
 from .volatile import VolatileDB
 
+# Validation policies (Run.hs:133-143): `--only-validation` forces
+# ValidateAllChunks; normal startup validates the most recent chunk and
+# trusts the clean-shutdown marker for the rest. The policy threads
+# through this codebase as the `validate_all` flag — these names exist
+# so the protocol layer (storage/guard.py, db_analyser, db_truncater)
+# can speak the reference vocabulary. db_analyser adds a third value,
+# "stream": the SAME all-chunks checks folded into the replay's own
+# chunk reads (one disk pass, identical truncation points).
+ValidateAllChunks = True
+ValidateMostRecentChunk = False
+
+
+def escalate_policy(policy, opened_dirty: bool):
+    """Node/Recovery.hs:24-59 — forced revalidation after a crash: a
+    store that cannot prove a clean shutdown revalidates EVERYTHING.
+    `ValidateMostRecentChunk` escalates to `ValidateAllChunks`;
+    "stream" already runs the all-chunks checks (at read time) and
+    stays stream; an explicit all-chunks policy is unchanged."""
+    if opened_dirty and not policy:
+        return ValidateAllChunks
+    return policy
+
+
+def open_repair_store(path: str, chunk_size: int = 21600, fs=None,
+                      quarantine_dir: str | None = None,
+                      repair: bool = True) -> ImmutableDB:
+    """The deep-open recipe in ONE place: full `ValidateAllChunks` walk
+    (CRC + body-hash integrity, chunk-batched fast path) with on-disk
+    repair — the bundle every dirty-store escalation opens
+    (db_synthesizer resume, db_truncater slot-rewind and --to-last-valid).
+    ``repair=False`` is the read-only twin (--dry-run): identical scan,
+    actions computed in memory only."""
+    return ImmutableDB(
+        os.path.join(path, "immutable"),
+        chunk_size=chunk_size,
+        check_integrity=default_check_integrity,
+        validate_all=True,
+        check_integrity_batch=default_check_integrity_batch,
+        repair=repair,
+        quarantine_dir=quarantine_dir,
+        fs=fs,
+    )
+
 
 def default_check_integrity(raw: bytes) -> bool:
     """nodeCheckIntegrity (Node/InitStorage.hs:25 → shelley

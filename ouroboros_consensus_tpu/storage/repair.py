@@ -1,0 +1,174 @@
+"""The durable-store repair plane: quarantine + repair forensics.
+
+Reference: ImmutableDB/VolatileDB startup validation *truncates
+corrupted tails on disk* (ImmutableDB/Impl/Validation.hs:67) — repair
+is a first-class subsystem, not a side effect. This module gives every
+on-disk repair the ImmutableDB takes (or, read-only, WOULD take) a
+durable story:
+
+  * **Quarantine, never delete** — snipped chunk tails, dropped chunk
+    files and swept orphan indices are MOVED into
+    ``<immutable>/quarantine/`` before the live file mutates. A repair
+    that turns out to be wrong (a bad integrity hook, a bug in the
+    scanner) loses nothing; an operator can inspect or restore the
+    bytes.
+  * **Every action a first-class event** — `note_repair` fans one
+    repair action into the warmup forensics (`WARMUP.note_repair` →
+    round JSON + run ledger) and a `RepairEvent` through the batch
+    tracer (→ ``oct_repair_total{action=}`` when the flight recorder
+    is installed). Dry-run scans emit ``applied=False`` rows and are
+    never counted into the metric.
+
+Action vocabulary (the ``oct_repair_total{action=}`` labels):
+
+    truncate-chunk        a chunk's corrupted tail was cut on disk
+                          (CRC / body-hash / reparse first-bad point)
+    rebuild-index         a secondary index was reconstructed from
+                          chunk bytes (missing / corrupt / lagging)
+    drop-chunk            a wholly corrupt chunk (or a chunk stranded
+                          past a truncation gap) was removed
+    sweep-orphan-index    an index file without a chunk was removed
+    dirty-open-escalated  a missing clean-shutdown marker escalated
+                          the validation policy to all-chunks
+                          (storage/guard.py; the open itself)
+"""
+
+from __future__ import annotations
+
+import os
+
+REPAIR_ACTIONS = (
+    "truncate-chunk",
+    "rebuild-index",
+    "drop-chunk",
+    "sweep-orphan-index",
+    "dirty-open-escalated",
+)
+
+QUARANTINE_DIR = "quarantine"
+
+
+class QuarantineError(Exception):
+    """The quarantine copy could not be made durable (ENOSPC, an
+    unwritable quarantine dir). The repair REFUSES rather than
+    proceed: destroying bytes it promised to keep would break the
+    quarantine-never-delete guarantee exactly when disk pressure —
+    the condition under which stores corrupt — makes restores likely.
+    Classified REFUSE by `node/exit.triage`, never absorbed by the
+    recovery ladder."""
+
+
+def note_repair(action: str, chunk: int = -1, kept: int = 0,
+                dropped: int = 0, bytes_quarantined: int = 0,
+                applied: bool = True, detail: str = "") -> dict:
+    """Bank one repair action everywhere at once: the warmup report
+    (always-on forensics — round JSON + run ledger) and the batch
+    tracer (`RepairEvent` → ``oct_repair_total{action=}`` when the
+    flight recorder is installed). Returns the row for callers that
+    accumulate a per-open repair report. Fail-soft: forensics may
+    never break a store open."""
+    row = {
+        "action": action,
+        "chunk": chunk,
+        "kept": kept,
+        "dropped": dropped,
+        "bytes_quarantined": bytes_quarantined,
+        "applied": applied,
+        "detail": detail[:200],
+    }
+    try:
+        from ..obs.warmup import WARMUP
+
+        WARMUP.note_repair(action=action, chunk=chunk, kept=kept,
+                           dropped=dropped,
+                           bytes_quarantined=bytes_quarantined,
+                           applied=applied, detail=detail)
+    except Exception:  # noqa: BLE001 — forensics are best-effort
+        pass
+    try:
+        from ..protocol import batch as pbatch
+        from ..utils.trace import RepairEvent
+
+        if pbatch.BATCH_TRACER is not None:
+            pbatch.BATCH_TRACER(RepairEvent(
+                action=action, chunk=chunk, blocks_kept=kept,
+                blocks_dropped=dropped,
+                bytes_quarantined=bytes_quarantined,
+                applied=applied, detail=detail[:200],
+            ))
+    except Exception:  # noqa: BLE001
+        pass
+    return row
+
+
+def count_actions(rows, applied_only: bool = True) -> dict:
+    """``{action: count}`` over repair rows — the one aggregation
+    behind db_analyser's applied-repair counts and db_truncater's
+    report (``applied_only=False``: a dry-run report counts its
+    would-repair rows too). scripts/perf_report.py carries a local
+    twin (it is deliberately stdlib-only); keep the filter rules in
+    sync."""
+    counts: dict = {}
+    for row in rows or ():
+        if not isinstance(row, dict):
+            continue
+        if applied_only and not row.get("applied", True):
+            continue
+        a = row.get("action", "?")
+        counts[a] = counts.get(a, 0) + 1
+    return counts
+
+
+class Quarantine:
+    """Holds snipped bytes under ``<store>/quarantine/`` instead of
+    deleting them. Names collide across repeated repairs of the same
+    chunk, so a numeric suffix keeps every generation."""
+
+    def __init__(self, store_path: str, fs, directory: str | None = None):
+        self.fs = fs
+        self.path = (directory if directory is not None
+                     else os.path.join(store_path, QUARANTINE_DIR))
+        self._made = False
+
+    def _fresh_target(self, name: str) -> str:
+        """Lazy-mkdir the quarantine dir and pick a collision-free
+        target path (numeric suffix keeps every generation)."""
+        if not self._made:
+            self.fs.makedirs(self.path)
+            self._made = True
+        target = os.path.join(self.path, name)
+        suffix = 0
+        while self.fs.exists(target):
+            suffix += 1
+            target = os.path.join(self.path, f"{name}.{suffix}")
+        return target
+
+    def store(self, name: str, data: bytes) -> int:
+        """Write `data` under a fresh quarantine name; returns the byte
+        count banked (0 on empty data). A write failure raises
+        `QuarantineError` — callers MUST quarantine before they mutate,
+        so the failed copy aborts the repair instead of turning it into
+        the deletion this module exists to prevent."""
+        if not data:
+            return 0
+        try:
+            self.fs.write_bytes(self._fresh_target(name), data)
+            return len(data)
+        except OSError as exc:
+            raise QuarantineError(
+                f"cannot quarantine {name!r} under {self.path}: {exc}"
+            ) from exc
+
+    def store_file(self, name: str, src_path: str) -> int:
+        """MOVE a whole live file into quarantine (atomic rename —
+        O(1), no bytes through memory; the drop/sweep path, where the
+        original leaves the store anyway). Same collision-suffix and
+        refusal semantics as `store`."""
+        try:
+            size = self.fs.getsize(src_path)
+            self.fs.replace(src_path, self._fresh_target(name))
+            return size
+        except OSError as exc:
+            raise QuarantineError(
+                f"cannot quarantine {name!r} under {self.path}: {exc}"
+            ) from exc

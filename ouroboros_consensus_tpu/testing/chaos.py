@@ -33,6 +33,33 @@ optional for fault kinds that need none):
                                   timeout (one attempt per injection;
                                   list it twice to kill two attempts)
 
+Write-path faults (the durable-store matrix, PR 13) land at the chunk
+writer's seam (`ImmutableDB.append_block` consumes them via
+`write_fault()` and owns the disk mutation) and the marker writer's
+(`storage/guard.write_clean_marker`):
+
+    torn-write@append:4           the 5th block append crashes mid-
+                                  write: a PREFIX of the block lands
+                                  in the chunk, no index entry, and
+                                  the writer dies (TornWriteChaos)
+    bitflip@chunk:2               silent bit rot: one byte of a block
+                                  appended into chunk 2 flips on disk;
+                                  the write "succeeds" and the writer
+                                  carries on (the index CRC records
+                                  the truth, so a deep walk catches it)
+    index-truncate@epoch:1        the chunk-1 index file is torn mid-
+                                  entry right after an append lands,
+                                  and the writer dies (IndexTornChaos)
+    sigkill@append:3              SIGKILL self between the 4th block's
+                                  chunk append and its index append —
+                                  a REAL kill leaving the index lagging
+    partial-rename@marker         the clean-shutdown marker write dies
+                                  between the tmp write and the atomic
+                                  rename (PartialRenameChaos): durable
+                                  tmp, no marker — the next open is
+                                  dirty (optionally @marker:clean to
+                                  name a specific marker)
+
 Triggers are matched against per-seam sequence counters (each seam
 counts its own firings from 0 in dispatch order) or, for ``stage:``,
 by substring against the stage label. Each injection fires EXACTLY
@@ -72,6 +99,11 @@ FAULT_KINDS = (
     "chunk-corrupt",
     "aot-reject",
     "probe-timeout",
+    # write-path faults (the durable-store torn-write/bit-rot matrix)
+    "torn-write",
+    "bitflip",
+    "index-truncate",
+    "partial-rename",
 )
 
 # which seam(s) each fault kind is checked at — fire(site) only
@@ -81,10 +113,33 @@ _KIND_SITES = {
     "compile-stall": ("dispatch", "stage-call"),
     "device-error": ("dispatch", "stage-call", "shard"),
     "staging-thread-death": ("stage",),
-    "sigkill": ("retire",),
+    "sigkill": ("retire", "append"),
     "chunk-corrupt": ("chunk",),
     "aot-reject": ("aot",),
     "probe-timeout": ("probe",),
+    # the chunk writer's seam (write_fault in append_block) and the
+    # marker writer's (guard.write_clean_marker)
+    "torn-write": ("append",),
+    "bitflip": ("append",),
+    "index-truncate": ("append",),
+    "partial-rename": ("marker",),
+}
+
+# the trigger keys each seam actually provides (its explicit ctx= kwargs
+# plus its _SITE_SEQ_KEYS) — parse_spec refuses a trigger no seam of the
+# fault's kind can ever satisfy: such a spec would arm and then silently
+# never fire, exactly the fake-green matrix the fail-loud rule forbids
+_SITE_TRIGGER_KEYS = {
+    "dispatch": ("window", "dispatch"),
+    "stage-call": ("stage",),
+    "stage": ("window",),
+    "retire": ("window",),
+    "shard": ("shard",),
+    "chunk": ("chunk",),
+    "append": ("append", "chunk"),
+    "aot": ("stage",),
+    "marker": ("marker",),
+    "probe": ("attempt",),
 }
 
 
@@ -105,6 +160,18 @@ class ChunkChaosError(ChaosError):
     """A chunk read/extract came back corrupted (transient I/O)."""
 
 
+class TornWriteChaos(ChaosError):
+    """A block append crashed mid-write: a torn prefix is on disk."""
+
+
+class IndexTornChaos(ChaosError):
+    """The secondary index was torn mid-entry after an append."""
+
+
+class PartialRenameChaos(ChaosError):
+    """A marker write died between the tmp write and the rename."""
+
+
 class AotRejectChaos(ChaosError):
     """An AOT store entry is rejected as format-incompatible. The
     message deliberately matches ops/pk/aot.INCOMPATIBLE_PATTERNS so
@@ -117,14 +184,20 @@ class AotRejectChaos(ChaosError):
         )
 
 
+# wildcard arg: "any value at this trigger key" — only the grammar
+# forms that document it (partial-rename@marker) may parse to this
+ANY = object()
+
+
 class _Injection:
     __slots__ = ("kind", "trigger", "arg", "count", "fired")
 
     def __init__(self, kind: str, trigger: str | None, arg, count: int):
         self.kind = kind
         self.trigger = trigger  # "window"|"dispatch"|"stage"|"epoch"|
-        # "shard"|None — the ctx key the seam matches against
-        self.arg = arg  # int seq / str stage-substring / None
+        # "shard"|"append"|"marker"|None — the ctx key the seam
+        # matches against
+        self.arg = arg  # int seq / str stage-substring / ANY / None
         self.count = count  # firings remaining
         self.fired = 0
 
@@ -135,6 +208,8 @@ class _Injection:
             return True
         if self.trigger not in ctx:
             return False
+        if self.arg is ANY:
+            return True
         v = ctx[self.trigger]
         if isinstance(self.arg, str):
             return self.arg in str(v)
@@ -145,8 +220,11 @@ class _Injection:
         self.fired += 1
 
     def describe(self) -> str:
-        t = f"@{self.trigger}:{self.arg}" if self.trigger is not None else ""
-        return f"{self.kind}{t}"
+        if self.trigger is None:
+            return self.kind
+        if self.arg is ANY:
+            return f"{self.kind}@{self.trigger}"
+        return f"{self.kind}@{self.trigger}:{self.arg}"
 
 
 class ChaosPlan:
@@ -209,7 +287,11 @@ def parse_spec(spec: str) -> list[_Injection]:
             if "x" in argtxt and argtxt.rsplit("x", 1)[1].isdigit():
                 argtxt, _, n = argtxt.rpartition("x")
                 count = int(n)
-            if not trigger or not argtxt:
+            if not argtxt and kind == "partial-rename" and trigger == "marker":
+                # the documented no-arg form: ANY marker write (there
+                # is normally exactly one — the clean-shutdown marker)
+                arg = ANY
+            elif not trigger or not argtxt:
                 # an empty arg would parse as the match-ANYTHING ''
                 # substring — a silently mis-placed fault, exactly what
                 # the fail-loud rule exists to prevent
@@ -217,7 +299,8 @@ def parse_spec(spec: str) -> list[_Injection]:
                     f"OCT_CHAOS: {part!r} has an empty trigger or arg "
                     "(want <fault>@<trigger>:<arg>)"
                 )
-            arg = int(argtxt) if argtxt.lstrip("-").isdigit() else argtxt
+            else:
+                arg = int(argtxt) if argtxt.lstrip("-").isdigit() else argtxt
             if trigger == "epoch":  # chunk index stands in for epoch
                 trigger = "chunk"
         elif kind == "probe-timeout":
@@ -226,6 +309,17 @@ def parse_spec(spec: str) -> list[_Injection]:
             raise ValueError(
                 f"OCT_CHAOS: fault {kind!r} needs a @trigger:arg clause"
             )
+        if arg is not None and trigger is not None:
+            satisfiable = {
+                k for site in _KIND_SITES[kind]
+                for k in _SITE_TRIGGER_KEYS.get(site, ())
+            }
+            if trigger not in satisfiable:
+                raise ValueError(
+                    f"OCT_CHAOS: {part!r} can never fire — trigger "
+                    f"{trigger!r} is not provided at any {kind!r} seam "
+                    f"(know: {', '.join(sorted(satisfiable))})"
+                )
         out.append(_Injection(kind, trigger if arg is not None else None,
                               arg, count))
     return out
@@ -303,6 +397,13 @@ def _execute(inj: _Injection, site: str, ctx: dict) -> None:
         raise ChunkChaosError(f"chaos: chunk read corrupted at {where}")
     if inj.kind == "aot-reject":
         raise AotRejectChaos(str(ctx.get("stage", "?")))
+    if inj.kind == "partial-rename":
+        # the marker writer already wrote (and fsynced) the tmp file;
+        # raising HERE models the crash between tmp and rename — the
+        # durable tmp survives, the final marker never appears
+        raise PartialRenameChaos(
+            f"chaos: marker rename died at {where}"
+        )
     if inj.kind == "sigkill":
         import signal
 
@@ -323,33 +424,66 @@ _SITE_SEQ_KEYS = {
     "retire": ("window",),  # one retire per window
     "shard": ("shard",),
     "chunk": ("chunk",),
+    "append": ("append",),  # one block append per seq (write_fault);
+    # the CHUNK NUMBER rides the explicit chunk= ctx, so bitflip@chunk:N
+    # and index-truncate@epoch:N place by chunk, torn-write@append:N and
+    # sigkill@append:N by append order
     # "stage-call" / "aot" match only on the explicit stage= ctx;
+    # "marker" matches only on the explicit marker= ctx;
     # "probe" is consumed via probe_timeout_pending()
 }
 
 
-def fire(site: str, **ctx) -> None:
-    """The one seam entry point. Cheap no-op disarmed (module bool);
-    armed, it advances this seam's sequence counter, exposes it as the
-    seam's OWN canonical trigger keys (_SITE_SEQ_KEYS), and executes
-    the first matching un-spent injection (raise / sleep / kill per
-    its fault kind)."""
+def _match(site: str, ctx: dict):
+    """THE injection matcher — one implementation of the semantics
+    every seam shares (armed check, per-site plan lookup, sequence
+    advance, _SITE_SEQ_KEYS defaulting, first un-spent match). Returns
+    ``(injection, seq)`` or None; the caller decides what a match DOES
+    (fire() executes it, write_fault() hands its kind to the writer).
+    The sequence counter only advances when the plan has injections at
+    this site, so a disarmed or unrelated run never drifts counters."""
     if not _ARMED:
-        return
+        return None
     p = _PLAN
     if p is None:
-        return
+        return None
     injections = p.for_site(site)
     if not injections:
-        return
+        return None
     seq = p.next_seq(site)
     full = dict(ctx)
     for k in _SITE_SEQ_KEYS.get(site, ()):
         full.setdefault(k, seq)
     for inj in injections:
         if inj.matches(full):
-            _execute(inj, site, ctx or {"seq": seq})
-            return
+            return inj, seq
+    return None
+
+
+def fire(site: str, **ctx) -> None:
+    """The one seam entry point. Cheap no-op disarmed (module bool);
+    armed, the first matching un-spent injection (`_match`) is
+    executed — raise / sleep / kill per its fault kind."""
+    m = _match(site, ctx)
+    if m is not None:
+        inj, seq = m
+        _execute(inj, site, ctx or {"seq": seq})
+
+
+def write_fault(**ctx) -> str | None:
+    """The chunk writer's seam (`ImmutableDB.append_block`): matching
+    identical to `fire()` at the ``append`` site (`_match`), but the
+    injection's KIND is returned instead of executed — the writer owns
+    the disk-mutation semantics (a torn prefix for ``torn-write``, a
+    flipped byte for ``bitflip``, a torn index entry for
+    ``index-truncate``, a SIGKILL between the chunk and index appends
+    for ``sigkill@append``). None = no fault this append."""
+    m = _match("append", ctx)
+    if m is None:
+        return None
+    inj, _seq = m
+    inj.spend()
+    return inj.kind
 
 
 def probe_timeout_pending() -> bool:

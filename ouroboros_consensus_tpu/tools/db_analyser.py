@@ -22,6 +22,7 @@ a db-synthesizer chain.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -47,6 +48,11 @@ class ValidationResult:
     resumed_headers: int = 0  # headers skipped by a checkpoint resume
     # (counted INTO n_valid: the record vouches for them — the resumed
     # total equals the uninterrupted run's by the differential suite)
+    opened_dirty: bool = False  # the clean-shutdown marker was absent:
+    # the validation policy escalated to all-chunks + on-disk repair
+    # (storage/guard.py — forced revalidation after a crash)
+    repairs: dict | None = None  # {action: count} of the store repairs
+    # this open/replay applied (detailed rows ride the warmup report)
     # filled by collect_phases=True (protocol/batch tracer events):
     phases: dict | None = None  # per-phase wall s (stage/dispatch/...)
     h2d_bytes: int = 0  # staged bytes shipped host->device
@@ -120,13 +126,18 @@ class SlotDataPoint:
         )
 
 
-def open_immutable(db_path: str, validate_all=False) -> ImmutableDB:
+def open_immutable(db_path: str, validate_all=False,
+                   repair: bool = False) -> ImmutableDB:
     """validate_all: False = most-recent-chunk check only; True =
     ValidateAllChunks at open (two disk passes: validation walk, then
-    the replay's stream); "stream" = the SAME all-chunks checks (CRC +
+    the replay's stream — truncates corrupted tails ON DISK, snipped
+    bytes quarantined); "stream" = the SAME all-chunks checks (CRC +
     body-hash integrity, per-blob order) folded into the replay's own
     chunk reads by _stream_views — one disk pass, identical verdicts
-    and truncation points, no on-disk repair (read-only analysis).
+    and truncation points. Stream mode is read-only analysis by
+    default: pass ``repair=True`` (revalidate's ``--repair`` /
+    ``repair=`` lever, forced by a dirty open) to write back the
+    truncation the deep read computes, via `ImmutableDB.repair_to`.
     Reference: --only-validation forces ValidateAllChunks
     (Tools/DBAnalyser.hs:133-136); the stream mode is how the replay
     pays for it without reading every chunk twice."""
@@ -143,7 +154,13 @@ def open_immutable(db_path: str, validate_all=False) -> ImmutableDB:
         check_integrity_batch=(
             default_check_integrity_batch if deep else None
         ),
+        # reader opens (shallow / plain stream) may not mutate the disk
+        # AT ALL: truncations and index rebuilds are computed in memory
+        # (applied=False rows); only a deep open or an explicit repair
+        # lever writes — matching the StoreGuard writer decision
+        repair=deep or bool(repair),
         stream_deep=stream,
+        stream_repair=stream and bool(repair),
     )
 
 
@@ -292,6 +309,12 @@ def _stream_windows(imm: ImmutableDB, res: "ValidationResult"):
                 if good < len(entries):
                     entries = entries[:good]
                     truncated = True
+                    if getattr(imm, "stream_repair", False):
+                        # --repair / dirty-open write-back: apply the
+                        # truncation this deep read just computed —
+                        # quarantine + on-disk cut, the same repair a
+                        # deep open would have taken here
+                        imm.repair_to(n, good, data=data)
             cols = None
             if native_ok and entries:
                 import numpy as np
@@ -497,12 +520,30 @@ def revalidate(
     resume: bool | None = None,  # resume from the OCT_CHECKPOINT
     # progress record when one matches this chain (None = follow the
     # OCT_RESUME env lever) — obs/recovery.py; batched backends only
+    repair: bool = False,  # opt-in ON-DISK write-back of the
+    # truncation the deep/stream validation computes (--repair):
+    # quarantine + truncate via ImmutableDB.repair_to. Defaults OFF —
+    # analysis stays read-only — but a DIRTY open (missing clean-
+    # shutdown marker) forces it on, the reference's forced-
+    # revalidation-after-crash semantics
+    network_magic: int | None = None,  # strict chain-magic check of
+    # the DB marker (wrong-chain open refuses with DbMarkerMismatch);
+    # None = accept the existing marker, create the default on a
+    # virgin store
 ) -> ValidationResult:
     """only-validation analysis: full chain revalidation from genesis
     — or, with `OCT_CHECKPOINT` set and a resume requested, from the
     last retired window of a killed attempt (crash-consistent progress
     record, obs/recovery.py; proven verdict-identical to the
     uninterrupted replay by tests/test_selfheal.py).
+
+    The open speaks the store crash protocol (storage/guard.py): DB
+    lock (a concurrent open refuses loudly with DbLocked), chain-magic
+    marker (a wrong-chain open refuses with DbMarkerMismatch), and the
+    clean-shutdown marker — an open that cannot prove the last writer
+    shut down cleanly escalates its validation policy to all-chunks
+    WITH on-disk repair, and the result records `opened_dirty` +
+    `repairs` ({action: count}; detailed rows in the warmup report).
 
     collect_phases=True threads a batch tracer through the replay and
     fills `res.phases` / `res.h2d_bytes` / `res.d2h_bytes` /
@@ -538,7 +579,7 @@ def revalidate(
         return _revalidate_traced(
             db_path, params, lview, backend, validate_all, max_batch,
             max_headers, trace, ledger, genesis_state, collect_phases,
-            resume,
+            resume, repair, network_magic,
         )
     finally:
         if plane is not None:
@@ -550,6 +591,7 @@ def revalidate(
 def _revalidate_traced(
     db_path, params, lview, backend, validate_all, max_batch,
     max_headers, trace, ledger, genesis_state, collect_phases, resume,
+    repair, network_magic,
 ) -> ValidationResult:
     if collect_phases:
         coll = _PhaseCollector()
@@ -565,6 +607,7 @@ def _revalidate_traced(
             res = _revalidate_impl(
                 db_path, params, lview, backend, validate_all, max_batch,
                 max_headers, trace, ledger, genesis_state, resume,
+                repair, network_magic,
             )
         finally:
             pbatch.set_batch_tracer(prev)
@@ -572,15 +615,105 @@ def _revalidate_traced(
         return res
     return _revalidate_impl(
         db_path, params, lview, backend, validate_all, max_batch,
-        max_headers, trace, ledger, genesis_state, resume,
+        max_headers, trace, ledger, genesis_state, resume, repair,
+        network_magic,
     )
 
 
 def _revalidate_impl(
     db_path, params, lview, backend, validate_all, max_batch,
     max_headers, trace, ledger, genesis_state, resume=None,
+    repair=False, network_magic=None,
 ) -> ValidationResult:
-    """The revalidate body (wrapped by `revalidate` for attribution).
+    """The store crash protocol around the replay (storage/guard.py):
+    lock → marker → clean-shutdown check. A dirty open escalates the
+    validation policy to all-chunks (`storage/open.escalate_policy` —
+    Recovery.hs's forced revalidation) and forces repair write-back;
+    a guard refusal (DbLocked / DbMarkerMismatch) raises BEFORE any
+    bytes are read. An exception unwinding out of the replay leaves
+    the store dirty (crash shape); a completed replay closes clean
+    only when its walk PROVED the whole store (deep open-time
+    validation, or an uncapped stream that reached the end of the
+    chain — a stream aborted at a validation error checked nothing
+    past the error and leaves a dirty store dirty)."""
+    from ..storage import guard as _guard_mod
+    from ..storage import open as _open_mod
+    from ..storage import repair as _repair_mod
+
+    res = ValidationResult()
+    t0 = time.monotonic()
+    policy = validate_all
+    # writer mode iff this open may mutate the store: a deep open
+    # repairs on disk (reference ValidateAllChunks), --repair writes
+    # back stream truncations; plain stream/shallow analysis is a
+    # reader and leaves the markers alone
+    guard = _guard_mod.StoreGuard(
+        db_path, network_magic=network_magic,
+        writer=bool(repair) or policy is True,
+    )
+    if guard.writer and not os.path.exists(
+        os.path.join(db_path, "immutable")
+    ):
+        # a writer-mode open of a path with no store would FABRICATE
+        # one (lock + default-magic marker + clean marker) and report
+        # a healthy 0/0 chain — a typo'd --db must refuse loudly
+        # first. (A read-only scan of a virgin path stays legal and
+        # side-effect-free.)
+        raise FileNotFoundError(
+            f"no store at {db_path} (refusing to create one — check --db)"
+        )
+    guard.open()
+    try:
+        if guard.opened_dirty:
+            policy = _open_mod.escalate_policy(policy, True)
+            guard.promote_writer()
+            _repair_mod.note_repair(
+                "dirty-open-escalated",
+                detail=f"no clean-shutdown marker: policy {validate_all!r}"
+                       f" -> {policy!r}, repair forced on",
+            )
+            repair = True
+        res.opened_dirty = guard.opened_dirty
+        imm = open_immutable(db_path, validate_all=policy, repair=repair)
+        res.open_s = time.monotonic() - t0
+        out = _revalidate_body(
+            imm, res, t0, db_path, params, lview, backend, max_batch,
+            max_headers, trace, ledger, genesis_state, resume,
+        )
+        counts: dict = {}
+        if res.opened_dirty:
+            counts["dirty-open-escalated"] = 1
+        # APPLIED rows only: computed-only (read-only scan) rows ride
+        # the warmup report, never the applied counts
+        counts.update(_repair_mod.count_actions(getattr(imm, "repairs", ())))
+        out.repairs = counts or None
+    except BaseException:
+        guard.close(clean=False)  # the crash shape: store stays dirty
+        raise
+    # Stamp clean only when this open PROVED store consistency: a deep
+    # open walked every chunk at open time (wherever the replay then
+    # stopped), but a stream ran its checks only over the chunks it
+    # actually consumed: it covers the whole chain only when uncapped
+    # AND the replay reached the end — a validation ERROR aborts the
+    # stream mid-chain, leaving later chunks unchecked and unrepaired
+    # (a checkpoint resume still reads every chunk — the skip is
+    # window-level). A capped or error-aborted stream on a DIRTY store
+    # must leave it dirty so the next open still force-revalidates the
+    # rest (Recovery.hs:24-59 — the promise is ALL chunks, not "the
+    # prefix the replay happened to read").
+    full_walk = policy is True or (policy == "stream"
+                                   and max_headers is None
+                                   and out.error is None)
+    guard.close(clean=full_walk or not res.opened_dirty)
+    return out
+
+
+def _revalidate_body(
+    imm, res, t0, db_path, params, lview, backend, max_batch,
+    max_headers, trace, ledger, genesis_state, resume=None,
+) -> ValidationResult:
+    """The revalidate body (wrapped by `revalidate` for attribution and
+    by `_revalidate_impl` for the store crash protocol).
 
     backend="device": epoch-segmented batches through the fused kernel
     (further split at max_batch to bound device memory; the jit caches
@@ -592,10 +725,6 @@ def _revalidate_impl(
     collectives (parallel/spmd.py); the production multi-chip path.
     backend="host": the sequential fold (reference semantics, pure Python).
     """
-    res = ValidationResult()
-    t0 = time.monotonic()
-    imm = open_immutable(db_path, validate_all=validate_all)
-    res.open_s = time.monotonic() - t0
 
     def stream_views(imm, res):
         if max_headers is None:
@@ -1128,6 +1257,12 @@ def main(argv=None) -> None:
                    help="resume only-validation from the OCT_CHECKPOINT "
                         "progress record when one matches this chain "
                         "(default: follow the OCT_RESUME env lever)")
+    p.add_argument("--repair", action="store_true",
+                   help="write back (quarantine + truncate on disk) the "
+                        "corrupted-tail truncation the validation walk "
+                        "computes; default off = read-only analysis. A "
+                        "dirty open (missing clean-shutdown marker) "
+                        "forces this on regardless")
     p.add_argument("--out-csv", default=None)
     p.add_argument("--config", default=None,
                    help="node config.json (defaults to <db>/config/config.json "
@@ -1152,6 +1287,13 @@ def main(argv=None) -> None:
 
         if a.analysis != "only-validation":
             raise SystemExit("--cardano supports only-validation")
+        if a.repair or a.resume:
+            # a silently ignored flag would fake a repair/resume that
+            # never ran — refuse loudly (same rule as --config below)
+            raise SystemExit(
+                "--cardano does not support --repair/--resume (the "
+                "composite replay opens its stores read-only)"
+            )
         if a.config is not None:
             # an ignored config would revalidate under WRONG parameters
             # and report spurious errors — refuse loudly instead
@@ -1224,8 +1366,13 @@ def main(argv=None) -> None:
         return
     res = revalidate(a.db, params, lview, backend=a.backend,
                      trace=lambda s: print(s),
-                     resume=True if a.resume else None)
+                     resume=True if a.resume else None,
+                     repair=a.repair)
     status = "OK" if res.error is None else f"INVALID at {res.n_valid}: {res.error!r}"
+    if res.repairs:
+        acts = ", ".join(f"{k}={v}" for k, v in sorted(res.repairs.items()))
+        print(("dirty open — " if res.opened_dirty else "")
+              + f"store repairs: {acts}")
     print(
         f"validated {res.n_valid}/{res.n_blocks} headers in {res.wall_s:.1f}s "
         f"(device {res.device_s:.1f}s) -> {status}"

@@ -116,17 +116,130 @@ def synthesize(
     # derive each epoch's election view from ITS stake snapshots — the
     # forging twin of db_analyser's ledger-derived revalidation (so
     # Shelley-backed chains synthesize at tool level)
+    resume: bool = False,  # continue forging into a NON-empty DB: the
+    # store is reopened dirty-aware (deep revalidation + repair when
+    # the last writer crashed), the protocol state rebuilt by
+    # replaying the surviving chain with the trusted reupdate path,
+    # and forging continues from the tip — forging is deterministic,
+    # so a killed-and-resumed synthesis converges on the byte-
+    # identical chain an uninterrupted run produces
+    network_magic: int | None = None,  # chain magic for the DB marker
 ) -> ForgeResult:
     """The forging loop (Forging.hs:57): tick → leader check per
     credential → forge → append, until the limit trips.
 
+    The writer speaks the store crash protocol (storage/guard.py): DB
+    lock held for the whole forge, chain-magic marker written on
+    first open, clean-shutdown marker absent while forging and written
+    back after the final flush — a killed synthesis leaves a DIRTY
+    store whose next open deep-revalidates and repairs.
+
     vrf_backend: "device" evaluates VRFs in epoch-span batches on the
     accelerator; "host" per-slot on the CPU; "auto" picks device when
     the run is big enough to amortize the kernel compile."""
+    from ..storage import guard as _guard_mod
+    from ..storage.open import open_repair_store
+
+    if resume and ledger is not None:
+        raise ValueError(
+            "resume is not supported in ledger mode (the ledger fold "
+            "has its own snapshot/replay machinery)"
+        )
     os.makedirs(db_path, exist_ok=True)
-    imm = ImmutableDB(os.path.join(db_path, "immutable"), chunk_size=chunk_size)
-    if not imm.is_empty:
-        raise RuntimeError(f"refusing to forge into non-empty DB at {db_path}")
+    # open as a READER first: the non-empty-DB refusal below must be
+    # side-effect-free (an operator mistake may not dirty a healthy
+    # store); promote_writer() adopts the writer protocol only once we
+    # have committed to mutating
+    guard = _guard_mod.StoreGuard(
+        db_path, network_magic=network_magic, writer=False
+    )
+    guard.open()
+    try:
+        if resume:
+            # a resume is committed to writing: adopt the writer
+            # protocol up front so any tail repair the open computes
+            # happens under the writer guard (never a reader's)
+            guard.promote_writer()
+            if guard.opened_dirty:
+                # the previous writer crashed: reopen with the full
+                # ValidateAllChunks + repair scan (torn tails truncated
+                # + quarantined, lagging indices rebuilt) before
+                # trusting the tip
+                imm = open_repair_store(db_path, chunk_size=chunk_size)
+            else:
+                imm = ImmutableDB(
+                    os.path.join(db_path, "immutable"),
+                    chunk_size=chunk_size,
+                )
+        else:
+            # repair=False: this probe happens under the READER guard —
+            # the non-empty refusal below must be side-effect-free (an
+            # operator mistake may not touch somebody else's dirty tail)
+            imm = ImmutableDB(
+                os.path.join(db_path, "immutable"), chunk_size=chunk_size,
+                repair=False,
+            )
+            if not imm.is_empty:
+                raise RuntimeError(
+                    f"refusing to forge into non-empty DB at {db_path} "
+                    "(pass resume=True to continue a crashed synthesis)"
+                )
+            if imm.repairs:
+                # "empty" came out of a read-only scan that COMPUTED
+                # repairs (e.g. a wholly-torn first chunk reparsed to
+                # zero entries): forging here would append after
+                # un-truncated garbage
+                raise RuntimeError(
+                    f"refusing to forge into corrupted store at "
+                    f"{db_path} (pass resume=True to repair and "
+                    "continue, or run db_truncater --to-last-valid)"
+                )
+            guard.promote_writer()
+            imm.prepare_write()  # the probe was read-only by design
+        out = _synthesize_locked(
+            imm, db_path, params, pools, lview, limit, txs_per_block,
+            vrf_backend, trace, ledger_view_for_epoch, txs_for_block,
+            ledger, genesis_state,
+        )
+    except BaseException:
+        # a killed/raising forge leaves DIRTY; the pre-writer refusal
+        # path releases the lock without having touched any marker
+        guard.close(clean=False)
+        raise
+    guard.close(clean=True)
+    return out
+
+
+def _replay_forged_state(params, lview, imm):
+    """Rebuild the forging state from a surviving chain: the trusted
+    reupdate fold (we forged these signatures ourselves — exactly the
+    reference's crypto-free path; tick/reupdate never read the stake
+    distribution, so the constant view serves every epoch). Yields the
+    PraosState at the tip plus the per-pool ocert counters, tip hash,
+    next block number and next slot — everything the forging loop
+    threads."""
+    from ..block.praos_block import Block
+
+    st = PraosState()
+    prev_hash = None
+    block_no = 0
+    slot = 0
+    for _entry, raw in imm.stream_all():
+        b = Block.from_bytes(raw)
+        ticked = praos.tick(params, lview, b.slot, st)
+        st = praos.reupdate(params, b.header.to_view(), b.slot, ticked)
+        prev_hash = b.hash_
+        block_no = b.block_no + 1
+        slot = b.slot + 1
+    # reupdate keyed these by hash_key(vk_cold) == pool.pool_id
+    return st, dict(st.ocert_counters), prev_hash, block_no, slot
+
+
+def _synthesize_locked(
+    imm, db_path, params, pools, lview, limit, txs_per_block,
+    vrf_backend, trace, ledger_view_for_epoch, txs_for_block,
+    ledger, genesis_state,
+) -> ForgeResult:
 
     n_target = limit.slots or limit.blocks or (
         (limit.epochs or 0) * params.epoch_length
@@ -145,6 +258,16 @@ def synthesize(
     block_no = 0
     slot = 0
     counters: dict[bytes, int] = {}
+    if not imm.is_empty:
+        # resume: rebuild the forging state from the surviving (just
+        # deep-validated/repaired) chain and continue from the tip —
+        # forging is deterministic, so the resumed chain converges on
+        # the uninterrupted run's bytes
+        st, counters, prev_hash, block_no, slot = _replay_forged_state(
+            params, lview, imm
+        )
+        trace(f"resuming synthesis at slot {slot} "
+              f"({block_no} blocks survive)")
 
     if ledger is not None:
         if genesis_state is None:
@@ -278,6 +401,10 @@ def main(argv=None) -> None:
     lim.add_argument("--blocks", type=int)
     lim.add_argument("--epochs", type=int)
     p.add_argument("--txs-per-block", type=int, default=0)
+    p.add_argument("--resume", action="store_true",
+                   help="continue a crashed synthesis: deep-validate + "
+                        "repair the surviving chain, rebuild the "
+                        "forging state from it, forge on from the tip")
     p.add_argument("--config", default=None,
                    help="node config.json (with CredentialsFile) instead "
                         "of --pools/--kes-depth generated credentials")
@@ -315,6 +442,7 @@ def main(argv=None) -> None:
         ForgeLimit(slots=a.slots, blocks=a.blocks, epochs=a.epochs),
         txs_per_block=a.txs_per_block,
         trace=lambda s: print(s),
+        resume=a.resume,
     )
     # the chain carries its own config (tools-test pipeline shape)
     from .config import write_genesis_files

@@ -99,6 +99,11 @@ class RealFS:
             os.fsync(f.fileno())
         os.replace(tmp, self._p(path))
 
+    def replace(self, src: str, dst: str) -> None:
+        """Atomic rename (the tail of write_atomic, for callers that
+        staged + fsynced their own tmp file)."""
+        os.replace(self._p(src), self._p(dst))
+
     def truncate(self, path: str, size: int) -> None:
         with open(self._p(path), "r+b") as f:
             f.truncate(size)
@@ -214,6 +219,15 @@ class MockFS:
         nf.synced = len(data)
         nf.durable = True
         self._files[p] = nf
+
+    def replace(self, src: str, dst: str) -> None:
+        # atomic rename: the destination inherits the source file whole
+        # (synced/durable state included)
+        s = self._norm(src)
+        f = self._files.pop(s, None)
+        if f is None:
+            raise FsError(f"no such file: {src}")
+        self._files[self._norm(dst)] = f
 
     def truncate(self, path: str, size: int) -> None:
         f = self._files.get(self._norm(path))

@@ -206,6 +206,27 @@ class CheckpointEvent:
 
 
 @dataclass(frozen=True)
+class RepairEvent:
+    """The durable store mutated (or, dry-run, WOULD have mutated)
+    itself back to consistency (storage/repair.py): a corrupted chunk
+    tail truncated on disk, a secondary index rebuilt from chunk
+    bytes, a wholly corrupt chunk dropped, an orphaned index swept, or
+    a dirty open escalating its validation policy. Snipped bytes are
+    QUARANTINED (never deleted); `applied=False` marks a read-only /
+    --dry-run scan that only computed the action. Counted into
+    ``oct_repair_total{action=}``."""
+
+    action: str  # "truncate-chunk" | "rebuild-index" | "drop-chunk"
+    # | "sweep-orphan-index" | "dirty-open-escalated"
+    chunk: int  # chunk number (-1 for store-level actions)
+    blocks_kept: int
+    blocks_dropped: int
+    bytes_quarantined: int
+    applied: bool  # False = dry-run: computed, not written
+    detail: str = ""
+
+
+@dataclass(frozen=True)
 class ShardSpan:
     """Per-shard WindowSpan analogue for one sharded SPMD dispatch
     (parallel/spmd.sharded_run_batch): how one mesh position fared.

@@ -83,8 +83,13 @@ _MACHINERY_FILES = {"scripts/fit_costmodel.py"}
 # testing/chaos.py since round 12: its injection seams sit beside the
 # packed_unpack/verdict_reduce dispatch paths, so a chaos edit re-runs
 # the zero-eqn differential proving the seams add no equations to the
-# production jaxprs when disarmed.
-_OBS_PREFIX = "ouroboros_consensus_tpu/obs/"
+# production jaxprs when disarmed. storage/ joined in round 13: the
+# durable-store repair plane (immutable.py's write-fault seams +
+# RepairEvent emission, guard.py's marker seam) emits telemetry beside
+# the replay's staging inputs, so a storage edit re-runs the same
+# zero-eqn differential.
+_OBS_PREFIXES = ("ouroboros_consensus_tpu/obs/",
+                 "ouroboros_consensus_tpu/storage/")
 _OBS_FILES = {"scripts/perf_report.py",
               "ouroboros_consensus_tpu/parallel/spmd.py",
               "ouroboros_consensus_tpu/testing/chaos.py"}
@@ -124,7 +129,7 @@ def _select_graphs(changed: set[str]) -> list[str] | None:
         n for n in absint.certifiable_graphs()
         if changed & set(sources.get(n, []))
     ]
-    if any(f.startswith(_OBS_PREFIX) or f in _OBS_FILES for f in changed):
+    if any(f.startswith(_OBS_PREFIXES) or f in _OBS_FILES for f in changed):
         purity = graphs.load_budgets().get(
             "instrumentation_purity", {}
         ).get("graphs", [])

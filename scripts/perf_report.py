@@ -42,7 +42,15 @@ transition of every episode). A round that banked its device number
 only because the supervisor walked failing windows down the ladder is
 its own class, `recovered@<fault>` — priority-wise between `stalled@`
 (it did not die) and clean (it did not run clean either) — rendered
-with its per-action transition counts."""
+with its per-action transition counts.
+
+Since round 13 the durable-store REPAIR plane rides the same way: the
+warmup report's `repairs` rows (storage/repair.py — every on-disk
+repair the open-with-repair scan applied: truncated tails, rebuilt
+indices, dropped chunks, dirty-open escalations). A round whose store
+opened dirty or was repaired under it classifies `repaired@<action>`
+— priority between `recovered@` (the replay itself never failed) and
+clean (the store was not healthy either) — with per-action counts."""
 
 from __future__ import annotations
 
@@ -169,6 +177,32 @@ def _recovery_counts(wr: dict | None) -> tuple[dict, str | None]:
     return counts, fault
 
 
+_REPAIR_PRIORITY = ("truncate-chunk", "drop-chunk", "rebuild-index",
+                    "sweep-orphan-index", "dirty-open-escalated")
+
+
+def _repair_counts(wr: dict | None) -> tuple[dict, str | None]:
+    """({action: count}, primary-action) out of a banked warmup
+    report's `repairs` rows (storage/repair.py). Only APPLIED rows
+    count (dry-run scans are not repairs); the primary action — what
+    `repaired@<action>` names — is the most disk-invasive one."""
+    rows = (wr or {}).get("repairs") or []
+    counts: dict = {}
+    for row in rows:
+        if not isinstance(row, dict) or not row.get("applied", True):
+            continue
+        a = row.get("action", "?")
+        counts[a] = counts.get(a, 0) + 1
+    primary = None
+    for a in _REPAIR_PRIORITY:
+        if counts.get(a):
+            primary = a
+            break
+    if primary is None and counts:
+        primary = sorted(counts)[0]
+    return counts, primary
+
+
 def _gate_counts(metrics: dict | None) -> dict:
     """{gate: count} out of a banked metrics snapshot (or {})."""
     if not isinstance(metrics, dict):
@@ -213,6 +247,9 @@ def analyze_bench_round(path: str) -> dict:
     recovery_actions, recovered_fault = _recovery_counts(
         wr if isinstance(wr, dict) else None
     )
+    repair_actions, repaired_action = _repair_counts(
+        wr if isinstance(wr, dict) else None
+    )
     row = {
         "round": _round_of(path, doc),
         "file": os.path.basename(path),
@@ -240,6 +277,13 @@ def analyze_bench_round(path: str) -> dict:
         # via recovery — the fault class it recovered from
         "recovery_actions": recovery_actions,
         "recovered_fault": recovered_fault,
+        # the durable-store repair plane's banked story (round 13):
+        # applied repair counts per action + whether the store opened
+        # dirty (warmup `repairs` rows / the banked attribution)
+        "repair_actions": repair_actions,
+        "repaired_action": repaired_action,
+        "opened_dirty": bool((parsed or {}).get("opened_dirty")
+                             or repair_actions.get("dirty-open-escalated")),
         "resumed_headers": (parsed or {}).get("resumed_headers") or 0,
         # the live plane's banked story (round 11): timeline length +
         # last state, and whether a stall dump named a wedged phase
@@ -440,9 +484,13 @@ def render_markdown(report: dict) -> str:
                 or ", ".join(filter(None, [
                     # a banked round that finished VIA recovery is its
                     # own class — priority between stalled@ (it did not
-                    # die) and clean (it did not run clean either)
+                    # die) and clean (it did not run clean either);
+                    # repaired@ sits between recovered@ and clean (the
+                    # replay never failed, the STORE was not healthy)
                     (f"recovered@{r['recovered_fault']}"
                      if r.get("recovered_fault") else None),
+                    (f"repaired@{r['repaired_action']}"
+                     if r.get("repaired_action") else None),
                     ("laddered" + (" (swapped)" if r.get("ladder_swapped")
                                    else "")
                      if r.get("laddered") else None),
@@ -464,6 +512,11 @@ def render_markdown(report: dict) -> str:
                                  sorted(r["recovery_actions"].items()))
                 modes += (" — recovery ladder HAD engaged before the "
                           f"death ({acts})")
+            if r.get("repair_actions"):
+                acts = ", ".join(f"{k}={v}" for k, v in
+                                 sorted(r["repair_actions"].items()))
+                modes += (" — store repairs HAD been applied before "
+                          f"the death ({acts})")
             out.append(f"* r{r['round']:02d}: {modes}")
     recovered = [r for r in rounds
                  if r["device_banked"] and r.get("recovery_actions")]
@@ -479,6 +532,20 @@ def render_markdown(report: dict) -> str:
                 f"{r.get('recovered_fault') or '?'} — the supervisor "
                 f"walked failing windows down the ladder ({acts})"
                 f"{resumed}; the banked number is a RECOVERED replay's"
+            )
+    repaired = [r for r in rounds
+                if r["device_banked"] and r.get("repair_actions")]
+    if repaired:
+        out += ["", "## Repaired rounds", ""]
+        for r in repaired:
+            acts = ", ".join(f"{k}={v}" for k, v in
+                             sorted(r["repair_actions"].items()))
+            out.append(
+                f"* r{r['round']:02d}: repaired@"
+                f"{r.get('repaired_action') or '?'} — the store "
+                + ("opened dirty and " if r.get("opened_dirty") else "")
+                + f"was repaired under the replay ({acts}); the banked "
+                "number is a replay of the repaired store"
             )
     laddered = [r for r in rounds if r["device_banked"] and r.get("laddered")]
     if laddered:

@@ -61,12 +61,92 @@ def test_parse_multiplicity_suffix():
     assert inj.arg == 2 and inj.count == 3
 
 
+def test_parse_write_path_fault_kinds():
+    """The round-13 durable-store matrix kinds: all placed at the
+    chunk writer's append seam (or the marker seam), epoch aliasing
+    onto chunk like the read-side grammar."""
+    injs = chaos.parse_spec(
+        "torn-write@append:4, bitflip@chunk:2, index-truncate@epoch:1,"
+        "sigkill@append:3, partial-rename@marker"
+    )
+    assert [i.kind for i in injs] == [
+        "torn-write", "bitflip", "index-truncate", "sigkill",
+        "partial-rename",
+    ]
+    for i in injs[:4]:
+        assert "append" in chaos._KIND_SITES[i.kind]
+    assert injs[0].trigger == "append" and injs[0].arg == 4
+    assert injs[1].trigger == "chunk" and injs[1].arg == 2
+    assert injs[2].trigger == "chunk" and injs[2].arg == 1  # epoch alias
+    # partial-rename@marker is the documented NO-ARG form: any marker
+    # write matches (there is normally exactly one)
+    assert injs[4].trigger == "marker" and injs[4].arg is chaos.ANY
+    assert injs[4].describe() == "partial-rename@marker"
+    # the substring form names a specific marker
+    (named,) = chaos.parse_spec("partial-rename@marker:clean")
+    assert named.arg == "clean"
+
+
+def test_write_path_malformed_specs_fail_loudly():
+    # the no-arg sugar belongs to partial-rename@marker ONLY — a bare
+    # trigger on any other kind is still the silently-misplaced shape
+    with pytest.raises(ValueError, match="empty trigger or arg"):
+        chaos.parse_spec("torn-write@append")
+    with pytest.raises(ValueError, match="needs a @trigger"):
+        chaos.parse_spec("bitflip")
+
+
+def test_write_fault_matches_and_spends(monkeypatch):
+    """write_fault mirrors fire()'s matching at the append site but
+    RETURNS the kind (the writer owns the disk mutation): append-order
+    and chunk-number triggers both place, exactly once each."""
+    _arm(monkeypatch, "torn-write@append:1, bitflip@chunk:7")
+    assert chaos.write_fault(chunk=0) is None          # append seq 0
+    assert chaos.write_fault(chunk=0) == "torn-write"  # append seq 1
+    assert chaos.write_fault(chunk=0) is None          # spent
+    assert chaos.write_fault(chunk=7) == "bitflip"     # chunk trigger
+    assert chaos.write_fault(chunk=7) is None          # spent
+    assert chaos.plan().fired() == [
+        "torn-write@append:1", "bitflip@chunk:7",
+    ]
+
+
+def test_partial_rename_fires_only_at_marker_seam(monkeypatch):
+    _arm(monkeypatch, "partial-rename@marker")
+    chaos.fire("dispatch")  # other seams never detonate it
+    assert chaos.write_fault(chunk=0) is None
+    with pytest.raises(chaos.PartialRenameChaos):
+        chaos.fire("marker", marker="clean")
+    chaos.fire("marker", marker="clean")  # spent: a retry succeeds
+
+
 def test_probe_timeout_rejects_trigger_clause():
     """probe_timeout_pending spends injections in list order, so a
     trigger clause would be silently unhonored — the parser refuses it
     (list the fault N times to kill N attempts instead)."""
     with pytest.raises(ValueError, match="probe-timeout takes no"):
         chaos.parse_spec("probe-timeout@attempt:2")
+
+
+def test_unsatisfiable_trigger_fails_loudly():
+    """A trigger key no seam of the fault's kind ever provides would
+    arm and then silently never fire — the fake-green matrix the
+    fail-loud rule forbids; refused at parse time instead."""
+    with pytest.raises(ValueError, match="can never fire"):
+        chaos.parse_spec("torn-write@marker:1")
+    with pytest.raises(ValueError, match="can never fire"):
+        chaos.parse_spec("partial-rename@chunk:0")
+    with pytest.raises(ValueError, match="can never fire"):
+        chaos.parse_spec("sigkill@marker:0")
+    with pytest.raises(ValueError, match="can never fire"):
+        chaos.parse_spec("chunk-corrupt@window:1")
+    # every documented placement still parses
+    for ok in ("torn-write@append:3", "bitflip@chunk:2", "sigkill@window:2",
+               "sigkill@append:15", "index-truncate@epoch:1",
+               "partial-rename@marker", "chunk-corrupt@epoch:1",
+               "device-error@stage:finish", "device-error@shard:0",
+               "compile-stall@window:1", "aot-reject@stage:aggregate"):
+        assert chaos.parse_spec(ok)
 
 
 def test_malformed_specs_fail_loudly():

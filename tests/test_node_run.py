@@ -44,6 +44,22 @@ def test_lock_excludes_second_node(tmp_path, setup):
     n2.shutdown()
 
 
+def test_double_shutdown_is_noop(tmp_path, setup):
+    """shutdown() rides StoreGuard.close — idempotent: a second call
+    must not re-run the marker write (or error), and the clean marker
+    survives."""
+    pool, ext, genesis = setup
+    n = node_run.start_node("n", str(tmp_path), ext, genesis, k=3)
+    n.shutdown()
+    assert node_run.was_clean_shutdown(str(tmp_path))
+    n.shutdown()
+    assert node_run.was_clean_shutdown(str(tmp_path))
+    # and the lock is free for the next node
+    n2 = node_run.start_node("n2", str(tmp_path), ext, genesis, k=3)
+    assert not n2.crashed_last_run
+    n2.shutdown()
+
+
 def test_marker_mismatch(tmp_path, setup):
     pool, ext, genesis = setup
     n = node_run.start_node("n", str(tmp_path), ext, genesis, k=3, network_magic=1)

@@ -1,0 +1,875 @@
+"""The durable-store robustness plane (PR 13): crash-consistent open
+with truncate-and-repair, the lock/marker/clean-shutdown protocol, and
+the torn-write fault matrix.
+
+The headline differential: every seeded corruption — torn write, chunk
+bitflip, index truncation, partial marker rename, stale lock, wrong
+magic, dirty shutdown — either repairs to a replay verdict- and
+nonce-carry-identical to the uninterrupted pristine-prefix run, or
+refuses with a classified reason. Never a crash, hang, or silently
+wrong verdict; repair actions visible as `oct_repair_total` + warmup
+`repairs` rows; and a REAL SIGKILL'd writer child reopens dirty,
+deep-validates, repairs, and RESUMES to the byte-identical chain."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu import obs
+from ouroboros_consensus_tpu.node import exit as node_exit
+from ouroboros_consensus_tpu.obs import recovery
+from ouroboros_consensus_tpu.obs.warmup import WARMUP
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.storage import guard as sg
+from ouroboros_consensus_tpu.storage.immutable import ImmutableDB
+from ouroboros_consensus_tpu.testing import chaos, fixtures
+from ouroboros_consensus_tpu.tools import db_analyser as ana
+from ouroboros_consensus_tpu.tools import db_synthesizer as synth
+from ouroboros_consensus_tpu.tools import db_truncater as trunc
+from ouroboros_consensus_tpu.utils.fs import MockFS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    WARMUP.reset()
+    obs.reset_for_tests()
+    recovery.reset_for_tests()
+    for var in ("OCT_CHAOS", "OCT_CHAOS_SEED", "OCT_CHECKPOINT",
+                "OCT_RESUME", "OCT_RECOVERY", "OCT_TRACE"):
+        monkeypatch.delenv(var, raising=False)
+    chaos.reset()
+    yield
+    WARMUP.reset()
+    obs.reset_for_tests()
+    recovery.reset_for_tests()
+    chaos.reset()
+
+
+def _params():
+    # small epochs, chunk_size == epoch_length: several chunks so the
+    # chunk-addressed faults and stranded-chunk drops have targets
+    return praos.PraosParams(
+        slots_per_kes_period=100,
+        max_kes_evolutions=62,
+        security_param=4,
+        active_slot_coeff=Fraction(1, 2),
+        epoch_length=60,
+        kes_depth=3,
+    )
+
+
+PARAMS = _params()
+POOL = fixtures.make_pool(11, kes_depth=3)
+LVIEW = fixtures.make_ledger_view([POOL])
+N_BLOCKS = 40
+
+
+def _synthesize(path, fault: str | None = None):
+    """Forge the deterministic 40-block chain; with `fault`, arm the
+    chaos spec for the duration and report how the writer died (None =
+    it survived — silent faults like bitflip)."""
+    shutil.rmtree(path, ignore_errors=True)
+    died = None
+    if fault:
+        os.environ["OCT_CHAOS"] = fault
+        chaos.reset()
+    try:
+        synth.synthesize(path, PARAMS, [POOL], LVIEW,
+                         synth.ForgeLimit(blocks=N_BLOCKS),
+                         chunk_size=PARAMS.epoch_length)
+    except chaos.ChaosError as e:
+        died = e
+    finally:
+        if fault:
+            os.environ.pop("OCT_CHAOS", None)
+            chaos.reset()
+    return died
+
+
+@pytest.fixture(scope="module")
+def pristine(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("repair") / "pristine")
+    assert _synthesize(path) is None
+    return path
+
+
+def _reval(path, **kw):
+    kw.setdefault("backend", "host")
+    kw.setdefault("validate_all", False)
+    return ana.revalidate(path, PARAMS, LVIEW, **kw)
+
+
+@pytest.fixture(scope="module")
+def pristine_states(pristine):
+    """final PraosState of the uninterrupted replay at every prefix
+    length — the matrix compares each repaired store's replay against
+    the pristine prefix of the SAME length."""
+    states = {0: praos.PraosState()}
+    st = praos.PraosState()
+    res = ana.ValidationResult()
+    i = 0
+    imm = ana.open_immutable(pristine)
+    for hv in ana._stream_views(imm, res):
+        ticked = praos.tick(PARAMS, LVIEW, hv.slot, st)
+        st = praos.update(PARAMS, hv, hv.slot, ticked)
+        i += 1
+        states[i] = st
+    assert i == N_BLOCKS
+    return states
+
+
+# ---------------------------------------------------------------------------
+# protocol units: stale lock, live lock, wrong magic, markers
+# ---------------------------------------------------------------------------
+
+
+def test_live_lock_refuses_stale_lock_acquires(tmp_path):
+    db = str(tmp_path / "db")
+    os.makedirs(db)
+    a = sg.DbLockFile(db)
+    a.acquire()
+    # a LIVE holder (separate open file description, same rules as a
+    # second process) refuses loudly
+    b = sg.DbLockFile(db)
+    with pytest.raises(sg.DbLocked):
+        b.acquire()
+    a.release()
+    # the lock FILE is still on disk — stale. flock semantics: a dead
+    # holder's lock is gone, the stale file must NOT wedge the restart
+    assert os.path.exists(os.path.join(db, sg.DB_LOCK))
+    b.acquire()
+    b.release()
+
+
+def test_mockfs_crash_releases_lock():
+    fs = MockFS()
+    fs.makedirs("db")
+    a = sg.DbLockFile("db", fs=fs)
+    a.acquire()
+    with pytest.raises(sg.DbLocked):
+        sg.DbLockFile("db", fs=fs).acquire()
+    fs.crash(0.0)  # every holder died
+    sg.DbLockFile("db", fs=fs).acquire()
+
+
+def test_concurrent_revalidate_refuses_loudly(pristine):
+    g = sg.StoreGuard(pristine, writer=False).open()
+    try:
+        with pytest.raises(sg.DbLocked):
+            _reval(pristine)
+    finally:
+        g.close()
+    # and the refusal is classified REFUSE — never laundered through
+    # the recovery ladder
+    assert node_exit.triage(sg.DbLocked("x")) is node_exit.Disposition.REFUSE
+    assert not recovery.recoverable(sg.DbLocked("x"))
+
+
+def test_wrong_magic_refuses_loudly(pristine):
+    assert sg.read_db_marker(pristine) == sg.DEFAULT_MAGIC
+    with pytest.raises(sg.DbMarkerMismatch):
+        _reval(pristine, network_magic=999)
+    assert (node_exit.triage(sg.DbMarkerMismatch("x"))
+            is node_exit.Disposition.REFUSE)
+    assert not recovery.recoverable(sg.DbMarkerMismatch("x"))
+    # the right magic (and the default-accepting None) both open
+    assert _reval(pristine, network_magic=sg.DEFAULT_MAGIC).error is None
+
+
+def test_triage_dispositions():
+    from ouroboros_consensus_tpu.storage.immutable import ImmutableDBError
+
+    D = node_exit.Disposition
+    assert node_exit.triage(ImmutableDBError("corrupt")) is D.REPAIR
+    assert not recovery.recoverable(ImmutableDBError("corrupt"))
+    assert node_exit.triage(chaos.ChunkChaosError("io")) is D.RECOVER
+    assert node_exit.triage(OSError("io")) is D.RECOVER
+    assert node_exit.triage(TypeError("bug")) is D.PROPAGATE
+    assert node_exit.to_exit_reason(sg.DbLocked("x")).name == "CONFIG_ERROR"
+    assert node_exit.to_exit_reason(
+        ImmutableDBError("x")).name == "DB_CORRUPTION"
+
+
+def test_dirty_shutdown_escalates_and_heals(pristine_states, tmp_path):
+    """A missing clean-shutdown marker escalates the validation policy
+    to all-chunks + repair; the replay matches, and the orderly close
+    writes the marker back — the NEXT open is clean again."""
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    sg.clear_clean_marker(db)
+    r = _reval(db)
+    assert r.opened_dirty and r.error is None and r.n_valid == N_BLOCKS
+    assert r.repairs == {"dirty-open-escalated": 1}
+    assert r.final_state == pristine_states[N_BLOCKS]
+    assert sg.was_clean_shutdown(db)
+    r2 = _reval(db)
+    assert not r2.opened_dirty and r2.repairs is None
+
+
+def test_dirty_escalation_never_stamps_assumed_magic(tmp_path):
+    """A magic-agnostic open of an existing marker-less store that
+    escalates to writer (dirty open) must NOT create the default
+    marker — the store's true chain is unknown, and stamping mainnet
+    would refuse its real magic forever."""
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    os.remove(os.path.join(db, sg.DB_MARKER))
+    sg.clear_clean_marker(db)
+    r = _reval(db)  # network_magic=None, dirty -> promoted to writer
+    assert r.opened_dirty and r.error is None and r.n_valid == N_BLOCKS
+    assert sg.read_db_marker(db) is None  # never branded
+    # an explicit magic on a marker-less store MAY stamp (the caller
+    # knows its chain): the writer path with a known magic
+    _reval(db, validate_all=True, network_magic=7)
+    assert sg.read_db_marker(db) == 7
+
+
+def test_readonly_scan_of_virgin_path_is_side_effect_free(tmp_path):
+    """A read-only analysis of an empty/typo'd db path must not create
+    `immutable/` — that side effect would make the NEXT open see a
+    marker-less non-first run and misclassify the untouched store as
+    dirty (then stamp markers on a store nobody ever wrote)."""
+    db = str(tmp_path / "virgin")
+    os.makedirs(db)
+    r1 = _reval(db)
+    assert r1.n_valid == 0 and not r1.opened_dirty
+    assert not os.path.exists(os.path.join(db, "immutable"))
+    assert sg.read_db_marker(db) is None
+    r2 = _reval(db)
+    assert not r2.opened_dirty and r2.repairs is None
+
+
+def test_capped_dirty_replay_stays_dirty(tmp_path):
+    """A max_headers-capped stream replay of a DIRTY store validated
+    only the chunks behind the cap — it must NOT stamp the clean
+    marker (the escalation promised ALL chunks; bench's probe prefix
+    proving a store clean would let silent rot past the cap ride every
+    later shallow open). The next UNCAPPED open still revalidates,
+    repairs, and only THEN heals the marker."""
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    sg.clear_clean_marker(db)
+    r = _reval(db, validate_all="stream", max_headers=8)
+    assert r.opened_dirty and r.error is None
+    assert r.n_valid == 8  # the capped prefix only
+    assert not sg.was_clean_shutdown(db)  # still dirty
+    r2 = _reval(db, validate_all="stream")
+    assert r2.opened_dirty and r2.n_valid == N_BLOCKS
+    assert sg.was_clean_shutdown(db)  # the full walk heals
+
+
+def test_error_aborted_dirty_stream_stays_dirty(tmp_path):
+    """An uncapped stream replay of a DIRTY store that ABORTED at a
+    validation error proved nothing about the chunks past the error —
+    it must NOT stamp the clean marker (regression: any uncapped
+    stream stamped it, so a torn tail past a protocol-invalid header
+    would ride every later shallow open)."""
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    sg.clear_clean_marker(db)
+    # a ledger view with the wrong pool set fails validation at the
+    # first header: the stream never consumes the chunks behind it
+    wrong = fixtures.make_ledger_view([fixtures.make_pool(99,
+                                                          kes_depth=3)])
+    r = ana.revalidate(db, PARAMS, wrong, backend="host",
+                       validate_all="stream")
+    assert r.opened_dirty and r.error is not None
+    assert not sg.was_clean_shutdown(db)  # still dirty
+    # the right view walks the whole chain and heals honestly
+    r2 = _reval(db, validate_all="stream")
+    assert r2.opened_dirty and r2.error is None
+    assert sg.was_clean_shutdown(db)
+
+
+# ---------------------------------------------------------------------------
+# open-with-repair: quarantine, events, metric, dry-run
+# ---------------------------------------------------------------------------
+
+
+def _corrupt_tail(db, chunk=0, garbage=b"\x81\x18garbage-tail"):
+    """Append unparseable garbage past the indexed end of a chunk (the
+    classic torn-append shape, applied from outside)."""
+    p = os.path.join(db, "immutable", f"{chunk:05d}.chunk")
+    with open(p, "ab") as f:
+        f.write(garbage)
+    return len(garbage)
+
+
+def test_open_with_repair_quarantines_and_counts(tmp_path):
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    n_garbage = _corrupt_tail(db, chunk=0)
+    rec = obs.install()
+    try:
+        r = _reval(db, validate_all=True)
+    finally:
+        obs.uninstall()
+    # the chunk-0 tail was cut, chunk 1 is now stranded (chain gap) and
+    # dropped; everything snipped is QUARANTINED, not deleted
+    assert r.error is None
+    assert r.repairs["rebuild-index"] == 1  # index lagged the garbage
+    assert r.repairs["truncate-chunk"] == 1
+    qdir = os.path.join(db, "immutable", "quarantine")
+    qfiles = os.listdir(qdir)
+    assert any(f.startswith("00000.chunk.tail") for f in qfiles)
+    qbytes = sum(
+        os.path.getsize(os.path.join(qdir, f)) for f in qfiles
+    )
+    assert qbytes >= n_garbage
+    # visible as oct_repair_total{action=} through the flight recorder
+    fam = rec.registry.snapshot()["oct_repair_total"]
+    by_action = {s["labels"]["action"]: s["value"]
+                 for s in fam["samples"]}
+    assert by_action.get("truncate-chunk", 0) >= 1
+    assert by_action.get("rebuild-index", 0) >= 1
+    # and as warmup `repairs` rows (the round-JSON / ledger story)
+    rows = WARMUP.report()["repairs"]
+    assert {row["action"] for row in rows} >= {
+        "truncate-chunk", "rebuild-index",
+    }
+    assert all(row["applied"] for row in rows)
+
+
+def test_unwritable_quarantine_refuses_repair(tmp_path):
+    """Quarantine-never-delete is a REFUSAL, not best-effort: when the
+    quarantine copy cannot be written (ENOSPC / unwritable dir — disk
+    pressure is exactly when stores corrupt), the repair aborts with a
+    classified `QuarantineError` BEFORE any destructive mutation, and
+    the corrupt bytes stay on disk for the operator."""
+    from ouroboros_consensus_tpu.storage.repair import QuarantineError
+
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    _corrupt_tail(db, chunk=0)
+    imm_dir = os.path.join(db, "immutable")
+    qdir = os.path.join(imm_dir, "quarantine")
+    # a FILE where the quarantine dir must go: makedirs fails -> store
+    # raises; cross-platform stand-in for an unwritable filesystem
+    with open(qdir, "wb") as f:
+        f.write(b"not a directory")
+    before = {f: os.path.getsize(os.path.join(imm_dir, f))
+              for f in os.listdir(imm_dir)}
+    with pytest.raises(QuarantineError):
+        _reval(db, validate_all=True)
+    after = {f: os.path.getsize(os.path.join(imm_dir, f))
+             for f in os.listdir(imm_dir)}
+    assert after == before  # nothing destroyed, nothing truncated
+    # classified REFUSE — never absorbed by the recovery ladder
+    assert (node_exit.triage(QuarantineError("x"))
+            is node_exit.Disposition.REFUSE)
+    assert not recovery.recoverable(QuarantineError("x"))
+    os.remove(qdir)
+    r = _reval(db, validate_all=True)  # writable again: repair runs
+    assert r.error is None and r.repairs["truncate-chunk"] == 1
+
+
+def test_stranded_drop_reports_real_block_counts(tmp_path):
+    """A chunk dropped before its entries were ever loaded (stranded
+    past a truncation) reports the block count from its on-disk index
+    — an operator triaging a drop-chunk row sees the real data loss,
+    not 0."""
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    imm_dir = os.path.join(db, "immutable")
+    # wholly corrupt chunk 0: unparseable bytes, index gone — the
+    # reparse truncates it to empty and strands chunk 1
+    with open(os.path.join(imm_dir, "00000.chunk"), "wb") as f:
+        f.write(b"\xff" * 128)
+    os.remove(os.path.join(imm_dir, "00000.index"))
+    r = _reval(db, validate_all=True)
+    assert r.error is None and r.n_valid == 0
+    rows = [row for row in WARMUP.report()["repairs"]
+            if row["action"] == "drop-chunk"]
+    (row,) = rows
+    assert row["chunk"] == 1
+    assert row["dropped"] > 0  # from the on-disk index, never silent 0
+    assert row["bytes_quarantined"] > 0
+
+
+def test_dry_run_scan_touches_nothing(tmp_path):
+    """ImmutableDB(repair=False): the identical scan computes every
+    action in memory (applied=False) and the disk — markers included —
+    stays byte-identical."""
+    from ouroboros_consensus_tpu.storage.open import (
+        default_check_integrity, default_check_integrity_batch,
+    )
+
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    _corrupt_tail(db, chunk=0)
+    imm_dir = os.path.join(db, "immutable")
+
+    def snap():
+        return {f: open(os.path.join(imm_dir, f), "rb").read()
+                for f in sorted(os.listdir(imm_dir))
+                if os.path.isfile(os.path.join(imm_dir, f))}
+
+    before = snap()
+    imm = ImmutableDB(
+        imm_dir, check_integrity=default_check_integrity,
+        validate_all=True,
+        check_integrity_batch=default_check_integrity_batch,
+        repair=False,
+    )
+    assert snap() == before  # byte-untouched
+    assert not os.path.exists(os.path.join(imm_dir, "quarantine"))
+    actions = {row["action"] for row in imm.repairs}
+    assert "truncate-chunk" in actions
+    assert all(not row["applied"] for row in imm.repairs)
+    # the in-memory view still reflects the truncation it computed
+    assert imm.n_blocks() < N_BLOCKS
+
+
+# ---------------------------------------------------------------------------
+# db_truncater: the repair CLI
+# ---------------------------------------------------------------------------
+
+
+def test_truncater_to_last_valid_dry_run_then_repair(tmp_path, capsys):
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    base = _reval(db, validate_all=True)
+    assert base.error is None and base.n_valid == N_BLOCKS
+    _corrupt_tail(db, chunk=1)  # tail of the LAST chunk: no stranding
+    sizes_corrupted = _corrupted_sizes(db)
+
+    trunc.main(["--db", db, "--to-last-valid", "--dry-run"])
+    out1 = capsys.readouterr().out
+    rep = json.loads(out1.splitlines()[0])
+    assert not rep["applied"] and rep["actions"].get("truncate-chunk")
+    assert "would repair" in out1
+    # dry-run left the garbage in place
+    assert _corrupted_sizes(db) == sizes_corrupted
+    assert not os.path.exists(os.path.join(db, "immutable", "quarantine"))
+
+    qdir = str(tmp_path / "jail")
+    trunc.main(["--db", db, "--to-last-valid", "--quarantine-dir", qdir])
+    out2 = capsys.readouterr().out
+    rep = json.loads(out2.splitlines()[0])
+    assert rep["applied"] and rep["actions"]["truncate-chunk"] == 1
+    assert rep["blocks"] == N_BLOCKS
+    assert os.listdir(qdir)  # the --quarantine-dir flag was honored
+    # the repaired store replays clean and verdict-identical
+    r = _reval(db, validate_all=True)
+    assert r.error is None and r.n_valid == N_BLOCKS
+    assert r.final_state == base.final_state
+
+
+def test_truncater_refuses_virgin_path(tmp_path):
+    """--to-last-valid / slot truncate of a nonexistent (typo'd) --db
+    refuses loudly BEFORE any side effect — a writer-mode open would
+    otherwise fabricate a clean default-magic store there and report
+    the 'repair' a success."""
+    missing = str(tmp_path / "typo")
+    with pytest.raises(FileNotFoundError):
+        trunc.repair(missing)
+    with pytest.raises(FileNotFoundError):
+        trunc.truncate(missing, 30)
+    with pytest.raises(FileNotFoundError):
+        _reval(missing, validate_all=True)  # writer-mode analyser too
+    with pytest.raises(FileNotFoundError):
+        _reval(missing, repair=True)
+    assert not os.path.exists(missing)  # nothing fabricated
+
+
+def _corrupted_sizes(db):
+    d = os.path.join(db, "immutable")
+    return {f: os.path.getsize(os.path.join(d, f))
+            for f in sorted(os.listdir(d))
+            if os.path.isfile(os.path.join(d, f))}
+
+
+def test_truncate_after_slot_mode_unchanged(tmp_path, capsys):
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    trunc.main(["--db", db, "--truncate-after-slot", "30"])
+    out = capsys.readouterr().out
+    assert "truncated;" in out
+    r = _reval(db, validate_all=True)
+    assert r.error is None and 0 < r.n_valid < N_BLOCKS
+
+
+# ---------------------------------------------------------------------------
+# db_analyser --repair: stream-mode write-back
+# ---------------------------------------------------------------------------
+
+
+def test_stream_repair_writeback_differential(pristine_states, tmp_path):
+    """Read-only stream mode truncates the VERDICT only; with
+    repair=True the same truncation lands on disk (quarantined), and
+    both replays are verdict-identical to the pristine prefix."""
+    db = str(tmp_path / "db")
+    died = _synthesize(db, "bitflip@append:20")
+    assert died is None  # silent rot: the writer never knew
+    assert sg.was_clean_shutdown(db)
+
+    sizes_before = _corrupted_sizes(db)
+    r1 = _reval(db, validate_all="stream")
+    assert r1.error is None and r1.n_valid == 20
+    assert r1.final_state == pristine_states[20]
+    assert r1.repairs is None  # read-only analysis
+    assert _corrupted_sizes(db) == sizes_before  # disk untouched
+
+    r2 = _reval(db, validate_all="stream", repair=True)
+    assert r2.error is None and r2.n_valid == 20
+    assert r2.final_state == pristine_states[20]
+    assert r2.repairs and r2.repairs.get("truncate-chunk") == 1
+    assert os.listdir(os.path.join(db, "immutable", "quarantine"))
+
+    # the repaired store now passes a FULL deep open clean
+    r3 = _reval(db, validate_all=True)
+    assert r3.error is None and r3.n_valid == 20
+    assert r3.repairs is None
+    assert r3.final_state == pristine_states[20]
+
+
+# ---------------------------------------------------------------------------
+# the corruption matrix (tier-1: bounded fault x policy grid)
+# ---------------------------------------------------------------------------
+
+# (fault spec, policies) — whether the writer survives and how many
+# blocks must survive repair is derived, not hard-coded: the pristine
+# replay of the SAME prefix is the oracle. bitflip is placed by append
+# order (mid-chain) so every policy that deep-checks catches it; under
+# the shallow policy it is placed in the LAST chunk, which even a
+# most-recent-chunk open CRC-walks.
+_MATRIX = [
+    ("torn-write@append:10", [False, True, "stream"]),
+    ("index-truncate@epoch:1", [False, "stream"]),
+    ("bitflip@append:20", [True, "stream"]),
+    ("partial-rename@marker", [False, "stream"]),
+    ("sigkill@append:15", [False]),
+]
+
+
+def _matrix_cell(tmp_path, pristine_states, fault, policy):
+    db = str(tmp_path / "db")
+    if fault.startswith("sigkill"):
+        # a REAL kill needs a child process (below); in-process matrix
+        # cells arm the raise/rot faults only
+        _writer_child(db, fault)
+    else:
+        _synthesize(db, fault)
+    r = _reval(db, validate_all=policy)
+    assert r.error is None, (fault, policy, r.error)
+    # the repaired store's replay IS the pristine prefix: same verdict
+    # count, same nonce carry, same counters
+    assert r.final_state == pristine_states[r.n_valid], (fault, policy)
+    # dirty-open escalation fired for every fault that killed a writer
+    if fault.split("@")[0] in ("torn-write", "index-truncate",
+                               "partial-rename", "sigkill"):
+        assert r.opened_dirty, (fault, policy)
+        assert r.repairs.get("dirty-open-escalated") == 1
+        # ...and the store healed: the NEXT open is clean and equal
+        r2 = _reval(db, validate_all=policy)
+        assert not r2.opened_dirty
+        assert r2.error is None and r2.n_valid == r.n_valid
+        assert r2.final_state == r.final_state
+    return r
+
+
+@pytest.mark.parametrize("fault,policy", [
+    (f, p) for f, policies in _MATRIX for p in policies[:1]
+])
+def test_corruption_matrix_tier1(tmp_path, pristine_states, fault, policy):
+    """One policy per fault kind rides tier-1; the full grid is the
+    slow-tier sweep below."""
+    _matrix_cell(tmp_path, pristine_states, fault, policy)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fault,policy", [
+    (f, p) for f, policies in _MATRIX for p in policies[1:]
+])
+def test_corruption_matrix_deep_sweep(tmp_path, pristine_states, fault,
+                                      policy):
+    _matrix_cell(tmp_path, pristine_states, fault, policy)
+
+
+def test_bitflip_last_chunk_caught_even_shallow(tmp_path, pristine_states):
+    """The most-recent-chunk policy always CRC-walks the last chunk:
+    silent rot there is caught on a plain open even after a clean
+    shutdown. The shallow open is a READER: the truncation is computed
+    in memory (applied=False forensics, verdict still the pristine
+    prefix) and the disk stays byte-untouched until an explicit repair
+    lever. (Rot in OLDER chunks under the shallow policy is the
+    documented trust trade-off — COVERAGE.md §5.17.)"""
+    db = str(tmp_path / "db")
+    assert _synthesize(db, "bitflip@append:35") is None
+    assert sg.was_clean_shutdown(db)
+    sizes = _corrupted_sizes(db)
+    r = _reval(db, validate_all=False)
+    assert r.error is None and r.n_valid == 35
+    assert r.final_state == pristine_states[35]
+    assert r.repairs is None  # a reader APPLIES nothing...
+    assert _corrupted_sizes(db) == sizes  # ...and writes nothing
+    rows = [row for row in WARMUP.report()["repairs"]
+            if row["action"] == "truncate-chunk"]
+    assert rows and not rows[0]["applied"]  # the would-repair is banked
+    # the deep (writer) open DOES land it on disk
+    r2 = _reval(db, validate_all=True)
+    assert r2.repairs and r2.repairs.get("truncate-chunk") == 1
+    assert r2.final_state == pristine_states[35]
+
+
+# ---------------------------------------------------------------------------
+# the real thing: a SIGKILL'd WRITER child reopens dirty, repairs, resumes
+# ---------------------------------------------------------------------------
+
+_WRITER_CHILD = r"""
+import os, sys
+sys.path.insert(0, os.environ["OCT_REPO"])
+from fractions import Fraction
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.testing import fixtures
+from ouroboros_consensus_tpu.tools import db_synthesizer as synth
+
+params = praos.PraosParams(
+    slots_per_kes_period=100, max_kes_evolutions=62, security_param=4,
+    active_slot_coeff=Fraction(1, 2), epoch_length=60, kes_depth=3,
+)
+pool = fixtures.make_pool(11, kes_depth=3)
+lv = fixtures.make_ledger_view([pool])
+synth.synthesize(os.environ["OCT_TEST_DB"], params, [pool], lv,
+                 synth.ForgeLimit(blocks=40), chunk_size=60,
+                 resume=os.environ.get("OCT_TEST_RESUME") == "1")
+"""
+
+
+def _writer_child(db, fault=None, resume=False):
+    env = dict(os.environ)
+    env.pop("OCT_CHAOS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "OCT_REPO": REPO,
+        "OCT_TEST_DB": db,
+        "OCT_TEST_RESUME": "1" if resume else "0",
+    })
+    if fault:
+        env["OCT_CHAOS"] = fault
+    proc = subprocess.run([sys.executable, "-c", _WRITER_CHILD], env=env,
+                          cwd=REPO, capture_output=True, timeout=300)
+    if fault and fault.startswith("sigkill"):
+        assert proc.returncode == -signal.SIGKILL, (
+            proc.returncode, proc.stderr.decode()[-2000:]
+        )
+    else:
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    return proc
+
+
+def test_sigkilled_writer_reopens_dirty_repairs_resumes(
+        pristine, pristine_states, tmp_path):
+    """The acceptance headline: a REAL SIGKILL between a block's chunk
+    append and its index append (rc=-9). The store reopens DIRTY,
+    deep-validates, repairs the lagging index ON DISK, replays
+    verdict-identical to the pristine prefix — and the resumed WRITER
+    converges on the byte-identical full chain."""
+    db = str(tmp_path / "db")
+    _writer_child(db, "sigkill@append:15")
+    assert not sg.was_clean_shutdown(db)  # died mid-forge: dirty
+
+    # reopen: dirty -> all-chunks escalation -> index rebuilt from
+    # chunk bytes (the 16th block's entry never hit the index)
+    r = _reval(db)
+    assert r.opened_dirty and r.error is None
+    assert r.n_valid == 16  # the killed append's block was recovered
+    assert r.repairs.get("dirty-open-escalated") == 1
+    assert r.repairs.get("rebuild-index", 0) >= 1
+    assert r.final_state == pristine_states[16]
+    assert sg.was_clean_shutdown(db)  # healed
+
+    # the writer RESUMES: deterministic forging converges on the
+    # uninterrupted chain, byte for byte
+    _writer_child(db, resume=True)
+    r2 = _reval(db, validate_all=True)
+    ref = _reval(pristine, validate_all=True)
+    assert r2.error is None and r2.n_valid == N_BLOCKS
+    assert r2.final_state == ref.final_state
+    t_res = ana.open_immutable(db).tip()
+    t_ref = ana.open_immutable(pristine).tip()
+    assert (t_res.slot, t_res.hash_) == (t_ref.slot, t_ref.hash_)
+
+
+def test_resume_refused_without_flag(tmp_path):
+    """The refusal is SIDE-EFFECT-FREE: an operator mistake may not
+    dirty (or re-stamp) a healthy store."""
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    with pytest.raises(RuntimeError, match="non-empty DB"):
+        synth.synthesize(db, PARAMS, [POOL], LVIEW,
+                         synth.ForgeLimit(blocks=N_BLOCKS),
+                         chunk_size=PARAMS.epoch_length)
+    assert sg.was_clean_shutdown(db)  # still clean
+    r = _reval(db)
+    assert not r.opened_dirty and r.error is None
+
+
+def test_refusal_probe_read_only_on_dirty_store(tmp_path):
+    """The non-empty refusal on a DIRTY store (crashed writer, torn
+    tail still on disk) must not repair under the reader guard: the
+    probe open is repair=False, so the disk stays byte-identical and
+    the store stays dirty for the next legitimate (resume / analyser)
+    open to heal."""
+    db = str(tmp_path / "db")
+    died = _synthesize(db, fault="torn-write@append:15")
+    assert died is not None and not sg.was_clean_shutdown(db)
+    sizes = _corrupted_sizes(db)
+    with pytest.raises(RuntimeError, match="non-empty DB"):
+        synth.synthesize(db, PARAMS, [POOL], LVIEW,
+                         synth.ForgeLimit(blocks=N_BLOCKS),
+                         chunk_size=PARAMS.epoch_length)
+    assert _corrupted_sizes(db) == sizes  # disk byte-untouched
+    assert not sg.was_clean_shutdown(db)  # still dirty
+    assert not os.path.exists(os.path.join(db, "immutable", "quarantine"))
+
+
+def test_unparseable_marker_refuses_loudly(tmp_path):
+    """A protocolMagicId that EXISTS but does not parse is corruption,
+    not 'missing': every open refuses with a classified
+    DbMarkerMismatch — a writer may not re-stamp (and a reader may not
+    silently accept) a store whose chain identity is unknown."""
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    with open(os.path.join(db, sg.DB_MARKER), "wb") as f:
+        f.write(b"not-a-magic\n")
+    with pytest.raises(sg.DbMarkerMismatch):
+        sg.read_db_marker(db)
+    with pytest.raises(sg.DbMarkerMismatch):
+        _reval(db)  # reader, no magic requested: still refuses
+    with pytest.raises(sg.DbMarkerMismatch):
+        _reval(db, network_magic=sg.DEFAULT_MAGIC)
+    with pytest.raises(sg.DbMarkerMismatch):
+        sg.StoreGuard(db, writer=True).open()  # never a raw ValueError
+
+
+def test_truncate_after_slot_speaks_lock_protocol(tmp_path):
+    """The legacy slot-addressed rewind mutates the store, so it holds
+    the writer lock (concurrent open refuses) and leaves the store
+    clean-marked on an orderly finish."""
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    g = sg.StoreGuard(db, writer=False).open()
+    try:
+        with pytest.raises(sg.DbLocked):
+            trunc.truncate(db, 30)
+    finally:
+        g.close()
+    assert 0 < trunc.truncate(db, 30) < N_BLOCKS
+    assert sg.was_clean_shutdown(db)
+
+
+def test_dirty_slot_truncate_runs_full_repair_walk(tmp_path,
+                                                  pristine_states):
+    """Slot-mode truncate of a DIRTY store may not stamp the clean
+    marker after a most-recent-chunk open: rot in an OLDER chunk would
+    then sit under a clean marker and the next open would bank a
+    silently wrong verdict. A dirty open escalates to the full repair
+    walk first (regression: the escalation was missing)."""
+    db = str(tmp_path / "db")
+    assert _synthesize(db, "bitflip@append:5") is None  # rot in chunk 0
+    sg.clear_clean_marker(db)  # ...behind a crashed shutdown
+    n = trunc.truncate(db, 10**9)  # keep-everything rewind
+    assert n == 5  # the walk truncated at the rot, not at the slot
+    assert sg.was_clean_shutdown(db)  # clean is honest: full walk ran
+    r = _reval(db)
+    assert not r.opened_dirty and r.error is None and r.n_valid == 5
+    assert r.final_state == pristine_states[5]
+
+
+def test_reader_open_never_stamps_a_marker(tmp_path):
+    """An open of an existing store WITHOUT a marker must not brand it
+    with an ASSUMED magic — reader or writer (a testnet DB analysed
+    once would otherwise be mainnet forever). Only a caller that KNOWS
+    its chain (explicit network_magic) stamps."""
+    db = str(tmp_path / "db")
+    _synthesize(db)
+    os.remove(os.path.join(db, sg.DB_MARKER))
+    r = _reval(db)  # shallow reader
+    assert r.error is None
+    assert sg.read_db_marker(db) is None  # nothing stamped
+    r = _reval(db, validate_all=True)  # deep = writer, magic-agnostic
+    assert r.error is None
+    assert sg.read_db_marker(db) is None  # STILL nothing stamped
+    r = _reval(db, validate_all=True, network_magic=42)  # known chain
+    assert r.error is None
+    assert sg.read_db_marker(db) == 42
+
+
+# ---------------------------------------------------------------------------
+# lint --changed: storage edits map onto the purity selection
+# ---------------------------------------------------------------------------
+
+
+def test_lint_changed_maps_storage_onto_purity_graphs():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_gate_repair", os.path.join(REPO, "scripts", "lint.py")
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    purity = {"packed_unpack", "verdict_reduce", "spmd_sharded_verify"}
+    assert purity <= set(lint._select_graphs(
+        {"ouroboros_consensus_tpu/storage/immutable.py"}
+    ))
+    assert purity <= set(lint._select_graphs(
+        {"ouroboros_consensus_tpu/storage/guard.py"}
+    ))
+
+
+# ---------------------------------------------------------------------------
+# perf_report: repaired@<action> classification
+# ---------------------------------------------------------------------------
+
+
+def test_perf_report_classifies_repaired_rounds(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_report_repair", os.path.join(REPO, "scripts",
+                                           "perf_report.py")
+    )
+    pr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pr)
+    doc = {
+        "n": 13, "rc": 0,
+        "parsed": {
+            "value": 4100.0, "metric": "1,000,000-header replay",
+            "opened_dirty": True,
+            "warmup_report": {
+                "repairs": [
+                    {"action": "dirty-open-escalated", "applied": True},
+                    {"action": "truncate-chunk", "applied": True},
+                    {"action": "rebuild-index", "applied": False},
+                ],
+            },
+        },
+        "tail": "",
+    }
+    p = tmp_path / "BENCH_r13.json"
+    p.write_text(json.dumps(doc))
+    row = pr.analyze_bench_round(str(p))
+    # dry-run rows never count; the primary action is the most
+    # disk-invasive applied one
+    assert row["repair_actions"] == {
+        "dirty-open-escalated": 1, "truncate-chunk": 1,
+    }
+    assert row["repaired_action"] == "truncate-chunk"
+    assert row["opened_dirty"] is True
+    md = pr.render_markdown({
+        "bench_rounds": [row], "multichip_rounds": [],
+        "ledger": None, "verdicts": [],
+    })
+    assert "repaired@truncate-chunk" in md
+    assert "## Repaired rounds" in md
