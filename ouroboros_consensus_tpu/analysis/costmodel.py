@@ -439,6 +439,7 @@ STAGE_GRAPHS: dict[str, str] = {
     "reduce": "verdict_reduce",
     "reduce_noscan": "verdict_reduce",
     "agg-packed": "aggregate_core",
+    "agg-vrf": "aggregate_vrf_core",
     "xla-packed": "verify_praos_core_bc",
     "xla-fused": "verify_praos_core",
     "xla-fused-bc": "verify_praos_core_bc",
@@ -478,7 +479,8 @@ def stage_graph(stage: str) -> str | None:
 # If a future kernel change makes the structure lane-sensitive, these
 # pins are where it shows up — and choose_rung starts discriminating.
 LADDER_RUNGS = (1024, 2048)
-LADDER_GRAPHS = ("aggregate_core", "verify_praos_core_bc")
+LADDER_GRAPHS = ("aggregate_core", "aggregate_vrf_core",
+                 "verify_praos_core_bc")
 
 
 def ladder_pin_name(graph: str, lanes: int) -> str:

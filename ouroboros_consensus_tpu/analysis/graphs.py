@@ -310,6 +310,28 @@ def _graph_aggregate_core(t=None):
     )
 
 
+def _graph_aggregate_vrf_core(t=None):
+    """The kill-switch (OCT_RLC_ALL=0) aggregated window program
+    (ops/pk/aggregate.aggregate_window_vrf): exact per-lane ed/KES
+    checks + the vrf-only RLC on the unsigned per-group MSM engine.
+    Same 22-column signature as the unified program."""
+    import functools
+
+    from ..ops.pk import aggregate as pk_aggregate
+
+    t = t or _T
+    fn = functools.partial(pk_aggregate.aggregate_window_vrf,
+                           kes_depth=_DEPTH)
+    return fn, (
+        _s(32, t), _s(32, t), _s(32, t), _s(_NB, 128, t), _s(1, t),
+        _s(32, t), _s(1, t), _s(32, t), _s(32, t), _s(32, t),
+        _s(_DEPTH, 32, t), _s(_NB, 128, t), _s(1, t),
+        _s(32, t), _s(32, t), _s(32, t), _s(32, t), _s(32, t),
+        _s(32, t),
+        _s(64, t), _s(32, t), _s(32, t),
+    )
+
+
 def _graph_spmd_local(t=None):
     """The per-shard body of parallel/spmd._sharded_verify: the XLA-twin
     `protocol.batch.verify_praos` plus the verdict collectives, traced
@@ -412,6 +434,7 @@ REGISTRY: dict[str, Callable] = {
     "verify_praos_core_bc": _graph_verify_praos_core_bc,
     "msm": _graph_msm,
     "aggregate_core": _graph_aggregate_core,
+    "aggregate_vrf_core": _graph_aggregate_vrf_core,
     "spmd_sharded_verify": _graph_spmd_local,
     "packed_unpack": _graph_packed_unpack,
     "verdict_reduce": _graph_verdict_reduce,
@@ -454,6 +477,10 @@ GRAPH_SOURCES: dict[str, list[str]] = {
         "ouroboros_consensus_tpu/ops/pk/msm.py",
         "ouroboros_consensus_tpu/ops/pk/aggregate.py",
     ],
+    "aggregate_vrf_core": _PK_COMMON + [
+        "ouroboros_consensus_tpu/ops/pk/msm.py",
+        "ouroboros_consensus_tpu/ops/pk/aggregate.py",
+    ],
     "spmd_sharded_verify": _XLA_TWIN + [
         "ouroboros_consensus_tpu/parallel/spmd.py",
         "ouroboros_consensus_tpu/ops/field.py",
@@ -481,7 +508,8 @@ GRAPH_SOURCES: dict[str, list[str]] = {
 DEFAULT_TILES: dict[str, int] = {
     "ed_core": _T, "kes_core": _T, "vrf_core": _T, "vrf_bc_core": _T,
     "finish_core": _T, "verify_praos_core": _T, "verify_praos_core_bc": _T,
-    "aggregate_core": _T, "msm": 4, "spmd_sharded_verify": 8,
+    "aggregate_core": _T, "aggregate_vrf_core": _T, "msm": 4,
+    "spmd_sharded_verify": 8,
     "packed_unpack": 4, "verdict_reduce": 8,
 }
 
@@ -569,9 +597,31 @@ def check_point_ops(budgets: dict | None = None,
     sec = budgets.get("point_ops", {})
     violations = []
     for name in sorted(sec):
+        cfg = sec[name]
+        if name == "all_stage_total":
+            # Composite pin (round 15): the SUM of per-lane point ops
+            # across every stage executable the unified dispatch path
+            # runs per window (cfg["graphs"]). This is the number the
+            # one-RLC fold is accountable for — before the fold the
+            # per-window total was agg(vrf) + ed + kes ladders
+            # (~1018/lane); folding all four equations into one
+            # shared-bucket MSM takes the whole pipeline under 100.
+            members = list(cfg["graphs"])
+            if names is not None and not set(members) & set(names):
+                continue
+            lanes = int(cfg["at_lanes"])
+            ceiling = float(cfg["lane_ops_per_lane"])
+            total = sum(point_ops(g, lanes)["lane_ops"] / lanes
+                        for g in members)
+            if total > ceiling:
+                violations.append(
+                    f"all_stage_total: {total:.1f} point lane-ops/lane "
+                    f"summed over {'+'.join(members)} at {lanes} lanes "
+                    f"exceeds budget {ceiling:g}"
+                )
+            continue
         if names is not None and name not in names:
             continue
-        cfg = sec[name]
         lanes = int(cfg["at_lanes"])
         ceiling = float(cfg["lane_ops_per_lane"])
         stats = point_ops(name, lanes)

@@ -16,9 +16,20 @@ points, so a window verifies with ONE random linear combination
 
   Σ_i  z1·eq_ed + z2·eq_kes + z3·eq_u + z4·eq_v  =  0
 
-checked by a single Pippenger MSM (ops/pk/msm.py) plus one fixed-base
-mul for the collected B coefficient — replacing every per-lane ladder
-(~320 point-ops/lane/ladder) with ~one bucket add per point per window.
+checked by ONE shared-bucket signed-digit MSM (msm.msm_shared: every
+width group through one bucket machine, balanced base-2^12 digits,
+Abel-summation weighted sums, one shared Horner chain) plus one
+fixed-base mul for the collected B coefficient — replacing every
+per-lane ladder (~320 point-ops/lane/ladder) with ~one bucket add per
+point per window pass. Repeated-key columns (cold keys A_e, OCert
+signatures R_e, KES leaf keys, VRF keys — a Praos window re-uses its
+pools' credentials across many lanes) first collapse into
+fixed-capacity per-distinct-key coefficient tables (`_dedupe_column`),
+so four of the nine per-lane columns cost ≤ 256 bucket entries each
+instead of T. `OCT_RLC_ALL=0` (protocol/batch) swaps in
+`aggregate_window_vrf`: exact per-lane Ed25519/KES ladders with only
+the VRF equations aggregated on the unsigned engine — the isolation
+switch for the shared-bucket machinery.
 
 The per-lane coefficients (z1..z4) are derived by Fiat–Shamir from the
 LANE's own transcript (SHA-512 over its wire bytes and challenge-hash
@@ -46,7 +57,14 @@ offline — so the aggregate is byte-identical to the reference on every
 honestly-signed chain (the replay/bench workload it accelerates), but
 is NOT a cofactor-exact adversarial verifier; `OCT_VRF_AGG=0` selects
 the exact per-lane path where that distinction matters
-(COVERAGE.md records this).
+(COVERAGE.md records this). The odd-forcing covers ALL FOUR lanes —
+z1 (ed), z2 (kes), z3/z4 (vrf) — so the single-lane guarantee holds
+for every folded stage, and key dedupe does not weaken it: grouping
+keys are the raw wire BYTES, so a torsion-offset encoding lands in its
+own table slot with its own (odd) coefficient rather than merging with
+the honest encoding. Colluding lanes that submit byte-identical
+tampered columns only reach the already-documented multi-lane
+Σ z_i·T_i = 0 residual.
 
 All cheap per-lane work stays per-lane: decompressions (now including
 R_e, R_k, U, V — ~4 extra Shanks chains/lane), hash-to-curve, the
@@ -62,15 +80,17 @@ Certification (octrange, analysis/absint.py): the whole window program
 PR 3 fix octrange retroactively proves (262k-lane-term boundary shape
 in analysis/shapes.json). The taint pass marks every verifier input
 `wire:` (public), so the Fiat–Shamir z_i — and therefore the MSM's
-argsort keys — provably carry no secret marks; per-lane point-op
-counts (260/lane at 8192, the 5.35× PR 3 win) are ratcheted in
-budgets.json `point_ops`.
+argsort keys AND the dedupe tables' lexicographic key sorts /
+scatter-adds — provably carry no secret marks; per-lane point-op
+counts (the all-stage total at 8192, vs 1018 for the per-lane ladders)
+are ratcheted in budgets.json `point_ops` (`all_stage_total`).
 """
 
 from __future__ import annotations
 
 from typing import NamedTuple
 
+from jax import lax
 from jax import numpy as jnp
 
 from . import curve as pc
@@ -120,6 +140,70 @@ def fs_coefficients(ed_r, ed_s, ed_digest, kes_r, kes_s, kes_digest,
     z = z.at[0].set(z[0] | 1).at[16].set(z[16] | 1)
     z = z.at[32].set(z[32] | 1).at[48].set(z[48] | 1)
     return z[0:16], z[16:32], z[32:48], z[48:64]
+
+
+# capacity of one deduped-key coefficient table: bounds the bucket work
+# the tables add to the shared MSM (4 tables x 22 windows ≈ 2.8
+# lane-ops/lane at 8192). A window with more distinct keys than this in
+# ANY deduped column falls back to the exact per-lane path via
+# agg_ok = False — correct, just slow (COVERAGE.md records the knee).
+_DEDUPE_CAP = 256
+
+
+def _dedupe_column(key_bytes, coeff, p, cap: int = _DEDUPE_CAP):
+    """Collapse a repeated-key MSM column into per-distinct-key
+    coefficient sums: (key_bytes [32, T], coeff [20, T] mod-L limbs,
+    p Point [20, T]) -> (table [20, cap] limbs < L, Point [20, cap],
+    ok_cap [] bool).
+
+    Grouping is an EXACT 32-byte lexicographic multi-key sort (never a
+    hash — a grouping collision would merge two different points under
+    one summed coefficient, a soundness break): adjacent-inequality
+    boundaries give contiguous group ids, the per-lane coefficients
+    scatter-add into the group's table slot as raw int32 limb rows
+    (≤ 2^17 lanes x 13-bit rows < 2^30 — exact), one carry + Barrett
+    pass restores mod-L form, and each slot takes the FIRST sorted
+    lane's point as representative. Unused slots keep a valid point
+    with a zero coefficient (digit 0 -> the unweighted bucket).
+
+    The sort keys are the raw public wire bytes, so the taint
+    certification marks these steering sites `wire:` like the MSM's
+    argsort — and byte-exact grouping means a torsion-offset encoding
+    NEVER shares a slot with the honest encoding of the same point
+    (the single-lane odd-coefficient guarantee survives dedupe; see
+    the module small-order caveat for the multi-lane residual)."""
+    t = key_bytes.shape[-1]
+    iota = jnp.arange(t, dtype=jnp.int32)
+    rows = [key_bytes[i].astype(jnp.int32)
+            for i in range(key_bytes.shape[0])]
+    sorted_ops = lax.sort(rows + [iota], num_keys=len(rows))
+    sk = jnp.stack(sorted_ops[:-1])
+    perm = sorted_ops[-1]
+    newgrp = jnp.concatenate([
+        jnp.ones((1,), bool),
+        jnp.any(sk[:, 1:] != sk[:, :-1], axis=0),
+    ])
+    gid = jnp.cumsum(newgrp.astype(jnp.int32)) - 1  # [T] nondecreasing
+    ok_cap = gid[-1] < cap
+    gid_c = jnp.minimum(gid, cap - 1)
+    table = fe.reduce_raw_sums(
+        jnp.zeros((fe.NLIMBS, cap), jnp.int32)
+        .at[:, gid_c].add(coeff[:, perm])
+    )
+    # group start positions via scatter-ADD: exactly one newgrp lane
+    # per group, so the add IS the start index (clamped: an
+    # over-capacity slot may accumulate garbage, but ok_cap already
+    # voids the window)
+    starts = jnp.minimum(
+        jnp.zeros((cap,), jnp.int32)
+        .at[gid_c].add(jnp.where(newgrp, iota, 0)),
+        t - 1,
+    )
+    rep = jnp.take(perm, starts)
+    tbl_pt = pc.Point(*(
+        jnp.take(c, rep, axis=-1) for c in (p.x, p.y, p.z, p.t)
+    ))
+    return table, tbl_pt, ok_cap
 
 
 def _cat_points(points):
@@ -213,32 +297,141 @@ def aggregate_window(
     ])
     sb_pt = pc.base_mul_w8(fe.windows8_from_limbs(sb_scalar, 256))
 
-    # MSM groups: raw 128-bit coefficients on the announced points,
-    # full-width mod-L products on the key/commitment points
+    # repeated-key columns collapse into fixed-capacity tables before
+    # the MSM: a Praos window re-uses its pools' cold keys (A_e), OCert
+    # signatures (R_e), KES leaf keys (A_l) and VRF keys (Y) across many
+    # lanes, so the per-distinct-key coefficient SUMS replace T bucket
+    # entries with ≤ _DEDUPE_CAP (soundness guard: a window with more
+    # distinct keys than capacity forces agg_ok = False -> clean
+    # per-lane fallback, never a wrong verdict)
+    t_re, p_re, cap1 = _dedupe_column(ed_r, z1, pc.neg(re_pt))
+    t_a, p_a, cap2 = _dedupe_column(ed_pk, fe.mul_mod_l(z1, h_ed),
+                                    pc.neg(a_pt))
+    t_al, p_al, cap3 = _dedupe_column(kes_vk_leaf,
+                                      fe.mul_mod_l(z2, h_kes),
+                                      pc.neg(al_pt))
+    t_y, p_y, cap4 = _dedupe_column(vrf_pk, fe.mul_mod_l(z3, c_l),
+                                    pc.neg(y_pt))
+
+    # ONE shared-bucket signed-digit MSM over every remaining column:
+    # raw 128-bit coefficients on the per-lane announced points, full
+    # mod-L widths on the per-lane VRF commitments and the deduped
+    # tables (table sums are mod-L-wide regardless of the source width)
     group_small = (
-        _cat([z1, z2, z3, z4]),
-        _cat_points([pc.neg(re_pt), pc.neg(rk_pt), pc.neg(u_pt),
-                     pc.neg(v_pt)]),
+        _cat([z2, z3, z4]),
+        _cat_points([pc.neg(rk_pt), pc.neg(u_pt), pc.neg(v_pt)]),
         128,
     )
     group_wide = (
-        _cat([
-            fe.mul_mod_l(z1, h_ed), fe.mul_mod_l(z2, h_kes),
-            fe.mul_mod_l(z3, c_l), fe.mul_mod_l(z4, c_l),
-            fe.mul_mod_l(z4, s_v),
-        ]),
-        _cat_points([pc.neg(a_pt), pc.neg(al_pt), pc.neg(y_pt),
-                     pc.neg(g_pt), h_pt]),
-        256,
+        _cat([fe.mul_mod_l(z4, c_l), fe.mul_mod_l(z4, s_v),
+              t_re, t_a, t_al, t_y]),
+        _cat_points([pc.neg(g_pt), h_pt, p_re, p_a, p_al, p_y]),
+        253,
     )
-    total = pc.add(msm.msm_groups([group_small, group_wide]), sb_pt)
-    agg_ok = msm.is_identity(total)[0]
+    total = pc.add(msm.msm_shared([group_small, group_wide]), sb_pt)
+    agg_ok = msm.is_identity(total)[0] & cap1 & cap2 & cap3 & cap4
 
     pre_ok = jnp.all(pre_ed) & jnp.all(pre_kes) & jnp.all(pre_vrf)
     okb = agg_ok[None]
     flags = jnp.stack([
         (pre_ed & okb).astype(jnp.int32),
         (pre_kes & okb).astype(jnp.int32),
+        (pre_vrf & okb).astype(jnp.int32),
+        certain_win.astype(jnp.int32),
+        ambiguous.astype(jnp.int32),
+    ], axis=0)
+    return AggregateVerdicts(flags, eta, lv, agg_ok, pre_ok)
+
+
+def aggregate_window_vrf(
+    ed_pk, ed_r, ed_s, ed_hblocks, ed_hnblocks,
+    kes_vk, kes_period, kes_r, kes_s, kes_vk_leaf, kes_siblings,
+    kes_hblocks, kes_hnblocks,
+    vrf_pk, vrf_gamma, vrf_u, vrf_v, vrf_s, vrf_alpha,
+    beta_decl, thr_lo, thr_hi,
+    *, kes_depth: int,
+) -> AggregateVerdicts:
+    """The `OCT_RLC_ALL=0` kill-switch window: EXACT per-lane Ed25519
+    and KES ladders (ops/pk/verify.py cores, compress-and-compare — the
+    pre-fold PR 3 shape) with only the two VRF equations aggregated,
+    and the aggregation running on the UNSIGNED `msm.msm_groups` engine
+    so the switch also isolates the shared-bucket machinery itself.
+    Same signature/verdict contract as `aggregate_window`."""
+    t = ed_pk.shape[-1]
+
+    # --- exact per-lane Ed25519 + KES (reference ladders) --------------
+    ok_e, ed_pt = pv.ed_core(ed_pk, ed_s, ed_hblocks, ed_hnblocks[0])
+    ok_k, kes_pt = pv.kes_core(
+        kes_vk, kes_period[0], kes_s, kes_vk_leaf, kes_siblings,
+        kes_hblocks, kes_hnblocks[0], kes_depth,
+    )
+    ed_enc, kes_enc = pc.compress_many([ed_pt, kes_pt])
+    ed_ok = ok_e & jnp.all(ed_enc == ed_r.astype(jnp.int32), axis=0)
+    kes_ok = ok_k & jnp.all(kes_enc == kes_r.astype(jnp.int32), axis=0)
+
+    # --- per-lane VRF cheap work (as the unified path) -----------------
+    ok_y, y_pt = pc.decompress(vrf_pk)
+    ok_g, g_pt = pc.decompress(vrf_gamma)
+    ok_u, u_pt = pc.decompress(vrf_u)
+    ok_v, v_pt = pc.decompress(vrf_v)
+    h_pt = pv.hash_to_curve(vrf_pk, vrf_alpha)
+    g8 = pc.mul_cofactor(g_pt)
+    h_enc, g8_enc = pc.compress_many([h_pt, g8])
+    p2 = ph.const_rows([pv.SUITE, 0x02], t)
+    c16 = ph.sha512_fixed(jnp.concatenate(
+        [p2, h_enc, vrf_gamma.astype(jnp.int32), vrf_u.astype(jnp.int32),
+         vrf_v.astype(jnp.int32)], axis=0,
+    ))[:16]
+    p3 = ph.const_rows([pv.SUITE, 0x03], t)
+    beta = ph.sha512_fixed(jnp.concatenate([p3, g8_enc], axis=0))
+    beta_ok = jnp.all(beta == beta_decl.astype(jnp.int32), axis=0)
+    pre_vrf = (ok_y & ok_g & ok_u & ok_v
+               & fe.is_canonical_scalar(vrf_s) & beta_ok)
+
+    # --- leader / nonce range extensions -------------------------------
+    beta_i = beta_decl.astype(jnp.int32)
+    tag_l = ph.const_rows([ord("L")], t)
+    lv = ph.blake2b_fixed(jnp.concatenate([tag_l, beta_i], axis=0), 65, 32)
+    tag_n = ph.const_rows([ord("N")], t)
+    eta1 = ph.blake2b_fixed(jnp.concatenate([tag_n, beta_i], axis=0), 65, 32)
+    eta = ph.blake2b_fixed(eta1, 32, 32)
+    certain_win = pv._lt_be(lv, thr_lo.astype(jnp.int32))
+    certain_loss = ~pv._lt_be(lv, thr_hi.astype(jnp.int32))
+    ambiguous = ~certain_win & ~certain_loss
+
+    # --- vrf-only RLC (z3/z4 equations; z1/z2 unused here) -------------
+    ed_digest = ph.sha512_var(ed_hblocks, ed_hnblocks[0])
+    kes_digest = ph.sha512_var(kes_hblocks, kes_hnblocks[0])
+    _, _, z3b, z4b = fs_coefficients(
+        ed_r, ed_s, ed_digest, kes_r, kes_s, kes_digest,
+        vrf_gamma, vrf_u, vrf_v, vrf_s, vrf_pk, vrf_alpha, beta_decl,
+    )
+    z3 = fe.bytes_to_limbs(z3b, fe.NLIMBS)
+    z4 = fe.bytes_to_limbs(z4b, fe.NLIMBS)
+    c_l = fe.bytes_to_limbs(c16, fe.NLIMBS)
+    s_v = fe.bytes_to_limbs(vrf_s.astype(jnp.int32), fe.NLIMBS)
+
+    sb_scalar = fe.sum_mod_l([fe.mul_mod_l(z3, s_v)])
+    sb_pt = pc.base_mul_w8(fe.windows8_from_limbs(sb_scalar, 256))
+    group_small = (
+        _cat([z3, z4]),
+        _cat_points([pc.neg(u_pt), pc.neg(v_pt)]),
+        128,
+    )
+    group_wide = (
+        _cat([fe.mul_mod_l(z3, c_l), fe.mul_mod_l(z4, c_l),
+              fe.mul_mod_l(z4, s_v)]),
+        _cat_points([pc.neg(y_pt), pc.neg(g_pt), h_pt]),
+        256,
+    )
+    total = pc.add(msm.msm_groups([group_small, group_wide]), sb_pt)
+    agg_ok = msm.is_identity(total)[0]
+
+    pre_ok = jnp.all(ed_ok) & jnp.all(kes_ok) & jnp.all(pre_vrf)
+    okb = agg_ok[None]
+    flags = jnp.stack([
+        (ed_ok & okb).astype(jnp.int32),
+        (kes_ok & okb).astype(jnp.int32),
         (pre_vrf & okb).astype(jnp.int32),
         certain_win.astype(jnp.int32),
         ambiguous.astype(jnp.int32),

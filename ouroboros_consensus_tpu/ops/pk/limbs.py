@@ -486,6 +486,19 @@ def mul_mod_l(a, b):
     return barrett_reduce40(prod)
 
 
+def reduce_raw_sums(v):
+    """[20, T] UN-normalized limb rows (each < 2^30, e.g. the raw int32
+    scatter-sums of the aggregate verifier's repeated-key coefficient
+    tables: ≤ 2^17 lanes x 13-bit rows < 2^30) -> [20, T] limbs < L.
+    One carry pass restores 13-bit rows (value < 2^278 fits 22 rows of
+    the zero-padded 40), then the shared Barrett step reduces mod L."""
+    t = v.shape[-1]
+    wide = jnp.concatenate([v, jnp.zeros((40 - NLIMBS, t), jnp.int32)],
+                           axis=0)
+    wide, _ = _seq_carry(wide)
+    return barrett_reduce40(wide)
+
+
 def sum_mod_l(terms):
     """Sum a list of [20, T] limb scalars (< L each) over BOTH the list
     and the lane axis -> [20, 1] limbs < L. Each term's lane sum stays

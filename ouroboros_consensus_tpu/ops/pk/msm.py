@@ -133,9 +133,16 @@ def _segment_scan(p: pc.Point, n: int, chunks: int):
     return outs, ex
 
 
-def _window_buckets(p: pc.Point, digits_w, nbuckets: int, chunks: int):
-    """Bucket sums B_d = Σ_{digit_i = d} P_i for ONE window ->
-    Point with [20, D] coords. digits_w: [N] int32 in [0, D)."""
+def _window_prefix(p: pc.Point, digits_w, nbuckets: int, chunks: int):
+    """Bucket PREFIX sums E_d = Σ_{digit_i ≤ d} P_i for ONE window ->
+    Point with [20, D] coords. digits_w: [N] int32 in [0, D).
+
+    The prefixes are what the segment scan produces for free (one gather
+    at the digit-boundary positions); returning them un-differenced lets
+    the caller choose between per-bucket sums (B_d = E_d − E_{d−1}, the
+    unsigned `msm` path) and the Abel-summation weighting of
+    `_weighted_sums_abel`, which consumes E_d directly and skips the
+    width-D differencing add entirely."""
     n = digits_w.shape[0]
     chunks = min(chunks, n)
     m = -(-n // chunks)
@@ -166,7 +173,13 @@ def _window_buckets(p: pc.Point, digits_w, nbuckets: int, chunks: int):
     local_pt = _point(tuple(c[:, chunk_of, m_of] for c in local))
     off_pt = _take(offsets, chunk_of)
     e = pc.add(off_pt, local_pt)
-    e = pc.select(cum > 0, e, pc.identity(nbuckets))
+    return pc.select(cum > 0, e, pc.identity(nbuckets))
+
+
+def _window_buckets(p: pc.Point, digits_w, nbuckets: int, chunks: int):
+    """Bucket sums B_d = Σ_{digit_i = d} P_i for ONE window ->
+    Point with [20, D] coords (difference of adjacent prefixes)."""
+    e = _window_prefix(p, digits_w, nbuckets, chunks)
     prev = _point(tuple(
         jnp.concatenate([ic, c[:, :-1]], axis=-1)
         for ic, c in zip(_coords(pc.identity(1)), _coords(e))
@@ -255,3 +268,167 @@ def msm_groups(groups) -> pc.Point:
     for scalars, p, nbits in groups:
         total = pc.add(total, msm(scalars, p, nbits))
     return total
+
+
+# ---------------------------------------------------------------------------
+# Shared-bucket signed-digit engine (the all-stage fold of PR 15)
+# ---------------------------------------------------------------------------
+#
+# `msm_groups` runs one FULL Pippenger per width group: separate sorts,
+# separate segment scans, separate weighted sums, separate Horner
+# chains. `msm_shared` merges every group into ONE bucket machine:
+#
+#   * scalars recode into BALANCED signed base-2^c digits
+#     d ∈ (−2^(c−1), 2^(c−1)] (python carry loop over the unsigned c-bit
+#     windows — static, per-lane int32 work only). A window buckets on
+#     |d| and conditionally negates the point (select/neg: field ops,
+#     not counted point-ops), so D = 2^(c−1)+1 buckets replace the 2^c
+#     of the unsigned path — HALF the bucket-boundary and weighted-sum
+#     work at one window width wider, which is what makes c = 12
+#     affordable (D = 2049) and drops the dominant per-point bucket-add
+#     count from ⌈nbits/8⌉ to ⌈(nbits+1)/12⌉ passes;
+#   * windows are grouped into SEGMENTS by which groups still have
+#     digits: low windows walk the concatenation of every group's
+#     points, high windows walk only the wide groups — one lax.scan per
+#     segment, all windows of a segment sharing one traced body;
+#   * each window keeps the PREFIX sums E_d (no per-bucket
+#     differencing); the weighted sum uses Abel summation
+#         Σ_{d=1}^{D−1} d·B_d = (D−1)·E_{D−1} − Σ_{d=0}^{D−2} E_d
+#     — ONE add per bucket step (the unsigned path pays two) plus c−1
+#     doublings for the (D−1) = 2^(c−1) weighting, and the digit-0
+#     bucket cancels algebraically so identity padding needs no mask;
+#   * every window of every segment lands in one stacked [20, D, W]
+#     prefix tensor -> one vectorized Abel pass -> ONE shared Horner
+#     doubling chain for the whole multi-group total.
+
+# signed-digit window width of the shared engine: D = 2^11+1 buckets,
+# ⌈129/12⌉ = 11 windows over the raw 128-bit Fiat–Shamir coefficients,
+# ⌈254/12⌉ = 22 over full mod-L products (scripts/count_point_ops.py
+# measures the resulting all-stage total; budgets.json ratchets it)
+SHARED_BITS = 12
+# chunk count of the shared path's segment scans: the counted cost is
+# chunks·(m−1) + log2(chunks)·chunks (Hillis–Steele combine), so
+# NARROWER chunks cost less point-op budget (N−chunks main walk, tiny
+# combine) at more sequential fori steps per pass — 64 lands the
+# all-stage total under the 100/lane pin with the walk still 64 lanes
+# wide (the unsigned `msm` keeps CHUNKS=256: its budget has slack and
+# its fori depth stays shallow for the XLA-twin walls)
+SHARED_CHUNKS = 64
+
+
+def signed_digit_windows(nbits: int, cbits: int = SHARED_BITS) -> int:
+    """Window count of the balanced recode: the +1 bit absorbs the
+    final carry, so no extra top window is ever needed."""
+    return -(-(nbits + 1) // cbits)
+
+
+def recode_signed(scalars, nbits: int, cbits: int = SHARED_BITS):
+    """[20, N] normalized limbs (< 2^nbits) -> [W, N] int32 balanced
+    signed digits with Σ_w d_w·2^(w·c) = scalar and
+    d_w ∈ (−2^(c−1), 2^(c−1)].
+
+    Static python carry loop over the unsigned c-bit windows: a window
+    spans at most two 13-bit limbs for c ≤ 13, and the top window's
+    slack (nbits+1 ≤ W·c) absorbs the final carry, so the loop never
+    emits a W+1-th digit. Pure per-lane int32 shifts/masks — no point
+    ops, no data-dependent control flow."""
+    assert 2 <= cbits <= fe.BITS, "window must fit two adjacent limbs"
+    w = signed_digit_windows(nbits, cbits)
+    half = 1 << (cbits - 1)
+    mask = (1 << cbits) - 1
+    n = scalars.shape[-1]
+    padded = jnp.concatenate(
+        [scalars, jnp.zeros((2, n), jnp.int32)], axis=0
+    )
+    digits = []
+    carry = jnp.zeros((n,), jnp.int32)
+    for i in range(w):
+        li, sh = divmod(i * cbits, fe.BITS)
+        u = padded[li] >> sh
+        if sh + cbits > fe.BITS:
+            u = u | (padded[li + 1] << (fe.BITS - sh))
+        d = (u & mask) + carry
+        carry = (d > half).astype(jnp.int32)
+        digits.append(d - (carry << cbits))
+    return jnp.stack(digits)
+
+
+def _weighted_sums_abel(prefix_stack: pc.Point, nbuckets: int,
+                        cbits: int) -> pc.Point:
+    """Σ_d d·B_d per window from the PREFIX sums, windows vectorized:
+    prefix_stack coords [20, D, W] -> Point [20, W]. Abel summation:
+    (D−1)·E_{D−1} − Σ_{d=0}^{D−2} E_d — one add per bucket step (vs the
+    two of the running-sum form) and c−1 doublings for the top weight
+    (D−1 = 2^(c−1) with balanced digits)."""
+    assert nbuckets == (1 << (cbits - 1)) + 1
+    w = prefix_stack.x.shape[-1]
+    cs = _coords(prefix_stack)
+
+    def body(d, acc):
+        e = _point(tuple(
+            lax.dynamic_slice(c, (0, d, 0), (20, 1, w))[:, 0, :]
+            for c in cs
+        ))
+        return pc.add(acc, e)
+
+    acc = lax.fori_loop(0, nbuckets - 1, body, pc.identity(w))
+    pc._count(w, nbuckets - 2)  # 1 add/step, body traced once
+    top = _point(tuple(c[:, nbuckets - 1, :] for c in cs))
+    top = pc.doubles(top, cbits - 1)  # (D−1)·E_{D−1}
+    return pc.add(top, pc.neg(acc))
+
+
+def msm_shared(groups, *, cbits: int = SHARED_BITS,
+               chunks: int = SHARED_CHUNKS) -> pc.Point:
+    """Sum of several MSMs through ONE shared signed-digit bucket
+    machine: groups = [(scalars [20, N_g], Point, nbits), ...] ->
+    Point [20, 1]. See the section comment above for the structure.
+
+    Window segments: with the group widths sorted, windows
+    [0, W_min) walk every group's points concatenated, the next segment
+    only the groups still holding digits, and so on — one lax.scan per
+    segment (each body traced once; the op counter replicates per
+    window exactly like `msm`)."""
+    ws = [signed_digit_windows(nbits, cbits) for _, _, nbits in groups]
+    nbuckets = (1 << (cbits - 1)) + 1
+    digits = [recode_signed(s, nbits, cbits)
+              for s, _, nbits in groups]  # [W_g, N_g] signed
+
+    stacks = []
+    w_lo = 0
+    for w_hi in sorted(set(ws)):
+        alive = [i for i in range(len(groups)) if ws[i] > w_lo]
+        p_seg = _point(tuple(
+            jnp.concatenate([_coords(groups[i][1])[k] for i in alive],
+                            axis=-1)
+            for k in range(4)
+        ))
+        d_seg = jnp.concatenate(
+            [digits[i][w_lo:w_hi] for i in alive], axis=-1
+        )  # [w_hi − w_lo, N_seg]
+
+        ops0 = dict(pc._OPSTATS)
+
+        def wbody(_, dw, p_seg=p_seg):
+            # bucket on |d|; fold the sign into the point (select/neg
+            # are field work — the bucket adds are what's counted)
+            p_eff = pc.select(dw >= 0, p_seg, pc.neg(p_seg))
+            e = _window_prefix(p_eff, jnp.abs(dw), nbuckets, chunks)
+            return 0, _coords(e)
+
+        _, st = lax.scan(wbody, 0, d_seg)  # coords [Wseg, 20, D]
+        nwin = w_hi - w_lo
+        if pc._OPSTATS["on"]:  # scan body traced once; nwin windows run
+            for k in ("ops", "lane_ops"):
+                pc._OPSTATS[k] += (nwin - 1) * (pc._OPSTATS[k] - ops0[k])
+        stacks.append(_point(tuple(
+            jnp.moveaxis(c, 0, -1) for c in st
+        )))
+        w_lo = w_hi
+
+    stack = _point(tuple(
+        jnp.concatenate([_coords(s)[k] for s in stacks], axis=-1)
+        for k in range(4)
+    ))  # [20, D, W_total] — window w weighted 2^(w·c) by the Horner
+    sums = _weighted_sums_abel(stack, nbuckets, cbits)
+    return _horner(sums, cbits)

@@ -788,6 +788,21 @@ def _agg_enabled() -> bool:
     return os.environ.get("OCT_VRF_AGG", "1") != "0"
 
 
+def _rlc_all_enabled() -> bool:
+    """OCT_RLC_ALL (default 1): fold the Ed25519 and KES equations into
+    the shared-bucket window MSM (`aggregate_window` — one signed-digit
+    bucket pass over every stage). =0 keeps the window aggregated but
+    restores the vrf-only RLC with exact per-lane ed/kes ladders
+    (`aggregate_window_vrf`, the pre-fold shape on the unsigned engine)
+    — the isolation kill-switch for the shared-bucket machinery. Only
+    consulted when `_agg_enabled()` admits the aggregate path at all.
+    Read per call like OCT_VRF_AGG so tests can A/B in one process."""
+    ov = getattr(_RECOVERY_OVERRIDES, "vals", None)
+    if ov is not None and ov.get("rlc_all") is not None:
+        return bool(ov["rlc_all"])
+    return os.environ.get("OCT_RLC_ALL", "1") != "0"
+
+
 def _impl() -> str:
     ov = getattr(_RECOVERY_OVERRIDES, "vals", None)
     if ov is not None and ov.get("impl"):
@@ -811,8 +826,8 @@ class recovery_overrides:
     """Context manager: pin `_agg_enabled()` / `_impl()` for THIS
     thread while a recovery rung re-validates a window."""
 
-    def __init__(self, agg=None, impl=None):
-        self._vals = {"agg": agg, "impl": impl}
+    def __init__(self, agg=None, impl=None, rlc_all=None):
+        self._vals = {"agg": agg, "impl": impl, "rlc_all": rlc_all}
 
     def __enter__(self):
         self._prev = getattr(_RECOVERY_OVERRIDES, "vals", None)
@@ -1392,45 +1407,73 @@ def _jitted_packed_xla(layout: PraosPackedLayout, scan: bool):
     return _JIT[key]
 
 
-def _jitted_packed_agg(layout: PraosPackedLayout, scan: bool):
+def _jitted_packed_agg(layout: PraosPackedLayout, scan: bool,
+                       mode: str = "all"):
     """The AGGREGATED packed program (batch-compatible layouts only):
-    device unpack -> limb relayout -> ops/pk/aggregate.aggregate_window
-    (cheap per-lane work + Fiat–Shamir coefficients + the RLC MSM) ->
-    verdict_reduce. One jit per (layout, scan); identical output
-    vocabulary to the per-lane packed programs, with the aggregate
-    verdict folded into the ok mask rows — a window that is not clean
-    under aggregation is re-dispatched through the UNCHANGED per-lane
-    stages by materialize_verdicts."""
+    device unpack -> limb relayout -> the window aggregate ->
+    verdict_reduce. `mode` selects the aggregate:
+
+      "all" — ops/pk/aggregate.aggregate_window, EVERY stage folded
+              into one shared-bucket signed-digit MSM (the default;
+              label family "agg-packed");
+      "vrf" — aggregate_window_vrf, exact per-lane ed/kes ladders with
+              only the VRF equations aggregated on the unsigned engine
+              (the OCT_RLC_ALL=0 kill-switch; label family "agg-vrf").
+
+    One jit per (layout, scan, mode); identical output vocabulary to
+    the per-lane packed programs, with the aggregate verdict folded
+    into the ok mask rows — a window that is not clean under
+    aggregation is re-dispatched through the UNCHANGED per-lane stages
+    by materialize_verdicts. The `_warm_timed` wrap gives both mode
+    families first-execute attribution AND build-pinned AOT store
+    coverage (load / write-back) under their label-derived store
+    names."""
     import jax
 
-    key = ("agg-packed", layout, scan)
+    key = ("agg-packed", layout, scan, mode)
     if key not in _JIT:
-        from ..ops.pk import aggregate as pk_aggregate
-        from ..ops.pk import kernels as pk_kernels
-
-        def fn(body, kes_rs, kt_idx, kt_tab, slot, counter, c0,
-               thr_idx, thr_tab, nonce, within, n_real,
-               ev0, ev0_set, cand0, cand0_set):
-            cols = unpack_packed(
-                layout, body, kes_rs, kt_idx, kt_tab, slot, counter, c0,
-                thr_idx, thr_tab, nonce,
-            )
-            limb = pk_kernels.staged_to_limb_first_bc(*cols)
-            av = pk_aggregate.aggregate_window(
-                *limb, kes_depth=layout.kes_depth
-            )
-            red = verdict_reduce(
-                av.flags, jnp.transpose(av.eta), within, n_real,
-                ev0, ev0_set, cand0, cand0_set, scan=scan,
-            )
-            return red, av.flags, av.eta, av.leader_value
-
         _JIT[key] = _warm_timed(
-            f"agg-packed:{layout.body_len}b:"
+            f"{_AGG_STAGE_FAMILY[mode]}:{layout.body_len}b:"
             f"{'scan' if scan else 'noscan'}",
-            jax.jit(fn),
+            jax.jit(_packed_agg_fn(layout, scan, mode)),
         )
     return _JIT[key]
+
+
+def _packed_agg_fn(layout: PraosPackedLayout, scan: bool,
+                   mode: str = "all"):
+    """The RAW (un-jitted) aggregated stage program for (layout, scan,
+    mode) — the function the jit builder above wraps, exposed so
+    scripts/aot_precompile.py can trace/lower/compile the SAME program
+    into the build-pinned store under its `_store_name(label)` row
+    (the first execute then loads instead of compiling)."""
+    from ..ops.pk import aggregate as pk_aggregate
+    from ..ops.pk import kernels as pk_kernels
+
+    agg_fn = (pk_aggregate.aggregate_window if mode == "all"
+              else pk_aggregate.aggregate_window_vrf)
+
+    def fn(body, kes_rs, kt_idx, kt_tab, slot, counter, c0,
+           thr_idx, thr_tab, nonce, within, n_real,
+           ev0, ev0_set, cand0, cand0_set):
+        cols = unpack_packed(
+            layout, body, kes_rs, kt_idx, kt_tab, slot, counter, c0,
+            thr_idx, thr_tab, nonce,
+        )
+        limb = pk_kernels.staged_to_limb_first_bc(*cols)
+        av = agg_fn(*limb, kes_depth=layout.kes_depth)
+        red = verdict_reduce(
+            av.flags, jnp.transpose(av.eta), within, n_real,
+            ev0, ev0_set, cand0, cand0_set, scan=scan,
+        )
+        return red, av.flags, av.eta, av.leader_value
+
+    return fn
+
+
+# warmup/compile-gate label families of the two aggregate modes (the
+# family prefix is what analysis/costmodel.STAGE_GRAPHS keys on)
+_AGG_STAGE_FAMILY = {"all": "agg-packed", "vrf": "agg-vrf"}
 
 
 def _jitted_pk(kes_depth: int, bc: bool = False):
@@ -2041,12 +2084,15 @@ def prepare_window(params, lview, eta0, hvs) -> _StagedWindow:
                          t0, time.monotonic())
 
 
-def _agg_label(layout, lanes: int, scan: bool) -> str:
+def _agg_label(layout, lanes: int, scan: bool,
+               mode: str = "all") -> str:
     """The aggregate monolith's warmup/first-execute label at one
     padded lane count (must match what `_warm_timed` derives from the
     dispatched arguments — the compile gate and the warm ladder key
-    their cold/warm decisions on it)."""
-    return (f"agg-packed:{layout.body_len}b:"
+    their cold/warm decisions on it). `mode` picks the label family:
+    "all" -> agg-packed (shared-bucket fold), "vrf" -> agg-vrf (the
+    OCT_RLC_ALL=0 vrf-only aggregate)."""
+    return (f"{_AGG_STAGE_FAMILY[mode]}:{layout.body_len}b:"
             f"{'scan' if scan else 'noscan'}:{lanes}l")
 
 
@@ -2098,7 +2144,8 @@ def dispatch_prepared(sw: _StagedWindow, carry=None, ladder=None):
         cargs = carry if scan_mode else _ZERO_CARRY
         n_real = np.int32(b)
         refused_gate = None
-        agg_stage = _agg_label(layout, lanes, scan_mode)
+        agg_mode = "all" if _rlc_all_enabled() else "vrf"
+        agg_stage = _agg_label(layout, lanes, scan_mode, agg_mode)
         agg_path = layout.vrf_proof_len == 128 and _agg_enabled()
         if agg_path and ladder is not None:
             # the warm ladder owns the production-bucket compile: hand
@@ -2130,7 +2177,7 @@ def dispatch_prepared(sw: _StagedWindow, carry=None, ladder=None):
             # scan carry chain is valid even if this window later falls
             # back (materialize_verdicts re-dispatches per-lane on any
             # anomaly — the fallback recomputes the same etas)
-            out = _jitted_packed_agg(layout, scan_mode)(
+            out = _jitted_packed_agg(layout, scan_mode, agg_mode)(
                 *parr, n_real, *cargs
             )
             carry_out = tuple(out[0][1:5]) if scan_mode else None
@@ -2269,7 +2316,10 @@ class WarmLadder:
         already warm in this process)."""
         if self._bg is not None or self._done.is_set():
             return
-        label = _agg_label(layout, self.target, scan)
+        # warm the mode that dispatch will actually serve (agg-packed
+        # unless the OCT_RLC_ALL kill-switch pins the vrf-only family)
+        mode = "all" if _rlc_all_enabled() else "vrf"
+        label = _agg_label(layout, self.target, scan, mode)
         from ..obs.warmup import WARMUP
 
         if label in WARMUP.stages:
@@ -2284,12 +2334,12 @@ class WarmLadder:
         )
         self._emit("bg-compile-started", self.rung)
         self._bg = threading.Thread(
-            target=self._warm, args=(layout, parr, scan),
+            target=self._warm, args=(layout, parr, scan, mode),
             daemon=True, name="oct-warm-ladder",
         )
         self._bg.start()
 
-    def _warm(self, layout, parr, scan: bool) -> None:
+    def _warm(self, layout, parr, scan: bool, mode: str = "all") -> None:
         """Background thread body: pad the observed window's packed
         columns to the production bucket and run the production program
         once, blocking until the compile (and one execute) lands. XLA
@@ -2303,7 +2353,7 @@ class WarmLadder:
         try:
             parr_t = pad_packed_to(parr, self.target)
             n_real = np.int32(parr.body.shape[0])
-            out = _jitted_packed_agg(layout, scan)(
+            out = _jitted_packed_agg(layout, scan, mode)(
                 *parr_t, n_real, *_ZERO_CARRY
             )
             jax.block_until_ready(out)

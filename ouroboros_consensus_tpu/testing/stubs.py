@@ -79,7 +79,7 @@ def stub_agg_program_builder(delay_s=None):
     seen: set = set()
     sleep = _first_exec_delay(delay_s, seen)
 
-    def builder(layout, scan):
+    def builder(layout, scan, mode="all"):
         key = ("stub-agg", layout, scan, bool(delay_s))
         if key not in pbatch._JIT:
 

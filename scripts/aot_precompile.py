@@ -140,12 +140,16 @@ def packed_sds(params, lview, bucket, rep, sharding):
     return layout, unpack_in, red_in
 
 
-def compile_stage(name, fn, in_sds, b, manifest):
+def compile_stage(name, fn, in_sds, b, manifest, kes_depth=KES_DEPTH,
+                  tile=K.TILE, wall_label=None):
     """Compile-and-save one stage; returns True iff a FRESH executable
-    was written (False = an on-disk entry was reused)."""
+    was written (False = an on-disk entry was reused). The unified
+    aggregate programs pass kes_depth=0, tile=0 — the store key
+    protocol/batch._warm_timed loads them back under (the layout's
+    depth is baked into the program, not the key)."""
     sig = aot.sig_of(in_sds)
-    path = aot.stage_path(name, b, KES_DEPTH, K.TILE, sig)
-    key = aot.entry_key(name, b, KES_DEPTH, K.TILE, sig)
+    path = aot.stage_path(name, b, kes_depth, tile, sig)
+    key = aot.entry_key(name, b, kes_depth, tile, sig)
     # cached means artifact AND manifest row: a crash between the
     # artifact write and the manifest update (or a corrupt manifest)
     # orphans the file — load() gates on the manifest, so an orphan is
@@ -153,7 +157,7 @@ def compile_stage(name, fn, in_sds, b, manifest):
     if os.path.exists(path) and key in aot.read_manifest():
         print(f"  {name:8s} sig={sig} — cached", flush=True)
         return False
-    predicted = _predicted_wall(name)
+    predicted = _predicted_wall(wall_label or name)
     if AOT_BUDGET and predicted is not None:
         remaining = AOT_BUDGET - (time.time() - _T0)
         if predicted > remaining:
@@ -173,13 +177,13 @@ def compile_stage(name, fn, in_sds, b, manifest):
     compiled = lowered.compile()
     t_compile = time.time() - t0
     meta = {
-        "stage": name, "b": b, "kes_depth": KES_DEPTH, "tile": K.TILE,
+        "stage": name, "b": b, "kes_depth": kes_depth, "tile": tile,
         "sig": sig, "lower_s": round(t_lower, 1),
         "compile_s": round(t_compile, 1), "topology": TOPOLOGY,
         "jax": jax.__version__,
         "hash_impl": os.environ.get("OCT_PK_HASH_IMPL", ""),
     }
-    p = aot.save(name, b, KES_DEPTH, K.TILE, sig, compiled, meta)
+    p = aot.save(name, b, kes_depth, tile, sig, compiled, meta)
     meta["bytes"] = os.path.getsize(p)
     if predicted is not None:
         meta["predicted_s"] = round(predicted, 1)
@@ -282,6 +286,24 @@ def main():
                                        unpack_in, bucket, manifest))
             fresh.append(compile_stage("reduce", K._mk_reduce(True),
                                        red_in, bucket, manifest))
+            # UNIFIED aggregated window programs (round 15): the
+            # one-RLC monolith ("all", the production default) and the
+            # OCT_RLC_ALL=0 kill-switch ("vrf"), compiled under the
+            # EXACT store rows protocol/batch._warm_timed loads —
+            # name = _store_name(label), b = padded lanes,
+            # kes_depth = tile = 0, sig over the runtime call args
+            # (unpack columns + the verdict_reduce scan tail)
+            if layout.vrf_proof_len == 128:
+                agg_in = unpack_in + red_in[2:]
+                for mode in ("all", "vrf"):
+                    label = (f"{pbatch._AGG_STAGE_FAMILY[mode]}:"
+                             f"{layout.body_len}b:scan")
+                    fresh.append(compile_stage(
+                        pbatch._store_name(label),
+                        pbatch._packed_agg_fn(layout, True, mode),
+                        agg_in, bucket, manifest,
+                        kes_depth=0, tile=0, wall_label=label,
+                    ))
         # generic-fallback relayout (mixed-layout windows)
         fresh.append(compile_stage(relayout_name, relayout_fn, rel_sds, bucket,
                       manifest))

@@ -15,9 +15,16 @@ reduced tiles) and prints the reduction factor measured against the
 
 Usage:
     JAX_PLATFORMS=cpu python scripts/count_point_ops.py [T]
+    JAX_PLATFORMS=cpu python scripts/count_point_ops.py --all-stages [T]
+        # round-15 evidence mode: per-stage point-op table for the
+        # WHOLE per-window pipeline (unified one-RLC program vs the
+        # OCT_RLC_ALL=0 kill-switch program vs the per-lane ladders)
+        # plus the all-stage totals the point_ops.all_stage_total
+        # budget pins
     JAX_PLATFORMS=cpu python scripts/count_point_ops.py --check
-        # run the budgets.json point_ops ratchet and exit nonzero on
-        # any violation (same check scripts/lint.py applies)
+        # run the budgets.json point_ops ratchet — including the
+        # composite all_stage_total pin — and exit nonzero on any
+        # violation (same check scripts/lint.py applies)
 """
 
 import os
@@ -72,7 +79,40 @@ def count(fn, args, label):
     return lane_ops
 
 
+def all_stages():
+    """Per-stage accounting of the full per-window pipeline.
+
+    The unified dispatch path runs: packed unpack (no point ops by
+    construction — byte slicing + hashing only), ONE aggregated
+    program, verdict reduce (also point-op-free). So the unified
+    all-stage total IS the aggregate_window count, and the table
+    makes that visible rather than assumed. The kill-switch column
+    (OCT_RLC_ALL=0, aggregate_window_vrf) carries the exact per-lane
+    ed/KES ladders inline, so its total shows what the one-RLC fold
+    is buying at this lane count."""
+    unified = count(
+        functools.partial(agg.aggregate_window, kes_depth=DEPTH),
+        _args_bc(), f"unified RLC (all stages, T={T})",
+    )
+    vrf_only = count(
+        functools.partial(agg.aggregate_window_vrf, kes_depth=DEPTH),
+        _args_bc(), f"kill-switch OCT_RLC_ALL=0 (T={T})",
+    )
+    per_lane = count(
+        functools.partial(pv.verify_praos_core_bc, kes_depth=DEPTH),
+        _args_core_bc(), f"per-lane ladders (T={T})",
+    )
+    print(f"all-stage total (unified):     {unified / T:10.2f} lane-ops/lane")
+    print(f"all-stage total (kill-switch): {vrf_only / T:10.2f} lane-ops/lane")
+    print(f"all-stage total (per-lane):    {per_lane / T:10.2f} lane-ops/lane")
+    print(f"unified vs kill-switch: {vrf_only / unified:.2f}x; "
+          f"unified vs per-lane ladders: {per_lane / unified:.2f}x")
+    return 0
+
+
 def main():
+    if "--all-stages" in sys.argv:
+        return all_stages()
     if "--check" in sys.argv:
         from ouroboros_consensus_tpu.analysis import graphs
 

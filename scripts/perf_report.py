@@ -557,6 +557,30 @@ def render_markdown(report: dict) -> str:
                 + (" (swapped to production mid-replay)"
                    if r.get("ladder_swapped") else " (no swap before end)")
             )
+    po = report.get("point_ops")
+    if po:
+        out += ["", "## Static point-op ratchet (budgets.json)", ""]
+        out.append(
+            "Per-lane point-op ceilings pinned by lint exit 3 / "
+            "`scripts/count_point_ops.py --check` — the device-free "
+            "half of the perf story. Round 15 folded the Ed25519 and "
+            "KES ladders into the one-RLC shared-bucket MSM, so the "
+            "whole per-window pipeline now rides one aggregated "
+            "program."
+        )
+        out.append("")
+        out.append("| graph | pinned lane-ops/lane | at lanes |")
+        out.append("|---|---|---|")
+        for name, cfg in po["pins"]:
+            out.append(f"| {name} | {cfg['lane_ops_per_lane']:g} | "
+                       f"{cfg['at_lanes']} |")
+        total = po.get("all_stage_total")
+        if total:
+            out.append(
+                f"| **all_stage_total** ({'+'.join(total['graphs'])}) | "
+                f"**{total['lane_ops_per_lane']:g}** | "
+                f"{total['at_lanes']} |"
+            )
     mc = report.get("multichip_rounds") or []
     if mc:
         out += ["", "## Multichip", ""]
@@ -589,6 +613,28 @@ def render_markdown(report: dict) -> str:
     return "\n".join(out) + "\n"
 
 
+def point_ops_section() -> dict | None:
+    """The ratcheted per-lane point-op pins from budgets.json — no
+    tracing, a dict read: the STATIC perf trajectory (what the
+    MSM/aggregate refactors banked) surfaced next to the device
+    rounds. Fail-soft: a missing/odd budgets file just drops the
+    section."""
+    try:
+        from ouroboros_consensus_tpu.analysis import graphs as an_graphs
+
+        sec = an_graphs.load_budgets().get("point_ops", {})
+    except Exception:  # noqa: BLE001 — report survives a broken budgets file
+        return None
+    if not sec:
+        return None
+    pins = [(n, cfg) for n, cfg in sorted(sec.items())
+            if n != "all_stage_total" and cfg.get("lane_ops_per_lane")]
+    return {
+        "pins": pins,
+        "all_stage_total": sec.get("all_stage_total"),
+    }
+
+
 def build_report(dir_: str, threshold: float | None,
                  require_device: bool, ledger_dir: str | None) -> dict:
     bench_rounds = sorted(
@@ -612,6 +658,7 @@ def build_report(dir_: str, threshold: float | None,
         "bench_rounds": bench_rounds,
         "multichip_rounds": multichip,
         "ledger": led,
+        "point_ops": point_ops_section(),
         "verdicts": verdicts,
         "ok": all(v["ok"] for v in verdicts),
     }

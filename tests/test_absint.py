@@ -460,7 +460,7 @@ def test_changed_selection():
     lint = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(lint)
     sel = lint._select_graphs({"ouroboros_consensus_tpu/ops/pk/msm.py"})
-    assert sel == ["aggregate_core", "msm"]
+    assert sel == ["aggregate_core", "aggregate_vrf_core", "msm"]
     assert lint._select_graphs(set()) == []
     # machinery edits invalidate everything -> full sweep
     assert lint._select_graphs(

@@ -56,14 +56,17 @@ def lview(pools):
     return fixtures.make_ledger_view(pools)
 
 
-def real_chain(params, pools, lview, n, tamper=None, first_slot=100):
+def real_chain(params, pools, lview, n, tamper=None, first_slot=100,
+               vrf_batch=None):
     """Real-codec batch-compatible chain forged on WINNING slots only
     (the leader lottery is consulted per slot, db-synthesizer style, so
     a clean chain validates end to end); `tamper(i, pool, is_leader,
     ocert) -> (is_leader, ocert, kes_flip)` lets a lane be corrupted
     BEFORE the body is built, so the window still qualifies for packed
     staging (the corruption is inside the signed body, exactly like a
-    forged-on-chain attack)."""
+    forged-on-chain attack). `vrf_batch(i) -> bool` selects the proof
+    format per header (True = 128-byte batch-compatible, False =
+    80-byte draft-03) so mixed-format chains stay real-codec."""
     from ouroboros_consensus_tpu.block.forge import evaluate_vrf
     from ouroboros_consensus_tpu.protocol import nonces as nonces_mod
     from ouroboros_consensus_tpu.protocol.leader import check_leader_value
@@ -71,7 +74,10 @@ def real_chain(params, pools, lview, n, tamper=None, first_slot=100):
     nonce = b"\x07" * 32
     hvs, prev = [], b"\xaa" * 32
     slot = first_slot
+    prev_fmt = os.environ.get("OCT_VRF_BATCH")
     while len(hvs) < n:
+        if vrf_batch is not None:
+            os.environ["OCT_VRF_BATCH"] = "1" if vrf_batch(len(hvs)) else "0"
         winner = None
         for pool in pools:
             cand = evaluate_vrf(pool, slot, nonce)
@@ -98,12 +104,20 @@ def real_chain(params, pools, lview, n, tamper=None, first_slot=100):
         )
         hv = blk.header.to_view()
         if kes_flip:
-            sig = bytearray(hv.kes_sig)
-            sig[1] ^= 1
-            hv = replace(hv, kes_sig=bytes(sig))
+            if callable(kes_flip):
+                hv = replace(hv, kes_sig=kes_flip(hv.kes_sig))
+            else:
+                sig = bytearray(hv.kes_sig)
+                sig[1] ^= 1
+                hv = replace(hv, kes_sig=bytes(sig))
         hvs.append(hv)
         prev = blk.header.hash_
         slot += 1
+    if vrf_batch is not None:
+        if prev_fmt is None:
+            os.environ.pop("OCT_VRF_BATCH", None)
+        else:
+            os.environ["OCT_VRF_BATCH"] = prev_fmt
     return nonce, hvs
 
 
@@ -279,10 +293,51 @@ def test_aggregate_clean_chain_matches_per_lane_and_host(
     assert res_agg.state == res_lane.state
 
 
+def _torsion8():
+    """A point of EXACT order 8 (host representation): [L]Q for the
+    first decompressable encoding Q whose torsion component has full
+    order. Adding it to a wire point encoding keeps the encoding
+    canonical but moves the point off the prime-order subgroup."""
+    from ouroboros_consensus_tpu.ops.host import ed25519 as he
+
+    for b0 in range(256):
+        q = he.point_decompress(bytes([b0]) + bytes(31))
+        if q is None:
+            continue
+        t = he.point_mul(he.L, q)
+        if (not he.point_equal(t, he.IDENT)
+                and not he.point_equal(he.point_mul(4, t), he.IDENT)):
+            return t
+    raise AssertionError("no order-8 point found")
+
+
+def _add_torsion(enc32: bytes) -> bytes:
+    from ouroboros_consensus_tpu.ops.host import ed25519 as he
+
+    p = he.point_decompress(enc32)
+    assert p is not None
+    return he.point_compress(he.point_add(p, _torsion8()))
+
+
 def _tamper_factory(kind, bad_lane):
     def tamper(i, pool, is_leader, ocert):
         if i != bad_lane:
             return is_leader, ocert, False
+        if kind == "ed_torsion":
+            # torsion-grind the announced Ed25519 R of the OCert
+            # signature: still a canonical encoding, but off the
+            # prime-order subgroup — the odd (cofactor-coprime) z1
+            # keeps the z1·T term alive in the aggregate, so the
+            # unified identity check must reject exactly like the
+            # cofactorless host reference
+            sig = _add_torsion(ocert.sigma[:32]) + ocert.sigma[32:]
+            return is_leader, replace(ocert, sigma=sig), False
+        if kind == "kes_torsion":
+            # same grind on the KES leaf signature's R (first 32 bytes
+            # of the CompactSum signature) — the z2 lane of the fold
+            return is_leader, ocert, (
+                lambda ks: _add_torsion(ks[:32]) + ks[32:]
+            )
         if kind == "ocert":
             sig = bytearray(ocert.sigma)
             sig[3] ^= 1
@@ -327,6 +382,111 @@ def test_corrupted_lane_falls_back_and_isolates(pools, lview, kind,
         "beta": praos.VRFKeyBadProof,
     }[kind]
     assert isinstance(res.error, expect), res.error
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["ed_torsion", "kes_torsion"])
+def test_single_lane_torsion_grinding_rejected(pools, lview, kind,
+                                               monkeypatch):
+    """Round-15 regression: an adversary who grinds an 8-torsion offset
+    onto a single lane's Ed25519 R (OCert sigma) or KES leaf R must be
+    rejected by the UNIFIED aggregate exactly like the cofactorless
+    host reference — the odd Fiat–Shamir coefficients keep the z·T
+    torsion term alive in the folded identity, so the shared-bucket
+    MSM cannot be talked into accepting what the per-lane path
+    refuses. Same 9-lane window shape as the corruption matrix (shares
+    the compiled programs)."""
+    params = make_params()
+    bad = 5
+    nonce, hvs = real_chain(
+        params, pools, lview, 9, tamper=_tamper_factory(kind, bad)
+    )
+    res = _validate(params, lview, nonce, hvs, True, monkeypatch)
+    assert res.n_valid == bad
+    _results_match_host(res, params, lview, nonce, hvs)
+    expect = {
+        "ed_torsion": praos.InvalidSignatureOCERT,
+        "kes_torsion": praos.InvalidKesSignatureOCERT,
+    }[kind]
+    assert isinstance(res.error, expect), res.error
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("combo,first_err", [
+    ((("ocert", 2), ("vrf", 6)), "ocert"),
+    ((("kes", 1), ("beta", 7)), "kes"),
+])
+def test_multiple_dirty_stages_one_window(pools, lview, combo, first_err,
+                                          monkeypatch):
+    """Two DIFFERENT crypto families corrupted in the same window: the
+    single aggregated identity check must go dirty, and the per-lane
+    re-dispatch must reproduce the FIRST reference error at the first
+    bad lane (later corruption stays behind the first-error horizon,
+    exactly like the sequential fold)."""
+    params = make_params()
+    tampers = [_tamper_factory(kind, lane) for kind, lane in combo]
+
+    def tamper(i, pool, is_leader, ocert):
+        flip = False
+        for t in tampers:
+            is_leader, ocert, f = t(i, pool, is_leader, ocert)
+            flip = flip or f
+        return is_leader, ocert, flip
+
+    nonce, hvs = real_chain(params, pools, lview, 9, tamper=tamper)
+    res = _validate(params, lview, nonce, hvs, True, monkeypatch)
+    assert res.n_valid == min(lane for _, lane in combo)
+    _results_match_host(res, params, lview, nonce, hvs)
+    expect = {
+        "ocert": praos.InvalidSignatureOCERT,
+        "kes": praos.InvalidKesSignatureOCERT,
+    }[first_err]
+    assert isinstance(res.error, expect), res.error
+
+
+def test_mixed_format_chain_segments_before_aggregate(pools, lview,
+                                                      monkeypatch,
+                                                      fenced_jits):
+    """A chain mixing 80-byte draft-03 and 128-byte batch-compatible
+    proofs must SEGMENT at format boundaries rather than enter the
+    unified one-RLC path: every window the aggregate builder sees is
+    batch-compatible, draft-03 segments ride the per-lane packed
+    program, and the chain result still equals the sequential
+    reupdate fold (crypto stubbed hash-only — dispatch plumbing
+    only)."""
+    from ouroboros_consensus_tpu.ops.pk import aggregate as agg_mod
+
+    params = make_params()
+    # alternating 2-header format runs: [bc, bc][d3, d3][bc, bc][d3, d3]
+    nonce, hvs = real_chain(
+        params, pools, lview, 8, vrf_batch=lambda i: (i // 2) % 2 == 0
+    )
+    assert {len(hv.vrf_proof) for hv in hvs} == {80, 128}
+
+    monkeypatch.setattr(agg_mod, "aggregate_window", _stub_aggregate(True))
+    monkeypatch.setattr(pbatch, "verify_praos_any",
+                        lambda *cols: _stub_verdicts(cols))
+    seen_plens = []
+    orig_agg = pbatch._jitted_packed_agg
+
+    def counting_agg(layout, scan, mode="all"):
+        seen_plens.append(layout.vrf_proof_len)
+        return orig_agg(layout, scan, mode)
+
+    monkeypatch.setattr(pbatch, "_jitted_packed_agg", counting_agg)
+
+    st0 = replace(praos.PraosState(), epoch_nonce=nonce)
+    res = pbatch.validate_chain(
+        params, lambda _e: lview, st0, hvs, max_batch=len(hvs)
+    )
+    assert res.error is None and res.n_valid == len(hvs)
+    st = st0
+    for hv in hvs:
+        ticked = praos.tick(params, lview, hv.slot, st)
+        st = praos.reupdate(params, hv, hv.slot, ticked)
+    assert res.state == st
+    assert seen_plens, "no batch-compatible segment reached the aggregate"
+    assert set(seen_plens) == {128}
 
 
 @pytest.mark.slow

@@ -504,7 +504,7 @@ def test_dispatch_refusal_rides_the_fallback_path(monkeypatch,
     agg_calls = []
     monkeypatch.setattr(
         pbatch, "_jitted_packed_agg",
-        lambda layout, scan: agg_calls.append(1)
+        lambda layout, scan, mode="all": agg_calls.append(1)
         or pytest.fail("refused aggregate program was still dispatched"),
     )
     before = set(pbatch._JIT)
@@ -527,7 +527,7 @@ def test_dispatch_refusal_rides_the_fallback_path(monkeypatch,
         taken = []
         monkeypatch.setattr(
             pbatch, "_jitted_packed_agg",
-            lambda layout, scan: lambda *a: taken.append(1) or (
+            lambda layout, scan, mode="all": lambda *a: taken.append(1) or (
                 ((np.zeros((5, (len(hvs) + 7) // 8 * 8), np.int64),)
                  + tuple(np.zeros(1) for _ in range(6))),
                 np.zeros((5, 8)), np.zeros((32, 8)), np.zeros((32, 8)),

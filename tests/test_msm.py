@@ -93,6 +93,62 @@ def test_msm_cancellation_is_identity(rng_points):
     assert bool(msm.is_identity(total)[0])
 
 
+def test_recode_signed_roundtrip_and_bounds():
+    """Balanced signed-digit recoding (the shared-bucket engine's
+    digit form): digits stay in (−2^11, 2^11] and Σ d_i·2^(12i)
+    reconstructs the scalar exactly, across every width class the
+    unified aggregate folds (64-bit products never appear, but 128-bit
+    coefficients and 253-bit mod-L products both do)."""
+    random.seed(7)
+    for nbits in (64, 128, 253):
+        ks = [random.randrange(1 << nbits) for _ in range(50)]
+        ks[0] = 0
+        ks[1] = (1 << nbits) - 1
+        d = np.asarray(msm.recode_signed(_limbs_col(ks), nbits))
+        assert d.shape[0] == msm.signed_digit_windows(nbits)
+        assert (np.abs(d) <= 1 << 11).all()
+        for j, k in enumerate(ks):
+            got = sum(int(v) << (12 * i) for i, v in enumerate(d[:, j]))
+            assert got == k, (nbits, j)
+
+
+@pytest.mark.slow
+def test_msm_shared_two_group_matches_host(rng_points):
+    """ONE shared bucket pass over two width-segmented groups (the
+    unified aggregate's exact shape: narrow Fiat–Shamir coefficients +
+    wide mod-L products) equals the host fold — including a zero
+    scalar, a duplicated scalar and an L−1 wide scalar."""
+    pts_a = rng_points[:4]
+    pts_b = rng_points[4:]
+    ks_a = [random.randrange(1 << 64) for _ in pts_a]
+    ks_a[0] = 0
+    ks_a[1] = ks_a[2]
+    ks_b = [random.randrange(he.L) for _ in pts_b]
+    ks_b[0] = he.L - 1
+    got = msm.msm_shared([
+        (_limbs_col(ks_a), _points_col(pts_a), 64),
+        (_limbs_col(ks_b), _points_col(pts_b), 253),
+    ])
+    enc = np.asarray(pc.compress(got))[:, 0].astype(np.uint8).tobytes()
+    acc = he.IDENT
+    for k, p in zip(ks_a + ks_b, pts_a + pts_b):
+        acc = he.point_add(acc, he.point_mul(k, p))
+    assert enc == he.point_compress(acc)
+
+
+@pytest.mark.slow
+def test_msm_shared_cancellation_is_identity(rng_points):
+    """k·P + k·(−P) = 0 through the signed-digit shared engine — the
+    accept condition of the unified aggregate (identity equality after
+    the one folded bucket pass)."""
+    p = rng_points[0]
+    k = random.randrange(1 << 64)
+    total = msm.msm_shared([
+        (_limbs_col([k, k]), _points_col([p, he.point_neg(p)]), 64),
+    ])
+    assert bool(msm.is_identity(total)[0])
+
+
 def test_mul_sum_mod_l_match_python():
     random.seed(11)
     a = [random.randrange(he.L) for _ in range(5)]
@@ -157,6 +213,42 @@ def test_fs_coefficients_deterministic_and_reorder_invariant():
     # distinct lanes get (overwhelmingly) distinct coefficients
     flat = np.concatenate([z.T for z in z_a], axis=-1)
     assert len({r.tobytes() for r in flat}) == flat.shape[0]
+
+
+def test_fs_coefficients_odd_on_all_four_lanes():
+    """Round-15 extension of the PR-3 cofactor-coprime forcing: ALL
+    FOUR coefficient streams (z1 ed, z2 kes — new with the unified
+    fold — z3/z4 vrf) carry a forced-odd low bit in every lane, and an
+    odd z keeps any nonzero 8-torsion offset alive: z·T ≠ 0 for the
+    order-8 generator, host-checked per stream. This is the property
+    that makes single-lane torsion grinding on the ed/kes wire points
+    detectable by the one aggregated identity check."""
+    from ouroboros_consensus_tpu.ops.pk import aggregate as agg
+
+    args = _fs_inputs(6, seed=42)
+    zs = [np.asarray(z) for z in jax.jit(agg.fs_coefficients)(*args)]
+    assert len(zs) == 4
+    # order-8 torsion generator: [L]Q for a decompressable Q with a
+    # full-order torsion component
+    t8 = None
+    for b0 in range(256):
+        q = he.point_decompress(bytes([b0]) + bytes(31))
+        if q is None:
+            continue
+        cand = he.point_mul(he.L, q)
+        if (not he.point_equal(cand, he.IDENT)
+                and not he.point_equal(he.point_mul(4, cand), he.IDENT)):
+            t8 = cand
+            break
+    assert t8 is not None
+    for z in zs:
+        assert (z[0] & 1 == 1).all()
+        for lane in range(z.shape[-1]):
+            zi = int.from_bytes(bytes(z[:, lane].astype(np.uint8)),
+                                "little")
+            assert zi & 1 == 1
+            assert not he.point_equal(he.point_mul(zi % (8 * he.L), t8),
+                                      he.IDENT)
 
 
 # ---------------------------------------------------------------------------

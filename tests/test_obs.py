@@ -816,7 +816,7 @@ def test_compile_wall_refusal_is_visible_telemetry(monkeypatch):
                         lambda *cols: _stub_verdicts(cols))
     monkeypatch.setattr(
         pbatch, "_jitted_packed_agg",
-        lambda layout, scan: pytest.fail(
+        lambda layout, scan, mode="all": pytest.fail(
             "refused aggregate program was still dispatched"),
     )
     before = set(pbatch._JIT)
@@ -1009,7 +1009,8 @@ def test_lint_changed_maps_obs_sources_to_purity_graphs():
         "ouroboros_consensus_tpu/obs/ledger.py",
         "ouroboros_consensus_tpu/ops/pk/msm.py",
     })
-    assert set(sel) == purity | {"aggregate_core", "msm"}
+    assert set(sel) == purity | {"aggregate_core", "aggregate_vrf_core",
+                                 "msm"}
     # and still selects nothing for unrelated files
     assert lint._select_graphs({"README.md"}) == []
 
