@@ -644,6 +644,7 @@ class _HeartbeatTail:
         self.attempt = attempt
         self._t0 = time.monotonic()
         self._state = None
+        self.errors = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="bench-hb-tail", daemon=True
@@ -678,8 +679,23 @@ class _HeartbeatTail:
         while not self._stop.wait(self.POLL_S):
             try:
                 self._poll()
-            except Exception:  # noqa: BLE001 — tailing never kills bench
-                pass
+            except Exception as exc:  # noqa: BLE001 — tailing never
+                self._note_tail_error(exc)  # kills bench, nor hides
+
+    def _note_tail_error(self, exc: BaseException) -> None:
+        """Tail failures ride the timeline they were hiding from: one
+        `tail-error` entry for the FIRST failure (bounded — a wedged
+        reader would otherwise spam an entry per poll), plus a count
+        any later entry's consumer can see on the object."""
+        self.errors += 1
+        if self.errors != 1:
+            return
+        self.timeline.append({
+            "t": round(time.monotonic() - self._t0, 1),
+            "attempt": self.attempt,
+            "state": "tail-error",
+            "error": f"{type(exc).__name__}: {exc}"[:200],
+        })
 
     def stop(self) -> None:
         self._stop.set()

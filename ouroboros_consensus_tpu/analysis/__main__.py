@@ -13,6 +13,11 @@ Subcommands:
              obs/resources.py) vs the budgets.json "device_resources"
              section: hash-freshness + ceiling compares only — traces
              for the fresh feature hashes, never compiles
+  sync       octsync concurrency & durability-protocol sweep
+             (analysis/concurrency.py): lock-order / guarded-attribute
+             / thread-lifecycle / tmp-fsync-rename checkers vs the
+             analysis/concurrency.json ratchet. Pure AST — never
+             imports jax
 
 Shared options:
   --json            machine-readable report on stdout (keys sorted —
@@ -30,6 +35,12 @@ range/taint options:
   --tier {fast,full}  lane-sweep tier from shapes.json (default fast)
   --no-ratchet        report only; skip the certified.json comparison
 
+sync options:
+  --paths P [P...]  sweep these files/dirs instead of the default roots
+                    (package + scripts/ + bench.py)
+  --all             include suppressed findings in the report
+  --no-ratchet      report only; skip the concurrency.json comparison
+
 Exit codes (distinct so CI can tell WHY the gate failed):
   0  clean
   1  unsuppressed AST finding(s)
@@ -42,8 +53,11 @@ Exit codes (distinct so CI can tell WHY the gate failed):
      "device_resources" pin, a stale-structure pin — feature hash no
      longer matching the traced graph — or a pinned FLOP/byte/peak-HBM
      value over its ceiling)
+  7  octsync concurrency ratchet violation (a new unsuppressed
+     lock/thread/durability finding, lock-or-thread inventory drift,
+     or a stale suppression)
 When several classes fire at once the lowest code wins
-(1 < 3 < 4 < 5 < 6).
+(1 < 3 < 4 < 5 < 6 < 7).
 """
 
 from __future__ import annotations
@@ -61,6 +75,7 @@ EXIT_BUDGET = 3
 EXIT_CERT = 4
 EXIT_COST = 5
 EXIT_RESOURCES = 6
+EXIT_SYNC = 7
 
 
 def _package_root() -> str:
@@ -233,6 +248,62 @@ def _cmd_resources(args) -> int:
     return EXIT_RESOURCES if violations else EXIT_OK
 
 
+def _cmd_sync(args) -> int:
+    """octsync: concurrency & durability-protocol sweep vs the
+    concurrency.json ratchet (sorted-keys --json is byte-stable for CI
+    diffing). Pure AST — jax is never imported on this route."""
+    from . import concurrency
+
+    repo = os.path.dirname(_package_root())
+    paths = args.paths or concurrency.default_roots(repo)
+    report = concurrency.sweep_paths(
+        paths, repo, concurrency.load_roots()
+    )
+    violations: list[str] = []
+    stale: list[str] = []
+    if not args.no_ratchet:
+        violations, stale = concurrency.check_sync(
+            report, concurrency.load_baseline()
+        )
+    shown = (report.findings if args.all
+             else [f for f in report.findings if not f.suppressed])
+    lines = [f.format() for f in shown]
+    lines.extend(f"SYNC: {v}" for v in violations)
+    lines.extend(
+        f"note: concurrency baseline entry no longer fires "
+        f"(run scripts/lint.py --update-sync to ratchet): {k}"
+        for k in stale
+    )
+    n_sup = sum(1 for f in report.findings if f.suppressed)
+    lines.append(
+        f"octsync: {len(shown)} finding(s), {n_sup} suppressed, "
+        f"{len(violations)} ratchet violation(s), "
+        f"{len(stale)} stale ratchet entr(y/ies)"
+    )
+    _emit(
+        {
+            "findings": [
+                {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "line": f.line,
+                    "col": f.col,
+                    "message": f.message,
+                    "suppressed": f.suppressed,
+                    "key": f.key(),
+                }
+                for f in shown
+            ],
+            "inventory": report.inventory,
+            "violations": violations,
+            "stale": stale,
+            "ok": not violations,
+        },
+        args.json, lines,
+    )
+    return EXIT_SYNC if violations else EXIT_OK
+
+
 def _cmd_pointops(args) -> int:
     _pin_cpu()
     budgets = graphs.load_budgets(args.budgets)
@@ -386,6 +457,14 @@ def main(argv: list[str] | None = None) -> int:
     common(sub.add_parser("cost"))
     common(sub.add_parser("resources"))
 
+    p = sub.add_parser("sync")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--paths", nargs="+", default=None)
+    p.add_argument("--all", action="store_true",
+                   help="include suppressed findings")
+    p.add_argument("--no-ratchet", action="store_true",
+                   help="skip the concurrency.json comparison")
+
     args = ap.parse_args(argv)
     if args.cmd in ("range", "taint"):
         return _cmd_certify(args, args.cmd)
@@ -395,6 +474,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_cost(args)
     if args.cmd == "resources":
         return _cmd_resources(args)
+    if args.cmd == "sync":
+        return _cmd_sync(args)
     # default-run graph names must be registered (certification targets
     # include aux graphs; the default run's budget pass does not)
     if args.graphs:

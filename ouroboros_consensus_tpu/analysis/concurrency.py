@@ -1,0 +1,1397 @@
+"""Pass 5 — octsync: concurrency & durability-protocol analyzer.
+
+The four existing passes certify the *device graphs*; octsync checks
+the host-side thread/lock/rename fabric those graphs run inside — the
+threaded staging producer, the warm-ladder background compiler, the
+heartbeat/watchdog/metrics-server threads, the flock'd AOT store and
+the guard/marker tmp+rename durability protocol. Three checkers:
+
+Lock discipline
+  SYNC201 lock-order-inversion   the interprocedural lock-acquisition
+                                 graph (every `with <lock>` scope plus
+                                 the locks acquired by every function
+                                 called inside it) contains a cycle —
+                                 two code paths can take the same pair
+                                 of locks in opposite orders, or a
+                                 non-reentrant lock can be re-acquired
+                                 under itself through a call chain.
+  SYNC202 acquire-without-release
+                                 a bare `.acquire()` (or an exclusive
+                                 `fcntl.flock`) with no `.release()` /
+                                 `LOCK_UN` anywhere in the same
+                                 function. Lock-manager methods whose
+                                 CONTRACT is to hold (`acquire`,
+                                 `open`, `__enter__`) are exempt.
+  SYNC203 unguarded-attribute    an attribute annotated
+                                 `# guarded-by: <lock>` on its
+                                 assignment line is touched by a
+                                 thread-entry-reachable function
+                                 outside a `with <lock>` scope.
+
+Thread lifecycle
+  SYNC204 unjoined-thread        a non-daemon `threading.Thread` with
+                                 no `.join()` anywhere in its module —
+                                 interpreter shutdown blocks on it
+                                 with no shutdown path of its own.
+  SYNC205 escaping-thread-exception
+                                 a thread target either has no broad
+                                 (bare / Exception / BaseException)
+                                 handler at all — the exception kills
+                                 the thread silently on stderr — or
+                                 has a broad handler whose body is
+                                 only `pass`/`continue`: swallowed
+                                 without feeding any recorder seam.
+  SYNC206 unbalanced-recorder-install
+                                 a function pairs a recorder install
+                                 (`install`/`maybe_arm`) with its
+                                 uninstall (`uninstall`/`disarm`) but
+                                 the uninstall only sits on the
+                                 straight-line path — an exception
+                                 between the two leaks an armed
+                                 recorder (the partial-arm bug class).
+
+Durability protocol
+  SYNC207 bare-write-to-protected-path
+                                 `open(path, "w")` where `path` taints
+                                 from a protected root (the env levers
+                                 and path-producing functions declared
+                                 in analysis/sync_roots.json): every
+                                 write under a guarded store path must
+                                 ride write-tmp -> fsync -> rename
+                                 (`write_atomic`, `fs.replace`, the
+                                 guard's marker writer). A `+ ".tmp"`
+                                 target is blessed only when the same
+                                 function also calls a `replace`.
+  SYNC208 stale-suppression      an `# octsync: disable=...` comment
+                                 that suppresses nothing on the
+                                 current tree (suppression rot).
+
+Suppression grammar (same shape as octlint's):
+
+  self._x = 0   # octsync: disable=SYNC203  <why it is safe here>
+  # `# octsync: disable` (no rule list) suppresses all rules on that
+  # line; the def-line suppresses the whole body;
+  # `# octsync: disable-file=SYNC207` suppresses the file.
+
+Annotation grammar:
+
+  self.stages = {}   # guarded-by: _lock
+
+ties the attribute to the lock *name*; holding is credited leniently
+by trailing name (`with self._lock:`, `with WARMUP._lock:` both hold
+`_lock`), so a shared-lock handoff (`self._lock = lock`) still counts.
+
+octsync is a static over-approximation and proves nothing about the
+C++ scanner threads, OS-level flock semantics across filesystems, or
+GIL-dependent atomicity of single bytecode ops — see
+analysis/README.md for the full caveat list.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable
+
+from .astlint import _attr_chain, _comment_lines
+
+RULES = {
+    "SYNC201": "lock-order-inversion",
+    "SYNC202": "acquire-without-release",
+    "SYNC203": "unguarded-attribute",
+    "SYNC204": "unjoined-thread",
+    "SYNC205": "escaping-thread-exception",
+    "SYNC206": "unbalanced-recorder-install",
+    "SYNC207": "bare-write-to-protected-path",
+    "SYNC208": "stale-suppression",
+}
+
+_RULE_LIST = r"[A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*"
+_SUPPRESS_RE = re.compile(
+    rf"#\s*octsync:\s*disable(?:=({_RULE_LIST}))?(?=[\s,]|$)"
+)
+_SUPPRESS_FILE_RE = re.compile(
+    rf"#\s*octsync:\s*disable-file=({_RULE_LIST})"
+)
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_REENTRANT = {"RLock", "Condition"}  # Condition wraps an RLock by default
+_INSTALLERS = {"install", "maybe_arm"}
+_UNINSTALLERS = {"uninstall", "disarm"}
+# lock-manager methods whose contract is to hold across return
+_HOLDER_NAMES = {"acquire", "open", "__enter__", "promote_writer"}
+_WRITE_MODES = ("w", "a", "x", "+")
+
+_ROOTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "sync_roots.json")
+_BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "concurrency.json")
+
+
+def load_roots(path: str | None = None) -> dict:
+    with open(path or _ROOTS_PATH, encoding="utf-8") as f:
+        return json.load(f)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    seq: int = 0  # ordinal among same-keyed findings (see astlint)
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"[{RULES[self.rule]}] {self.message}{tag}"
+
+    def key(self) -> str:
+        base = f"{self.rule}::{self.path}::{self.message}"
+        return base if self.seq == 0 else f"{base}::#{self.seq}"
+
+
+# ---------------------------------------------------------------------------
+# Per-module model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Func:
+    module: str
+    qualname: str  # "Class.method" for methods
+    node: ast.AST
+    cls: str | None = None
+    thread_entry: bool = False
+    thread_reachable: bool = False
+    # locks this function acquires directly (strict identities)
+    acquires: set = dataclasses.field(default_factory=set)
+    # strict identities acquired here or in any resolvable callee
+    trans_acquires: set = dataclasses.field(default_factory=set)
+    calls: list = dataclasses.field(default_factory=list)  # resolved later
+    children: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Guarded:
+    module: str
+    cls: str | None  # None = module-level name
+    attr: str
+    lock: str  # lock NAME from the annotation (lenient matching)
+    line: int
+
+    def ident(self) -> str:
+        owner = f"{self.module}.{self.cls}" if self.cls else self.module
+        return f"{owner}.{self.attr} -> {self.lock}"
+
+
+class _Module:
+    def __init__(self, modname: str, path: str, tree: ast.Module,
+                 source: str):
+        self.modname = modname
+        self.path = path
+        self.tree = tree
+        self.mod_aliases: dict[str, str] = {}
+        self.sym_imports: dict[str, tuple[str, str]] = {}
+        self.functions: dict[str, _Func] = {}
+        # lock identity -> ctor name ("Lock"/"RLock"/"Condition")
+        self.locks: dict[str, str] = {}
+        # module-level `NAME = ClassName(...)` -> (module, ClassName)
+        self.instances: dict[str, tuple[str, str]] = {}
+        # module-level `NAME = "literal"` (the `_ENV = "OCT_X"` idiom)
+        self.str_consts: dict[str, str] = {}
+        self.classes: set[str] = set()
+        self.guarded: list[_Guarded] = []
+        self.suppress_file: set[str] = set()
+        self.suppress_line: dict[int, set[str] | None] = {}
+        self.suppress_decls: list[list] = []
+        self._guard_comments: dict[int, str] = {}
+        self._scan_comments(source)
+        self._scan()
+
+    # -- comments: suppressions + guarded-by annotations --------------------
+
+    def _scan_comments(self, source: str) -> None:
+        for i, line in _comment_lines(source):
+            g = _GUARDED_BY_RE.search(line)
+            if g:
+                self._guard_comments[i] = g.group(1)
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                self.suppress_file |= rules
+                self.suppress_decls.append([i, rules, True, False])
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = m.group(1)
+                if rules is None:
+                    self.suppress_line[i] = None
+                    self.suppress_decls.append([i, None, False, False])
+                else:
+                    rs = {r.strip() for r in rules.split(",") if r.strip()}
+                    self.suppress_line[i] = rs
+                    self.suppress_decls.append([i, rs, False, False])
+
+    def _mark_used(self, line: int | None, rule: str, file_level: bool):
+        for d in self.suppress_decls:
+            if d[2] != file_level:
+                continue
+            if file_level:
+                if d[1] is not None and rule in d[1]:
+                    d[3] = True
+                    return
+            elif d[0] == line and (d[1] is None or rule in d[1]):
+                d[3] = True
+                return
+
+    def is_suppressed(self, rule: str, line: int,
+                      def_line: int | None) -> bool:
+        if rule in self.suppress_file:
+            self._mark_used(None, rule, True)
+            return True
+        for ln in (line, def_line):
+            if ln is None:
+                continue
+            rules = self.suppress_line.get(ln, "missing")
+            if rules is None or (rules != "missing" and rule in rules):
+                self._mark_used(ln, rule, False)
+                return True
+        return False
+
+    def stale_suppressions(self) -> list[Finding]:
+        out = []
+        for d in self.suppress_decls:
+            if d[3]:
+                continue
+            line, rules, file_level, _ = d
+            what = "all rules" if rules is None else ",".join(sorted(rules))
+            kind = "disable-file" if file_level else "disable"
+            sup = self.is_suppressed("SYNC208", line, None)
+            out.append(Finding(
+                "SYNC208", self.path, line, 0,
+                f"`# octsync: {kind}={what}` suppresses nothing on the "
+                "current tree — remove the stale comment",
+                sup,
+            ))
+        return out
+
+    # -- structure -----------------------------------------------------------
+
+    def _resolve_relative(self, node: ast.ImportFrom) -> str:
+        base = self.modname.split(".")
+        if node.level:
+            base = base[: len(base) - node.level]
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    def _scan(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.mod_aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom):
+                src = (self._resolve_relative(node) if node.level
+                       else (node.module or ""))
+                for a in node.names:
+                    name = a.asname or a.name
+                    self.mod_aliases[name] = f"{src}.{a.name}"
+                    self.sym_imports[name] = (src, a.name)
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self.classes.add(stmt.name)
+            elif isinstance(stmt, ast.Assign):
+                if isinstance(stmt.value, ast.Constant) and \
+                        isinstance(stmt.value.value, str):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.str_consts[t.id] = stmt.value.value
+                    continue
+                if not isinstance(stmt.value, ast.Call):
+                    continue
+                ctor = _lock_ctor(stmt.value, self)
+                cls = _instance_class(stmt.value, self)
+                for t in stmt.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if ctor:
+                        self.locks[f"{self.modname}.{t.id}"] = ctor
+                    elif cls:
+                        self.instances[t.id] = cls
+        self._collect(self.tree, prefix="", cls=None)
+        # guarded-by annotations attach to the assignment on their line
+        self._collect_guarded()
+
+    def _collect(self, node: ast.AST, prefix: str, cls: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                info = _Func(self.modname, qn, child, cls=cls)
+                self.functions[qn] = info
+                self._collect(child, prefix=f"{qn}.", cls=cls)
+                for sub in self.functions.values():
+                    if sub.qualname.startswith(f"{qn}."):
+                        info.children.append(sub.qualname)
+                # instance locks: `self.X = threading.Lock()` in a body
+                if cls is not None:
+                    for sub in ast.walk(child):
+                        if isinstance(sub, ast.Assign) and \
+                                isinstance(sub.value, ast.Call):
+                            ctor = _lock_ctor(sub.value, self)
+                            if not ctor:
+                                continue
+                            for t in sub.targets:
+                                if isinstance(t, ast.Attribute) and \
+                                        isinstance(t.value, ast.Name) and \
+                                        t.value.id in ("self", "cls"):
+                                    lid = f"{self.modname}.{cls}.{t.attr}"
+                                    self.locks[lid] = ctor
+            elif isinstance(child, ast.ClassDef):
+                self._collect(child, prefix=f"{prefix}{child.name}.",
+                              cls=child.name)
+            elif not isinstance(child, ast.Lambda):
+                self._collect(child, prefix=prefix, cls=cls)
+
+    def _collect_guarded(self) -> None:
+        if not self._guard_comments:
+            return
+
+        def visit(node: ast.AST, cls: str | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                    continue
+                if isinstance(child, (ast.Assign, ast.AnnAssign)):
+                    lock = self._guard_comments.get(child.lineno)
+                    if lock:
+                        targets = (child.targets
+                                   if isinstance(child, ast.Assign)
+                                   else [child.target])
+                        for t in targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id in ("self", "cls"):
+                                self.guarded.append(_Guarded(
+                                    self.modname, cls, t.attr, lock,
+                                    child.lineno))
+                            elif isinstance(t, ast.Name) and cls is None:
+                                self.guarded.append(_Guarded(
+                                    self.modname, None, t.id, lock,
+                                    child.lineno))
+                visit(child, cls)
+
+        # class context for a method body's assignments comes from the
+        # enclosing ClassDef chain, which visit() threads through
+        visit(self.tree, None)
+
+
+def _lock_ctor(call: ast.Call, model: _Module) -> str | None:
+    """threading.Lock()/RLock()/Condition() (alias-aware) -> ctor name."""
+    chain = _attr_chain(call.func)
+    if not chain or chain[-1] not in _LOCK_CTORS:
+        return None
+    if len(chain) == 1:
+        src = model.sym_imports.get(chain[0], ("", ""))[0]
+        return chain[0] if src == "threading" else None
+    return chain[-1] if model.mod_aliases.get(chain[0]) == "threading" \
+        else None
+
+
+def _instance_class(call: ast.Call, model: _Module) \
+        -> tuple[str, str] | None:
+    """`NAME = ClassName(...)` -> (defining module, ClassName)."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id in model.classes:
+            return (model.modname, f.id)
+        if f.id in model.sym_imports:
+            return model.sym_imports[f.id]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The package: cross-module call graph + thread reachability
+# ---------------------------------------------------------------------------
+
+
+class SyncPackage:
+    def __init__(self, roots: list[str], rel_to: str,
+                 roots_table: dict | None = None):
+        self.rel_to = rel_to
+        self.roots_table = roots_table or load_roots()
+        self.modules: dict[str, _Module] = {}
+        for root in roots:
+            self._load(root)
+        self._resolve_all_calls()
+        self._mark_threads()
+        self._close_acquires()
+
+    # -- loading -------------------------------------------------------------
+
+    def _iter_sources(self, root: str) -> Iterable[tuple[str, str]]:
+        if os.path.isfile(root):
+            yield os.path.splitext(os.path.basename(root))[0], root
+            return
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, os.path.dirname(root))
+                mod = rel[:-3].replace(os.sep, ".")
+                if mod.endswith(".__init__"):
+                    mod = mod[: -len(".__init__")]
+                yield mod, full
+
+    def _load(self, root: str) -> None:
+        for modname, path in self._iter_sources(root):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=path)
+            except (SyntaxError, OSError):
+                continue
+            rel = os.path.relpath(path, self.rel_to)
+            self.modules[modname] = _Module(modname, rel, tree, source)
+
+    # -- call resolution -----------------------------------------------------
+
+    def _lookup(self, modname: str | None, fname: str) -> _Func | None:
+        if modname is None:
+            return None
+        model = self.modules.get(modname)
+        if model is None:
+            return None
+        if fname in model.functions:
+            return model.functions[fname]
+        if fname in model.sym_imports:
+            src, sym = model.sym_imports[fname]
+            if src != modname:
+                return self._lookup(src, sym)
+        return None
+
+    def _instance_of(self, model: _Module, name: str) \
+            -> tuple[str, str] | None:
+        """Resolve a bare name to a (module, Class) instance, through
+        `from m import NAME` re-exports."""
+        if name in model.instances:
+            return model.instances[name]
+        if name in model.sym_imports:
+            src, sym = model.sym_imports[name]
+            srcm = self.modules.get(src)
+            if srcm is not None and src != model.modname:
+                return self._instance_of(srcm, sym)
+        return None
+
+    def resolve_call(self, model: _Module, info: _Func | None,
+                     func: ast.expr) -> _Func | None:
+        if isinstance(func, ast.Name):
+            name = func.id
+            if info is not None:
+                prefix = info.qualname
+                while "." in prefix:
+                    prefix = prefix.rsplit(".", 1)[0]
+                    qn = f"{prefix}.{name}"
+                    if qn in model.functions:
+                        return model.functions[qn]
+            if name in model.functions:
+                return model.functions[name]
+            if name in model.sym_imports:
+                src, sym = model.sym_imports[name]
+                return self._lookup(src, sym)
+            return None
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            base, meth = func.value.id, func.attr
+            if base in ("self", "cls") and info is not None and \
+                    info.cls is not None:
+                return model.functions.get(f"{info.cls}.{meth}")
+            inst = self._instance_of(model, base)
+            if inst is not None:
+                src, cls = inst
+                srcm = self.modules.get(src)
+                if srcm is not None:
+                    return srcm.functions.get(f"{cls}.{meth}")
+            mod = model.mod_aliases.get(base)
+            if mod is not None:
+                return self._lookup(mod, meth)
+        return None
+
+    def _resolve_all_calls(self) -> None:
+        for model in self.modules.values():
+            for info in model.functions.values():
+                for sub in _own_nodes(info.node):
+                    if isinstance(sub, ast.Call):
+                        target = self.resolve_call(model, info, sub.func)
+                        if target is not None and target is not info:
+                            info.calls.append(target)
+
+    # -- thread entries + reachability ---------------------------------------
+
+    def thread_sites(self) -> list[tuple[_Module, _Func | None, ast.Call]]:
+        """Every `threading.Thread(...)` construction site."""
+        out = []
+        for model in self.modules.values():
+            seen: set[int] = set()
+            for info in model.functions.values():
+                for sub in _own_nodes(info.node):
+                    if isinstance(sub, ast.Call) and \
+                            _is_thread_ctor(sub, model):
+                        out.append((model, info, sub))
+                        seen.add(id(sub))
+            for sub in ast.walk(model.tree):
+                if isinstance(sub, ast.Call) and id(sub) not in seen \
+                        and _is_thread_ctor(sub, model):
+                    out.append((model, None, sub))
+        return out
+
+    def thread_target(self, model: _Module, info: _Func | None,
+                      call: ast.Call) -> _Func | None:
+        expr = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                expr = kw.value
+        if expr is None and call.args:
+            expr = call.args[0]
+        if expr is None or isinstance(expr, ast.Lambda):
+            return None
+        return self.resolve_call(model, info, expr)
+
+    def _mark_threads(self) -> None:
+        work: list[_Func] = []
+        for model, info, call in self.thread_sites():
+            target = self.thread_target(model, info, call)
+            if target is not None and not target.thread_entry:
+                target.thread_entry = True
+                if not target.thread_reachable:
+                    target.thread_reachable = True
+                    work.append(target)
+        while work:
+            info = work.pop()
+            model = self.modules[info.module]
+            nxt = list(info.calls)
+            nxt.extend(model.functions[qn] for qn in info.children)
+            for t in nxt:
+                if not t.thread_reachable:
+                    t.thread_reachable = True
+                    work.append(t)
+
+    # -- lock acquisition closure --------------------------------------------
+
+    def resolve_lock(self, model: _Module, info: _Func | None,
+                     expr: ast.expr) -> str | None:
+        """Strict lock identity of a `with` item / acquire receiver:
+        must resolve to a declared Lock/RLock/Condition."""
+        if isinstance(expr, ast.Name):
+            lid = f"{model.modname}.{expr.id}"
+            if lid in model.locks:
+                return lid
+            if expr.id in model.sym_imports:
+                src, sym = model.sym_imports[expr.id]
+                srcm = self.modules.get(src)
+                if srcm is not None and f"{src}.{sym}" in srcm.locks:
+                    return f"{src}.{sym}"
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base in ("self", "cls") and info is not None and \
+                    info.cls is not None:
+                lid = f"{model.modname}.{info.cls}.{attr}"
+                return lid if lid in model.locks else None
+            inst = self._instance_of(model, base)
+            if inst is not None:
+                src, cls = inst
+                srcm = self.modules.get(src)
+                if srcm is not None:
+                    lid = f"{src}.{cls}.{attr}"
+                    return lid if lid in srcm.locks else None
+        return None
+
+    def lock_kind(self, lid: str) -> str:
+        for model in self.modules.values():
+            if lid in model.locks:
+                return model.locks[lid]
+        return "Lock"
+
+    def _direct_acquires(self, model: _Module, info: _Func) -> set:
+        out = set()
+        for sub in _own_nodes(info.node):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    lid = self.resolve_lock(model, info,
+                                            item.context_expr)
+                    if lid:
+                        out.add(lid)
+            elif isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "acquire":
+                lid = self.resolve_lock(model, info, sub.func.value)
+                if lid:
+                    out.add(lid)
+        return out
+
+    def _close_acquires(self) -> None:
+        for model in self.modules.values():
+            for info in model.functions.values():
+                info.acquires = self._direct_acquires(model, info)
+                info.trans_acquires = set(info.acquires)
+        changed = True
+        while changed:
+            changed = False
+            for model in self.modules.values():
+                for info in model.functions.values():
+                    for callee in info.calls:
+                        extra = callee.trans_acquires - info.trans_acquires
+                        if extra:
+                            info.trans_acquires |= extra
+                            changed = True
+
+
+def _own_nodes(n: ast.AST):
+    """Walk a function body excluding nested def/class bodies."""
+    yield n
+    for child in ast.iter_child_nodes(n):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)) and child is not n:
+            continue
+        yield from _own_nodes(child)
+
+
+def _is_thread_ctor(call: ast.Call, model: _Module) -> bool:
+    chain = _attr_chain(call.func)
+    if not chain or chain[-1] != "Thread":
+        return False
+    if len(chain) == 1:
+        return model.sym_imports.get("Thread", ("", ""))[0] == "threading"
+    return model.mod_aliases.get(chain[0]) == "threading"
+
+
+# ---------------------------------------------------------------------------
+# Checker 1 — lock discipline
+# ---------------------------------------------------------------------------
+
+
+def _lock_order_edges(pkg: SyncPackage):
+    """(held, acquired, model, node) for every acquisition performed —
+    directly or through a resolvable call — inside a `with <lock>`."""
+    edges = []
+
+    def scan(model: _Module, info: _Func, node: ast.AST,
+             held: tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            now = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    lid = pkg.resolve_lock(model, info, item.context_expr)
+                    if lid:
+                        for h in now:
+                            edges.append((h, lid, model, child))
+                        now = now + (lid,)
+            elif isinstance(child, ast.Call) and held:
+                target = pkg.resolve_call(model, info, child.func)
+                acq = set()
+                if target is not None:
+                    acq = target.trans_acquires
+                elif isinstance(child.func, ast.Attribute) and \
+                        child.func.attr == "acquire":
+                    lid = pkg.resolve_lock(model, info, child.func.value)
+                    if lid:
+                        acq = {lid}
+                for lid in acq:
+                    for h in now:
+                        edges.append((h, lid, model, child))
+            scan(model, info, child, now)
+
+    for model in pkg.modules.values():
+        for info in model.functions.values():
+            scan(model, info, info.node, ())
+    return edges
+
+
+def _check_lock_order(pkg: SyncPackage) -> list[Finding]:
+    edges = _lock_order_edges(pkg)
+    graph: dict[str, set[str]] = {}
+    site: dict[tuple[str, str], tuple[_Module, ast.AST]] = {}
+    for a, b, model, node in edges:
+        if a == b and pkg.lock_kind(a) in _REENTRANT:
+            continue
+        graph.setdefault(a, set()).add(b)
+        key = (a, b)
+        prev = site.get(key)
+        if prev is None or (model.path, node.lineno) < \
+                (prev[0].path, prev[1].lineno):
+            site[key] = (model, node)
+    out = []
+    reported: set[frozenset] = set()
+    for a, b in sorted(site):
+        # a cycle through this edge: b can (transitively) lead back to a
+        if a == b:
+            cyc = {a}
+        else:
+            seen, stack, cyc = {b}, [b], None
+            while stack:
+                n = stack.pop()
+                if a in graph.get(n, ()):
+                    cyc = seen | {a}
+                    break
+                for m in graph.get(n, ()):
+                    if m not in seen:
+                        seen.add(m)
+                        stack.append(m)
+            if cyc is None:
+                continue
+        fz = frozenset(cyc)
+        if fz in reported:
+            continue
+        reported.add(fz)
+        model, node = site[(a, b)]
+        names = " -> ".join(sorted(cyc)) + f" -> {sorted(cyc)[0]}"
+        info_fn = next(
+            (i.qualname for i in model.functions.values()
+             if i.node.lineno <= node.lineno <=
+             max(i.node.lineno, getattr(i.node, "end_lineno", 0) or 0)),
+            "<module>",
+        )
+        sup = model.is_suppressed("SYNC201", node.lineno, None)
+        out.append(Finding(
+            "SYNC201", model.path, node.lineno, node.col_offset,
+            f"lock-order inversion cycle {{{names}}} (one edge acquired "
+            f"in `{info_fn}`)", sup,
+        ))
+    return out
+
+
+def _check_acquire_release(pkg: SyncPackage) -> list[Finding]:
+    out = []
+    for model in pkg.modules.values():
+        for info in model.functions.values():
+            name = info.qualname.rsplit(".", 1)[-1]
+            if name in _HOLDER_NAMES:
+                continue
+            acquires, releases = [], 0
+            flock_ex, flock_un = [], 0
+            for sub in _own_nodes(info.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr == "acquire":
+                        lid = pkg.resolve_lock(model, info, f.value)
+                        if lid:
+                            acquires.append((sub, lid))
+                    elif f.attr == "release":
+                        releases += 1
+                    elif f.attr == "flock":
+                        flags = {c[-1] for a in sub.args[1:]
+                                 for c in [_attr_chain(a)] if c}
+                        for a in sub.args[1:]:
+                            for n in ast.walk(a):
+                                c = _attr_chain(n) if isinstance(
+                                    n, (ast.Attribute, ast.Name)) else []
+                                if c:
+                                    flags.add(c[-1])
+                        if "LOCK_UN" in flags:
+                            flock_un += 1
+                        elif {"LOCK_EX", "LOCK_SH"} & flags:
+                            flock_ex.append(sub)
+            if acquires and not releases:
+                sub, lid = acquires[0]
+                sup = model.is_suppressed("SYNC202", sub.lineno,
+                                          info.node.lineno)
+                out.append(Finding(
+                    "SYNC202", model.path, sub.lineno, sub.col_offset,
+                    f"`{lid}.acquire()` in `{info.qualname}` has no "
+                    "release on any path in this function", sup,
+                ))
+            if flock_ex and not flock_un:
+                sub = flock_ex[0]
+                sup = model.is_suppressed("SYNC202", sub.lineno,
+                                          info.node.lineno)
+                out.append(Finding(
+                    "SYNC202", model.path, sub.lineno, sub.col_offset,
+                    f"exclusive `fcntl.flock` in `{info.qualname}` has "
+                    "no LOCK_UN on any path in this function", sup,
+                ))
+    return out
+
+
+def _check_guarded(pkg: SyncPackage) -> list[Finding]:
+    guarded = [(g, m) for m in pkg.modules.values() for g in m.guarded]
+    if not guarded:
+        return []
+    by_class: dict[tuple[str, str], dict[str, _Guarded]] = {}
+    by_module: dict[tuple[str, str], _Guarded] = {}
+    for g, _ in guarded:
+        if g.cls:
+            by_class.setdefault((g.module, g.cls), {})[g.attr] = g
+        else:
+            by_module[(g.module, g.attr)] = g
+    out = []
+    for model in pkg.modules.values():
+        for info in model.functions.values():
+            if not info.thread_reachable:
+                continue
+            if info.qualname.rsplit(".", 1)[-1] == "__init__":
+                continue
+
+            def scan(node: ast.AST, held: frozenset) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        continue
+                    now = held
+                    if isinstance(child, (ast.With, ast.AsyncWith)):
+                        for item in child.items:
+                            chain = _attr_chain(item.context_expr)
+                            if chain:
+                                now = now | {chain[-1]}
+                    self_cls = info.cls
+                    if isinstance(child, ast.Attribute) and \
+                            isinstance(child.value, ast.Name):
+                        g = None
+                        base = child.value.id
+                        if base in ("self", "cls") and self_cls:
+                            g = by_class.get(
+                                (model.modname, self_cls), {}
+                            ).get(child.attr)
+                        else:
+                            inst = pkg._instance_of(model, base)
+                            if inst is not None:
+                                g = by_class.get(inst, {}).get(child.attr)
+                        if g is not None and g.lock not in now:
+                            sup = model.is_suppressed(
+                                "SYNC203", child.lineno, info.node.lineno)
+                            out.append(Finding(
+                                "SYNC203", model.path, child.lineno,
+                                child.col_offset,
+                                f"`{g.ident().split(' ->')[0]}` is "
+                                f"guarded-by `{g.lock}` but "
+                                f"`{info.qualname}` (thread-reachable) "
+                                "touches it outside a "
+                                f"`with {g.lock}` scope", sup,
+                            ))
+                    elif isinstance(child, ast.Name) and \
+                            (model.modname, child.id) in by_module:
+                        g = by_module[(model.modname, child.id)]
+                        if g.lock not in now and not isinstance(
+                                getattr(child, "ctx", None), ast.Store):
+                            sup = model.is_suppressed(
+                                "SYNC203", child.lineno, info.node.lineno)
+                            out.append(Finding(
+                                "SYNC203", model.path, child.lineno,
+                                child.col_offset,
+                                f"`{model.modname}.{child.id}` is "
+                                f"guarded-by `{g.lock}` but "
+                                f"`{info.qualname}` (thread-reachable) "
+                                "touches it outside a "
+                                f"`with {g.lock}` scope", sup,
+                            ))
+                    scan(child, now)
+
+            scan(info.node, frozenset())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Checker 2 — thread lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _thread_is_daemon(model: _Module, info: _Func | None,
+                      call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    # `t.daemon = True` after construction, anywhere in the same scope
+    scope = info.node if info is not None else model.tree
+    for sub in _own_nodes(scope) if info is not None else ast.walk(scope):
+        if isinstance(sub, ast.Assign) and \
+                isinstance(sub.value, ast.Constant) and sub.value.value:
+            for t in sub.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                    return True
+    return False
+
+
+def _module_has_join(model: _Module) -> set[str]:
+    """Receiver names with a `.join()` call anywhere in the module:
+    {'t'} for `t.join()`, {'_thread'} for `self._thread.join()`."""
+    out: set[str] = set()
+    for sub in ast.walk(model.tree):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == "join":
+            chain = _attr_chain(sub.func.value)
+            if chain:
+                out.add(chain[-1])
+    return out
+
+
+def _thread_binding(model: _Module, call: ast.Call) -> str | None:
+    """The name the Thread object is bound to: `t = Thread(...)` -> 't',
+    `self._thread = Thread(...)` -> '_thread'."""
+    for sub in ast.walk(model.tree):
+        if isinstance(sub, ast.Assign) and sub.value is call:
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    return t.id
+                if isinstance(t, ast.Attribute):
+                    return t.attr
+        # `self._thread = threading.Thread(...); ...` via intermediate:
+        # `t = Thread(...); self._thread = t` is covered by the Name arm
+    return None
+
+
+def _check_thread_lifecycle(pkg: SyncPackage) -> list[Finding]:
+    out = []
+    checked_targets: set[int] = set()
+    for model, info, call in pkg.thread_sites():
+        def_line = info.node.lineno if info is not None else None
+        # SYNC204 — non-daemon thread with no join on any shutdown path
+        if not _thread_is_daemon(model, info, call):
+            binding = _thread_binding(model, call)
+            joins = _module_has_join(model)
+            if binding is None or binding not in joins:
+                sup = model.is_suppressed("SYNC204", call.lineno, def_line)
+                where = info.qualname if info is not None else "<module>"
+                out.append(Finding(
+                    "SYNC204", model.path, call.lineno, call.col_offset,
+                    f"non-daemon Thread constructed in `{where}` is never "
+                    "joined in this module — interpreter shutdown blocks "
+                    "on it with no shutdown path", sup,
+                ))
+        # SYNC205 — target exception handling
+        target = pkg.thread_target(model, info, call)
+        if target is None or id(target.node) in checked_targets:
+            continue
+        checked_targets.add(id(target.node))
+        tmodel = pkg.modules[target.module]
+        broad_handlers = []
+        for sub in _own_nodes(target.node):
+            if isinstance(sub, ast.Try):
+                for h in sub.handlers:
+                    if _is_broad_handler(h):
+                        broad_handlers.append(h)
+        if not broad_handlers:
+            sup = tmodel.is_suppressed("SYNC205", target.node.lineno,
+                                       target.node.lineno)
+            out.append(Finding(
+                "SYNC205", tmodel.path, target.node.lineno,
+                target.node.col_offset,
+                f"thread target `{target.qualname}` has no broad "
+                "try/except: an exception kills the thread silently "
+                "(stderr only, nothing feeds the recorder)", sup,
+            ))
+        for h in broad_handlers:
+            if _handler_is_silent(h):
+                sup = tmodel.is_suppressed("SYNC205", h.lineno,
+                                           target.node.lineno)
+                out.append(Finding(
+                    "SYNC205", tmodel.path, h.lineno, h.col_offset,
+                    f"thread target `{target.qualname}` swallows broad "
+                    "exceptions with a pass-only handler — nothing "
+                    "feeds a recorder seam", sup,
+                ))
+    return out
+
+
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True
+    names = [h.type] if not isinstance(h.type, ast.Tuple) \
+        else list(h.type.elts)
+    for n in names:
+        chain = _attr_chain(n)
+        if chain and chain[-1] in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _handler_is_silent(h: ast.ExceptHandler) -> bool:
+    for stmt in h.body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, (ast.Call, ast.Raise)):
+                return False
+    return True
+
+
+def _check_install_pairs(pkg: SyncPackage) -> list[Finding]:
+    out = []
+    for model in pkg.modules.values():
+        for info in model.functions.values():
+            name = info.qualname.rsplit(".", 1)[-1]
+            if name in _INSTALLERS | _UNINSTALLERS:
+                continue  # the managers themselves, not a pairing site
+            installs, uninstalls = [], []
+            unwound: set[int] = set()  # uninstall calls under try-unwind
+            for sub in _own_nodes(info.node):
+                if isinstance(sub, ast.Try):
+                    for blk in ([h for hh in sub.handlers
+                                 for h in hh.body] + sub.finalbody):
+                        for s in ast.walk(blk):
+                            if isinstance(s, ast.Call) and \
+                                    _call_name(s) in _UNINSTALLERS:
+                                unwound.add(id(s))
+                if isinstance(sub, ast.Call):
+                    cn = _call_name(sub)
+                    if cn in _INSTALLERS:
+                        installs.append(sub)
+                    elif cn in _UNINSTALLERS:
+                        uninstalls.append(sub)
+            if installs and uninstalls and \
+                    not any(id(u) in unwound for u in uninstalls):
+                u = uninstalls[0]
+                sup = model.is_suppressed("SYNC206", u.lineno,
+                                          info.node.lineno)
+                out.append(Finding(
+                    "SYNC206", model.path, u.lineno, u.col_offset,
+                    f"`{info.qualname}` pairs a recorder install with an "
+                    "uninstall that only runs on the straight-line path "
+                    "— an exception between them leaks an armed "
+                    "recorder (wrap the uninstall in finally/except)",
+                    sup,
+                ))
+    return out
+
+
+def _call_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Checker 3 — durability protocol
+# ---------------------------------------------------------------------------
+
+_TAINT_FINAL = 1
+_TAINT_TMP = 2
+
+
+class _PathTaint:
+    """Per-function forward taint: names derived from a protected path
+    root. `p + '.tmp'` (or a join whose basename ends '.tmp') demotes
+    to tmp-taint, blessed iff the function also calls a `replace`."""
+
+    def __init__(self, pkg: SyncPackage, model: _Module, info: _Func):
+        self.pkg = pkg
+        self.model = model
+        self.info = info
+        roots = pkg.roots_table
+        self.env_roots = set(roots.get("env_path_levers", []))
+        self.fn_roots = {n for names in roots.get("path_fns", {}).values()
+                         for n in names}
+        self.exempt = set(roots.get("exempt_basenames", []))
+        self.taint: dict[str, int] = {}
+
+    def _lever_name(self, a: ast.expr) -> str | None:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+        if isinstance(a, ast.Name):  # the `_ENV = "OCT_X"` indirection
+            return self.model.str_consts.get(a.id)
+        return None
+
+    def _is_env_read(self, node: ast.Call) -> str | None:
+        """os.environ.get('X') / os.getenv('X') -> 'X' when protected."""
+        f = node.func
+        chain = _attr_chain(f)
+        lever = None
+        if chain and chain[-1] in ("get", "getenv") and node.args:
+            if chain[-1] == "getenv" or "environ" in chain:
+                lever = self._lever_name(node.args[0])
+        return lever if lever in self.env_roots else None
+
+    def expr_taint(self, node: ast.expr) -> int:
+        if isinstance(node, ast.Name):
+            return self.taint.get(node.id, 0)
+        if isinstance(node, ast.Subscript):
+            # os.environ["X"]
+            chain = _attr_chain(node.value)
+            if chain and chain[-1] == "environ" and \
+                    self._lever_name(node.slice) in self.env_roots:
+                return _TAINT_FINAL
+            return self.expr_taint(node.value)
+        if isinstance(node, ast.Call):
+            if self._is_env_read(node):
+                return _TAINT_FINAL
+            cn = _call_name(node)
+            if cn in self.fn_roots:
+                return _TAINT_FINAL
+            if cn == "join":
+                t = 0
+                for a in node.args:
+                    t = max(t, self.expr_taint(a))
+                if t and node.args:
+                    last = node.args[-1]
+                    if isinstance(last, ast.Constant) and \
+                            isinstance(last.value, str) and \
+                            last.value.endswith(".tmp"):
+                        return _TAINT_TMP
+                return t
+            return 0
+        if isinstance(node, ast.BinOp):
+            lt = self.expr_taint(node.left)
+            rt = self.expr_taint(node.right)
+            t = max(lt, rt)
+            if t and isinstance(node.right, ast.Constant) and \
+                    isinstance(node.right.value, str) and \
+                    node.right.value.endswith(".tmp"):
+                return _TAINT_TMP
+            return t
+        if isinstance(node, ast.JoinedStr):
+            t = 0
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    t = max(t, self.expr_taint(v.value))
+            if t and node.values and \
+                    isinstance(node.values[-1], ast.Constant) and \
+                    str(node.values[-1].value).endswith(".tmp"):
+                return _TAINT_TMP
+            return t
+        if isinstance(node, ast.IfExp):
+            return max(self.expr_taint(node.body),
+                       self.expr_taint(node.orelse))
+        return 0
+
+    def basename_of(self, node: ast.expr) -> str | None:
+        if isinstance(node, ast.Call) and _call_name(node) == "join" and \
+                node.args:
+            last = node.args[-1]
+            if isinstance(last, ast.Constant) and \
+                    isinstance(last.value, str):
+                return os.path.basename(last.value)
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.right, ast.Constant) and \
+                isinstance(node.right.value, str):
+            return os.path.basename(node.right.value)
+        if isinstance(node, ast.Name):
+            return self._bound_basenames.get(node.id)
+        return None
+
+    def run(self) -> list[Finding]:
+        self._bound_basenames: dict[str, str] = {}
+        # fixpoint over assignments (loops/reordered helpers)
+        for _ in range(4):
+            changed = False
+            for sub in _own_nodes(self.info.node):
+                if isinstance(sub, ast.Assign):
+                    t = self.expr_taint(sub.value)
+                    bn = self.basename_of(sub.value)
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            if t and self.taint.get(tgt.id, 0) != t:
+                                self.taint[tgt.id] = t
+                                changed = True
+                            if bn:
+                                self._bound_basenames[tgt.id] = bn
+            if not changed:
+                break
+        has_replace = any(
+            isinstance(s, ast.Call) and _call_name(s) == "replace"
+            for s in _own_nodes(self.info.node)
+        )
+        out = []
+        for sub in _own_nodes(self.info.node):
+            if not (isinstance(sub, ast.Call) and
+                    isinstance(sub.func, ast.Name) and
+                    sub.func.id == "open" and sub.args):
+                continue
+            mode = "r"
+            if len(sub.args) > 1 and isinstance(sub.args[1], ast.Constant):
+                mode = str(sub.args[1].value)
+            for kw in sub.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = str(kw.value.value)
+            if not any(c in mode for c in _WRITE_MODES):
+                continue
+            t = self.expr_taint(sub.args[0])
+            if not t:
+                continue
+            bn = self.basename_of(sub.args[0])
+            if bn in self.exempt:
+                continue
+            if t == _TAINT_TMP and has_replace:
+                continue  # the blessed write-tmp -> rename idiom
+            sup = self.model.is_suppressed("SYNC207", sub.lineno,
+                                           self.info.node.lineno)
+            detail = ("a `.tmp` write with no rename in this function"
+                      if t == _TAINT_TMP else
+                      "a bare write (no tmp, no fsync, no rename)")
+            out.append(Finding(
+                "SYNC207", self.model.path, sub.lineno, sub.col_offset,
+                f"`{self.info.qualname}` opens a protected store path "
+                f"for writing — {detail}; route it through write_atomic "
+                "or the tmp+replace idiom", sup,
+            ))
+        return out
+
+
+def _check_durability(pkg: SyncPackage) -> list[Finding]:
+    out = []
+    for model in pkg.modules.values():
+        for info in model.functions.values():
+            out.extend(_PathTaint(pkg, model, info).run())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Entry points + inventory + ratchet
+# ---------------------------------------------------------------------------
+
+
+def _thread_ident(pkg: SyncPackage, model: _Module, info: _Func | None,
+                  call: ast.Call) -> str:
+    target = pkg.thread_target(model, info, call)
+    if target is not None:
+        return f"{target.module}.{target.qualname}"
+    where = info.qualname if info is not None else "<module>"
+    return f"{model.modname}.{where}.<dynamic-target>"
+
+
+def inventory(pkg: SyncPackage) -> dict:
+    """Line-number-free concurrency inventory, pinned in
+    concurrency.json so a new lock/thread/flock/guarded-attr site is a
+    conscious --update-sync, never a silent drive-by."""
+    locks = sorted({lid for m in pkg.modules.values() for lid in m.locks})
+    flocks = sorted({
+        f"{m.modname}.{i.qualname}"
+        for m in pkg.modules.values() for i in m.functions.values()
+        for s in _own_nodes(i.node)
+        if isinstance(s, ast.Call) and
+        isinstance(s.func, ast.Attribute) and s.func.attr == "flock"
+    })
+    threads = sorted({
+        _thread_ident(pkg, model, info, call)
+        for model, info, call in pkg.thread_sites()
+    })
+    guarded = sorted({g.ident() for m in pkg.modules.values()
+                      for g in m.guarded})
+    return {"locks": locks, "flock_functions": flocks,
+            "threads": threads, "guarded": guarded}
+
+
+@dataclasses.dataclass
+class SyncReport:
+    findings: list
+    inventory: dict
+
+
+def sweep_paths(paths: list[str], rel_to: str | None = None,
+                roots_table: dict | None = None) -> SyncReport:
+    rel = rel_to or os.path.dirname(os.path.abspath(paths[0]))
+    pkg = SyncPackage([p for p in paths if os.path.exists(p)], rel,
+                      roots_table=roots_table)
+    findings: list[Finding] = []
+    findings += _check_lock_order(pkg)
+    findings += _check_acquire_release(pkg)
+    findings += _check_guarded(pkg)
+    findings += _check_thread_lifecycle(pkg)
+    findings += _check_install_pairs(pkg)
+    findings += _check_durability(pkg)
+    # SYNC208 runs last: it audits which declarations the rules above
+    # actually consumed
+    for model in pkg.modules.values():
+        findings.extend(model.stale_suppressions())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    counts: dict[str, int] = {}
+    out: list[Finding] = []
+    for f in findings:
+        base = f"{f.rule}::{f.path}::{f.message}"
+        n = counts.get(base, 0)
+        counts[base] = n + 1
+        out.append(dataclasses.replace(f, seq=n) if n else f)
+    return SyncReport(out, inventory(pkg))
+
+
+def sweep_source(source: str, name: str = "<memory>",
+                 roots_table: dict | None = None) -> list[Finding]:
+    """Sweep a single source string (fixture tests)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, f"{name}.py")
+        with open(p, "w", encoding="utf-8") as f:
+            f.write(source)
+        rep = sweep_paths([p], rel_to=d, roots_table=roots_table)
+    return [dataclasses.replace(f, path=name) for f in rep.findings]
+
+
+def default_roots(repo_root: str | None = None) -> list[str]:
+    repo = repo_root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return [os.path.join(repo, "ouroboros_consensus_tpu"),
+            os.path.join(repo, "scripts"),
+            os.path.join(repo, "bench.py")]
+
+
+def load_baseline(path: str | None = None) -> dict:
+    with open(path or _BASELINE_PATH, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def baseline_payload(report: SyncReport) -> dict:
+    return {
+        "comment": "octsync ratchet (scripts/lint.py --update-sync): "
+                   "grandfathered finding keys + the line-number-free "
+                   "concurrency inventory. Shrink-only in normal "
+                   "operation.",
+        "findings": sorted({f.key() for f in report.findings
+                            if not f.suppressed}),
+        "inventory": report.inventory,
+    }
+
+
+def write_baseline(report: SyncReport, path: str | None = None) -> dict:
+    payload = baseline_payload(report)
+    with open(path or _BASELINE_PATH, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return payload
+
+
+def check_sync(report: SyncReport, baseline: dict | None = None) \
+        -> tuple[list[str], list[str]]:
+    """(violations, stale_notes) vs the concurrency.json ratchet: a new
+    unsuppressed finding or inventory drift is a violation; a baseline
+    key that stopped firing is a ratchet-tightening note."""
+    base = baseline if baseline is not None else load_baseline()
+    known = set(base.get("findings", []))
+    current = {f.key() for f in report.findings if not f.suppressed}
+    violations = [
+        f.format() for f in report.findings
+        if not f.suppressed and f.key() not in known
+    ]
+    pinned = base.get("inventory", {})
+    for section, now in report.inventory.items():
+        then = pinned.get(section, [])
+        gained = sorted(set(now) - set(then))
+        lost = sorted(set(then) - set(now))
+        if gained or lost:
+            delta = "; ".join(
+                ([f"new: {', '.join(gained)}"] if gained else []) +
+                ([f"gone: {', '.join(lost)}"] if lost else [])
+            )
+            violations.append(
+                f"inventory drift in `{section}` ({delta}) — review and "
+                "re-pin with scripts/lint.py --update-sync"
+            )
+    stale = sorted(known - current)
+    return violations, stale

@@ -1,0 +1,210 @@
+"""Env-lever drift gate: the `OCT_*` / `BENCH_*` switchboard vs its doc.
+
+Every observability / recovery / bench lever in this tree is an
+environment variable, and obs/README.md's "## Levers" table is the one
+place operators are told they exist. Tables rot in both directions:
+
+  * a new `os.environ.get("OCT_FOO")` lands without a row — the lever
+    works but nobody can discover it;
+  * a lever is deleted from the code but its row lingers — operators
+    set it and silently get nothing.
+
+This pass closes the loop statically. It walks the same roots as the
+octsync sweep (package + scripts/ + bench.py), collects every env name
+actually READ through the stdlib seams —
+
+    os.environ.get("OCT_X") / os.environ["OCT_X"] / os.getenv("OCT_X")
+    "OCT_X" in os.environ / os.environ.pop("OCT_X")
+    _ENV = "OCT_X" ... os.environ.get(_ENV)      (constant-aware)
+
+— filters to the `OCT_*` / `BENCH_*` namespaces, and diffs the set
+against the backticked lever names parsed out of the README table.
+Both directions are violations. Writes (`os.environ["OCT_X"] = v`,
+`env={**os.environ, "OCT_X": v}`) are deliberately NOT reads: bench.py
+sets many levers for its device child; setting is not a discoverable
+switch, reading is.
+
+Pure AST + text. Never imports the modules it scans, never imports jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+_PREFIX_RE = re.compile(r"^(?:OCT|BENCH)_[A-Z0-9_]+$")
+_DOC_NAME_RE = re.compile(r"\b((?:OCT|BENCH)_[A-Z0-9_]+)\b")
+
+_README_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "obs", "README.md",
+)
+
+# namespace-prefix probes (ledger env capture: k.startswith("OCT_"))
+# surface as bare prefixes — they are sweeps, not individual levers
+_BARE_PREFIXES = {"OCT_", "BENCH_"}
+
+
+def _is_lever(name: str) -> bool:
+    return bool(_PREFIX_RE.match(name)) and name not in _BARE_PREFIXES
+
+
+# ---------------------------------------------------------------------------
+# Source side: env names the tree actually reads
+# ---------------------------------------------------------------------------
+
+
+def _env_attr(node: ast.AST) -> bool:
+    """`os.environ` (or a bare `environ` from `from os import environ`)."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return isinstance(node.value, ast.Name) and node.value.id == "os"
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+class _ReadScanner(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.reads: set[str] = set()
+        self.consts: dict[str, str] = {}
+
+    def _resolve(self, node: ast.AST) -> str | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.consts.get(node.id)
+        return None
+
+    def _note(self, node: ast.AST) -> None:
+        name = self._resolve(node)
+        if name and _is_lever(name):
+            self.reads.add(name)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # constant-aware: _ENV = "OCT_X" later fed to environ.get(_ENV)
+        if isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.consts[tgt.id] = node.value.value
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in ("get", "pop") and _env_attr(fn.value) \
+                    and node.args:
+                self._note(node.args[0])
+            elif fn.attr == "getenv" and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "os" and node.args:
+                self._note(node.args[0])
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # environ["OCT_X"] reads; environ["OCT_X"] = v (Store) does not
+        if _env_attr(node.value) and isinstance(node.ctx, ast.Load):
+            self._note(node.slice)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        # "OCT_X" in os.environ — membership probe is a read
+        if len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and _env_attr(node.comparators[0]):
+            self._note(node.left)
+        self.generic_visit(node)
+
+
+def _iter_py(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def scan_reads(paths: list[str]) -> set[str]:
+    """Every OCT_*/BENCH_* env name read anywhere under `paths`."""
+    reads: set[str] = set()
+    for path in _iter_py(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        sc = _ReadScanner()
+        sc.visit(tree)
+        reads |= sc.reads
+    return reads
+
+
+def default_roots(repo_root: str | None = None) -> list[str]:
+    repo = repo_root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    pkg = os.path.join(repo, "ouroboros_consensus_tpu")
+    return [pkg, os.path.join(repo, "scripts"),
+            os.path.join(repo, "bench.py")]
+
+
+# ---------------------------------------------------------------------------
+# Doc side: lever names in the README "## Levers" table
+# ---------------------------------------------------------------------------
+
+
+def documented_levers(readme_path: str | None = None) -> set[str]:
+    """Lever names from every backticked token in the "## Levers"
+    table's first column (a row may document variant spellings —
+    `OCT_LEDGER=<dir>` / `OCT_LEDGER=0` — they collapse to one name)."""
+    with open(readme_path or _README_PATH, encoding="utf-8") as f:
+        text = f.read()
+    m = re.search(r"^## Levers\s*$", text, flags=re.MULTILINE)
+    if not m:
+        return set()
+    section = text[m.end():]
+    nxt = re.search(r"^## ", section, flags=re.MULTILINE)
+    if nxt:
+        section = section[:nxt.start()]
+    names: set[str] = set()
+    for line in section.splitlines():
+        line = line.strip()
+        if not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1]
+        for tick in re.findall(r"`([^`]+)`", first_cell):
+            names.update(
+                n for n in _DOC_NAME_RE.findall(tick) if _is_lever(n)
+            )
+    return names
+
+
+# ---------------------------------------------------------------------------
+# The gate
+# ---------------------------------------------------------------------------
+
+
+def check_env_levers(
+    paths: list[str] | None = None,
+    readme_path: str | None = None,
+) -> list[str]:
+    """Both drift directions as violation strings; empty = in sync."""
+    reads = scan_reads(paths or default_roots())
+    documented = documented_levers(readme_path)
+    out = []
+    for name in sorted(reads - documented):
+        out.append(
+            f"env lever `{name}` is read by the tree but has no row in "
+            f"the obs/README.md \"## Levers\" table"
+        )
+    for name in sorted(documented - reads):
+        out.append(
+            f"obs/README.md documents env lever `{name}` but nothing "
+            f"under the swept roots reads it — stale row or dead lever"
+        )
+    return out

@@ -236,7 +236,11 @@ def append(rec: dict, path: str | None = None) -> str | None:
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         line = json.dumps(rec, sort_keys=True, allow_nan=False)
-        with open(path, "a", encoding="utf-8") as f:
+        # The ledger is an append-only JSONL journal, not a
+        # rewrite-in-place document: O_APPEND keeps concurrent appenders
+        # line-atomic and iter_runs tolerates a torn tail line, so
+        # tmp+rename would break (not add) the durability protocol here.
+        with open(path, "a", encoding="utf-8") as f:  # octsync: disable=SYNC207
             f.write(line + "\n")
         return path
     except (OSError, TypeError, ValueError):

@@ -185,10 +185,14 @@ def live_snapshot(rec=None, clock=time.monotonic) -> dict:
 
 def _stall_count(rec) -> int:
     try:
-        fam = rec.registry._families.get("oct_stalls_total")
-        if fam is None:
-            return 0
-        return int(sum(child.value for _l, child in fam.samples()))
+        # under the registry lock: the watchdog's trip counter rides
+        # label first-touches from other threads, and samples() iterates
+        # the child dict that first-touch inserts into
+        with rec.registry._lock:
+            fam = rec.registry._families.get("oct_stalls_total")
+            if fam is None:
+                return 0
+            return int(sum(child.value for _l, child in fam.samples()))
     except Exception:  # noqa: BLE001 — the heartbeat never raises
         return 0
 
@@ -387,55 +391,67 @@ class Heartbeat:
         self.interval_s = interval_s
         self.watchdog = watchdog
         self.clock = clock
-        self.seq = 0
-        self._samples: deque[tuple[float, int]] = deque()
+        self._beat_lock = threading.Lock()
+        self.seq = 0  # guarded-by: _beat_lock
+        self._samples: deque[tuple[float, int]] = deque()  # guarded-by: _beat_lock
+        self.beat_errors = 0  # guarded-by: _beat_lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     # -- one beat (unit-testable without the thread) ------------------------
 
     def beat(self) -> dict:
-        now = self.clock()
-        doc = live_snapshot(self.rec, clock=self.clock)
-        self._samples.append((now, doc["headers"]))
-        # age out samples older than the window but ALWAYS keep a
-        # two-sample anchor: a silent stretch then reads 0.0 headers/s
-        # (informative for a stall), never None
-        while (len(self._samples) > 2
-               and now - self._samples[1][0] > RATE_WINDOW_S):
-            self._samples.popleft()
-        t0, h0 = self._samples[0]
-        dt = now - t0
-        doc["headers_per_s"] = (
-            round((doc["headers"] - h0) / dt, 1) if dt > 0.5 else None
-        )
-        doc["seq"] = self.seq
-        doc["interval_s"] = self.interval_s
-        self.seq += 1
-        if self.watchdog is not None:
-            self.watchdog.check(now)
-            doc["stalls"] = _stall_count(self.rec)
-            # CURRENT state, not the lifetime count: tripped resets the
-            # moment progress resumes, so a run that stalled once at
-            # window 10 and recovered classifies by its live phase
-            # again instead of reading "stalled" forever
-            doc["stalled_now"] = self.watchdog.tripped
-        if self.path:
-            try:
-                tmp = self.path + ".tmp"
-                with open(tmp, "w", encoding="utf-8") as f:
-                    json.dump(doc, f)
-                os.replace(tmp, self.path)
-            except OSError:
-                pass  # the heartbeat never breaks the run it describes
-        return doc
+        # one beat at a time: stop()'s final beat can race a
+        # join-timed-out _run still mid-beat — serializing keeps
+        # seq/_samples coherent and the tmp+rename below un-torn
+        with self._beat_lock:
+            now = self.clock()
+            doc = live_snapshot(self.rec, clock=self.clock)
+            self._samples.append((now, doc["headers"]))
+            # age out samples older than the window but ALWAYS keep a
+            # two-sample anchor: a silent stretch then reads 0.0
+            # headers/s (informative for a stall), never None
+            while (len(self._samples) > 2
+                   and now - self._samples[1][0] > RATE_WINDOW_S):
+                self._samples.popleft()
+            t0, h0 = self._samples[0]
+            dt = now - t0
+            doc["headers_per_s"] = (
+                round((doc["headers"] - h0) / dt, 1) if dt > 0.5 else None
+            )
+            doc["seq"] = self.seq
+            doc["interval_s"] = self.interval_s
+            if self.beat_errors:
+                doc["beat_errors"] = self.beat_errors
+            self.seq += 1
+            if self.watchdog is not None:
+                self.watchdog.check(now)
+                doc["stalls"] = _stall_count(self.rec)
+                # CURRENT state, not the lifetime count: tripped resets
+                # the moment progress resumes, so a run that stalled
+                # once at window 10 and recovered classifies by its live
+                # phase again instead of reading "stalled" forever
+                doc["stalled_now"] = self.watchdog.tripped
+            if self.path:
+                try:
+                    tmp = self.path + ".tmp"
+                    with open(tmp, "w", encoding="utf-8") as f:
+                        json.dump(doc, f)
+                    os.replace(tmp, self.path)
+                except OSError:
+                    pass  # the heartbeat never breaks the run it describes
+            return doc
 
     # -- thread lifecycle ---------------------------------------------------
 
     def start(self) -> "Heartbeat":
         if self._thread is not None:
             return self
-        self.beat()  # an armed plane is visible IMMEDIATELY
+        try:
+            self.beat()  # an armed plane is visible IMMEDIATELY
+        except Exception as exc:  # noqa: BLE001 — diagnostics must
+            self._note_beat_error(exc)  # never break the run they
+            # describe; the thread below keeps trying every interval
         self._thread = threading.Thread(
             target=self._run, name="oct-heartbeat", daemon=True
         )
@@ -446,8 +462,28 @@ class Heartbeat:
         while not self._stop.wait(self.interval_s):
             try:
                 self.beat()
-            except Exception:  # noqa: BLE001 — keep beating
-                pass
+            except Exception as exc:  # noqa: BLE001 — keep beating,
+                self._note_beat_error(exc)  # but never silently
+
+    def _note_beat_error(self, exc: BaseException) -> None:
+        """A failing beat must stay visible without being able to kill
+        the plane: count it (the next good beat publishes the count as
+        `beat_errors`) and note the FIRST one into the warmup report —
+        bounded, so a wedged snapshot source cannot spam a note per
+        interval."""
+        with self._beat_lock:
+            self.beat_errors += 1
+            first = self.beat_errors == 1
+        if not first:
+            return
+        try:
+            from .warmup import WARMUP
+
+            WARMUP.note(
+                f"heartbeat beat failed: {type(exc).__name__}: {exc}"
+            )
+        except Exception:  # noqa: BLE001 — the seam itself failing
+            pass           # must not take the heartbeat thread down
 
     def stop(self) -> None:
         self._stop.set()
@@ -457,8 +493,8 @@ class Heartbeat:
         # final beat so the file's last word reflects the finished run
         try:
             self.beat()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:  # noqa: BLE001
+            self._note_beat_error(exc)
 
 
 def read_heartbeat(path: str) -> dict | None:

@@ -191,9 +191,9 @@ class ProgressWriter:
                  headers: int = 0, windows: int = 0):
         self.path = path
         self.chain_tag = chain_tag_
-        self.headers = headers
-        self.windows = windows
         self._lock = threading.Lock()
+        self.headers = headers  # guarded-by: _lock
+        self.windows = windows  # guarded-by: _lock
 
     def note(self, state, n_new: int) -> None:
         from ..utils.trace import CheckpointEvent

@@ -174,7 +174,7 @@ class _Family:
         self.labelnames = labelnames
         self._kw = kw
         self._lock = registry._lock
-        self._children: dict[tuple, object] = {}
+        self._children: dict[tuple, object] = {}  # guarded-by: _lock
         if not labelnames:
             self._default = self._make(())
 
@@ -218,7 +218,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._families: dict[str, _Family] = {}
+        self._families: dict[str, _Family] = {}  # guarded-by: _lock
 
     def _family(self, name: str, help_: str, cls, labelnames, **kw):
         # registration and exposition share the registry lock: a scrape

@@ -176,11 +176,28 @@ class MetricsServer:
                 )))
             except OSError:
                 pass  # a broken scrape never breaks the replay
+            except Exception:  # noqa: BLE001 — and neither does a
+                # handler bug: count it, answer 500, keep serving
+                self._note_handler_error(conn)
             finally:
                 try:
                     conn.close()
                 except OSError:
                     pass
+
+    def _note_handler_error(self, conn) -> None:
+        from .registry import default_registry
+
+        reg = (self.registry if self.registry is not None
+               else default_registry())
+        reg.counter(
+            "oct_metrics_scrape_errors_total", "scrape-handler failures"
+        ).inc()
+        try:
+            conn.sendall(_render(b"500 Internal Server Error",
+                                 b"text/plain", b"scrape handler error\n"))
+        except OSError:
+            pass
 
     def close(self) -> None:
         self._stop.set()

@@ -42,31 +42,31 @@ class WarmupRecorder:
         self.t0 = time.monotonic()
         # stage -> {"wall_s", "via", "t"} — FIRST execute only (the
         # compile happens synchronously inside that call)
-        self.stages: dict[str, dict] = {}
+        self.stages: dict[str, dict] = {}  # guarded-by: _lock
         # aot outcome counts + the per-stage detail rows
-        self.aot: dict[str, int] = {}
-        self.aot_events: list[dict] = []
+        self.aot: dict[str, int] = {}  # guarded-by: _lock
+        self.aot_events: list[dict] = []  # guarded-by: _lock
         # pre-flight refusals (analysis/costmodel.preflight): dispatches
         # whose PREDICTED cold-compile wall did not fit the remaining
         # bench budget — the decision is forensics too
-        self.refusals: list[dict] = []
+        self.refusals: list[dict] = []  # guarded-by: _lock
         # warm-while-serving compile ladder (protocol/batch.WarmLadder):
         # engagement, background-compile start/land and every rung swap,
         # each with the octwall feature hash of the program involved
-        self.ladder: list[dict] = []
-        self.cache_probe: dict | None = None
-        self.notes: list[str] = []
+        self.ladder: list[dict] = []  # guarded-by: _lock
+        self.cache_probe: dict | None = None  # guarded-by: _lock
+        self.notes: list[str] = []  # guarded-by: _lock
         # recovery-supervisor episodes (obs/recovery.py): every ladder
         # transition for a failing window — banked with the rest of the
         # forensics so the round JSON and ledger carry the recovery
         # story (perf_report classifies recovered rounds from this)
-        self.recovery: list[dict] = []
+        self.recovery: list[dict] = []  # guarded-by: _lock
         # durable-store repair plane (storage/repair.py): every
         # on-disk repair (or dry-run would-repair) the open-with-repair
         # scan took — truncated chunk tails, rebuilt indices, dropped
         # chunks, dirty-open escalations — banked with the forensics so
         # perf_report can classify a round `repaired@<action>`
-        self.repairs: list[dict] = []
+        self.repairs: list[dict] = []  # guarded-by: _lock
 
     # -- recording ----------------------------------------------------------
 

@@ -270,8 +270,16 @@ def note_failure(exc: BaseException) -> bool:
         _RUNTIME_REJECTED = True
         try:
             os.makedirs(aot_dir(), exist_ok=True)
-            with open(_reject_marker(), "w") as f:
+            # tmp -> fsync -> rename: the marker's mtime is load-bearing
+            # (it separates condemned entries from post-rejection
+            # write-backs), so a torn half-written marker after a crash
+            # must be impossible
+            tmp = _reject_marker() + ".tmp"
+            with open(tmp, "w") as f:
                 f.write(str(exc)[:500])
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, _reject_marker())
             _MARKER_TIME = os.path.getmtime(_reject_marker())
         except Exception:
             _MARKER_TIME = time.time()  # in-process latch still holds
@@ -378,7 +386,9 @@ def save(name: str, b: int, kes_depth: int, tile: int, sig: str, compiled,
     return path
 
 
-_LOADED: dict = {}
+# negative results included; writes hold _LOAD_LOCK (the bare `key in
+# _LOADED` fast-path read is GIL-atomic on a monotonic memo)
+_LOADED: dict = {}  # guarded-by: _LOAD_LOCK
 
 
 def load(name: str, b: int, kes_depth: int, tile: int, sig: str):
@@ -396,8 +406,12 @@ def load(name: str, b: int, kes_depth: int, tile: int, sig: str):
     worker's aggregate re-dispatch) can never stack a second doomed
     deserialize behind the first one's rejection."""
     key = (name, b, kes_depth, tile, sig)
-    if key in _LOADED:
-        return _LOADED[key]
+    # lock-free memo probe BY DESIGN: a hit is immutable once written,
+    # the read is GIL-atomic, and taking _LOAD_LOCK here would park a
+    # warm caller behind a concurrent multi-second deserialize; misses
+    # re-check under the lock below.
+    if key in _LOADED:  # octsync: disable=SYNC203
+        return _LOADED[key]  # octsync: disable=SYNC203
     if not enabled():
         return None
     from ...testing import chaos
@@ -412,17 +426,20 @@ def load(name: str, b: int, kes_depth: int, tile: int, sig: str):
             # are transient by contract, a persisted marker would
             # outlive the injection and condemn real entries
             _note_aot(name, "rejected", detail=repr(e))
-            _LOADED[key] = None
+            with _LOAD_LOCK:
+                _LOADED.setdefault(key, None)
             return None
     meta = _cached_manifest().get(entry_key(name, b, kes_depth, tile, sig))
     if meta is None:
         _note_aot(name, "missing")
-        _LOADED[key] = None
+        with _LOAD_LOCK:
+            _LOADED.setdefault(key, None)
         return None
     if meta.get("build_id") != build_id():
         _note_aot(name, "wrong_build",
                   detail=f"artifact build {meta.get('build_id')!r}")
-        _LOADED[key] = None
+        with _LOAD_LOCK:
+            _LOADED.setdefault(key, None)
         return None
 
     def _condemned() -> bool:
@@ -435,7 +452,8 @@ def load(name: str, b: int, kes_depth: int, tile: int, sig: str):
 
     if _condemned():
         _note_aot(name, "marker_skip", detail=_reject_marker())
-        _LOADED[key] = None
+        with _LOAD_LOCK:
+            _LOADED.setdefault(key, None)
         return None
     result = None
     path = stage_path(name, b, kes_depth, tile, sig)
@@ -507,7 +525,8 @@ def compile_and_store(name: str, b: int, kes_depth: int, tile: int,
 
         print(f"# pk-aot: write-back save for {key} failed: {e!r}",
               file=sys.stderr)
-    _LOADED[key] = compiled
+    with _LOAD_LOCK:
+        _LOADED[key] = compiled
     return compiled
 
 

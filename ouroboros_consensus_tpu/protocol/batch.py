@@ -2262,26 +2262,32 @@ class WarmLadder:
         self.rung = rung
         self.graph = graph
         self.predicted_s = predicted_s
-        self._engaged = False
+        # the ladder's transition latches cross threads (the loop reads
+        # what the background compile writes) — serialize them so the
+        # serving tier can drive poll_swap from more than one thread
+        self._state_lock = threading.Lock()
+        self._engaged = False  # guarded-by: _state_lock
         self._done = threading.Event()
         self._bg: threading.Thread | None = None
-        self._swapped = False
-        self.failed = False
+        self._swapped = False  # guarded-by: _state_lock
+        self.failed = False  # guarded-by: _state_lock
 
     # -- loop-facing ---------------------------------------------------------
 
     def cap(self) -> int | None:
         """Lane cap for the next window slice (None = production)."""
-        if self._swapped or self._done.is_set():
-            return None
-        return self.rung
+        with self._state_lock:
+            if self._swapped or self._done.is_set():
+                return None
+            return self.rung
 
     def note_engaged_once(self) -> None:
         """Record engagement the first time a slice is actually capped
         (a chain shorter than the rung never engages — no noise)."""
-        if self._engaged:
-            return
-        self._engaged = True
+        with self._state_lock:
+            if self._engaged:
+                return
+            self._engaged = True
         from ..analysis import costmodel
         from ..obs.warmup import WARMUP
 
@@ -2298,13 +2304,16 @@ class WarmLadder:
     def poll_swap(self) -> bool:
         """True exactly once, when the background compile has landed
         and the loop should re-tile onto the production bucket."""
-        if self._swapped or not self._engaged or not self._done.is_set():
-            return False
-        self._swapped = True
+        with self._state_lock:
+            if (self._swapped or not self._engaged
+                    or not self._done.is_set()):
+                return False
+            self._swapped = True
+            failed = self.failed
         from ..obs.warmup import WARMUP
 
         WARMUP.note_ladder("swap", rung=self.rung, target=self.target,
-                           failed=self.failed or None)
+                           failed=failed or None)
         self._emit("swap", None)
         return True
 
@@ -2359,7 +2368,8 @@ class WarmLadder:
             jax.block_until_ready(out)
         except Exception as e:  # noqa: BLE001 — fail-open: the loop
             # simply dispatches the production program synchronously
-            self.failed = True
+            with self._state_lock:
+                self.failed = True
             from ..obs.warmup import WARMUP
 
             WARMUP.note_ladder("bg-compile-failed", rung=self.rung,

@@ -307,8 +307,14 @@ def main():
         # generic-fallback relayout (mixed-layout windows)
         fresh.append(compile_stage(relayout_name, relayout_fn, rel_sds, bucket,
                       manifest))
-        with open(manifest_path, "w") as f:
+        # tmp -> fsync -> rename: the compile log lives inside the AOT
+        # store dir, so it rides the store's durability protocol
+        tmp = manifest_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, manifest_path)
     # clear a persisted per-build rejection ONLY when this run wrote
     # EVERY entry itself: a cached early-return may be reusing exactly
     # the stale executables the REJECTED marker records (fresh saves

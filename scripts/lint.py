@@ -18,6 +18,9 @@ ratcheted.
                                               #   device_resources section
                                               #   (lowers AND COMPILES every
                                               #   registry graph — slow)
+    python scripts/lint.py --update-sync      # re-pin the octsync
+                                              #   concurrency ratchet
+                                              #   (analysis/concurrency.json)
 
 Exit 0 = no NEW AST findings (anything in analysis/baseline.json is
 grandfathered), every registered kernel graph within its
@@ -37,7 +40,13 @@ ratchet violation(s) (budgets.json "device_resources": a registry graph
 without a pin, a pin whose octwall feature hash no longer matches the
 traced structure, or a pinned FLOP/byte/peak-HBM value over its
 ceiling — obs/resources.check_device_resources; the check is dict
-compares only, the compiles run solely under --update-resources). The
+compares only, the compiles run solely under --update-resources),
+7 = octsync concurrency/durability ratchet violation(s) (Pass 5,
+analysis/concurrency.py: a new unsuppressed SYNC2xx finding — lock-order
+inversion, unguarded `# guarded-by:` attribute, silent thread death,
+bare write to a protected store path — or drift in the pinned
+lock/thread/guarded inventory vs analysis/concurrency.json; pure AST,
+runs even under --no-graphs). The
 ratchet files only ever shrink in normal operation — fixing a
 grandfathered finding makes its key stale, and the gate prints a
 reminder to re-run the matching --update flag so the ratchet tightens.
@@ -93,6 +102,27 @@ _OBS_PREFIXES = ("ouroboros_consensus_tpu/obs/",
 _OBS_FILES = {"scripts/perf_report.py",
               "ouroboros_consensus_tpu/parallel/spmd.py",
               "ouroboros_consensus_tpu/testing/chaos.py"}
+# octsync (Pass 5) --changed trigger: the thread/lock/rename fabric
+# lives in obs/ + storage/ + the chaos seams + the analysis machinery
+# itself; protocol/batch.py and ops/pk/aot.py carry guarded-by
+# annotations and bench.py hosts thread entries, so an edit to any of
+# them re-runs the concurrency sweep too (pure AST — seconds, no jax)
+_SYNC_PREFIXES = ("ouroboros_consensus_tpu/obs/",
+                  "ouroboros_consensus_tpu/storage/",
+                  "ouroboros_consensus_tpu/analysis/")
+_SYNC_FILES = {"ouroboros_consensus_tpu/testing/chaos.py",
+               "ouroboros_consensus_tpu/protocol/batch.py",
+               "ouroboros_consensus_tpu/ops/pk/aot.py",
+               "bench.py"}
+
+
+def _sync_selected(changed: set[str]) -> bool:
+    """--changed: does the diff touch the concurrency plane? Empty
+    diff/no git -> True (conservative: the sweep is cheap)."""
+    if not changed:
+        return True
+    return any(f.startswith(_SYNC_PREFIXES) or f in _SYNC_FILES
+               for f in changed)
 
 
 def _changed_files() -> set[str]:
@@ -180,6 +210,10 @@ def main(argv: list[str] | None = None) -> int:
                          "graph — slow) and re-pin the budgets.json "
                          "device_resources section; missing ceilings "
                          "are created, existing ones preserved")
+    ap.add_argument("--update-sync", action="store_true",
+                    help="re-pin the octsync concurrency ratchet "
+                         "(analysis/concurrency.json: grandfathered "
+                         "finding keys + lock/thread/guarded inventory)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -212,6 +246,30 @@ def main(argv: list[str] | None = None) -> int:
     new = [f for f in unsuppressed if f.key() not in baseline]
     current_keys = {f.key() for f in unsuppressed}
     stale = sorted(baseline - current_keys)
+
+    # Pass 5 (octsync): the concurrency/durability sweep is pure AST —
+    # it runs with or without the graph passes, and under --changed only
+    # when the diff touches the thread/lock/rename fabric
+    from ouroboros_consensus_tpu.analysis import concurrency
+
+    sync_violations: list[str] = []
+    sync_stale: list[str] = []
+    run_sync = (args.update_sync or not args.changed
+                or _sync_selected(_changed_files()))
+    if run_sync:
+        sync_report = concurrency.sweep_paths(
+            concurrency.default_roots(REPO), REPO, concurrency.load_roots()
+        )
+        if args.update_sync:
+            payload = concurrency.write_baseline(sync_report)
+            print(f"concurrency.json updated: "
+                  f"{len(payload['findings'])} grandfathered finding(s), "
+                  f"{sum(len(v) for v in payload['inventory'].values())} "
+                  "inventory row(s)")
+            return 0
+        sync_violations, sync_stale = concurrency.check_sync(
+            sync_report, concurrency.load_baseline()
+        )
 
     budget_violations: list[str] = []
     cert_violations: list[str] = []
@@ -382,13 +440,16 @@ def main(argv: list[str] | None = None) -> int:
             "certification_violations": cert_violations,
             "cost_violations": cost_violations,
             "resource_violations": resource_violations,
+            "sync_violations": sync_violations,
+            "stale_sync": sync_stale,
             "graphs": [r.to_dict() for r in reports],
             "certified": [r.to_dict() for r in cert_reports],
             "cost_features": [f.to_dict() | {"name": f.name}
                               for f in cost_features],
             "changed_selection": names,
             "ok": not (new or budget_violations or cert_violations
-                       or cost_violations or resource_violations),
+                       or cost_violations or resource_violations
+                       or sync_violations),
         }, indent=2, sort_keys=True))
     else:
         for f in new:
@@ -401,9 +462,14 @@ def main(argv: list[str] | None = None) -> int:
             print(f"COST: {v}")
         for v in resource_violations:
             print(f"RESOURCES: {v}")
+        for v in sync_violations:
+            print(f"SYNC: {v}")
         for k in stale:
             print(f"note: baseline entry no longer fires "
                   f"(run --update-baseline to ratchet): {k}")
+        for k in sync_stale:
+            print(f"note: concurrency baseline entry no longer fires "
+                  f"(run --update-sync to ratchet): {k}")
         if names is not None:
             print(f"--changed: {len(names)} graph(s) selected: "
                   f"{', '.join(names) or '(none)'}")
@@ -413,6 +479,7 @@ def main(argv: list[str] | None = None) -> int:
             f"{len(cert_violations)} certification violation(s), "
             f"{len(cost_violations)} compile-wall violation(s), "
             f"{len(resource_violations)} device-resource violation(s), "
+            f"{len(sync_violations)} concurrency violation(s), "
             f"{len(stale)} stale baseline entr(y/ies)"
         )
     if new:
@@ -423,7 +490,9 @@ def main(argv: list[str] | None = None) -> int:
         return 4
     if cost_violations:
         return 5
-    return 6 if resource_violations else 0
+    if resource_violations:
+        return 6
+    return 7 if sync_violations else 0
 
 
 if __name__ == "__main__":

@@ -101,91 +101,95 @@ def host_ceiling():
     print(f"host pipeline: {mode} (OCT_TRACE={'1' if traced else '0'}, "
           f"live={'armed' if plane else 'off'})", flush=True)
 
-    for attempt in ("warm", "hot"):
-        res = ana.ValidationResult()
-        imm = ana.open_immutable(path, validate_all="stream")
-        t_stream = t_pre = t_stage = 0.0
-        nh = nwin = npacked = 0
-        t0 = time.monotonic()
+    try:
+        for attempt in ("warm", "hot"):
+            res = ana.ValidationResult()
+            imm = ana.open_immutable(path, validate_all="stream")
+            t_stream = t_pre = t_stage = 0.0
+            nh = nwin = npacked = 0
+            t0 = time.monotonic()
 
-        def timed_windows():
-            nonlocal t_stream
-            it = ana._stream_windows(imm, res)
-            while True:
-                ts = time.monotonic()
-                try:
-                    win = next(it)
-                except StopIteration:
+            def timed_windows():
+                nonlocal t_stream
+                it = ana._stream_windows(imm, res)
+                while True:
+                    ts = time.monotonic()
+                    try:
+                        win = next(it)
+                    except StopIteration:
+                        t_stream += time.monotonic() - ts
+                        return
                     t_stream += time.monotonic() - ts
-                    return
-                t_stream += time.monotonic() - ts
-                yield win
+                    yield win
 
-        wins = ana._cap_windows(timed_windows(), N)
-        state = praos.PraosState()
-        for seg in ana._epoch_window_segments(params, wins):
-            ticked = praos.tick(
-                params, lview, pbatch._slot_at(seg, 0), state
-            )
-            eta0 = ticked.state.epoch_nonce
-            w, seg_n = 0, len(seg)
-            while w < seg_n:
-                j = pbatch._proof_break(seg, w, min(w + bench.MAX_BATCH, seg_n))
-                win = seg[w:j]
-                ts = time.monotonic()
-                pre = pbatch.host_prechecks(params, lview, win)
-                t_pre += time.monotonic() - ts
-                ts = time.monotonic()
-                packed = None
-                if isinstance(win, ViewColumns) and isinstance(
-                    pre, pbatch.ColumnChecks
-                ):
-                    packed = pbatch.stage_packed_columns(
-                        params, lview, eta0, win, pre
-                    )
-                elif not isinstance(win, ViewColumns):
-                    packed = pbatch.stage_packed(params, lview, eta0, win)
-                if packed is None:
-                    pbatch.stage_any(params, lview, eta0, win, pre)
-                else:
-                    pbatch.pad_packed_to(
-                        packed[1], pbatch.bucket_size(len(win))
-                    )
-                    npacked += 1
-                t_stage += time.monotonic() - ts
-                nh += len(win)
-                nwin += 1
-                w = j
-        wall = time.monotonic() - t0
-        host_s = t_stream + t_pre + t_stage
-        print(f"\n== {attempt}: {nh} headers, host pipeline {host_s:.2f}s "
-              f"(ceiling {nh/host_s:.0f} headers/s; wall {wall:.2f}s)",
-              flush=True)
-        for label, secs in (("view-stream", t_stream),
-                            ("prechecks", t_pre), ("stage", t_stage)):
-            print(f"  {label:12s} {secs:8.2f}s  {secs/nh*1e6:7.2f} us/header")
-        print(f"  windows: {nwin} ({npacked} packed)")
-    # one run-ledger record per invocation (obs/ledger.py): the hot
-    # attempt's ceiling + phase walls, with full env/git provenance
-    from ouroboros_consensus_tpu.obs import ledger
+            wins = ana._cap_windows(timed_windows(), N)
+            state = praos.PraosState()
+            for seg in ana._epoch_window_segments(params, wins):
+                ticked = praos.tick(
+                    params, lview, pbatch._slot_at(seg, 0), state
+                )
+                eta0 = ticked.state.epoch_nonce
+                w, seg_n = 0, len(seg)
+                while w < seg_n:
+                    j = pbatch._proof_break(seg, w, min(w + bench.MAX_BATCH, seg_n))
+                    win = seg[w:j]
+                    ts = time.monotonic()
+                    pre = pbatch.host_prechecks(params, lview, win)
+                    t_pre += time.monotonic() - ts
+                    ts = time.monotonic()
+                    packed = None
+                    if isinstance(win, ViewColumns) and isinstance(
+                        pre, pbatch.ColumnChecks
+                    ):
+                        packed = pbatch.stage_packed_columns(
+                            params, lview, eta0, win, pre
+                        )
+                    elif not isinstance(win, ViewColumns):
+                        packed = pbatch.stage_packed(params, lview, eta0, win)
+                    if packed is None:
+                        pbatch.stage_any(params, lview, eta0, win, pre)
+                    else:
+                        pbatch.pad_packed_to(
+                            packed[1], pbatch.bucket_size(len(win))
+                        )
+                        npacked += 1
+                    t_stage += time.monotonic() - ts
+                    nh += len(win)
+                    nwin += 1
+                    w = j
+            wall = time.monotonic() - t0
+            host_s = t_stream + t_pre + t_stage
+            print(f"\n== {attempt}: {nh} headers, host pipeline {host_s:.2f}s "
+                  f"(ceiling {nh/host_s:.0f} headers/s; wall {wall:.2f}s)",
+                  flush=True)
+            for label, secs in (("view-stream", t_stream),
+                                ("prechecks", t_pre), ("stage", t_stage)):
+                print(f"  {label:12s} {secs:8.2f}s  {secs/nh*1e6:7.2f} us/header")
+            print(f"  windows: {nwin} ({npacked} packed)")
+        # one run-ledger record per invocation (obs/ledger.py): the hot
+        # attempt's ceiling + phase walls, with full env/git provenance
+        from ouroboros_consensus_tpu.obs import ledger
 
-    ledger.record_replay(
-        "profile_replay",
-        recorder=obs.recorder() if traced else None,
-        config={"n": N, "mode": "host", "columnar": columnar,
-                "traced": traced},
-        result={
-            "headers": nh, "host_s": round(host_s, 3),
-            "ceiling_per_s": round(nh / host_s, 1),
-            "windows": nwin, "packed_windows": npacked,
-        },
-        wall_s=wall,
-        phases_s={"view-stream": round(t_stream, 3),
-                  "prechecks": round(t_pre, 3),
-                  "stage": round(t_stage, 3)},
-    )
-    if plane is not None:
-        plane.disarm()
+        ledger.record_replay(
+            "profile_replay",
+            recorder=obs.recorder() if traced else None,
+            config={"n": N, "mode": "host", "columnar": columnar,
+                    "traced": traced},
+            result={
+                "headers": nh, "host_s": round(host_s, 3),
+                "ceiling_per_s": round(nh / host_s, 1),
+                "windows": nwin, "packed_windows": npacked,
+            },
+            wall_s=wall,
+            phases_s={"view-stream": round(t_stream, 3),
+                      "prechecks": round(t_pre, 3),
+                      "stage": round(t_stage, 3)},
+        )
+    finally:
+        # a raising replay must still disarm the live plane — the
+        # unwind is what keeps maybe_arm re-entrant for the next run
+        if plane is not None:
+            plane.disarm()
 
 
 def main():
@@ -218,75 +222,80 @@ def main():
     # the flight recorder chains BEHIND the local tracer (obs.install
     # preserves it) — spans + histograms + the Perfetto event stream
     rec = obs.install() if (TRACE_OUT or obs.enabled()) else None
+    try:
 
-    # instrument the window stream (disk read + native parse + column
-    # build) by timing the generator pulls
-    stream_s = 0.0
-    orig_stream = ana._stream_windows
+        # instrument the window stream (disk read + native parse + column
+        # build) by timing the generator pulls
+        stream_s = 0.0
+        orig_stream = ana._stream_windows
 
-    def timed_stream(imm, res):
-        nonlocal stream_s
-        it = orig_stream(imm, res)
-        while True:
-            t0 = time.monotonic()
-            try:
-                win = next(it)
-            except StopIteration:
+        def timed_stream(imm, res):
+            nonlocal stream_s
+            it = orig_stream(imm, res)
+            while True:
+                t0 = time.monotonic()
+                try:
+                    win = next(it)
+                except StopIteration:
+                    stream_s += time.monotonic() - t0
+                    return
                 stream_s += time.monotonic() - t0
-                return
-            stream_s += time.monotonic() - t0
-            yield win
+                yield win
 
-    for attempt in ("warm", "hot"):
-        tot.clear(); cnt.clear(); xfer.clear(); stream_s = 0.0
-        ana._stream_windows = lambda imm, res: timed_stream(imm, res)
-        t0 = time.monotonic()
-        r = ana.revalidate(
-            path, params, lview, backend="device", validate_all=True,
-            max_batch=bench.MAX_BATCH,
-        )
-        wall = time.monotonic() - t0
-        ana._stream_windows = orig_stream
-        assert r.error is None and r.n_valid == r.n_blocks
-        print(f"\n== {attempt}: {r.n_valid} headers in {wall:.2f}s "
-              f"({r.n_valid/wall:.0f} headers/s)", flush=True)
-        accounted = 0.0
-        for label in ("stage", "dispatch", "materialize", "epilogue"):
-            if cnt[label]:
-                print(f"  {label:12s} {tot[label]:8.2f}s  x{cnt[label]:4d} "
-                      f"({tot[label]/wall*100:5.1f}%)")
-                accounted += tot[label]
-        print(f"  {'view-stream':12s} {stream_s:8.2f}s          "
-              f"({stream_s/wall*100:5.1f}%)")
-        other = wall - accounted - stream_s
-        print(f"  {'other':12s} {other:8.2f}s          "
-              f"({other/wall*100:5.1f}%)")
-        nwin = xfer["packed"] + xfer["generic"]
-        if nwin:
-            print(
-                f"  windows: {nwin} ({xfer['packed']} packed) | "
-                f"H2D {xfer['h2d']/nwin/1e3:.1f} KB/window | "
-                f"D2H {xfer['d2h']/nwin/1e3:.1f} KB/window"
+        for attempt in ("warm", "hot"):
+            tot.clear(); cnt.clear(); xfer.clear(); stream_s = 0.0
+            ana._stream_windows = lambda imm, res: timed_stream(imm, res)
+            t0 = time.monotonic()
+            r = ana.revalidate(
+                path, params, lview, backend="device", validate_all=True,
+                max_batch=bench.MAX_BATCH,
             )
-    if rec is not None:
-        s = rec.latency_summary()
-        if s["windows"]:
-            p50 = s["device_latency_p50_s"]
-            p99 = s["device_latency_p99_s"]
-            print(
-                f"\ndispatch->materialize latency over {s['windows']} "
-                f"windows: p50 {p50*1e3:.1f} ms | p99 {p99*1e3:.1f} ms"
-            )
-        if TRACE_OUT:
-            from ouroboros_consensus_tpu.obs import perfetto
+            wall = time.monotonic() - t0
+            ana._stream_windows = orig_stream
+            assert r.error is None and r.n_valid == r.n_blocks
+            print(f"\n== {attempt}: {r.n_valid} headers in {wall:.2f}s "
+                  f"({r.n_valid/wall:.0f} headers/s)", flush=True)
+            accounted = 0.0
+            for label in ("stage", "dispatch", "materialize", "epilogue"):
+                if cnt[label]:
+                    print(f"  {label:12s} {tot[label]:8.2f}s  x{cnt[label]:4d} "
+                          f"({tot[label]/wall*100:5.1f}%)")
+                    accounted += tot[label]
+            print(f"  {'view-stream':12s} {stream_s:8.2f}s          "
+                  f"({stream_s/wall*100:5.1f}%)")
+            other = wall - accounted - stream_s
+            print(f"  {'other':12s} {other:8.2f}s          "
+                  f"({other/wall*100:5.1f}%)")
+            nwin = xfer["packed"] + xfer["generic"]
+            if nwin:
+                print(
+                    f"  windows: {nwin} ({xfer['packed']} packed) | "
+                    f"H2D {xfer['h2d']/nwin/1e3:.1f} KB/window | "
+                    f"D2H {xfer['d2h']/nwin/1e3:.1f} KB/window"
+                )
+        if rec is not None:
+            s = rec.latency_summary()
+            if s["windows"]:
+                p50 = s["device_latency_p50_s"]
+                p99 = s["device_latency_p99_s"]
+                print(
+                    f"\ndispatch->materialize latency over {s['windows']} "
+                    f"windows: p50 {p50*1e3:.1f} ms | p99 {p99*1e3:.1f} ms"
+                )
+            if TRACE_OUT:
+                from ouroboros_consensus_tpu.obs import perfetto
 
-            doc = rec.write_chrome_trace(TRACE_OUT)
-            errs = perfetto.validate_chrome_trace(doc)
-            print(f"chrome trace: {TRACE_OUT} "
-                  f"({len(doc['traceEvents'])} events"
-                  f"{'' if not errs else f', INVALID: {errs[:3]}'})")
-        obs.uninstall()
-    pbatch.set_batch_tracer(None)
+                doc = rec.write_chrome_trace(TRACE_OUT)
+                errs = perfetto.validate_chrome_trace(doc)
+                print(f"chrome trace: {TRACE_OUT} "
+                      f"({len(doc['traceEvents'])} events"
+                      f"{'' if not errs else f', INVALID: {errs[:3]}'})")
+    finally:
+        # unwind even when revalidate raises: the recorder and the
+        # module-level tracer hook must not leak into the next run
+        if rec is not None:
+            obs.uninstall()
+        pbatch.set_batch_tracer(None)
     # one run-ledger record per invocation: the hot replay's rate, phase
     # walls and boundary bytes, plus the warmup/resource ledgers
     from ouroboros_consensus_tpu.obs import ledger
@@ -361,10 +370,12 @@ def overlap_ab():
         rec = obs.install()
         rec.clear()
         t0 = time.monotonic()
-        r = ana.revalidate(path, params, lview, backend="device",
-                           validate_all="stream", max_batch=max_batch)
-        wall = time.monotonic() - t0
-        obs.uninstall()
+        try:
+            r = ana.revalidate(path, params, lview, backend="device",
+                               validate_all="stream", max_batch=max_batch)
+            wall = time.monotonic() - t0
+        finally:
+            obs.uninstall()
         assert r.error is None and r.n_valid == r.n_blocks > 0
         walls[label] = wall
         summaries[label] = rec.latency_summary()

@@ -1,0 +1,29 @@
+"""octsync fixture: SYNC202 acquire-without-release.
+
+NOT a test module and never imported — swept by tests/test_concurrency.py.
+`grab` takes the module lock and returns while still holding it;
+`grab_pair` releases in a finally and is clean; `grab_quietly` is the
+suppressed twin.
+"""
+
+import threading
+
+_L = threading.Lock()
+
+
+def grab():
+    _L.acquire()  # fires SYNC202 (no release on any path)
+    return True
+
+
+def grab_pair():
+    _L.acquire()
+    try:
+        return True
+    finally:
+        _L.release()  # released: NOT a finding
+
+
+def grab_quietly():
+    _L.acquire()  # octsync: disable=SYNC202
+    return True

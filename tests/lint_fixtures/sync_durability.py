@@ -1,0 +1,34 @@
+"""octsync fixture: SYNC207 bare write to a protected store path.
+
+NOT a test module and never imported — swept by tests/test_concurrency.py
+with the REAL analysis/sync_roots.json table: `OCT_HEARTBEAT` is an
+env_path_lever, so its value taints as a protected path. `write_bare`
+opens it directly for writing (fires); `write_atomic` rides the
+blessed write-tmp -> fsync -> rename idiom (clean); `write_quietly`
+is the suppressed twin.
+"""
+
+import json
+import os
+
+
+def write_bare(doc):
+    path = os.environ.get("OCT_HEARTBEAT")
+    with open(path, "w", encoding="utf-8") as f:  # fires SYNC207
+        json.dump(doc, f)
+
+
+def write_atomic(doc):
+    path = os.environ.get("OCT_HEARTBEAT")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:  # tmp+rename: NOT a finding
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_quietly(doc):
+    path = os.environ.get("OCT_HEARTBEAT")
+    with open(path, "w", encoding="utf-8") as f:  # octsync: disable=SYNC207
+        json.dump(doc, f)

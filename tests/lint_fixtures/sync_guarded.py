@@ -1,0 +1,41 @@
+"""octsync fixture: SYNC203 unguarded guarded-by attribute.
+
+NOT a test module and never imported — swept by tests/test_concurrency.py.
+`Counter.value` is annotated guarded-by `_lock`; `_spin` is a thread
+target, so every method it reaches is thread-reachable. `bump` touches
+the attribute inside `with self._lock` (clean), `peek` touches it bare
+(fires), `peek_quietly` is the suppressed twin.
+"""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self.value += 1  # held: NOT a finding
+
+    def peek(self):
+        return self.value  # fires SYNC203 (thread-reachable, no lock)
+
+    def peek_quietly(self):
+        return self.value  # octsync: disable=SYNC203
+
+
+_COUNTER = Counter()
+
+
+def _spin():
+    try:
+        _COUNTER.bump()
+        _COUNTER.peek()
+        _COUNTER.peek_quietly()
+    except Exception as exc:
+        print("spin failed:", exc)
+
+
+_T = threading.Thread(target=_spin, daemon=True)

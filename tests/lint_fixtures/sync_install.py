@@ -1,0 +1,31 @@
+"""octsync fixture: SYNC206 unbalanced recorder install/uninstall.
+
+NOT a test module and never imported — swept by tests/test_concurrency.py.
+`run_once` pairs install with a straight-line uninstall (an exception
+in between leaks the armed recorder); `run_safe` uninstalls in a
+finally and is clean; `run_quietly` is the suppressed twin.
+"""
+
+
+def run_once(rec):
+    rec.install()
+    do_work()
+    rec.uninstall()  # fires SYNC206 (straight-line only)
+
+
+def run_safe(rec):
+    rec.install()
+    try:
+        do_work()
+    finally:
+        rec.uninstall()  # unwound: NOT a finding
+
+
+def run_quietly(rec):
+    rec.install()
+    do_work()
+    rec.uninstall()  # octsync: disable=SYNC206
+
+
+def do_work():
+    return None

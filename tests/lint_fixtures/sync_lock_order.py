@@ -1,0 +1,40 @@
+"""octsync fixture: SYNC201 lock-order inversion.
+
+NOT a test module and never imported — swept by tests/test_concurrency.py
+and `python -m ouroboros_consensus_tpu.analysis sync --paths ...`.
+`ab` takes _A then _B while `ba` takes _B then _A: the classic ABBA
+cycle. One finding per cycle, reported at the lexically-first edge of
+the first sorted pair — `ab`'s inner `with`. The _C/_D cycle is the
+suppressed twin (disable on the reported edge only).
+"""
+
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+_C = threading.Lock()
+_D = threading.Lock()
+
+
+def ab():
+    with _A:
+        with _B:  # fires SYNC201 (the {A,B} cycle's reported edge)
+            pass
+
+
+def ba():
+    with _B:
+        with _A:
+            pass
+
+
+def cd():
+    with _C:
+        with _D:  # octsync: disable=SYNC201
+            pass
+
+
+def dc():
+    with _D:
+        with _C:
+            pass

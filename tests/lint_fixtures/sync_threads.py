@@ -1,0 +1,56 @@
+"""octsync fixture: SYNC204 unjoined thread + SYNC205 escaping/silent
+thread exceptions.
+
+NOT a test module and never imported — swept by tests/test_concurrency.py.
+`_worker` has no broad handler (a raise kills the daemon thread with
+nothing feeding a recorder seam); `_quiet` has a pass-only broad
+handler (same silence, different spelling); `_ok` routes the exception
+into a callable seam and is clean. The `u` thread is non-daemon and
+never joined; `v` is joined; `w`/`_quiet2` are the suppressed twins.
+"""
+
+import threading
+
+
+def _worker():
+    raise RuntimeError("boom")
+
+
+def _quiet():
+    try:
+        return 1
+    except Exception:
+        pass
+
+
+def _ok():
+    try:
+        return 2
+    except Exception as exc:
+        _record(exc)
+
+
+def _record(exc):
+    del exc
+
+
+def start_workers():
+    t = threading.Thread(target=_worker, daemon=True)
+    t.start()
+    u = threading.Thread(target=_quiet)  # fires SYNC204 (never joined)
+    u.start()
+    v = threading.Thread(target=_ok)
+    v.start()
+    v.join()  # joined: NOT a finding
+
+
+def start_suppressed():
+    w = threading.Thread(target=_quiet2)  # octsync: disable=SYNC204
+    w.start()
+
+
+def _quiet2():
+    try:
+        return 3
+    except Exception:  # octsync: disable=SYNC205
+        pass

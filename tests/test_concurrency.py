@@ -1,0 +1,322 @@
+"""octsync tier-1 gate (Pass 5): concurrency & durability checkers.
+
+Three layers, mirroring test_analysis.py:
+  1. fixture coverage — every SYNC rule fires on its purpose-built
+     positive at the EXACT pinned (file, line) and honors its
+     suppressed twin (tests/lint_fixtures/sync_*.py);
+  2. the tree gate — zero unsuppressed findings over the shipped
+     default roots, and the concurrency.json ratchet round-trips
+     clean;
+  3. the wiring — scripts/lint.py exits 7 on a seeded violation and
+     maps --changed diffs onto the sweep; the `sync` subcommand's
+     sorted-keys --json is byte-stable and exits 7 on its own.
+
+The env-lever drift gate (analysis/envlevers.py) rides along: the
+obs/README.md "## Levers" table must match the tree's actual
+`os.environ` reads in both directions.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from ouroboros_consensus_tpu.analysis import concurrency, envlevers
+from ouroboros_consensus_tpu.analysis.__main__ import main as analysis_cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "lint_gate_sync", os.path.join(REPO, "scripts", "lint.py")
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    return lint
+
+
+def _sweep_fixture(name):
+    rep = concurrency.sweep_paths(
+        [os.path.join(FIXTURES, f"{name}.py")], rel_to=FIXTURES
+    )
+    return rep.findings
+
+
+# ---------------------------------------------------------------------------
+# 1 — fixtures: exact (rule, line) pins per seeded violation
+# ---------------------------------------------------------------------------
+
+# (fixture module, unsuppressed (rule, line) pins, suppressed pins)
+_FIXTURE_PINS = [
+    ("sync_lock_order", [("SYNC201", 21)], [("SYNC201", 33)]),
+    ("sync_acquire", [("SYNC202", 15)], [("SYNC202", 28)]),
+    ("sync_guarded", [("SYNC203", 23)], [("SYNC203", 26)]),
+    ("sync_threads",
+     [("SYNC204", 40), ("SYNC205", 15), ("SYNC205", 22)],
+     [("SYNC204", 48), ("SYNC205", 55)]),
+    ("sync_install", [("SYNC206", 13)], [("SYNC206", 27)]),
+    ("sync_durability", [("SYNC207", 17)], [("SYNC207", 33)]),
+    ("sync_stale", [("SYNC208", 10)], []),
+]
+
+
+@pytest.mark.parametrize(
+    "name,fired,suppressed", _FIXTURE_PINS,
+    ids=[p[0] for p in _FIXTURE_PINS],
+)
+def test_fixture_exact_findings(name, fired, suppressed):
+    """Set equality, not subset: a fixture firing anything beyond its
+    pins means a checker regressed into noise."""
+    found = _sweep_fixture(name)
+    assert {(f.rule, f.line) for f in found if not f.suppressed} \
+        == set(fired)
+    assert {(f.rule, f.line) for f in found if f.suppressed} \
+        == set(suppressed)
+    assert all(f.path == f"{name}.py" for f in found)
+
+
+def test_every_sync_rule_represented():
+    all_rules = {r for _, fired, _ in _FIXTURE_PINS for r, _ in fired}
+    assert all_rules == set(concurrency.RULES)
+
+
+def test_suppressed_twin_for_every_suppressible_rule():
+    # SYNC208 is the suppression audit itself — the one rule without a
+    # suppressed twin in the fixture set
+    twinned = {r for _, _, sup in _FIXTURE_PINS for r, _ in sup}
+    assert twinned == set(concurrency.RULES) - {"SYNC208"}
+
+
+def test_lock_order_reports_one_finding_per_cycle():
+    found = [f for f in _sweep_fixture("sync_lock_order")
+             if f.rule == "SYNC201"]
+    # two cycles ({A,B} and {C,D}), each reported exactly once even
+    # though each has two inverted edges
+    assert len(found) == 2
+    msgs = "\n".join(f.message for f in found)
+    assert "sync_lock_order._A -> sync_lock_order._B" in msgs
+    assert "sync_lock_order._C -> sync_lock_order._D" in msgs
+
+
+def test_durability_blesses_tmp_rename_idiom():
+    found = _sweep_fixture("sync_durability")
+    # write_atomic's tmp write (line 25) must NOT fire: `.tmp` taint +
+    # an os.replace in the same function is the blessed protocol
+    assert not any(f.line == 25 for f in found)
+
+
+def test_standalone_comment_does_not_suppress():
+    src = (
+        "import threading\n"
+        "_L = threading.Lock()\n"
+        "def grab():\n"
+        "    # octsync: disable=SYNC202\n"
+        "    _L.acquire()\n"
+        "    return 1\n"
+    )
+    found = concurrency.sweep_source(src, "scopes")
+    by_rule = {f.rule: f for f in found}
+    # the comment line above the acquire suppresses nothing — the
+    # grammar is line-exact (finding line or def line only) — so the
+    # finding fires AND the comment is audited as stale
+    assert not by_rule["SYNC202"].suppressed
+    assert by_rule["SYNC208"].line == 4
+
+
+def test_def_line_suppression_scopes_whole_function():
+    src = (
+        "import threading\n"
+        "_L = threading.Lock()\n"
+        "def grab():  # octsync: disable=SYNC202\n"
+        "    _L.acquire()\n"
+        "    return 1\n"
+    )
+    found = concurrency.sweep_source(src, "scopes")
+    assert [f.rule for f in found] == ["SYNC202"]
+    assert found[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# 2 — the tree gate + ratchet round-trip
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tree_report():
+    return concurrency.sweep_paths(
+        concurrency.default_roots(REPO), REPO, concurrency.load_roots()
+    )
+
+
+def test_tree_has_no_unsuppressed_findings(tree_report):
+    bad = [f.format() for f in tree_report.findings if not f.suppressed]
+    assert not bad, "\n".join(bad)
+
+
+def test_ratchet_round_trips_clean(tree_report):
+    violations, stale = concurrency.check_sync(
+        tree_report, concurrency.load_baseline()
+    )
+    assert violations == []
+    assert stale == []
+
+
+def test_shipped_baseline_matches_payload(tree_report):
+    payload = concurrency.baseline_payload(tree_report)
+    shipped = concurrency.load_baseline()
+    assert payload["findings"] == shipped["findings"] == []
+    assert payload["inventory"] == shipped["inventory"]
+
+
+def test_inventory_drift_is_a_violation(tree_report):
+    base = json.loads(json.dumps(concurrency.load_baseline()))
+    base["inventory"]["locks"] = base["inventory"]["locks"][:-1]
+    violations, _ = concurrency.check_sync(tree_report, base)
+    assert any("inventory drift in `locks`" in v for v in violations)
+
+
+def test_new_finding_is_a_violation_and_keys_are_line_free():
+    found = _sweep_fixture("sync_acquire")
+    rep = concurrency.SyncReport(found, concurrency.load_baseline()
+                                 .get("inventory", {}))
+    violations, _ = concurrency.check_sync(
+        rep, concurrency.load_baseline()
+    )
+    assert any("SYNC202" in v and "grab" in v for v in violations)
+    # ratchet keys carry rule::path::message, never line numbers — a
+    # pure-whitespace shift above a grandfathered finding cannot
+    # resurrect it
+    for f in found:
+        assert f"::{f.line}" not in f.key()
+
+
+# ---------------------------------------------------------------------------
+# 3 — wiring: lint.py exit 7, --changed mapping, sync subcommand
+# ---------------------------------------------------------------------------
+
+
+def test_lint_changed_maps_concurrency_plane_to_sweep():
+    lint = _load_lint()
+    assert lint._sync_selected({"ouroboros_consensus_tpu/obs/live.py"})
+    assert lint._sync_selected({"ouroboros_consensus_tpu/storage/guard.py"})
+    assert lint._sync_selected({"ouroboros_consensus_tpu/analysis/sync_roots.json"})
+    assert lint._sync_selected({"ouroboros_consensus_tpu/testing/chaos.py"})
+    assert lint._sync_selected({"ouroboros_consensus_tpu/protocol/batch.py"})
+    assert lint._sync_selected({"ouroboros_consensus_tpu/ops/pk/aot.py"})
+    assert lint._sync_selected({"bench.py"})
+    assert not lint._sync_selected({"README.md"})
+    assert not lint._sync_selected({"ouroboros_consensus_tpu/ops/pk/msm.py"})
+    # empty diff / no git -> conservative full sweep
+    assert lint._sync_selected(set())
+
+
+def test_lint_exits_7_on_seeded_violation(monkeypatch):
+    """End to end through scripts/lint.py main(): poison the octsync
+    roots with a fixture that fires, assert the NEW exit code, then
+    assert --changed on an unrelated diff skips the sweep entirely."""
+    lint = _load_lint()
+    seeded = [os.path.join(FIXTURES, "sync_stale.py")]
+    monkeypatch.setattr(concurrency, "default_roots", lambda repo: seeded)
+    assert lint.main(["--no-graphs"]) == 7
+    # an unrelated --changed diff skips the sweep: exit 0 even with
+    # the poisoned roots
+    monkeypatch.setattr(lint, "_changed_files", lambda: {"README.md"})
+    assert lint.main(["--no-graphs", "--changed"]) == 0
+    # a concurrency-plane diff selects it again
+    monkeypatch.setattr(
+        lint, "_changed_files",
+        lambda: {"ouroboros_consensus_tpu/obs/live.py"},
+    )
+    assert lint.main(["--no-graphs", "--changed"]) == 7
+
+
+def test_sync_subcommand_exit_and_json_byte_stable(capsys):
+    fixture = os.path.join(FIXTURES, "sync_stale.py")
+    # findings not in the shipped ratchet -> the distinct exit code
+    assert analysis_cli(["sync", "--paths", fixture]) == 7
+    capsys.readouterr()
+    # --no-ratchet reports without enforcing
+    assert analysis_cli(["sync", "--paths", fixture, "--no-ratchet"]) == 0
+    capsys.readouterr()
+    assert analysis_cli(
+        ["sync", "--paths", fixture, "--no-ratchet", "--json"]
+    ) == 0
+    first = capsys.readouterr().out
+    assert analysis_cli(
+        ["sync", "--paths", fixture, "--no-ratchet", "--json"]
+    ) == 0
+    second = capsys.readouterr().out
+    assert first == second  # byte-stable for CI diffing
+    doc = json.loads(first)
+    assert doc["ok"] is True
+    assert [(f["rule"], f["line"]) for f in doc["findings"]] \
+        == [("SYNC208", 10)]
+
+
+def test_sync_subcommand_clean_tree_exits_0(capsys):
+    assert analysis_cli(["sync", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is True and doc["findings"] == []
+    assert doc["inventory"] == concurrency.load_baseline()["inventory"]
+
+
+# ---------------------------------------------------------------------------
+# env-lever drift gate (analysis/envlevers.py)
+# ---------------------------------------------------------------------------
+
+
+def test_env_lever_table_matches_tree():
+    violations = envlevers.check_env_levers()
+    assert not violations, "\n".join(violations)
+
+
+def test_env_lever_gate_catches_both_directions(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import os\n"
+        "A = os.environ.get('OCT_FAKE_READ_LEVER')\n"
+        "os.environ['OCT_FAKE_WRITE_LEVER'] = '1'\n"
+    )
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "## Levers\n\n"
+        "| Env | Effect |\n|---|---|\n"
+        "| `OCT_FAKE_DOC_LEVER=1` | documented but never read |\n"
+    )
+    out = envlevers.check_env_levers([str(src)], str(readme))
+    assert any("OCT_FAKE_READ_LEVER" in v and "no row" in v for v in out)
+    assert any("OCT_FAKE_DOC_LEVER" in v and "nothing" in v for v in out)
+    # a WRITE is not a read: bench.py sets levers for its child
+    assert not any("OCT_FAKE_WRITE_LEVER" in v for v in out)
+
+
+def test_env_lever_scanner_seams(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import os\n"
+        "_E = 'OCT_CONST_LEVER'\n"
+        "A = os.environ.get(_E)\n"
+        "B = os.getenv('OCT_GETENV_LEVER', '0')\n"
+        "C = os.environ['OCT_SUBSCRIPT_LEVER']\n"
+        "D = 'OCT_MEMBER_LEVER' in os.environ\n"
+        "E = os.environ.get('NOT_A_LEVER')\n"
+    )
+    reads = envlevers.scan_reads([str(src)])
+    assert reads == {"OCT_CONST_LEVER", "OCT_GETENV_LEVER",
+                     "OCT_SUBSCRIPT_LEVER", "OCT_MEMBER_LEVER"}
+
+
+def test_env_lever_variant_row_spellings_collapse(tmp_path):
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "## Levers\n\n"
+        "| Env | Effect |\n|---|---|\n"
+        "| `OCT_V=<dir>` / `OCT_V=0` | one lever, two spellings |\n\n"
+        "## Next section\n\n"
+        "| `OCT_NOT_A_LEVER_ROW` | tables after Levers don't count |\n"
+    )
+    assert envlevers.documented_levers(str(readme)) == {"OCT_V"}

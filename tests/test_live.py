@@ -204,6 +204,45 @@ def test_heartbeat_thread_start_stop(tmp_path):
     assert doc["interval_s"] == 0.05
 
 
+def test_heartbeat_thread_survives_beat_errors(tmp_path, monkeypatch):
+    """A raising beat must not kill the heartbeat thread, must not be
+    swallowed silently (the count surfaces as `beat_errors` in the next
+    good document + ONE bounded warmup note), and stop() must still
+    join and land a final beat."""
+    from ouroboros_consensus_tpu.obs.warmup import WARMUP
+
+    WARMUP.reset()
+    try:
+        path = str(tmp_path / "hb.json")
+        hb = live.Heartbeat(path, rec=obs.recorder(), interval_s=0.02)
+        boom = [True]
+        real_beat = hb.beat
+
+        def flaky_beat():
+            if boom[0]:
+                raise RuntimeError("snapshot source wedged")
+            return real_beat()
+
+        monkeypatch.setattr(hb, "beat", flaky_beat)
+        hb.start()  # the immediate armed-plane beat raises too
+        time.sleep(0.15)
+        assert hb._thread is not None and hb._thread.is_alive()
+        assert hb.beat_errors >= 2  # kept beating through the errors
+        boom[0] = False
+        time.sleep(0.1)
+        hb.stop()  # joins cleanly; the final beat succeeds
+        doc = live.read_heartbeat(path)
+        assert doc is not None
+        assert doc["beat_errors"] >= 2  # failures stay visible
+        # one bounded forensic note, not one per failed interval
+        notes = [n for n in WARMUP.report()["notes"]
+                 if "heartbeat beat failed" in n]
+        assert len(notes) == 1
+        assert "RuntimeError" in notes[0]
+    finally:
+        WARMUP.reset()
+
+
 def test_heartbeat_survives_a_kill_mid_rewrite(tmp_path):
     """Mirror of test_warmup_report_survives_a_kill: a child SIGKILLed
     mid-rewrite (a torn .tmp on disk) must leave the last COMPLETE beat
