@@ -394,6 +394,7 @@ _WARMUP.note(
 jax.config.update("jax_compilation_cache_dir", cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 from bench import BENCH_HEADERS, KES_DEPTH, MAX_BATCH, bench_params, build_or_load_chain
+from ouroboros_consensus_tpu.storage import sidecar as _sidecar
 from ouroboros_consensus_tpu.tools import db_analyser as ana
 
 # the flight recorder rides every replay (per-window spans, gate
@@ -457,6 +458,12 @@ def attribution(r):
         out["opened_dirty"] = True
     if r.repairs:
         out["repairs"] = dict(r.repairs)
+    # columnar-sidecar outcomes of THIS replay (reset before each timed
+    # run): hit/miss attribution for the view-stream wall — the
+    # stream-mmap/stream-parse phases_s rows split the same wall
+    sc = _sidecar.counters()
+    if any(sc.values()):
+        out["sidecar"] = sc
     return out
 
 # Warm up compiles/cache-loads on the SMALL cached chain when the
@@ -510,6 +517,7 @@ if _resume_lever is not None:
 best_rate = None
 for _ in range(2):
     t0 = time.monotonic()
+    _sidecar.reset_counters()
     r = ana.revalidate(path, params, lview, backend="device",
                        validate_all="stream", max_batch=MAX_BATCH,
                        collect_phases=True)

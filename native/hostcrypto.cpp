@@ -946,6 +946,173 @@ extern "C" void oc_blake2b(const u8* p, size_t n, u8* out, int outlen) {
     blake2b(p, n, out, outlen);
 }
 
+// ---------------------------------------------------------------------------
+// CRC32 (zlib polynomial 0xEDB88320, reflected) — the sidecar probe's
+// seal check. PCLMULQDQ 4-way folding where the CPU has it (runtime
+// detected; ~10x zlib's slicing tables), slicing-by-8 otherwise. Both
+// produce values bit-identical to zlib.crc32 — the seals on disk were
+// written with zlib and MUST keep verifying.
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32_tab[8][256];
+static int crc32_tab_ready = 0;
+
+static void crc32_tab_init(void) {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c >> 1) ^ (0xEDB88320u & (0u - (c & 1u)));
+        crc32_tab[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++)
+        for (int j = 1; j < 8; j++)
+            crc32_tab[j][i] = (crc32_tab[j - 1][i] >> 8)
+                ^ crc32_tab[0][crc32_tab[j - 1][i] & 0xffu];
+    crc32_tab_ready = 1;
+}
+
+static uint32_t crc32_sw(const u8* p, size_t n, uint32_t crc) {
+    if (!crc32_tab_ready) crc32_tab_init();
+    crc = ~crc;
+    while (n && ((uintptr_t)p & 7)) {
+        crc = (crc >> 8) ^ crc32_tab[0][(crc ^ *p++) & 0xffu];
+        n--;
+    }
+    while (n >= 8) {
+        u64 v;
+        memcpy(&v, p, 8);
+        v ^= crc;
+        crc = crc32_tab[7][v & 0xff] ^ crc32_tab[6][(v >> 8) & 0xff]
+            ^ crc32_tab[5][(v >> 16) & 0xff] ^ crc32_tab[4][(v >> 24) & 0xff]
+            ^ crc32_tab[3][(v >> 32) & 0xff] ^ crc32_tab[2][(v >> 40) & 0xff]
+            ^ crc32_tab[1][(v >> 48) & 0xff] ^ crc32_tab[0][(v >> 56) & 0xff];
+        p += 8;
+        n -= 8;
+    }
+    while (n--) crc = (crc >> 8) ^ crc32_tab[0][(crc ^ *p++) & 0xffu];
+    return ~crc;
+}
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+
+// Reflected CRC-32 by 4x128-bit carry-less folding (the classic Intel
+// PCLMULQDQ scheme; constants are x^K mod P for the zlib polynomial).
+// Takes and returns the RAW (pre/post-inverted) crc register; requires
+// len >= 64 and len % 16 == 0 — the caller folds the tail with tables.
+__attribute__((target("pclmul,sse4.1")))
+static uint32_t crc32_clmul(const u8* buf, size_t len, uint32_t crc) {
+    const __m128i k1k2 = _mm_set_epi64x(0x00000001c6e41596ll,
+                                        0x0000000154442bd4ll);
+    const __m128i k3k4 = _mm_set_epi64x(0x00000000ccaa009ell,
+                                        0x00000001751997d0ll);
+    const __m128i k5k0 = _mm_set_epi64x(0x0000000000000000ll,
+                                        0x0000000163cd6124ll);
+    const __m128i poly = _mm_set_epi64x(0x00000001f7011641ll,
+                                        0x00000001db710641ll);
+    __m128i x0, x1, x2, x3, x4, x5, x6, x7, x8, y5, y6, y7, y8;
+
+    x1 = _mm_loadu_si128((const __m128i*)(buf + 0x00));
+    x2 = _mm_loadu_si128((const __m128i*)(buf + 0x10));
+    x3 = _mm_loadu_si128((const __m128i*)(buf + 0x20));
+    x4 = _mm_loadu_si128((const __m128i*)(buf + 0x30));
+    x1 = _mm_xor_si128(x1, _mm_cvtsi32_si128((int)crc));
+    x0 = k1k2;
+    buf += 64;
+    len -= 64;
+
+    while (len >= 64) {
+        x5 = _mm_clmulepi64_si128(x1, x0, 0x00);
+        x6 = _mm_clmulepi64_si128(x2, x0, 0x00);
+        x7 = _mm_clmulepi64_si128(x3, x0, 0x00);
+        x8 = _mm_clmulepi64_si128(x4, x0, 0x00);
+        x1 = _mm_clmulepi64_si128(x1, x0, 0x11);
+        x2 = _mm_clmulepi64_si128(x2, x0, 0x11);
+        x3 = _mm_clmulepi64_si128(x3, x0, 0x11);
+        x4 = _mm_clmulepi64_si128(x4, x0, 0x11);
+        y5 = _mm_loadu_si128((const __m128i*)(buf + 0x00));
+        y6 = _mm_loadu_si128((const __m128i*)(buf + 0x10));
+        y7 = _mm_loadu_si128((const __m128i*)(buf + 0x20));
+        y8 = _mm_loadu_si128((const __m128i*)(buf + 0x30));
+        x1 = _mm_xor_si128(_mm_xor_si128(x1, x5), y5);
+        x2 = _mm_xor_si128(_mm_xor_si128(x2, x6), y6);
+        x3 = _mm_xor_si128(_mm_xor_si128(x3, x7), y7);
+        x4 = _mm_xor_si128(_mm_xor_si128(x4, x8), y8);
+        buf += 64;
+        len -= 64;
+    }
+
+    // fold the four lanes down to one
+    x0 = k3k4;
+    x5 = _mm_clmulepi64_si128(x1, x0, 0x00);
+    x1 = _mm_clmulepi64_si128(x1, x0, 0x11);
+    x1 = _mm_xor_si128(_mm_xor_si128(x1, x2), x5);
+    x5 = _mm_clmulepi64_si128(x1, x0, 0x00);
+    x1 = _mm_clmulepi64_si128(x1, x0, 0x11);
+    x1 = _mm_xor_si128(_mm_xor_si128(x1, x3), x5);
+    x5 = _mm_clmulepi64_si128(x1, x0, 0x00);
+    x1 = _mm_clmulepi64_si128(x1, x0, 0x11);
+    x1 = _mm_xor_si128(_mm_xor_si128(x1, x4), x5);
+
+    while (len >= 16) {
+        x2 = _mm_loadu_si128((const __m128i*)buf);
+        x5 = _mm_clmulepi64_si128(x1, x0, 0x00);
+        x1 = _mm_clmulepi64_si128(x1, x0, 0x11);
+        x1 = _mm_xor_si128(_mm_xor_si128(x1, x2), x5);
+        buf += 16;
+        len -= 16;
+    }
+
+    // 128 -> 64 -> 32 reduction, then Barrett
+    x2 = _mm_clmulepi64_si128(x1, x0, 0x10);
+    x3 = _mm_setr_epi32(~0, 0, ~0, 0);
+    x1 = _mm_srli_si128(x1, 8);
+    x1 = _mm_xor_si128(x1, x2);
+
+    x0 = k5k0;
+    x2 = _mm_srli_si128(x1, 4);
+    x1 = _mm_and_si128(x1, x3);
+    x1 = _mm_clmulepi64_si128(x1, x0, 0x00);
+    x1 = _mm_xor_si128(x1, x2);
+
+    x0 = poly;
+    x2 = _mm_and_si128(x1, x3);
+    x2 = _mm_clmulepi64_si128(x2, x0, 0x10);
+    x2 = _mm_and_si128(x2, x3);
+    x2 = _mm_clmulepi64_si128(x2, x0, 0x00);
+    x1 = _mm_xor_si128(x1, x2);
+
+    return (uint32_t)_mm_extract_epi32(x1, 1);
+}
+#endif
+
+extern "C" uint32_t oc_crc32(const u8* p, size_t n, uint32_t crc) {
+#if defined(__x86_64__) || defined(__i386__)
+    if (n >= 64 && __builtin_cpu_supports("pclmul")
+            && __builtin_cpu_supports("sse4.1")) {
+        size_t chunk = n & ~(size_t)15;
+        crc = ~crc32_clmul(p, chunk, ~crc);
+        p += chunk;
+        n -= chunk;
+    }
+#endif
+    return crc32_sw(p, n, crc);
+}
+
+// Batch blake2b over n spans data[starts[i]:ends[i]) → out[i*outlen ..).
+// The columnar-sidecar body-hash sweep: one C loop over the whole chunk
+// instead of n Python-side hashlib round-trips.
+extern "C" void oc_blake2b_spans(const u8* data, long n,
+                                 const long long* starts,
+                                 const long long* ends, u8* out,
+                                 int outlen) {
+    for (long i = 0; i < n; i++) {
+        long long s = starts[i], e = ends[i];
+        if (e < s) e = s;
+        blake2b(data + s, (size_t)(e - s), out + (size_t)i * outlen, outlen);
+    }
+}
+
 // The full per-header crypto of Praos updateChainDepState
 // (Praos.hs:441-606): OCert DSIGN verify + CompactSum KES verify + ECVRF
 // verify + declared-output compare. State bookkeeping (nonces, counters,

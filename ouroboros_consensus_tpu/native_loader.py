@@ -181,6 +181,13 @@ def load_crypto():
     lib.oc_blake2b.argtypes = [
         ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_int,
     ]
+    lib.oc_crc32.restype = ctypes.c_uint32
+    lib.oc_crc32.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_uint32]
+    lib.oc_blake2b_spans.restype = None
+    lib.oc_blake2b_spans.argtypes = [
+        ctypes.c_void_p, ctypes.c_long, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int,
+    ]
     lib.oc_validate_praos.restype = ctypes.c_long
     lib.oc_validate_praos.argtypes = (
         [ctypes.c_long] + [ctypes.c_void_p] * 6 + [ctypes.c_long]
@@ -213,6 +220,39 @@ def load_crypto():
     ]
     _clib = lib
     return _clib
+
+
+def native_crc32(data, value: int = 0):
+    """CRC32 (zlib polynomial) via the native library — PCLMULQDQ
+    folding on CPUs that have it, bit-identical to ``zlib.crc32``.
+    None when the library is unavailable (callers fall back to zlib)."""
+    lib = load_crypto()
+    if lib is None or not hasattr(lib, "oc_crc32"):
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    return int(lib.oc_crc32(buf.ctypes.data, buf.size, value & 0xFFFFFFFF))
+
+
+def native_blake2b_spans(data, starts, ends, digest_size: int = 32):
+    """Batch blake2b over ``data[starts[i]:ends[i])`` via one C call →
+    [n, digest_size] uint8, or None when the library is unavailable
+    (callers fall back to the hashlib loop). `data` may be bytes, a
+    memoryview, or an mmap — anything the buffer protocol exposes
+    contiguously."""
+    lib = load_crypto()
+    if lib is None or not hasattr(lib, "oc_blake2b_spans"):
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    s = np.ascontiguousarray(starts, np.int64)
+    e = np.ascontiguousarray(ends, np.int64)
+    n = len(s)
+    out = np.empty((n, digest_size), np.uint8)
+    if n:
+        lib.oc_blake2b_spans(
+            buf.ctypes.data, n, s.ctypes.data, e.ctypes.data,
+            out.ctypes.data, digest_size,
+        )
+    return out
 
 
 def native_ed25519_sign(seed: bytes, msg: bytes) -> bytes | None:

@@ -18,6 +18,8 @@ event into the metrics registry:
                                            (obs/recovery)
     oct_repair_total{action=}              on-disk store repairs applied
                                            (storage/repair)
+    oct_sidecar_total{outcome=}            columnar-sidecar probe/build
+                                           outcomes (storage/sidecar)
     oct_shard_{windows,lanes,ok_lanes,pad_lanes}_total{shard=}
                                            per-shard SPMD telemetry
 
@@ -31,8 +33,8 @@ import time
 
 from ..utils.trace import (
     AggRedispatch, CheckpointEvent, EncloseEvent, LadderEvent,
-    RecoveryEvent, RepairEvent, ShardSpan, StallEvent, TransferEvent,
-    WindowSpan, WindowStaged,
+    RecoveryEvent, RepairEvent, ShardSpan, SidecarEvent, StallEvent,
+    TransferEvent, WindowSpan, WindowStaged,
 )
 from . import registry as _registry
 
@@ -100,6 +102,13 @@ class FlightRecorder:
         self._repairs = r.counter(
             "oct_repair_total",
             "on-disk store repair actions applied", ("action",),
+        )
+        # columnar-sidecar plane (storage/sidecar.py): every freshness
+        # probe / backfill outcome — hit is the parse-free fast path,
+        # everything else costs exactly one parse fallback
+        self._sidecar = r.counter(
+            "oct_sidecar_total",
+            "columnar-sidecar probe/build outcomes", ("outcome",),
         )
         # per-shard SPMD telemetry (parallel/spmd.py ShardSpan events):
         # label cardinality is the mesh size — bounded by hardware
@@ -176,6 +185,8 @@ class FlightRecorder:
         elif isinstance(ev, RepairEvent):
             if ev.applied:
                 self._repairs.labels(action=ev.action).inc()
+        elif isinstance(ev, SidecarEvent):
+            self._sidecar.labels(outcome=ev.outcome).inc()
         elif isinstance(ev, ShardSpan):
             s = str(ev.shard)
             self._shard_windows.labels(shard=s).inc()

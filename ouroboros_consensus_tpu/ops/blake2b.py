@@ -189,6 +189,85 @@ def blake2b_blocks(blocks, nblocks, total_len, digest_size: int = 32):
     return jnp.concatenate(outs, axis=-1)[..., :digest_size]
 
 
+_ENV_DEVICE_HASH = "OCT_SIDECAR_DEVICE_HASH"
+_hash_spans_jit = None
+
+
+def _device_hash_enabled() -> bool:
+    """``OCT_SIDECAR_DEVICE_HASH`` (default 0): route the sidecar hot
+    path's body-hash batch through the device Blake2b kernel instead
+    of hashlib. Off by default — the host loop is exact and the device
+    batch only pays off once the span batch is large and a device is
+    attached; read per call so tests A/B both paths."""
+    import os
+
+    return os.environ.get(_ENV_DEVICE_HASH, "0") == "1"
+
+
+def hash_spans(data, starts, ends, digest_size: int = 32) -> np.ndarray:
+    """Blake2b over ``data[starts[i]:ends[i])`` for every i →
+    [n, digest_size] uint8 digests — the columnar-sidecar hot path's
+    per-header body-hash compare (storage/sidecar.integrity_batch_hook)
+    with ZERO header parsing: the spans come straight from the
+    sidecar's ``header_end`` column and the index entries. One native
+    batch call when the host-crypto library is available (the hot
+    path), hashlib loop otherwise; `_device_hash_enabled` routes the
+    whole batch through `blake2b_blocks` with bucket-padded shapes."""
+    import hashlib
+
+    n = len(starts)
+    out = np.empty((n, digest_size), np.uint8)
+    if n == 0:
+        return out
+    mv = memoryview(data)
+    if _device_hash_enabled():
+        msgs = [bytes(mv[int(s):int(e)]) for s, e in zip(starts, ends)]
+        return _hash_spans_device(msgs, digest_size)
+    from .. import native_loader
+
+    native = native_loader.native_blake2b_spans(data, starts, ends, digest_size)
+    if native is not None:
+        return native
+    for i in range(n):
+        out[i] = np.frombuffer(
+            hashlib.blake2b(
+                mv[int(starts[i]):int(ends[i])], digest_size=digest_size
+            ).digest(),
+            np.uint8,
+        )
+    return out
+
+
+def _hash_spans_device(msgs, digest_size: int) -> np.ndarray:
+    """Bucket-padded device batch: nblocks rounds up to a power of two
+    and the batch to a multiple of 256 (zero-length pad lanes, outputs
+    dropped), so repeated chunks reuse ONE compiled executable per
+    bucket instead of re-tracing per chunk shape."""
+    global _hash_spans_jit
+    import jax
+
+    if _hash_spans_jit is None:
+        _hash_spans_jit = jax.jit(
+            blake2b_blocks, static_argnames=("digest_size",)
+        )
+    need = max(nblocks_for_len(len(m)) for m in msgs)
+    nb = 1 << max(0, need - 1).bit_length()
+    blocks, nblocks, total = pad_messages_np(msgs, nb=nb)
+    n = len(msgs)
+    b = max(256, ((n + 255) // 256) * 256)
+    if b != n:
+        pad = b - n
+        blocks = np.concatenate(
+            [blocks, np.zeros((pad, *blocks.shape[1:]), blocks.dtype)]
+        )
+        nblocks = np.concatenate([nblocks, np.ones(pad, np.int32)])
+        total = np.concatenate([total, np.zeros(pad, np.int32)])
+    dig = np.asarray(
+        _hash_spans_jit(blocks, nblocks, total, digest_size=digest_size)
+    )
+    return dig[:n].astype(np.uint8)
+
+
 def nonce_fold_scan(etas, within, is_real, ev0, ev0_set, cand0, cand0_set):
     """Device-side Praos nonce fold: `jax.lax.scan` of the evolving /
     candidate nonce bookkeeping over a window's per-lane eta values,

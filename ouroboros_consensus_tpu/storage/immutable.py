@@ -74,6 +74,12 @@ def _index_name(n: int) -> str:
     return f"{n:05d}.index"
 
 
+def _cols_name(n: int) -> str:
+    """Chunk n's columnar sidecar (storage/sidecar.py) — lives beside
+    the chunk + index it is derived from."""
+    return f"{n:05d}.cols"
+
+
 class ImmutableDB:
     """Append-only block store; blocks arrive in strictly increasing slot
     order (the chain ≥ k deep is immutable — ChainDB background copy).
@@ -185,6 +191,24 @@ class ImmutableDB:
                     "sweep-orphan-index", int(f.split(".")[0]), qbytes=q,
                     detail="index file without a chunk",
                 )
+            elif f.endswith(".cols.tmp") or (
+                f.endswith(".cols") and int(f.split(".")[0]) not in live
+            ):
+                # a sidecar tmp is NEVER live (the rename it awaited
+                # died — a crash mid-build); a final-name sidecar is
+                # orphaned when its chunk is gone. Both are derived
+                # data with no referent — quarantined like any orphan,
+                # never trusted, never deleted (storage/sidecar.py
+                # trust contract)
+                q = 0
+                if self._repair:
+                    q = self._quarantine_file(f)
+                self._note_repair(
+                    "sweep-orphan-sidecar", int(f.split(".")[0]), qbytes=q,
+                    detail="sidecar without a chunk"
+                    if f.endswith(".cols")
+                    else "sidecar tmp stranded by a crash mid-build",
+                )
 
     # -- the repair plane ----------------------------------------------------
 
@@ -239,7 +263,7 @@ class ImmutableDB:
             dropped = len(idx) if idx else 0
         q = 0
         if self._repair:
-            for name in (_chunk_name(n), _index_name(n)):
+            for name in (_chunk_name(n), _index_name(n), _cols_name(n)):
                 if self.fs.exists(os.path.join(self.path, name)):
                     q += self._quarantine_file(name)  # moved, not copied
         self._note_repair("drop-chunk", n, kept=0, dropped=dropped,
@@ -500,12 +524,24 @@ class ImmutableDB:
         return entries
 
     def _rewrite_chunk(self, n: int, data: bytes, entries: list[IndexEntry]):
+        # the chunk bytes change, so any sidecar's seal is now a lie:
+        # quarantine it BEFORE the rewrite (never trusted past its
+        # seal, never deleted) — the next writer replay backfills
+        self._invalidate_sidecar(n)
         end = entries[-1].offset + entries[-1].size if entries else 0
         self.fs.write_bytes(os.path.join(self.path, _chunk_name(n)), data[:end])
         self._write_index(n, entries)
 
+    def _invalidate_sidecar(self, n: int) -> int:
+        """Move chunk n's sidecar (if any) into quarantine — every
+        path that mutates chunk bytes calls this first, so a stale
+        seal can never linger beside the rewritten chunk."""
+        if self.fs.exists(os.path.join(self.path, _cols_name(n))):
+            return self._quarantine_file(_cols_name(n))
+        return 0
+
     def _remove_chunk(self, n: int):
-        for name in (_chunk_name(n), _index_name(n)):
+        for name in (_chunk_name(n), _index_name(n), _cols_name(n)):
             self.fs.remove(os.path.join(self.path, name))
 
     def _load_index(self, ipath: str) -> list[IndexEntry] | None:

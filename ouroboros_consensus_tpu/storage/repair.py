@@ -28,6 +28,9 @@ Action vocabulary (the ``oct_repair_total{action=}`` labels):
     drop-chunk            a wholly corrupt chunk (or a chunk stranded
                           past a truncation gap) was removed
     sweep-orphan-index    an index file without a chunk was removed
+    sweep-orphan-sidecar  a columnar sidecar (or a sidecar tmp
+                          stranded by a crash mid-build) without a
+                          live chunk was removed (storage/sidecar.py)
     dirty-open-escalated  a missing clean-shutdown marker escalated
                           the validation policy to all-chunks
                           (storage/guard.py; the open itself)
@@ -42,6 +45,7 @@ REPAIR_ACTIONS = (
     "rebuild-index",
     "drop-chunk",
     "sweep-orphan-index",
+    "sweep-orphan-sidecar",
     "dirty-open-escalated",
 )
 

@@ -60,6 +60,25 @@ writer's seam (`ImmutableDB.append_block` consumes them via
                                   dirty (optionally @marker:clean to
                                   name a specific marker)
 
+Columnar-sidecar faults (PR 17) land at the sidecar writer's and
+freshness probe's seams (`storage/sidecar.write_sidecar` /
+`load_sidecar` consume them via `sidecar_fault()` and own the
+semantics — a fault here may NEVER change a replay verdict, only
+force the parse fallback):
+
+    sidecar-torn@build:2          the 3rd sidecar build bypasses the
+                                  tmp+rename protocol and lands a torn
+                                  prefix at the final name; the probe
+                                  must reject it by seal
+    sidecar-stale@open:0          the 1st freshness probe reports
+                                  stale regardless of the seal — the
+                                  replay falls back to parse and (a
+                                  writer open) rebuilds
+    sigkill@build:1               SIGKILL self between the 2nd sidecar
+                                  build's tmp write and its rename —
+                                  only the durable tmp survives (the
+                                  next open sweeps it)
+
 Triggers are matched against per-seam sequence counters (each seam
 counts its own firings from 0 in dispatch order) or, for ``stage:``,
 by substring against the stage label. Each injection fires EXACTLY
@@ -104,6 +123,10 @@ FAULT_KINDS = (
     "bitflip",
     "index-truncate",
     "partial-rename",
+    # columnar-sidecar faults (storage/sidecar.py; verdict-neutral by
+    # contract — they may only force the parse fallback)
+    "sidecar-torn",
+    "sidecar-stale",
 )
 
 # which seam(s) each fault kind is checked at — fire(site) only
@@ -113,7 +136,7 @@ _KIND_SITES = {
     "compile-stall": ("dispatch", "stage-call"),
     "device-error": ("dispatch", "stage-call", "shard"),
     "staging-thread-death": ("stage",),
-    "sigkill": ("retire", "append"),
+    "sigkill": ("retire", "append", "sidecar-build"),
     "chunk-corrupt": ("chunk",),
     "aot-reject": ("aot",),
     "probe-timeout": ("probe",),
@@ -123,6 +146,10 @@ _KIND_SITES = {
     "bitflip": ("append",),
     "index-truncate": ("append",),
     "partial-rename": ("marker",),
+    # the sidecar writer's seam (sidecar_fault in write_sidecar) and
+    # the freshness probe's (load_sidecar)
+    "sidecar-torn": ("sidecar-build",),
+    "sidecar-stale": ("sidecar-open",),
 }
 
 # the trigger keys each seam actually provides (its explicit ctx= kwargs
@@ -140,6 +167,8 @@ _SITE_TRIGGER_KEYS = {
     "aot": ("stage",),
     "marker": ("marker",),
     "probe": ("attempt",),
+    "sidecar-build": ("build", "chunk"),
+    "sidecar-open": ("open", "chunk"),
 }
 
 
@@ -431,6 +460,9 @@ _SITE_SEQ_KEYS = {
     # "stage-call" / "aot" match only on the explicit stage= ctx;
     # "marker" matches only on the explicit marker= ctx;
     # "probe" is consumed via probe_timeout_pending()
+    "sidecar-build": ("build",),  # one sidecar build per seq; the
+    # CHUNK NUMBER rides the explicit chunk= ctx (sidecar-torn@chunk:N)
+    "sidecar-open": ("open",),  # one freshness probe per seq
 }
 
 
@@ -479,6 +511,22 @@ def write_fault(**ctx) -> str | None:
     ``index-truncate``, a SIGKILL between the chunk and index appends
     for ``sigkill@append``). None = no fault this append."""
     m = _match("append", ctx)
+    if m is None:
+        return None
+    inj, _seq = m
+    inj.spend()
+    return inj.kind
+
+
+def sidecar_fault(site: str, **ctx) -> str | None:
+    """The columnar-sidecar seams (`storage/sidecar.write_sidecar` at
+    ``sidecar-build``, `load_sidecar` at ``sidecar-open``): matching
+    identical to `fire()` (`_match`), but the injection's KIND is
+    returned instead of executed — the sidecar module owns the
+    semantics (a torn prefix at the final name for ``sidecar-torn``, a
+    SIGKILL between tmp and rename for ``sigkill@build``, a forced
+    stale verdict for ``sidecar-stale``). None = no fault here."""
+    m = _match(site, ctx)
     if m is None:
         return None
     inj, _seq = m

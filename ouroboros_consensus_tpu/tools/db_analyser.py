@@ -266,20 +266,40 @@ def _read_chunk(path: str, chunk_idx: int) -> bytes:
 
 
 def _stream_windows(imm: ImmutableDB, res: "ValidationResult"):
-    """Per-chunk window stream for revalidation: `ViewColumns` straight
-    from the native columnar extractor when available (the C++
-    data-loader path — SURVEY.md §7.3 item 5: CBOR decode is the host
-    bottleneck — with ZERO per-header Python objects), HeaderView lists
-    otherwise (no native library, OCT_COLUMNAR=0, or ragged chunks)."""
+    """Per-chunk window stream for revalidation. Three tiers:
+
+    1. **Sidecar fast path** (storage/sidecar.py): a fresh-sealed
+       ``NNNNN.cols`` builds `ViewColumns` straight from mmap'd column
+       blobs — ZERO per-header parse; stream-deep integrity collapses
+       to the one native ``crc32_first_bad`` sweep plus the sidecar's
+       body-hash columns (``ops/blake2b.hash_spans``), with the exact
+       host walk kept as the anomaly path on any truncation.
+    2. **Native parse** (`native_loader.extract_headers` — the C++
+       data-loader path, SURVEY.md §7.3 item 5): the miss/stale
+       fallback, which also BACKFILLS the sidecar through the PR 13
+       tmp+rename protocol — writer opens only; a read-only open never
+       writes.
+    3. **HeaderView lists** (no native library, OCT_COLUMNAR=0, or
+       ragged chunks).
+
+    The mmap-vs-parse wall split rides nested `_enclose` brackets
+    ("stream-mmap" / "stream-parse") inside the per-chunk "stream"
+    span, so the flight recorder's phase collector banks both."""
     import os
+
+    import numpy as np
 
     from .. import native_loader
     from ..protocol.views import ViewColumns
+    from ..storage import sidecar as sidecar_mod
     from ..storage.immutable import _chunk_name
 
     native_ok = native_loader.load() is not None
     columnar = _columnar_enabled()
     stream_deep = getattr(imm, "stream_deep", False)
+    # the sidecar produces ViewColumns, so the kill-switch rides BOTH
+    # levers: OCT_SIDECAR=0 and OCT_COLUMNAR=0 each restore the parse
+    use_sidecar = sidecar_mod.enabled() and native_ok and columnar
     for chunk_idx, n in enumerate(imm._chunks):
         entries = imm._entries[n]
         if not entries:
@@ -293,6 +313,13 @@ def _stream_windows(imm: ImmutableDB, res: "ValidationResult"):
                 os.path.join(imm.path, _chunk_name(n)), chunk_idx
             )
             truncated = False
+            sc = None
+            if use_sidecar:
+                with pbatch._enclose("stream-mmap"):
+                    sc, outcome = sidecar_mod.load_sidecar(
+                        imm.fs, imm.path, n, data, len(entries)
+                    )
+                sidecar_mod.record(outcome, n)
             if stream_deep:
                 # single-pass validate-all: the open deferred the deep
                 # walk to this read (open_immutable "stream" mode) —
@@ -302,10 +329,38 @@ def _stream_windows(imm: ImmutableDB, res: "ValidationResult"):
                     default_check_integrity_batch,
                 )
 
-                good = imm.deep_check_loaded(
-                    data, entries, default_check_integrity,
-                    default_check_integrity_batch,
-                )
+                if sc is not None:
+                    # hot path — no parse. WALKED seals (forge/truncater/
+                    # deep-replay builds) skip the per-blob CRC sweep:
+                    # the probe's whole-chunk CRC proved these are the
+                    # build-time bytes, and the build-time walk proved
+                    # those bytes pass the sweep; only the body-hash
+                    # compare (cryptographic, vs the sealed column)
+                    # still runs. Unwalked seals pay the full sweep.
+                    if sc.walked:
+                        good = sidecar_mod.integrity_batch_hook(sc)(
+                            data, entries
+                        )
+                    else:
+                        good = imm.deep_check_loaded(
+                            data, entries, default_check_integrity,
+                            sidecar_mod.integrity_batch_hook(sc),
+                        )
+                    if good < len(entries):
+                        # anomaly path: recompute with the EXACT host
+                        # walk so the truncation point and arbitration
+                        # are parse-identical, and drop the sidecar —
+                        # its seal dies with the repair anyway
+                        sc = None
+                        good = imm.deep_check_loaded(
+                            data, entries, default_check_integrity,
+                            default_check_integrity_batch,
+                        )
+                else:
+                    good = imm.deep_check_loaded(
+                        data, entries, default_check_integrity,
+                        default_check_integrity_batch,
+                    )
                 if good < len(entries):
                     entries = entries[:good]
                     truncated = True
@@ -315,22 +370,42 @@ def _stream_windows(imm: ImmutableDB, res: "ValidationResult"):
                         # quarantine + on-disk cut, the same repair a
                         # deep open would have taken here
                         imm.repair_to(n, good, data=data)
+            pieces = None
             cols = None
-            if native_ok and entries:
-                import numpy as np
-
-                offsets = np.asarray([e.offset for e in entries], np.int64)
-                cols = native_loader.extract_headers(data, offsets)
+            if sc is not None and not truncated:
+                with pbatch._enclose("stream-mmap"):
+                    pieces = sc.pieces(data)
+                if pieces is not None:
+                    res.n_blocks += sc.n
+            if pieces is None and native_ok and entries:
+                with pbatch._enclose("stream-parse"):
+                    offsets = np.asarray(
+                        [e.offset for e in entries], np.int64
+                    )
+                    cols = native_loader.extract_headers(data, offsets)
                 res.n_blocks += cols.n
-        if cols is not None:
-            pieces = (
+                if use_sidecar and sc is None and not truncated \
+                        and getattr(imm, "_repair", False):
+                    # back-fill: the first replay of an un-sidecared
+                    # chunk writes the sidecar it just paid the parse
+                    # for (tmp+rename durability; WRITER opens only —
+                    # a read-only open leaves the disk untouched).
+                    # walked only when THIS replay's deep walk covered
+                    # the whole chunk; a shallow replay seals unwalked
+                    if sidecar_mod.backfill(imm.fs, imm.path, n, cols,
+                                            data, walked=stream_deep):
+                        sidecar_mod.record("rebuilt", n)
+        if pieces is not None:
+            yield from pieces
+        elif cols is not None:
+            pcs = (
                 ViewColumns.pieces_from_header_columns(cols)
                 if columnar else None
             )
-            if pieces is None:
+            if pcs is None:
                 yield _views_from_columns(cols)
             else:
-                yield from pieces
+                yield from pcs
         else:
             win = []
             for e in entries:

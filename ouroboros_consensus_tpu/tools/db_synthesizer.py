@@ -383,6 +383,14 @@ def _synthesize_locked(
         res.n_slots += 1
 
     imm.flush()
+    # forge-time sidecars: seal every retired chunk's columnar sidecar
+    # NOW so the first replay opens hot (write-once; skips fresh seals;
+    # no-op under OCT_SIDECAR=0 or without the native extractor)
+    from ..storage import sidecar as sidecar_mod
+
+    # walked=True: the forge wrote these exact bytes this run — the
+    # seal covers a chunk whose integrity holds by construction
+    sidecar_mod.backfill_store(imm, walked=True)
     res.wall_s = time.monotonic() - t0
     res.final_state = st
     return res

@@ -118,6 +118,16 @@ def repair(db_path: str, dry_run: bool = False,
         )
         if not dry_run:
             imm.flush()
+            # regenerate sidecars the repair walk invalidated: any
+            # rewritten/truncated chunk had its stale seal quarantined,
+            # so re-seal from the now-consistent bytes (write-once —
+            # chunks whose seal survived are skipped)
+            from ..storage import sidecar as sidecar_mod
+
+            # walked=True: everything that survives --to-last-valid sits
+            # at or below the validated truncation point — the repair
+            # walk that chose it covered every surviving blob
+            sidecar_mod.backfill_store(imm, walked=True)
         from ..storage import repair as repair_mod
 
         # applied_only=False: a dry-run's report IS its would-repair rows

@@ -217,13 +217,27 @@ class RepairEvent:
     ``oct_repair_total{action=}``."""
 
     action: str  # "truncate-chunk" | "rebuild-index" | "drop-chunk"
-    # | "sweep-orphan-index" | "dirty-open-escalated"
+    # | "sweep-orphan-index" | "sweep-orphan-sidecar"
+    # | "dirty-open-escalated"
     chunk: int  # chunk number (-1 for store-level actions)
     blocks_kept: int
     blocks_dropped: int
     bytes_quarantined: int
     applied: bool  # False = dry-run: computed, not written
     detail: str = ""
+
+
+@dataclass(frozen=True)
+class SidecarEvent:
+    """One columnar-sidecar probe/build outcome (storage/sidecar.py):
+    the stream loader probed a chunk's ``NNNNN.cols`` seal (hit / miss
+    / stale / torn) or backfilled one through the tmp+rename protocol
+    (rebuilt). Counted into ``oct_sidecar_total{outcome=}``; a
+    non-hit outcome costs exactly one parse fallback, never a verdict
+    change."""
+
+    outcome: str  # "hit" | "miss" | "stale" | "rebuilt" | "torn"
+    chunk: int = -1
 
 
 @dataclass(frozen=True)

@@ -581,6 +581,36 @@ def render_markdown(report: dict) -> str:
                 f"**{total['lane_ops_per_lane']:g}** | "
                 f"{total['at_lanes']} |"
             )
+    hc = report.get("host_ceiling")
+    if hc:
+        out += ["", "## Host ceiling trajectory", ""]
+        out.append(
+            "The best rate any device can be fed at "
+            "(`profile_replay.py --host`). Round 17's columnar sidecar "
+            "streams device-ready windows straight off disk — a warm "
+            "sidecar replaces the native parse with an mmap."
+        )
+        out.append("")
+        out.append("| round/run | pipeline | ceiling headers/s | "
+                   "sidecar | mmap s | parse s |")
+        out.append("|---|---|---|---|---|---|")
+        for m in hc["milestones"]:
+            out.append(f"| {m['round']} | {m['what']} | "
+                       f"{m['ceiling_per_s']:,} | — | — | — |")
+        for r in hc["runs"]:
+            sc = r.get("sidecar") or {}
+            sc_txt = (f"hit {sc.get('hit', 0)} / miss {sc.get('miss', 0)}"
+                      if sc else "—")
+            out.append("| {} | {} | {} | {} | {} | {} |".format(
+                (r.get("ts") or "?")[:19],
+                "sidecar" if sc.get("hit") else "parse",
+                r.get("ceiling_per_s") or "?",
+                sc_txt,
+                r.get("stream_mmap_s") if r.get("stream_mmap_s")
+                is not None else "—",
+                r.get("stream_parse_s") if r.get("stream_parse_s")
+                is not None else "—",
+            ))
     mc = report.get("multichip_rounds") or []
     if mc:
         out += ["", "## Multichip", ""]
@@ -611,6 +641,53 @@ def render_markdown(report: dict) -> str:
         out.append(f"* {'OK ' if v['ok'] else 'REGRESSION'} "
                    f"[{v['rule']}]: {v['detail']}")
     return "\n".join(out) + "\n"
+
+
+# the banked host-ceiling milestones (PERF.md): the parse ceiling's
+# round-by-round trajectory the round-17 sidecar row appends to —
+# static anchors so the section renders even on a box whose ledger
+# only has the newest runs
+_HOST_CEILING_MILESTONES = (
+    ("r08", "columnar host pipeline", 26_800),
+    ("r09", "threaded staging + native extract", 118_700),
+    ("r16", "pass-5 host pipeline", 177_000),
+    ("r17", "columnar sidecar: walked seals + PCLMUL CRC + native "
+            "span hash", 419_000),
+)
+
+
+def host_ceiling_section(ledger_dir: str | None) -> dict | None:
+    """The host-ceiling trajectory: the static PERF.md milestone
+    anchors plus every `profile_replay --host` ledger record, with the
+    round-17 sidecar evidence (hit/miss counts, mmap-vs-parse wall
+    split) when the record carries it. Fail-soft like the ledger
+    section."""
+    rows = []
+    try:
+        from ouroboros_consensus_tpu.obs import ledger
+
+        for r in ledger.read_runs(ledger_dir, kind="profile_replay"):
+            cfg = r.get("config") or {}
+            if cfg.get("mode") != "host":
+                continue
+            res = r.get("result") or {}
+            phases = r.get("phases_s") or {}
+            rows.append({
+                "ts": r.get("ts_iso"),
+                "headers": res.get("headers"),
+                "ceiling_per_s": res.get("ceiling_per_s"),
+                "sidecar": res.get("sidecar"),
+                "stream_mmap_s": phases.get("stream-mmap"),
+                "stream_parse_s": phases.get("stream-parse"),
+            })
+    except Exception:  # noqa: BLE001 — report survives a broken ledger
+        pass
+    if not rows and ledger_dir == "0":
+        return None
+    return {"milestones": [
+        {"round": rd, "what": what, "ceiling_per_s": v}
+        for rd, what, v in _HOST_CEILING_MILESTONES
+    ], "runs": rows}
 
 
 def point_ops_section() -> dict | None:
@@ -659,6 +736,7 @@ def build_report(dir_: str, threshold: float | None,
         "multichip_rounds": multichip,
         "ledger": led,
         "point_ops": point_ops_section(),
+        "host_ceiling": host_ceiling_section(ledger_dir),
         "verdicts": verdicts,
         "ok": all(v["ok"] for v in verdicts),
     }

@@ -82,11 +82,25 @@ def host_ceiling():
     from ouroboros_consensus_tpu.protocol import batch as pbatch
     from ouroboros_consensus_tpu.protocol import praos
     from ouroboros_consensus_tpu.protocol.views import ViewColumns
+    from ouroboros_consensus_tpu.storage import sidecar as sidecar_mod
     from ouroboros_consensus_tpu.tools import db_analyser as ana
+    from ouroboros_consensus_tpu.utils.trace import EncloseEvent
 
     path, params, lview = bench.build_or_load_chain()
     columnar = ana._columnar_enabled()
     mode = "columnar (ViewColumns)" if columnar else "per-object (HeaderView)"
+    # the round-17 mmap-vs-parse wall split rides the nested
+    # "stream-mmap"/"stream-parse" Enclose brackets — per-CHUNK events
+    # (a handful per run), collected by a local tracer the recorder
+    # chains behind exactly as in main()
+    split = defaultdict(float)
+
+    def _split_tracer(ev):
+        if isinstance(ev, EncloseEvent) and ev.edge == "end" \
+                and ev.label in ("stream-mmap", "stream-parse"):
+            split[ev.label] += ev.duration
+
+    pbatch.set_batch_tracer(_split_tracer)
     # the acceptance A/B: OCT_TRACE=1 must not tax the host ceiling —
     # the recorder hangs off BATCH_TRACER and sees per-window events
     # only, none of which this host-only loop emits per header
@@ -103,6 +117,8 @@ def host_ceiling():
 
     try:
         for attempt in ("warm", "hot"):
+            split.clear()
+            sidecar_mod.reset_counters()
             res = ana.ValidationResult()
             imm = ana.open_immutable(path, validate_all="stream")
             t_stream = t_pre = t_stage = 0.0
@@ -166,6 +182,11 @@ def host_ceiling():
                                 ("prechecks", t_pre), ("stage", t_stage)):
                 print(f"  {label:12s} {secs:8.2f}s  {secs/nh*1e6:7.2f} us/header")
             print(f"  windows: {nwin} ({npacked} packed)")
+            sc_counts = sidecar_mod.counters()
+            if any(sc_counts.values()) or split:
+                print(f"  sidecar: {sc_counts} | "
+                      f"mmap {split['stream-mmap']:.3f}s / "
+                      f"parse {split['stream-parse']:.3f}s")
         # one run-ledger record per invocation (obs/ledger.py): the hot
         # attempt's ceiling + phase walls, with full env/git provenance
         from ouroboros_consensus_tpu.obs import ledger
@@ -174,22 +195,28 @@ def host_ceiling():
             "profile_replay",
             recorder=obs.recorder() if traced else None,
             config={"n": N, "mode": "host", "columnar": columnar,
-                    "traced": traced},
+                    "traced": traced,
+                    "sidecar": sidecar_mod.enabled()},
             result={
                 "headers": nh, "host_s": round(host_s, 3),
                 "ceiling_per_s": round(nh / host_s, 1),
                 "windows": nwin, "packed_windows": npacked,
+                "sidecar": sc_counts,
             },
             wall_s=wall,
             phases_s={"view-stream": round(t_stream, 3),
                       "prechecks": round(t_pre, 3),
-                      "stage": round(t_stage, 3)},
+                      "stage": round(t_stage, 3),
+                      "stream-mmap": round(split["stream-mmap"], 3),
+                      "stream-parse": round(split["stream-parse"], 3)},
         )
     finally:
         # a raising replay must still disarm the live plane — the
-        # unwind is what keeps maybe_arm re-entrant for the next run
+        # unwind is what keeps maybe_arm re-entrant for the next run;
+        # and the split tracer must not leak into the next run
         if plane is not None:
             plane.disarm()
+        pbatch.set_batch_tracer(None)
 
 
 def main():

@@ -29,6 +29,7 @@ from ouroboros_consensus_tpu.obs import recovery
 from ouroboros_consensus_tpu.obs.warmup import WARMUP
 from ouroboros_consensus_tpu.protocol import praos
 from ouroboros_consensus_tpu.storage import guard as sg
+from ouroboros_consensus_tpu.storage import sidecar as sc_mod
 from ouroboros_consensus_tpu.storage.immutable import ImmutableDB
 from ouroboros_consensus_tpu.testing import chaos, fixtures
 from ouroboros_consensus_tpu.tools import db_analyser as ana
@@ -549,6 +550,11 @@ _MATRIX = [
     ("bitflip@append:20", [True, "stream"]),
     ("partial-rename@marker", [False, "stream"]),
     ("sigkill@append:15", [False]),
+    # the columnar-sidecar plane (PR 17): a torn sidecar build is
+    # SILENT (the chain is intact; only the cache is half-written), a
+    # SIGKILL mid-build leaves a dirty store + a stranded .cols.tmp
+    ("sidecar-torn@build:1", [False, "stream"]),
+    ("sigkill@build:1", [False]),
 ]
 
 
@@ -620,6 +626,183 @@ def test_bitflip_last_chunk_caught_even_shallow(tmp_path, pristine_states):
     r2 = _reval(db, validate_all=True)
     assert r2.repairs and r2.repairs.get("truncate-chunk") == 1
     assert r2.final_state == pristine_states[35]
+
+
+# ---------------------------------------------------------------------------
+# the columnar sidecar as a repair-plane citizen (PR 17)
+# ---------------------------------------------------------------------------
+
+
+def test_sidecar_torn_at_build_falls_back_then_rebuilds(
+        tmp_path, pristine_states):
+    """A torn sidecar BUILD (crash shape: a prefix at the final name)
+    is silent — the chain is complete and clean. The probe classifies
+    it `torn`, the replay parses and stays verdict-identical; the
+    first WRITER open rebuilds the seal and the next replay hits."""
+    db = str(tmp_path / "db")
+    assert _synthesize(db, "sidecar-torn@build:1") is None
+    assert sg.was_clean_shutdown(db)
+    torn = os.path.join(db, "immutable", "00001.cols")
+    assert os.path.exists(torn)
+    torn_size = os.path.getsize(torn)
+
+    sc_mod.reset_counters()
+    r1 = _reval(db, validate_all="stream")  # read-only analysis
+    c = sc_mod.counters()
+    assert c["hit"] == 1 and c["torn"] == 1 and c["rebuilt"] == 0
+    assert r1.error is None and r1.n_valid == N_BLOCKS
+    assert r1.final_state == pristine_states[N_BLOCKS]
+    assert os.path.getsize(torn) == torn_size  # reader wrote nothing
+
+    sc_mod.reset_counters()
+    r2 = _reval(db, validate_all="stream", repair=True)
+    c = sc_mod.counters()
+    assert c["torn"] == 1 and c["rebuilt"] == 1
+    assert os.path.getsize(torn) > torn_size  # sealed blob landed
+
+    sc_mod.reset_counters()
+    r3 = _reval(db, validate_all="stream")
+    assert sc_mod.counters()["hit"] == 2
+    for r in (r2, r3):
+        assert r.error is None and r.n_valid == N_BLOCKS
+        assert r.final_state == r1.final_state
+
+
+def test_sidecar_stale_at_open_forces_fallback(tmp_path, pristine_states):
+    """`sidecar-stale@open:0` forces the probe's stale verdict on a
+    PERFECTLY fresh sidecar: the fallback parse must never change a
+    verdict — that is the whole trust contract."""
+    db = str(tmp_path / "db")
+    assert _synthesize(db) is None
+    os.environ["OCT_CHAOS"] = "sidecar-stale@open:0"
+    chaos.reset()
+    try:
+        sc_mod.reset_counters()
+        r = _reval(db, validate_all="stream")
+        assert chaos.plan().fired() == ["sidecar-stale@open:0"]
+    finally:
+        os.environ.pop("OCT_CHAOS", None)
+        chaos.reset()
+    c = sc_mod.counters()
+    assert c["stale"] == 1 and c["hit"] == 1
+    assert r.error is None and r.n_valid == N_BLOCKS
+    assert r.final_state == pristine_states[N_BLOCKS]
+    # unarmed, the same store is all hits and still equal
+    sc_mod.reset_counters()
+    r2 = _reval(db, validate_all="stream")
+    assert sc_mod.counters()["hit"] == 2
+    assert r2.final_state == r.final_state
+
+
+def test_sidecar_bitflip_stale_never_trusted(tmp_path, pristine_states):
+    """Silent rot INSIDE a sidecar (one flipped payload byte) breaks
+    the payload CRC seal: probe stale, parse fallback, verdict
+    untouched — and a writer open re-seals it."""
+    db = str(tmp_path / "db")
+    assert _synthesize(db) is None
+    p = os.path.join(db, "immutable", "00000.cols")
+    blob = bytearray(open(p, "rb").read())
+    blob[sc_mod.HEADER_SIZE + 9] ^= 0x10
+    with open(p, "wb") as f:
+        f.write(bytes(blob))
+
+    sc_mod.reset_counters()
+    r = _reval(db, validate_all="stream")
+    c = sc_mod.counters()
+    assert c["stale"] == 1 and c["hit"] == 1
+    assert r.error is None and r.n_valid == N_BLOCKS
+    assert r.final_state == pristine_states[N_BLOCKS]
+
+    sc_mod.reset_counters()
+    _reval(db, validate_all="stream", repair=True)
+    assert sc_mod.counters()["rebuilt"] == 1
+    sc_mod.reset_counters()
+    r2 = _reval(db, validate_all="stream")
+    assert sc_mod.counters()["hit"] == 2
+    assert r2.final_state == r.final_state
+
+
+def test_truncater_invalidates_and_regenerates_sidecars(
+        tmp_path, pristine_states):
+    """`db_truncater --to-last-valid` on a garbage-tailed chunk: the
+    rewrite quarantines the now-lying seal BEFORE mutating the chunk,
+    and the repair pass regenerates a fresh one — the store comes out
+    fully sidecared and verdict-identical."""
+    db = str(tmp_path / "db")
+    assert _synthesize(db) is None
+    _corrupt_tail(db, chunk=1)  # last chunk: tail snip, no strand
+    out = trunc.repair(db)
+    assert out
+
+    qdir = os.path.join(db, "immutable", "quarantine")
+    assert any(f.startswith("00001.cols") for f in os.listdir(qdir))
+    sc_mod.reset_counters()
+    r = _reval(db, validate_all="stream")
+    assert sc_mod.counters()["hit"] == 2  # both seals fresh again
+    assert r.error is None and r.n_valid == N_BLOCKS
+    assert r.final_state == pristine_states[N_BLOCKS]
+
+
+def test_orphan_sidecars_swept(tmp_path, pristine_states):
+    """A `.cols` without a chunk and a `.cols.tmp` stranded by a crash
+    mid-build are derived data with no referent: a reader BANKS the
+    would-sweep (`applied=False`), a writer open quarantines both as
+    `sweep-orphan-sidecar` — never deletes, never trusts."""
+    db = str(tmp_path / "db")
+    assert _synthesize(db) is None
+    d = os.path.join(db, "immutable")
+    for name in ("00007.cols", "00000.cols.tmp"):
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(b"\x00junk")
+
+    r = _reval(db)  # reader: verdict only
+    assert r.error is None and r.repairs is None
+    assert os.path.exists(os.path.join(d, "00007.cols"))
+    rows = [row for row in WARMUP.report()["repairs"]
+            if row["action"] == "sweep-orphan-sidecar"]
+    assert len(rows) == 2 and not any(row["applied"] for row in rows)
+
+    r2 = _reval(db, validate_all=True)  # writer: lands on disk
+    assert r2.repairs.get("sweep-orphan-sidecar") == 2
+    assert not os.path.exists(os.path.join(d, "00007.cols"))
+    assert not os.path.exists(os.path.join(d, "00000.cols.tmp"))
+    qfiles = os.listdir(os.path.join(d, "quarantine"))
+    assert any(f.startswith("00007.cols") for f in qfiles)
+    assert any(f.startswith("00000.cols.tmp") for f in qfiles)
+    assert r2.error is None and r2.n_valid == N_BLOCKS
+    assert r2.final_state == pristine_states[N_BLOCKS]
+
+
+def test_sigkilled_sidecar_build_sweeps_tmp_on_reopen(
+        tmp_path, pristine_states):
+    """A REAL SIGKILL mid-sidecar-build (rc=-9, after the chain + index
+    flushed, before the clean marker): the store reopens DIRTY with a
+    stranded `00001.cols.tmp`, sweeps it as `sweep-orphan-sidecar`,
+    back-fills the missing seal on the same (forced-repair) open, and
+    replays verdict-identical to the pristine chain."""
+    db = str(tmp_path / "db")
+    _writer_child(db, "sigkill@build:1")
+    assert not sg.was_clean_shutdown(db)
+    tmp = os.path.join(db, "immutable", "00001.cols.tmp")
+    assert os.path.exists(tmp)
+
+    sc_mod.reset_counters()
+    r = _reval(db, validate_all="stream")
+    assert r.opened_dirty and r.error is None
+    assert r.n_valid == N_BLOCKS  # every block had landed
+    assert r.final_state == pristine_states[N_BLOCKS]
+    assert r.repairs.get("dirty-open-escalated") == 1
+    assert r.repairs.get("sweep-orphan-sidecar") == 1
+    assert not os.path.exists(tmp)  # quarantined, not deleted
+    qfiles = os.listdir(os.path.join(db, "immutable", "quarantine"))
+    assert any(f.startswith("00001.cols.tmp") for f in qfiles)
+    assert sc_mod.counters()["rebuilt"] == 1  # forced repair backfills
+    assert sg.was_clean_shutdown(db)  # healed
+
+    sc_mod.reset_counters()
+    r2 = _reval(db, validate_all="stream")
+    assert not r2.opened_dirty and sc_mod.counters()["hit"] == 2
+    assert r2.final_state == r.final_state
 
 
 # ---------------------------------------------------------------------------
