@@ -424,6 +424,55 @@ def _graph_verdict_reduce(t=None):
     return functools.partial(pbatch.verdict_reduce, scan=True), args
 
 
+def _graph_forge_sweep(t=None):
+    """The leader-election sweep (protocol/forge.forge_sweep): device
+    alpha derivation, the full VRF prove (both proof serializations),
+    the Blake2b leader-value tail and the threshold bracket — exactly
+    the program the batched synthesizer dispatches per election window.
+    Lane-invariant (everything is per-(slot, pool) pair), so the tiny
+    registry tile pins the production FORGE_BUCKET structure."""
+    import jax
+    from jax import numpy as jnp
+
+    from ..protocol import forge as pforge
+
+    b = t or _T
+
+    def u8(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.uint8)
+
+    args = (
+        u8(b, 32), u8(b, 32), u8(b, 32), _s(b), u8(32),
+        u8(b, 32), u8(b, 32),
+    )
+    return pforge.forge_sweep, args
+
+
+def _graph_forge_sign(t=None):
+    """The packed OCert-issue signer (protocol/forge.forge_sign — the
+    certified ed25519 sign kernel under its forge-lane registry name):
+    the sign direction of the forging pipeline carries its own pins at
+    the shape the synthesizer dispatches (deduped OCert signables)."""
+    import jax
+    from jax import numpy as jnp
+
+    from ..protocol import forge as pforge
+
+    b = t or 4
+
+    def u8(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.uint8)
+
+    def u32(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.uint32)
+
+    args = (
+        u8(b, 32), u8(b, 32), u32(b, _NB, 16, 2), _s(b),
+        u32(b, _NB, 16, 2), _s(b),
+    )
+    return pforge.forge_sign, args
+
+
 REGISTRY: dict[str, Callable] = {
     "ed_core": _graph_ed_core,
     "kes_core": _graph_kes_core,
@@ -438,6 +487,8 @@ REGISTRY: dict[str, Callable] = {
     "spmd_sharded_verify": _graph_spmd_local,
     "packed_unpack": _graph_packed_unpack,
     "verdict_reduce": _graph_verdict_reduce,
+    "forge_sweep": _graph_forge_sweep,
+    "forge_sign": _graph_forge_sign,
 }
 
 
@@ -498,6 +549,26 @@ GRAPH_SOURCES: dict[str, list[str]] = {
         "ouroboros_consensus_tpu/ops/blake2b.py",
         "ouroboros_consensus_tpu/ops/u64.py",
     ],
+    # the forge graphs trace through the XLA-twin ops (ecvrf_batch /
+    # ed25519_batch), not the ops/pk ladder cores
+    "forge_sweep": _XLA_TWIN + [
+        "ouroboros_consensus_tpu/protocol/forge.py",
+        "ouroboros_consensus_tpu/ops/field.py",
+        "ouroboros_consensus_tpu/ops/bigint.py",
+        "ouroboros_consensus_tpu/ops/sha512.py",
+        "ouroboros_consensus_tpu/ops/blake2b.py",
+        "ouroboros_consensus_tpu/ops/u64.py",
+    ],
+    "forge_sign": [
+        "ouroboros_consensus_tpu/protocol/forge.py",
+        "ouroboros_consensus_tpu/ops/ed25519_batch.py",
+        "ouroboros_consensus_tpu/ops/curve.py",
+        "ouroboros_consensus_tpu/ops/scalar.py",
+        "ouroboros_consensus_tpu/ops/bigint.py",
+        "ouroboros_consensus_tpu/ops/field.py",
+        "ouroboros_consensus_tpu/ops/sha512.py",
+        "ouroboros_consensus_tpu/ops/u64.py",
+    ],
 }
 
 
@@ -511,6 +582,7 @@ DEFAULT_TILES: dict[str, int] = {
     "aggregate_core": _T, "aggregate_vrf_core": _T, "msm": 4,
     "spmd_sharded_verify": 8,
     "packed_unpack": 4, "verdict_reduce": 8,
+    "forge_sweep": _T, "forge_sign": 4,
 }
 
 

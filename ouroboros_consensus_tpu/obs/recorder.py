@@ -22,6 +22,10 @@ event into the metrics registry:
                                            outcomes (storage/sidecar)
     oct_shard_{windows,lanes,ok_lanes,pad_lanes}_total{shard=}
                                            per-shard SPMD telemetry
+    oct_forge_windows_total{engine=}       election windows dispatched
+                                           (protocol/forge ForgeSpan)
+    oct_forge_elected_total                slots won across windows
+    oct_forge_signed_total                 blocks forged + appended
 
 Per-window granularity only — a 1M-header replay emits a few hundred
 events, so the host feed ceiling is untaxed."""
@@ -32,7 +36,7 @@ import threading
 import time
 
 from ..utils.trace import (
-    AggRedispatch, CheckpointEvent, EncloseEvent, LadderEvent,
+    AggRedispatch, CheckpointEvent, EncloseEvent, ForgeSpan, LadderEvent,
     RecoveryEvent, RepairEvent, ShardSpan, SidecarEvent, StallEvent,
     TransferEvent, WindowSpan, WindowStaged,
 )
@@ -129,6 +133,19 @@ class FlightRecorder:
             "oct_shard_pad_lanes_total",
             "bucket-pad waste lanes per shard", ("shard",),
         )
+        # forge plane (protocol/forge.py ForgeSpan events): the batched
+        # synthesizer's election windows, elected slots and signed
+        # blocks — label cardinality is the engine set (device/host)
+        self._forge_windows = r.counter(
+            "oct_forge_windows_total",
+            "forge election windows dispatched", ("engine",),
+        )
+        self._forge_elected = r.counter(
+            "oct_forge_elected_total", "slots won in forge windows"
+        )
+        self._forge_signed = r.counter(
+            "oct_forge_signed_total", "blocks forged and appended"
+        )
         # heartbeat source: the most recent event (kept even after the
         # bounded buffer fills) + the latest retired window index
         self._last: "tuple[float, object] | None" = None
@@ -196,6 +213,10 @@ class FlightRecorder:
             # shards also count as headers retired on the sharded path
             # ONLY through their WindowSpan-carrying replay loop — the
             # per-shard families never double-fold into oct_headers_*
+        elif isinstance(ev, ForgeSpan):
+            self._forge_windows.labels(engine=ev.engine).inc()
+            self._forge_elected.inc(ev.elected)
+            self._forge_signed.inc(ev.signed)
         # EncloseEvent: kept in the event stream (Perfetto slices) only
 
     # -- live plane (obs/live.py heartbeat source) --------------------------

@@ -314,20 +314,14 @@ def prove(x, prefix, pk, alpha):
 _PROVE_JIT = None
 
 
-def prove_batch(seeds, alphas, batch_compat: bool | None = None):
-    """Host convenience: -> ([B, 80|128] uint8 proofs, [B, 64] betas).
-    batch_compat=None follows the process default (host.fast
-    vrf_batch_compat / OCT_VRF_BATCH)."""
-    import jax
-
+def stage_prove_np(seeds):
+    """Host staging for the prove side: expand each 32-byte VRF seed to
+    its (x, prefix, pk) columns — [B, 32] uint8 each — ready for
+    `prove` / the forge leader sweep. Factored out of prove_batch so
+    protocol/forge.py can stage once per pool and tile across a whole
+    slot window."""
     from .host import ed25519 as he
-    from .host import fast
 
-    if batch_compat is None:
-        batch_compat = fast.vrf_batch_compat()
-    global _PROVE_JIT
-    if _PROVE_JIT is None:
-        _PROVE_JIT = jax.jit(prove)
     b = len(seeds)
     x = np.zeros((b, 32), np.uint8)
     prefix = np.zeros((b, 32), np.uint8)
@@ -337,15 +331,39 @@ def prove_batch(seeds, alphas, batch_compat: bool | None = None):
         x[i] = np.frombuffer(x_bytes, np.uint8)
         prefix[i] = np.frombuffer(pref, np.uint8)
         pk[i] = np.frombuffer(pk_bytes, np.uint8)
-    alpha = np.stack([np.frombuffer(a, np.uint8) for a in alphas])
-    g_enc, c16, u_enc, v_enc, s32, beta = _PROVE_JIT(x, prefix, pk, alpha)
+    return x, prefix, pk
+
+
+def encode_proofs_np(g_enc, c16, u_enc, v_enc, s32, batch_compat):
+    """Splice prove() output columns into wire proofs: [B, 128] uint8
+    (batch-compatible, gamma ‖ u ‖ v ‖ s) or [B, 80] (draft-03,
+    gamma ‖ c ‖ s)."""
     if batch_compat:
         cols = [g_enc, u_enc, v_enc, s32]
     else:
         cols = [g_enc, c16, s32]
-    proofs = np.concatenate(
+    return np.concatenate(
         [np.asarray(col) for col in cols], axis=-1
     ).astype(np.uint8)
+
+
+def prove_batch(seeds, alphas, batch_compat: bool | None = None):
+    """Host convenience: -> ([B, 80|128] uint8 proofs, [B, 64] betas).
+    batch_compat=None follows the process default (host.fast
+    vrf_batch_compat / OCT_VRF_BATCH)."""
+    import jax
+
+    from .host import fast
+
+    if batch_compat is None:
+        batch_compat = fast.vrf_batch_compat()
+    global _PROVE_JIT
+    if _PROVE_JIT is None:
+        _PROVE_JIT = jax.jit(prove)
+    x, prefix, pk = stage_prove_np(seeds)
+    alpha = np.stack([np.frombuffer(a, np.uint8) for a in alphas])
+    g_enc, c16, u_enc, v_enc, s32, beta = _PROVE_JIT(x, prefix, pk, alpha)
+    proofs = encode_proofs_np(g_enc, c16, u_enc, v_enc, s32, batch_compat)
     return proofs, np.asarray(beta).astype(np.uint8)
 
 

@@ -79,6 +79,20 @@ force the parse fallback):
                                   only the durable tmp survives (the
                                   next open sweeps it)
 
+Forge-pipeline faults (PR 18) land at the batched synthesizer's seams
+(`protocol/forge.py`): the per-window election dispatch and the
+per-forged-block retire (after the append + state fold land, before
+the next block is forged):
+
+    device-error@forge-dispatch:0 raise DeviceChaosError at the 1st
+                                  window's leader-election dispatch;
+                                  the forge recovery ladder retries,
+                                  then drops to the exact host loop
+    sigkill@forge:10              SIGKILL self right after the 11th
+                                  forged block's append lands — the
+                                  store reopens dirty and resume=True
+                                  must converge byte-identically
+
 Triggers are matched against per-seam sequence counters (each seam
 counts its own firings from 0 in dispatch order) or, for ``stage:``,
 by substring against the stage label. Each injection fires EXACTLY
@@ -134,9 +148,9 @@ FAULT_KINDS = (
 # at a seam its fault kind does not model
 _KIND_SITES = {
     "compile-stall": ("dispatch", "stage-call"),
-    "device-error": ("dispatch", "stage-call", "shard"),
+    "device-error": ("dispatch", "stage-call", "shard", "forge-dispatch"),
     "staging-thread-death": ("stage",),
-    "sigkill": ("retire", "append", "sidecar-build"),
+    "sigkill": ("retire", "append", "sidecar-build", "forge"),
     "chunk-corrupt": ("chunk",),
     "aot-reject": ("aot",),
     "probe-timeout": ("probe",),
@@ -169,6 +183,8 @@ _SITE_TRIGGER_KEYS = {
     "probe": ("attempt",),
     "sidecar-build": ("build", "chunk"),
     "sidecar-open": ("open", "chunk"),
+    "forge": ("forge",),
+    "forge-dispatch": ("forge-dispatch",),
 }
 
 
@@ -463,6 +479,8 @@ _SITE_SEQ_KEYS = {
     "sidecar-build": ("build",),  # one sidecar build per seq; the
     # CHUNK NUMBER rides the explicit chunk= ctx (sidecar-torn@chunk:N)
     "sidecar-open": ("open",),  # one freshness probe per seq
+    "forge": ("forge",),  # one forged-block retire per seq
+    "forge-dispatch": ("forge-dispatch",),  # one election dispatch/seq
 }
 
 

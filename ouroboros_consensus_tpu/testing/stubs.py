@@ -128,6 +128,148 @@ def stub_agg_program_builder(delay_s=None):
     return builder
 
 
+def _expand_host(tag: int, data: bytes, n: int) -> bytes:
+    """Counter-mode Blake2b expansion — the host half of the stub
+    forge-crypto family. MUST stay byte-identical to `_expand_dev`."""
+    import hashlib
+
+    out = b""
+    i = 0
+    while len(out) < n:
+        out += hashlib.blake2b(
+            bytes([tag, i]) + data, digest_size=32
+        ).digest()
+        i += 1
+    return out[:n]
+
+
+def _expand_dev(tag: int, data, data_len: int, n: int):
+    """The device twin of `_expand_host` on [..., L] int32 byte rows."""
+    parts = []
+    for i in range((n + 31) // 32):
+        pre = jnp.broadcast_to(
+            jnp.asarray([tag, i], jnp.int32), (*data.shape[:-1], 2)
+        )
+        parts.append(
+            blake2b.blake2b_fixed(
+                jnp.concatenate([pre, data], axis=-1), data_len + 2, 32
+            )
+        )
+    return jnp.concatenate(parts, axis=-1)[..., :n]
+
+
+def make_stub_forge_sweep(plen: int):
+    """Build a hash-twin of protocol/forge.forge_sweep: the VRF prove
+    is replaced by the counter-mode expansion (compiles in seconds on
+    XLA:CPU) while the alpha derivation, leader-value tail and
+    threshold bracket stay REAL — so the election scatter, ambiguity
+    split and proof-column splice are exercised end to end. Must agree
+    byte-for-byte with the host stubs install_stub_forge patches into
+    ops/host/fast.
+
+    The proof length is captured HERE, at build time, and each call
+    returns a fresh function object: jax's tracing cache keys on the
+    function identity plus argument avals, and both formats present
+    identical avals — a shared module-level sweep traced under one
+    format would silently serve the other format's calls with the
+    first trace's proof layout baked in."""
+
+    def stub_forge_sweep(x, prefix, pk, slots, nonce, thr_lo, thr_hi):
+        from ..ops import ecvrf_batch
+        from ..protocol.batch import _lt_be
+
+        x = jnp.asarray(x).astype(jnp.int32)
+        alpha = ecvrf_batch.alpha_from_slots(
+            jnp.asarray(slots).astype(jnp.int32), nonce
+        )
+        xa = jnp.concatenate([x, alpha], axis=-1)
+        proof = _expand_dev(ord("p"), xa, 64, plen)
+        p32 = blake2b.blake2b_fixed(proof, plen, 32)
+        beta = _expand_dev(ord("b"), p32, 32, 64)
+        tag_l = jnp.broadcast_to(
+            jnp.asarray([ord("L")], jnp.int32), (*beta.shape[:-1], 1)
+        )
+        lv = blake2b.blake2b_fixed(
+            jnp.concatenate([tag_l, beta], axis=-1), 65, 32
+        )
+        thr_lo = jnp.asarray(thr_lo).astype(jnp.int32)
+        thr_hi = jnp.asarray(thr_hi).astype(jnp.int32)
+        win = _lt_be(lv, thr_lo)
+        ambiguous = ~win & _lt_be(lv, thr_hi)
+        if plen == 128:
+            g_enc, u_enc, v_enc, s32 = (
+                proof[..., :32], proof[..., 32:64],
+                proof[..., 64:96], proof[..., 96:128],
+            )
+            c16 = proof[..., :16]
+        else:
+            g_enc, c16, s32 = (
+                proof[..., :32], proof[..., 32:48], proof[..., 48:80],
+            )
+            u_enc, v_enc = g_enc, g_enc
+        return g_enc, c16, u_enc, v_enc, s32, beta, win, ambiguous
+
+    return stub_forge_sweep
+
+
+def install_stub_forge(monkeypatch, bucket: int = 256):
+    """Stub the forge-side crypto for the tier-1 device differential:
+    `fast.ecvrf_prove` / `ecvrf_proof_to_hash` / `ed25519_sign` become
+    the counter-mode expansion family and the device sweep becomes
+    `stub_forge_sweep` — every engine (loop / host / device) then
+    forges the SAME bytes, at stub speed. `fast.ed25519_public` is
+    deliberately NOT patched: ops/host/kes.derive_vk lru-caches vks
+    derived through it, and a poisoned cache would outlive the patch.
+    The device OCert batch-sign is rerouted through the (patched) host
+    signer so no real ed25519 device graph compiles under the stub —
+    the real forge_sign kernel is octrange-certified byte-identical to
+    the host signer and exercised by the slow-tier differential."""
+    from ..ops.host import ed25519 as he
+    from ..ops.host import fast
+    from ..protocol import forge as forge_mod
+    from ..protocol.views import OCert
+
+    # the proof length is pinned ONCE, at install time, and threaded
+    # into a freshly built device sweep: see make_stub_forge_sweep on
+    # why the sweep must be a new function object per install
+    plen = 128 if fast.vrf_batch_compat() else 80
+
+    def stub_prove(seed: bytes, alpha: bytes) -> bytes:
+        x_bytes, _pref, _pk = he.expand_for_staging(seed)
+        return _expand_host(ord("p"), x_bytes + alpha, plen)
+
+    def stub_proof_to_hash(pi: bytes) -> bytes:
+        # the proof is hashed to 32 bytes first: the device twin's
+        # single-block blake2b_fixed cannot absorb tag+proof (130B bc)
+        import hashlib
+
+        p32 = hashlib.blake2b(pi, digest_size=32).digest()
+        return _expand_host(ord("b"), p32, 64)
+
+    def stub_sign(seed: bytes, msg: bytes) -> bytes:
+        x_bytes, _pref, _pk = he.expand_for_staging(seed)
+        return _expand_host(ord("s"), x_bytes + msg, 64)
+
+    def stub_sign_ocerts(pools, triples) -> dict:
+        out = {}
+        for pool_i, counter, kp0 in sorted(triples):
+            pool = pools[pool_i]
+            oc = OCert(pool.kes_vk, counter, kp0, b"")
+            sig = stub_sign(pool.cold_seed, oc.signable())
+            out[(pool_i, counter, kp0)] = OCert(
+                oc.vk_hot, oc.counter, oc.kes_period, sig
+            )
+        return out
+
+    monkeypatch.setattr(fast, "ecvrf_prove", stub_prove)
+    monkeypatch.setattr(fast, "ecvrf_proof_to_hash", stub_proof_to_hash)
+    monkeypatch.setattr(fast, "ed25519_sign", stub_sign)
+    monkeypatch.setattr(forge_mod, "_SWEEP_FN", make_stub_forge_sweep(plen))
+    monkeypatch.setattr(forge_mod, "sign_ocerts_batch", stub_sign_ocerts)
+    monkeypatch.setattr(forge_mod, "_JITS", {})
+    monkeypatch.setattr(forge_mod, "FORGE_BUCKET", bucket)
+
+
 def install_stub_crypto(monkeypatch=None, agg_delay_s=None):
     """Patch the crypto entry points of protocol/batch with the stubs.
     With a pytest `monkeypatch` the patches auto-revert; without one

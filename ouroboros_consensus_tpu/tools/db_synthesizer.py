@@ -210,6 +210,14 @@ def synthesize(
     return out
 
 
+# trusted-fold memo: a resume whose deep-open confirms the tip this
+# process itself forged skips the whole-chain reupdate replay. Keyed by
+# the store path; the (tip slot, tip hash) check makes a stale entry —
+# another writer, an external truncation — fall through to the replay.
+# The stored tuple is EXACTLY what _replay_forged_state would return.
+_REPLAY_MEMO: dict[str, tuple] = {}
+
+
 def _replay_forged_state(params, lview, imm):
     """Rebuild the forging state from a surviving chain: the trusted
     reupdate fold (we forged these signatures ourselves — exactly the
@@ -235,15 +243,145 @@ def _replay_forged_state(params, lview, imm):
     return st, dict(st.ocert_counters), prev_hash, block_no, slot
 
 
+def _forge_pipeline(
+    imm, params, pools, lview, limit, res, st, prev_hash, block_no,
+    slot, counters, ledger_view_for_epoch, txs_per_block, txs_for_block,
+    engine, trace,
+):
+    """The batched forging fast path: elect whole slot windows in one
+    sweep (device or batched-host, protocol/forge.py), then run the
+    sequential assembly tail over just the elected slots. Byte- and
+    state-identical to the per-slot loop below for the same inputs
+    (tests/test_forge.py holds the equation); returns the threaded
+    (st, prev_hash, block_no, slot)."""
+    from ..protocol import batch as pbatch
+    from ..protocol import forge as forge_mod
+    from ..testing import chaos
+
+    asm = forge_mod.BlockAssembler(params, pools)
+    stg = forge_mod.stage_pools(pools) if engine == "device" else None
+    tracer = pbatch.BATCH_TRACER
+
+    def done() -> bool:
+        if limit.slots is not None and slot >= limit.slots:
+            return True
+        if limit.blocks is not None and block_no >= limit.blocks:
+            return True
+        if limit.epochs is not None and params.epoch_of(slot) >= limit.epochs:
+            return True
+        return False
+
+    while not done():
+        lv_now = (
+            ledger_view_for_epoch(params.epoch_of(slot))
+            if ledger_view_for_epoch is not None
+            else lview
+        )
+        # eta0 is epoch-constant: one tick at the window start serves
+        # the whole (epoch-clamped) window's elections; the per-block
+        # reupdate below re-ticks at each forged slot exactly as the
+        # reference loop does
+        ticked0 = praos.tick(params, lv_now, slot, st)
+        eta0 = ticked0.state.epoch_nonce
+        epoch_end = (params.epoch_of(slot) + 1) * params.epoch_length
+        wend = min(epoch_end, slot + forge_mod.window_slots(len(pools)))
+        if limit.slots is not None:
+            wend = min(wend, limit.slots)
+        if limit.blocks is not None:
+            # don't elect far past where the block limit will trip:
+            # ~1/f slots per block, padded 2x + a margin
+            need = limit.blocks - block_no
+            est = int(2 * need / float(params.active_slot_coeff)) + 64
+            wend = min(wend, slot + est)
+        wend = max(wend, slot + 1)
+        windex = forge_mod.next_window_index()
+        thr = forge_mod.pool_thresholds(params, lv_now, pools)
+        t_el = time.monotonic()
+        elected = forge_mod.elect_window_recovering(
+            params, pools, stg, thr, range(slot, wend), eta0, engine,
+            lv_now, windex, tracer=tracer,
+        )
+        elect_s = time.monotonic() - t_el
+        if engine == "device" and elected:
+            # pre-sign the window's deduped OCert issues through the
+            # forge_sign graph (byte-identical to the host signer)
+            triples = {
+                (el.pool, counters.get(pools[el.pool].pool_id, 0),
+                 asm.ocert_window(el.slot))
+                for el in elected
+            }
+            missing = {t for t in triples if t not in asm._ocerts}
+            if missing:
+                asm.prime_ocerts(
+                    forge_mod.sign_ocerts_batch(pools, missing)
+                )
+        t_asm = time.monotonic()
+        signed = 0
+        last_forged = slot
+        for el in elected:
+            if limit.blocks is not None and block_no >= limit.blocks:
+                break
+            s = el.slot
+            ticked = praos.tick(params, lv_now, s, st)
+            n = counters.get(pools[el.pool].pool_id, 0)
+            if txs_for_block is not None:
+                txs = tuple(txs_for_block(s, block_no))
+            else:
+                txs = tuple(
+                    b"tx-%d-%d" % (s, i) for i in range(txs_per_block)
+                )
+            block = asm.forge(
+                el.pool, slot=s, block_no=block_no, prev_hash=prev_hash,
+                txs=txs, ocert_counter=n, is_leader=el.is_leader,
+            )
+            imm.append_block(s, block_no, block.hash_, block.bytes_)
+            st = praos.reupdate(params, block.header.to_view(), s, ticked)
+            counters[pools[el.pool].pool_id] = n
+            prev_hash = block.hash_
+            block_no += 1
+            last_forged = s
+            signed += 1
+            res.n_blocks += 1
+            chaos.fire("forge")
+            if res.n_blocks % 1000 == 0:
+                trace(f"forged {res.n_blocks} blocks to slot {s}")
+        if limit.blocks is not None and block_no >= limit.blocks:
+            # the reference loop stops right after the tripping block's
+            # slot — count only the slots up to and including it
+            consumed = last_forged + 1 - slot
+        else:
+            consumed = wend - slot
+        slot += consumed
+        res.n_slots += consumed
+        if tracer is not None:
+            from ..utils.trace import ForgeSpan
+
+            tracer(ForgeSpan(
+                index=windex, engine=engine, slots=consumed,
+                pairs=(wend - (slot - consumed)) * len(pools),
+                elected=len(elected), signed=signed, elect_s=elect_s,
+                assemble_s=time.monotonic() - t_asm,
+            ))
+    return st, prev_hash, block_no, slot
+
+
 def _synthesize_locked(
     imm, db_path, params, pools, lview, limit, txs_per_block,
     vrf_backend, trace, ledger_view_for_epoch, txs_for_block,
     ledger, genesis_state,
 ) -> ForgeResult:
 
+    from ..protocol import forge as forge_mod
+
     n_target = limit.slots or limit.blocks or (
         (limit.epochs or 0) * params.epoch_length
     )
+    engine = forge_mod.engine_from_env(vrf_backend)
+    if ledger is not None:
+        # the ledger fold derives each epoch's view from state the loop
+        # itself threads — the whole-window election has no view to
+        # elect against yet, so ledger mode stays on the per-slot loop
+        engine = "loop"
     if vrf_backend == "auto":
         # host signing runs through the native C library (ops/host/fast)
         # at ~0.3 ms/proof — robust on every platform; the device span
@@ -263,11 +401,25 @@ def _synthesize_locked(
         # deep-validated/repaired) chain and continue from the tip —
         # forging is deterministic, so the resumed chain converges on
         # the uninterrupted run's bytes
-        st, counters, prev_hash, block_no, slot = _replay_forged_state(
-            params, lview, imm
-        )
-        trace(f"resuming synthesis at slot {slot} "
-              f"({block_no} blocks survive)")
+        tip = imm.tip()
+        memo_key = os.path.realpath(db_path)
+        memo = _REPLAY_MEMO.get(memo_key)
+        if (
+            memo is not None
+            and memo[0] == tip.slot
+            and memo[1] == tip.hash_
+        ):
+            st, counters, prev_hash, block_no, slot = (
+                memo[2], dict(memo[3]), memo[4], memo[5], memo[6],
+            )
+            trace(f"resuming synthesis at slot {slot} "
+                  f"({block_no} blocks survive, memoized fold)")
+        else:
+            st, counters, prev_hash, block_no, slot = _replay_forged_state(
+                params, lview, imm
+            )
+            trace(f"resuming synthesis at slot {slot} "
+                  f"({block_no} blocks survive)")
 
     if ledger is not None:
         if genesis_state is None:
@@ -311,6 +463,18 @@ def _synthesize_locked(
     span_proofs: dict = {}
     span_end = 0
 
+    if engine != "loop":
+        # the batched pipeline (protocol/forge.py): whole-window
+        # elections + amortized assembly. It advances the same state
+        # the loop below threads, so after it returns done() is True
+        # and the per-slot reference loop is a no-op — except when a
+        # recovery ladder exhausted mid-run, which re-enters it as the
+        # floor that cannot fail for device reasons.
+        st, prev_hash, block_no, slot = _forge_pipeline(
+            imm, params, pools, lview, limit, res, st, prev_hash,
+            block_no, slot, counters, ledger_view_for_epoch,
+            txs_per_block, txs_for_block, engine, trace,
+        )
     while not done():
         lv_now = (
             ledger_view_for_epoch(params.epoch_of(slot))
@@ -393,6 +557,14 @@ def _synthesize_locked(
     sidecar_mod.backfill_store(imm, walked=True)
     res.wall_s = time.monotonic() - t0
     res.final_state = st
+    tip = imm.tip()
+    if tip is not None:
+        # seed the trusted-fold memo: a resume-then-extend onto this
+        # exact tip skips the whole-chain reupdate replay
+        _REPLAY_MEMO[os.path.realpath(db_path)] = (
+            tip.slot, tip.hash_, st, dict(counters), prev_hash,
+            block_no, tip.slot + 1,
+        )
     return res
 
 

@@ -268,6 +268,26 @@ class AggRedispatch:
 
 
 @dataclass(frozen=True)
+class ForgeSpan:
+    """One election window retired through the batched forging
+    pipeline (protocol/forge.py via tools/db_synthesizer): the
+    pools×slots election grid dispatched, the elected set scattered
+    back, and the sequential assembly tail signed + appended. Counted
+    into oct_forge_windows_total{engine=} / oct_forge_elected_total /
+    oct_forge_signed_total. Per-WINDOW granularity like WindowSpan: a
+    10⁷-header synthesis emits ~thousands, never per-block."""
+
+    index: int  # process-wide forge-window sequence number
+    engine: str  # "device" | "host" (the loop engine emits none)
+    slots: int  # window width in slots
+    pairs: int  # pools × slots election grid size
+    elected: int  # slots won in this window
+    signed: int  # blocks forged + appended (a limit may truncate)
+    elect_s: float
+    assemble_s: float
+
+
+@dataclass(frozen=True)
 class WindowSpan:
     """One window fully retired through validate_chain's pipelined
     loop: the complete per-phase wall plus the dispatch->materialize

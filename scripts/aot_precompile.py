@@ -315,6 +315,47 @@ def main():
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, manifest_path)
+    # forge pipeline programs (PR 18): the election sweep at its
+    # production bucket and the OCert batch signer at its padding
+    # quantum, compiled under the EXACT store rows protocol/forge's
+    # _jit_of -> _warm_timed loads (kes_depth = tile = 0, b = the
+    # dispatch lane count, sig over the runtime call columns). The
+    # signable length is derived from a zero proto-OCert so the row's
+    # KES hash-block count tracks the real message, not a guess.
+    from ouroboros_consensus_tpu.ops import ed25519_batch  # noqa: E402
+    from ouroboros_consensus_tpu.protocol import forge as pforge  # noqa: E402
+    from ouroboros_consensus_tpu.protocol.views import OCert  # noqa: E402
+
+    fb = pforge.FORGE_BUCKET
+    u8 = lambda *s: jax.ShapeDtypeStruct(s, np.uint8, sharding=shard)  # noqa: E731
+    sweep_in = [
+        u8(fb, 32), u8(fb, 32), u8(fb, 32),
+        jax.ShapeDtypeStruct((fb,), np.int32, sharding=shard),
+        u8(32), u8(fb, 32), u8(fb, 32),
+    ]
+    fresh.append(compile_stage("forge_sweep", pforge._SWEEP_FN, sweep_in,
+                               fb, manifest, kes_depth=0, tile=0))
+    # neutral-nonce variant (epoch 0 of a fresh chain): same family,
+    # statically nonce-free — its own store row, no [32] nonce arg
+    sweep_n_in = sweep_in[:4] + sweep_in[5:]
+    fresh.append(compile_stage("forge_sweep-neutral",
+                               pforge._make_sweep_neutral(pforge._SWEEP_FN),
+                               sweep_n_in, fb, manifest, kes_depth=0,
+                               tile=0))
+    sb = pforge._SIGN_BUCKET
+    msg = OCert(b"\0" * 32, 0, 0, b"").signable()
+    sign_cols = ed25519_batch.stage_sign_np([b"\0" * 32] * sb, [msg] * sb)
+    sign_in = [jax.ShapeDtypeStruct(np.asarray(c).shape,
+                                    np.asarray(c).dtype, sharding=shard)
+               for c in sign_cols]
+    fresh.append(compile_stage("forge_sign", pforge._SIGN_FN, sign_in,
+                               sb, manifest, kes_depth=0, tile=0))
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, manifest_path)
     # clear a persisted per-build rejection ONLY when this run wrote
     # EVERY entry itself: a cached early-return may be reusing exactly
     # the stale executables the REJECTED marker records (fresh saves

@@ -101,7 +101,8 @@ _OBS_PREFIXES = ("ouroboros_consensus_tpu/obs/",
                  "ouroboros_consensus_tpu/storage/")
 _OBS_FILES = {"scripts/perf_report.py",
               "ouroboros_consensus_tpu/parallel/spmd.py",
-              "ouroboros_consensus_tpu/testing/chaos.py"}
+              "ouroboros_consensus_tpu/testing/chaos.py",
+              "ouroboros_consensus_tpu/protocol/forge.py"}
 # octsync (Pass 5) --changed trigger: the thread/lock/rename fabric
 # lives in obs/ + storage/ + the chaos seams + the analysis machinery
 # itself; protocol/batch.py and ops/pk/aot.py carry guarded-by

@@ -611,6 +611,30 @@ def render_markdown(report: dict) -> str:
                 r.get("stream_parse_s") if r.get("stream_parse_s")
                 is not None else "—",
             ))
+    fg = report.get("forge")
+    if fg:
+        out += ["", "## Forge trajectory", ""]
+        out.append(
+            "Chain-synthesis rates (`profile_forge.py`): the per-slot "
+            "reference loop vs the batched host engine vs the packed "
+            "device sweep (PR 18). Stub runs isolate the pipeline "
+            "(crypto-independent per-slot costs); native runs are what "
+            "a TPU session banks."
+        )
+        out.append("")
+        out.append("| run | crypto | pools | engine | slots | blocks | "
+                   "slots/s | vs loop |")
+        out.append("|---|---|---|---|---|---|---|---|")
+        for r in fg["runs"]:
+            for e in r["engines"]:
+                speed = r["speedups"].get(f"{e['engine']}_vs_loop")
+                out.append("| {} | {} | {} | {} | {} | {} | {:,} | {} |".format(
+                    (r.get("ts") or "?")[:19], r.get("crypto") or "?",
+                    r.get("pools") or "?", e.get("engine") or "?",
+                    e.get("slots") or "?", e.get("blocks") or "?",
+                    e.get("slots_per_s") or 0,
+                    f"{speed}x" if speed else "—",
+                ))
     mc = report.get("multichip_rounds") or []
     if mc:
         out += ["", "## Multichip", ""]
@@ -690,6 +714,32 @@ def host_ceiling_section(ledger_dir: str | None) -> dict | None:
     ], "runs": rows}
 
 
+def forge_section(ledger_dir: str | None) -> dict | None:
+    """The forging-rate trajectory: every `profile_forge` ledger record
+    (engine table + speedups). Fail-soft like the ledger section — a
+    broken or absent ledger just drops the section."""
+    rows = []
+    try:
+        from ouroboros_consensus_tpu.obs import ledger
+
+        for r in ledger.read_runs(ledger_dir, kind="profile_forge"):
+            cfg = r.get("config") or {}
+            res = r.get("result") or {}
+            rows.append({
+                "ts": r.get("ts_iso"),
+                "n": cfg.get("n"),
+                "pools": cfg.get("pools"),
+                "crypto": cfg.get("crypto"),
+                "engines": res.get("engines") or [],
+                "speedups": res.get("speedups") or {},
+            })
+    except Exception:  # noqa: BLE001 — report survives a broken ledger
+        pass
+    if not rows:
+        return None
+    return {"runs": rows}
+
+
 def point_ops_section() -> dict | None:
     """The ratcheted per-lane point-op pins from budgets.json — no
     tracing, a dict read: the STATIC perf trajectory (what the
@@ -737,6 +787,7 @@ def build_report(dir_: str, threshold: float | None,
         "ledger": led,
         "point_ops": point_ops_section(),
         "host_ceiling": host_ceiling_section(ledger_dir),
+        "forge": forge_section(ledger_dir),
         "verdicts": verdicts,
         "ok": all(v["ok"] for v in verdicts),
     }

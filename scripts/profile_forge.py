@@ -1,0 +1,154 @@
+"""Profiled chain synthesis: headers-forged/s, engine vs engine.
+
+Runs the SAME `db_synthesizer.synthesize` three times — the per-slot
+reference loop (`OCT_FORGE_DEVICE=0`, the pre-PR-18 path), the batched
+host engine, and the packed device sweep (`OCT_FORGE_DEVICE=1`) — over
+a fresh DB each, and prints the forging-rate table the PR-18
+acceptance gate banks (PERF.md "Forge trajectory").
+
+Default convention is the STUBBED-CRYPTO DEVICE TWIN (testing/stubs
+`install_stub_forge`, the same convention as `profile_replay
+--overlap-ab`): every engine forges byte-identical chains through the
+counter-mode expansion family, the device sweep compiles in seconds on
+XLA:CPU, and what the A/B isolates is the PIPELINE — per-slot Python +
+Fraction leader checks vs whole-window packed dispatch. The per-slot
+loop's dominant costs (the Python slot loop and the exact Fraction
+compare per (slot, pool)) are crypto-independent, so the stub ratio
+UNDERSTATES the native one: native proves add ~0.49 ms x pools to
+every loop slot but only amortized bucket dispatches to the sweep.
+`--native` runs the real crypto instead (host libsodium-family proves;
+the device engine then pays the real XLA compile — minutes on CPU,
+the convention a TPU session banks).
+
+Each engine pays a small warmup window first (compiles + jit caches),
+then the timed window; rates are steady-state slots/s and blocks/s.
+The loop engine is timed over `--loop-slots` (default 4096) — at
+~1 ms/slot a 100k-slot loop window would dominate the wall for no
+extra information; rates are per-second and directly comparable.
+
+One run-ledger record (`kind=profile_forge`) banks the table; the
+"Forge trajectory" section of scripts/perf_report.py renders the
+trajectory across runs.
+
+Usage: python scripts/profile_forge.py [n_slots] [--native]
+         [--pools=N] [--loop-slots=N] [--skip-device]
+       (default n_slots 100000, 4 pools)
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ARGS = [a for a in sys.argv[1:] if not a.startswith("--")]
+NATIVE = "--native" in sys.argv[1:]
+SKIP_DEVICE = "--skip-device" in sys.argv[1:]
+N = int(ARGS[0]) if ARGS else 100_000
+POOLS = next((int(a.split("=", 1)[1]) for a in sys.argv[1:]
+              if a.startswith("--pools=")), 4)
+LOOP_SLOTS = next((int(a.split("=", 1)[1]) for a in sys.argv[1:]
+                   if a.startswith("--loop-slots=")), 4096)
+WARMUP_SLOTS = 512
+
+
+class _Patch:
+    """install_stub_forge's monkeypatch surface (setattr only) without
+    pytest — the patches live for the process, which is the point."""
+
+    def setattr(self, obj, name, value):
+        setattr(obj, name, value)
+
+
+def _engine_env(engine: str):
+    if engine == "loop":
+        os.environ["OCT_FORGE_DEVICE"] = "0"
+    elif engine == "device":
+        os.environ["OCT_FORGE_DEVICE"] = "1"
+    else:
+        os.environ.pop("OCT_FORGE_DEVICE", None)
+
+
+def run_engine(engine: str, n_slots: int, params, pools, lview,
+               tmp: str) -> dict:
+    from ouroboros_consensus_tpu.tools import db_synthesizer as synth
+
+    _engine_env(engine)
+    try:
+        # warmup window: first-execute compiles / jit caches / staged
+        # pool columns — steady state is what the table compares
+        synth.synthesize(
+            os.path.join(tmp, f"warm-{engine}"), params, pools, lview,
+            synth.ForgeLimit(slots=WARMUP_SLOTS),
+        )
+        db = os.path.join(tmp, f"db-{engine}")
+        t0 = time.monotonic()
+        res = synth.synthesize(
+            db, params, pools, lview, synth.ForgeLimit(slots=n_slots),
+        )
+        wall = time.monotonic() - t0
+    finally:
+        os.environ.pop("OCT_FORGE_DEVICE", None)
+    return {
+        "engine": engine, "slots": res.n_slots, "blocks": res.n_blocks,
+        "wall_s": round(wall, 3),
+        "slots_per_s": round(res.n_slots / wall, 1),
+        "blocks_per_s": round(res.n_blocks / wall, 1),
+    }
+
+
+def main() -> int:
+    from ouroboros_consensus_tpu.tools import db_synthesizer as synth
+
+    crypto = "native" if NATIVE else "stub"
+    if not NATIVE:
+        from ouroboros_consensus_tpu.testing import stubs
+
+        stubs.install_stub_forge(_Patch(), bucket=256)
+    params = synth.default_params()
+    pools, lview = synth.make_credentials(POOLS)
+    print(f"profile_forge: {N} slots, {POOLS} pools, {crypto} crypto "
+          f"(loop window {LOOP_SLOTS} slots)", flush=True)
+
+    rows = []
+    engines = ["loop", "host"] + ([] if SKIP_DEVICE else ["device"])
+    with tempfile.TemporaryDirectory() as tmp:
+        for engine in engines:
+            n = LOOP_SLOTS if engine == "loop" else N
+            t0 = time.monotonic()
+            row = run_engine(engine, n, params, pools, lview, tmp)
+            print(f"  {engine:6s} {row['slots']:>7d} slots "
+                  f"{row['blocks']:>6d} blocks in {row['wall_s']:8.2f}s "
+                  f"-> {row['slots_per_s']:>9.1f} slots/s "
+                  f"{row['blocks_per_s']:>8.1f} blocks/s "
+                  f"(+{time.monotonic() - t0 - row['wall_s']:.1f}s warmup)",
+                  flush=True)
+            rows.append(row)
+
+    by = {r["engine"]: r for r in rows}
+    speedups = {}
+    loop_rate = by["loop"]["slots_per_s"]
+    for eng in ("host", "device"):
+        if eng in by and loop_rate:
+            speedups[f"{eng}_vs_loop"] = round(
+                by[eng]["slots_per_s"] / loop_rate, 1
+            )
+    for k, v in sorted(speedups.items()):
+        print(f"  {k}: {v}x")
+
+    from ouroboros_consensus_tpu.obs import ledger
+
+    ledger.record_replay(
+        "profile_forge",
+        config={"n": N, "pools": POOLS, "crypto": crypto,
+                "loop_slots": LOOP_SLOTS},
+        result={"engines": rows, "speedups": speedups},
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -362,10 +362,11 @@ def test_certified_json_covers_every_certifiable_graph():
 _FAST_GRAPHS = [
     "ed_core", "kes_core", "finish_core", "msm", "packed_unpack",
     "verdict_reduce", "mul_mod_l", "sum_mod_l_3t", "sum_mod_l_40t",
-    "sum_mod_l_epoch", "ed25519_sign",
+    "sum_mod_l_epoch", "ed25519_sign", "forge_sign",
 ]
 _HEAVY_GRAPHS = [
     "verify_praos_core_bc", "aggregate_core", "spmd_sharded_verify",
+    "forge_sweep",
 ]
 _INTERIOR_GRAPHS = ["vrf_core", "vrf_bc_core", "verify_praos_core"]
 

@@ -1,0 +1,484 @@
+"""Device-batched chain synthesis (PR 18, protocol/forge.py): the
+forging differential plane.
+
+The headline equation: the batched pipeline — windowed leader-election
+sweeps + sequential assembly over just the elected slots — forges the
+byte-identical chain the per-slot reference loop forges, for every
+engine (loop / host / device), both proof formats, across epoch
+boundaries, under empty elections, after a resume, and with chaos
+detonating at the forge seams. Forged chains replay green through
+validate_chain with zero gate declines, and the ForgeSpan plane counts
+what happened."""
+
+from __future__ import annotations
+
+import os
+from fractions import Fraction
+
+import pytest
+
+from ouroboros_consensus_tpu import obs
+from ouroboros_consensus_tpu.obs.warmup import WARMUP
+from ouroboros_consensus_tpu.protocol import forge as forge_mod
+from ouroboros_consensus_tpu.protocol import praos
+from ouroboros_consensus_tpu.testing import chaos, fixtures
+from ouroboros_consensus_tpu.testing.stubs import install_stub_forge
+from ouroboros_consensus_tpu.tools import db_analyser as ana
+from ouroboros_consensus_tpu.tools import db_synthesizer as synth
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    WARMUP.reset()
+    obs.reset_for_tests()
+    for var in ("OCT_CHAOS", "OCT_FORGE_DEVICE", "OCT_VRF_BATCH",
+                "OCT_TRACE"):
+        monkeypatch.delenv(var, raising=False)
+    chaos.reset()
+    synth._REPLAY_MEMO.clear()
+    yield
+    WARMUP.reset()
+    obs.reset_for_tests()
+    chaos.reset()
+    synth._REPLAY_MEMO.clear()
+
+
+def _params():
+    # small epochs: a 150-slot run crosses two epoch boundaries, so the
+    # window clamp at epoch edges (eta0 is epoch-constant) is exercised
+    return praos.PraosParams(
+        slots_per_kes_period=100,
+        max_kes_evolutions=62,
+        security_param=4,
+        active_slot_coeff=Fraction(1, 2),
+        epoch_length=60,
+        kes_depth=3,
+    )
+
+
+PARAMS = _params()
+POOLS = [fixtures.make_pool(7, kes_depth=3),
+         fixtures.make_pool(8, kes_depth=3)]
+LVIEW = fixtures.make_ledger_view(POOLS)
+
+
+def _forge(path, engine_env, limit, monkeypatch, *, pools=None,
+           lview=None, txs_per_block=2):
+    """Synthesize with the forging engine pinned by the env lever
+    (None = unset -> the batched host default)."""
+    if engine_env is None:
+        monkeypatch.delenv("OCT_FORGE_DEVICE", raising=False)
+    else:
+        monkeypatch.setenv("OCT_FORGE_DEVICE", engine_env)
+    return synth.synthesize(
+        str(path), PARAMS, pools or POOLS, lview or LVIEW, limit,
+        txs_per_block=txs_per_block, chunk_size=PARAMS.epoch_length,
+    )
+
+
+def _chain(db):
+    imm = ana.open_immutable(str(db))
+    return [(e.slot, e.block_no, e.hash_, raw)
+            for e, raw in imm.stream_all()]
+
+
+# ---------------------------------------------------------------------------
+# the headline: pipeline == loop, byte for byte
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fmt", ["1", "0"], ids=["bc", "draft03"])
+def test_host_pipeline_matches_loop_bytes(tmp_path, monkeypatch, fmt):
+    """Batched host engine vs the per-slot reference loop: identical
+    chain bytes, counters and final state across two epoch boundaries,
+    in BOTH proof serializations."""
+    monkeypatch.setenv("OCT_VRF_BATCH", fmt)
+    r_loop = _forge(tmp_path / "loop", "0",
+                    synth.ForgeLimit(slots=150), monkeypatch)
+    r_host = _forge(tmp_path / "host", None,
+                    synth.ForgeLimit(slots=150), monkeypatch)
+    assert _chain(tmp_path / "loop") == _chain(tmp_path / "host")
+    assert r_loop.n_blocks == r_host.n_blocks > 0
+    assert r_loop.n_slots == r_host.n_slots == 150
+    assert r_loop.final_state == r_host.final_state
+    # the pipeline seals WALKED sidecars at forge time, same as the loop
+    cols = [f for f in os.listdir(tmp_path / "host" / "immutable")
+            if f.endswith(".cols")]
+    assert cols
+
+
+def test_blocks_limit_consumed_slots_match(tmp_path, monkeypatch):
+    """The blocks limit trips mid-window: the pipeline must count only
+    the slots up to and including the tripping block's — the loop's
+    n_slots accounting, exactly."""
+    r_loop = _forge(tmp_path / "loop", "0",
+                    synth.ForgeLimit(blocks=23), monkeypatch)
+    r_host = _forge(tmp_path / "host", None,
+                    synth.ForgeLimit(blocks=23), monkeypatch)
+    assert _chain(tmp_path / "loop") == _chain(tmp_path / "host")
+    assert r_loop.n_blocks == r_host.n_blocks == 23
+    assert r_loop.n_slots == r_host.n_slots
+    assert r_loop.final_state == r_host.final_state
+
+
+def test_epochs_limit_matches(tmp_path, monkeypatch):
+    r_loop = _forge(tmp_path / "loop", "0",
+                    synth.ForgeLimit(epochs=2), monkeypatch)
+    r_host = _forge(tmp_path / "host", None,
+                    synth.ForgeLimit(epochs=2), monkeypatch)
+    assert _chain(tmp_path / "loop") == _chain(tmp_path / "host")
+    assert r_loop.n_slots == r_host.n_slots == 2 * PARAMS.epoch_length
+    assert r_loop.final_state == r_host.final_state
+
+
+def test_empty_election_window(tmp_path, monkeypatch):
+    """Zero-stake pools win nothing: both engines forge the same empty
+    chain and still consume the whole slot budget."""
+    dead = fixtures.make_ledger_view(POOLS, stakes=[Fraction(0)] * 2)
+    r_loop = _forge(tmp_path / "loop", "0", synth.ForgeLimit(slots=80),
+                    monkeypatch, lview=dead)
+    r_host = _forge(tmp_path / "host", None, synth.ForgeLimit(slots=80),
+                    monkeypatch, lview=dead)
+    assert r_loop.n_blocks == r_host.n_blocks == 0
+    assert r_loop.n_slots == r_host.n_slots == 80
+    assert _chain(tmp_path / "loop") == _chain(tmp_path / "host") == []
+
+
+def test_unknown_pool_treated_as_sigma_zero(tmp_path, monkeypatch):
+    """A credential absent from the pool distribution never forges —
+    the loop's `entry is None: continue` and the pipeline's sigma-0
+    threshold rows are the same rule."""
+    stranger = fixtures.make_pool(99, kes_depth=3)
+    pools = POOLS + [stranger]
+    # LVIEW only knows POOLS; `stranger` is the unknown credential
+    r_loop = _forge(tmp_path / "loop", "0", synth.ForgeLimit(slots=100),
+                    monkeypatch, pools=pools)
+    r_host = _forge(tmp_path / "host", None, synth.ForgeLimit(slots=100),
+                    monkeypatch, pools=pools)
+    assert _chain(tmp_path / "loop") == _chain(tmp_path / "host")
+    assert r_loop.final_state == r_host.final_state
+    for _slot, _no, _hash, raw in _chain(tmp_path / "host"):
+        assert stranger.vk_cold not in raw
+
+
+# ---------------------------------------------------------------------------
+# election engines as units
+# ---------------------------------------------------------------------------
+
+
+def test_elected_set_matches_reference_random_stakes():
+    """Seeded random (irregular-denominator) stakes, 3 pools, 80 slots:
+    the batched host election and the exact per-slot reference pick the
+    same (slot, pool) set with the same VRF outputs."""
+    import random
+
+    rng = random.Random(42)
+    pools = [fixtures.make_pool(20 + i, kes_depth=3) for i in range(3)]
+    stakes = [Fraction(rng.randrange(1, 97), 291) for _ in range(3)]
+    lview = fixtures.make_ledger_view(pools, stakes=stakes)
+    import hashlib
+
+    eta0 = hashlib.blake2b(b"forge-test-eta0", digest_size=32).digest()
+    slots = range(0, 80)
+    thr = forge_mod.pool_thresholds(PARAMS, lview, pools)
+    host = forge_mod._elect_window_host(PARAMS, pools, thr, slots, eta0)
+    ref = forge_mod._elect_window_reference(PARAMS, pools, lview, slots,
+                                            eta0)
+    assert [(e.slot, e.pool) for e in host] == [
+        (e.slot, e.pool) for e in ref
+    ]
+    assert [e.is_leader for e in host] == [e.is_leader for e in ref]
+    assert host  # seeded so the window is not vacuously empty
+
+
+def test_engine_from_env(monkeypatch):
+    monkeypatch.delenv("OCT_FORGE_DEVICE", raising=False)
+    assert forge_mod.engine_from_env() == "host"
+    assert forge_mod.engine_from_env("device") == "device"
+    monkeypatch.setenv("OCT_FORGE_DEVICE", "0")
+    assert forge_mod.engine_from_env("device") == "loop"
+    monkeypatch.setenv("OCT_FORGE_DEVICE", "1")
+    assert forge_mod.engine_from_env("host") == "device"
+
+
+def test_kill_switch_restores_loop(tmp_path, monkeypatch):
+    """OCT_FORGE_DEVICE=0 is the round-18 kill switch: the pipeline is
+    never entered (an election dispatch would raise here), and the
+    legacy loop forges the reference chain."""
+    ref = _forge(tmp_path / "ref", "0", synth.ForgeLimit(blocks=20),
+                 monkeypatch)
+
+    def boom(*a, **kw):
+        raise AssertionError("pipeline engaged under the kill switch")
+
+    monkeypatch.setattr(forge_mod, "elect_window", boom)
+    monkeypatch.setattr(forge_mod, "_elect_window_host", boom)
+    r = _forge(tmp_path / "killed", "0", synth.ForgeLimit(blocks=20),
+               monkeypatch)
+    assert _chain(tmp_path / "killed") == _chain(tmp_path / "ref")
+    assert r.final_state == ref.final_state
+
+
+# ---------------------------------------------------------------------------
+# the device engine under the stub family (tier-1) and for real (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fmt", ["1", "0"], ids=["bc", "draft03"])
+def test_device_stub_engine_byte_identical(tmp_path, monkeypatch, fmt):
+    """Device sweep (stub hash-twin kernels — the real-crypto twin is
+    the slow-tier test below) vs the reference loop under the SAME
+    stubbed host crypto: byte-identical chains, ForgeSpan counters
+    consistent, and the forge stages visible in the warmup forensics
+    (the Perfetto warmup track's source)."""
+    from ouroboros_consensus_tpu.protocol import batch as pbatch
+
+    monkeypatch.setenv("OCT_VRF_BATCH", fmt)
+    install_stub_forge(monkeypatch, bucket=256)
+    # fresh first-execute ledger: the other format's cell already noted
+    # forge_sweep's label, and _warm_timed only notes a stage once per
+    # process — the warmup-stage assertion below needs its own note
+    monkeypatch.setattr(pbatch, "_WARM_SEEN", set())
+    r_loop = _forge(tmp_path / "loop", "0",
+                    synth.ForgeLimit(slots=150), monkeypatch)
+    rec = obs.install()
+    try:
+        r_dev = _forge(tmp_path / "dev", "1",
+                       synth.ForgeLimit(slots=150), monkeypatch)
+    finally:
+        obs.uninstall()
+    assert _chain(tmp_path / "loop") == _chain(tmp_path / "dev")
+    assert r_loop.n_blocks == r_dev.n_blocks > 0
+    assert r_loop.final_state == r_dev.final_state
+    snap = rec.registry.snapshot()
+    by_engine = {s["labels"]["engine"]: s["value"]
+                 for s in snap["oct_forge_windows_total"]["samples"]}
+    assert by_engine.get("device", 0) >= 1
+    (elected,) = snap["oct_forge_elected_total"]["samples"]
+    (signed,) = snap["oct_forge_signed_total"]["samples"]
+    assert signed["value"] == r_dev.n_blocks
+    assert elected["value"] >= signed["value"]
+    # the sweep's first execute is a warmup stage note (lane-qualified);
+    # a 150-slot run spans the neutral-nonce epoch-0 window AND real-
+    # nonce windows, so BOTH sweep variants must have dispatched — and
+    # neither through the recovery ladder
+    stages = WARMUP.report()["stages"]
+    assert any(k.startswith("forge_sweep:") for k in stages)
+    assert any(k.startswith("forge_sweep-neutral:") for k in stages)
+    assert not snap.get("oct_recovery_total", {}).get("samples", [])
+
+
+def test_device_sweep_dispatches_under_neutral_nonce(tmp_path, monkeypatch):
+    """Epoch 0 of a fresh chain elects under the NEUTRAL epoch nonce
+    (PraosState() starts at None — mk_input_vrf hashes slot bytes
+    alone). The device engine must dispatch the statically nonce-free
+    sweep variant for those windows, not ride the recovery ladder to
+    the host loop: a fallback would be byte-identical and therefore
+    invisible to every differential, which is exactly why this pins
+    the dispatch itself."""
+    from ouroboros_consensus_tpu.protocol import batch as pbatch
+    from ouroboros_consensus_tpu.utils.trace import RecoveryEvent
+
+    install_stub_forge(monkeypatch, bucket=256)
+    monkeypatch.setattr(pbatch, "_WARM_SEEN", set())
+    events = []
+    monkeypatch.setattr(pbatch, "BATCH_TRACER", events.append)
+    # slots < epoch_length: the WHOLE run stays in epoch 0 (neutral)
+    limit = synth.ForgeLimit(slots=50)
+    r_loop = _forge(tmp_path / "loop", "0", limit, monkeypatch)
+    r_dev = _forge(tmp_path / "dev", "1", limit, monkeypatch)
+    assert _chain(tmp_path / "loop") == _chain(tmp_path / "dev")
+    assert r_loop.n_blocks == r_dev.n_blocks > 0
+    assert not [e for e in events if isinstance(e, RecoveryEvent)]
+    stages = WARMUP.report()["stages"]
+    assert any(k.startswith("forge_sweep-neutral:") for k in stages)
+    assert not any(k.startswith("forge_sweep:") for k in stages)
+
+
+@pytest.mark.slow
+def test_device_engine_real_crypto_byte_identical(tmp_path, monkeypatch):
+    """The real thing: the full ECVRF prove sweep on the device engine
+    (one ~4 min XLA:CPU compile at bucket 64) forges the byte-identical
+    chain — measured 52/52 blocks equal on seed 7/8."""
+    monkeypatch.setattr(forge_mod, "FORGE_BUCKET", 64)
+    monkeypatch.setattr(forge_mod, "_JITS", {})
+    r_loop = _forge(tmp_path / "loop", "0",
+                    synth.ForgeLimit(slots=100), monkeypatch)
+    r_dev = _forge(tmp_path / "dev", "1",
+                   synth.ForgeLimit(slots=100), monkeypatch)
+    assert _chain(tmp_path / "loop") == _chain(tmp_path / "dev")
+    assert r_loop.final_state == r_dev.final_state
+
+
+# ---------------------------------------------------------------------------
+# forged chains replay green
+# ---------------------------------------------------------------------------
+
+
+def test_forged_chain_replays_green_zero_gate_declines(tmp_path,
+                                                       monkeypatch):
+    """A pipeline-forged chain is a first-class citizen of the verify
+    side: validate_chain replays it end to end with no error and ZERO
+    qualification-gate declines."""
+    _forge(tmp_path / "db", None, synth.ForgeLimit(blocks=40),
+           monkeypatch, txs_per_block=0)
+    rec = obs.install()
+    try:
+        r = ana.revalidate(str(tmp_path / "db"), PARAMS, LVIEW,
+                           backend="host", validate_all="stream")
+    finally:
+        obs.uninstall()
+    assert r.error is None and r.n_valid == 40
+    declines = rec.registry.snapshot().get("oct_gate_declines_total")
+    assert sum(s["value"] for s in declines["samples"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# resume: the memoized trusted fold
+# ---------------------------------------------------------------------------
+
+
+def test_resume_memoizes_trusted_fold(tmp_path, monkeypatch):
+    """Resuming a store THIS process forged skips the whole-chain
+    reupdate replay (the memo hit); a cleared memo falls through to the
+    replay; both converge on the one-shot chain byte for byte."""
+    calls = []
+    real = synth._replay_forged_state
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(synth, "_replay_forged_state", spy)
+
+    one = tmp_path / "oneshot"
+    _forge(one, None, synth.ForgeLimit(blocks=30), monkeypatch)
+
+    hit = tmp_path / "hit"
+    _forge(hit, None, synth.ForgeLimit(blocks=15), monkeypatch)
+    calls.clear()
+    synth.synthesize(str(hit), PARAMS, POOLS, LVIEW,
+                     synth.ForgeLimit(blocks=30), txs_per_block=2,
+                     chunk_size=PARAMS.epoch_length, resume=True)
+    assert calls == []  # the memo served the fold
+    assert _chain(hit) == _chain(one)
+
+    miss = tmp_path / "miss"
+    _forge(miss, None, synth.ForgeLimit(blocks=15), monkeypatch)
+    synth._REPLAY_MEMO.clear()
+    calls.clear()
+    synth.synthesize(str(miss), PARAMS, POOLS, LVIEW,
+                     synth.ForgeLimit(blocks=30), txs_per_block=2,
+                     chunk_size=PARAMS.epoch_length, resume=True)
+    assert len(calls) == 1  # no memo: the replay fold ran once
+    assert _chain(miss) == _chain(one)
+
+
+def test_resume_memo_stale_tip_falls_through(tmp_path, monkeypatch):
+    """A memo whose (slot, hash) no longer matches the on-disk tip —
+    another writer, an external truncation — must NOT be trusted."""
+    db = tmp_path / "db"
+    _forge(db, None, synth.ForgeLimit(blocks=15), monkeypatch)
+    key = os.path.realpath(str(db))
+    assert key in synth._REPLAY_MEMO
+    stale = synth._REPLAY_MEMO[key]
+    synth._REPLAY_MEMO[key] = (stale[0] + 7, b"\x00" * 32) + stale[2:]
+    calls = []
+    real = synth._replay_forged_state
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(synth, "_replay_forged_state", spy)
+    synth.synthesize(str(db), PARAMS, POOLS, LVIEW,
+                     synth.ForgeLimit(blocks=30), txs_per_block=2,
+                     chunk_size=PARAMS.epoch_length, resume=True)
+    assert len(calls) == 1  # stale memo rejected, replay ran
+    one = tmp_path / "oneshot"
+    _forge(one, None, synth.ForgeLimit(blocks=30), monkeypatch)
+    assert _chain(db) == _chain(one)
+
+
+# ---------------------------------------------------------------------------
+# chaos at the forge seams: the recovery ladder
+# ---------------------------------------------------------------------------
+
+
+def _armed(monkeypatch, spec):
+    monkeypatch.setenv("OCT_CHAOS", spec)
+    chaos.reset()
+
+
+def _recovery_actions(rec):
+    fam = rec.registry.snapshot().get("oct_recovery_total")
+    if not fam:
+        return {}
+    return {s["labels"]["action"]: s["value"] for s in fam["samples"]}
+
+
+def test_forge_dispatch_device_error_rides_retry(tmp_path, monkeypatch):
+    """One injected dispatch fault is absorbed by the ladder's retry —
+    the chain is byte-identical to the unfaulted run and the episode is
+    countable."""
+    ref = _forge(tmp_path / "ref", None, synth.ForgeLimit(blocks=20),
+                 monkeypatch)
+    _armed(monkeypatch, "device-error@forge-dispatch:0")
+    rec = obs.install()
+    try:
+        r = _forge(tmp_path / "db", None, synth.ForgeLimit(blocks=20),
+                   monkeypatch)
+    finally:
+        obs.uninstall()
+        monkeypatch.delenv("OCT_CHAOS")
+        chaos.reset()
+    assert _chain(tmp_path / "db") == _chain(tmp_path / "ref")
+    assert r.final_state == ref.final_state
+    acts = _recovery_actions(rec)
+    assert acts.get("retry", 0) >= 1
+    assert acts.get("recovered", 0) >= 1
+    assert "host-reference" not in acts
+
+
+def test_forge_dispatch_ladder_exhausts_to_host_reference(
+        tmp_path, monkeypatch):
+    """TWO consecutive dispatch faults defeat the retry (each fire
+    advances the seam's sequence, so `:0,:1` hits both attempts): the
+    window drops to the exact host-reference election and the chain is
+    STILL byte-identical."""
+    ref = _forge(tmp_path / "ref", None, synth.ForgeLimit(blocks=20),
+                 monkeypatch)
+    _armed(monkeypatch,
+           "device-error@forge-dispatch:0,device-error@forge-dispatch:1")
+    rec = obs.install()
+    try:
+        r = _forge(tmp_path / "db", None, synth.ForgeLimit(blocks=20),
+                   monkeypatch)
+    finally:
+        obs.uninstall()
+        monkeypatch.delenv("OCT_CHAOS")
+        chaos.reset()
+    assert _chain(tmp_path / "db") == _chain(tmp_path / "ref")
+    assert r.final_state == ref.final_state
+    acts = _recovery_actions(rec)
+    assert acts.get("host-reference", 0) >= 1
+    assert acts.get("recovered", 0) >= 1
+
+
+def test_forge_dispatch_fault_on_device_engine_stub(tmp_path,
+                                                    monkeypatch):
+    """The ladder on the DEVICE engine (stub kernels): exhaustion lands
+    on the host-reference floor — a dispatch fault can never change
+    chain bytes, only cost."""
+    install_stub_forge(monkeypatch, bucket=256)
+    ref = _forge(tmp_path / "ref", "1", synth.ForgeLimit(blocks=20),
+                 monkeypatch)
+    _armed(monkeypatch,
+           "device-error@forge-dispatch:0,device-error@forge-dispatch:1")
+    try:
+        _forge(tmp_path / "db", "1", synth.ForgeLimit(blocks=20),
+               monkeypatch)
+    finally:
+        monkeypatch.delenv("OCT_CHAOS")
+        chaos.reset()
+    assert _chain(tmp_path / "db") == _chain(tmp_path / "ref")

@@ -986,7 +986,10 @@ def test_lint_changed_maps_obs_sources_to_purity_graphs():
     )
     lint = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(lint)
-    purity = {"packed_unpack", "verdict_reduce", "spmd_sharded_verify"}
+    # forge_sweep joined the purity plane in round 18: ForgeSpan
+    # telemetry is emitted beside the traced sweep program
+    purity = {"packed_unpack", "verdict_reduce", "spmd_sharded_verify",
+              "forge_sweep"}
     assert set(lint._select_graphs(
         {"ouroboros_consensus_tpu/obs/recorder.py"}
     )) == purity

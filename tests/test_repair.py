@@ -555,6 +555,10 @@ _MATRIX = [
     # SIGKILL mid-build leaves a dirty store + a stranded .cols.tmp
     ("sidecar-torn@build:1", [False, "stream"]),
     ("sigkill@build:1", [False]),
+    # the forge pipeline (PR 18): a SIGKILL between a forged block's
+    # retire and the next — the append fully flushed, only the clean
+    # marker is missing, and the batched resume must converge
+    ("sigkill@forge:10", [False]),
 ]
 
 
@@ -874,6 +878,37 @@ def test_sigkilled_writer_reopens_dirty_repairs_resumes(
 
     # the writer RESUMES: deterministic forging converges on the
     # uninterrupted chain, byte for byte
+    _writer_child(db, resume=True)
+    r2 = _reval(db, validate_all=True)
+    ref = _reval(pristine, validate_all=True)
+    assert r2.error is None and r2.n_valid == N_BLOCKS
+    assert r2.final_state == ref.final_state
+    t_res = ana.open_immutable(db).tip()
+    t_ref = ana.open_immutable(pristine).tip()
+    assert (t_res.slot, t_res.hash_) == (t_ref.slot, t_ref.hash_)
+
+
+def test_sigkilled_forge_child_resume_converges(
+        pristine, pristine_states, tmp_path):
+    """The batched-forge twin of the headline: a REAL SIGKILL at the
+    `forge` seam (right after the 11th forged block's append+reupdate,
+    before the clean marker). Unlike sigkill@append the store's last
+    append fully flushed — the reopen is dirty but repair-free past the
+    escalation — and the RESUMED writer re-enters mid-window through
+    the batched pipeline (memoized trusted fold, fresh election sweep)
+    and converges on the byte-identical uninterrupted chain."""
+    db = str(tmp_path / "db")
+    _writer_child(db, "sigkill@forge:10")
+    assert not sg.was_clean_shutdown(db)  # died mid-forge: dirty
+
+    r = _reval(db)
+    assert r.opened_dirty and r.error is None
+    assert r.n_valid == 11  # every append behind the kill had landed
+    assert r.repairs.get("dirty-open-escalated") == 1
+    assert r.repairs.get("rebuild-index", 0) == 0  # nothing was torn
+    assert r.final_state == pristine_states[11]
+    assert sg.was_clean_shutdown(db)  # healed
+
     _writer_child(db, resume=True)
     r2 = _reval(db, validate_all=True)
     ref = _reval(pristine, validate_all=True)
