@@ -18,6 +18,12 @@ Subcommands:
              / thread-lifecycle / tmp-fsync-rename checkers vs the
              analysis/concurrency.json ratchet. Pure AST — never
              imports jax
+  flow       octflow exception-routing & degradation-lattice sweep
+             (analysis/flow.py): raise-classification / corruption-
+             laundering / verdict-fabrication / lattice-completeness /
+             kill-switch-integrity / re-dispatch-pinning checkers vs
+             the analysis/flow.json ratchet. Pure AST — never imports
+             jax
 
 Shared options:
   --json            machine-readable report on stdout (keys sorted —
@@ -41,6 +47,13 @@ sync options:
   --all             include suppressed findings in the report
   --no-ratchet      report only; skip the concurrency.json comparison
 
+flow options:
+  --paths P [P...]  sweep these files/dirs instead of the default roots
+                    (package + scripts/ + bench.py); partial sweeps
+                    skip the whole-tree FLOW305 lever audit
+  --all             include suppressed findings in the report
+  --no-ratchet      report only; skip the flow.json comparison
+
 Exit codes (distinct so CI can tell WHY the gate failed):
   0  clean
   1  unsuppressed AST finding(s)
@@ -56,8 +69,11 @@ Exit codes (distinct so CI can tell WHY the gate failed):
   7  octsync concurrency ratchet violation (a new unsuppressed
      lock/thread/durability finding, lock-or-thread inventory drift,
      or a stale suppression)
+  8  octflow failure-taxonomy ratchet violation (a new unsuppressed
+     FLOW3xx exception-routing finding, raise-site/handler/rung-edge/
+     lever inventory drift, or a stale suppression)
 When several classes fire at once the lowest code wins
-(1 < 3 < 4 < 5 < 6 < 7).
+(1 < 3 < 4 < 5 < 6 < 7 < 8).
 """
 
 from __future__ import annotations
@@ -76,6 +92,7 @@ EXIT_CERT = 4
 EXIT_COST = 5
 EXIT_RESOURCES = 6
 EXIT_SYNC = 7
+EXIT_FLOW = 8
 
 
 def _package_root() -> str:
@@ -304,6 +321,64 @@ def _cmd_sync(args) -> int:
     return EXIT_SYNC if violations else EXIT_OK
 
 
+def _cmd_flow(args) -> int:
+    """octflow: exception-routing & degradation-lattice sweep vs the
+    flow.json ratchet (sorted-keys --json is byte-stable for CI
+    diffing). Pure AST — jax is never imported on this route."""
+    from . import flow
+
+    repo = os.path.dirname(_package_root())
+    paths = args.paths or flow.default_roots(repo)
+    cfg = flow.load_roots()
+    if args.paths:
+        # FLOW305 lever integrity is a whole-tree property — a partial
+        # --paths sweep would read none of the documented levers and
+        # drown the report in dead-lever noise
+        cfg["kill_switches"] = []
+    report = flow.sweep_paths(paths, repo, cfg)
+    violations: list[str] = []
+    stale: list[str] = []
+    if not args.no_ratchet:
+        violations, stale = flow.check_flow(report, flow.load_baseline())
+    shown = (report.findings if args.all
+             else [f for f in report.findings if not f.suppressed])
+    lines = [f.format() for f in shown]
+    lines.extend(f"FLOW: {v}" for v in violations)
+    lines.extend(
+        f"note: flow baseline entry no longer fires "
+        f"(run scripts/lint.py --update-flow to ratchet): {k}"
+        for k in stale
+    )
+    n_sup = sum(1 for f in report.findings if f.suppressed)
+    lines.append(
+        f"octflow: {len(shown)} finding(s), {n_sup} suppressed, "
+        f"{len(violations)} ratchet violation(s), "
+        f"{len(stale)} stale ratchet entr(y/ies)"
+    )
+    _emit(
+        {
+            "findings": [
+                {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "line": f.line,
+                    "col": f.col,
+                    "message": f.message,
+                    "suppressed": f.suppressed,
+                    "key": f.key(),
+                }
+                for f in shown
+            ],
+            "inventory": report.inventory,
+            "violations": violations,
+            "stale": stale,
+            "ok": not violations,
+        },
+        args.json, lines,
+    )
+    return EXIT_FLOW if violations else EXIT_OK
+
+
 def _cmd_pointops(args) -> int:
     _pin_cpu()
     budgets = graphs.load_budgets(args.budgets)
@@ -465,6 +540,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--no-ratchet", action="store_true",
                    help="skip the concurrency.json comparison")
 
+    p = sub.add_parser("flow")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--paths", nargs="+", default=None)
+    p.add_argument("--all", action="store_true",
+                   help="include suppressed findings")
+    p.add_argument("--no-ratchet", action="store_true",
+                   help="skip the flow.json comparison")
+
     args = ap.parse_args(argv)
     if args.cmd in ("range", "taint"):
         return _cmd_certify(args, args.cmd)
@@ -476,6 +559,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_resources(args)
     if args.cmd == "sync":
         return _cmd_sync(args)
+    if args.cmd == "flow":
+        return _cmd_flow(args)
     # default-run graph names must be registered (certification targets
     # include aux graphs; the default run's budget pass does not)
     if args.graphs:

@@ -212,13 +212,16 @@ class _Module:
         self.suppress_line: dict[int, set[str] | None] = {}
         self.suppress_decls: list[list] = []
         self._guard_comments: dict[int, str] = {}
-        self._scan_comments(source)
+        # tokenized once, shared with octflow's suppression scan
+        self.comment_lines: list[tuple[int, str]] = list(
+            _comment_lines(source))
+        self._scan_comments()
         self._scan()
 
     # -- comments: suppressions + guarded-by annotations --------------------
 
-    def _scan_comments(self, source: str) -> None:
-        for i, line in _comment_lines(source):
+    def _scan_comments(self) -> None:
+        for i, line in self.comment_lines:
             g = _GUARDED_BY_RE.search(line)
             if g:
                 self._guard_comments[i] = g.group(1)
@@ -425,15 +428,19 @@ def _instance_class(call: ast.Call, model: _Module) \
 
 class SyncPackage:
     def __init__(self, roots: list[str], rel_to: str,
-                 roots_table: dict | None = None):
+                 roots_table: dict | None = None,
+                 threads: bool = True):
         self.rel_to = rel_to
         self.roots_table = roots_table or load_roots()
         self.modules: dict[str, _Module] = {}
         for root in roots:
             self._load(root)
         self._resolve_all_calls()
-        self._mark_threads()
-        self._close_acquires()
+        # octflow reuses the package for its call graph only — thread
+        # reachability and transitive lock closure are octsync-specific
+        if threads:
+            self._mark_threads()
+            self._close_acquires()
 
     # -- loading -------------------------------------------------------------
 

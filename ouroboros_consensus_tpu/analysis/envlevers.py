@@ -184,9 +184,73 @@ def documented_levers(readme_path: str | None = None) -> set[str]:
     return names
 
 
+def kill_switch_levers(readme_path: str | None = None) -> set[str]:
+    """The kill-switch SUBSET of the documented levers: rows whose
+    first cell documents an `=0` spelling (`OCT_RECOVERY=0`,
+    `OCT_FORGE_DEVICE=1` / `=0`, …). These are the levers octflow's
+    FLOW305 holds to guard-a-branch integrity — value levers
+    (`OCT_CHECKPOINT=<file>`) are documented but not kill-switches."""
+    with open(readme_path or _README_PATH, encoding="utf-8") as f:
+        text = f.read()
+    m = re.search(r"^## Levers\s*$", text, flags=re.MULTILINE)
+    if not m:
+        return set()
+    section = text[m.end():]
+    nxt = re.search(r"^## ", section, flags=re.MULTILINE)
+    if nxt:
+        section = section[:nxt.start()]
+    names: set[str] = set()
+    for line in section.splitlines():
+        line = line.strip()
+        if not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1]
+        if "=0" not in first_cell:
+            continue
+        for tick in re.findall(r"`([^`]+)`", first_cell):
+            names.update(
+                n for n in _DOC_NAME_RE.findall(tick) if _is_lever(n)
+            )
+    return names
+
+
 # ---------------------------------------------------------------------------
-# The gate
+# The gates
 # ---------------------------------------------------------------------------
+
+
+def check_kill_switches(
+    readme_path: str | None = None,
+    flow_baseline: dict | None = None,
+) -> list[str]:
+    """Cross-link the README kill-switch rows with octflow's ratcheted
+    FLOW305 lever inventory (analysis/flow.json `inventory.levers`,
+    entries `NAME:guards=N`). Both drift directions are violations, so
+    a new `=0` row lands only together with a --update-flow re-pin
+    (which re-runs the guard analysis on it) and a deleted row retires
+    its inventory entry."""
+    from . import flow
+
+    rows = kill_switch_levers(readme_path)
+    base = flow_baseline if flow_baseline is not None \
+        else flow.load_baseline()
+    entries = base.get("inventory", {}).get("levers", [])
+    pinned = {e.split(":", 1)[0] for e in entries}
+    out = []
+    for name in sorted(rows - pinned):
+        out.append(
+            f"obs/README.md documents kill-switch `{name}=0` but "
+            f"analysis/flow.json has no FLOW305 lever inventory entry "
+            "for it — run scripts/lint.py --update-flow"
+        )
+    for name in sorted(pinned - rows):
+        out.append(
+            f"analysis/flow.json pins FLOW305 lever inventory for "
+            f"`{name}` but obs/README.md no longer documents it as a "
+            "kill-switch row — stale pin, run scripts/lint.py "
+            "--update-flow"
+        )
+    return out
 
 
 def check_env_levers(

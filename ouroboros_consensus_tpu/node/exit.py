@@ -47,6 +47,44 @@ class Disposition(Enum):
     PROPAGATE = "propagate"
 
 
+# The one place a failure class gets its disposition. Keyed by CLASS
+# NAME (walked along type(exc).__mro__, so a subclass inherits its
+# family's row unless it has its own) because the analysis plane reads
+# this table statically: octflow (analysis/flow.py FLOW301) refuses a
+# `raise` of a custom exception class in the crash/verdict-bearing
+# modules unless the class — or an ancestor — has a row here. Adding a
+# failure class to storage/tools/protocol is therefore a two-line
+# change by construction: the class, and its conscious classification.
+DISPOSITIONS: dict[str, Disposition] = {
+    # REFUSE — the operator asked for something the store/forger must
+    # not do; retrying or degrading would be WRONG
+    "DbLocked": Disposition.REFUSE,
+    "DbMarkerMismatch": Disposition.REFUSE,
+    "QuarantineError": Disposition.REFUSE,
+    "KESKeyExpired": Disposition.REFUSE,      # forging with a dead key
+    "KESBeforeStart": Disposition.REFUSE,     # cert not yet valid
+    "OperationalCertIssueError": Disposition.REFUSE,
+    # REPAIR — on-disk corruption the open-with-repair scan owns;
+    # never absorbed by the per-window ladder, never masked
+    "ImmutableDBError": Disposition.REPAIR,   # + MissingBlock subclass
+    "MalformedBlock": Disposition.REPAIR,     # unparseable block bytes
+    # RECOVER — transient by contract: the supervisor ladder may absorb
+    "ChaosError": Disposition.RECOVER,        # the whole chaos taxonomy
+    "OSError": Disposition.RECOVER,           # + ConnectionError family
+    "MemoryError": Disposition.RECOVER,
+    "RuntimeError": Disposition.RECOVER,      # the PJRT surface family
+    # PROPAGATE — verdicts and contract violations: recovery must never
+    # re-dispatch a header the protocol already judged, and chain
+    # selection (not the ladder) owns invalid-block routing
+    "PraosValidationError": Disposition.PROPAGATE,  # + every subclass
+    "ConsensusError": Disposition.PROPAGATE,        # Bft/PBft verdicts
+    "HeaderEnvelopeError": Disposition.PROPAGATE,
+    "InvalidBlock": Disposition.PROPAGATE,    # chain selection owns it
+    "MissingBlockError": Disposition.PROPAGATE,  # caller contract bug
+    "BlockGCed": Disposition.PROPAGATE,       # caller contract bug
+}
+
+
 def to_exit_reason(exc: BaseException) -> ExitReason:
     """toExitReason (Node/Exit.hs:100)."""
     from ..storage.guard import DbLocked, DbMarkerMismatch
@@ -67,28 +105,18 @@ def triage(exc: BaseException) -> Disposition:
     supervisor (obs/recovery.recoverable) absorbs ONLY `RECOVER`;
     `REFUSE` and `REPAIR` classes propagate to the layer that owns
     them (the caller / the open-with-repair scan), and `PROPAGATE`
-    bugs always surface raw."""
-    from ..storage.guard import DbLocked, DbMarkerMismatch
-    from ..storage.immutable import ImmutableDBError
-    from ..storage.repair import QuarantineError
-    from ..testing import chaos
+    bugs always surface raw.
 
-    if isinstance(exc, (DbLocked, DbMarkerMismatch, QuarantineError)):
-        # QuarantineError: the environment cannot honor quarantine-
-        # never-delete (ENOSPC, unwritable dir) — repairing anyway
-        # would destroy the bytes the repair promised to keep
-        return Disposition.REFUSE
-    if isinstance(exc, ImmutableDBError):
-        # on-disk corruption: truncate-and-repair territory — the
-        # window ladder re-dispatching the same corrupt bytes would
-        # loop, and masking it would be silence
-        return Disposition.REPAIR
-    if isinstance(exc, chaos.ChaosError):
-        return Disposition.RECOVER  # transient by construction
-    if isinstance(exc, (OSError, MemoryError)):
-        return Disposition.RECOVER
-    # jaxlib's XlaRuntimeError (module path varies across jax versions)
-    # and the RuntimeError family PJRT surfaces through
-    if isinstance(exc, RuntimeError) or "XlaRuntimeError" in type(exc).__name__:
+    The MRO walk makes the DISPOSITIONS table positional: the most
+    derived classified ancestor wins, so `MissingBlock` rides its
+    `ImmutableDBError` REPAIR row while `DbLocked` (a plain Exception)
+    hits its own REFUSE row before any family default could."""
+    for klass in type(exc).__mro__:
+        d = DISPOSITIONS.get(klass.__name__)
+        if d is not None:
+            return d
+    # jaxlib's XlaRuntimeError moved modules across jax versions and is
+    # not importable without jax — matched by name, not by row
+    if "XlaRuntimeError" in type(exc).__name__:
         return Disposition.RECOVER
     return Disposition.PROPAGATE

@@ -683,7 +683,9 @@ def _warm_timed(stage: str, fn):
                 ex = pk_aot.load(name, lanes or 0, 0, 0, sig)
                 if ex is not None:
                     via = "xla-aot"
-            except Exception:  # noqa: BLE001 — fail-soft by contract
+            except Exception:  # noqa: BLE001 # octflow: disable=FLOW303
+                # — fail-soft by contract: a failed AOT load falls
+                # through to the fresh-compile dispatch just below
                 ex = None
         if ex is None and pk_aot.writeback_enabled():
             ex = pk_aot.compile_and_store(name, lanes or 0, 0, 0, fn, a)
@@ -772,7 +774,10 @@ def _compile_gate_admit(stage: str, action: str,
         return costmodel.preflight(stage, action=action,
                                    fallback_graph=fallback_graph,
                                    lanes=lanes)
-    except Exception:  # noqa: BLE001 — fail-open by contract
+    except Exception:  # noqa: BLE001 # octflow: disable=FLOW303 —
+        # fail-open by contract: the compile-wall gate must never
+        # break dispatch; admitting is the no-gate behavior, and the
+        # window's verdict still comes from the full validation
         return True
 
 
@@ -2212,7 +2217,11 @@ def dispatch_batch(params, lview, eta0, hvs, carry=None, ladder=None):
     composition of `prepare_window` + `dispatch_prepared`; the
     pipelined validate_chain loop calls the halves separately so a
     producer thread can stage ahead of dispatch."""
-    return dispatch_prepared(
+    return dispatch_prepared(  # octflow: disable=FLOW304 — public
+        # composition seam with no in-package caller: the pipelined
+        # loops call the halves separately (and ride the supervisor);
+        # an external caller of the inline form owns its own recovery,
+        # exactly like calling dispatch_prepared directly
         prepare_window(params, lview, eta0, hvs), carry, ladder
     )
 

@@ -378,6 +378,35 @@ class TPraosProtocol:
             backend = "device" if self.use_device_batch else "host-fold"
         if backend == "host-fold":
             return self._host_fold(ticked, hvs, collect_states)
+        return self.recover_fold(backend, ticked, hvs, collect_states)
+
+    def recover_fold(self, backend, ticked, hvs, collect_states):
+        """The TPraos dispatch's degradation floor (FLOW304 protector):
+        TPraos windows are dispatched through the hardfork combinator's
+        dynamic `proto.validate_batch`, which the RecoverySupervisor's
+        static ladder never sees — so the exact-host-reference rung
+        lives here. Only RECOVER-classified faults (node/exit.triage:
+        device/runtime errors, I/O, the chaos taxonomy) are absorbed,
+        only with the supervisor enabled (OCT_RECOVERY=0 restores
+        raise-through), and every fall is banked as a RecoveryEvent —
+        REFUSE/REPAIR/PROPAGATE classes surface raw, same contract as
+        `RecoverySupervisor.recover_window`."""
+        from ..obs import recovery as _recovery
+
+        try:
+            return self._device_batch(backend, ticked, hvs, collect_states)
+        except Exception as e:  # noqa: BLE001 — triaged: only RECOVER
+            # (recoverable below) is absorbed onto the host fold
+            if not (_recovery.enabled() and _recovery.recoverable(e)):
+                raise
+            lanes = len(hvs)
+            _recovery.note_recovery_event("host-fold", -1, lanes, 1, e)
+            res = self._host_fold(ticked, hvs, collect_states)
+            _recovery.note_recovery_event("recovered", -1, lanes, 1, e,
+                                          ok=True)
+            return res
+
+    def _device_batch(self, backend, ticked, hvs, collect_states):
         params, lview = self.params, ticked.ledger_view
         eta0 = ticked.state.epoch_nonce
         pre = host_prechecks(params, lview, hvs)

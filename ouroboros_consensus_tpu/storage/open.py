@@ -70,7 +70,9 @@ def default_check_integrity(raw: bytes) -> bool:
     runs batched when the analyser revalidates headers.)"""
     try:
         return Block.from_bytes(raw).check_integrity()
-    except Exception:
+    except Exception:  # octflow: disable=FLOW303 — fail-closed IS the
+        # verdict here: nodeCheckIntegrity treats any parse/hash failure
+        # as not-intact; the open-with-repair scan owns what follows
         return False
 
 

@@ -87,7 +87,9 @@ def note_repair(action: str, chunk: int = -1, kept: int = 0,
                            dropped=dropped,
                            bytes_quarantined=bytes_quarantined,
                            applied=applied, detail=detail)
-    except Exception:  # noqa: BLE001 — forensics are best-effort
+    except Exception:  # noqa: BLE001 # octflow: disable=FLOW303 — the
+        # repair row is already built; dropping the best-effort warmup
+        # mirror fabricates no verdict
         pass
     try:
         from ..protocol import batch as pbatch
@@ -100,7 +102,9 @@ def note_repair(action: str, chunk: int = -1, kept: int = 0,
                 bytes_quarantined=bytes_quarantined,
                 applied=applied, detail=detail[:200],
             ))
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 # octflow: disable=FLOW303 — the
+        # tracer mirror of the same row: best-effort telemetry, no
+        # verdict depends on it
         pass
     return row
 

@@ -176,7 +176,9 @@ def record(outcome: str, chunk: int = -1) -> None:
 
         if pbatch.BATCH_TRACER is not None:
             pbatch.BATCH_TRACER(SidecarEvent(outcome=outcome, chunk=chunk))
-    except Exception:  # noqa: BLE001 — telemetry is best-effort
+    except Exception:  # noqa: BLE001 # octflow: disable=FLOW303 — the
+        # outcome counter already ticked; only the tracer mirror is
+        # best-effort, and sidecar verdicts never depend on telemetry
         pass
 
 

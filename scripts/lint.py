@@ -21,6 +21,9 @@ ratcheted.
     python scripts/lint.py --update-sync      # re-pin the octsync
                                               #   concurrency ratchet
                                               #   (analysis/concurrency.json)
+    python scripts/lint.py --update-flow      # re-pin the octflow
+                                              #   failure-taxonomy ratchet
+                                              #   (analysis/flow.json)
 
 Exit 0 = no NEW AST findings (anything in analysis/baseline.json is
 grandfathered), every registered kernel graph within its
@@ -46,7 +49,17 @@ analysis/concurrency.py: a new unsuppressed SYNC2xx finding — lock-order
 inversion, unguarded `# guarded-by:` attribute, silent thread death,
 bare write to a protected store path — or drift in the pinned
 lock/thread/guarded inventory vs analysis/concurrency.json; pure AST,
-runs even under --no-graphs). The
+runs even under --no-graphs),
+8 = octflow failure-taxonomy ratchet violation(s) (Pass 6,
+analysis/flow.py: a new unsuppressed FLOW3xx finding — an unclassified
+raise in the durable planes, a laundered REFUSE/REPAIR class inside the
+recovery ladder, a silent broad handler on a verdict path, a device
+dispatch unreachable from a host-reference protector, a dead or
+re-entrant OCT_*=0 kill-switch lever, an unpinned anomaly re-dispatch —
+drift in the pinned raise-site/handler/rung-edge/lever inventory vs
+analysis/flow.json, or a README kill-switch row out of sync with the
+pinned lever inventory (analysis/envlevers.check_kill_switches); pure
+AST, runs even under --no-graphs). The
 ratchet files only ever shrink in normal operation — fixing a
 grandfathered finding makes its key stale, and the gate prints a
 reminder to re-run the matching --update flag so the ratchet tightens.
@@ -123,6 +136,31 @@ def _sync_selected(changed: set[str]) -> bool:
     if not changed:
         return True
     return any(f.startswith(_SYNC_PREFIXES) or f in _SYNC_FILES
+               for f in changed)
+
+
+# octflow (Pass 6) --changed trigger: the failure-routing fabric — the
+# triage table (node/exit.py), the degradation ladder (obs/ prefix
+# covers obs/recovery.py), the dispatch seams (protocol/batch.py,
+# forge.py, tpraos.py), the REFUSE-classed storage planes, the chaos
+# injection seams, and the analysis machinery itself. Any other diff
+# skips the sweep under --changed (pure AST — seconds, no jax).
+_FLOW_PREFIXES = ("ouroboros_consensus_tpu/storage/",
+                  "ouroboros_consensus_tpu/obs/",
+                  "ouroboros_consensus_tpu/analysis/")
+_FLOW_FILES = {"ouroboros_consensus_tpu/node/exit.py",
+               "ouroboros_consensus_tpu/protocol/batch.py",
+               "ouroboros_consensus_tpu/protocol/forge.py",
+               "ouroboros_consensus_tpu/protocol/tpraos.py",
+               "ouroboros_consensus_tpu/testing/chaos.py"}
+
+
+def _flow_selected(changed: set[str]) -> bool:
+    """--changed: does the diff touch the failure-routing plane? Empty
+    diff/no git -> True (conservative: the sweep is cheap)."""
+    if not changed:
+        return True
+    return any(f.startswith(_FLOW_PREFIXES) or f in _FLOW_FILES
                for f in changed)
 
 
@@ -215,6 +253,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="re-pin the octsync concurrency ratchet "
                          "(analysis/concurrency.json: grandfathered "
                          "finding keys + lock/thread/guarded inventory)")
+    ap.add_argument("--update-flow", action="store_true",
+                    help="re-pin the octflow failure-taxonomy ratchet "
+                         "(analysis/flow.json: grandfathered finding "
+                         "keys + raise-site/handler/rung-edge/lever "
+                         "inventory)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -270,6 +313,37 @@ def main(argv: list[str] | None = None) -> int:
             return 0
         sync_violations, sync_stale = concurrency.check_sync(
             sync_report, concurrency.load_baseline()
+        )
+
+    # Pass 6 (octflow): the exception-routing/degradation-lattice sweep
+    # is pure AST too — same run policy as Pass 5, own --changed map
+    from ouroboros_consensus_tpu.analysis import envlevers, flow
+
+    flow_violations: list[str] = []
+    flow_stale: list[str] = []
+    run_flow = (args.update_flow or not args.changed
+                or _flow_selected(_changed_files()))
+    if run_flow:
+        flow_report = flow.sweep_paths(
+            flow.default_roots(REPO), REPO
+        )
+        if args.update_flow:
+            payload = flow.write_baseline(flow_report)
+            print(f"flow.json updated: "
+                  f"{len(payload['findings'])} grandfathered finding(s), "
+                  f"{sum(len(v) for v in payload['inventory'].values())} "
+                  "inventory row(s)")
+            return 0
+        flow_violations, flow_stale = flow.check_flow(
+            flow_report, flow.load_baseline()
+        )
+        # the README kill-switch table and the pinned FLOW305 lever
+        # inventory must name the same levers — a documented lever the
+        # analyzer never proved guarded (or a proven lever the README
+        # forgot) is a Pass-6 violation, not a docs nit
+        flow_violations += envlevers.check_kill_switches(
+            os.path.join(REPO, "ouroboros_consensus_tpu", "obs",
+                         "README.md")
         )
 
     budget_violations: list[str] = []
@@ -443,6 +517,8 @@ def main(argv: list[str] | None = None) -> int:
             "resource_violations": resource_violations,
             "sync_violations": sync_violations,
             "stale_sync": sync_stale,
+            "flow_violations": flow_violations,
+            "stale_flow": flow_stale,
             "graphs": [r.to_dict() for r in reports],
             "certified": [r.to_dict() for r in cert_reports],
             "cost_features": [f.to_dict() | {"name": f.name}
@@ -450,7 +526,7 @@ def main(argv: list[str] | None = None) -> int:
             "changed_selection": names,
             "ok": not (new or budget_violations or cert_violations
                        or cost_violations or resource_violations
-                       or sync_violations),
+                       or sync_violations or flow_violations),
         }, indent=2, sort_keys=True))
     else:
         for f in new:
@@ -465,12 +541,17 @@ def main(argv: list[str] | None = None) -> int:
             print(f"RESOURCES: {v}")
         for v in sync_violations:
             print(f"SYNC: {v}")
+        for v in flow_violations:
+            print(f"FLOW: {v}")
         for k in stale:
             print(f"note: baseline entry no longer fires "
                   f"(run --update-baseline to ratchet): {k}")
         for k in sync_stale:
             print(f"note: concurrency baseline entry no longer fires "
                   f"(run --update-sync to ratchet): {k}")
+        for k in flow_stale:
+            print(f"note: flow baseline entry no longer fires "
+                  f"(run --update-flow to ratchet): {k}")
         if names is not None:
             print(f"--changed: {len(names)} graph(s) selected: "
                   f"{', '.join(names) or '(none)'}")
@@ -481,6 +562,7 @@ def main(argv: list[str] | None = None) -> int:
             f"{len(cost_violations)} compile-wall violation(s), "
             f"{len(resource_violations)} device-resource violation(s), "
             f"{len(sync_violations)} concurrency violation(s), "
+            f"{len(flow_violations)} flow violation(s), "
             f"{len(stale)} stale baseline entr(y/ies)"
         )
     if new:
@@ -493,7 +575,9 @@ def main(argv: list[str] | None = None) -> int:
         return 5
     if resource_violations:
         return 6
-    return 7 if sync_violations else 0
+    if sync_violations:
+        return 7
+    return 8 if flow_violations else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,39 @@
+"""octflow FLOW306 fixture: unsanctioned bare/BaseException handlers.
+
+tests/test_flow.py sweeps this with sanctioned_broad ["pump"].
+"""
+
+
+def fires(fn):
+    try:
+        return fn()
+    except BaseException:
+        return None
+
+
+def bare_fires(fn):
+    try:
+        return fn()
+    except:  # noqa: E722
+        return None
+
+
+def reraises(fn):
+    try:
+        return fn()
+    except BaseException:
+        raise
+
+
+def pump(fn, out):
+    try:
+        out.append(fn())
+    except BaseException as e:
+        out.append(e)
+
+
+def suppressed(fn):
+    try:
+        return fn()
+    except BaseException:  # octflow: disable=FLOW306 — fixture twin
+        return None

@@ -1,0 +1,47 @@
+"""octflow FLOW304 fixture: holes in the degradation lattice.
+
+tests/test_flow.py sweeps this with ladder module "flow_lattice",
+router "RecoverySupervisor._run_rung", terminal "host_reference_fold",
+dispatch functions ["run_batch"] and protectors ["recover_window"].
+"""
+
+LADDERS = {
+    "device": ("retry", "host-reference"),
+    "ghost": ("missing-rung", "host-reference"),
+    "floorless": ("retry",),
+}
+
+
+def run_batch(xs):
+    return xs
+
+
+def host_reference_fold(xs):
+    return xs
+
+
+class RecoverySupervisor:
+    def _run_rung(self, rung, xs):
+        if rung == "retry":
+            return run_batch(xs)
+        if rung == "host-reference":
+            return host_reference_fold(xs)
+        raise ValueError(rung)
+
+    def recover_window(self, xs):
+        return self._run_rung("retry", xs)
+
+
+def uncovered_dispatch(xs):
+    return run_batch(xs)
+
+
+def covered_dispatch(xs):
+    sup = RecoverySupervisor()
+    if not xs:
+        return sup.recover_window(xs)
+    return run_batch(xs)
+
+
+def suppressed_dispatch(xs):
+    return run_batch(xs)  # octflow: disable=FLOW304 — fixture twin

@@ -1,0 +1,49 @@
+"""octflow FLOW302 fixture: the recovery ladder laundering REFUSE.
+
+tests/test_flow.py sweeps this with the three recover_window* ladder
+roots — the PR 13 bug shape: the ladder absorbing a quarantine refusal.
+"""
+
+
+class Disposition:
+    REFUSE = "refuse"
+
+
+class QuarantineError(Exception):
+    pass
+
+
+DISPOSITIONS = {
+    "QuarantineError": Disposition.REFUSE,
+}
+
+
+def triage(exc):
+    return DISPOSITIONS.get(type(exc).__name__)
+
+
+def _rung(fn):
+    return fn()
+
+
+def recover_window(fn):
+    try:
+        return _rung(fn)
+    except QuarantineError:
+        return None
+
+
+def recover_window_triaged(fn):
+    try:
+        return _rung(fn)
+    except QuarantineError as e:
+        if triage(e) == "refuse":
+            raise
+        return None
+
+
+def recover_window_suppressed(fn):
+    try:
+        return _rung(fn)
+    except QuarantineError:  # octflow: disable=FLOW302 — fixture twin
+        return None

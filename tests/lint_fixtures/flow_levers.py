@@ -1,0 +1,31 @@
+"""octflow FLOW305 fixture: kill-switch integrity.
+
+tests/test_flow.py sweeps this with kill_switches ["OCT_FX_DEAD",
+"OCT_FX_DEAD_SUPP", "OCT_FX_GOOD", "OCT_FX_REENTER"].
+"""
+
+import os
+
+DEAD = os.environ.get("OCT_FX_DEAD", "1")
+DEAD_SUPP = os.environ.get("OCT_FX_DEAD_SUPP", "1")  # octflow: disable=FLOW305 — fixture twin
+
+
+def _impl(xs):
+    return xs
+
+
+def _fallback(xs):
+    return list(xs)
+
+
+def good(xs):
+    if os.environ.get("OCT_FX_GOOD", "1") != "0":
+        return _impl(xs)
+    return _fallback(xs)
+
+
+def reenter(xs):
+    if os.environ.get("OCT_FX_REENTER", "1") != "0":
+        return _impl(xs)
+    else:
+        return _impl(xs)

@@ -1,0 +1,51 @@
+"""octflow FLOW301 fixture: unclassified raise sites.
+
+Swept by tests/test_flow.py with raise_scope [""] — every line here is
+in the crash/verdict-bearing plane for the fixture sweep.
+"""
+
+
+class Disposition:
+    REFUSE = "refuse"
+    RECOVER = "recover"
+
+
+class ClassifiedError(Exception):
+    pass
+
+
+class ChildError(ClassifiedError):
+    pass
+
+
+class OddError(Exception):
+    pass
+
+
+DISPOSITIONS = {
+    "ClassifiedError": Disposition.REFUSE,
+}
+
+
+def fires():
+    raise OddError("no DISPOSITIONS row")
+
+
+def classified_ok():
+    raise ClassifiedError("has a row")
+
+
+def ancestor_ok():
+    raise ChildError("classified through its ClassifiedError base")
+
+
+def builtin_ok():
+    raise ValueError("exempt builtin")
+
+
+def variable_ok(err):
+    raise err  # class unknowable statically: FLOW301 stays silent
+
+
+def suppressed():
+    raise OddError("x")  # octflow: disable=FLOW301 — fixture twin

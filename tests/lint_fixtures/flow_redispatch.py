@@ -1,0 +1,21 @@
+"""octflow FLOW307 fixture: re-dispatch drifting off its pinned route.
+
+tests/test_flow.py sweeps this with redispatch_pins on materialize /
+routed / drifted_suppressed / gone_fn.
+"""
+
+
+def reference_fold(xs):
+    return xs
+
+
+def materialize(xs):
+    return [x + 1 for x in xs]
+
+
+def routed(xs):
+    return reference_fold(xs)
+
+
+def drifted_suppressed(xs):  # octflow: disable=FLOW307 — fixture twin
+    return xs

@@ -1,0 +1,8 @@
+"""octflow FLOW308 fixture: a suppression that suppresses nothing.
+
+Swept with the base fixture config by tests/test_flow.py.
+"""
+
+
+def clean(xs):
+    return list(xs)  # octflow: disable=FLOW303 — nothing fires here

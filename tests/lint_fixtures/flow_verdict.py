@@ -1,0 +1,33 @@
+"""octflow FLOW303 fixture: silent verdict fabrication.
+
+tests/test_flow.py sweeps this with the validate_chain* functions as
+verdict roots and raise_scope [""].
+"""
+
+
+def _device_step(x):
+    return x + 1
+
+
+def validate_chain(xs):
+    out = []
+    for x in xs:
+        try:
+            out.append(_device_step(x))
+        except Exception:
+            pass
+    return out
+
+
+def validate_chain_forwarding(xs):
+    try:
+        return [_device_step(x) for x in xs], None
+    except Exception as e:
+        return [], e
+
+
+def validate_chain_suppressed(xs):
+    try:
+        return [_device_step(x) for x in xs]
+    except Exception:  # octflow: disable=FLOW303 — fixture twin
+        return []

@@ -22,7 +22,7 @@ import os
 
 import pytest
 
-from ouroboros_consensus_tpu.analysis import concurrency, envlevers
+from ouroboros_consensus_tpu.analysis import concurrency, envlevers, flow
 from ouroboros_consensus_tpu.analysis.__main__ import main as analysis_cli
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -221,6 +221,10 @@ def test_lint_exits_7_on_seeded_violation(monkeypatch):
     lint = _load_lint()
     seeded = [os.path.join(FIXTURES, "sync_stale.py")]
     monkeypatch.setattr(concurrency, "default_roots", lambda repo: seeded)
+    # scope the Pass-6 sweep to the same tiny file — exit 7 wins the
+    # cascade regardless, and the whole-tree flow sweep is pinned by
+    # test_flow.py's tree gate
+    monkeypatch.setattr(flow, "default_roots", lambda repo=None: seeded)
     assert lint.main(["--no-graphs"]) == 7
     # an unrelated --changed diff skips the sweep: exit 0 even with
     # the poisoned roots

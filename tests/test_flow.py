@@ -1,0 +1,495 @@
+"""octflow tier-1 gate (Pass 6): exception-routing & degradation-
+lattice checkers.
+
+Four layers, mirroring test_concurrency.py:
+  1. fixture coverage — every FLOW rule fires on its purpose-built
+     positive at the EXACT pinned (file, line) and honors its
+     suppressed twin (tests/lint_fixtures/flow_*.py);
+  2. the tree gate — zero unsuppressed findings over the shipped
+     default roots, and the flow.json ratchet round-trips clean;
+  3. the wiring — scripts/lint.py exits 8 on a seeded violation and
+     maps --changed diffs onto the sweep; the `flow` subcommand's
+     sorted-keys --json is byte-stable and exits 8 on its own;
+  4. the routing the analyzer certifies — node/exit.triage()'s
+     DISPOSITIONS table (one assertion per taxonomy row) and
+     TPraosProtocol.recover_fold's degradation floor (the FLOW304
+     remediation: a RECOVER-class device fault lands on _host_fold,
+     everything else surfaces raw).
+
+The kill-switch drift gate (analysis/envlevers.check_kill_switches)
+rides along: the obs/README.md `=0` rows must match the FLOW305 lever
+inventory pinned in analysis/flow.json in both directions.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from ouroboros_consensus_tpu.analysis import envlevers, flow
+from ouroboros_consensus_tpu.analysis.__main__ import main as analysis_cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "lint_gate_flow", os.path.join(REPO, "scripts", "lint.py")
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    return lint
+
+
+def _cfg(**over):
+    """A self-contained flow_roots table for fixture sweeps: everything
+    in raise scope, no ladder/levers/pins unless the fixture opts in."""
+    base = {
+        "raise_scope": [""],
+        "dispositions_table": "DISPOSITIONS",
+        "builtin_exempt": ["ValueError", "TypeError"],
+        "ladder": {"module": "", "table": "LADDERS", "router": "",
+                   "terminal": "", "roots": []},
+        "verdict_roots": [],
+        "dispatch": {"functions": [], "protectors": [], "exclude": []},
+        "kill_switches": [],
+        "sanctioned_broad": [],
+        "redispatch_pins": {},
+    }
+    base.update(over)
+    return base
+
+
+_FIXTURE_CFGS = {
+    "flow_raise": _cfg(),
+    "flow_launder": _cfg(ladder={
+        "module": "", "table": "LADDERS", "router": "", "terminal": "",
+        "roots": ["recover_window", "recover_window_triaged",
+                  "recover_window_suppressed"],
+    }),
+    "flow_verdict": _cfg(verdict_roots=[
+        "validate_chain", "validate_chain_forwarding",
+        "validate_chain_suppressed",
+    ]),
+    "flow_lattice": _cfg(
+        ladder={"module": "flow_lattice", "table": "LADDERS",
+                "router": "RecoverySupervisor._run_rung",
+                "terminal": "host_reference_fold", "roots": []},
+        dispatch={"functions": ["run_batch"],
+                  "protectors": ["recover_window"], "exclude": []},
+    ),
+    "flow_levers": _cfg(kill_switches=[
+        "OCT_FX_DEAD", "OCT_FX_DEAD_SUPP", "OCT_FX_GOOD",
+        "OCT_FX_REENTER",
+    ]),
+    "flow_broad": _cfg(sanctioned_broad=["pump"]),
+    "flow_redispatch": _cfg(redispatch_pins={
+        "flow_redispatch.materialize": ["reference_fold"],
+        "flow_redispatch.routed": ["reference_fold"],
+        "flow_redispatch.drifted_suppressed": ["reference_fold"],
+        "flow_redispatch.gone_fn": ["reference_fold"],
+    }),
+    "flow_stale": _cfg(),
+}
+
+
+def _sweep_fixture(name):
+    rep = flow.sweep_paths(
+        [os.path.join(FIXTURES, f"{name}.py")], rel_to=FIXTURES,
+        roots_table=_FIXTURE_CFGS[name],
+    )
+    return rep.findings
+
+
+# ---------------------------------------------------------------------------
+# 1 — fixtures: exact (rule, line) pins per seeded violation
+# ---------------------------------------------------------------------------
+
+# (fixture module, unsuppressed (rule, line) pins, suppressed pins)
+_FIXTURE_PINS = [
+    ("flow_raise", [("FLOW301", 31)], [("FLOW301", 51)]),
+    ("flow_launder", [("FLOW302", 32)], [("FLOW302", 48)]),
+    ("flow_verdict", [("FLOW303", 17)], [("FLOW303", 32)]),
+    ("flow_lattice",
+     [("FLOW304", 24), ("FLOW304", 36)], [("FLOW304", 47)]),
+    ("flow_levers",
+     [("FLOW305", 9), ("FLOW305", 28)], [("FLOW305", 10)]),
+    ("flow_broad",
+     [("FLOW306", 10), ("FLOW306", 17)], [("FLOW306", 38)]),
+    ("flow_redispatch",
+     [("FLOW307", 0), ("FLOW307", 12)], [("FLOW307", 20)]),
+    ("flow_stale", [("FLOW308", 8)], []),
+]
+
+
+@pytest.mark.parametrize(
+    "name,fired,suppressed", _FIXTURE_PINS,
+    ids=[p[0] for p in _FIXTURE_PINS],
+)
+def test_fixture_exact_findings(name, fired, suppressed):
+    """Set equality, not subset: a fixture firing anything beyond its
+    pins means a checker regressed into noise."""
+    found = _sweep_fixture(name)
+    assert {(f.rule, f.line) for f in found if not f.suppressed} \
+        == set(fired)
+    assert {(f.rule, f.line) for f in found if f.suppressed} \
+        == set(suppressed)
+
+
+def test_every_flow_rule_represented():
+    all_rules = {r for _, fired, _ in _FIXTURE_PINS for r, _ in fired}
+    assert all_rules == set(flow.RULES)
+
+
+def test_suppressed_twin_for_every_suppressible_rule():
+    # FLOW308 is the suppression audit itself — the one rule without a
+    # suppressed twin in the fixture set
+    twinned = {r for _, _, sup in _FIXTURE_PINS for r, _ in sup}
+    assert twinned == set(flow.RULES) - {"FLOW308"}
+
+
+def test_lattice_reports_both_hole_kinds():
+    msgs = [f.message for f in _sweep_fixture("flow_lattice")
+            if f.line == 24]
+    # line 24 carries BOTH wellformedness holes: the unrouted ghost
+    # rung and the floorless chain missing its terminal
+    assert any("missing-rung" in m and "no branch" in m for m in msgs)
+    assert any("floorless" in m and "host_reference_fold" in m
+               for m in msgs)
+
+
+def test_levers_reports_dead_and_reentry():
+    by_line = {f.line: f.message for f in _sweep_fixture("flow_levers")
+               if not f.suppressed}
+    assert "OCT_FX_DEAD" in by_line[9] and "dead lever" in by_line[9]
+    assert "OCT_FX_REENTER" in by_line[28] \
+        and "identical callees" in by_line[28]
+
+
+def test_redispatch_missing_function_vs_missing_callee():
+    found = [f for f in _sweep_fixture("flow_redispatch")
+             if not f.suppressed]
+    by_line = {f.line: f.message for f in found}
+    assert "gone_fn" in by_line[0] and "no longer exists" in by_line[0]
+    assert "reference_fold" in by_line[12]
+
+
+def test_standalone_comment_does_not_suppress():
+    src = (
+        "def f(fn):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    # octflow: disable=FLOW306\n"
+        "    except BaseException:\n"
+        "        return None\n"
+    )
+    found = flow.sweep_source(src, "scopes", roots_table=_cfg())
+    by_rule = {f.rule: f for f in found}
+    # the comment line above the handler suppresses nothing — the
+    # grammar is line-exact (finding line or def line only) — so the
+    # finding fires AND the comment is audited as stale
+    assert not by_rule["FLOW306"].suppressed
+    assert by_rule["FLOW308"].line == 4
+
+
+def test_def_line_suppression_scopes_whole_function():
+    src = (
+        "def f(fn):  # octflow: disable=FLOW306\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except BaseException:\n"
+        "        return None\n"
+    )
+    found = flow.sweep_source(src, "scopes", roots_table=_cfg())
+    assert [f.rule for f in found] == ["FLOW306"]
+    assert found[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# 2 — the tree gate + ratchet round-trip
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tree_report():
+    return flow.sweep_paths(flow.default_roots(REPO), REPO)
+
+
+def test_tree_has_no_unsuppressed_findings(tree_report):
+    bad = [f.format() for f in tree_report.findings if not f.suppressed]
+    assert not bad, "\n".join(bad)
+
+
+def test_every_kill_switch_guards_something(tree_report):
+    # the FLOW305 analysis proved every documented `=0` lever gates at
+    # least one branch — a zero here is a dead lever the rule missed
+    for entry in tree_report.inventory["levers"]:
+        name, guards = entry.split(":guards=")
+        assert int(guards) > 0, f"{name} pinned with zero guard sites"
+
+
+def test_ratchet_round_trips_clean(tree_report):
+    violations, stale = flow.check_flow(tree_report, flow.load_baseline())
+    assert violations == []
+    assert stale == []
+
+
+def test_shipped_baseline_matches_payload(tree_report):
+    payload = flow.baseline_payload(tree_report)
+    shipped = flow.load_baseline()
+    assert payload["findings"] == shipped["findings"] == []
+    assert payload["inventory"] == shipped["inventory"]
+
+
+def test_inventory_drift_is_a_violation(tree_report):
+    base = json.loads(json.dumps(flow.load_baseline()))
+    base["inventory"]["handlers"] = base["inventory"]["handlers"][:-1]
+    violations, _ = flow.check_flow(tree_report, base)
+    assert any("inventory drift in `handlers`" in v for v in violations)
+
+
+def test_new_finding_is_a_violation_and_keys_are_line_free():
+    found = _sweep_fixture("flow_broad")
+    rep = flow.FlowReport(found, flow.load_baseline().get("inventory", {}))
+    violations, _ = flow.check_flow(rep, flow.load_baseline())
+    assert any("FLOW306" in v and "bare_fires" in v for v in violations)
+    # ratchet keys carry rule::path::message, never line numbers — a
+    # pure-whitespace shift above a grandfathered finding cannot
+    # resurrect it
+    for f in found:
+        assert f"::{f.line}" not in f.key()
+
+
+# ---------------------------------------------------------------------------
+# 3 — wiring: lint.py exit 8, --changed mapping, flow subcommand
+# ---------------------------------------------------------------------------
+
+
+def test_lint_changed_maps_failure_plane_to_sweep():
+    lint = _load_lint()
+    assert lint._flow_selected({"ouroboros_consensus_tpu/node/exit.py"})
+    assert lint._flow_selected({"ouroboros_consensus_tpu/obs/recovery.py"})
+    assert lint._flow_selected({"ouroboros_consensus_tpu/protocol/batch.py"})
+    assert lint._flow_selected({"ouroboros_consensus_tpu/protocol/tpraos.py"})
+    assert lint._flow_selected({"ouroboros_consensus_tpu/storage/repair.py"})
+    assert lint._flow_selected({"ouroboros_consensus_tpu/testing/chaos.py"})
+    assert lint._flow_selected({"ouroboros_consensus_tpu/analysis/flow_roots.json"})
+    assert not lint._flow_selected({"README.md"})
+    assert not lint._flow_selected({"ouroboros_consensus_tpu/ops/pk/msm.py"})
+    # empty diff / no git -> conservative full sweep
+    assert lint._flow_selected(set())
+
+
+def test_lint_exits_8_on_seeded_violation(monkeypatch, capsys):
+    """End to end through scripts/lint.py main(): poison the octflow
+    roots with the FLOW302 corruption-laundering fixture (the PR 13
+    bug shape), assert the NEW exit code, and assert --changed on an
+    unrelated diff skips the sweep entirely. Driven through --changed
+    so the sync/octlint passes stay scoped to one file — the full-run
+    selection logic (`not args.changed` -> sweep) is pinned by
+    test_lint_changed_maps_failure_plane_to_sweep's empty-diff case."""
+    lint = _load_lint()
+    seeded = [os.path.join(FIXTURES, "flow_launder.py")]
+    monkeypatch.setattr(flow, "default_roots", lambda repo=None: seeded)
+    monkeypatch.setattr(
+        flow, "load_roots", lambda: _FIXTURE_CFGS["flow_launder"])
+    # an unrelated --changed diff skips the sweep: exit 0 even with
+    # the poisoned roots
+    monkeypatch.setattr(lint, "_changed_files", lambda: {"README.md"})
+    assert lint.main(["--no-graphs", "--changed"]) == 0
+    capsys.readouterr()
+    # a failure-plane diff selects it: the laundering handler fails
+    # the gate with the Pass-6 exit code
+    monkeypatch.setattr(
+        lint, "_changed_files",
+        lambda: {"ouroboros_consensus_tpu/node/exit.py"},
+    )
+    assert lint.main(["--no-graphs", "--changed"]) == 8
+    assert "FLOW302" in capsys.readouterr().out
+
+
+def test_flow_subcommand_exit_and_json_byte_stable(capsys):
+    fixture = os.path.join(FIXTURES, "flow_stale.py")
+    # findings not in the shipped ratchet -> the distinct exit code
+    assert analysis_cli(["flow", "--paths", fixture]) == 8
+    capsys.readouterr()
+    # --no-ratchet reports without enforcing
+    assert analysis_cli(["flow", "--paths", fixture, "--no-ratchet"]) == 0
+    capsys.readouterr()
+    assert analysis_cli(
+        ["flow", "--paths", fixture, "--no-ratchet", "--json"]
+    ) == 0
+    first = capsys.readouterr().out
+    assert analysis_cli(
+        ["flow", "--paths", fixture, "--no-ratchet", "--json"]
+    ) == 0
+    second = capsys.readouterr().out
+    assert first == second  # byte-stable for CI diffing
+    doc = json.loads(first)
+    assert doc["ok"] is True
+    assert [(f["rule"], f["line"]) for f in doc["findings"]] \
+        == [("FLOW308", 8)]
+
+
+def test_flow_subcommand_clean_tree_exits_0(tree_report, monkeypatch,
+                                            capsys):
+    # reuse the module fixture's whole-tree sweep (the sweep itself is
+    # pinned by the tree-gate layer above) and drive the subcommand's
+    # ratchet check + JSON emit + exit-code logic over the real report
+    monkeypatch.setattr(flow, "sweep_paths",
+                        lambda *a, **k: tree_report)
+    assert analysis_cli(["flow", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is True and doc["findings"] == []
+    assert doc["inventory"] == flow.load_baseline()["inventory"]
+
+
+# ---------------------------------------------------------------------------
+# kill-switch drift gate (analysis/envlevers.check_kill_switches)
+# ---------------------------------------------------------------------------
+
+
+def test_kill_switch_rows_match_pinned_inventory():
+    violations = envlevers.check_kill_switches()
+    assert not violations, "\n".join(violations)
+
+
+def test_kill_switch_gate_catches_both_directions(tmp_path):
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "## Levers\n\n"
+        "| Env | Effect |\n|---|---|\n"
+        "| `OCT_FAKE_KILL=0` | documented but never pinned |\n"
+        "| `OCT_CHECKPOINT=<file>` | a value lever: not a kill-switch |\n"
+    )
+    base = {"inventory": {"levers": ["OCT_STALE_PIN:guards=3"]}}
+    out = envlevers.check_kill_switches(str(readme), base)
+    assert any("OCT_FAKE_KILL" in v and "no FLOW305" in v for v in out)
+    assert any("OCT_STALE_PIN" in v and "stale pin" in v for v in out)
+    assert not any("OCT_CHECKPOINT" in v for v in out)
+
+
+def test_kill_switch_subset_of_documented_levers():
+    kills = envlevers.kill_switch_levers()
+    assert kills <= envlevers.documented_levers()
+    # the pinned inventory and the README agree on the exact set
+    pinned = {e.split(":", 1)[0]
+              for e in flow.load_baseline()["inventory"]["levers"]}
+    assert pinned == kills
+
+
+# ---------------------------------------------------------------------------
+# 4 — the routing octflow certifies: triage() + recover_fold
+# ---------------------------------------------------------------------------
+
+
+def test_dispositions_table_routes_every_row():
+    from ouroboros_consensus_tpu.node import exit as node_exit
+
+    D = node_exit.Disposition
+    want = {
+        "REFUSE": D.REFUSE, "REPAIR": D.REPAIR,
+        "RECOVER": D.RECOVER, "PROPAGATE": D.PROPAGATE,
+    }
+    for name, dispo in node_exit.DISPOSITIONS.items():
+        assert dispo in want.values(), name
+    # one live-class probe per disposition, through the real triage()
+    from ouroboros_consensus_tpu.protocol.praos import PraosValidationError
+    from ouroboros_consensus_tpu.storage.guard import DbLocked
+    from ouroboros_consensus_tpu.storage.immutable import ImmutableDBError
+    from ouroboros_consensus_tpu.testing.chaos import ChaosError
+
+    assert node_exit.triage(DbLocked("x")) is D.REFUSE
+    assert node_exit.triage(ImmutableDBError("x")) is D.REPAIR
+    assert node_exit.triage(ChaosError("x")) is D.RECOVER
+    assert node_exit.triage(PraosValidationError("x")) is D.PROPAGATE
+
+
+def test_triage_walks_the_mro():
+    from ouroboros_consensus_tpu.node import exit as node_exit
+
+    D = node_exit.Disposition
+
+    class SubLocked(Exception):
+        pass
+
+    # a subclass of a classified type inherits the row through __mro__
+    from ouroboros_consensus_tpu.storage.guard import DbLocked
+
+    class Derived(DbLocked):
+        pass
+
+    assert node_exit.triage(Derived("x")) is D.REFUSE
+    # an unclassified tree falls to PROPAGATE, never a silent default
+    assert node_exit.triage(SubLocked("x")) is D.PROPAGATE
+
+
+def test_triage_routes_xla_runtime_by_name():
+    from ouroboros_consensus_tpu.node import exit as node_exit
+
+    class XlaRuntimeError(Exception):  # jaxlib spells it this way
+        pass
+
+    assert node_exit.triage(XlaRuntimeError("RESOURCE_EXHAUSTED")) \
+        is node_exit.Disposition.RECOVER
+
+
+def _bare_tpraos():
+    from ouroboros_consensus_tpu.protocol import tpraos
+
+    return object.__new__(tpraos.TPraosProtocol)
+
+
+def test_recover_fold_degrades_recover_class_to_host_fold(monkeypatch):
+    from ouroboros_consensus_tpu.testing.chaos import ChaosError
+
+    proto = _bare_tpraos()
+    events = []
+
+    def boom(backend, ticked, hvs, collect_states):
+        raise ChaosError("injected device fault")
+
+    proto._device_batch = boom
+    proto._host_fold = lambda ticked, hvs, collect: ("host", hvs)
+    from ouroboros_consensus_tpu.obs import recovery
+    monkeypatch.setattr(
+        recovery, "note_recovery_event",
+        lambda *a, **k: events.append(a[0]),
+    )
+    out = proto.recover_fold("native", None, [1, 2], False)
+    assert out == ("host", [1, 2])
+    assert events == ["host-fold", "recovered"]
+
+
+def test_recover_fold_surfaces_propagate_class(monkeypatch):
+    from ouroboros_consensus_tpu.protocol.praos import PraosValidationError
+
+    proto = _bare_tpraos()
+
+    def boom(backend, ticked, hvs, collect_states):
+        raise PraosValidationError("wrong, not broken")
+
+    proto._device_batch = boom
+    proto._host_fold = lambda *a: pytest.fail(
+        "PROPAGATE-class fault must never reach the host fold")
+    with pytest.raises(PraosValidationError):
+        proto.recover_fold("native", None, [1], False)
+
+
+def test_recover_fold_respects_the_kill_switch(monkeypatch):
+    from ouroboros_consensus_tpu.testing.chaos import ChaosError
+
+    proto = _bare_tpraos()
+
+    def boom(backend, ticked, hvs, collect_states):
+        raise ChaosError("injected device fault")
+
+    proto._device_batch = boom
+    proto._host_fold = lambda *a: pytest.fail(
+        "OCT_RECOVERY=0 must restore raise-through")
+    monkeypatch.setenv("OCT_RECOVERY", "0")
+    with pytest.raises(ChaosError):
+        proto.recover_fold("native", None, [1], False)
