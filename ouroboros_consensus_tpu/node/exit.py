@@ -64,6 +64,7 @@ DISPOSITIONS: dict[str, Disposition] = {
     "KESKeyExpired": Disposition.REFUSE,      # forging with a dead key
     "KESBeforeStart": Disposition.REFUSE,     # cert not yet valid
     "OperationalCertIssueError": Disposition.REFUSE,
+    "AdmissionRefused": Disposition.REFUSE,   # malformed serve submission
     # REPAIR — on-disk corruption the open-with-repair scan owns;
     # never absorbed by the per-window ladder, never masked
     "ImmutableDBError": Disposition.REPAIR,   # + MissingBlock subclass

@@ -102,6 +102,17 @@ class Histogram:
 
     @property
     def count(self) -> int:
+        """Total observations. Takes the registry lock: `counts` is
+        mutated under it by concurrent observe()/observe_many(), so an
+        unlocked sum could tear against a mid-flight bincount add (the
+        SLO endpoint scrapes while the serving scheduler observes)."""
+        with self._lock:
+            return self._count_locked()
+
+    def _count_locked(self) -> int:
+        # caller holds self._lock (exposition renders under it and the
+        # shared lock is non-reentrant, so the public property would
+        # deadlock — same split as _snapshot_locked/_expose_text_locked)
         return int(self.counts.sum())
 
     def observe(self, value: float) -> None:
@@ -141,8 +152,19 @@ class Histogram:
         estimate). None when empty — NEVER NaN: a NaN here would ride
         the p50/p99 fields of `snapshot()` into the bench round JSON
         and break strict-JSON consumers. The +Inf bucket clamps to the
-        last finite bound."""
-        total = self.count
+        last finite bound.
+
+        Takes the registry lock: the cumsum must see one consistent
+        `counts` array, not a row torn against a concurrent observe —
+        the recorder's `latency_summary()` and the serve SLO snapshot
+        both call this from scrape threads while the run observes."""
+        with self._lock:
+            return self._quantile_locked(q)
+
+    def _quantile_locked(self, q: float) -> float | None:
+        # caller holds self._lock (non-reentrant; snapshot() renders
+        # every child's p50/p99 under it)
+        total = self._count_locked()
         if total == 0:
             return None
         rank = q * total
@@ -274,14 +296,15 @@ class MetricsRegistry:
                         out.append(f"{name}_bucket{_label_str(le)} {cum}")
                     le = dict(labels)
                     le["le"] = "+Inf"
+                    n = child._count_locked()
                     out.append(
-                        f"{name}_bucket{_label_str(le)} {child.count}"
+                        f"{name}_bucket{_label_str(le)} {n}"
                     )
                     out.append(
                         f"{name}_sum{_label_str(labels)} {_fmt(child.sum)}"
                     )
                     out.append(
-                        f"{name}_count{_label_str(labels)} {child.count}"
+                        f"{name}_count{_label_str(labels)} {n}"
                     )
                 else:
                     out.append(
@@ -303,7 +326,7 @@ class MetricsRegistry:
                 if isinstance(child, Histogram):
                     rows.append({
                         "labels": labels,
-                        "count": child.count,
+                        "count": child._count_locked(),
                         "sum": child.sum,
                         **({"dropped_nonfinite": child.dropped_nonfinite}
                            if child.dropped_nonfinite else {}),
@@ -312,8 +335,8 @@ class MetricsRegistry:
                             for u, c in zip(child.uppers, child.counts)
                         },
                         "inf": int(child.counts[-1]),
-                        "p50": child.quantile(0.5),
-                        "p99": child.quantile(0.99),
+                        "p50": child._quantile_locked(0.5),
+                        "p99": child._quantile_locked(0.99),
                     })
                 else:
                     rows.append({"labels": labels, "value": child.value})

@@ -15,6 +15,12 @@ Routes (minimal HTTP/1.0, no dependencies):
     GET /healthz        the live heartbeat document (obs/live.py)
     GET /progress       compact progress twin: phase / headers /
                         headers_per_s / age_s / window_index
+    GET /slo            serving-plane SLO document (node/serve.py
+                        `ValidationService.slo_snapshot`): p50/p99
+                        verdict latency, aggregate headers/s, queue
+                        depths, degraded-mode flag + intervals. 404
+                        when no serving plane is mounted (`slo_doc`
+                        unset) — replays have no SLO surface.
 
 Every request increments `oct_metrics_scrapes_total{path=}` (label
 values are the FIXED route names, never wire input)."""
@@ -54,7 +60,7 @@ def _live_doc(live_doc) -> dict:
     return live.live_snapshot()
 
 
-def handle_path(path: str, registry=None, live_doc=None):
+def handle_path(path: str, registry=None, live_doc=None, slo_doc=None):
     """Route one GET -> (status: bytes, content-type: bytes, body:
     bytes). Shared by the asyncio and threaded servers so the two can
     never drift."""
@@ -81,8 +87,15 @@ def handle_path(path: str, registry=None, live_doc=None):
         doc = _live_doc(live_doc)
         slim = {k: doc.get(k) for k in _PROGRESS_KEYS if k in doc}
         return (b"200 OK", b"application/json", json.dumps(slim).encode())
+    if path.startswith("/slo"):
+        scrapes.labels(path="/slo").inc()
+        if slo_doc is None:
+            return (b"404 Not Found", b"text/plain",
+                    b"no serving plane mounted\n")
+        return (b"200 OK", b"application/json",
+                json.dumps(slo_doc()).encode())
     return (b"404 Not Found", b"text/plain",
-            b"try /metrics /metrics.json /healthz /progress\n")
+            b"try /metrics /metrics.json /healthz /progress /slo\n")
 
 
 def _render(status: bytes, ctype: bytes, body: bytes) -> bytes:
@@ -97,7 +110,7 @@ def _render(status: bytes, ctype: bytes, body: bytes) -> bytes:
 
 
 async def serve_metrics(host: str = "127.0.0.1", port: int = 9100,
-                        registry=None, live_doc=None):
+                        registry=None, live_doc=None, slo_doc=None):
     """Minimal HTTP/1.0 responder over asyncio — the cardano-node
     EKG/Prometheus bridge analog. `port=0` binds ephemeral (tests)."""
     import asyncio
@@ -112,7 +125,8 @@ async def serve_metrics(host: str = "127.0.0.1", port: int = 9100,
             parts = req.split()
             path = (parts[1].decode("ascii", "replace")
                     if len(parts) > 1 else "/")
-            writer.write(_render(*handle_path(path, registry, live_doc)))
+            writer.write(_render(*handle_path(
+                path, registry, live_doc, slo_doc)))
             await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
@@ -133,11 +147,12 @@ class MetricsServer:
     `port=0` binds ephemeral; `.port` reports the bound port."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 registry=None, live_doc=None):
+                 registry=None, live_doc=None, slo_doc=None):
         import socket
 
         self.registry = registry
         self.live_doc = live_doc
+        self.slo_doc = slo_doc
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -172,7 +187,7 @@ class MetricsServer:
                 path = (parts[1].decode("ascii", "replace")
                         if len(parts) > 1 else "/")
                 conn.sendall(_render(*handle_path(
-                    path, self.registry, self.live_doc
+                    path, self.registry, self.live_doc, self.slo_doc
                 )))
             except OSError:
                 pass  # a broken scrape never breaks the replay
@@ -209,7 +224,8 @@ class MetricsServer:
 
 
 def start_in_thread(port: int | None = None, host: str = "127.0.0.1",
-                    registry=None, live_doc=None) -> MetricsServer | None:
+                    registry=None, live_doc=None,
+                    slo_doc=None) -> MetricsServer | None:
     """Mount the thread-hosted endpoint on `port` (default: the
     OCT_METRICS_PORT lever; None/unset -> no server). Fail-soft: a
     port already in use logs to stderr and returns None rather than
@@ -221,11 +237,12 @@ def start_in_thread(port: int | None = None, host: str = "127.0.0.1",
         return None
     try:
         srv = MetricsServer(host=host, port=port, registry=registry,
-                            live_doc=live_doc)
+                            live_doc=live_doc, slo_doc=slo_doc)
     except OSError as e:
         print(f"# obs/server: cannot bind metrics port {port}: {e}",
               file=sys.stderr)
         return None
     print(f"# obs/server: live metrics on http://{srv.host}:{srv.port}"
-          "/metrics (/metrics.json /healthz /progress)", file=sys.stderr)
+          "/metrics (/metrics.json /healthz /progress /slo)",
+          file=sys.stderr)
     return srv

@@ -93,6 +93,22 @@ the next block is forged):
                                   store reopens dirty and resume=True
                                   must converge byte-identically
 
+Serving-plane faults (PR 20) land at the continuous-batching
+scheduler's seams (`node/serve.py`): the shared-window dispatch and
+the per-retired-window checkpoint:
+
+    device-error@serve-dispatch:2 raise DeviceChaosError at the 3rd
+                                  shared serving window's dispatch;
+                                  every affected tenant segment sheds
+                                  down the recovery ladder (degraded-
+                                  mode serving, byte-identical verdicts,
+                                  no tenant dropped)
+    sigkill@serve:10              SIGKILL self right after the 11th
+                                  serving window's checkpoint lands —
+                                  the relaunched service resumes every
+                                  tenant's fold state and banked
+                                  verdicts from the progress record
+
 Triggers are matched against per-seam sequence counters (each seam
 counts its own firings from 0 in dispatch order) or, for ``stage:``,
 by substring against the stage label. Each injection fires EXACTLY
@@ -148,9 +164,10 @@ FAULT_KINDS = (
 # at a seam its fault kind does not model
 _KIND_SITES = {
     "compile-stall": ("dispatch", "stage-call"),
-    "device-error": ("dispatch", "stage-call", "shard", "forge-dispatch"),
+    "device-error": ("dispatch", "stage-call", "shard", "forge-dispatch",
+                     "serve-dispatch"),
     "staging-thread-death": ("stage",),
-    "sigkill": ("retire", "append", "sidecar-build", "forge"),
+    "sigkill": ("retire", "append", "sidecar-build", "forge", "serve"),
     "chunk-corrupt": ("chunk",),
     "aot-reject": ("aot",),
     "probe-timeout": ("probe",),
@@ -185,6 +202,8 @@ _SITE_TRIGGER_KEYS = {
     "sidecar-open": ("open", "chunk"),
     "forge": ("forge",),
     "forge-dispatch": ("forge-dispatch",),
+    "serve": ("serve",),
+    "serve-dispatch": ("serve-dispatch",),
 }
 
 
@@ -481,6 +500,8 @@ _SITE_SEQ_KEYS = {
     "sidecar-open": ("open",),  # one freshness probe per seq
     "forge": ("forge",),  # one forged-block retire per seq
     "forge-dispatch": ("forge-dispatch",),  # one election dispatch/seq
+    "serve": ("serve",),  # one serving-window checkpoint per seq
+    "serve-dispatch": ("serve-dispatch",),  # one shared window per seq
 }
 
 

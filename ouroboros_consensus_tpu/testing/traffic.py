@@ -1,0 +1,296 @@
+"""Seeded multi-peer candidate-suffix traffic for the serving plane.
+
+The reference's production workload is not one long replay: it is
+thousands of concurrent ChainSync instances each pushing a SHORT
+candidate suffix at the tip (SURVEY.md §3.2/§3.5). This module forges
+that shape deterministically — N tenants (simulated peers), each
+emitting rounds of within-epoch suffixes from its own fork of the
+shared tip — so the serving-plane scheduler (node/serve.py), its
+differential tests and `scripts/profile_serve.py` all drive the SAME
+byte-reproducible traffic from one integer seed.
+
+Convention: STUBBED-CRYPTO, like the profile_replay/profile_forge
+device twins (testing/stubs.install_stub_crypto). Every signature,
+VRF proof and VRF output is a counter-mode Blake2b expansion — zero
+curve operations at forge time, so a 64-tenant x 256-header run
+synthesizes in milliseconds — while everything validation actually
+folds stays REAL: slots, OCert counters, KES window arithmetic, pool
+lookups against the shared ledger view, and the eta/nonce chain
+derived from the (deterministic) declared VRF outputs. Injected
+failures therefore ride the REAL host-side error paths:
+
+  * a counter jump   -> CounterOverIncrementedOCERT at the exact lane
+  * an unknown pool  -> NoCounterForKeyHashOCERT (the stateful counter
+                        check precedes the VRF pool lookup in the
+                        reference order, Praos.hs:585-590)
+
+Traffic shapes (all seeded):
+
+  * follow        — one peer extending the tip, one suffix per round
+  * fork storm    — a group of peers offering COMPETING suffixes from
+                    the same parent: same pool, same slots, distinct
+                    bodies (so distinct etas / distinct chains)
+  * equivocators  — fork-storm pairs sharing the leader pool slot for
+                    slot: the same pool forging two different headers
+                    per slot across two peers
+  * mixed formats — a seeded fraction of tenants carries 128-byte
+                    batch-compatible proofs (the rest draft-03 80-byte),
+                    so shared windows must segregate by proof class
+
+Real networking (mux, delta-Q, peer churn) is NOT simulated — see
+COVERAGE.md §3 for the honesty row."""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from fractions import Fraction
+
+from ..protocol import praos
+from ..protocol.views import HeaderView, LedgerView, OCert
+from . import fixtures
+
+__all__ = [
+    "TrafficConfig", "TenantSpec", "Suffix", "Traffic", "make_traffic",
+]
+
+# draft-03 / batch-compatible ECVRF proof lengths (protocol/views.py)
+PROOF_LEN_DRAFT03 = 80
+PROOF_LEN_BC = 128
+
+
+def _expand(tag: bytes, data: bytes, n: int) -> bytes:
+    """Counter-mode Blake2b expansion — the deterministic byte source
+    for every stubbed signature/proof/output (same family as
+    testing/stubs._expand_host, different tags)."""
+    out = b""
+    i = 0
+    while len(out) < n:
+        out += hashlib.blake2b(
+            tag + i.to_bytes(2, "big") + data, digest_size=32
+        ).digest()
+        i += 1
+    return out[:n]
+
+
+@dataclass(frozen=True)
+class TrafficConfig:
+    """One seeded traffic mix. Defaults are tier-1 sized; the profile
+    script scales n_tenants/suffix_len/rounds up."""
+
+    n_tenants: int = 8
+    seed: int = 0
+    suffix_len: int = 12  # headers per suffix
+    rounds: int = 2  # suffixes per tenant
+    n_pools: int = 4
+    body_len: int = 64  # KES-signed body bytes per header
+    kes_depth: int = 3  # small tree: derive_vk is 2^depth leaf derives
+    bc_every: int = 0  # every k-th tenant uses 128-byte bc proofs (0=off)
+    fork_storm: int = 0  # first `fork_storm` tenants share one parent
+    equivocators: int = 0  # pairs inside the storm sharing pool+slots
+    bad_lane_every: int = 0  # every k-th tenant: one counter jump/round
+    unknown_pool_every: int = 0  # every k-th tenant: one foreign-pool lane
+    base_slot: int = 10
+    slot_stride: int = 3  # slots between a tenant's headers
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One simulated peer: identity, forging pool, proof format and
+    which failure (if any) its suffixes carry."""
+
+    tenant_id: str
+    pool_idx: int
+    proof_len: int = PROOF_LEN_DRAFT03
+    storm_group: int | None = None  # shared-parent fork-storm group
+    equivocal_with: str | None = None  # peer sharing pool+slots
+    bad_lane: int | None = None  # in-suffix index of the counter jump
+    unknown_pool_lane: int | None = None  # in-suffix index of foreign pool
+
+
+@dataclass(frozen=True)
+class Suffix:
+    """One candidate suffix as a peer offers it: tenant, arrival
+    sequence number, and the forged headers in chain order."""
+
+    tenant_id: str
+    seq: int
+    hvs: tuple
+
+
+@dataclass
+class _TenantForgeState:
+    """Forge-side chain cursor per tenant (NOT validation state)."""
+
+    next_slot: int
+    counter: int = 0
+    prev_hash: bytes | None = None
+    suffixes: int = 0
+
+
+class Traffic:
+    """Deterministic traffic source: `suffixes()` yields the full
+    seeded arrival order (round-robin across tenants, the interleaving
+    the scheduler must be fair under); `genesis_state()` is the shared
+    tip state every tenant's candidate chain extends."""
+
+    def __init__(self, cfg: TrafficConfig):
+        if cfg.n_tenants < 1 or cfg.n_pools < 1:
+            raise ValueError("traffic needs >= 1 tenant and >= 1 pool")
+        self.cfg = cfg
+        self.params = praos.PraosParams(
+            slots_per_kes_period=3600,
+            max_kes_evolutions=62,
+            security_param=108,
+            active_slot_coeff=Fraction(1, 2),
+            epoch_length=4320,
+            kes_depth=cfg.kes_depth,
+        )
+        self.pools = [
+            fixtures.make_pool(1000 + i, kes_depth=cfg.kes_depth)
+            for i in range(cfg.n_pools)
+        ]
+        # one pool deliberately OUTSIDE the ledger view: the
+        # unknown-pool failure lane forges from it
+        self.foreign_pool = fixtures.make_pool(9999, kes_depth=cfg.kes_depth)
+        self.lview: LedgerView = fixtures.make_ledger_view(self.pools)
+        self.eta0 = _expand(b"eta0", cfg.seed.to_bytes(8, "big"), 32)
+        self.tenants = self._make_tenants()
+        self._forge: dict[str, _TenantForgeState] = {}
+
+    # -- tenant mix ---------------------------------------------------------
+
+    def _make_tenants(self) -> list[TenantSpec]:
+        cfg = self.cfg
+        out: list[TenantSpec] = []
+        for i in range(cfg.n_tenants):
+            tid = f"peer-{i:03d}"
+            storm = i if i < cfg.fork_storm else None
+            # equivocator pairs live inside the storm: peers 2j/2j+1
+            # forge from the SAME pool over the SAME slots
+            eq_with = None
+            if storm is not None and i < 2 * cfg.equivocators:
+                eq_with = f"peer-{(i ^ 1):03d}"
+            pool_idx = (i // 2 if eq_with is not None else i) % cfg.n_pools
+            plen = (
+                PROOF_LEN_BC
+                if cfg.bc_every and (i % cfg.bc_every == cfg.bc_every - 1)
+                else PROOF_LEN_DRAFT03
+            )
+            bad = (
+                cfg.suffix_len // 2
+                if cfg.bad_lane_every
+                and (i % cfg.bad_lane_every == cfg.bad_lane_every - 1)
+                else None
+            )
+            unk = (
+                cfg.suffix_len // 3
+                if cfg.unknown_pool_every
+                and (i % cfg.unknown_pool_every
+                     == cfg.unknown_pool_every - 1)
+                else None
+            )
+            out.append(TenantSpec(
+                tenant_id=tid, pool_idx=pool_idx, proof_len=plen,
+                storm_group=storm, equivocal_with=eq_with,
+                bad_lane=bad, unknown_pool_lane=unk,
+            ))
+        return out
+
+    # -- forging ------------------------------------------------------------
+
+    def genesis_state(self) -> praos.PraosState:
+        return praos.PraosState(epoch_nonce=self.eta0)
+
+    def _cursor(self, spec: TenantSpec) -> _TenantForgeState:
+        st = self._forge.get(spec.tenant_id)
+        if st is None:
+            # equivocator pairs (and storm members) start on the same
+            # slot grid so their headers COLLIDE slot-for-slot; plain
+            # followers are offset per tenant so shared windows carry
+            # genuinely interleaved slot ranges
+            base = self.cfg.base_slot
+            if spec.storm_group is None:
+                base += (int(spec.tenant_id[-3:]) % 7)
+            st = _TenantForgeState(next_slot=base)
+            self._forge[spec.tenant_id] = st
+        return st
+
+    def _forge_header(self, spec: TenantSpec, slot: int, counter: int,
+                      prev_hash: bytes | None, *, pool=None) -> HeaderView:
+        """One stub-crypto header: real identity/slot/counter columns,
+        expansion-derived signature/proof/output bytes."""
+        pool = pool if pool is not None else self.pools[spec.pool_idx]
+        uid = (spec.tenant_id.encode()
+               + slot.to_bytes(8, "big") + counter.to_bytes(4, "big"))
+        body = _expand(b"body", uid, self.cfg.body_len)
+        beta = _expand(b"beta", pool.pool_id + body, 64)
+        proof = _expand(b"pi", pool.pool_id + body, spec.proof_len)
+        kes_sig = _expand(
+            b"kes", uid, 64 + 32 + 32 * self.cfg.kes_depth
+        )
+        kp = self.params.kes_period_of(slot)
+        ocert = OCert(
+            pool.kes_vk, counter, kp, _expand(b"oc", uid, 64)
+        )
+        return HeaderView(
+            prev_hash=prev_hash,
+            vk_cold=pool.vk_cold,
+            vrf_vk=pool.vrf_vk,
+            vrf_output=beta,
+            vrf_proof=proof,
+            ocert=ocert,
+            slot=slot,
+            signed_bytes=body,
+            kes_sig=kes_sig,
+        )
+
+    def next_suffix(self, spec: TenantSpec) -> Suffix:
+        """The tenant's next candidate suffix, extending its own fork.
+        Failure lanes are injected at the spec's pinned in-suffix index
+        on EVERY round — the valid prefix before them still advances
+        the tenant's chain, exactly like a peer whose candidate is
+        truncated at the first invalid header."""
+        cfg = self.cfg
+        st = self._cursor(spec)
+        hvs: list[HeaderView] = []
+        for j in range(cfg.suffix_len):
+            slot = st.next_slot
+            st.next_slot += cfg.slot_stride
+            counter = st.counter
+            pool = None
+            if j == spec.bad_lane and st.suffixes % 2 == 0:
+                # m <= n <= m+1 violated: the sequential fold raises
+                # CounterOverIncrementedOCERT at exactly this lane
+                counter = st.counter + 5
+            elif j == spec.unknown_pool_lane and st.suffixes % 2 == 1:
+                pool = self.foreign_pool  # NoCounterForKeyHashOCERT lane
+            hv = self._forge_header(
+                spec, slot, counter, st.prev_hash, pool=pool
+            )
+            hvs.append(hv)
+            st.prev_hash = hashlib.blake2b(
+                hv.signed_bytes + slot.to_bytes(8, "big"),
+                digest_size=32,
+            ).digest()
+        st.suffixes += 1
+        return Suffix(spec.tenant_id, st.suffixes - 1, tuple(hvs))
+
+    def suffixes(self):
+        """The full seeded arrival order: `rounds` passes, round-robin
+        across tenants (the adversarial interleaving for fairness and
+        cross-tenant-bleed tests)."""
+        for _ in range(self.cfg.rounds):
+            for spec in self.tenants:
+                yield self.next_suffix(spec)
+
+    def reset(self) -> None:
+        """Forget all forge cursors: the next `suffixes()` pass
+        regenerates the byte-identical stream (sigkill-resume tests
+        re-derive the undelivered tail from the same seed)."""
+        self._forge.clear()
+
+
+def make_traffic(**kw) -> Traffic:
+    """Convenience: Traffic(TrafficConfig(**kw))."""
+    return Traffic(TrafficConfig(**kw))

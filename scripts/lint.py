@@ -127,7 +127,14 @@ _SYNC_PREFIXES = ("ouroboros_consensus_tpu/obs/",
 _SYNC_FILES = {"ouroboros_consensus_tpu/testing/chaos.py",
                "ouroboros_consensus_tpu/protocol/batch.py",
                "ouroboros_consensus_tpu/ops/pk/aot.py",
-               "bench.py"}
+               "bench.py",
+               # the serving plane (round 20): the scheduler's service
+               # lock + checkpoint rename discipline, the lock-free
+               # admission single-writer contract, and the seeded
+               # traffic source the chaos matrix drives through it
+               "ouroboros_consensus_tpu/node/serve.py",
+               "ouroboros_consensus_tpu/protocol/admission.py",
+               "ouroboros_consensus_tpu/testing/traffic.py"}
 
 
 def _sync_selected(changed: set[str]) -> bool:
@@ -152,7 +159,14 @@ _FLOW_FILES = {"ouroboros_consensus_tpu/node/exit.py",
                "ouroboros_consensus_tpu/protocol/batch.py",
                "ouroboros_consensus_tpu/protocol/forge.py",
                "ouroboros_consensus_tpu/protocol/tpraos.py",
-               "ouroboros_consensus_tpu/testing/chaos.py"}
+               "ouroboros_consensus_tpu/testing/chaos.py",
+               # the serving plane (round 20): its dispatch seam must
+               # stay ladder-protected (FLOW304), AdmissionRefused is a
+               # classified raise (FLOW301), and OCT_SERVE_DEVICE is a
+               # documented lever (FLOW305)
+               "ouroboros_consensus_tpu/node/serve.py",
+               "ouroboros_consensus_tpu/protocol/admission.py",
+               "ouroboros_consensus_tpu/testing/traffic.py"}
 
 
 def _flow_selected(changed: set[str]) -> bool:

@@ -635,6 +635,37 @@ def render_markdown(report: dict) -> str:
                     e.get("slots_per_s") or 0,
                     f"{speed}x" if speed else "—",
                 ))
+    sv = report.get("serve")
+    if sv:
+        out += ["", "## Serving plane", ""]
+        out.append(
+            "Follow-the-tip serving rates (`profile_serve.py`): the "
+            "same seeded multi-peer suffix traffic validated as one "
+            "window per peer (the naive port) vs continuous-batched "
+            "shared windows (PR 20), verdict-identical by assertion. "
+            "The SLO columns are the live `/slo` document scraped "
+            "during the batched run."
+        )
+        out.append("")
+        out.append("| run | tenants | mode | headers | windows | "
+                   "headers/s | speedup | p50 s | p99 s |")
+        out.append("|---|---|---|---|---|---|---|---|---|")
+        for r in sv["runs"]:
+            slo = r.get("slo") or {}
+            for m in r["modes"]:
+                batched = m.get("mode") == "batched"
+                p50 = slo.get("verdict_latency_p50_s")
+                p99 = slo.get("verdict_latency_p99_s")
+                out.append("| {} | {} | {} | {} | {} | {:,} | {} | {} | {} |".format(
+                    (r.get("ts") or "?")[:19], r.get("tenants") or "?",
+                    m.get("mode") or "?", m.get("headers") or "?",
+                    m.get("windows") or "?",
+                    m.get("headers_per_s") or 0,
+                    (f"{r['speedup']}x" if batched and r.get("speedup")
+                     else "—"),
+                    (round(p50, 4) if batched and p50 is not None else "—"),
+                    (round(p99, 4) if batched and p99 is not None else "—"),
+                ))
     mc = report.get("multichip_rounds") or []
     if mc:
         out += ["", "## Multichip", ""]
@@ -740,6 +771,34 @@ def forge_section(ledger_dir: str | None) -> dict | None:
     return {"runs": rows}
 
 
+def serve_section(ledger_dir: str | None) -> dict | None:
+    """The serving-plane trajectory: every `profile_serve` ledger
+    record (continuous batching vs one-window-per-peer, with the
+    scraped /slo document). Fail-soft like the ledger section."""
+    rows = []
+    try:
+        from ouroboros_consensus_tpu.obs import ledger
+
+        for r in ledger.read_runs(ledger_dir, kind="profile_serve"):
+            cfg = r.get("config") or {}
+            res = r.get("result") or {}
+            rows.append({
+                "ts": r.get("ts_iso"),
+                "tenants": cfg.get("tenants"),
+                "rounds": cfg.get("rounds"),
+                "suffix_len": cfg.get("suffix_len"),
+                "max_window": cfg.get("max_window"),
+                "modes": res.get("modes") or [],
+                "speedup": res.get("speedup_batched_vs_per_peer"),
+                "slo": res.get("slo") or {},
+            })
+    except Exception:  # noqa: BLE001 — report survives a broken ledger
+        pass
+    if not rows:
+        return None
+    return {"runs": rows}
+
+
 def point_ops_section() -> dict | None:
     """The ratcheted per-lane point-op pins from budgets.json — no
     tracing, a dict read: the STATIC perf trajectory (what the
@@ -788,6 +847,7 @@ def build_report(dir_: str, threshold: float | None,
         "point_ops": point_ops_section(),
         "host_ceiling": host_ceiling_section(ledger_dir),
         "forge": forge_section(ledger_dir),
+        "serve": serve_section(ledger_dir),
         "verdicts": verdicts,
         "ok": all(v["ok"] for v in verdicts),
     }

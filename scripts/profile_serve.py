@@ -1,0 +1,204 @@
+"""Profiled serving plane: aggregate headers/s, continuous batching
+vs one-window-per-peer.
+
+Drives the SAME seeded multi-peer traffic (testing/traffic.py) through
+two serving disciplines:
+
+  * `batched` — node/serve.ValidationService: continuous batching of
+    candidate suffixes from all tenants into shared packed windows
+    (the PR-20 serving plane);
+  * `per-peer` — the naive port: every peer's every suffix dispatched
+    as its OWN device window (`validate_batch` per suffix), padded to
+    its own tiny bucket — the one-window-per-peer baseline the
+    continuous batcher exists to beat.
+
+Convention is the STUBBED-CRYPTO DEVICE TWIN (testing/stubs
+`install_stub_crypto`, same as profile_replay/profile_forge): both
+disciplines validate byte-identical traffic through the same stubbed
+packed programs, so what the A/B isolates is the WINDOWING — per-peer
+dispatch walls and minimum-bucket padding vs shared full windows. Both
+modes pay an untimed warmup pass first (compiles + jit caches); rates
+are steady-state.
+
+The run also mounts the live SLO endpoint (obs/server.py `/slo`) on an
+ephemeral port and banks the scraped document — p50/p99 verdict
+latency, aggregate headers/s, queue depths, degraded flag — alongside
+the rate table in one run-ledger record (`kind=profile_serve`); the
+"Serving plane" section of scripts/perf_report.py renders the
+trajectory across runs.
+
+Usage: python scripts/profile_serve.py [tenants] [--rounds=N]
+         [--suffix-len=N] [--max-window=N] [--seed=N] [--check=4.0]
+       (default 64 tenants, 4 rounds, 8-header suffixes, 256-lane
+        windows; --check=X exits 1 unless batched >= X x per-peer)
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ARGS = [a for a in sys.argv[1:] if not a.startswith("--")]
+TENANTS = int(ARGS[0]) if ARGS else 64
+
+
+def _opt(name: str, default, cast=int):
+    return next((cast(a.split("=", 1)[1]) for a in sys.argv[1:]
+                 if a.startswith(f"--{name}=")), default)
+
+
+ROUNDS = _opt("rounds", 4)
+SUFFIX_LEN = _opt("suffix-len", 8)
+MAX_WINDOW = _opt("max-window", 256)
+SEED = _opt("seed", 0)
+CHECK = _opt("check", None, float)
+
+
+class _Patch:
+    """install_stub_crypto's monkeypatch surface (setattr only) without
+    pytest — the patches live for the process, which is the point."""
+
+    def setattr(self, obj, name, value):
+        setattr(obj, name, value)
+
+
+def _mk_traffic():
+    from ouroboros_consensus_tpu.testing import traffic
+
+    # the tier-1 mix at profile scale: mixed draft-03/bc tenants, fork
+    # storms, equivocating pools, both injected failure classes
+    return traffic.make_traffic(
+        n_tenants=TENANTS, rounds=ROUNDS, suffix_len=SUFFIX_LEN,
+        seed=SEED, bc_every=4, fork_storm=max(2, TENANTS // 8),
+        equivocators=max(1, TENANTS // 16), bad_lane_every=7,
+        unknown_pool_every=11,
+    )
+
+
+def run_batched(timed: bool) -> dict:
+    from ouroboros_consensus_tpu.node import serve
+    from ouroboros_consensus_tpu.obs import server as obs_server
+    from ouroboros_consensus_tpu.obs.registry import MetricsRegistry
+
+    tr = _mk_traffic()
+    reg = MetricsRegistry()
+    svc = serve.ValidationService(tr.params, tr.lview, tr.eta0,
+                                  registry=reg, max_window=MAX_WINDOW)
+    srv = obs_server.MetricsServer(registry=reg,
+                                   slo_doc=svc.slo_snapshot) if timed else None
+    t0 = time.monotonic()
+    for sfx in tr.suffixes():
+        svc.submit(sfx.tenant_id, sfx.hvs)
+    svc.run_until_drained()
+    wall = time.monotonic() - t0
+    headers = sum(t.headers_done for t in svc.tenants.values())
+    suffixes = sum(t.done for t in svc.tenants.values())
+    slo = None
+    if srv is not None:
+        slo = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/slo"))
+        srv.close()
+    return {
+        "mode": "batched", "headers": headers, "suffixes": suffixes,
+        "windows": svc.windows, "wall_s": round(wall, 3),
+        "headers_per_s": round(headers / wall, 1),
+        "slo": slo,
+        "verdicts": {s.tenant_id: [v.row() for v in
+                                   svc.verdicts(s.tenant_id)]
+                     for s in tr.tenants},
+    }
+
+
+def run_per_peer() -> dict:
+    """The naive baseline: one device window per peer per suffix —
+    same traffic, same packed path, no sharing. First-failure fold per
+    suffix against the peer's own state, exactly like the service."""
+    from ouroboros_consensus_tpu.node import serve
+    from ouroboros_consensus_tpu.protocol import batch as pbatch
+    from ouroboros_consensus_tpu.protocol import praos
+
+    tr = _mk_traffic()
+    states = {s.tenant_id: tr.genesis_state() for s in tr.tenants}
+    rows: dict[str, list] = {s.tenant_id: [] for s in tr.tenants}
+    headers = 0
+    windows = 0
+    t0 = time.monotonic()
+    for sfx in tr.suffixes():
+        st = states[sfx.tenant_id]
+        ticked = praos.tick(tr.params, tr.lview, sfx.hvs[0].slot, st)
+        res = pbatch.validate_batch(tr.params, ticked, list(sfx.hvs))
+        states[sfx.tenant_id] = res.state
+        headers += res.n_valid
+        windows += 1
+        rows[sfx.tenant_id].append(
+            [sfx.seq, res.n_valid, serve._canon_error(res.error)]
+        )
+    wall = time.monotonic() - t0
+    return {
+        "mode": "per-peer", "headers": headers,
+        "suffixes": sum(len(r) for r in rows.values()),
+        "windows": windows, "wall_s": round(wall, 3),
+        "headers_per_s": round(headers / wall, 1),
+        "verdicts": rows,
+    }
+
+
+def main() -> int:
+    from ouroboros_consensus_tpu.testing import stubs
+
+    stubs.install_stub_crypto(_Patch())
+    print(f"profile_serve: {TENANTS} tenants x {ROUNDS} rounds x "
+          f"{SUFFIX_LEN}-header suffixes, {MAX_WINDOW}-lane windows, "
+          "stub crypto", flush=True)
+
+    # untimed warmup pass per discipline: compiles + jit caches for
+    # every bucket shape the timed pass will dispatch
+    run_batched(timed=False)
+    run_per_peer()
+
+    batched = run_batched(timed=True)
+    per_peer = run_per_peer()
+
+    # the A/B is only meaningful if both disciplines produced the SAME
+    # verdicts on the same seeded traffic — assert it, loudly
+    if batched["verdicts"] != per_peer["verdicts"]:
+        print("FATAL: batched and per-peer verdicts diverge", flush=True)
+        return 2
+    speedup = (batched["headers_per_s"] / per_peer["headers_per_s"]
+               if per_peer["headers_per_s"] else 0.0)
+    for row in (per_peer, batched):
+        print(f"  {row['mode']:9s} {row['headers']:>7d} headers "
+              f"{row['windows']:>5d} windows in {row['wall_s']:8.2f}s "
+              f"-> {row['headers_per_s']:>10.1f} headers/s", flush=True)
+    print(f"  batched_vs_per_peer: {speedup:.1f}x", flush=True)
+    slo = batched.get("slo") or {}
+    print(f"  slo: p50={slo.get('verdict_latency_p50_s')} "
+          f"p99={slo.get('verdict_latency_p99_s')} "
+          f"degraded={slo.get('degraded')}", flush=True)
+
+    from ouroboros_consensus_tpu.obs import ledger
+
+    for row in (batched, per_peer):
+        row.pop("verdicts")  # byte-identity asserted; too big to bank
+    ledger.record_replay(
+        "profile_serve",
+        config={"tenants": TENANTS, "rounds": ROUNDS,
+                "suffix_len": SUFFIX_LEN, "max_window": MAX_WINDOW,
+                "seed": SEED, "crypto": "stub"},
+        result={"modes": [per_peer, batched],
+                "speedup_batched_vs_per_peer": round(speedup, 1),
+                "slo": slo},
+    )
+    if CHECK is not None and speedup < CHECK:
+        print(f"CHECK FAILED: {speedup:.1f}x < {CHECK:g}x", flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

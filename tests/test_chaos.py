@@ -87,6 +87,29 @@ def test_parse_write_path_fault_kinds():
     assert named.arg == "clean"
 
 
+def test_parse_serving_plane_fault_sites():
+    """The round-20 serving-plane cells: device-error places at the
+    shared-window dispatch seam, sigkill at the per-window retire seam
+    (AFTER its checkpoint lands) — both fenced off every other kind's
+    sites so a serve spec can never satisfy a replay counter."""
+    injs = chaos.parse_spec(
+        "device-error@serve-dispatch:1, sigkill@serve:2"
+    )
+    assert injs[0].trigger == "serve-dispatch" and injs[0].arg == 1
+    assert injs[1].trigger == "serve" and injs[1].arg == 2
+    assert "serve-dispatch" in chaos._KIND_SITES["device-error"]
+    assert "serve" in chaos._KIND_SITES["sigkill"]
+    # site fencing: the serving seams answer ONLY their own triggers
+    assert chaos._SITE_TRIGGER_KEYS["serve"] == ("serve",)
+    assert chaos._SITE_TRIGGER_KEYS["serve-dispatch"] == (
+        "serve-dispatch",
+    )
+    # ...and no other fault kind may place at the serving seams
+    for kind, sites in chaos._KIND_SITES.items():
+        if kind not in ("device-error", "sigkill"):
+            assert "serve" not in sites and "serve-dispatch" not in sites
+
+
 def test_write_path_malformed_specs_fail_loudly():
     # the no-arg sugar belongs to partial-rename@marker ONLY — a bare
     # trigger on any other kind is still the silently-misplaced shape

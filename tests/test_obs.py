@@ -725,6 +725,7 @@ def _readme_metric_names():
 def _registered_metric_names():
     import re
 
+    from ouroboros_consensus_tpu.node import serve as node_serve
     from ouroboros_consensus_tpu.obs import resources as obs_resources
     from ouroboros_consensus_tpu.obs import server as obs_server
     from ouroboros_consensus_tpu.obs.recorder import FlightRecorder
@@ -735,10 +736,10 @@ def _registered_metric_names():
     NodeMetrics().bind(reg)
     obs_resources.register_families(reg)
     names = set(reg._families)
-    # the immdb server and the (factored-out) HTTP endpoint register
-    # their families at serve time: hold them to the same contract via
-    # their registration literals
-    for mod in (immdb_server, obs_server):
+    # the immdb server, the (factored-out) HTTP endpoint and the serving
+    # plane register their families at serve time: hold them to the same
+    # contract via their registration literals
+    for mod in (immdb_server, obs_server, node_serve):
         with open(mod.__file__, encoding="utf-8") as f:
             names |= set(re.findall(r'"(oct_[a-z0-9_]+)"', f.read()))
     return names
